@@ -1,0 +1,27 @@
+"""photon-ml-tpu: a TPU-native framework with the capabilities of Photon-ML.
+
+A from-scratch JAX/XLA/Pallas re-design of LinkedIn Photon-ML
+(reference: lazycrazyowl/photon-ml, a fork of linkedin/photon-ml):
+large-scale Generalized Linear Models (logistic / linear / Poisson /
+smoothed-hinge SVM with L1/L2/elastic-net) and GAME/GLMix mixed-effect
+models, built TPU-first:
+
+- per-shard math as pure jittable functions; gradients and Hessian-vector
+  products derived by autodiff (replacing the reference's hand-written
+  aggregators in ``photon-api/.../function/glm/*Aggregator.scala``),
+- L-BFGS / OWLQN / TRON as ``lax.while_loop``-compiled on-device optimizers
+  (replacing breeze-backed ``photon-lib/.../optimization/{LBFGS,OWLQN,TRON}.scala``),
+- data-parallel reductions via ``psum`` over ICI on a ``jax.sharding.Mesh``
+  (replacing ``RDD.treeAggregate``),
+- entity-sharded ``vmap``-batched local solves for random effects
+  (replacing per-executor training in
+  ``photon-api/.../algorithm/RandomEffectCoordinate.scala``).
+
+Citation convention: docstrings cite reference files by repo-relative path.
+At survey time the reference mount was empty, so line numbers are
+deliberately omitted (see SURVEY.md provenance caveat).
+"""
+
+__version__ = "0.1.0"
+
+from photon_ml_tpu.types import TaskType  # noqa: F401

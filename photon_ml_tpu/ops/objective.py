@@ -1,0 +1,180 @@
+"""GLM objective: value / gradient / Hessian-vector product by autodiff.
+
+TPU-first replacement for the reference's objective-function hierarchy
+(``photon-api/.../function/ObjectiveFunction.scala``, ``DiffFunction.scala``,
+``TwiceDiffFunction.scala``, ``function/glm/DistributedGLMLossFunction.scala``,
+``function/glm/SingleNodeGLMLossFunction.scala`` and the four aggregator
+classes ``ValueAndGradientAggregator`` / ``HessianVectorAggregator`` /
+``HessianDiagonalAggregator`` / ``HessianMatrixAggregator``).
+
+Design stance (SURVEY.md §7): define only the per-sample pointwise loss and the
+(linear) margin model; derive everything else:
+
+- value: ``sum_i weight_i * l(margin_i, label_i) + 0.5 * l2 * ||w_reg||^2``
+- gradient: ``jax.grad`` of that pure function,
+- Hessian-vector product: ``jax.jvp`` of the gradient — exact for GLMs
+  (the margin is linear in ``w``, so forward-over-reverse equals
+  ``X^T diag(d2) X v + l2 v``, the quantity TRON needs),
+- Hessian diagonal / full matrix (for variance computation): closed-form
+  contractions using the loss's ``d2``.
+
+Everything here is a pure function of ``(w, data, l2)`` and safe under
+``jit`` / ``vmap`` / ``shard_map``; the distributed ("DistributedGLMLossFunction")
+variant is these same functions wrapped in a ``psum`` by
+:mod:`photon_ml_tpu.parallel.distributed` — one code path from a single chip
+to a pod, replacing the RDD ``treeAggregate`` tree.
+
+Normalization is applied as a coefficient-space reparameterization
+(:mod:`photon_ml_tpu.ops.normalization`) — transformed-space margins are
+computed on raw features on the fly, never materializing scaled data, matching
+the reference's normalization-aware aggregators.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.ops.design import CsrDesign, DenseDesign, Design
+from photon_ml_tpu.ops.losses import PointwiseLoss
+from photon_ml_tpu.ops.normalization import NormalizationContext, NoNormalization
+
+Array = jax.Array
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class GLMData:
+    """One batch/shard of labeled GLM data.
+
+    Counterpart of the reference's ``data/LabeledPoint.scala`` collection:
+    ``labels`` ``(n,)``, per-sample additive ``offsets`` ``(n,)`` (the residual
+    scores that make GAME coordinate descent work), non-negative ``weights``
+    ``(n,)``. ``weights`` may also encode padding: a padded row has weight 0
+    and contributes exactly nothing to value/grad/Hvp, which is what makes
+    fixed-shape bucketing of ragged entity data correct.
+    """
+
+    design: Design
+    labels: Array
+    offsets: Array
+    weights: Array
+
+    @property
+    def n_samples(self) -> int:
+        return self.design.n_samples
+
+    @property
+    def dim(self) -> int:
+        return self.design.dim
+
+    def with_offsets(self, offsets: Array) -> "GLMData":
+        return dataclasses.replace(self, offsets=offsets)
+
+
+@dataclasses.dataclass(frozen=True)
+class GLMObjective:
+    """Pure-functional twice-differentiable GLM objective.
+
+    Static configuration only (the pointwise loss, the normalization context,
+    and an optional L2 mask); all numeric state flows through arguments so a
+    single compilation serves every lambda in a regularization sweep (the
+    reference's warm-start sweep in ``ModelTraining.scala``).
+
+    ``reg_mask`` is an optional ``(d,)`` 0/1 vector selecting which
+    coefficients the L2 term touches (e.g. to exempt the intercept).
+    """
+
+    loss: PointwiseLoss
+    normalization: NormalizationContext = NoNormalization
+    reg_mask: Optional[Array] = None
+
+    # --- margins ----------------------------------------------------------
+    def margins(self, w: Array, data: GLMData) -> Array:
+        w_eff, margin_shift = self.normalization.transform_coefficients(w)
+        return data.design.matvec(w_eff) + margin_shift + data.offsets
+
+    # --- objective value --------------------------------------------------
+    def _l2_term(self, w: Array, l2) -> Array:
+        wr = w if self.reg_mask is None else w * self.reg_mask
+        return 0.5 * l2 * jnp.vdot(wr, wr)
+
+    def value(self, w: Array, data: GLMData, l2=0.0) -> Array:
+        m = self.margins(w, data)
+        per_sample = self.loss.loss(m, data.labels)
+        return jnp.sum(data.weights * per_sample) + self._l2_term(w, l2)
+
+    # --- derivatives (autodiff) ------------------------------------------
+    def value_and_grad(self, w: Array, data: GLMData, l2=0.0) -> tuple[Array, Array]:
+        return jax.value_and_grad(self.value)(w, data, l2)
+
+    def grad(self, w: Array, data: GLMData, l2=0.0) -> Array:
+        return jax.grad(self.value)(w, data, l2)
+
+    def hvp(self, w: Array, v: Array, data: GLMData, l2=0.0) -> Array:
+        """Exact Hessian-vector product via forward-over-reverse autodiff.
+
+        Replaces ``HessianVectorAggregator.scala``; feeds TRON's inner CG.
+        """
+        g = lambda w_: jax.grad(self.value)(w_, data, l2)
+        return jax.jvp(g, (w,), (v,))[1]
+
+    # --- closed-form second-order contractions (for variance) -------------
+    def _d2_weights(self, w: Array, data: GLMData) -> Array:
+        m = self.margins(w, data)
+        return data.weights * self.loss.d2(m, data.labels)
+
+    def hessian_diagonal(self, w: Array, data: GLMData, l2=0.0) -> Array:
+        """Diagonal of the Hessian in *transformed* feature space.
+
+        Replaces ``HessianDiagonalAggregator.scala`` (VarianceComputationType
+        SIMPLE). Computed as ``sum_i d2_i * x'_ij^2`` via one Hvp-free pass:
+        for the dense design it is an einsum; for sparse, a scatter-add of
+        squared values.
+        """
+        d2 = self._d2_weights(w, data)
+        design = data.design
+        factors = self.normalization.factors
+
+        if isinstance(design, DenseDesign):
+            x = design.x
+            if self.normalization.shifts is not None:
+                x = x - self.normalization.shifts
+            if factors is not None:
+                x = x * factors
+            diag = jnp.einsum("nd,n->d", jnp.square(x), d2,
+                              preferred_element_type=jnp.promote_types(x.dtype, jnp.float32))
+        elif isinstance(design, CsrDesign):
+            vals = design.values if factors is None else design.values * jnp.take(factors, design.cols)
+            contrib = jnp.square(vals) * jnp.take(d2, design.rows)
+            diag = jnp.zeros((design.dim,), contrib.dtype).at[design.cols].add(contrib)
+            if self.normalization.shifts is not None:
+                raise NotImplementedError(
+                    "hessian_diagonal with shift-normalization on sparse designs")
+        else:
+            raise TypeError(type(design))
+        if self.reg_mask is None:
+            return diag + l2
+        return diag + l2 * self.reg_mask
+
+    def hessian_matrix(self, w: Array, data: GLMData, l2=0.0) -> Array:
+        """Full ``(d, d)`` Hessian (VarianceComputationType FULL; replaces
+        ``HessianMatrixAggregator.scala``). Only for small ``d`` — the
+        reference has the same restriction."""
+        d2 = self._d2_weights(w, data)
+        if not isinstance(data.design, DenseDesign):
+            # Materialize through Hvp columns for sparse designs.
+            eye = jnp.eye(data.dim, dtype=w.dtype)
+            return jax.vmap(lambda v: self.hvp(w, v, data, l2))(eye).T
+        x = data.design.x
+        if self.normalization.shifts is not None:
+            x = x - self.normalization.shifts
+        if self.normalization.factors is not None:
+            x = x * self.normalization.factors
+        h = jnp.einsum("nd,n,ne->de", x, d2, x,
+                       preferred_element_type=jnp.promote_types(x.dtype, jnp.float32))
+        reg = l2 if self.reg_mask is None else l2 * self.reg_mask
+        return h + jnp.diag(jnp.broadcast_to(reg, (data.dim,)))

@@ -1,0 +1,65 @@
+"""L1 / L2 / elastic-net regularization contexts.
+
+Re-design of ``photon-lib/.../optimization/RegularizationContext.scala`` and
+``ElasticNetRegularizationContext``: a regularization *type* plus an elastic-net
+mixing weight ``alpha`` split one scalar ``regularization_weight`` (lambda) into
+
+- a smooth L2 part, folded into the differentiable objective
+  (value and gradient) exactly as the reference's ``L2RegularizationDiff``, and
+- a non-smooth L1 part handled by the optimizer (OWLQN pseudo-gradient /
+  orthant projection), never differentiated.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from photon_ml_tpu.types import RegularizationType
+
+
+@dataclasses.dataclass(frozen=True)
+class RegularizationContext:
+    """How a single lambda is split between L1 and L2 penalties.
+
+    ``alpha`` follows the reference/glmnet convention: the fraction of the
+    penalty that is L1. ``alpha=1`` is pure L1 (lasso), ``alpha=0`` pure L2
+    (ridge). For ``RegularizationType.L1``/``L2`` alpha is forced to 1/0.
+    """
+
+    reg_type: RegularizationType = RegularizationType.NONE
+    alpha: float = 0.0
+
+    def __post_init__(self) -> None:
+        if self.reg_type == RegularizationType.ELASTIC_NET:
+            if not 0.0 <= self.alpha <= 1.0:
+                raise ValueError(f"elastic-net alpha must be in [0,1], got {self.alpha}")
+        elif self.reg_type == RegularizationType.L1:
+            object.__setattr__(self, "alpha", 1.0)
+        else:
+            object.__setattr__(self, "alpha", 0.0)
+
+    def l1_weight(self, regularization_weight: float) -> float:
+        """The L1 coefficient handed to OWLQN (``alpha * lambda``)."""
+        if self.reg_type == RegularizationType.NONE:
+            return 0.0
+        return self.alpha * regularization_weight
+
+    def l2_weight(self, regularization_weight: float) -> float:
+        """The smooth L2 coefficient folded into the objective
+        (``(1 - alpha) * lambda``; 0 for ``NONE`` regardless of lambda)."""
+        if self.reg_type == RegularizationType.NONE:
+            return 0.0
+        return (1.0 - self.alpha) * regularization_weight
+
+    @property
+    def has_l1(self) -> bool:
+        return self.reg_type in (RegularizationType.L1, RegularizationType.ELASTIC_NET) and self.alpha > 0.0
+
+
+NoRegularization = RegularizationContext(RegularizationType.NONE)
+L1Regularization = RegularizationContext(RegularizationType.L1, alpha=1.0)
+L2Regularization = RegularizationContext(RegularizationType.L2, alpha=0.0)
+
+
+def elastic_net(alpha: float) -> RegularizationContext:
+    return RegularizationContext(RegularizationType.ELASTIC_NET, alpha=alpha)

@@ -1,0 +1,7 @@
+from photon_ml_tpu.optimize.common import (  # noqa: F401
+    OptimizerConfig,
+    OptimizerResult,
+)
+from photon_ml_tpu.optimize.lbfgs import minimize_lbfgs  # noqa: F401
+from photon_ml_tpu.optimize.owlqn import minimize_owlqn  # noqa: F401
+from photon_ml_tpu.optimize.tron import minimize_tron  # noqa: F401

@@ -1,0 +1,162 @@
+"""OWL-QN (orthant-wise L-BFGS) for L1 / elastic-net, as a ``lax.while_loop``.
+
+Replacement for ``photon-lib/.../optimization/OWLQN.scala`` (a wrapper over
+``breeze.optimize.OWLQN``). Implements Andrew & Gao (2007): the smooth part of
+the objective flows through the L-BFGS machinery (curvature pairs built from
+*smooth* gradients), while the L1 term enters only via
+
+- the **pseudo-gradient** (sub-gradient choice that locally steepest-descends
+  the full objective),
+- **direction alignment** (zero the quasi-Newton direction where it disagrees
+  with the pseudo-gradient's descent orthant),
+- **orthant projection** of each line-search trial point (coordinates that
+  cross zero are clamped to zero — this is what produces exact sparsity).
+
+The hard part on TPU (SURVEY.md §7 "hard parts" #3) is that all of this is
+data-dependent per-coordinate control flow; here it is expressed branch-free
+with ``jnp.where`` masks so the whole solver stays one compiled loop.
+
+``l1_weight`` may be a scalar or a per-coordinate vector (e.g. to exempt the
+intercept from L1, matching the reference's intercept handling).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from photon_ml_tpu.optimize.common import (
+    OptimizerConfig,
+    OptimizerResult,
+    ValueAndGrad,
+    armijo_backtracking,
+    init_trace,
+    record_trace,
+    update_history,
+)
+from photon_ml_tpu.optimize.lbfgs import _ARMIJO_C1, _EPS, two_loop_direction
+
+Array = jax.Array
+
+
+def pseudo_gradient(w: Array, g: Array, l1: Array) -> Array:
+    """Sub-gradient selection for f(w) + ||l1 * w||_1 (Andrew & Gao eq. 4)."""
+    right = g + l1  # derivative moving toward +
+    left = g - l1  # derivative moving toward -
+    pg_zero = jnp.where(right < 0, right, jnp.where(left > 0, left, 0.0))
+    return jnp.where(w > 0, right, jnp.where(w < 0, left, pg_zero))
+
+
+def _l1_norm(w: Array, l1: Array) -> Array:
+    return jnp.sum(l1 * jnp.abs(w))
+
+
+def minimize_owlqn(fun: ValueAndGrad, w0: Array, l1_weight,
+                   config: OptimizerConfig = OptimizerConfig()) -> OptimizerResult:
+    """Minimize ``fun(w) + ||l1_weight * w||_1``. Jittable and vmappable.
+
+    ``fun`` must be the *smooth* part only (loss + L2); pass the L1 weight
+    separately exactly as the reference passes ``l1RegWeight`` to breeze OWLQN
+    apart from the smooth objective.
+    """
+    m, d = config.history, w0.shape[-1]
+    l1 = jnp.broadcast_to(jnp.asarray(l1_weight, w0.dtype), w0.shape)
+
+    f0_s, g0 = fun(w0)
+    f0 = f0_s + _l1_norm(w0, l1)
+    pg0 = pseudo_gradient(w0, g0, l1)
+    pgnorm0 = jnp.linalg.norm(pg0)
+    values, gnorms = init_trace(config, f0, pgnorm0)
+    tol = config.tolerance * jnp.maximum(pgnorm0, 1.0)
+
+    State = _State
+    init = State(
+        w=w0, f=f0, g=g0, pg=pg0,
+        s_hist=jnp.zeros((m, d), w0.dtype),
+        y_hist=jnp.zeros((m, d), w0.dtype),
+        rho=jnp.zeros((m,), w0.dtype),
+        n_pairs=jnp.int32(0), it=jnp.int32(0),
+        converged=pgnorm0 <= tol, failed=jnp.asarray(False),
+        values=values, grad_norms=gnorms,
+    )
+
+    def cond(s):
+        return (~s.converged) & (~s.failed) & (s.it < config.max_iterations)
+
+    def body(s):
+        d_dir = two_loop_direction(s.pg, s.s_hist, s.y_hist, s.rho, s.n_pairs, m)
+        # Align with the pseudo-gradient descent orthant (A&G constraint):
+        # keep components where d and -pg agree in sign.
+        d_dir = jnp.where(d_dir * s.pg < 0, d_dir, 0.0)
+        # Fallback to steepest descent on degenerate direction.
+        degenerate = jnp.vdot(d_dir, s.pg) >= 0
+        d_dir = jnp.where(degenerate, -s.pg, d_dir)
+
+        # Chosen orthant: sign(w), or sign(-pg) at zero coordinates.
+        xi = jnp.where(s.w != 0, jnp.sign(s.w), jnp.sign(-s.pg))
+
+        alpha0 = jnp.where(s.n_pairs > 0, 1.0,
+                           1.0 / jnp.maximum(jnp.linalg.norm(d_dir), 1.0))
+
+        def trial(alpha):
+            w_t = s.w + alpha * d_dir
+            w_t = jnp.where(jnp.sign(w_t) == xi, w_t, 0.0)  # orthant projection
+            f_s, g_t = fun(w_t)
+            return w_t, f_s + _l1_norm(w_t, l1), g_t
+
+        def sufficient(alpha, w_t, f_t):
+            # Armijo on the projected step, directional derivative pg.(w_t - w).
+            return f_t <= s.f + _ARMIJO_C1 * jnp.vdot(s.pg, w_t - s.w)
+
+        alpha, w_new, f_new, g_new, ok = armijo_backtracking(
+            trial, sufficient, alpha0, config.max_line_search)
+
+        # Curvature pairs from smooth-gradient differences (A&G).
+        s_hist, y_hist, rho, n_pairs = update_history(
+            s.s_hist, s.y_hist, s.rho, s.n_pairs, w_new - s.w, g_new - s.g, ok,
+            _EPS)
+
+        pg_new = pseudo_gradient(w_new, g_new, l1)
+        pgnorm = jnp.linalg.norm(pg_new)
+        it = s.it + 1
+        values, gnorms = record_trace(
+            s.values, s.grad_norms, it,
+            jnp.where(ok, f_new, s.f),
+            jnp.where(ok, pgnorm, jnp.linalg.norm(s.pg)))
+        return State(
+            w=jnp.where(ok, w_new, s.w),
+            f=jnp.where(ok, f_new, s.f),
+            g=jnp.where(ok, g_new, s.g),
+            pg=jnp.where(ok, pg_new, s.pg),
+            s_hist=s_hist, y_hist=y_hist, rho=rho, n_pairs=n_pairs,
+            it=it, converged=ok & (pgnorm <= tol), failed=~ok,
+            values=values, grad_norms=gnorms,
+        )
+
+    final = lax.while_loop(cond, body, init)
+    return OptimizerResult(
+        w=final.w, value=final.f, grad_norm=jnp.linalg.norm(final.pg),
+        iterations=final.it, converged=final.converged,
+        values=final.values, grad_norms=final.grad_norms,
+    )
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class _State:
+    w: Array
+    f: Array
+    g: Array
+    pg: Array
+    s_hist: Array
+    y_hist: Array
+    rho: Array
+    n_pairs: Array
+    it: Array
+    converged: Array
+    failed: Array
+    values: Array
+    grad_norms: Array

@@ -1,0 +1,85 @@
+"""Finite-difference checks of pointwise losses.
+
+Port of the reference's unit-test idea in
+``photon-api/src/test/.../function/glm/*LossFunctionTest.scala``: verify the
+hand-written first/second margin derivatives against numerical differentiation
+and against autodiff.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.ops.losses import (
+    LogisticLoss,
+    PoissonLoss,
+    SmoothedHingeLoss,
+    SquaredLoss,
+    loss_for_task,
+)
+from photon_ml_tpu.types import TaskType
+
+ALL_LOSSES = [LogisticLoss, SquaredLoss, PoissonLoss, SmoothedHingeLoss]
+
+# Margins chosen away from the smoothed-hinge kinks at t in {0, 1}.
+MARGINS = np.array([-3.7, -1.2, -0.4, 0.3, 0.6, 1.9, 4.1], dtype=np.float64)
+
+
+def _labels_for(loss):
+    if loss is PoissonLoss:
+        return np.array([0.0, 1.0, 2.0, 3.0, 0.0, 5.0, 1.0])
+    if loss is SquaredLoss:
+        return np.array([-1.3, 0.0, 0.7, 2.2, -0.5, 1.0, 3.1])
+    return np.array([0.0, 1.0, 0.0, 1.0, 1.0, 0.0, 1.0])
+
+
+@pytest.mark.parametrize("loss", ALL_LOSSES, ids=lambda l: l.name)
+def test_d1_matches_finite_difference(loss):
+    labels = _labels_for(loss)
+    eps = 1e-5
+    num = (np.asarray(loss.loss(jnp.asarray(MARGINS + eps), jnp.asarray(labels)), np.float64)
+           - np.asarray(loss.loss(jnp.asarray(MARGINS - eps), jnp.asarray(labels)), np.float64)) / (2 * eps)
+    ana = np.asarray(loss.d1(jnp.asarray(MARGINS), jnp.asarray(labels)))
+    np.testing.assert_allclose(ana, num, rtol=1e-3, atol=1e-3)
+
+
+@pytest.mark.parametrize("loss", ALL_LOSSES, ids=lambda l: l.name)
+def test_d2_matches_finite_difference(loss):
+    labels = _labels_for(loss)
+    eps = 1e-3
+    num = (np.asarray(loss.d1(jnp.asarray(MARGINS + eps), jnp.asarray(labels)), np.float64)
+           - np.asarray(loss.d1(jnp.asarray(MARGINS - eps), jnp.asarray(labels)), np.float64)) / (2 * eps)
+    ana = np.asarray(loss.d2(jnp.asarray(MARGINS), jnp.asarray(labels)))
+    np.testing.assert_allclose(ana, num, rtol=1e-3, atol=1e-3)
+
+
+@pytest.mark.parametrize("loss", ALL_LOSSES, ids=lambda l: l.name)
+def test_d1_matches_autodiff(loss):
+    labels = _labels_for(loss)
+    auto = jax.vmap(jax.grad(loss.loss))(jnp.asarray(MARGINS, jnp.float32),
+                                         jnp.asarray(labels, jnp.float32))
+    ana = loss.d1(jnp.asarray(MARGINS, jnp.float32), jnp.asarray(labels, jnp.float32))
+    np.testing.assert_allclose(np.asarray(auto), np.asarray(ana), rtol=1e-4, atol=1e-5)
+
+
+def test_logistic_extreme_margins_stable():
+    m = jnp.asarray([-500.0, 500.0])
+    y = jnp.asarray([1.0, 0.0])
+    v = LogisticLoss.loss(m, y)
+    assert np.all(np.isfinite(np.asarray(v)))
+    np.testing.assert_allclose(np.asarray(v), [500.0, 500.0], rtol=1e-6)
+
+
+def test_smoothed_hinge_piecewise_values():
+    y = jnp.ones((3,))
+    m = jnp.asarray([-1.0, 0.5, 2.0])
+    v = np.asarray(SmoothedHingeLoss.loss(m, y))
+    np.testing.assert_allclose(v, [1.5, 0.125, 0.0], rtol=1e-6)
+
+
+def test_loss_for_task_mapping():
+    assert loss_for_task(TaskType.LOGISTIC_REGRESSION) is LogisticLoss
+    assert loss_for_task(TaskType.LINEAR_REGRESSION) is SquaredLoss
+    assert loss_for_task(TaskType.POISSON_REGRESSION) is PoissonLoss
+    assert loss_for_task(TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM) is SmoothedHingeLoss
