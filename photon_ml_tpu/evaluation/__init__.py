@@ -1,0 +1,16 @@
+from photon_ml_tpu.evaluation.evaluator import (  # noqa: F401
+    EvaluationResults,
+    Evaluator,
+    evaluate_all,
+    parse_evaluator,
+    parse_evaluators,
+)
+from photon_ml_tpu.evaluation.grouped import (  # noqa: F401
+    grouped_auc,
+    grouped_precision_at_k,
+)
+from photon_ml_tpu.evaluation.metrics import (  # noqa: F401
+    area_under_roc_curve,
+    mean_pointwise_loss,
+    root_mean_squared_error,
+)

@@ -1,0 +1,10 @@
+from photon_ml_tpu.glm.problem import (  # noqa: F401
+    GLMOptimizationConfiguration,
+    OptimizationProblem,
+)
+from photon_ml_tpu.glm.training import (  # noqa: F401
+    TrainedModel,
+    to_original_space,
+    train_glm_sweep,
+    validate_and_select,
+)

@@ -1,0 +1,120 @@
+"""Single-model GLM training: the warm-start regularization sweep.
+
+Re-design of the reference's legacy training stage
+(``photon-client/src/main/scala/com/linkedin/photon/ml/ModelTraining.scala``):
+train one model per regularization weight, descending, each solve warm-started
+from the previous lambda's solution, then pick the best by a validation
+evaluator (``Evaluation.scala`` + ``ModelSelection``).
+
+TPU shape: the solve for every lambda reuses ONE compiled XLA program (lambda
+is a traced scalar), so the sweep costs one compile + k solves. Normalization
+is a coefficient-space reparameterization inside the objective; trained
+coefficients are mapped back to original feature space before models are
+returned, mirroring the reference's back-transformation at output time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.evaluation import EvaluationResults, Evaluator, evaluate_all
+from photon_ml_tpu.glm.problem import GLMOptimizationConfiguration, OptimizationProblem
+from photon_ml_tpu.models import Coefficients, GeneralizedLinearModel
+from photon_ml_tpu.ops.losses import loss_for_task
+from photon_ml_tpu.ops.normalization import NormalizationContext, NoNormalization
+from photon_ml_tpu.ops.objective import GLMData, GLMObjective
+from photon_ml_tpu.optimize import OptimizerResult
+from photon_ml_tpu.types import TaskType
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainedModel:
+    """One (lambda, model, optimization trace) entry of the sweep."""
+
+    regularization_weight: float
+    model: GeneralizedLinearModel
+    result: OptimizerResult
+    evaluation: Optional[EvaluationResults] = None
+
+
+def train_glm_sweep(
+    task: TaskType,
+    data: GLMData,
+    regularization_weights: Sequence[float],
+    config: GLMOptimizationConfiguration = GLMOptimizationConfiguration(),
+    normalization: NormalizationContext = NoNormalization,
+    reg_mask: Optional[Array] = None,
+    initial: Optional[Array] = None,
+    warm_start: bool = True,
+) -> list[TrainedModel]:
+    """Train one GLM per regularization weight with warm starts.
+
+    Weights are processed in descending order (strongest regularization first,
+    the stable warm-start direction the reference uses); the returned list
+    follows that order. ``reg_mask`` excludes coefficients (e.g. the
+    intercept) from regularization.
+    """
+    objective = GLMObjective(
+        loss=loss_for_task(task), normalization=normalization, reg_mask=reg_mask)
+    problem = OptimizationProblem(objective, config)
+
+    run = jax.jit(problem.run)
+    w = jnp.zeros((data.dim,)) if initial is None else jnp.asarray(initial)
+
+    out: list[TrainedModel] = []
+    for lam in sorted(regularization_weights, reverse=True):
+        result = run(data, w, jnp.asarray(lam, w.dtype))
+        variances = problem.compute_variances(result.w, data, lam)
+        coeffs = Coefficients(means=result.w, variances=variances)
+        model = GeneralizedLinearModel(
+            coefficients=to_original_space(coeffs, normalization), task=task)
+        out.append(TrainedModel(float(lam), model, result))
+        if warm_start:
+            w = result.w
+    return out
+
+
+def to_original_space(coeffs: Coefficients, normalization: NormalizationContext
+                      ) -> Coefficients:
+    """Map transformed-space coefficients (and variances, which scale by the
+    squared factors) back to raw feature space for model output."""
+    if normalization.is_identity:
+        return coeffs
+    means = normalization.model_to_original(coeffs.means)
+    variances = coeffs.variances
+    if variances is not None and normalization.factors is not None:
+        variances = variances * jnp.square(normalization.factors)
+    return Coefficients(means=means, variances=variances)
+
+
+def validate_and_select(
+    trained: Sequence[TrainedModel],
+    evaluators: Sequence[Evaluator],
+    validation: GLMData,
+    id_tags=None,
+) -> tuple[int, list[TrainedModel]]:
+    """Score every swept model on validation data and pick the best by the
+    FIRST evaluator (reference ``ModelSelection.selectBestModel``).
+
+    Returns ``(best_index, trained_with_evaluations)``.
+    """
+    labels = np.asarray(validation.labels)
+    weights = np.asarray(validation.weights)
+    best_idx, best_val = 0, None
+    evaluated: list[TrainedModel] = []
+    primary = evaluators[0]
+    for i, tm in enumerate(trained):
+        scores = np.asarray(tm.model.score(validation.design, validation.offsets))
+        ev = evaluate_all(evaluators, scores, labels, weights, id_tags)
+        evaluated.append(dataclasses.replace(tm, evaluation=ev))
+        val = ev.primary[1]
+        if primary.better_than(val, best_val):
+            best_idx, best_val = i, val
+    return best_idx, evaluated

@@ -1,0 +1,8 @@
+from photon_ml_tpu.models.coefficients import Coefficients  # noqa: F401
+from photon_ml_tpu.models.glm import (  # noqa: F401
+    GeneralizedLinearModel,
+    linear_regression_model,
+    logistic_regression_model,
+    poisson_regression_model,
+    smoothed_hinge_loss_linear_svm_model,
+)

@@ -1,0 +1,57 @@
+"""Model coefficients: means plus optional posterior variances.
+
+Re-design of the reference's ``photon-api/.../model/Coefficients.scala``:
+a coefficient vector (the GLM weights) and, when variance computation is
+enabled (``VarianceComputationType`` SIMPLE/FULL), a per-coefficient variance
+vector — together the "Bayesian linear model" the reference writes as
+``BayesianLinearModelAvro``.
+
+A frozen pytree dataclass so it flows freely through jit/vmap/shard_map; the
+`variances` leaf is optional (None when variance computation is off).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class Coefficients:
+    """GLM coefficients: ``means`` ``(d,)``, optional ``variances`` ``(d,)``."""
+
+    means: Array
+    variances: Optional[Array] = None
+
+    @property
+    def dim(self) -> int:
+        return self.means.shape[-1]
+
+    @staticmethod
+    def zeros(dim: int, dtype=jnp.float32) -> "Coefficients":
+        return Coefficients(means=jnp.zeros((dim,), dtype=dtype))
+
+    def with_variances(self, variances: Optional[Array]) -> "Coefficients":
+        return dataclasses.replace(self, variances=variances)
+
+    def norm(self) -> Array:
+        return jnp.linalg.norm(self.means)
+
+    def nnz(self, eps: float = 0.0) -> Array:
+        """Count of active (non-zero beyond ``eps``) coefficients — the
+        quantity the reference's model-sparsity-threshold option reports."""
+        return jnp.sum(jnp.abs(self.means) > eps)
+
+    def sparsify(self, threshold: float) -> "Coefficients":
+        """Zero out coefficients with ``|w_j| < threshold`` (the GAME driver's
+        ``model-sparsity-threshold`` post-processing)."""
+        keep = jnp.abs(self.means) >= threshold
+        means = jnp.where(keep, self.means, 0.0)
+        variances = None if self.variances is None else jnp.where(keep, self.variances, 0.0)
+        return Coefficients(means=means, variances=variances)

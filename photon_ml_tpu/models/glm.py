@@ -1,0 +1,77 @@
+"""Generalized linear models: task-typed coefficient containers that score data.
+
+Re-design of the reference's model hierarchy
+(``photon-api/.../supervised/classification/LogisticRegressionModel.scala``,
+``supervised/regression/{LinearRegressionModel, PoissonRegressionModel}.scala``,
+``SmoothedHingeLossLinearSVMModel`` and the ``GeneralizedLinearModel`` base).
+
+One pytree dataclass parameterized by :class:`photon_ml_tpu.types.TaskType`
+instead of a subclass tree: the task selects the pointwise loss / inverse link,
+and scoring is a pure function usable inside jit. Factory helpers carry the
+reference class names for discoverability.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.models.coefficients import Coefficients
+from photon_ml_tpu.ops.design import Design
+from photon_ml_tpu.ops.losses import PointwiseLoss, loss_for_task
+from photon_ml_tpu.types import TaskType
+
+Array = jax.Array
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class GeneralizedLinearModel:
+    """A trained GLM: coefficients plus the task that interprets them."""
+
+    coefficients: Coefficients
+    task: TaskType = dataclasses.field(metadata=dict(static=True))
+
+    @property
+    def dim(self) -> int:
+        return self.coefficients.dim
+
+    @property
+    def loss(self) -> PointwiseLoss:
+        return loss_for_task(self.task)
+
+    # --- scoring ----------------------------------------------------------
+    def score(self, design: Design, offsets: Array | float = 0.0) -> Array:
+        """Raw margins ``X @ w + offset`` — what GAME coordinate accounting
+        sums across coordinates (reference ``DatumScoringModel.score``)."""
+        return design.matvec(self.coefficients.means) + offsets
+
+    def predict_mean(self, design: Design, offsets: Array | float = 0.0) -> Array:
+        """Response-scale predictions (sigmoid / identity / exp per task),
+        the reference's ``computeMeanFunction``."""
+        return self.loss.mean(self.score(design, offsets))
+
+    def with_coefficients(self, coefficients: Coefficients) -> "GeneralizedLinearModel":
+        return dataclasses.replace(self, coefficients=coefficients)
+
+
+def logistic_regression_model(coefficients: Coefficients) -> GeneralizedLinearModel:
+    """Reference: ``supervised/classification/LogisticRegressionModel.scala``."""
+    return GeneralizedLinearModel(coefficients, TaskType.LOGISTIC_REGRESSION)
+
+
+def linear_regression_model(coefficients: Coefficients) -> GeneralizedLinearModel:
+    """Reference: ``supervised/regression/LinearRegressionModel.scala``."""
+    return GeneralizedLinearModel(coefficients, TaskType.LINEAR_REGRESSION)
+
+
+def poisson_regression_model(coefficients: Coefficients) -> GeneralizedLinearModel:
+    """Reference: ``supervised/regression/PoissonRegressionModel.scala``."""
+    return GeneralizedLinearModel(coefficients, TaskType.POISSON_REGRESSION)
+
+
+def smoothed_hinge_loss_linear_svm_model(coefficients: Coefficients) -> GeneralizedLinearModel:
+    """Reference: ``supervised/classification/SmoothedHingeLossLinearSVMModel.scala``."""
+    return GeneralizedLinearModel(coefficients, TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM)

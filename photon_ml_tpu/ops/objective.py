@@ -103,9 +103,17 @@ class GLMObjective:
         return 0.5 * l2 * jnp.vdot(wr, wr)
 
     def value(self, w: Array, data: GLMData, l2=0.0) -> Array:
+        live = data.weights > 0
         m = self.margins(w, data)
-        per_sample = self.loss.loss(m, data.labels)
-        return jnp.sum(data.weights * per_sample) + self._l2_term(w, l2)
+        # Double-where masking: weight-0 padding rows are evaluated at margin
+        # 0 (finite) AND zero-weighted. Masking only the output would leave
+        # 0 * inf = NaN in the value and — because backprop differentiates the
+        # overflowing primal — NaN in the gradient; this is the invariant that
+        # makes fixed-shape bucketing of ragged entity data safe.
+        m_safe = jnp.where(live, m, 0.0)
+        per_sample = self.loss.loss(m_safe, data.labels)
+        contrib = jnp.where(live, data.weights * per_sample, 0.0)
+        return jnp.sum(contrib) + self._l2_term(w, l2)
 
     # --- derivatives (autodiff) ------------------------------------------
     def value_and_grad(self, w: Array, data: GLMData, l2=0.0) -> tuple[Array, Array]:
@@ -124,8 +132,10 @@ class GLMObjective:
 
     # --- closed-form second-order contractions (for variance) -------------
     def _d2_weights(self, w: Array, data: GLMData) -> Array:
-        m = self.margins(w, data)
-        return data.weights * self.loss.d2(m, data.labels)
+        live = data.weights > 0
+        m = jnp.where(live, self.margins(w, data), 0.0)
+        d2 = self.loss.d2(m, data.labels)
+        return jnp.where(live, data.weights * d2, 0.0)
 
     def hessian_diagonal(self, w: Array, data: GLMData, l2=0.0) -> Array:
         """Diagonal of the Hessian in *transformed* feature space.
@@ -148,12 +158,12 @@ class GLMObjective:
             diag = jnp.einsum("nd,n->d", jnp.square(x), d2,
                               preferred_element_type=jnp.promote_types(x.dtype, jnp.float32))
         elif isinstance(design, CsrDesign):
-            vals = design.values if factors is None else design.values * jnp.take(factors, design.cols)
-            contrib = jnp.square(vals) * jnp.take(d2, design.rows)
-            diag = jnp.zeros((design.dim,), contrib.dtype).at[design.cols].add(contrib)
             if self.normalization.shifts is not None:
                 raise NotImplementedError(
                     "hessian_diagonal with shift-normalization on sparse designs")
+            vals = design.values if factors is None else design.values * jnp.take(factors, design.cols)
+            contrib = jnp.square(vals) * jnp.take(d2, design.rows)
+            diag = jnp.zeros((design.dim,), contrib.dtype).at[design.cols].add(contrib)
         else:
             raise TypeError(type(design))
         if self.reg_mask is None:

@@ -125,13 +125,18 @@ def minimize_tron(fun: ValueAndGrad, hvp: Hvp, w0: Array,
         f_new, g_new = fun(w_new)
 
         gs = jnp.vdot(s.g, step)
-        actred = s.f - f_new
+        # NaN-safe actual reduction: a non-finite trial value (overflowing
+        # loss) must behave like "no reduction" so the radius SHRINKS and the
+        # solver recovers — NaN propagating into delta would otherwise disable
+        # the trust region permanently (every comparison False).
+        actred = jnp.where(jnp.isfinite(f_new), s.f - f_new, -jnp.inf)
 
         # LIBLINEAR step-size interpolation for the radius update.
         denom = f_new - s.f - gs
-        alpha = jnp.where(denom <= 0, _SIGMA3,
+        alpha = jnp.where(jnp.isfinite(denom) & (denom > 0),
                           jnp.maximum(_SIGMA1, -0.5 * (gs / jnp.where(
-                              denom == 0, 1.0, denom))))
+                              denom == 0, 1.0, denom))),
+                          jnp.where(jnp.isfinite(f_new), _SIGMA3, _SIGMA1))
         delta = s.delta
         # On the very first iteration LIBLINEAR shrinks delta to min(delta, snorm).
         delta = jnp.where(s.it == 0, jnp.minimum(delta, snorm), delta)

@@ -1,0 +1,12 @@
+from photon_ml_tpu.parallel.mesh import (  # noqa: F401
+    DATA_AXIS,
+    ENTITY_AXIS,
+    FEATURE_AXIS,
+    data_sharded,
+    make_mesh,
+    replicated,
+)
+from photon_ml_tpu.parallel.distributed import (  # noqa: F401
+    DistributedGLMObjective,
+    shard_glm_data,
+)

@@ -1,0 +1,172 @@
+"""Distributed GLM objective: ``shard_map`` + ``psum`` over the data axis.
+
+TPU-native replacement for the reference's
+``photon-api/.../function/glm/DistributedGLMLossFunction.scala``: where the
+reference broadcasts the coefficient vector to executors and reduces
+per-partition aggregator arrays through ``RDD.treeAggregate`` (depth 1–2 tree
+over netty RPC), here every chip computes its shard's (value, gradient) with
+the SAME pure math as the single-chip path and one ``lax.psum`` over ICI
+produces the global result — inside the compiled optimizer loop, so a whole
+L-BFGS/TRON run is ONE device program with no host round-trips per iteration
+(the reference pays a broadcast + treeAggregate per iteration).
+
+Data layout: :func:`shard_glm_data` splits samples into per-device blocks on
+host (padding the tail block with weight-0 rows, which contribute exactly
+zero), stacks them on a leading mesh-axis dimension, and the objective's
+``shard_map`` consumes one block per device. The L2 term is added OUTSIDE the
+psum so it is counted once globally, not once per shard.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from photon_ml_tpu.ops.design import CsrDesign, DenseDesign
+from photon_ml_tpu.ops.objective import GLMData, GLMObjective
+from photon_ml_tpu.parallel.mesh import DATA_AXIS
+
+Array = jax.Array
+
+
+def _unstack(tree):
+    """Drop the per-device leading axis inside a shard_map body."""
+    return jax.tree.map(lambda x: x[0], tree)
+
+
+def shard_glm_data(data: GLMData, n_shards: int, *, device_put_mesh: Optional[Mesh] = None,
+                   axis: str = DATA_AXIS) -> GLMData:
+    """Split a host-resident :class:`GLMData` into ``n_shards`` equal blocks.
+
+    Returns a GLMData whose leaves have a leading ``n_shards`` dimension
+    (block i = device i's shard). Sample counts are padded up to a multiple of
+    ``n_shards`` with zero-weight rows; a sparse design's nnz budget is padded
+    to the max per-block nnz. If ``device_put_mesh`` is given, leaves are
+    placed with the leading dim sharded over ``axis`` so each block lives on
+    its device (the host→device feed the reference does via Spark partition
+    locality).
+    """
+    n = data.n_samples
+    per = math.ceil(n / n_shards)
+    n_pad = per * n_shards
+
+    labels = np.zeros((n_pad,), np.asarray(data.labels).dtype)
+    labels[:n] = np.asarray(data.labels)
+    offsets = np.zeros((n_pad,), np.asarray(data.offsets).dtype)
+    offsets[:n] = np.asarray(data.offsets)
+    weights = np.zeros((n_pad,), np.asarray(data.weights).dtype)
+    weights[:n] = np.asarray(data.weights)
+
+    design = data.design
+    if isinstance(design, DenseDesign):
+        x = np.asarray(design.x)
+        xp = np.zeros((n_pad, x.shape[1]), x.dtype)
+        xp[:n] = x
+        sharded_design = DenseDesign(x=jnp.asarray(xp.reshape(n_shards, per, x.shape[1])))
+    elif isinstance(design, CsrDesign):
+        rows = np.asarray(design.rows)
+        cols = np.asarray(design.cols)
+        vals = np.asarray(design.values)
+        block_of = rows // per
+        local_row = rows % per
+        counts = np.bincount(block_of, minlength=n_shards)
+        budget = int(counts.max()) if counts.size else 0
+        r = np.zeros((n_shards, budget), np.int32)
+        c = np.zeros((n_shards, budget), np.int32)
+        v = np.zeros((n_shards, budget), vals.dtype)
+        for b in range(n_shards):
+            sel = block_of == b
+            k = int(counts[b])
+            r[b, :k] = local_row[sel]
+            c[b, :k] = cols[sel]
+            v[b, :k] = vals[sel]
+        sharded_design = CsrDesign(
+            rows=jnp.asarray(r), cols=jnp.asarray(c), values=jnp.asarray(v),
+            n_rows=per, n_cols=design.n_cols)
+    else:
+        raise TypeError(type(design))
+
+    out = GLMData(
+        design=sharded_design,
+        labels=jnp.asarray(labels.reshape(n_shards, per)),
+        offsets=jnp.asarray(offsets.reshape(n_shards, per)),
+        weights=jnp.asarray(weights.reshape(n_shards, per)),
+    )
+    if device_put_mesh is not None:
+        sharding = NamedSharding(device_put_mesh, P(axis))
+        out = jax.tree.map(lambda x: jax.device_put(x, sharding), out)
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class DistributedGLMObjective:
+    """The fixed-effect objective over a sharded dataset.
+
+    Drop-in for :class:`GLMObjective` (same value / value_and_grad / hvp
+    signatures) but ``data`` must be the stacked per-device layout from
+    :func:`shard_glm_data`. Feed its closures straight into
+    ``minimize_lbfgs/owlqn/tron`` — the optimizers don't know they're driving
+    a pod (the reference needed a separate Distributed vs SingleNode class
+    hierarchy for this).
+    """
+
+    objective: GLMObjective
+    mesh: Mesh
+    axis: str = DATA_AXIS
+
+    def _global_value_fn(self, blk, l2):
+        """Inside a shard_map body: the GLOBAL objective as a function of w.
+
+        The ``psum`` sits INSIDE the differentiated function, so shard_map's
+        varying-axis-aware autodiff derives the correct global gradient and
+        Hvp (an explicit psum on an inner-autodiff gradient would double-count
+        — the cotangent of the replicated ``w`` is already all-reduced). The
+        L2 term is added after the psum so it counts once, not per shard.
+        """
+        data = _unstack(blk)
+
+        def global_value(wv):
+            local = self.objective.value(wv, data, 0.0)
+            return jax.lax.psum(local, self.axis) + self.objective._l2_term(wv, l2)
+
+        return global_value
+
+    def value_and_grad(self, w: Array, sharded: GLMData, l2=0.0):
+        def body(wv, blk):
+            return jax.value_and_grad(self._global_value_fn(blk, l2))(wv)
+
+        return shard_map(body, mesh=self.mesh,
+                         in_specs=(P(), P(self.axis)), out_specs=(P(), P()))(w, sharded)
+
+    def value(self, w: Array, sharded: GLMData, l2=0.0):
+        def body(wv, blk):
+            return self._global_value_fn(blk, l2)(wv)
+
+        return shard_map(body, mesh=self.mesh,
+                         in_specs=(P(), P(self.axis)), out_specs=P())(w, sharded)
+
+    def grad(self, w: Array, sharded: GLMData, l2=0.0):
+        return self.value_and_grad(w, sharded, l2)[1]
+
+    def hvp(self, w: Array, v: Array, sharded: GLMData, l2=0.0):
+        def body(wv, tangent, blk):
+            g = jax.grad(self._global_value_fn(blk, l2))
+            return jax.jvp(g, (wv,), (tangent,))[1]
+
+        return shard_map(body, mesh=self.mesh,
+                         in_specs=(P(), P(), P(self.axis)), out_specs=P())(w, v, sharded)
+
+    def margins(self, w: Array, sharded: GLMData) -> Array:
+        """Per-sample margins in the stacked (n_shards, per) layout."""
+        def local(wv, blk):
+            return self.objective.margins(wv, _unstack(blk))[None, :]
+
+        return shard_map(local, mesh=self.mesh,
+                         in_specs=(P(), P(self.axis)), out_specs=P(self.axis))(w, sharded)

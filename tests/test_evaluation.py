@@ -1,0 +1,138 @@
+"""Evaluator tests: AUC/RMSE parity vs sklearn, grouped metrics vs naive loops,
+evaluator-string parsing (reference ``EvaluatorType`` vocabulary)."""
+
+import numpy as np
+import pytest
+from sklearn.metrics import roc_auc_score
+
+from photon_ml_tpu.evaluation import (
+    Evaluator,
+    area_under_roc_curve,
+    evaluate_all,
+    grouped_auc,
+    grouped_precision_at_k,
+    mean_pointwise_loss,
+    parse_evaluator,
+    root_mean_squared_error,
+)
+from photon_ml_tpu.ops.losses import LogisticLoss
+
+
+class TestAUC:
+    def test_matches_sklearn(self):
+        rng = np.random.default_rng(0)
+        scores = rng.normal(size=500)
+        labels = (rng.uniform(size=500) < 0.4).astype(np.float64)
+        got = float(area_under_roc_curve(scores, labels))
+        assert got == pytest.approx(roc_auc_score(labels, scores), abs=1e-12)
+
+    def test_ties_matches_sklearn(self):
+        rng = np.random.default_rng(1)
+        # Heavy ties: quantized scores.
+        scores = np.round(rng.normal(size=400), 1)
+        labels = (rng.uniform(size=400) < 0.5).astype(np.float64)
+        got = float(area_under_roc_curve(scores, labels))
+        assert got == pytest.approx(roc_auc_score(labels, scores), abs=1e-12)
+
+    def test_weighted_matches_sklearn(self):
+        rng = np.random.default_rng(2)
+        scores = np.round(rng.normal(size=300), 1)
+        labels = (rng.uniform(size=300) < 0.5).astype(np.float64)
+        w = rng.uniform(0.1, 3.0, size=300)
+        got = float(area_under_roc_curve(scores, labels, w))
+        assert got == pytest.approx(
+            roc_auc_score(labels, scores, sample_weight=w), abs=1e-12)
+
+    def test_zero_weight_rows_ignored(self):
+        scores = np.array([0.1, 0.9, 0.5, 100.0])
+        labels = np.array([0.0, 1.0, 0.0, 0.0])
+        w = np.array([1.0, 1.0, 1.0, 0.0])  # padding row
+        got = float(area_under_roc_curve(scores, labels, w))
+        assert got == pytest.approx(1.0)
+
+    def test_single_class_is_nan(self):
+        scores = np.array([0.1, 0.9])
+        labels = np.array([1.0, 1.0])
+        assert np.isnan(float(area_under_roc_curve(scores, labels)))
+
+
+class TestRMSEAndLosses:
+    def test_rmse(self):
+        scores = np.array([1.0, 2.0, 3.0])
+        labels = np.array([1.5, 2.0, 2.0])
+        expect = np.sqrt((0.25 + 0.0 + 1.0) / 3.0)
+        assert float(root_mean_squared_error(scores, labels)) == pytest.approx(expect)
+
+    def test_weighted_logistic_loss(self):
+        scores = np.array([0.0, 2.0])
+        labels = np.array([1.0, 0.0])
+        w = np.array([1.0, 3.0])
+        per = np.log1p(np.exp(scores)) - labels * scores
+        expect = np.sum(w * per) / np.sum(w)
+        got = float(mean_pointwise_loss(LogisticLoss, scores, labels, w))
+        assert got == pytest.approx(expect, rel=1e-6)
+
+
+class TestGrouped:
+    def test_grouped_auc_vs_naive(self):
+        rng = np.random.default_rng(3)
+        n, g = 600, 40
+        scores = np.round(rng.normal(size=n), 1)
+        labels = (rng.uniform(size=n) < 0.5).astype(np.float64)
+        groups = rng.integers(0, g, size=n)
+        vals = []
+        for gid in range(g):
+            sel = groups == gid
+            if sel.sum() and 0 < labels[sel].sum() < sel.sum():
+                vals.append(roc_auc_score(labels[sel], scores[sel]))
+        assert grouped_auc(scores, labels, groups) == pytest.approx(
+            np.mean(vals), abs=1e-12)
+
+    def test_grouped_precision_at_k_vs_naive(self):
+        rng = np.random.default_rng(4)
+        n, g, k = 500, 30, 3
+        scores = rng.normal(size=n)
+        labels = (rng.uniform(size=n) < 0.4).astype(np.float64)
+        groups = rng.integers(0, g, size=n)
+        vals = []
+        for gid in np.unique(groups):
+            sel = np.flatnonzero(groups == gid)
+            top = sel[np.argsort(-scores[sel])][:k]
+            vals.append(labels[top].sum() / k)
+        assert grouped_precision_at_k(scores, labels, groups, k) == pytest.approx(
+            np.mean(vals), abs=1e-12)
+
+
+class TestParsing:
+    def test_global_evaluators(self):
+        assert parse_evaluator("AUC") == Evaluator("AUC", maximize=True)
+        assert parse_evaluator("RMSE") == Evaluator("RMSE", maximize=False)
+        assert parse_evaluator("logistic_loss").name == "LOGISTIC_LOSS"
+
+    def test_sharded_auc(self):
+        ev = parse_evaluator("AUC:queryId")
+        assert ev.id_tag == "queryId" and ev.maximize
+
+    def test_precision_at_k(self):
+        ev = parse_evaluator("PRECISION@5:documentId")
+        assert ev.k == 5 and ev.id_tag == "documentId"
+
+    def test_unknown_raises(self):
+        with pytest.raises(ValueError):
+            parse_evaluator("F1")
+
+    def test_evaluate_all_with_id_tags(self):
+        rng = np.random.default_rng(5)
+        n = 200
+        scores = rng.normal(size=n)
+        labels = (rng.uniform(size=n) < 0.5).astype(np.float64)
+        tags = {"uid": rng.integers(0, 10, size=n)}
+        evs = [parse_evaluator(s) for s in ["AUC", "AUC:uid", "PRECISION@2:uid"]]
+        res = evaluate_all(evs, scores, labels, None, tags)
+        assert set(res.as_dict()) == {"AUC", "AUC:uid", "PRECISION@2:uid"}
+
+    def test_better_than_direction(self):
+        auc = parse_evaluator("AUC")
+        rmse = parse_evaluator("RMSE")
+        assert auc.better_than(0.9, 0.8) and not auc.better_than(0.7, 0.8)
+        assert rmse.better_than(0.1, 0.2) and not rmse.better_than(0.3, 0.2)

@@ -192,3 +192,37 @@ def test_reg_mask_exempts_intercept():
     g_none = np.asarray(obj.grad(w, data, 0.0))
     np.testing.assert_allclose(g_reg[-1], g_none[-1], rtol=1e-6)
     assert abs(g_reg[0] - g_none[0]) > 1e-3
+
+
+class TestPaddingOverflowSafety:
+    """Weight-0 padding rows must contribute exactly nothing even when their
+    loss overflows (0 * inf would otherwise poison value/grad/Hvp — the
+    invariant that makes fixed-shape bucketing of ragged entity data safe)."""
+
+    def test_poisson_inf_loss_on_padded_row(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from photon_ml_tpu.ops.design import DenseDesign
+        from photon_ml_tpu.ops.losses import PoissonLoss
+        from photon_ml_tpu.ops.objective import GLMData, GLMObjective
+
+        # Row 2 is padding: weight 0, margin huge enough that exp overflows.
+        x = jnp.asarray(np.array([[1.0, 0.0], [0.0, 1.0], [1e6, 1e6]]))
+        data = GLMData(design=DenseDesign(x=x),
+                       labels=jnp.asarray([1.0, 2.0, 0.0]),
+                       offsets=jnp.zeros(3),
+                       weights=jnp.asarray([1.0, 1.0, 0.0]))
+        obj = GLMObjective(loss=PoissonLoss)
+        w = jnp.asarray([1.0, 1.0])
+        f, g = obj.value_and_grad(w, data, 0.5)
+        assert bool(jnp.isfinite(f))
+        assert bool(jnp.all(jnp.isfinite(g)))
+        hv = obj.hvp(w, jnp.ones(2), data, 0.5)
+        assert bool(jnp.all(jnp.isfinite(hv)))
+        # And the padded row truly contributes nothing.
+        data2 = GLMData(design=DenseDesign(x=x[:2]), labels=data.labels[:2],
+                        offsets=data.offsets[:2], weights=data.weights[:2])
+        f2, g2 = obj.value_and_grad(w, data2, 0.5)
+        np.testing.assert_allclose(float(f), float(f2), rtol=1e-12)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(g2), rtol=1e-12)

@@ -226,3 +226,29 @@ def test_trace_valid_prefix_has_no_nan_after_line_search_failure():
     vals = np.asarray(res.values)[: n + 1]
     assert np.all(np.isfinite(vals))
     assert np.all(np.diff(vals) <= 1e-9)
+
+
+class TestTronNaNRecovery:
+    """A trial step whose objective value is NaN/inf must shrink the trust
+    region and recover, not poison the radius forever."""
+
+    def test_overflowing_objective_recovers(self):
+        import jax.numpy as jnp
+        import numpy as np
+        from photon_ml_tpu.optimize import OptimizerConfig, minimize_tron
+
+        # f(w) = exp(w0) - 3*w0 + w1^2: overflows to inf (and NaN gradient
+        # products) for large w0 trial steps; minimum at w0=log(3), w1=0.
+        def fun(w):
+            f = jnp.exp(w[0]) - 3.0 * w[0] + w[1] ** 2
+            g = jnp.stack([jnp.exp(w[0]) - 3.0, 2.0 * w[1]])
+            return f, g
+
+        def hvp(w, v):
+            return jnp.stack([jnp.exp(w[0]) * v[0], 2.0 * v[1]])
+
+        res = minimize_tron(fun, hvp, jnp.asarray([0.0, 5.0]),
+                            OptimizerConfig(max_iterations=100, tolerance=1e-10))
+        assert bool(res.converged)
+        np.testing.assert_allclose(np.asarray(res.w), [np.log(3.0), 0.0],
+                                   atol=1e-6)
