@@ -1,0 +1,123 @@
+"""Headline benchmark: logistic-GLM training throughput on one TPU chip.
+
+Workload: BASELINE config-1 shape scaled up — L2-regularized logistic
+regression via the on-device compiled L-BFGS loop — the per-iteration
+broadcast + treeAggregate cycle that dominates the reference's wall-clock
+(SURVEY.md §3.1). Design matrix stored bfloat16, margins/gradients accumulated
+f32 on the MXU.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+``vs_baseline`` is the speedup of the compiled on-device solve over a
+same-machine scipy L-BFGS-B solve on the identical problem (the closest
+available stand-in for the reference's breeze/JVM driver-side solve; the
+reference publishes no numbers — BASELINE.json published:{}).
+
+NOTE timing sync: on the axon PJRT platform ``jax.block_until_ready`` does
+not block; the reliable barrier is a device→host transfer (``float(x)``).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+N_SAMPLES = 200_000
+N_FEATURES = 1024
+NNZ_PER_ROW = 64
+L2 = 1.0
+MAX_ITERS = 50
+
+
+def _make_problem(seed=0):
+    """Sparse-generated logistic data, densified (dense is the TPU-first
+    layout at this dim — SURVEY.md §7 hard-parts #2)."""
+    rng = np.random.default_rng(seed)
+    n, d, k = N_SAMPLES, N_FEATURES, NNZ_PER_ROW
+    rows = np.repeat(np.arange(n, dtype=np.int32), k)
+    cols = rng.integers(0, d, size=n * k, dtype=np.int32)
+    vals = rng.normal(size=n * k).astype(np.float32) / np.sqrt(k)
+    x = np.zeros((n, d), np.float32)
+    np.add.at(x, (rows, cols), vals)
+    w_true = rng.normal(size=d).astype(np.float32)
+    margins = x @ w_true
+    y = (rng.uniform(size=n) < 1.0 / (1.0 + np.exp(-margins))).astype(np.float32)
+    return x, y
+
+
+def _scipy_baseline(x, y):
+    import scipy.optimize
+
+    xx = x.astype(np.float64)
+    yy = y.astype(np.float64)
+
+    def f(w):
+        m = xx @ w
+        ym = np.where(yy > 0.5, m, -m)
+        loss = np.logaddexp(0.0, -ym).sum() + 0.5 * L2 * w @ w
+        p = 1.0 / (1.0 + np.exp(-m))
+        g = xx.T @ (p - yy) + L2 * w
+        return loss, g
+
+    t0 = time.perf_counter()
+    res = scipy.optimize.minimize(
+        f, np.zeros(N_FEATURES), jac=True, method="L-BFGS-B",
+        options={"maxiter": MAX_ITERS, "ftol": 0.0, "gtol": 1e-12})
+    return time.perf_counter() - t0, float(res.fun)
+
+
+def _tpu_solve(x, y):
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.ops.design import DenseDesign
+    from photon_ml_tpu.ops.losses import loss_for_task
+    from photon_ml_tpu.ops.objective import GLMData, GLMObjective
+    from photon_ml_tpu.optimize import OptimizerConfig, minimize_lbfgs
+    from photon_ml_tpu.types import TaskType
+
+    n = x.shape[0]
+    data = GLMData(
+        design=DenseDesign(x=jnp.asarray(x, jnp.bfloat16)),
+        labels=jnp.asarray(y),
+        offsets=jnp.zeros((n,), jnp.float32),
+        weights=jnp.ones((n,), jnp.float32),
+    )
+    objective = GLMObjective(loss=loss_for_task(TaskType.LOGISTIC_REGRESSION))
+    cfg = OptimizerConfig(max_iterations=MAX_ITERS, tolerance=1e-12,
+                          track_states=False)
+
+    @jax.jit
+    def solve(data):
+        fun = lambda w: objective.value_and_grad(w, data, L2)
+        return minimize_lbfgs(fun, jnp.zeros((N_FEATURES,), jnp.float32), cfg)
+
+    result = solve(data)
+    _ = float(result.value)  # compile + first run; D2H is the real barrier
+    best = float("inf")
+    for _rep in range(3):
+        t0 = time.perf_counter()
+        result = solve(data)
+        val = float(result.value)
+        best = min(best, time.perf_counter() - t0)
+    return best, val, int(result.iterations)
+
+
+def main():
+    x, y = _make_problem()
+    tpu_s, tpu_val, iters = _tpu_solve(x, y)
+    base_s, base_val = _scipy_baseline(x, y)
+    rel = abs(tpu_val - base_val) / max(abs(base_val), 1.0)
+    assert rel < 5e-3, f"objective mismatch: tpu={tpu_val} scipy={base_val}"
+    throughput = N_SAMPLES * max(iters, 1) / tpu_s
+    print(json.dumps({
+        "metric": "glm_logistic_lbfgs_sample_iters_per_sec",
+        "value": round(throughput, 1),
+        "unit": "sample-iterations/s",
+        "vs_baseline": round(base_s / tpu_s, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

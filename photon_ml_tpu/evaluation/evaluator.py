@@ -61,7 +61,16 @@ class Evaluator:
                 raise KeyError(
                     f"evaluator {self.name} needs id tag '{self.id_tag}' "
                     f"but scored data has {sorted(id_tags or {})}")
-            groups = id_tags[self.id_tag]
+            groups = np.asarray(id_tags[self.id_tag])
+            # drop rows that don't carry the tag (-1 = missing), matching the
+            # reference MultiEvaluator joining scores with present tags only
+            present = groups >= 0 if np.issubdtype(groups.dtype, np.integer) \
+                else np.ones(groups.shape, bool)
+            scores = np.asarray(scores)[present]
+            labels = np.asarray(labels)[present]
+            groups = groups[present]
+            if weights is not None:
+                weights = np.asarray(weights)[present]
             if self.k is not None:
                 return grouped_precision_at_k(scores, labels, groups, self.k)
             return grouped_auc(scores, labels, groups, weights)
@@ -86,7 +95,10 @@ def parse_evaluator(spec: str) -> Evaluator:
     spec = spec.strip()
     m = _PRECISION_RE.match(spec)
     if m:
-        return Evaluator(name=spec, maximize=True, id_tag=m.group(2), k=int(m.group(1)))
+        k = int(m.group(1))
+        if k < 1:
+            raise ValueError(f"PRECISION@k needs k >= 1, got {spec!r}")
+        return Evaluator(name=spec, maximize=True, id_tag=m.group(2), k=k)
     upper = spec.upper()
     if ":" in spec:
         base, tag = spec.split(":", 1)

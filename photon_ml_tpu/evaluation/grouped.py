@@ -17,16 +17,7 @@ from __future__ import annotations
 
 import numpy as np
 
-
-def _group_starts(groups_sorted: np.ndarray) -> np.ndarray:
-    """Indices where a new group begins in a group-sorted array."""
-    n = groups_sorted.shape[0]
-    if n == 0:
-        return np.zeros((0,), dtype=np.int64)
-    new = np.empty(n, dtype=bool)
-    new[0] = True
-    np.not_equal(groups_sorted[1:], groups_sorted[:-1], out=new[1:])
-    return np.flatnonzero(new)
+from photon_ml_tpu.util import group_starts as _group_starts
 
 
 def grouped_auc(scores, labels, groups, weights=None) -> float:

@@ -1,0 +1,35 @@
+"""GAME: Generalized Additive Mixed-Effect models, TPU-first.
+
+Re-design of the reference's GAME stack (``photon-api/.../algorithm/``,
+``data/``, ``model/``, ``estimators/``): block coordinate descent over a
+fixed-effect coordinate (pod-wide sharded GLM solve) and random-effect
+coordinates (per-entity solves, ``vmap``-batched over size buckets instead of
+the reference's per-executor breeze loops).
+"""
+
+from photon_ml_tpu.game.data import (  # noqa: F401
+    FeatureShard,
+    FixedEffectDataset,
+    GameData,
+    RandomEffectDataset,
+    RandomEffectDatasetConfig,
+)
+from photon_ml_tpu.game.model import (  # noqa: F401
+    FixedEffectModel,
+    GameModel,
+    RandomEffectModel,
+)
+from photon_ml_tpu.game.coordinate import (  # noqa: F401
+    Coordinate,
+    FixedEffectCoordinate,
+    RandomEffectCoordinate,
+)
+from photon_ml_tpu.game.coordinate_descent import (  # noqa: F401
+    CoordinateDescent,
+    CoordinateDescentResult,
+)
+from photon_ml_tpu.game.estimator import (  # noqa: F401
+    GameEstimator,
+    GameOptimizationConfiguration,
+    GameResult,
+)

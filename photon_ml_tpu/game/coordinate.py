@@ -1,0 +1,136 @@
+"""GAME coordinates: one trainable block of the additive model.
+
+Re-design of ``photon-api/.../algorithm/{Coordinate, FixedEffectCoordinate,
+RandomEffectCoordinate}.scala``. A coordinate owns its dataset and
+optimization problem; ``train(offsets, warm_start)`` fits against the
+residual offsets coordinate descent supplies and returns (model, scores)
+where ``scores`` is this coordinate's margin contribution per global sample.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import lru_cache
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.game.data import (
+    FixedEffectDataset,
+    GameData,
+    RandomEffectDataset,
+)
+from photon_ml_tpu.game.model import (
+    FixedEffectModel,
+    GameModel,
+    RandomEffectModel,
+)
+from photon_ml_tpu.game.random_effect import RandomEffectSolver
+from photon_ml_tpu.glm.problem import GLMOptimizationConfiguration, OptimizationProblem
+from photon_ml_tpu.models.coefficients import Coefficients
+from photon_ml_tpu.models.glm import GeneralizedLinearModel
+from photon_ml_tpu.ops.losses import loss_for_task
+from photon_ml_tpu.ops.objective import GLMObjective
+from photon_ml_tpu.sampling import DownSampler
+from photon_ml_tpu.types import TaskType
+
+CoordinateModel = Union[FixedEffectModel, RandomEffectModel]
+
+
+@lru_cache(maxsize=None)
+def _fixed_train_fn(task: TaskType, config: GLMOptimizationConfiguration):
+    """One compiled fixed-effect train step per (task, config)."""
+    problem = OptimizationProblem(
+        GLMObjective(loss=loss_for_task(task)), config)
+
+    @jax.jit
+    def train(data, w0, lam):
+        result = problem.run(data, w0, lam)
+        variances = problem.compute_variances(result.w, data, lam)
+        scores = data.design.matvec(result.w)
+        return result, variances, scores
+
+    return train
+
+
+@dataclasses.dataclass(frozen=True)
+class FixedEffectCoordinate:
+    """Cluster-wide GLM solve for the global coordinate
+    (reference ``algorithm/FixedEffectCoordinate.scala``).
+
+    The solve is a single compiled on-device optimizer run; per-CD-iteration
+    down-sampling (reference behavior for dominant-class data) reweights via
+    the coordinate's :class:`DownSampler`, applied to a fresh weight vector
+    each sweep.
+    """
+
+    coordinate_id: str
+    dataset: FixedEffectDataset
+    task: TaskType
+    config: GLMOptimizationConfiguration
+    lam: float = 0.0
+    downsampler: Optional[DownSampler] = None
+
+    def __post_init__(self):
+        self.config.regularization.check_weight(self.lam)
+
+    def train(self, offsets: np.ndarray,
+              warm_start: Optional[FixedEffectModel] = None,
+              sweep: int = 0) -> tuple[FixedEffectModel, np.ndarray]:
+        data = self.dataset.glm_data(offsets)
+        if self.downsampler is not None:
+            weights = self.downsampler.downsample(
+                np.asarray(data.labels), np.asarray(data.weights), sweep=sweep)
+            data = dataclasses.replace(data, weights=jnp.asarray(weights))
+        w0 = (jnp.zeros((self.dataset.dim,), jnp.float32)
+              if warm_start is None
+              else jnp.asarray(warm_start.model.coefficients.means))
+        result, variances, scores = _fixed_train_fn(self.task, self.config)(
+            data, w0, jnp.asarray(self.lam, jnp.float32))
+        model = FixedEffectModel(
+            model=GeneralizedLinearModel(
+                coefficients=Coefficients(means=result.w, variances=variances),
+                task=self.task),
+            feature_shard_id=self.dataset.feature_shard_id)
+        return model, np.asarray(scores, np.float32)
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomEffectCoordinate:
+    """Per-entity solves for one random-effect coordinate
+    (reference ``algorithm/RandomEffectCoordinate.scala``).
+
+    Active samples are scored in the bucket layout on device; passive samples
+    (and any future data) go through the model's host-side join.
+    """
+
+    coordinate_id: str
+    dataset: RandomEffectDataset
+    data: GameData  # for passive scoring
+    task: TaskType
+    config: GLMOptimizationConfiguration
+    lam: float = 0.0
+
+    def __post_init__(self):
+        self.config.regularization.check_weight(self.lam)
+
+    @property
+    def solver(self) -> RandomEffectSolver:
+        return RandomEffectSolver(task=self.task, config=self.config)
+
+    def train(self, offsets: np.ndarray,
+              warm_start: Optional[RandomEffectModel] = None,
+              sweep: int = 0) -> tuple[RandomEffectModel, np.ndarray]:
+        shard_dim = self.data.shards[self.dataset.config.feature_shard_id].dim
+        model, scores = self.solver.train(
+            self.dataset, offsets, self.lam, warm_start, dim=shard_dim)
+        passive = self.dataset.passive_sample_idx
+        if len(passive):
+            # reference passiveData scoring: trained model, scored-only rows
+            scores[passive] = model.score(self.data, sample_idx=passive)
+        return model, scores
+
+
+Coordinate = Union[FixedEffectCoordinate, RandomEffectCoordinate]

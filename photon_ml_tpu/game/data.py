@@ -1,0 +1,432 @@
+"""GAME data layer: global columnar data, fixed-effect and random-effect datasets.
+
+Re-design of the reference's GAME data layer
+(``photon-api/.../data/{GameDatum, FixedEffectDataset, RandomEffectDataset,
+LocalDataset, RandomEffectDatasetPartitioner}.scala``).
+
+The reference represents data as ``RDD[(UniqueSampleId, GameDatum)]`` and
+builds per-coordinate datasets by Spark shuffles (keyBy entity → frequency-
+balanced partitioner → groupByKey → per-entity ``LocalDataset``). Here the
+global dataset is host-resident columnar numpy (labels / offsets / weights /
+per-shard CSR features / per-entity-type id columns), and the "shuffle" is a
+vectorized argsort-by-entity. The random-effect dataset then departs from the
+reference entirely — instead of millions of ragged per-entity iterables it
+builds **fixed-shape size buckets**: entities are grouped by (padded sample
+count, padded per-entity feature count), each bucket a dense
+``(entities, samples, features)`` tensor ready for a ``vmap``-batched
+on-device solve (SURVEY.md §7 "hard parts" #1/#2). Per-entity feature-space
+reduction (the reference's ``projector/IndexMapProjector``) happens here too:
+each entity's observed feature ids become a compact local index map, so the
+bucket feature dim is the max *observed* dim, not the shard vocabulary dim.
+
+Active/passive split follows the reference: an upper bound subsamples an
+entity's training rows (reservoir-style), a lower bound drops entities with
+too few rows from training entirely; all rows excluded from training remain
+"passive" — scored with the trained entity model during coordinate descent.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.ops.design import CsrDesign, DenseDesign
+from photon_ml_tpu.ops.objective import GLMData
+from photon_ml_tpu.util import group_starts as _group_starts
+
+#: Fixed-effect designs at or below this width are densified (MXU path);
+#: wider ones stay sparse.
+DENSE_DESIGN_MAX_DIM = 4096
+
+
+@dataclasses.dataclass(frozen=True)
+class FeatureShard:
+    """Host CSR feature block over all samples for one feature shard.
+
+    The reference assembles per-shard ``SparseVector`` columns in
+    ``data/avro/AvroDataReader.scala``; this is the columnar equivalent.
+    Rows are samples; ``dim`` is the shard vocabulary size (intercept
+    included if the shard config adds one).
+    """
+
+    indptr: np.ndarray  # (n_samples + 1,) int64
+    cols: np.ndarray  # (nnz,) int32
+    vals: np.ndarray  # (nnz,) float32
+    dim: int
+
+    @property
+    def n_samples(self) -> int:
+        return self.indptr.shape[0] - 1
+
+    @property
+    def nnz(self) -> int:
+        return int(self.cols.shape[0])
+
+    def row_counts(self) -> np.ndarray:
+        return np.diff(self.indptr)
+
+    def rows(self) -> np.ndarray:
+        """Expand indptr to one row id per nnz."""
+        return np.repeat(np.arange(self.n_samples, dtype=np.int64),
+                         self.row_counts())
+
+    def take(self, sample_idx: np.ndarray) -> "FeatureShard":
+        """Row-subset (and reorder) by sample indices (vectorized — this
+        runs per CD sweep on the passive-scoring path)."""
+        sample_idx = np.asarray(sample_idx, np.int64)
+        counts = self.row_counts()[sample_idx]
+        new_indptr = np.zeros(len(sample_idx) + 1, np.int64)
+        np.cumsum(counts, out=new_indptr[1:])
+        total = int(new_indptr[-1])
+        # gather[k] = old nnz position: per-row arange built flat
+        row_of_nnz = np.repeat(np.arange(len(sample_idx)), counts)
+        offset_in_row = np.arange(total) - np.repeat(new_indptr[:-1], counts)
+        gather = self.indptr[sample_idx][row_of_nnz] + offset_in_row
+        return FeatureShard(indptr=new_indptr, cols=self.cols[gather],
+                            vals=self.vals[gather], dim=self.dim)
+
+    @staticmethod
+    def from_coo(rows, cols, vals, n_samples: int, dim: int) -> "FeatureShard":
+        rows = np.asarray(rows, np.int64)
+        order = np.argsort(rows, kind="stable")
+        rows, cols, vals = rows[order], np.asarray(cols, np.int32)[order], \
+            np.asarray(vals, np.float32)[order]
+        indptr = np.zeros(n_samples + 1, np.int64)
+        np.cumsum(np.bincount(rows, minlength=n_samples), out=indptr[1:])
+        return FeatureShard(indptr=indptr, cols=cols, vals=vals, dim=dim)
+
+    def to_dense(self) -> np.ndarray:
+        x = np.zeros((self.n_samples, self.dim), np.float32)
+        np.add.at(x, (self.rows(), self.cols.astype(np.int64)), self.vals)
+        return x
+
+
+@dataclasses.dataclass(frozen=True)
+class GameData:
+    """The global host-resident dataset: one row per sample.
+
+    Counterpart of the reference's ``RDD[(UniqueSampleId, GameDatum)]``
+    (``data/GameDatum.scala`` + ``data/GameConverters.scala``): response,
+    additive offset, weight, per-shard feature vectors, and per-entity-type
+    integer id columns (entity ids are pre-indexed into ``[0, n_entities)``
+    by ingest; ``-1`` marks a missing id).
+    """
+
+    labels: np.ndarray  # (n,) float32
+    offsets: np.ndarray  # (n,) float32
+    weights: np.ndarray  # (n,) float32
+    shards: dict[str, FeatureShard]
+    id_columns: dict[str, np.ndarray]  # entity-type -> (n,) int64
+
+    def __post_init__(self):
+        n = self.labels.shape[0]
+        for name, shard in self.shards.items():
+            if shard.n_samples != n:
+                raise ValueError(f"shard {name!r}: {shard.n_samples} rows != {n}")
+        for name, ids in self.id_columns.items():
+            if ids.shape[0] != n:
+                raise ValueError(f"id column {name!r}: {ids.shape[0]} != {n}")
+
+    @property
+    def n_samples(self) -> int:
+        return int(self.labels.shape[0])
+
+    @staticmethod
+    def build(labels, shards, offsets=None, weights=None, id_columns=None) -> "GameData":
+        labels = np.asarray(labels, np.float32)
+        n = labels.shape[0]
+        return GameData(
+            labels=labels,
+            offsets=np.zeros(n, np.float32) if offsets is None
+            else np.asarray(offsets, np.float32),
+            weights=np.ones(n, np.float32) if weights is None
+            else np.asarray(weights, np.float32),
+            shards=dict(shards),
+            id_columns={k: np.asarray(v, np.int64)
+                        for k, v in (id_columns or {}).items()},
+        )
+
+
+# ---------------------------------------------------------------------------
+# Fixed effect
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class FixedEffectDataset:
+    """Device-ready data for one fixed-effect coordinate
+    (reference ``data/FixedEffectDataset.scala``).
+
+    Holds the device arrays minus offsets — coordinate descent supplies
+    fresh residual offsets every sweep via :meth:`with_offsets`.
+    """
+
+    coordinate_id: str
+    feature_shard_id: str
+    design: object  # DenseDesign | CsrDesign (device)
+    labels: jnp.ndarray
+    weights: jnp.ndarray
+    dim: int
+
+    @staticmethod
+    def build(coordinate_id: str, data: GameData, feature_shard_id: str,
+              *, dense_max_dim: int = DENSE_DESIGN_MAX_DIM,
+              dtype=jnp.float32) -> "FixedEffectDataset":
+        shard = data.shards[feature_shard_id]
+        if shard.dim <= dense_max_dim:
+            design = DenseDesign(x=jnp.asarray(shard.to_dense(), dtype))
+        else:
+            design = CsrDesign(
+                rows=jnp.asarray(shard.rows(), jnp.int32),
+                cols=jnp.asarray(shard.cols, jnp.int32),
+                values=jnp.asarray(shard.vals),
+                n_rows=shard.n_samples, n_cols=shard.dim)
+        return FixedEffectDataset(
+            coordinate_id=coordinate_id, feature_shard_id=feature_shard_id,
+            design=design, labels=jnp.asarray(data.labels),
+            weights=jnp.asarray(data.weights), dim=shard.dim)
+
+    def glm_data(self, offsets) -> GLMData:
+        return GLMData(design=self.design, labels=self.labels,
+                       offsets=jnp.asarray(offsets, jnp.float32),
+                       weights=self.weights)
+
+
+# ---------------------------------------------------------------------------
+# Random effect
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomEffectDatasetConfig:
+    """Bounds and projection settings for one random-effect coordinate
+    (reference ``data/RandomEffectDataset.scala`` +
+    ``RandomEffectDataConfiguration``)."""
+
+    random_effect_type: str  # id-column name, e.g. "userId"
+    feature_shard_id: str
+    #: max training rows kept per entity (reservoir subsample beyond this);
+    #: None = unlimited (reference activeDataUpperBound).
+    active_data_upper_bound: Optional[int] = None
+    #: entities with fewer rows than this get no model (rows stay passive).
+    active_data_lower_bound: int = 1
+    #: cap on per-entity features kept (by within-entity support, ties by id;
+    #: reference LocalDataset feature pruning). None = all observed.
+    max_active_features: Optional[int] = None
+    #: bucket shape granularity: per-entity sample/feature counts are padded
+    #: up to powers of these growth factors. Every distinct padded
+    #: (samples, features) shape is a separate XLA compilation of the
+    #: vmapped solver, so coarser growth = fewer compiles but more padded
+    #: compute. 4.0 keeps shape count ~log4(max entity size) ≈ a handful.
+    sample_bucket_growth: float = 4.0
+    feature_bucket_growth: float = 2.0
+    seed: int = 20260729
+
+
+def _geom_at_least(x: np.ndarray, growth: float, floor: int = 1) -> np.ndarray:
+    """Elementwise next integer power of ``growth`` ≥ max(x, floor)."""
+    x = np.maximum(np.asarray(x, np.int64), floor)
+    exp = np.ceil(np.log(x) / np.log(growth) - 1e-9).astype(np.int64)
+    out = np.ceil(np.power(growth, exp)).astype(np.int64)
+    return np.maximum(out, x)  # guard against fp rounding down
+
+
+@dataclasses.dataclass(frozen=True)
+class REBucket:
+    """One fixed-shape bucket of entities: the unit of vmapped solving.
+
+    ``x`` is dense ``(E, S, D)`` in each entity's **local** feature space;
+    ``feature_index`` maps local column j of entity e to the shard-global
+    feature id (``-1`` on padding columns, whose x-values are all zero).
+    ``weights`` is zero on padded sample rows, which the objective treats as
+    exactly absent.
+    """
+
+    entity_ids: np.ndarray  # (E,) int64 — global entity index
+    x: np.ndarray  # (E, S, D) float32
+    labels: np.ndarray  # (E, S) float32
+    offsets_zero: bool  # offsets supplied per sweep; kept for clarity
+    weights: np.ndarray  # (E, S) float32 (0 = padding)
+    sample_idx: np.ndarray  # (E, S) int64 global sample row of each slot (-1 pad)
+    feature_index: np.ndarray  # (E, D) int64 shard-global feature ids (-1 pad)
+
+    @property
+    def n_entities(self) -> int:
+        return int(self.entity_ids.shape[0])
+
+    @property
+    def shape(self) -> tuple[int, int]:
+        return (int(self.x.shape[1]), int(self.x.shape[2]))
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomEffectDataset:
+    """Active data bucketed for vmapped solves + passive remainder.
+
+    The reference's active data is ``RDD[(REId, LocalDataset)]`` hash-sharded
+    by ``RandomEffectDatasetPartitioner``; here the load balancing is done by
+    construction — same-shaped entities share a bucket, and buckets shard
+    evenly over the ``entity`` mesh axis.
+    """
+
+    coordinate_id: str
+    config: RandomEffectDatasetConfig
+    buckets: list[REBucket]
+    #: passive rows, scored-only (reference passiveData): global sample rows
+    #: plus their entity ids.
+    passive_sample_idx: np.ndarray  # (p,) int64
+    passive_entity_ids: np.ndarray  # (p,) int64
+    n_entities_total: int
+
+    @property
+    def n_active_entities(self) -> int:
+        return sum(b.n_entities for b in self.buckets)
+
+    @staticmethod
+    def build(coordinate_id: str, data: GameData,
+              config: RandomEffectDatasetConfig) -> "RandomEffectDataset":
+        shard = data.shards[config.feature_shard_id]
+        entities = data.id_columns[config.random_effect_type]
+        n = data.n_samples
+        rng = np.random.default_rng(config.seed)
+
+        present = entities >= 0
+        order = np.argsort(entities[present], kind="stable")
+        sample_rows = np.flatnonzero(present)[order]  # samples grouped by entity
+        ent_sorted = entities[sample_rows]
+        uniq, seg_start, seg_count = np.unique(
+            ent_sorted, return_index=True, return_counts=True)
+
+        # --- active/passive split per entity ------------------------------
+        active_rows: list[np.ndarray] = []
+        passive_rows: list[np.ndarray] = []
+        act_entity: list[int] = []
+        upper = config.active_data_upper_bound
+        for e, s0, c in zip(uniq, seg_start, seg_count):
+            rows_e = sample_rows[s0:s0 + c]
+            if c < config.active_data_lower_bound:
+                passive_rows.append(rows_e)
+                continue
+            if upper is not None and c > upper:
+                keep = rng.choice(c, size=upper, replace=False)
+                keep_mask = np.zeros(c, bool)
+                keep_mask[keep] = True
+                active_rows.append(rows_e[keep_mask])
+                passive_rows.append(rows_e[~keep_mask])
+            else:
+                active_rows.append(rows_e)
+            act_entity.append(int(e))
+        passive = (np.concatenate(passive_rows) if passive_rows
+                   else np.zeros((0,), np.int64))
+
+        # --- per-entity local feature maps --------------------------------
+        # For each active entity: observed shard features (optionally pruned
+        # to the top max_active_features by support), compact-indexed.
+        ent_of_active = np.concatenate([
+            np.full(len(r), i, np.int64) for i, r in enumerate(active_rows)
+        ]) if active_rows else np.zeros((0,), np.int64)
+        all_active = (np.concatenate(active_rows) if active_rows
+                      else np.zeros((0,), np.int64))
+        sub = shard.take(all_active)  # CSR over active rows, entity-grouped
+        nnz_ent = np.repeat(ent_of_active, sub.row_counts())  # entity per nnz
+
+        # count support per (entity, feature)
+        pair_keys = nnz_ent * np.int64(shard.dim) + sub.cols.astype(np.int64)
+        uniq_pairs, pair_inv, pair_support = np.unique(
+            pair_keys, return_inverse=True, return_counts=True)
+        pair_ent = uniq_pairs // shard.dim
+        pair_feat = uniq_pairs % shard.dim
+
+        # prune: rank features within entity by (-support, feature id)
+        if config.max_active_features is not None:
+            rank_order = np.lexsort((pair_feat, -pair_support, pair_ent))
+            ranked_ent = pair_ent[rank_order]
+            starts = _group_starts(ranked_ent)
+            rank_within = np.arange(len(ranked_ent)) - np.repeat(
+                starts, np.diff(np.append(starts, len(ranked_ent))))
+            kept_sorted = rank_within < config.max_active_features
+            kept = np.zeros(len(uniq_pairs), bool)
+            kept[rank_order] = kept_sorted
+        else:
+            kept = np.ones(len(uniq_pairs), bool)
+
+        # local index of each kept pair within its entity (order: feature id)
+        local_idx = np.full(len(uniq_pairs), -1, np.int64)
+        kept_ent = pair_ent[kept]
+        starts_k = _group_starts(kept_ent)
+        counts_k = np.diff(np.append(starts_k, len(kept_ent)))
+        local_idx[kept] = np.arange(len(kept_ent)) - np.repeat(starts_k, counts_k)
+        n_feat_per_entity = np.zeros(len(active_rows), np.int64)
+        if len(kept_ent):
+            ent_u, ent_c = np.unique(kept_ent, return_counts=True)
+            n_feat_per_entity[ent_u] = ent_c
+
+        n_samp_per_entity = np.array([len(r) for r in active_rows], np.int64)
+
+        # --- bucketing by (padded samples, padded features) ----------------
+        buckets: list[REBucket] = []
+        if len(active_rows):
+            s_pad = _geom_at_least(n_samp_per_entity, config.sample_bucket_growth)
+            d_pad = _geom_at_least(n_feat_per_entity, config.feature_bucket_growth)
+            bucket_key = s_pad * np.int64(1 << 40) + d_pad
+            for key in np.unique(bucket_key):
+                sel = np.flatnonzero(bucket_key == key)
+                S = int(s_pad[sel[0]])
+                D = int(d_pad[sel[0]])
+                E = len(sel)
+                x = np.zeros((E, S, D), np.float32)
+                labels = np.zeros((E, S), np.float32)
+                weights = np.zeros((E, S), np.float32)
+                sample_idx = np.full((E, S), -1, np.int64)
+                feature_index = np.full((E, D), -1, np.int64)
+
+                slot_of_entity = np.full(len(active_rows), -1, np.int64)
+                slot_of_entity[sel] = np.arange(E)
+
+                # features
+                sel_pairs = kept & np.isin(pair_ent, sel)
+                pe = slot_of_entity[pair_ent[sel_pairs]]
+                feature_index[pe, local_idx[sel_pairs]] = pair_feat[sel_pairs]
+
+                # samples: rows of these entities, slot position within entity
+                ent_mask = np.isin(ent_of_active, sel)
+                rows_sel = np.flatnonzero(ent_mask)
+                ent_rows = ent_of_active[rows_sel]
+                row_starts = _group_starts(ent_rows)
+                row_counts = np.diff(np.append(row_starts, len(ent_rows)))
+                pos = np.arange(len(ent_rows)) - np.repeat(row_starts, row_counts)
+                es = slot_of_entity[ent_rows]
+                g = all_active[rows_sel]
+                labels[es, pos] = data.labels[g]
+                weights[es, pos] = data.weights[g]
+                sample_idx[es, pos] = g
+
+                # nnz values into local dense tensor
+                nnz_sel = np.isin(nnz_ent, sel) & (local_idx[pair_inv] >= 0)
+                # local sample position for each nnz: position of its active row
+                pos_of_active_row = np.full(len(all_active), -1, np.int64)
+                pos_of_active_row[rows_sel] = pos
+                nnz_rows_local = np.repeat(
+                    np.arange(len(all_active)), sub.row_counts())
+                take = nnz_sel
+                e_nnz = slot_of_entity[nnz_ent[take]]
+                s_nnz = pos_of_active_row[nnz_rows_local[take]]
+                d_nnz = local_idx[pair_inv[take]]
+                np.add.at(x, (e_nnz, s_nnz, d_nnz), sub.vals[take])
+
+                buckets.append(REBucket(
+                    entity_ids=np.array([act_entity[i] for i in sel], np.int64),
+                    x=x, labels=labels, offsets_zero=True, weights=weights,
+                    sample_idx=sample_idx, feature_index=feature_index))
+
+        n_entities_total = int(entities.max()) + 1 if n and present.any() else 0
+        return RandomEffectDataset(
+            coordinate_id=coordinate_id, config=config, buckets=buckets,
+            passive_sample_idx=passive,
+            passive_entity_ids=entities[passive],
+            n_entities_total=n_entities_total)
+
+

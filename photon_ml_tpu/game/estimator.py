@@ -1,0 +1,175 @@
+"""GameEstimator: build datasets once, fit many configurations, pick the best.
+
+Re-design of ``photon-api/.../estimators/GameEstimator.scala``: the estimator
+owns the (expensive) dataset construction — fixed-effect device arrays and
+random-effect bucketing happen once — then loops over hyperparameter
+configurations (a grid of per-coordinate regularization weights, or points
+suggested by the Bayesian search), running coordinate descent per
+configuration and evaluating validation data. Returns one
+:class:`GameResult` per configuration; the first validation evaluator is the
+model-selection criterion (reference ``ModelSelection``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Mapping, Optional, Sequence
+
+import numpy as np
+
+from photon_ml_tpu.evaluation import EvaluationResults, Evaluator, evaluate_all
+from photon_ml_tpu.game.coordinate import (
+    FixedEffectCoordinate,
+    RandomEffectCoordinate,
+)
+from photon_ml_tpu.game.coordinate_descent import CoordinateDescent
+from photon_ml_tpu.game.data import (
+    FixedEffectDataset,
+    GameData,
+    RandomEffectDataset,
+    RandomEffectDatasetConfig,
+)
+from photon_ml_tpu.game.model import GameModel
+from photon_ml_tpu.glm.problem import GLMOptimizationConfiguration
+from photon_ml_tpu.sampling import DownSampler
+from photon_ml_tpu.types import TaskType
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class FixedEffectCoordinateConfig:
+    """Static definition of a fixed-effect coordinate
+    (reference ``FixedEffectDataConfiguration`` +
+    ``FixedEffectOptimizationConfiguration``)."""
+
+    feature_shard_id: str
+    optimization: GLMOptimizationConfiguration = GLMOptimizationConfiguration()
+    downsampler: Optional[DownSampler] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomEffectCoordinateConfig:
+    """Static definition of a random-effect coordinate
+    (reference ``RandomEffectDataConfiguration`` +
+    ``RandomEffectOptimizationConfiguration``)."""
+
+    dataset: RandomEffectDatasetConfig
+    optimization: GLMOptimizationConfiguration = GLMOptimizationConfiguration()
+
+
+CoordinateConfig = FixedEffectCoordinateConfig | RandomEffectCoordinateConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class GameOptimizationConfiguration:
+    """One hyperparameter point: per-coordinate regularization weights
+    (reference ``GameEstimator.GameOptimizationConfiguration``)."""
+
+    regularization_weights: Mapping[str, float]
+
+    def lam(self, coordinate_id: str) -> float:
+        return float(self.regularization_weights.get(coordinate_id, 0.0))
+
+
+@dataclasses.dataclass
+class GameResult:
+    """(model, validation evaluation, configuration) triple."""
+
+    model: GameModel
+    configuration: GameOptimizationConfiguration
+    evaluation: Optional[EvaluationResults]
+    validation_history: list[dict[str, float]]
+
+
+@dataclasses.dataclass
+class GameEstimator:
+    """Fits GAME models over a training set for many configurations."""
+
+    task: TaskType
+    coordinate_configs: Mapping[str, CoordinateConfig]
+    update_sequence: Sequence[str]
+    n_cd_iterations: int = 1
+
+    def __post_init__(self):
+        for cid in self.update_sequence:
+            if cid not in self.coordinate_configs:
+                raise KeyError(f"update sequence names unknown coordinate {cid!r}")
+
+    # --- dataset construction (once) --------------------------------------
+    def prepare(self, data: GameData) -> dict[str, object]:
+        datasets: dict[str, object] = {}
+        for cid in self.update_sequence:
+            cfg = self.coordinate_configs[cid]
+            if isinstance(cfg, FixedEffectCoordinateConfig):
+                datasets[cid] = FixedEffectDataset.build(
+                    cid, data, cfg.feature_shard_id)
+            else:
+                datasets[cid] = RandomEffectDataset.build(cid, data, cfg.dataset)
+                logger.info(
+                    "coordinate %s: %d active entities in %d buckets, "
+                    "%d passive rows", cid, datasets[cid].n_active_entities,
+                    len(datasets[cid].buckets),
+                    len(datasets[cid].passive_sample_idx))
+        return datasets
+
+    def _coordinates(self, data: GameData, datasets: Mapping[str, object],
+                     config: GameOptimizationConfiguration):
+        out = {}
+        for cid in self.update_sequence:
+            ccfg = self.coordinate_configs[cid]
+            if isinstance(ccfg, FixedEffectCoordinateConfig):
+                out[cid] = FixedEffectCoordinate(
+                    coordinate_id=cid, dataset=datasets[cid], task=self.task,
+                    config=ccfg.optimization, lam=config.lam(cid),
+                    downsampler=ccfg.downsampler)
+            else:
+                out[cid] = RandomEffectCoordinate(
+                    coordinate_id=cid, dataset=datasets[cid], data=data,
+                    task=self.task, config=ccfg.optimization,
+                    lam=config.lam(cid))
+        return out
+
+    # --- fit ---------------------------------------------------------------
+    def fit(
+        self,
+        data: GameData,
+        configurations: Sequence[GameOptimizationConfiguration],
+        validation: Optional[tuple[GameData, Sequence[Evaluator]]] = None,
+    ) -> list[GameResult]:
+        datasets = self.prepare(data)
+        cd = CoordinateDescent(update_sequence=self.update_sequence,
+                               n_iterations=self.n_cd_iterations)
+        results: list[GameResult] = []
+        for config in configurations:
+            coordinates = self._coordinates(data, datasets, config)
+            cd_result = cd.run(coordinates, data, self.task,
+                               validation=validation)
+            evaluation = None
+            if validation is not None:
+                vdata, evaluators = validation
+                vscores = cd_result.model.score(vdata)
+                evaluation = evaluate_all(
+                    evaluators, vscores, vdata.labels, weights=vdata.weights,
+                    id_tags=vdata.id_columns)
+            results.append(GameResult(
+                model=cd_result.model, configuration=config,
+                evaluation=evaluation,
+                validation_history=cd_result.validation_history))
+            logger.info("configuration %s -> %s",
+                        dict(config.regularization_weights), evaluation)
+        return results
+
+    @staticmethod
+    def select_best(results: Sequence[GameResult]) -> GameResult:
+        """Best by the first validation evaluator (reference ModelSelection)."""
+        scored = [r for r in results if r.evaluation is not None]
+        if not scored:
+            return results[0]
+        best = scored[0]
+        for r in scored[1:]:
+            ev, val = r.evaluation.primary
+            if ev.better_than(val, best.evaluation.primary[1]):
+                best = r
+        return best

@@ -1,0 +1,127 @@
+"""GAME model layer: fixed-effect, random-effect, and composite GAME models.
+
+Re-design of the reference's model layer
+(``photon-api/.../model/{GameModel, FixedEffectModel, RandomEffectModel,
+DatumScoringModel}.scala``). A ``GameModel`` is an ordered map
+coordinateId → per-coordinate model; total score of a sample is the sum of
+coordinate scores plus the data offset — the invariant coordinate descent's
+residual bookkeeping relies on (SURVEY.md §7 hard-parts #6).
+
+The reference keeps the fixed effect as broadcast coefficients and random
+effects as ``RDD[(REId, GLM)]``. Here the fixed effect is a single device
+coefficient vector, and a random-effect model is a flat **(entity, feature) →
+coefficient** table in host numpy: per-entity coefficient blocks from the
+bucketed solves, flattened and key-sorted so scoring any dataset is one
+searchsorted join — the vectorized equivalent of the reference's
+score-time RDD join (``model/RandomEffectModel.scala``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping, Optional
+
+import numpy as np
+
+from photon_ml_tpu.game.data import FeatureShard, GameData
+from photon_ml_tpu.models.glm import GeneralizedLinearModel
+from photon_ml_tpu.types import TaskType
+
+
+@dataclasses.dataclass(frozen=True)
+class FixedEffectModel:
+    """Global coefficients for one fixed-effect coordinate
+    (reference ``model/FixedEffectModel.scala``)."""
+
+    model: GeneralizedLinearModel
+    feature_shard_id: str
+
+    def score(self, data: GameData) -> np.ndarray:
+        """Raw margins w·x per sample (no offset; CD owns the accounting)."""
+        shard = data.shards[self.feature_shard_id]
+        w = np.asarray(self.model.coefficients.means, np.float64)
+        out = np.zeros(data.n_samples, np.float64)
+        np.add.at(out, shard.rows(),
+                  shard.vals.astype(np.float64) * w[shard.cols])
+        return out.astype(np.float32)
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomEffectModel:
+    """Per-entity coefficient table for one random-effect coordinate.
+
+    ``keys`` are ``entity_id * dim + feature_id`` (int64, sorted);
+    ``coeffs`` the matching coefficient values; entities absent from the
+    table score 0 (the reference's behavior for entities dropped by the
+    active-data lower bound). ``variances`` is optional, aligned with
+    ``coeffs``.
+    """
+
+    random_effect_type: str
+    feature_shard_id: str
+    task: TaskType
+    dim: int  # shard vocabulary size
+    keys: np.ndarray  # (k,) int64, sorted
+    coeffs: np.ndarray  # (k,) float32
+    variances: Optional[np.ndarray] = None
+
+    @property
+    def n_entities(self) -> int:
+        return int(np.unique(self.keys // self.dim).shape[0]) if len(self.keys) else 0
+
+    def lookup(self, entity_ids: np.ndarray, feature_ids: np.ndarray) -> np.ndarray:
+        """Coefficient for each (entity, feature) pair; 0 where absent."""
+        q = entity_ids.astype(np.int64) * self.dim + feature_ids.astype(np.int64)
+        pos = np.searchsorted(self.keys, q)
+        pos = np.minimum(pos, max(len(self.keys) - 1, 0))
+        found = (self.keys[pos] == q) if len(self.keys) else np.zeros(q.shape, bool)
+        out = np.zeros(q.shape, np.float32)
+        out[found] = self.coeffs[pos[found]]
+        return out
+
+    def entity_coefficients(self, entity_id: int) -> dict[int, float]:
+        """Sparse coefficient dict of one entity (for inspection/IO)."""
+        lo = np.searchsorted(self.keys, entity_id * self.dim)
+        hi = np.searchsorted(self.keys, (entity_id + 1) * self.dim)
+        return {int(k % self.dim): float(v)
+                for k, v in zip(self.keys[lo:hi], self.coeffs[lo:hi])}
+
+    def score(self, data: GameData,
+              sample_idx: Optional[np.ndarray] = None) -> np.ndarray:
+        """Margins from this coordinate: sum_j x_j * w[entity, j] per sample.
+
+        With ``sample_idx``, scores only those rows (returned in that order)
+        — the passive-data scoring path of coordinate descent.
+        """
+        shard = data.shards[self.feature_shard_id]
+        entities = data.id_columns[self.random_effect_type]
+        if sample_idx is not None:
+            shard = shard.take(sample_idx)
+            entities = entities[sample_idx]
+        rows = shard.rows()
+        ent_per_nnz = entities[rows]
+        valid = ent_per_nnz >= 0
+        w = np.zeros(shard.nnz, np.float32)
+        if valid.any():
+            w[valid] = self.lookup(ent_per_nnz[valid], shard.cols[valid])
+        out = np.zeros(shard.n_samples, np.float64)
+        np.add.at(out, rows, shard.vals.astype(np.float64) * w)
+        return out.astype(np.float32)
+
+
+@dataclasses.dataclass(frozen=True)
+class GameModel:
+    """Ordered coordinateId → model map (reference ``model/GameModel.scala``)."""
+
+    coordinates: Mapping[str, FixedEffectModel | RandomEffectModel]
+    task: TaskType
+
+    def score(self, data: GameData) -> np.ndarray:
+        """Total margin per sample: offsets + sum of coordinate scores."""
+        total = data.offsets.astype(np.float64)
+        for model in self.coordinates.values():
+            total = total + model.score(data)
+        return total.astype(np.float32)
+
+    def score_by_coordinate(self, data: GameData) -> dict[str, np.ndarray]:
+        return {cid: m.score(data) for cid, m in self.coordinates.items()}

@@ -111,7 +111,11 @@ class OptimizationProblem:
             diag = self.objective.hessian_diagonal(w, data, l2)
             return 1.0 / jnp.maximum(diag, jnp.finfo(diag.dtype).tiny)
         h = self.objective.hessian_matrix(w, data, l2)
-        return jnp.diag(jnp.linalg.inv(h))
+        # pinv, not inv: padded/unobserved feature dims (all-zero design
+        # columns, e.g. random-effect bucket padding) make H singular; the
+        # pseudo-inverse assigns them variance 0 instead of NaN-ing the
+        # whole inverse.
+        return jnp.diag(jnp.linalg.pinv(h, hermitian=True))
 
     def run_with_variances(self, data: GLMData, w0: Array, lam=0.0
                            ) -> tuple[Coefficients, OptimizerResult]:

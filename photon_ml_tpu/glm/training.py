@@ -61,6 +61,8 @@ def train_glm_sweep(
     follows that order. ``reg_mask`` excludes coefficients (e.g. the
     intercept) from regularization.
     """
+    for lam in regularization_weights:
+        config.regularization.check_weight(lam)
     objective = GLMObjective(
         loss=loss_for_task(task), normalization=normalization, reg_mask=reg_mask)
     problem = OptimizationProblem(objective, config)

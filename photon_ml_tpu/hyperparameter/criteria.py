@@ -1,0 +1,20 @@
+"""Acquisition criteria (reference
+``photon-lib/.../hyperparameter/criteria/ExpectedImprovement.scala``)."""
+
+from __future__ import annotations
+
+import numpy as np
+from scipy.stats import norm
+
+
+def expected_improvement(mean: np.ndarray, var: np.ndarray,
+                         best: float, *, maximize: bool = True) -> np.ndarray:
+    """EI of candidate points given GP posterior (mean, var) and incumbent.
+
+    ``maximize`` gives the metric direction (AUC ↑, RMSE ↓); EI itself is
+    always maximized by the search.
+    """
+    std = np.sqrt(var)
+    imp = (mean - best) if maximize else (best - mean)
+    z = imp / std
+    return imp * norm.cdf(z) + std * norm.pdf(z)

@@ -174,11 +174,11 @@ class GLMObjective:
         """Full ``(d, d)`` Hessian (VarianceComputationType FULL; replaces
         ``HessianMatrixAggregator.scala``). Only for small ``d`` — the
         reference has the same restriction."""
-        d2 = self._d2_weights(w, data)
         if not isinstance(data.design, DenseDesign):
             # Materialize through Hvp columns for sparse designs.
             eye = jnp.eye(data.dim, dtype=w.dtype)
             return jax.vmap(lambda v: self.hvp(w, v, data, l2))(eye).T
+        d2 = self._d2_weights(w, data)
         x = data.design.x
         if self.normalization.shifts is not None:
             x = x - self.normalization.shifts

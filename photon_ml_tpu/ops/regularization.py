@@ -51,6 +51,18 @@ class RegularizationContext:
             return 0.0
         return (1.0 - self.alpha) * regularization_weight
 
+    def check_weight(self, regularization_weight: float) -> None:
+        """Reject a nonzero lambda paired with a NONE context — the weight
+        would be silently ignored (every l1/l2 split maps it to 0), which
+        turns a regularization sweep or hyperparameter search into identical
+        unregularized fits. Call with *concrete* weights only (host side)."""
+        if (self.reg_type == RegularizationType.NONE
+                and float(regularization_weight) != 0.0):
+            raise ValueError(
+                f"regularization_weight={regularization_weight} has no effect "
+                "under RegularizationType.NONE; configure an L1/L2/elastic-net "
+                "RegularizationContext")
+
     @property
     def has_l1(self) -> bool:
         return self.reg_type in (RegularizationType.L1, RegularizationType.ELASTIC_NET) and self.alpha > 0.0

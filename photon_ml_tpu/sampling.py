@@ -1,0 +1,52 @@
+"""Down-sampling for fixed-effect training data.
+
+Re-design of the reference's samplers
+(``photon-api/.../sampling/{DownSampler, BinaryClassificationDownSampler,
+DefaultDownSampler}.scala``): the reference materializes a down-sampled RDD
+per CD iteration; here sampling is a fresh per-sweep weight vector — rows
+dropped get weight 0 (exactly absent from the objective), kept rows are
+re-weighted by ``1/rate`` so the objective stays an unbiased estimate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class DownSampler:
+    """Uniform down-sampler (reference ``DefaultDownSampler``)."""
+
+    rate: float
+    seed: int = 20260729
+
+    def __post_init__(self):
+        if not 0.0 < self.rate < 1.0:
+            raise ValueError(f"down-sampling rate must be in (0, 1): {self.rate}")
+
+    def downsample(self, labels: np.ndarray, weights: np.ndarray,
+                   sweep: int = 0) -> np.ndarray:
+        """``sweep`` must vary per CD iteration so each sweep draws a fresh
+        sample (the reference creates a new sampled RDD per iteration)."""
+        rng = np.random.default_rng((self.seed, sweep))
+        keep = rng.uniform(size=labels.shape[0]) < self.rate
+        out = np.where(keep, weights / self.rate, 0.0).astype(np.float32)
+        return out
+
+
+@dataclasses.dataclass(frozen=True)
+class BinaryClassificationDownSampler(DownSampler):
+    """Negative-class down-sampler for dominant-negative binary data
+    (reference ``BinaryClassificationDownSampler``): positives always kept;
+    negatives kept with probability ``rate`` and re-weighted ``1/rate``."""
+
+    def downsample(self, labels: np.ndarray, weights: np.ndarray,
+                   sweep: int = 0) -> np.ndarray:
+        rng = np.random.default_rng((self.seed, sweep))
+        pos = labels > 0.5
+        keep_neg = rng.uniform(size=labels.shape[0]) < self.rate
+        out = np.where(pos, weights,
+                       np.where(keep_neg, weights / self.rate, 0.0))
+        return out.astype(np.float32)

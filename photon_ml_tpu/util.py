@@ -1,0 +1,17 @@
+"""Small host-side helpers shared across modules
+(counterpart of the reference's ``util/`` grab-bag, e.g. ``MathUtils.scala``)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def group_starts(sorted_ids: np.ndarray) -> np.ndarray:
+    """Indices where a new group begins in a group-sorted id array."""
+    n = sorted_ids.shape[0]
+    if n == 0:
+        return np.zeros((0,), np.int64)
+    new = np.empty(n, bool)
+    new[0] = True
+    np.not_equal(sorted_ids[1:], sorted_ids[:-1], out=new[1:])
+    return np.flatnonzero(new)

@@ -1,0 +1,282 @@
+"""GAME stack tests: bucketing, vmapped random-effect solves, coordinate descent.
+
+Mirrors the reference's integration-test strategy
+(``RandomEffectDatasetIntegTest``, ``CoordinateDescentIntegTest``,
+``GameEstimatorIntegTest``) on synthetic mixed-effect data: a global fixed
+effect plus per-entity random intercept/slopes, so GAME must beat the
+fixed-effect-only model.
+"""
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.evaluation import parse_evaluators
+from photon_ml_tpu.game import (
+    FixedEffectDataset,
+    GameData,
+    FeatureShard,
+    RandomEffectDataset,
+    RandomEffectDatasetConfig,
+    GameEstimator,
+    GameOptimizationConfiguration,
+)
+from photon_ml_tpu.game.coordinate import FixedEffectCoordinate, RandomEffectCoordinate
+from photon_ml_tpu.game.coordinate_descent import CoordinateDescent
+from photon_ml_tpu.game.estimator import (
+    FixedEffectCoordinateConfig,
+    RandomEffectCoordinateConfig,
+)
+from photon_ml_tpu.game.random_effect import RandomEffectSolver
+from photon_ml_tpu.glm.problem import GLMOptimizationConfiguration
+from photon_ml_tpu.ops.regularization import L2Regularization
+from photon_ml_tpu.optimize import OptimizerConfig
+from photon_ml_tpu.types import TaskType
+
+
+def make_mixed_data(n=2000, d_fixed=8, d_re=4, n_entities=37, seed=0,
+                    param_seed=12345):
+    """Logistic data with a global effect and per-entity random slopes.
+
+    ``param_seed`` fixes the true (w_fixed, u) so train/validation splits
+    drawn with different ``seed`` share one distribution.
+    """
+    prng = np.random.default_rng(param_seed)
+    w_fixed = prng.normal(size=d_fixed).astype(np.float32)
+    u = (1.5 * prng.normal(size=(n_entities, d_re))).astype(np.float32)
+    rng = np.random.default_rng(seed)
+    xf = rng.normal(size=(n, d_fixed)).astype(np.float32)
+    xr = rng.normal(size=(n, d_re)).astype(np.float32)
+    # power-law-ish entity sizes
+    probs = 1.0 / np.arange(1, n_entities + 1)
+    probs /= probs.sum()
+    ent = rng.choice(n_entities, size=n, p=probs).astype(np.int64)
+    margin = xf @ w_fixed + np.einsum("nd,nd->n", xr, u[ent])
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-margin))).astype(np.float32)
+
+    def shard_from_dense(x):
+        n_, d_ = x.shape
+        rows = np.repeat(np.arange(n_), d_)
+        cols = np.tile(np.arange(d_, dtype=np.int32), n_)
+        return FeatureShard.from_coo(rows, cols, x.ravel(), n_, d_)
+
+    data = GameData.build(
+        labels=y,
+        shards={"fixed": shard_from_dense(xf), "re": shard_from_dense(xr)},
+        id_columns={"entityId": ent},
+    )
+    return data, (xf, xr, ent, w_fixed, u)
+
+
+class TestRandomEffectDataset:
+    def test_bucket_roundtrip(self):
+        data, (xf, xr, ent, *_) = make_mixed_data(n=500, n_entities=11)
+        ds = RandomEffectDataset.build(
+            "re", data, RandomEffectDatasetConfig("entityId", "re"))
+        # every sample appears exactly once (active xor passive)
+        seen = np.concatenate(
+            [b.sample_idx[b.sample_idx >= 0] for b in ds.buckets]
+            + [ds.passive_sample_idx])
+        assert sorted(seen.tolist()) == list(range(500))
+        # bucket features reconstruct the original rows
+        for b in ds.buckets:
+            for e in range(b.n_entities):
+                for s in range(b.x.shape[1]):
+                    g = b.sample_idx[e, s]
+                    if g < 0:
+                        continue
+                    dense = np.zeros(4, np.float32)
+                    cols = b.feature_index[e]
+                    m = cols >= 0
+                    dense[cols[m]] = b.x[e, s, m]
+                    np.testing.assert_allclose(dense, xr[g], rtol=1e-6)
+                    assert ent[g] == b.entity_ids[e]
+
+    def test_active_bounds(self):
+        data, _ = make_mixed_data(n=800, n_entities=7)
+        ds = RandomEffectDataset.build(
+            "re", data,
+            RandomEffectDatasetConfig("entityId", "re",
+                                      active_data_upper_bound=20,
+                                      active_data_lower_bound=5))
+        for b in ds.buckets:
+            per_entity = (b.sample_idx >= 0).sum(axis=1)
+            assert (per_entity <= 20).all()
+            assert (per_entity >= 5).all()
+        # dropped + subsampled rows are passive
+        n_active = sum((b.sample_idx >= 0).sum() for b in ds.buckets)
+        assert n_active + len(ds.passive_sample_idx) == 800
+
+    def test_feature_pruning(self):
+        data, _ = make_mixed_data(n=300, n_entities=5)
+        ds = RandomEffectDataset.build(
+            "re", data,
+            RandomEffectDatasetConfig("entityId", "re", max_active_features=2))
+        for b in ds.buckets:
+            assert ((b.feature_index >= 0).sum(axis=1) <= 2).all()
+
+
+class TestRandomEffectSolver:
+    def test_matches_independent_solves(self):
+        """Bucketed vmapped solves == per-entity single solves."""
+        data, _ = make_mixed_data(n=600, n_entities=9, d_re=4)
+        ds = RandomEffectDataset.build(
+            "re", data, RandomEffectDatasetConfig("entityId", "re"))
+        cfg = GLMOptimizationConfiguration(
+            regularization=L2Regularization,
+            optimizer_config=OptimizerConfig(max_iterations=60, tolerance=1e-9))
+        solver = RandomEffectSolver(task=TaskType.LOGISTIC_REGRESSION, config=cfg)
+        model, scores = solver.train(
+            ds, np.zeros(data.n_samples, np.float32), lam=0.5, dim=4)
+
+        # independent reference solves on raw per-entity data
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.glm.problem import OptimizationProblem
+        from photon_ml_tpu.ops.design import DenseDesign
+        from photon_ml_tpu.ops.losses import loss_for_task
+        from photon_ml_tpu.ops.objective import GLMData, GLMObjective
+
+        xr = data.shards["re"].to_dense()
+        ent = data.id_columns["entityId"]
+        problem = OptimizationProblem(
+            GLMObjective(loss=loss_for_task(TaskType.LOGISTIC_REGRESSION)), cfg)
+        for e in np.unique(ent):
+            rows = np.flatnonzero(ent == e)
+            gd = GLMData(
+                design=DenseDesign(x=jnp.asarray(xr[rows])),
+                labels=jnp.asarray(data.labels[rows]),
+                offsets=jnp.zeros(len(rows)),
+                weights=jnp.ones(len(rows)))
+            ref = problem.run(gd, jnp.zeros(4), 0.5)
+            got = np.zeros(4, np.float32)
+            for j, v in model.entity_coefficients(int(e)).items():
+                got[j] = v
+            np.testing.assert_allclose(got, np.asarray(ref.w), atol=5e-4)
+
+    def test_scores_match_model_score(self):
+        data, _ = make_mixed_data(n=400, n_entities=6)
+        ds = RandomEffectDataset.build(
+            "re", data, RandomEffectDatasetConfig("entityId", "re"))
+        solver = RandomEffectSolver(
+            task=TaskType.LOGISTIC_REGRESSION,
+            config=GLMOptimizationConfiguration(regularization=L2Regularization))
+        model, scores = solver.train(
+            ds, np.zeros(data.n_samples, np.float32), lam=1.0, dim=4)
+        np.testing.assert_allclose(
+            scores, model.score(data), rtol=1e-4, atol=1e-5)
+
+
+class TestCoordinateDescent:
+    def _coords(self, data, lam_f=0.01, lam_r=0.1, upper=None):
+        fe_ds = FixedEffectDataset.build("global", data, "fixed")
+        re_ds = RandomEffectDataset.build(
+            "perEntity", data,
+            RandomEffectDatasetConfig("entityId", "re",
+                                      active_data_upper_bound=upper))
+        cfg = GLMOptimizationConfiguration(regularization=L2Regularization)
+        return {
+            "global": FixedEffectCoordinate(
+                coordinate_id="global", dataset=fe_ds,
+                task=TaskType.LOGISTIC_REGRESSION, config=cfg, lam=lam_f),
+            "perEntity": RandomEffectCoordinate(
+                coordinate_id="perEntity", dataset=re_ds, data=data,
+                task=TaskType.LOGISTIC_REGRESSION, config=cfg, lam=lam_r),
+        }
+
+    def test_score_accounting_invariant(self):
+        data, _ = make_mixed_data(n=800, n_entities=13)
+        coords = self._coords(data)
+        cd = CoordinateDescent(update_sequence=["global", "perEntity"],
+                               n_iterations=2)
+        result = cd.run(coords, data, TaskType.LOGISTIC_REGRESSION)
+        total = data.offsets + sum(result.scores.values())
+        rebuilt = result.model.score(data)
+        np.testing.assert_allclose(total, rebuilt, rtol=1e-3, atol=1e-4)
+
+    def test_game_beats_fixed_only(self):
+        data, _ = make_mixed_data(n=3000, n_entities=23)
+        vdata, _ = make_mixed_data(n=1500, n_entities=23, seed=1)
+        evaluators = parse_evaluators(["AUC", "LOGISTIC_LOSS"])
+        coords = self._coords(data)
+        cd = CoordinateDescent(update_sequence=["global", "perEntity"],
+                               n_iterations=2)
+        result = cd.run(coords, data, TaskType.LOGISTIC_REGRESSION,
+                        validation=(vdata, evaluators))
+        fixed_only = CoordinateDescent(update_sequence=["global"]).run(
+            {"global": coords["global"]}, data, TaskType.LOGISTIC_REGRESSION,
+            validation=(vdata, evaluators))
+        auc_game = result.validation_history[-1]["AUC"]
+        auc_fixed = fixed_only.validation_history[-1]["AUC"]
+        assert auc_game > auc_fixed + 0.02, (auc_game, auc_fixed)
+
+
+class TestGameEstimator:
+    def test_fit_grid_and_select(self):
+        data, _ = make_mixed_data(n=1200, n_entities=11)
+        vdata, _ = make_mixed_data(n=600, n_entities=11, seed=3)
+        est = GameEstimator(
+            task=TaskType.LOGISTIC_REGRESSION,
+            coordinate_configs={
+                "global": FixedEffectCoordinateConfig(
+                    feature_shard_id="fixed",
+                    optimization=GLMOptimizationConfiguration(
+                        regularization=L2Regularization)),
+                "perEntity": RandomEffectCoordinateConfig(
+                    dataset=RandomEffectDatasetConfig("entityId", "re"),
+                    optimization=GLMOptimizationConfiguration(
+                        regularization=L2Regularization)),
+            },
+            update_sequence=["global", "perEntity"],
+            n_cd_iterations=2)
+        grid = [
+            GameOptimizationConfiguration({"global": 0.01, "perEntity": lam})
+            for lam in (10.0, 0.1)
+        ]
+        evaluators = parse_evaluators(["AUC"])
+        results = est.fit(data, grid, validation=(vdata, evaluators))
+        assert len(results) == 2
+        best = GameEstimator.select_best(results)
+        assert best.evaluation is not None
+        vals = [r.evaluation.primary[1] for r in results]
+        assert best.evaluation.primary[1] == max(vals)
+
+
+class TestDownSampling:
+    def test_resamples_per_sweep(self):
+        from photon_ml_tpu.sampling import BinaryClassificationDownSampler, DownSampler
+
+        labels = np.zeros(1000, np.float32)
+        weights = np.ones(1000, np.float32)
+        ds = DownSampler(rate=0.5)
+        w0, w1 = ds.downsample(labels, weights, 0), ds.downsample(labels, weights, 1)
+        assert (w0 != w1).any()
+        # unbiasedness: kept rows re-weighted 1/rate
+        assert abs(w0.sum() / 1000 - 1.0) < 0.15
+        bc = BinaryClassificationDownSampler(rate=0.25)
+        labels[:100] = 1.0
+        wb = bc.downsample(labels, weights, 0)
+        np.testing.assert_array_equal(wb[:100], 1.0)  # positives kept
+
+
+class TestEvaluatorEdgeCases:
+    def test_missing_id_rows_excluded_from_grouped_metric(self):
+        from photon_ml_tpu.evaluation import parse_evaluator
+
+        rng = np.random.default_rng(0)
+        scores = rng.normal(size=200)
+        labels = (rng.uniform(size=200) < 0.5).astype(np.float64)
+        groups = np.repeat(np.arange(10), 20)
+        ev = parse_evaluator("AUC:g")
+        full = ev.evaluate(scores, labels, id_tags={"g": groups})
+        # adding missing-id rows must not change the metric
+        scores2 = np.concatenate([scores, rng.normal(size=50)])
+        labels2 = np.concatenate([labels, np.ones(50)])
+        groups2 = np.concatenate([groups, np.full(50, -1)])
+        withheld = ev.evaluate(scores2, labels2, id_tags={"g": groups2})
+        assert abs(full - withheld) < 1e-12
+
+    def test_precision_at_zero_rejected(self):
+        from photon_ml_tpu.evaluation import parse_evaluator
+
+        with pytest.raises(ValueError):
+            parse_evaluator("PRECISION@0:queryId")

@@ -1,0 +1,110 @@
+"""Hyperparameter tuning tests (reference ``hyperparameter/*Test`` pattern:
+closed-form sanity on kernels/GP, convergence on a known optimum)."""
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.hyperparameter import (
+    GaussianProcessEstimator,
+    GaussianProcessSearch,
+    Matern52,
+    RandomSearch,
+    RBF,
+    expected_improvement,
+    slice_sample,
+)
+from photon_ml_tpu.hyperparameter.search import ParamRange
+
+
+class TestKernels:
+    def test_diagonal_is_amplitude(self):
+        x = np.random.default_rng(0).normal(size=(5, 3))
+        for kern in (RBF(amplitude=2.0, lengthscales=np.ones(3)),
+                     Matern52(amplitude=2.0, lengthscales=np.ones(3))):
+            k = kern(x, x)
+            np.testing.assert_allclose(np.diag(k), 2.0, rtol=1e-9)
+            # symmetric PSD
+            np.testing.assert_allclose(k, k.T, atol=1e-12)
+            assert np.linalg.eigvalsh(k).min() > -1e-9
+
+    def test_decay_with_distance(self):
+        a = np.zeros((1, 2))
+        b = np.array([[3.0, 0.0]])
+        c = np.array([[6.0, 0.0]])
+        for kern in (RBF(), Matern52()):
+            assert kern(a, b)[0, 0] > kern(a, c)[0, 0]
+
+
+class TestSliceSampler:
+    def test_recovers_gaussian_moments(self):
+        rng = np.random.default_rng(0)
+        target_mean, target_std = 1.5, 0.7
+
+        def logp(x):
+            return float(-0.5 * ((x[0] - target_mean) / target_std) ** 2)
+
+        samples = slice_sample(logp, np.zeros(1), rng, 4000, burn_in=100)
+        assert abs(samples.mean() - target_mean) < 0.1
+        assert abs(samples.std() - target_std) < 0.1
+
+
+class TestGaussianProcess:
+    def test_interpolates_observations(self):
+        rng = np.random.default_rng(1)
+        x = rng.uniform(size=(12, 1))
+        y = np.sin(6 * x[:, 0])
+        model = GaussianProcessEstimator(n_kernel_samples=4).fit(x, y)
+        mean, var = model.predict(x)
+        np.testing.assert_allclose(mean, y, atol=0.15)
+        # variance grows away from data
+        _, var_far = model.predict(np.array([[5.0]]))
+        assert var_far[0] > var.mean()
+
+    def test_expected_improvement_prefers_promising(self):
+        mean = np.array([0.0, 1.0])
+        var = np.array([0.01, 0.01])
+        ei = expected_improvement(mean, var, best=0.5, maximize=True)
+        assert ei[1] > ei[0]
+        ei_min = expected_improvement(mean, var, best=0.5, maximize=False)
+        assert ei_min[0] > ei_min[1]
+
+
+class TestSearch:
+    def _objective(self, config):
+        # smooth unimodal in log space: optimum at lam = 1e-2
+        return -(np.log10(config["lam"]) + 2.0) ** 2
+
+    def test_param_range_roundtrip(self):
+        r = ParamRange(1e-4, 1e2, log_scale=True)
+        for v in (1e-4, 1e-1, 1e2):
+            assert abs(r.from_unit(r.to_unit(v)) - v) / v < 1e-9
+        with pytest.raises(ValueError):
+            ParamRange(1.0, 0.5)
+        with pytest.raises(ValueError):
+            ParamRange(0.0, 1.0, log_scale=True)
+
+    def test_random_search_finds_region(self):
+        search = RandomSearch({"lam": ParamRange(1e-6, 1e2)}, seed=0)
+        result = search.find(self._objective, 40)
+        cfg, val = result.best(maximize=True)
+        assert val > -1.0  # within a decade of optimum
+
+    def test_gp_search_beats_random_budget(self):
+        space = {"lam": ParamRange(1e-6, 1e2)}
+        gp = GaussianProcessSearch(space, maximize=True, n_seed_points=4,
+                                   seed=3)
+        result = gp.find(self._objective, 12)
+        cfg, val = result.best(maximize=True)
+        assert val > -0.5, (cfg, val)
+        assert len(result.configs) == 12
+
+    def test_gp_search_uses_prior_observations(self):
+        space = {"lam": ParamRange(1e-6, 1e2)}
+        gp = GaussianProcessSearch(space, maximize=True, n_seed_points=0,
+                                   seed=4)
+        prior = [({"lam": 10.0 ** (e - 4)}, self._objective({"lam": 10.0 ** (e - 4)}))
+                 for e in range(5)]
+        result = gp.find(self._objective, 4, prior_observations=prior)
+        assert len(result.configs) == 9
+        _, val = result.best(maximize=True)
+        assert val > -0.5
