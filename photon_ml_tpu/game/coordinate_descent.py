@@ -36,6 +36,8 @@ class CoordinateDescentResult:
     scores: dict[str, np.ndarray]
     #: per-sweep validation metric dicts (empty when no validation set)
     validation_history: list[dict[str, float]]
+    #: final sweep's full evaluation (None without a validation set)
+    final_evaluation: object = None  # Optional[EvaluationResults]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -52,6 +54,8 @@ class CoordinateDescent:
         task: TaskType,
         validation: Optional[tuple[GameData, Sequence[Evaluator]]] = None,
         initial_models: Optional[Mapping[str, CoordinateModel]] = None,
+        checkpoint=None,  # Optional[photon_ml_tpu.io.checkpoint.CheckpointManager]
+        resume: bool = False,
     ) -> CoordinateDescentResult:
         for cid in self.update_sequence:
             if cid not in coordinates:
@@ -65,11 +69,23 @@ class CoordinateDescent:
         for cid, model in models.items():
             if cid in scores:
                 scores[cid] = model.score(data).astype(np.float32)
+
+        start_sweep, start_coord = 0, 0
+        if resume and checkpoint is not None and checkpoint.latest_step() is not None:
+            state = checkpoint.restore()
+            models = dict(state.model.coordinates)
+            scores.update({k: v for k, v in state.scores.items() if k in scores})
+            start_sweep, start_coord = state.sweep, state.coordinate_index
+            logger.info("resumed from checkpoint: sweep %d coordinate %d",
+                        start_sweep, start_coord)
         total = data.offsets + sum(scores.values())
 
         history: list[dict[str, float]] = []
-        for sweep in range(self.n_iterations):
-            for cid in self.update_sequence:
+        final_evaluation = None
+        for sweep in range(start_sweep, self.n_iterations):
+            for ci, cid in enumerate(self.update_sequence):
+                if sweep == start_sweep and ci < start_coord:
+                    continue
                 t0 = time.perf_counter()
                 residual = (total - scores[cid]).astype(np.float32)
                 model, new_scores = coordinates[cid].train(
@@ -79,6 +95,17 @@ class CoordinateDescent:
                 scores[cid] = new_scores
                 logger.info("sweep %d coordinate %s trained in %.2fs",
                             sweep, cid, time.perf_counter() - t0)
+                if checkpoint is not None:
+                    from photon_ml_tpu.io.checkpoint import CoordinateDescentState
+
+                    next_ci = (ci + 1) % len(self.update_sequence)
+                    checkpoint.save(
+                        sweep * len(self.update_sequence) + ci + 1,
+                        CoordinateDescentState(
+                            sweep=sweep + (next_ci == 0),
+                            coordinate_index=next_ci,
+                            model=GameModel(coordinates=dict(models), task=task),
+                            scores=dict(scores)))
 
             if validation is not None:
                 vdata, evaluators = validation
@@ -88,10 +115,12 @@ class CoordinateDescent:
                     evaluators, vscores, vdata.labels, weights=vdata.weights,
                     id_tags=vdata.id_columns)
                 history.append(results.as_dict())
+                final_evaluation = results
                 logger.info("sweep %d validation: %s", sweep, results)
 
         model = GameModel(
             coordinates={cid: models[cid] for cid in self.update_sequence},
             task=task)
         return CoordinateDescentResult(
-            model=model, scores=scores, validation_history=history)
+            model=model, scores=scores, validation_history=history,
+            final_evaluation=final_evaluation)

@@ -123,6 +123,10 @@ class GameData:
 
     def __post_init__(self):
         n = self.labels.shape[0]
+        if self.offsets.shape[0] != n or self.weights.shape[0] != n:
+            raise ValueError(
+                f"offsets/weights length ({self.offsets.shape[0]}/"
+                f"{self.weights.shape[0]}) != labels length ({n})")
         for name, shard in self.shards.items():
             if shard.n_samples != n:
                 raise ValueError(f"shard {name!r}: {shard.n_samples} rows != {n}")
@@ -365,6 +369,9 @@ class RandomEffectDataset:
             n_feat_per_entity[ent_u] = ent_c
 
         n_samp_per_entity = np.array([len(r) for r in active_rows], np.int64)
+        # one active-row index per nnz (loop-invariant over buckets)
+        nnz_rows_local = np.repeat(
+            np.arange(len(all_active)), sub.row_counts())
 
         # --- bucketing by (padded samples, padded features) ----------------
         buckets: list[REBucket] = []
@@ -409,8 +416,6 @@ class RandomEffectDataset:
                 # local sample position for each nnz: position of its active row
                 pos_of_active_row = np.full(len(all_active), -1, np.int64)
                 pos_of_active_row[rows_sel] = pos
-                nnz_rows_local = np.repeat(
-                    np.arange(len(all_active)), sub.row_counts())
                 take = nnz_sel
                 e_nnz = slot_of_entity[nnz_ent[take]]
                 s_nnz = pos_of_active_row[nnz_rows_local[take]]

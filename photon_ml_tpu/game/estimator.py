@@ -18,7 +18,7 @@ from typing import Mapping, Optional, Sequence
 
 import numpy as np
 
-from photon_ml_tpu.evaluation import EvaluationResults, Evaluator, evaluate_all
+from photon_ml_tpu.evaluation import EvaluationResults, Evaluator
 from photon_ml_tpu.game.coordinate import (
     FixedEffectCoordinate,
     RandomEffectCoordinate,
@@ -146,13 +146,8 @@ class GameEstimator:
             coordinates = self._coordinates(data, datasets, config)
             cd_result = cd.run(coordinates, data, self.task,
                                validation=validation)
-            evaluation = None
-            if validation is not None:
-                vdata, evaluators = validation
-                vscores = cd_result.model.score(vdata)
-                evaluation = evaluate_all(
-                    evaluators, vscores, vdata.labels, weights=vdata.weights,
-                    id_tags=vdata.id_columns)
+            # the final CD sweep already evaluated this exact model
+            evaluation = cd_result.final_evaluation
             results.append(GameResult(
                 model=cd_result.model, configuration=config,
                 evaluation=evaluation,

@@ -119,6 +119,8 @@ class GaussianProcessSearch:
             logger.info("GP search: %s -> %g", config, value)
 
         n_seed = min(self.n_seed_points, n_iterations)
+        if not xs and n_seed == 0 and n_iterations > 0:
+            n_seed = 1  # the GP needs at least one observation to fit
         for _ in range(n_seed):
             observe(rng.uniform(size=len(names)))
 

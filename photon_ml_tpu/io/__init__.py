@@ -1,0 +1,27 @@
+"""Host-side IO: Avro, feature indexing, model persistence, checkpoints.
+
+Re-design of the reference's IO surface (``photon-avro-schemas/``,
+``photon-client/.../data/avro/``, ``photon-client/.../index/``): Avro stays
+the on-disk interchange format (a self-contained codec — no fastavro in this
+environment), the PalDB feature store becomes a host dict with a compact
+on-disk form, and model directories mirror the reference's HDFS layout so a
+Photon-ML user finds the same structure.
+"""
+
+from photon_ml_tpu.io.avro import (  # noqa: F401
+    read_avro_file,
+    write_avro_file,
+)
+from photon_ml_tpu.io.index import (  # noqa: F401
+    DefaultIndexMap,
+    IndexMap,
+    build_index_map,
+)
+from photon_ml_tpu.io.data_reader import AvroDataReader, FeatureShardConfig  # noqa: F401
+from photon_ml_tpu.io.model_io import (  # noqa: F401
+    load_game_model,
+    load_glm_model,
+    save_game_model,
+    save_glm_model,
+)
+from photon_ml_tpu.io.checkpoint import CheckpointManager  # noqa: F401

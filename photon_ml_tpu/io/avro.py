@@ -1,0 +1,308 @@
+"""Minimal Avro object-container-file codec (pure Python + zlib).
+
+The environment has no ``fastavro``/``avro`` package, and Avro is the
+reference's interchange format (``photon-avro-schemas/src/main/avro/*.avsc``;
+read/written by ``photon-client/.../data/avro/AvroUtils.scala``), so this
+module implements the subset of the Avro 1.x spec those schemas need:
+
+- primitives: null, boolean, int, long, float, double, bytes, string;
+- complex: record, array, map, union, enum, fixed;
+- binary encoding: zigzag-varint longs, length-prefixed bytes, block-encoded
+  arrays/maps, union = long index + value;
+- container files: ``Obj\\x01`` magic, metadata map (schema JSON + codec),
+  16-byte sync marker, data blocks with ``null`` or ``deflate`` codec.
+
+Schemas are plain Python dicts in the ``.avsc`` JSON form. Unknown/unneeded
+spec corners (recursive types, aliases, logical types) raise cleanly.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import struct
+import zlib
+from typing import Any, BinaryIO, Iterable, Iterator, Union
+
+MAGIC = b"Obj\x01"
+SYNC_SIZE = 16
+
+Schema = Union[str, list, dict]
+
+
+# ---------------------------------------------------------------------------
+# binary encoding
+# ---------------------------------------------------------------------------
+
+
+def _zigzag_encode(n: int) -> int:
+    return (n << 1) ^ (n >> 63)
+
+
+def _zigzag_decode(n: int) -> int:
+    return (n >> 1) ^ -(n & 1)
+
+
+def write_long(out: BinaryIO, n: int) -> None:
+    n = _zigzag_encode(n)
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.write(bytes([b | 0x80]))
+        else:
+            out.write(bytes([b]))
+            return
+
+
+def read_long(buf: BinaryIO) -> int:
+    shift = 0
+    acc = 0
+    while True:
+        byte = buf.read(1)
+        if not byte:
+            raise EOFError("truncated varint")
+        b = byte[0]
+        acc |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return _zigzag_decode(acc)
+        shift += 7
+
+
+def _schema_type(schema: Schema) -> str:
+    if isinstance(schema, str):
+        return schema
+    if isinstance(schema, list):
+        return "union"
+    return schema["type"]
+
+
+def _resolve_named(schema: Schema, names: dict) -> Schema:
+    """Register/lookup named types so a schema can reference them by name."""
+    if isinstance(schema, str) and schema in names:
+        return names[schema]
+    if isinstance(schema, dict) and schema.get("type") in ("record", "enum", "fixed"):
+        name = schema.get("name")
+        if name:
+            names[name] = schema
+            ns = schema.get("namespace")
+            if ns:
+                names[f"{ns}.{name}"] = schema
+    return schema
+
+
+def write_datum(out: BinaryIO, datum: Any, schema: Schema, names: dict) -> None:
+    schema = _resolve_named(schema, names)
+    t = _schema_type(schema)
+    if t == "null":
+        return
+    if t == "boolean":
+        out.write(b"\x01" if datum else b"\x00")
+    elif t in ("int", "long"):
+        write_long(out, int(datum))
+    elif t == "float":
+        out.write(struct.pack("<f", float(datum)))
+    elif t == "double":
+        out.write(struct.pack("<d", float(datum)))
+    elif t == "bytes":
+        write_long(out, len(datum))
+        out.write(datum)
+    elif t == "string":
+        raw = datum.encode("utf-8")
+        write_long(out, len(raw))
+        out.write(raw)
+    elif t == "union":
+        idx = _union_branch(datum, schema, names)
+        write_long(out, idx)
+        write_datum(out, datum, schema[idx], names)
+    elif t == "record":
+        for field in schema["fields"]:
+            name = field["name"]
+            if name in datum:
+                value = datum[name]
+            elif "default" in field:
+                value = field["default"]
+            else:
+                raise ValueError(f"record field {name!r} missing and has no default")
+            write_datum(out, value, field["type"], names)
+    elif t == "array":
+        if datum:
+            write_long(out, len(datum))
+            for item in datum:
+                write_datum(out, item, schema["items"], names)
+        write_long(out, 0)
+    elif t == "map":
+        if datum:
+            write_long(out, len(datum))
+            for k, v in datum.items():
+                write_datum(out, k, "string", names)
+                write_datum(out, v, schema["values"], names)
+        write_long(out, 0)
+    elif t == "enum":
+        out_idx = schema["symbols"].index(datum)
+        write_long(out, out_idx)
+    elif t == "fixed":
+        if len(datum) != schema["size"]:
+            raise ValueError("fixed size mismatch")
+        out.write(datum)
+    else:
+        raise NotImplementedError(f"avro type {t!r}")
+
+
+def _union_branch(datum: Any, union: list, names: dict) -> int:
+    for i, branch in enumerate(union):
+        bt = _schema_type(_resolve_named(branch, names))
+        if datum is None and bt == "null":
+            return i
+        if datum is not None and bt != "null":
+            # first non-null branch wins (our schemas use [null, X] only)
+            return i
+    raise ValueError(f"no union branch for {type(datum)} in {union}")
+
+
+def read_datum(buf: BinaryIO, schema: Schema, names: dict) -> Any:
+    schema = _resolve_named(schema, names)
+    t = _schema_type(schema)
+    if t == "null":
+        return None
+    if t == "boolean":
+        return buf.read(1) == b"\x01"
+    if t in ("int", "long"):
+        return read_long(buf)
+    if t == "float":
+        return struct.unpack("<f", buf.read(4))[0]
+    if t == "double":
+        return struct.unpack("<d", buf.read(8))[0]
+    if t == "bytes":
+        return buf.read(read_long(buf))
+    if t == "string":
+        return buf.read(read_long(buf)).decode("utf-8")
+    if t == "union":
+        return read_datum(buf, schema[read_long(buf)], names)
+    if t == "record":
+        return {f["name"]: read_datum(buf, f["type"], names)
+                for f in schema["fields"]}
+    if t == "array":
+        out = []
+        while True:
+            count = read_long(buf)
+            if count == 0:
+                return out
+            if count < 0:  # block with byte size
+                count = -count
+                read_long(buf)
+            for _ in range(count):
+                out.append(read_datum(buf, schema["items"], names))
+    if t == "map":
+        out = {}
+        while True:
+            count = read_long(buf)
+            if count == 0:
+                return out
+            if count < 0:
+                count = -count
+                read_long(buf)
+            for _ in range(count):
+                k = read_datum(buf, "string", names)
+                out[k] = read_datum(buf, schema["values"], names)
+    if t == "enum":
+        return schema["symbols"][read_long(buf)]
+    if t == "fixed":
+        return buf.read(schema["size"])
+    raise NotImplementedError(f"avro type {t!r}")
+
+
+# ---------------------------------------------------------------------------
+# container files
+# ---------------------------------------------------------------------------
+
+
+def write_avro_file(path: str, records: Iterable[dict], schema: Schema,
+                    *, codec: str = "deflate", block_records: int = 4096) -> int:
+    """Write an Avro object-container file; returns the record count."""
+    if codec not in ("null", "deflate"):
+        raise ValueError(f"unsupported codec {codec!r}")
+    sync = os.urandom(SYNC_SIZE)
+    names: dict = {}
+    n_total = 0
+    with open(path, "wb") as f:
+        f.write(MAGIC)
+        meta = {"avro.schema": json.dumps(schema).encode(),
+                "avro.codec": codec.encode()}
+        write_long(f, len(meta))
+        for k, v in meta.items():
+            write_datum(f, k, "string", names)
+            write_long(f, len(v))
+            f.write(v)
+        write_long(f, 0)
+        f.write(sync)
+
+        block: list[dict] = []
+
+        def flush():
+            nonlocal n_total
+            if not block:
+                return
+            buf = io.BytesIO()
+            for rec in block:
+                write_datum(buf, rec, schema, names)
+            payload = buf.getvalue()
+            if codec == "deflate":
+                payload = zlib.compress(payload)[2:-4]  # raw deflate per spec
+            write_long(f, len(block))
+            write_long(f, len(payload))
+            f.write(payload)
+            f.write(sync)
+            n_total += len(block)
+            block.clear()
+
+        for rec in records:
+            block.append(rec)
+            if len(block) >= block_records:
+                flush()
+        flush()
+    return n_total
+
+
+def iter_avro_file(path: str) -> Iterator[dict]:
+    """Stream records from an Avro object-container file."""
+    with open(path, "rb") as f:
+        if f.read(4) != MAGIC:
+            raise ValueError(f"{path}: not an Avro container file")
+        names: dict = {}
+        meta = {}
+        while True:
+            count = read_long(f)
+            if count == 0:
+                break
+            if count < 0:
+                count = -count
+                read_long(f)
+            for _ in range(count):
+                k = read_datum(f, "string", names)
+                size = read_long(f)
+                meta[k] = f.read(size)
+        schema = json.loads(meta["avro.schema"].decode())
+        codec = meta.get("avro.codec", b"null").decode()
+        if codec not in ("null", "deflate"):
+            raise ValueError(f"unsupported codec {codec!r}")
+        sync = f.read(SYNC_SIZE)
+        while True:
+            try:
+                n_records = read_long(f)
+            except EOFError:
+                return
+            size = read_long(f)
+            payload = f.read(size)
+            if codec == "deflate":
+                payload = zlib.decompress(payload, -15)
+            if f.read(SYNC_SIZE) != sync:
+                raise ValueError(f"{path}: sync marker mismatch (corrupt block)")
+            buf = io.BytesIO(payload)
+            for _ in range(n_records):
+                yield read_datum(buf, schema, names)
+
+
+def read_avro_file(path: str) -> list[dict]:
+    return list(iter_avro_file(path))

@@ -1,0 +1,228 @@
+"""Model persistence: GLM and GAME models ↔ the reference's directory layout.
+
+Re-design of ``photon-client/.../data/avro/ModelProcessingUtils.scala``:
+
+    output/
+      model-metadata.json
+      fixed-effect/<coordinateId>/coefficients/part-00000.avro
+      random-effect/<coordinateId>/coefficients/part-00000.avro
+
+Coefficient files are ``BayesianLinearModelAvro`` records — fixed effect =
+one record, random effect = one record per entity (modelId = the raw entity
+id) — so a Photon-ML user finds the same structure and record shape.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterator, Optional
+
+import numpy as np
+
+from photon_ml_tpu.game.model import FixedEffectModel, GameModel, RandomEffectModel
+from photon_ml_tpu.io.avro import iter_avro_file, write_avro_file
+from photon_ml_tpu.io.index import IndexMap
+from photon_ml_tpu.io.schemas import BAYESIAN_LINEAR_MODEL_AVRO
+from photon_ml_tpu.models.coefficients import Coefficients
+from photon_ml_tpu.models.glm import GeneralizedLinearModel
+from photon_ml_tpu.types import NAME_TERM_DELIMITER, TaskType
+
+
+def _split_key(key: str) -> tuple[str, str]:
+    if NAME_TERM_DELIMITER in key:
+        name, term = key.split(NAME_TERM_DELIMITER, 1)
+        return name, term
+    return key, ""
+
+
+def _ntv_list(values: np.ndarray, index_map: IndexMap, sparsity_threshold: float):
+    names = index_map.names()
+    out = []
+    for i, v in enumerate(values):
+        if abs(float(v)) > sparsity_threshold:
+            name, term = _split_key(names[i])
+            out.append({"name": name, "term": term, "value": float(v)})
+    return out
+
+
+def _from_ntv_list(entries, index_map: IndexMap) -> np.ndarray:
+    from photon_ml_tpu.types import feature_key
+
+    w = np.zeros(len(index_map), np.float32)
+    for e in entries or ():
+        idx = index_map.key_to_index.get(feature_key(e["name"], e.get("term") or ""))
+        if idx is not None:
+            w[idx] = e["value"]
+    return w
+
+
+# ---------------------------------------------------------------------------
+# single GLM (legacy driver output)
+# ---------------------------------------------------------------------------
+
+
+def save_glm_model(path: str, model: GeneralizedLinearModel,
+                   index_map: IndexMap, *, model_id: str = "best",
+                   sparsity_threshold: float = 0.0) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    coeffs = model.coefficients
+    record = {
+        "modelId": model_id,
+        "modelClass": model.task.value,
+        "lossFunction": model.task.value,
+        "means": _ntv_list(np.asarray(coeffs.means), index_map, sparsity_threshold),
+        "variances": None if coeffs.variances is None else _ntv_list(
+            np.asarray(coeffs.variances), index_map, -1.0),
+    }
+    write_avro_file(path, [record], BAYESIAN_LINEAR_MODEL_AVRO)
+
+
+def load_glm_model(path: str, index_map: IndexMap) -> GeneralizedLinearModel:
+    import jax.numpy as jnp
+
+    record = next(iter(iter_avro_file(path)))
+    means = _from_ntv_list(record["means"], index_map)
+    variances = (None if record.get("variances") is None
+                 else _from_ntv_list(record["variances"], index_map))
+    task = TaskType(record["modelClass"]) if record.get("modelClass") else \
+        TaskType.LOGISTIC_REGRESSION
+    return GeneralizedLinearModel(
+        coefficients=Coefficients(
+            means=jnp.asarray(means),
+            variances=None if variances is None else jnp.asarray(variances)),
+        task=task)
+
+
+# ---------------------------------------------------------------------------
+# GAME models
+# ---------------------------------------------------------------------------
+
+
+def save_game_model(
+    output_dir: str,
+    model: GameModel,
+    index_maps: dict[str, IndexMap],
+    entity_vocabs: dict[str, dict[str, int]],
+    *,
+    sparsity_threshold: float = 0.0,
+) -> None:
+    """Write the reference's fixed-effect/random-effect directory tree."""
+    os.makedirs(output_dir, exist_ok=True)
+    metadata = {"task": model.task.value, "coordinates": {}}
+    for cid, cm in model.coordinates.items():
+        if isinstance(cm, FixedEffectModel):
+            kind = "fixed-effect"
+            extra = {"featureShardId": cm.feature_shard_id}
+        else:
+            kind = "random-effect"
+            extra = {"featureShardId": cm.feature_shard_id,
+                     "randomEffectType": cm.random_effect_type}
+        metadata["coordinates"][cid] = {"type": kind, **extra}
+        part = os.path.join(output_dir, kind, cid, "coefficients",
+                            "part-00000.avro")
+        os.makedirs(os.path.dirname(part), exist_ok=True)
+        imap = index_maps[cm.feature_shard_id]
+        if isinstance(cm, FixedEffectModel):
+            save_glm_model(part, cm.model, imap, model_id=cid,
+                           sparsity_threshold=sparsity_threshold)
+        else:
+            vocab = entity_vocabs[cm.random_effect_type]
+            reverse = {v: k for k, v in vocab.items()}
+            write_avro_file(
+                part, _re_records(cm, imap, reverse, sparsity_threshold),
+                BAYESIAN_LINEAR_MODEL_AVRO)
+    with open(os.path.join(output_dir, "model-metadata.json"), "w") as f:
+        json.dump(metadata, f, indent=2)
+
+
+def _re_records(model: RandomEffectModel, index_map: IndexMap,
+                reverse_vocab: dict[int, str],
+                sparsity_threshold: float) -> Iterator[dict]:
+    names = index_map.names()
+    if not len(model.keys):
+        return
+    entity_of = model.keys // model.dim
+    feat_of = model.keys % model.dim
+    starts = np.flatnonzero(np.r_[True, entity_of[1:] != entity_of[:-1]])
+    bounds = np.r_[starts, len(model.keys)]
+    for s, e in zip(bounds[:-1], bounds[1:]):
+        entity = int(entity_of[s])
+        means = []
+        variances = [] if model.variances is not None else None
+        for k in range(s, e):
+            v = float(model.coeffs[k])
+            if abs(v) <= sparsity_threshold:
+                continue
+            name, term = _split_key(names[int(feat_of[k])])
+            means.append({"name": name, "term": term, "value": v})
+            if variances is not None:
+                variances.append({"name": name, "term": term,
+                                  "value": float(model.variances[k])})
+        yield {
+            "modelId": reverse_vocab.get(entity, str(entity)),
+            "modelClass": model.task.value,
+            "lossFunction": model.task.value,
+            "means": means,
+            "variances": variances,
+        }
+
+
+def load_game_model(
+    output_dir: str,
+    index_maps: dict[str, IndexMap],
+    entity_vocabs: dict[str, dict[str, int]],
+) -> GameModel:
+    import jax.numpy as jnp
+
+    with open(os.path.join(output_dir, "model-metadata.json")) as f:
+        metadata = json.load(f)
+    task = TaskType(metadata["task"])
+    coordinates = {}
+    for cid, info in metadata["coordinates"].items():
+        shard_id = info["featureShardId"]
+        imap = index_maps[shard_id]
+        part = os.path.join(output_dir, info["type"], cid, "coefficients",
+                            "part-00000.avro")
+        if info["type"] == "fixed-effect":
+            glm = load_glm_model(part, imap)
+            coordinates[cid] = FixedEffectModel(
+                model=GeneralizedLinearModel(
+                    coefficients=glm.coefficients, task=task),
+                feature_shard_id=shard_id)
+        else:
+            re_type = info["randomEffectType"]
+            vocab = entity_vocabs[re_type]
+            dim = len(imap)
+            keys, coeffs, variances = [], [], []
+            has_var = False
+            from photon_ml_tpu.types import feature_key
+
+            for rec in iter_avro_file(part):
+                entity = vocab.get(rec["modelId"])
+                if entity is None:
+                    continue  # entity absent from this dataset's vocab
+                # variances are keyed by (name, term) just like means; index
+                # them so a feature missing from the load-time map drops its
+                # variance too (keeping coeffs/variances aligned)
+                var_by_key = {
+                    feature_key(e["name"], e.get("term") or ""): e["value"]
+                    for e in rec.get("variances") or ()}
+                for e in rec["means"] or ():
+                    key = feature_key(e["name"], e.get("term") or "")
+                    j = imap.key_to_index.get(key)
+                    if j is not None:
+                        keys.append(entity * dim + j)
+                        coeffs.append(e["value"])
+                        if var_by_key:
+                            has_var = True
+                            variances.append(var_by_key.get(key, 0.0))
+            keys = np.asarray(keys, np.int64)
+            order = np.argsort(keys, kind="stable")
+            coordinates[cid] = RandomEffectModel(
+                random_effect_type=re_type, feature_shard_id=shard_id,
+                task=task, dim=dim, keys=keys[order],
+                coeffs=np.asarray(coeffs, np.float32)[order],
+                variances=(np.asarray(variances, np.float32)[order]
+                           if has_var else None))
+    return GameModel(coordinates=coordinates, task=task)

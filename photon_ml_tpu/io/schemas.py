@@ -1,0 +1,87 @@
+"""The Photon-ML Avro schemas, as Python dicts.
+
+Counterparts of ``photon-avro-schemas/src/main/avro/*.avsc``: the training
+record (response/offset/weight/id + feature list of (name, term, value)),
+the Bayesian linear model output (means + variances as name-term-value
+lists), the scoring output, and per-feature summarization stats. Namespaces
+kept Photon-compatible so files interchange with reference tooling.
+"""
+
+NAMESPACE = "com.linkedin.photon.avro.generated"
+
+FEATURE_AVRO = {
+    "type": "record",
+    "name": "FeatureAvro",
+    "namespace": NAMESPACE,
+    "fields": [
+        {"name": "name", "type": "string"},
+        {"name": "term", "type": "string", "default": ""},
+        {"name": "value", "type": "double"},
+    ],
+}
+
+TRAINING_EXAMPLE_AVRO = {
+    "type": "record",
+    "name": "TrainingExampleAvro",
+    "namespace": NAMESPACE,
+    "fields": [
+        {"name": "uid", "type": ["null", "string"], "default": None},
+        {"name": "response", "type": "double"},
+        {"name": "offset", "type": ["null", "double"], "default": None},
+        {"name": "weight", "type": ["null", "double"], "default": None},
+        {"name": "features", "type": {"type": "array", "items": FEATURE_AVRO}},
+        # entity-id tags for GAME (userId, songId, ...) and grouped metrics
+        {"name": "metadataMap", "type": ["null", {"type": "map", "values": "string"}],
+         "default": None},
+    ],
+}
+
+NAME_TERM_VALUE_AVRO = {
+    "type": "record",
+    "name": "NameTermValueAvro",
+    "namespace": NAMESPACE,
+    "fields": [
+        {"name": "name", "type": "string"},
+        {"name": "term", "type": "string", "default": ""},
+        {"name": "value", "type": "double"},
+    ],
+}
+
+BAYESIAN_LINEAR_MODEL_AVRO = {
+    "type": "record",
+    "name": "BayesianLinearModelAvro",
+    "namespace": NAMESPACE,
+    "fields": [
+        {"name": "modelId", "type": "string"},
+        {"name": "modelClass", "type": ["null", "string"], "default": None},
+        {"name": "lossFunction", "type": ["null", "string"], "default": None},
+        {"name": "means", "type": {"type": "array", "items": NAME_TERM_VALUE_AVRO}},
+        {"name": "variances",
+         "type": ["null", {"type": "array", "items": "NameTermValueAvro"}],
+         "default": None},
+    ],
+}
+
+SCORING_RESULT_AVRO = {
+    "type": "record",
+    "name": "ScoringResultAvro",
+    "namespace": NAMESPACE,
+    "fields": [
+        {"name": "uid", "type": ["null", "string"], "default": None},
+        {"name": "predictionScore", "type": "double"},
+        {"name": "label", "type": ["null", "double"], "default": None},
+        {"name": "metadataMap", "type": ["null", {"type": "map", "values": "string"}],
+         "default": None},
+    ],
+}
+
+FEATURE_SUMMARIZATION_RESULT_AVRO = {
+    "type": "record",
+    "name": "FeatureSummarizationResultAvro",
+    "namespace": NAMESPACE,
+    "fields": [
+        {"name": "featureName", "type": "string"},
+        {"name": "featureTerm", "type": "string", "default": ""},
+        {"name": "metrics", "type": {"type": "map", "values": "double"}},
+    ],
+}

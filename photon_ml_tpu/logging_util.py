@@ -1,0 +1,72 @@
+"""Run logging and stage timing.
+
+Re-design of the reference's observability idioms: ``util/PhotonLogger.scala``
+(driver-side logger teeing to a durable file users read for iteration
+tables) and ``util/Timed.scala`` (named wall-clock stage sections logged at
+start/end). Same contract — one human-readable training log per run on
+durable storage — plus structured JSONL metrics alongside.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import os
+import time
+from typing import Iterator, Optional
+
+logger = logging.getLogger("photon_ml_tpu")
+
+
+class RunLogger:
+    """Tees log lines to the console and a run-directory log file, and
+    appends structured metrics to ``metrics.jsonl``."""
+
+    def __init__(self, run_dir: Optional[str] = None, level=logging.INFO):
+        self.run_dir = run_dir
+        self._handlers: list[logging.Handler] = []
+        root = logging.getLogger("photon_ml_tpu")
+        root.setLevel(level)
+        fmt = logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s")
+        if not any(isinstance(h, logging.StreamHandler) for h in root.handlers):
+            sh = logging.StreamHandler()
+            sh.setFormatter(fmt)
+            root.addHandler(sh)
+            self._handlers.append(sh)
+        if run_dir:
+            os.makedirs(run_dir, exist_ok=True)
+            fh = logging.FileHandler(os.path.join(run_dir, "photon.log"))
+            fh.setFormatter(fmt)
+            root.addHandler(fh)
+            self._handlers.append(fh)
+        self._metrics_path = (os.path.join(run_dir, "metrics.jsonl")
+                              if run_dir else None)
+
+    def metric(self, **kwargs) -> None:
+        kwargs.setdefault("ts", time.time())
+        if self._metrics_path:
+            with open(self._metrics_path, "a") as f:
+                f.write(json.dumps(kwargs) + "\n")
+        logger.info("metric %s", kwargs)
+
+    def close(self) -> None:
+        root = logging.getLogger("photon_ml_tpu")
+        for h in self._handlers:
+            root.removeHandler(h)
+            h.close()
+        self._handlers.clear()
+
+
+@contextlib.contextmanager
+def timed(stage: str, run_logger: Optional[RunLogger] = None) -> Iterator[None]:
+    """``with timed("Read data"): ...`` — the reference's ``Timed`` wrapper."""
+    logger.info("%s: start", stage)
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        logger.info("%s: done in %.2fs", stage, dt)
+        if run_logger is not None:
+            run_logger.metric(stage=stage, seconds=round(dt, 3))

@@ -1,0 +1,303 @@
+"""IO tests: Avro codec round-trips, index maps, data reader, model IO,
+checkpoints (reference ``AvroDataReaderIntegTest`` / ``ModelProcessingUtils``
+test pattern: write → read → exact round-trip)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.io import (
+    AvroDataReader,
+    CheckpointManager,
+    FeatureShardConfig,
+    IndexMap,
+    build_index_map,
+    load_game_model,
+    load_glm_model,
+    read_avro_file,
+    save_game_model,
+    save_glm_model,
+    write_avro_file,
+)
+from photon_ml_tpu.io.data_reader import write_training_examples
+from photon_ml_tpu.io.schemas import (
+    BAYESIAN_LINEAR_MODEL_AVRO,
+    TRAINING_EXAMPLE_AVRO,
+)
+from photon_ml_tpu.types import TaskType, feature_key
+
+
+class TestAvroCodec:
+    def test_roundtrip_training_examples(self, tmp_path):
+        records = [
+            {"uid": f"u{i}", "response": float(i % 2), "offset": 0.5,
+             "weight": 2.0,
+             "features": [{"name": "f.a", "term": "t", "value": 1.5},
+                          {"name": "f.b", "term": "", "value": -2.0}],
+             "metadataMap": {"userId": f"user{i % 3}"}}
+            for i in range(10)
+        ]
+        path = str(tmp_path / "data.avro")
+        n = write_avro_file(path, records, TRAINING_EXAMPLE_AVRO)
+        assert n == 10
+        back = read_avro_file(path)
+        assert back == records
+
+    def test_null_codec_and_defaults(self, tmp_path):
+        records = [{"uid": None, "response": 1.0, "offset": None,
+                    "weight": None, "features": [], "metadataMap": None}]
+        path = str(tmp_path / "n.avro")
+        write_avro_file(path, records, TRAINING_EXAMPLE_AVRO, codec="null")
+        assert read_avro_file(path) == records
+
+    def test_many_blocks(self, tmp_path):
+        records = [{"uid": str(i), "response": float(i), "offset": None,
+                    "weight": None, "features": [], "metadataMap": None}
+                   for i in range(10_000)]
+        path = str(tmp_path / "big.avro")
+        write_avro_file(path, records, TRAINING_EXAMPLE_AVRO,
+                        block_records=1000)
+        back = read_avro_file(path)
+        assert len(back) == 10_000
+        assert back[9_999]["response"] == 9999.0
+
+    def test_negative_and_large_longs(self, tmp_path):
+        schema = {"type": "record", "name": "R",
+                  "fields": [{"name": "x", "type": "long"}]}
+        vals = [0, -1, 1, -(2 ** 40), 2 ** 40, 2 ** 62, -(2 ** 62)]
+        path = str(tmp_path / "l.avro")
+        write_avro_file(path, [{"x": v} for v in vals], schema)
+        assert [r["x"] for r in read_avro_file(path)] == vals
+
+
+class TestIndexMap:
+    def test_build_and_lookup(self):
+        imap = build_index_map([feature_key("a"), feature_key("b", "t")],
+                               add_intercept=True)
+        assert len(imap) == 3
+        assert imap.has_intercept
+        assert imap.index_of("a") is not None
+        assert imap.index_of("missing") is None
+
+    def test_save_load(self, tmp_path):
+        imap = build_index_map([feature_key("x"), feature_key("y")])
+        p = str(tmp_path / "index.json")
+        imap.save(p)
+        back = IndexMap.load(p)
+        assert back.key_to_index == dict(imap.key_to_index)
+
+    def test_rejects_bad_mapping(self):
+        with pytest.raises(ValueError):
+            IndexMap({"a": 0, "b": 2})
+
+
+class TestAvroDataReader:
+    def _write(self, tmp_path, n=30):
+        rng = np.random.default_rng(0)
+        records = []
+        for i in range(n):
+            records.append({
+                "uid": str(i),
+                "response": float(i % 2),
+                "offset": 0.25,
+                "weight": 1.5,
+                "features": [
+                    {"name": "fixed.x1", "term": "", "value": float(rng.normal())},
+                    {"name": "fixed.x2", "term": "a", "value": float(rng.normal())},
+                    {"name": "user.bias", "term": "", "value": 1.0},
+                ],
+                "metadataMap": {"userId": f"u{i % 5}"},
+            })
+        path = str(tmp_path / "train.avro")
+        write_training_examples(path, records)
+        return path, records
+
+    def test_reads_shards_and_ids(self, tmp_path):
+        path, records = self._write(tmp_path)
+        reader = AvroDataReader(shard_configs=(
+            FeatureShardConfig("global", feature_bags=("fixed",)),
+            FeatureShardConfig("user", feature_bags=("user",),
+                               has_intercept=False),
+        ))
+        data, index_maps, vocabs = reader.read(path, id_columns=("userId",))
+        assert data.n_samples == 30
+        np.testing.assert_allclose(data.offsets, 0.25)
+        np.testing.assert_allclose(data.weights, 1.5)
+        # global shard: 2 features + intercept; every row has 3 nnz
+        assert data.shards["global"].dim == 3
+        assert data.shards["global"].nnz == 90
+        assert data.shards["user"].dim == 1
+        assert len(vocabs["userId"]) == 5
+        assert (data.id_columns["userId"] >= 0).all()
+
+    def test_validation_read_reuses_vocab_and_index(self, tmp_path):
+        path, _ = self._write(tmp_path)
+        reader = AvroDataReader(shard_configs=(
+            FeatureShardConfig("global", feature_bags=("fixed",)),))
+        data, imaps, vocabs = reader.read(path, id_columns=("userId",))
+        reader2 = AvroDataReader(
+            shard_configs=reader.shard_configs, index_maps=imaps)
+        data2, imaps2, vocabs2 = reader2.read(
+            path, id_columns=("userId",), entity_vocabs=vocabs)
+        assert imaps2 is imaps or imaps2 == imaps
+        np.testing.assert_array_equal(
+            data.id_columns["userId"], data2.id_columns["userId"])
+
+
+class TestModelIO:
+    def test_glm_roundtrip(self, tmp_path):
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.models import Coefficients, GeneralizedLinearModel
+
+        imap = build_index_map([feature_key("a"), feature_key("b")])
+        w = jnp.asarray(np.array([0.5, 0.0, -1.25], np.float32))
+        var = jnp.asarray(np.array([0.1, 0.2, 0.3], np.float32))
+        model = GeneralizedLinearModel(
+            coefficients=Coefficients(means=w, variances=var),
+            task=TaskType.POISSON_REGRESSION)
+        p = str(tmp_path / "m.avro")
+        save_glm_model(p, model, imap)
+        back = load_glm_model(p, imap)
+        assert back.task == TaskType.POISSON_REGRESSION
+        np.testing.assert_allclose(np.asarray(back.coefficients.means),
+                                   np.asarray(w), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(back.coefficients.variances),
+                                   np.asarray(var), rtol=1e-6)
+
+    def test_game_roundtrip(self, tmp_path):
+        import sys
+        sys.path.insert(0, os.path.dirname(__file__))
+        from test_game import make_mixed_data
+
+        from photon_ml_tpu.game import (
+            GameOptimizationConfiguration,
+            GameEstimator,
+            RandomEffectDatasetConfig,
+        )
+        from photon_ml_tpu.game.estimator import (
+            FixedEffectCoordinateConfig,
+            RandomEffectCoordinateConfig,
+        )
+        from photon_ml_tpu.glm.problem import GLMOptimizationConfiguration
+        from photon_ml_tpu.ops.regularization import L2Regularization
+
+        data, _ = make_mixed_data(n=400, n_entities=7)
+        opt = GLMOptimizationConfiguration(regularization=L2Regularization)
+        est = GameEstimator(
+            task=TaskType.LOGISTIC_REGRESSION,
+            coordinate_configs={
+                "global": FixedEffectCoordinateConfig(
+                    feature_shard_id="fixed", optimization=opt),
+                "perUser": RandomEffectCoordinateConfig(
+                    dataset=RandomEffectDatasetConfig("entityId", "re"),
+                    optimization=opt),
+            },
+            update_sequence=["global", "perUser"])
+        result = est.fit(data, [GameOptimizationConfiguration(
+            {"global": 0.1, "perUser": 1.0})])[0]
+
+        index_maps = {
+            "fixed": build_index_map(
+                [feature_key(f"x{i}") for i in range(8)], add_intercept=False),
+            "re": build_index_map(
+                [feature_key(f"r{i}") for i in range(4)], add_intercept=False),
+        }
+        vocabs = {"entityId": {f"e{i}": i for i in range(7)}}
+        out = str(tmp_path / "game-model")
+        save_game_model(out, result.model, index_maps, vocabs)
+        assert os.path.exists(
+            os.path.join(out, "fixed-effect", "global", "coefficients",
+                         "part-00000.avro"))
+        back = load_game_model(out, index_maps, vocabs)
+        scores_orig = result.model.score(data)
+        scores_back = back.score(data)
+        np.testing.assert_allclose(scores_back, scores_orig, atol=1e-5)
+
+
+class TestCheckpoint:
+    def test_save_restore_latest(self, tmp_path):
+        import sys
+        sys.path.insert(0, os.path.dirname(__file__))
+        from test_game import make_mixed_data
+
+        from photon_ml_tpu.game.model import GameModel, RandomEffectModel
+        from photon_ml_tpu.io.checkpoint import CoordinateDescentState
+
+        re_model = RandomEffectModel(
+            random_effect_type="u", feature_shard_id="re",
+            task=TaskType.LOGISTIC_REGRESSION, dim=4,
+            keys=np.array([0, 1, 5], np.int64),
+            coeffs=np.array([0.5, -1.0, 2.0], np.float32))
+        state = CoordinateDescentState(
+            sweep=2, coordinate_index=1,
+            model=GameModel(coordinates={"perU": re_model},
+                            task=TaskType.LOGISTIC_REGRESSION),
+            scores={"perU": np.arange(5, dtype=np.float32)})
+        mgr = CheckpointManager(str(tmp_path / "ckpt"), keep=2)
+        for step in (1, 2, 3):
+            mgr.save(step, state)
+        assert mgr.steps() == [2, 3]  # keep=2 garbage-collects step 1
+        back = mgr.restore()
+        assert back.sweep == 2 and back.coordinate_index == 1
+        m = back.model.coordinates["perU"]
+        np.testing.assert_array_equal(m.keys, re_model.keys)
+        np.testing.assert_array_equal(m.coeffs, re_model.coeffs)
+        np.testing.assert_array_equal(back.scores["perU"],
+                                      state.scores["perU"])
+
+
+class TestCheckpointedCD:
+    def test_resume_midway_matches_uninterrupted(self, tmp_path):
+        import sys
+        sys.path.insert(0, os.path.dirname(__file__))
+        from test_game import make_mixed_data
+
+        from photon_ml_tpu.game import (
+            FixedEffectDataset,
+            RandomEffectDataset,
+            RandomEffectDatasetConfig,
+        )
+        from photon_ml_tpu.game.coordinate import (
+            FixedEffectCoordinate,
+            RandomEffectCoordinate,
+        )
+        from photon_ml_tpu.game.coordinate_descent import CoordinateDescent
+        from photon_ml_tpu.glm.problem import GLMOptimizationConfiguration
+        from photon_ml_tpu.ops.regularization import L2Regularization
+
+        data, _ = make_mixed_data(n=500, n_entities=7)
+        cfg = GLMOptimizationConfiguration(regularization=L2Regularization)
+        coords = {
+            "global": FixedEffectCoordinate(
+                coordinate_id="global",
+                dataset=FixedEffectDataset.build("global", data, "fixed"),
+                task=TaskType.LOGISTIC_REGRESSION, config=cfg, lam=0.1),
+            "perU": RandomEffectCoordinate(
+                coordinate_id="perU",
+                dataset=RandomEffectDataset.build(
+                    "perU", data, RandomEffectDatasetConfig("entityId", "re")),
+                data=data, task=TaskType.LOGISTIC_REGRESSION, config=cfg,
+                lam=1.0),
+        }
+        cd = CoordinateDescent(update_sequence=["global", "perU"],
+                               n_iterations=2)
+        straight = cd.run(coords, data, TaskType.LOGISTIC_REGRESSION)
+
+        mgr = CheckpointManager(str(tmp_path / "cd-ckpt"), keep=10)
+        full = cd.run(coords, data, TaskType.LOGISTIC_REGRESSION,
+                      checkpoint=mgr)
+        # drop the last checkpoints to simulate a crash after step 2,
+        # then resume and compare final scores
+        for step in mgr.steps():
+            if step > 2:
+                import shutil
+                shutil.rmtree(str(tmp_path / "cd-ckpt" / f"step-{step}"))
+        resumed = cd.run(coords, data, TaskType.LOGISTIC_REGRESSION,
+                         checkpoint=mgr, resume=True)
+        for cid in ("global", "perU"):
+            np.testing.assert_allclose(
+                resumed.scores[cid], full.scores[cid], atol=1e-5)
+            np.testing.assert_allclose(
+                resumed.scores[cid], straight.scores[cid], atol=1e-5)
