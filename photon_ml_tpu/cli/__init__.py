@@ -1,0 +1,20 @@
+"""Command-line drivers.
+
+Re-design of the reference's client layer (``photon-client/.../cli/...`` and
+the legacy ``Driver.scala``): four entry points with the reference's
+vocabulary —
+
+- ``python -m photon_ml_tpu train_glm``  (legacy GLM ``Driver``)
+- ``python -m photon_ml_tpu train_game`` (``GameTrainingDriver``)
+- ``python -m photon_ml_tpu score_game`` (``GameScoringDriver``)
+- ``python -m photon_ml_tpu build_index`` (``FeatureIndexingDriver``)
+
+Spark-submit/scopt is replaced by argparse; the rich inline DSLs (feature
+shard configs, coordinate configs, evaluator strings) are kept — see
+:mod:`photon_ml_tpu.cli.config` for the grammar.
+"""
+
+from photon_ml_tpu.cli.config import (  # noqa: F401
+    parse_coordinate_config,
+    parse_feature_shard_config,
+)

@@ -1,0 +1,147 @@
+"""Inline config DSLs for the drivers.
+
+The reference's scopt parsers accept rich inline grammars
+(``util/ScoptGameTrainingParametersParser.scala``); ours keep the same
+semantic fields with an explicit, documented syntax:
+
+**Feature shard** (``--feature-shards``, comma-separates multiple)::
+
+    shardId=bag1+bag2            # bags; intercept on by default
+    shardId=bag1+bag2|noIntercept
+    shardId=*                    # every feature in the record
+
+**Coordinate** (``--coordinates``, one flag per coordinate)::
+
+    coordId=fixed,shard=global,optimizer=LBFGS,reg=L2,maxIter=80,tol=1e-6
+    coordId=random,entity=userId,shard=user,reg=L2,activeUpper=1000,
+           activeLower=1,maxFeatures=500
+
+**Regularization weights** (``--grid``)::
+
+    coordId=0.1;1;10  [space-separated groups → cartesian product]
+
+**Evaluators** (``--evaluators``): reference vocabulary — ``AUC``, ``RMSE``,
+``LOGISTIC_LOSS``, ``AUC:queryId``, ``PRECISION@5:documentId``, ...
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Mapping, Sequence
+
+from photon_ml_tpu.game.data import RandomEffectDatasetConfig
+from photon_ml_tpu.game.estimator import (
+    FixedEffectCoordinateConfig,
+    RandomEffectCoordinateConfig,
+)
+from photon_ml_tpu.glm.problem import GLMOptimizationConfiguration
+from photon_ml_tpu.io.data_reader import FeatureShardConfig
+from photon_ml_tpu.ops.regularization import RegularizationContext
+from photon_ml_tpu.optimize import OptimizerConfig
+from photon_ml_tpu.sampling import BinaryClassificationDownSampler, DownSampler
+from photon_ml_tpu.types import OptimizerType, RegularizationType
+
+
+def parse_feature_shard_config(spec: str) -> FeatureShardConfig:
+    spec = spec.strip()
+    if "=" not in spec:
+        raise ValueError(f"feature shard spec needs shardId=bags, got {spec!r}")
+    shard_id, rhs = spec.split("=", 1)
+    has_intercept = True
+    if "|" in rhs:
+        rhs, flag = rhs.split("|", 1)
+        if flag == "noIntercept":
+            has_intercept = False
+        elif flag != "intercept":
+            raise ValueError(f"unknown shard flag {flag!r}")
+    bags = None if rhs == "*" else tuple(b for b in rhs.split("+") if b)
+    return FeatureShardConfig(shard_id=shard_id.strip(), feature_bags=bags,
+                              has_intercept=has_intercept)
+
+
+def _parse_kv(parts: Sequence[str]) -> dict[str, str]:
+    out = {}
+    for p in parts:
+        if not p:
+            continue
+        if "=" not in p:
+            raise ValueError(f"expected key=value, got {p!r}")
+        k, v = p.split("=", 1)
+        out[k.strip()] = v.strip()
+    return out
+
+
+def _optimization(kv: dict) -> GLMOptimizationConfiguration:
+    reg_type = RegularizationType(kv.pop("reg", "NONE").upper())
+    alpha = float(kv.pop("alpha", 0.5))
+    optimizer = OptimizerType(kv.pop("optimizer", "LBFGS").upper())
+    opt_cfg = OptimizerConfig(
+        max_iterations=int(kv.pop("maxIter", 80)),
+        tolerance=float(kv.pop("tol", 1e-6)),
+        history=int(kv.pop("history", 10)),
+    )
+    from photon_ml_tpu.types import VarianceComputationType
+
+    variance = VarianceComputationType(kv.pop("variance", "NONE").upper())
+    return GLMOptimizationConfiguration(
+        optimizer=optimizer,
+        regularization=RegularizationContext(reg_type, alpha=alpha),
+        optimizer_config=opt_cfg,
+        variance_type=variance,
+    )
+
+
+def parse_coordinate_config(spec: str):
+    """Returns (coordinateId, FixedEffect/RandomEffectCoordinateConfig)."""
+    spec = spec.strip()
+    if "=" not in spec:
+        raise ValueError(f"coordinate spec needs coordId=kind,..., got {spec!r}")
+    cid, rhs = spec.split("=", 1)
+    cid = cid.strip()
+    parts = rhs.split(",")
+    kind = parts[0].strip()
+    kv = _parse_kv(parts[1:])
+    if kind == "fixed":
+        shard = kv.pop("shard")
+        downsampler = None
+        if "downsample" in kv:
+            rate = float(kv.pop("downsample"))
+            mode = kv.pop("downsampleMode", "binary")
+            cls = (BinaryClassificationDownSampler if mode == "binary"
+                   else DownSampler)
+            downsampler = cls(rate=rate)
+        cfg = FixedEffectCoordinateConfig(
+            feature_shard_id=shard, optimization=_optimization(kv),
+            downsampler=downsampler)
+    elif kind == "random":
+        entity = kv.pop("entity")
+        shard = kv.pop("shard")
+        ds = RandomEffectDatasetConfig(
+            random_effect_type=entity,
+            feature_shard_id=shard,
+            active_data_upper_bound=(int(kv.pop("activeUpper"))
+                                     if "activeUpper" in kv else None),
+            active_data_lower_bound=int(kv.pop("activeLower", 1)),
+            max_active_features=(int(kv.pop("maxFeatures"))
+                                 if "maxFeatures" in kv else None),
+        )
+        cfg = RandomEffectCoordinateConfig(
+            dataset=ds, optimization=_optimization(kv))
+    else:
+        raise ValueError(f"coordinate kind must be fixed|random, got {kind!r}")
+    if kv:
+        raise ValueError(f"unknown coordinate options {sorted(kv)} in {spec!r}")
+    return cid, cfg
+
+
+def parse_grid(specs: Sequence[str]) -> list[Mapping[str, float]]:
+    """``coordId=0.1;1;10`` groups → cartesian product of per-coordinate
+    lambda lists (the reference's hyperparameter grid)."""
+    axes: list[tuple[str, list[float]]] = []
+    for spec in specs:
+        cid, rhs = spec.split("=", 1)
+        axes.append((cid.strip(), [float(x) for x in rhs.split(";") if x]))
+    out = []
+    for combo in itertools.product(*(vals for _, vals in axes)):
+        out.append({cid: v for (cid, _), v in zip(axes, combo)})
+    return out or [{}]

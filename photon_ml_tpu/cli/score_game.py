@@ -1,0 +1,128 @@
+"""GAME batch-scoring driver.
+
+Re-design of ``photon-client/.../cli/game/scoring/GameScoringDriver.scala``
+(+ ``transformers/GameTransformer.scala``): load a saved GAME model + data →
+sum coordinate scores (+ offsets) → write ``ScoringResultAvro`` records;
+optional per-coordinate score breakdown and evaluation of the scored output.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Optional, Sequence
+
+from photon_ml_tpu.evaluation import parse_evaluators, evaluate_all
+from photon_ml_tpu.io import AvroDataReader, load_game_model
+from photon_ml_tpu.io.avro import write_avro_file
+from photon_ml_tpu.io.index import IndexMap
+from photon_ml_tpu.io.schemas import SCORING_RESULT_AVRO
+from photon_ml_tpu.logging_util import RunLogger, timed
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="photon_ml_tpu score_game",
+        description="Score data with a saved GAME model")
+    p.add_argument("--data", required=True)
+    p.add_argument("--model-dir", required=True,
+                   help="a train_game output dir (containing best/ or a "
+                        "model-metadata.json directly)")
+    p.add_argument("--output-dir", required=True)
+    p.add_argument("--feature-shards", required=True,
+                   help="same shard specs used at training time")
+    p.add_argument("--evaluators", default="",
+                   help="optional evaluation of the scored output")
+    p.add_argument("--score-breakdown", action="store_true",
+                   help="also write per-coordinate scores json")
+    return p
+
+
+def run(argv: Optional[Sequence[str]] = None) -> dict:
+    from photon_ml_tpu.cli.config import parse_feature_shard_config
+
+    args = build_parser().parse_args(argv)
+    run_logger = RunLogger(args.output_dir)
+    try:
+        model_dir = os.path.normpath(args.model_dir)
+        if not os.path.exists(os.path.join(model_dir, "model-metadata.json")):
+            nested = os.path.join(model_dir, "best")
+            if os.path.exists(os.path.join(nested, "model-metadata.json")):
+                model_dir = nested
+            else:
+                raise FileNotFoundError(
+                    f"no model-metadata.json under {args.model_dir!r}")
+
+        # feature-indexes lives at the train_game run root; the model may be
+        # at <run>/best or <run>/all/config-N — walk up to find it
+        index_dir = None
+        probe = model_dir
+        for _ in range(3):
+            candidate = os.path.join(probe, "feature-indexes")
+            if os.path.isdir(candidate):
+                index_dir = candidate
+                break
+            probe = os.path.dirname(probe)
+        if index_dir is None:
+            raise FileNotFoundError(
+                f"no feature-indexes directory at or above {model_dir!r}")
+        shard_configs = tuple(parse_feature_shard_config(s)
+                              for s in args.feature_shards.split(","))
+        index_maps = {
+            cfg.shard_id: IndexMap.load(
+                os.path.join(index_dir, f"{cfg.shard_id}.json"))
+            for cfg in shard_configs}
+
+        with open(os.path.join(model_dir, "model-metadata.json")) as f:
+            metadata = json.load(f)
+        re_types = sorted({info["randomEffectType"]
+                           for info in metadata["coordinates"].values()
+                           if info["type"] == "random-effect"})
+        evaluators = parse_evaluators(
+            [e for e in args.evaluators.split(",") if e])
+        id_columns = tuple(dict.fromkeys(
+            re_types + [e.id_tag for e in evaluators if e.id_tag]))
+
+        reader = AvroDataReader(shard_configs=shard_configs,
+                                index_maps=index_maps)
+        with timed("Read data", run_logger):
+            # entity vocab must match training; rebuilt from data then used
+            # for lookups — entities unseen at training score 0 for REs
+            data, _, vocabs = reader.read(args.data, id_columns=id_columns)
+
+        with timed("Load model", run_logger):
+            model = load_game_model(model_dir, index_maps, vocabs)
+
+        with timed("Score", run_logger):
+            scores = model.score(data)
+
+        with timed("Write scores", run_logger):
+            os.makedirs(args.output_dir, exist_ok=True)
+            records = (
+                {"uid": str(i), "predictionScore": float(s),
+                 "label": float(l), "metadataMap": None}
+                for i, (s, l) in enumerate(zip(scores, data.labels)))
+            write_avro_file(os.path.join(args.output_dir, "scores.avro"),
+                            records, SCORING_RESULT_AVRO)
+            if args.score_breakdown:
+                breakdown = model.score_by_coordinate(data)
+                with open(os.path.join(args.output_dir,
+                                       "score-breakdown.json"), "w") as f:
+                    json.dump({k: v.tolist() for k, v in breakdown.items()}, f)
+
+        evaluation = None
+        if evaluators:
+            results = evaluate_all(evaluators, scores, data.labels,
+                                   weights=data.weights,
+                                   id_tags=data.id_columns)
+            evaluation = results.as_dict()
+            run_logger.metric(stage="evaluate", **evaluation)
+        return {"n_scored": data.n_samples, "evaluation": evaluation,
+                "output_dir": args.output_dir}
+    finally:
+        run_logger.close()
+
+
+if __name__ == "__main__":
+    run()

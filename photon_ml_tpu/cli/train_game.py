@@ -1,0 +1,178 @@
+"""GAME training driver.
+
+Re-design of ``photon-client/.../cli/game/training/GameTrainingDriver.scala``
+(+ shared params on ``GameDriver.scala``): read train/validation Avro →
+assemble feature shards + index maps → build the estimator's coordinate
+datasets once → fit every hyperparameter configuration (explicit grid or
+Bayesian GP search) → select best by the first validation evaluator → write
+best (+ optionally all) models in the reference directory layout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from typing import Optional, Sequence
+
+from photon_ml_tpu.cli.config import (
+    parse_coordinate_config,
+    parse_feature_shard_config,
+    parse_grid,
+)
+from photon_ml_tpu.data_validation import validate_game_data
+from photon_ml_tpu.evaluation import parse_evaluators
+from photon_ml_tpu.game.estimator import (
+    GameEstimator,
+    GameOptimizationConfiguration,
+    RandomEffectCoordinateConfig,
+)
+from photon_ml_tpu.io import AvroDataReader, save_game_model
+from photon_ml_tpu.logging_util import RunLogger, timed
+from photon_ml_tpu.types import DataValidationType, TaskType
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="photon_ml_tpu train_game",
+        description="Train a GAME mixed-effect model (TPU)")
+    p.add_argument("--training-data", required=True)
+    p.add_argument("--validation-data")
+    p.add_argument("--output-dir", required=True)
+    p.add_argument("--task", default="LOGISTIC_REGRESSION",
+                   choices=[t.value for t in TaskType])
+    p.add_argument("--feature-shards", required=True,
+                   help="comma-separated shard specs, e.g. "
+                        "'global=fixed|intercept,user=user+item|noIntercept'")
+    p.add_argument("--coordinates", required=True, nargs="+",
+                   help="coordinate specs, e.g. "
+                        "'global=fixed,shard=global,reg=L2' "
+                        "'perUser=random,entity=userId,shard=user,reg=L2'")
+    p.add_argument("--update-sequence", required=True,
+                   help="comma-separated coordinate ids")
+    p.add_argument("--cd-iterations", type=int, default=1)
+    p.add_argument("--grid", nargs="*", default=[],
+                   help="per-coordinate lambda lists 'coordId=0.1;1;10'")
+    p.add_argument("--tuning", choices=["NONE", "RANDOM", "BAYESIAN"],
+                   default="NONE")
+    p.add_argument("--tuning-iterations", type=int, default=10)
+    p.add_argument("--tuning-range", default="1e-4:1e4",
+                   help="lambda search range 'low:high' for tuning")
+    p.add_argument("--evaluators", default="AUC",
+                   help="comma-separated; first drives model selection")
+    p.add_argument("--output-all-models", action="store_true")
+    p.add_argument("--data-validation", default="VALIDATE_FULL",
+                   choices=[v.value for v in DataValidationType])
+    return p
+
+
+def run(argv: Optional[Sequence[str]] = None) -> dict:
+    args = build_parser().parse_args(argv)
+    task = TaskType(args.task)
+    run_logger = RunLogger(args.output_dir)
+    try:
+        shard_configs = tuple(parse_feature_shard_config(s)
+                              for s in args.feature_shards.split(","))
+        coordinate_configs = dict(parse_coordinate_config(s)
+                                  for s in args.coordinates)
+        update_sequence = [c for c in args.update_sequence.split(",") if c]
+        re_types = sorted({
+            c.dataset.random_effect_type
+            for c in coordinate_configs.values()
+            if isinstance(c, RandomEffectCoordinateConfig)})
+        evaluators = parse_evaluators(
+            [e for e in args.evaluators.split(",") if e])
+        id_columns = tuple(dict.fromkeys(
+            re_types + [e.id_tag for e in evaluators if e.id_tag]))
+
+        reader = AvroDataReader(shard_configs=shard_configs)
+        with timed("Read training data", run_logger):
+            data, index_maps, vocabs = reader.read(
+                args.training_data, id_columns=id_columns)
+        with timed("Validate data", run_logger):
+            validate_game_data(data, task,
+                               DataValidationType(args.data_validation))
+
+        validation = None
+        if args.validation_data:
+            reader_v = AvroDataReader(shard_configs=shard_configs,
+                                      index_maps=index_maps)
+            with timed("Read validation data", run_logger):
+                vdata, _, _ = reader_v.read(
+                    args.validation_data, id_columns=id_columns,
+                    entity_vocabs=vocabs)
+            validation = (vdata, evaluators)
+
+        est = GameEstimator(task=task, coordinate_configs=coordinate_configs,
+                            update_sequence=update_sequence,
+                            n_cd_iterations=args.cd_iterations)
+
+        if args.tuning == "NONE":
+            grid = parse_grid(args.grid)
+            unknown = {cid for g in grid for cid in g} - set(update_sequence)
+            if unknown:
+                raise SystemExit(
+                    f"--grid names unknown coordinates {sorted(unknown)}; "
+                    f"update sequence is {update_sequence}")
+            configurations = [GameOptimizationConfiguration(g) for g in grid]
+            with timed("Train (grid)", run_logger):
+                results = est.fit(data, configurations, validation=validation)
+        else:
+            if validation is None:
+                raise SystemExit("--tuning needs --validation-data")
+            from photon_ml_tpu.hyperparameter.search import (
+                GaussianProcessSearch,
+                ParamRange,
+                RandomSearch,
+            )
+
+            low, high = (float(x) for x in args.tuning_range.split(":"))
+            space = {cid: ParamRange(low, high) for cid in update_sequence}
+            results = []
+            datasets = est.prepare(data)  # build once across tuning evals
+
+            def evaluate(config: dict) -> float:
+                r = est.fit(data, [GameOptimizationConfiguration(config)],
+                            validation=validation, datasets=datasets)[0]
+                results.append(r)
+                return r.evaluation.primary[1]
+
+            maximize = evaluators[0].maximize
+            search_cls = (GaussianProcessSearch if args.tuning == "BAYESIAN"
+                          else RandomSearch)
+            with timed(f"Train ({args.tuning} tuning)", run_logger):
+                if args.tuning == "BAYESIAN":
+                    search_cls(space, maximize=maximize).find(
+                        evaluate, args.tuning_iterations)
+                else:
+                    search_cls(space).find(evaluate, args.tuning_iterations)
+
+        best = GameEstimator.select_best(results)
+        if best.evaluation is not None:
+            run_logger.metric(stage="best", **best.evaluation.as_dict(),
+                              config=dict(best.configuration.regularization_weights))
+
+        with timed("Save models", run_logger):
+            os.makedirs(args.output_dir, exist_ok=True)
+            for shard_id, imap in index_maps.items():
+                imap.save(os.path.join(args.output_dir, "feature-indexes",
+                                       f"{shard_id}.json"))
+            save_game_model(os.path.join(args.output_dir, "best"),
+                            best.model, index_maps, vocabs)
+            if args.output_all_models:
+                for i, r in enumerate(results):
+                    save_game_model(
+                        os.path.join(args.output_dir, "all", f"config-{i}"),
+                        r.model, index_maps, vocabs)
+        return {
+            "best_config": dict(best.configuration.regularization_weights),
+            "best_evaluation": (best.evaluation.as_dict()
+                                if best.evaluation else None),
+            "n_configurations": len(results),
+            "output_dir": args.output_dir,
+        }
+    finally:
+        run_logger.close()
+
+
+if __name__ == "__main__":
+    run()

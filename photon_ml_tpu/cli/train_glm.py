@@ -1,0 +1,203 @@
+"""Legacy single-model GLM training driver.
+
+Re-design of the reference's original pipeline (``Driver.scala`` +
+``PhotonMLCmdLineParser.scala`` + ``ModelTraining.scala``; BASELINE configs
+1–3): read Avro → validate rows → optional feature summarization +
+normalization → train one model per regularization weight (descending, warm
+starts) → validate each → select best → write best + all models and the
+summary log. The staged state machine (INIT → ... → VALIDATED) collapses to
+straight-line host code; each stage is a ``timed`` section in the run log.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.data_validation import validate_game_data
+from photon_ml_tpu.evaluation import parse_evaluators
+from photon_ml_tpu.glm.problem import GLMOptimizationConfiguration
+from photon_ml_tpu.glm.training import train_glm_sweep, validate_and_select
+from photon_ml_tpu.io import AvroDataReader, FeatureShardConfig, save_glm_model
+from photon_ml_tpu.io.avro import write_avro_file
+from photon_ml_tpu.io.schemas import FEATURE_SUMMARIZATION_RESULT_AVRO
+from photon_ml_tpu.logging_util import RunLogger, timed
+from photon_ml_tpu.ops.design import CsrDesign, DenseDesign
+from photon_ml_tpu.ops.normalization import NoNormalization, build_normalization
+from photon_ml_tpu.ops.objective import GLMData
+from photon_ml_tpu.ops.regularization import RegularizationContext
+from photon_ml_tpu.optimize import OptimizerConfig
+from photon_ml_tpu.stat import FeatureDataStatistics
+from photon_ml_tpu.types import (
+    DataValidationType,
+    INTERCEPT_KEY,
+    NormalizationType,
+    OptimizerType,
+    RegularizationType,
+    TaskType,
+)
+
+DENSE_MAX_DIM = 4096
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="photon_ml_tpu train_glm",
+        description="Train a single GLM over a regularization sweep (TPU)")
+    p.add_argument("--training-data", required=True)
+    p.add_argument("--validation-data")
+    p.add_argument("--output-dir", required=True)
+    p.add_argument("--task", default="LOGISTIC_REGRESSION",
+                   choices=[t.value for t in TaskType])
+    p.add_argument("--optimizer", default="LBFGS",
+                   choices=[o.value for o in OptimizerType])
+    p.add_argument("--regularization-type", default="L2",
+                   choices=[r.value for r in RegularizationType])
+    p.add_argument("--elastic-net-alpha", type=float, default=0.5)
+    p.add_argument("--regularization-weights", default="1.0",
+                   help="semicolon-separated, e.g. '10;1;0.1'")
+    p.add_argument("--normalization", default="NONE",
+                   choices=[n.value for n in NormalizationType])
+    p.add_argument("--evaluators", default="",
+                   help="comma-separated evaluator specs (first selects the model)")
+    p.add_argument("--max-iterations", type=int, default=80)
+    p.add_argument("--tolerance", type=float, default=1e-6)
+    p.add_argument("--no-intercept", action="store_true")
+    p.add_argument("--variance-computation", default="NONE",
+                   choices=["NONE", "SIMPLE", "FULL"])
+    p.add_argument("--data-validation", default="VALIDATE_FULL",
+                   choices=[v.value for v in DataValidationType])
+    p.add_argument("--summarization-output", action="store_true",
+                   help="write per-feature summary stats avro")
+    return p
+
+
+def _to_glm_data(data, shard_id: str) -> GLMData:
+    shard = data.shards[shard_id]
+    if shard.dim <= DENSE_MAX_DIM:
+        design = DenseDesign(x=jnp.asarray(shard.to_dense()))
+    else:
+        design = CsrDesign(rows=jnp.asarray(shard.rows(), jnp.int32),
+                           cols=jnp.asarray(shard.cols, jnp.int32),
+                           values=jnp.asarray(shard.vals),
+                           n_rows=shard.n_samples, n_cols=shard.dim)
+    return GLMData(design=design, labels=jnp.asarray(data.labels),
+                   offsets=jnp.asarray(data.offsets),
+                   weights=jnp.asarray(data.weights))
+
+
+def run(argv: Optional[Sequence[str]] = None) -> dict:
+    args = build_parser().parse_args(argv)
+    task = TaskType(args.task)
+    run_logger = RunLogger(args.output_dir)
+    try:
+        evaluators = parse_evaluators(
+            [e for e in args.evaluators.split(",") if e])
+        id_columns = tuple(dict.fromkeys(
+            e.id_tag for e in evaluators if e.id_tag))
+        reader = AvroDataReader(shard_configs=(
+            FeatureShardConfig("global", feature_bags=None,
+                               has_intercept=not args.no_intercept),))
+        with timed("Read training data", run_logger):
+            data, index_maps, _ = reader.read(args.training_data,
+                                              id_columns=id_columns)
+        imap = index_maps["global"]
+
+        with timed("Validate data", run_logger):
+            validate_game_data(data, task,
+                               DataValidationType(args.data_validation))
+
+        shard = data.shards["global"]
+        norm_type = NormalizationType(args.normalization)
+        normalization = NoNormalization
+        if norm_type != NormalizationType.NONE or args.summarization_output:
+            with timed("Summarize features", run_logger):
+                stats = FeatureDataStatistics.from_shard(shard)
+            if args.summarization_output:
+                write_avro_file(
+                    os.path.join(args.output_dir, "summary.avro"),
+                    stats.to_records(imap.names()),
+                    FEATURE_SUMMARIZATION_RESULT_AVRO)
+            if norm_type != NormalizationType.NONE:
+                intercept_idx = imap.key_to_index.get(INTERCEPT_KEY)
+                normalization = build_normalization(
+                    norm_type, mean=stats.mean, variance=stats.variance,
+                    max_magnitude=stats.max_magnitude,
+                    intercept_index=intercept_idx)
+
+        from photon_ml_tpu.types import VarianceComputationType
+
+        lambdas = [float(x) for x in args.regularization_weights.split(";") if x]
+        config = GLMOptimizationConfiguration(
+            optimizer=OptimizerType(args.optimizer),
+            regularization=RegularizationContext(
+                RegularizationType(args.regularization_type),
+                alpha=args.elastic_net_alpha),
+            optimizer_config=OptimizerConfig(
+                max_iterations=args.max_iterations, tolerance=args.tolerance),
+            variance_type=VarianceComputationType(args.variance_computation),
+        )
+
+        reg_mask = None
+        if imap.has_intercept:
+            mask = np.ones(len(imap), np.float32)
+            mask[imap.key_to_index[INTERCEPT_KEY]] = 0.0
+            reg_mask = jnp.asarray(mask)
+
+        glm_train = _to_glm_data(data, "global")
+        with timed("Train", run_logger):
+            trained = train_glm_sweep(
+                task, glm_train, lambdas, config,
+                normalization=normalization, reg_mask=reg_mask)
+        for tm in trained:
+            run_logger.metric(stage="train", regularization_weight=tm.regularization_weight,
+                              value=float(tm.result.value),
+                              iterations=int(tm.result.iterations),
+                              converged=bool(tm.result.converged))
+
+        best_idx = 0
+        if args.validation_data and evaluators:
+            reader_v = AvroDataReader(shard_configs=reader.shard_configs,
+                                      index_maps=index_maps)
+            with timed("Read validation data", run_logger):
+                vdata, _, _ = reader_v.read(args.validation_data,
+                                            id_columns=id_columns)
+            glm_val = _to_glm_data(vdata, "global")
+            with timed("Validate models", run_logger):
+                best_idx, trained = validate_and_select(
+                    trained, evaluators, glm_val,
+                    id_tags=vdata.id_columns)
+            for tm in trained:
+                run_logger.metric(stage="validate",
+                                  regularization_weight=tm.regularization_weight,
+                                  **tm.evaluation.as_dict())
+
+        with timed("Save models", run_logger):
+            imap.save(os.path.join(args.output_dir, "feature-index.json"))
+            best = trained[best_idx]
+            save_glm_model(
+                os.path.join(args.output_dir, "best", "model.avro"),
+                best.model, imap, model_id="best")
+            for tm in trained:
+                save_glm_model(
+                    os.path.join(args.output_dir, "all",
+                                 f"lambda-{tm.regularization_weight:g}",
+                                 "model.avro"),
+                    tm.model, imap,
+                    model_id=f"lambda-{tm.regularization_weight:g}")
+        return {
+            "best_lambda": best.regularization_weight,
+            "best_evaluation": (best.evaluation.as_dict()
+                                if best.evaluation else None),
+            "output_dir": args.output_dir,
+        }
+    finally:
+        run_logger.close()
+
+
+if __name__ == "__main__":
+    run()

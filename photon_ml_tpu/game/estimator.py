@@ -137,8 +137,13 @@ class GameEstimator:
         data: GameData,
         configurations: Sequence[GameOptimizationConfiguration],
         validation: Optional[tuple[GameData, Sequence[Evaluator]]] = None,
+        datasets: Optional[Mapping[str, object]] = None,
     ) -> list[GameResult]:
-        datasets = self.prepare(data)
+        """``datasets`` (from :meth:`prepare`) lets callers that fit many
+        times over the same data — e.g. a tuning loop — build the coordinate
+        datasets once."""
+        if datasets is None:
+            datasets = self.prepare(data)
         cd = CoordinateDescent(update_sequence=self.update_sequence,
                                n_iterations=self.n_cd_iterations)
         results: list[GameResult] = []

@@ -1,0 +1,258 @@
+"""Build/load the native ingest library and decode Avro training files.
+
+Pairs with ``native/avro_reader.cc`` (see its header comment for the role).
+The module compiles the shared library on first use (g++ -O2, linked against
+zlib), caches it under ``native/build/``, and exposes
+:func:`decode_training_file` returning flat numpy arrays. Callers must treat
+this as an optional fast path: :data:`available` is False when no compiler
+or library is usable, and ``AvroDataReader`` falls back to the pure-Python
+codec (:mod:`photon_ml_tpu.io.avro`).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import dataclasses
+import io
+import json
+import os
+import subprocess
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+from photon_ml_tpu.io import avro as avro_mod
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SRC = os.path.join(_REPO_ROOT, "native", "avro_reader.cc")
+_BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
+_LIB = os.path.join(_BUILD_DIR, "libphoton_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+#: canonical field order we emit; the file's order is matched against names
+_FIELDS = ("uid", "response", "offset", "weight", "features", "metadataMap")
+
+
+def _build() -> bool:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    cmd = ["g++", "-std=c++17", "-O2", "-shared", "-fPIC", "-o", _LIB,
+           _SRC, "-lz"]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    return proc.returncode == 0 and os.path.exists(_LIB)
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        if not os.path.exists(_LIB) or \
+                os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
+            if not _build():
+                _load_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            _load_failed = True
+            return None
+        lib.photon_decode_blocks.restype = ctypes.c_void_p
+        lib.photon_decode_blocks.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_char_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int), ctypes.c_char_p, ctypes.c_char_p]
+        lib.photon_result_error.restype = ctypes.c_char_p
+        lib.photon_result_error.argtypes = [ctypes.c_void_p]
+        for name, res in (("n_records", ctypes.c_int64),
+                          ("nnz", ctypes.c_int64),
+                          ("n_feature_keys", ctypes.c_int32),
+                          ("feature_bytes_len", ctypes.c_int64)):
+            fn = getattr(lib, f"photon_result_{name}")
+            fn.restype = res
+            fn.argtypes = [ctypes.c_void_p]
+        lib.photon_result_copy_core.argtypes = [ctypes.c_void_p] + \
+            [np.ctypeslib.ndpointer(dtype=d, flags="C_CONTIGUOUS")
+             for d in (np.float64, np.float64, np.float64, np.int64,
+                       np.int32, np.float64)]
+        lib.photon_result_copy_feature_keys.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            np.ctypeslib.ndpointer(dtype=np.int64, flags="C_CONTIGUOUS")]
+        lib.photon_result_id_vocab_size.restype = ctypes.c_int32
+        lib.photon_result_id_vocab_size.argtypes = [ctypes.c_void_p,
+                                                    ctypes.c_int32]
+        lib.photon_result_id_vocab_bytes_len.restype = ctypes.c_int64
+        lib.photon_result_id_vocab_bytes_len.argtypes = [ctypes.c_void_p,
+                                                         ctypes.c_int32]
+        lib.photon_result_copy_id_col.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32,
+            np.ctypeslib.ndpointer(dtype=np.int32, flags="C_CONTIGUOUS"),
+            ctypes.c_char_p,
+            np.ctypeslib.ndpointer(dtype=np.int64, flags="C_CONTIGUOUS")]
+        lib.photon_result_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+@dataclasses.dataclass
+class DecodedFile:
+    """Columnar decode of one TrainingExampleAvro container file."""
+
+    response: np.ndarray  # (n,) f64, NaN never (response is required)
+    offset: np.ndarray  # (n,) f64, NaN = null
+    weight: np.ndarray  # (n,) f64, NaN = null
+    feat_indptr: np.ndarray  # (n+1,) i64
+    feat_key_id: np.ndarray  # (nnz,) i32 -> feature_keys
+    feat_val: np.ndarray  # (nnz,) f64
+    feature_keys: list[str]  # interned "name\x01term" strings
+    id_cols: dict[str, np.ndarray]  # (n,) i32, -1 missing
+    id_vocabs: dict[str, list[str]]
+
+    @property
+    def n_records(self) -> int:
+        return int(self.response.shape[0])
+
+
+def _schema_layout(schema) -> Optional[tuple[list[int], bytes]]:
+    """Match the file schema against TrainingExampleAvro; return
+    (field_order, null_first) or None if incompatible."""
+    if not isinstance(schema, dict) or schema.get("type") != "record":
+        return None
+    fields = schema.get("fields", [])
+    if len(fields) != len(_FIELDS):
+        return None
+    order: list[int] = []
+    null_first = bytearray(len(_FIELDS))
+    for f in fields:
+        name = f.get("name")
+        if name not in _FIELDS:
+            return None
+        idx = _FIELDS.index(name)
+        order.append(idx)
+        t = f.get("type")
+        if name in ("uid", "offset", "weight", "metadataMap"):
+            if not (isinstance(t, list) and len(t) == 2 and "null" in t):
+                return None
+            null_first[idx] = 1 if t[0] == "null" else 0
+            other = t[1] if t[0] == "null" else t[0]
+            if name == "uid" and other != "string":
+                return None
+            if name in ("offset", "weight") and other != "double":
+                return None
+            if name == "metadataMap" and not (
+                    isinstance(other, dict) and other.get("type") == "map"
+                    and other.get("values") == "string"):
+                return None
+        elif name == "response":
+            if t != "double":
+                return None
+        else:  # features
+            if not (isinstance(t, dict) and t.get("type") == "array"):
+                return None
+            items = t.get("items")
+            if not (isinstance(items, dict) and items.get("type") == "record"):
+                return None
+            fnames = [x.get("name") for x in items.get("fields", [])]
+            ftypes = [x.get("type") for x in items.get("fields", [])]
+            if fnames != ["name", "term", "value"] or \
+                    ftypes != ["string", "string", "double"]:
+                return None
+    return order, bytes(null_first)
+
+
+def decode_training_file(path: str, id_keys: Sequence[str] = ()
+                         ) -> Optional[DecodedFile]:
+    """Decode via the native library; None if unavailable/incompatible
+    (caller falls back to the pure-Python reader)."""
+    lib = _load()
+    if lib is None:
+        return None
+    with open(path, "rb") as f:
+        blob = f.read()
+    buf = io.BytesIO(blob)
+    if buf.read(4) != avro_mod.MAGIC:
+        return None
+    # header: metadata map + sync (python-side; cheap)
+    names: dict = {}
+    meta = {}
+    while True:
+        count = avro_mod.read_long(buf)
+        if count == 0:
+            break
+        if count < 0:
+            count = -count
+            avro_mod.read_long(buf)
+        for _ in range(count):
+            k = avro_mod.read_datum(buf, "string", names)
+            size = avro_mod.read_long(buf)
+            meta[k] = buf.read(size)
+    codec = meta.get("avro.codec", b"null").decode()
+    if codec not in ("null", "deflate"):
+        return None
+    layout = _schema_layout(json.loads(meta["avro.schema"].decode()))
+    if layout is None:
+        return None
+    field_order, null_first = layout
+    sync = buf.read(avro_mod.SYNC_SIZE)
+    blocks = blob[buf.tell():]
+
+    order_arr = (ctypes.c_int * len(field_order))(*field_order)
+    rp = lib.photon_decode_blocks(
+        blocks, len(blocks), sync, int(codec == "deflate"), order_arr,
+        null_first, "\n".join(id_keys).encode())
+    if not rp:
+        return None
+    try:
+        err = lib.photon_result_error(rp)
+        if err:
+            raise ValueError(f"native avro decode failed for {path!r}: "
+                             f"{err.decode()}")
+        n = lib.photon_result_n_records(rp)
+        nnz = lib.photon_result_nnz(rp)
+        n_keys = lib.photon_result_n_feature_keys(rp)
+        key_bytes_len = lib.photon_result_feature_bytes_len(rp)
+
+        response = np.empty(n, np.float64)
+        offset = np.empty(n, np.float64)
+        weight = np.empty(n, np.float64)
+        indptr = np.empty(n + 1, np.int64)
+        key_id = np.empty(nnz, np.int32)
+        val = np.empty(nnz, np.float64)
+        lib.photon_result_copy_core(rp, response, offset, weight, indptr,
+                                    key_id, val)
+
+        kb = ctypes.create_string_buffer(max(int(key_bytes_len), 1))
+        koff = np.empty(n_keys + 1, np.int64)
+        lib.photon_result_copy_feature_keys(rp, kb, koff)
+        kraw = kb.raw[:key_bytes_len]
+        feature_keys = [kraw[koff[i]:koff[i + 1]].decode()
+                        for i in range(n_keys)]
+
+        id_cols = {}
+        id_vocabs = {}
+        for c, key in enumerate(id_keys):
+            vsize = lib.photon_result_id_vocab_size(rp, c)
+            vbytes = lib.photon_result_id_vocab_bytes_len(rp, c)
+            ids = np.empty(n, np.int32)
+            vb = ctypes.create_string_buffer(max(int(vbytes), 1))
+            voff = np.empty(vsize + 1, np.int64)
+            lib.photon_result_copy_id_col(rp, c, ids, vb, voff)
+            vraw = vb.raw[:vbytes]
+            id_cols[key] = ids
+            id_vocabs[key] = [vraw[voff[i]:voff[i + 1]].decode()
+                              for i in range(vsize)]
+        return DecodedFile(
+            response=response, offset=offset, weight=weight,
+            feat_indptr=indptr, feat_key_id=key_id, feat_val=val,
+            feature_keys=feature_keys, id_cols=id_cols, id_vocabs=id_vocabs)
+    finally:
+        lib.photon_result_free(rp)

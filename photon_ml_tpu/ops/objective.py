@@ -91,6 +91,10 @@ class GLMObjective:
     loss: PointwiseLoss
     normalization: NormalizationContext = NoNormalization
     reg_mask: Optional[Array] = None
+    #: use the Pallas fused one-pass value+grad kernel (TPU only; dense
+    #: designs with identity normalization — other cases fall back to
+    #: autodiff transparently). See photon_ml_tpu/ops/pallas_glm.py.
+    fused: bool = False
 
     # --- margins ----------------------------------------------------------
     def margins(self, w: Array, data: GLMData) -> Array:
@@ -117,6 +121,17 @@ class GLMObjective:
 
     # --- derivatives (autodiff) ------------------------------------------
     def value_and_grad(self, w: Array, data: GLMData, l2=0.0) -> tuple[Array, Array]:
+        if (self.fused and isinstance(data.design, DenseDesign)
+                and self.normalization.is_identity):
+            from photon_ml_tpu.ops.pallas_glm import fused_value_and_grad
+
+            value, grad = fused_value_and_grad(
+                self.loss, data.design.x, w, data.labels, data.offsets,
+                data.weights)
+            l2 = jnp.asarray(l2, value.dtype)
+            wr = w if self.reg_mask is None else w * self.reg_mask
+            return (value + 0.5 * l2 * jnp.vdot(wr, wr),
+                    grad + l2 * wr)
         return jax.value_and_grad(self.value)(w, data, l2)
 
     def grad(self, w: Array, data: GLMData, l2=0.0) -> Array:

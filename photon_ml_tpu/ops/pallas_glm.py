@@ -1,0 +1,114 @@
+"""Pallas TPU kernel: fused GLM objective value + gradient in ONE pass over X.
+
+Why: XLA computes ``value_and_grad`` of the GLM objective as two passes over
+the design matrix — forward margins (``X @ w``) and transposed gradient
+(``X^T @ dl``) — so the HBM-bound solve reads X twice per L-BFGS iteration.
+This kernel streams each row-block of X through VMEM once and computes BOTH
+contractions while the block is resident (the counterpart of the
+reference's single-pass per-partition ``ValueAndGradientAggregator.scala``,
+which also fuses margin/loss/gradient in one sweep per sample):
+
+    per block i:   m   = X_i @ w + offsets_i          (MXU)
+                   l  += Σ weights_i * loss(m, y_i)   (VPU)
+                   g  += X_i^T @ (weights_i * dl(m))  (MXU)
+
+Halving HBM traffic roughly doubles throughput for the bandwidth-bound
+regime the headline bench measures. The kernel is jit/shard_map-safe (the
+distributed layer's psum wraps around it); L2 and normalization stay outside
+(coefficient-space reparameterization, SURVEY.md §7).
+
+Grid iteration on TPU is sequential, so accumulating into the outputs across
+grid steps (init at block 0) is the standard reduction pattern.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from photon_ml_tpu.ops.losses import PointwiseLoss
+
+#: rows streamed per grid step; multiple of every dtype's sublane tile
+DEFAULT_BLOCK_ROWS = 1024
+
+
+def _kernel(loss: PointwiseLoss, x_ref, y_ref, off_ref, wt_ref, w_ref,
+            loss_ref, grad_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        loss_ref[:] = jnp.zeros_like(loss_ref)
+        grad_ref[:] = jnp.zeros_like(grad_ref)
+
+    x = x_ref[:]  # (B, D) — read once, used by both contractions
+    w = w_ref[:]  # (D, 1)
+    y = y_ref[:]  # (1, B)
+    off = off_ref[:]
+    wt = wt_ref[:]
+
+    margins = jnp.dot(x, w, preferred_element_type=jnp.float32)  # (B, 1)
+    m = margins.reshape(1, -1) + off
+    lvec = loss.loss(m, y)
+    dvec = loss.d1(m, y) * wt
+    # padded rows carry weight 0; the where guards 0 * inf = nan
+    lsum = jnp.sum(jnp.where(wt > 0, wt * lvec, 0.0))
+    loss_ref[0, 0] += lsum
+    grad_ref[:] += jnp.dot(x.T, dvec.reshape(-1, 1).astype(x.dtype),
+                           preferred_element_type=jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("loss", "block_rows", "interpret"))
+def fused_value_and_grad(loss: PointwiseLoss, x, w, labels, offsets, weights,
+                         *, block_rows: int = DEFAULT_BLOCK_ROWS,
+                         interpret: bool = False):
+    """(value, grad) of ``Σ_i weights_i * loss(x_i·w + offsets_i, y_i)``.
+
+    ``x`` is ``(n, d)`` (any float dtype; bf16 recommended), ``w`` ``(d,)``
+    f32. Rows are processed in ``block_rows`` chunks; the tail block is
+    padded with weight-0 rows, which contribute exactly nothing.
+    """
+    n, d = x.shape
+    b = min(block_rows, max(n, 8))
+    n_blocks = pl.cdiv(n, b)
+    n_pad = n_blocks * b
+    if n_pad != n:
+        pad = n_pad - n
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+        labels = jnp.pad(labels, (0, pad))
+        offsets = jnp.pad(offsets, (0, pad))
+        weights = jnp.pad(weights, (0, pad))
+
+    f32 = jnp.float32
+    out = pl.pallas_call(
+        functools.partial(_kernel, loss),
+        grid=(n_blocks,),
+        in_specs=[
+            pl.BlockSpec((b, d), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, b), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, b), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, b), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((d, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((d, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, 1), f32),
+            jax.ShapeDtypeStruct((d, 1), f32),
+        ],
+        interpret=interpret,
+    )(
+        x,
+        labels.astype(f32).reshape(1, -1),
+        offsets.astype(f32).reshape(1, -1),
+        weights.astype(f32).reshape(1, -1),
+        w.astype(f32).reshape(-1, 1),
+    )
+    value, grad = out
+    return value[0, 0], grad[:, 0]

@@ -1,0 +1,223 @@
+"""End-to-end driver tests (reference ``DriverIntegTest`` /
+``GameTrainingDriverIntegTest`` / ``GameScoringDriverIntegTest`` pattern:
+tiny Avro datasets through the full CLI pipeline, asserting outputs and
+metric thresholds)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.cli import train_glm as train_glm_cli
+from photon_ml_tpu.cli import train_game as train_game_cli
+from photon_ml_tpu.cli import score_game as score_game_cli
+from photon_ml_tpu.cli import build_index as build_index_cli
+from photon_ml_tpu.cli.config import (
+    parse_coordinate_config,
+    parse_feature_shard_config,
+    parse_grid,
+)
+from photon_ml_tpu.io.data_reader import write_training_examples
+
+
+def make_avro_dataset(path, n=600, d_fixed=6, d_user=3, n_users=9, seed=0,
+                      param_seed=777):
+    """Mixed-effect logistic data as TrainingExampleAvro: global features in
+    bag 'fixed', per-user features in bag 'user', userId in metadataMap."""
+    prng = np.random.default_rng(param_seed)
+    w = prng.normal(size=d_fixed)
+    u = 1.5 * prng.normal(size=(n_users, d_user))
+    rng = np.random.default_rng(seed)
+    xf = rng.normal(size=(n, d_fixed))
+    xu = rng.normal(size=(n, d_user))
+    users = rng.integers(0, n_users, size=n)
+    margin = xf @ w + np.einsum("nd,nd->n", xu, u[users])
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-margin))).astype(float)
+    records = []
+    for i in range(n):
+        feats = [{"name": f"fixed.x{j}", "term": "", "value": float(xf[i, j])}
+                 for j in range(d_fixed)]
+        feats += [{"name": f"user.z{j}", "term": "", "value": float(xu[i, j])}
+                  for j in range(d_user)]
+        records.append({
+            "uid": str(i), "response": float(y[i]), "offset": None,
+            "weight": None, "features": feats,
+            "metadataMap": {"userId": f"u{users[i]}"},
+        })
+    write_training_examples(str(path), records)
+    return str(path)
+
+
+class TestConfigDSL:
+    def test_feature_shard_specs(self):
+        cfg = parse_feature_shard_config("global=fixed+ctx|noIntercept")
+        assert cfg.shard_id == "global"
+        assert cfg.feature_bags == ("fixed", "ctx")
+        assert not cfg.has_intercept
+        assert parse_feature_shard_config("all=*").feature_bags is None
+        with pytest.raises(ValueError):
+            parse_feature_shard_config("bad")
+        with pytest.raises(ValueError):
+            parse_feature_shard_config("a=b|what")
+
+    def test_coordinate_specs(self):
+        cid, cfg = parse_coordinate_config(
+            "global=fixed,shard=g,reg=L2,optimizer=TRON,maxIter=40")
+        assert cid == "global"
+        assert cfg.feature_shard_id == "g"
+        assert cfg.optimization.optimizer.value == "TRON"
+        assert cfg.optimization.optimizer_config.max_iterations == 40
+        cid, cfg = parse_coordinate_config(
+            "perU=random,entity=userId,shard=u,reg=ELASTIC_NET,alpha=0.7,"
+            "activeUpper=100,maxFeatures=50")
+        assert cfg.dataset.random_effect_type == "userId"
+        assert cfg.dataset.active_data_upper_bound == 100
+        assert cfg.dataset.max_active_features == 50
+        assert cfg.optimization.regularization.alpha == 0.7
+        with pytest.raises(ValueError):
+            parse_coordinate_config("x=fixed,shard=g,bogus=1")
+
+    def test_grid(self):
+        grid = parse_grid(["a=1;10", "b=0.5"])
+        assert grid == [{"a": 1.0, "b": 0.5}, {"a": 10.0, "b": 0.5}]
+        assert parse_grid([]) == [{}]
+
+
+class TestTrainGlmDriver:
+    def test_end_to_end(self, tmp_path):
+        train = make_avro_dataset(tmp_path / "train.avro", n=800, seed=0)
+        val = make_avro_dataset(tmp_path / "val.avro", n=400, seed=1)
+        out = str(tmp_path / "out")
+        result = train_glm_cli.run([
+            "--training-data", train, "--validation-data", val,
+            "--output-dir", out, "--task", "LOGISTIC_REGRESSION",
+            "--regularization-type", "L2",
+            "--regularization-weights", "10;1;0.1",
+            "--evaluators", "AUC,LOGISTIC_LOSS",
+            "--normalization", "STANDARDIZATION",
+            "--summarization-output",
+        ])
+        assert os.path.exists(os.path.join(out, "best", "model.avro"))
+        assert os.path.exists(os.path.join(out, "all", "lambda-10", "model.avro"))
+        assert os.path.exists(os.path.join(out, "summary.avro"))
+        assert os.path.exists(os.path.join(out, "photon.log"))
+        assert os.path.exists(os.path.join(out, "metrics.jsonl"))
+        # fixed effect alone on this data should clear AUC 0.6 easily
+        assert result["best_evaluation"]["AUC"] > 0.6
+
+    def test_elastic_net_owlqn(self, tmp_path):
+        train = make_avro_dataset(tmp_path / "train.avro", n=400)
+        out = str(tmp_path / "out")
+        result = train_glm_cli.run([
+            "--training-data", train, "--output-dir", out,
+            "--regularization-type", "ELASTIC_NET",
+            "--elastic-net-alpha", "0.9",
+            "--regularization-weights", "5",
+        ])
+        assert result["best_lambda"] == 5.0
+
+    def test_sharded_evaluator(self, tmp_path):
+        train = make_avro_dataset(tmp_path / "train.avro", n=500)
+        val = make_avro_dataset(tmp_path / "val.avro", n=300, seed=4)
+        out = str(tmp_path / "out")
+        result = train_glm_cli.run([
+            "--training-data", train, "--validation-data", val,
+            "--output-dir", out, "--regularization-weights", "1",
+            "--evaluators", "AUC:userId,AUC",
+        ])
+        assert 0.0 <= result["best_evaluation"]["AUC:userId"] <= 1.0
+
+    def test_tron(self, tmp_path):
+        train = make_avro_dataset(tmp_path / "train.avro", n=400)
+        out = str(tmp_path / "out")
+        train_glm_cli.run([
+            "--training-data", train, "--output-dir", out,
+            "--optimizer", "TRON", "--regularization-weights", "1",
+            "--variance-computation", "SIMPLE",
+        ])
+        assert os.path.exists(os.path.join(out, "best", "model.avro"))
+
+
+SHARDS = "global=fixed|intercept,user=user|noIntercept"
+COORDS = [
+    "global=fixed,shard=global,reg=L2",
+    "perUser=random,entity=userId,shard=user,reg=L2",
+]
+
+
+class TestTrainGameDriver:
+    def test_grid_and_scoring(self, tmp_path):
+        train = make_avro_dataset(tmp_path / "train.avro", n=900, seed=0)
+        val = make_avro_dataset(tmp_path / "val.avro", n=450, seed=2)
+        out = str(tmp_path / "game-out")
+        result = train_game_cli.run([
+            "--training-data", train, "--validation-data", val,
+            "--output-dir", out,
+            "--feature-shards", SHARDS,
+            "--coordinates", *COORDS,
+            "--update-sequence", "global,perUser",
+            "--cd-iterations", "2",
+            "--grid", "global=0.1", "perUser=1;10",
+            "--evaluators", "AUC,AUC:userId",
+            "--output-all-models",
+        ])
+        assert result["n_configurations"] == 2
+        assert result["best_evaluation"]["AUC"] > 0.65
+        assert os.path.exists(
+            os.path.join(out, "best", "model-metadata.json"))
+        assert os.path.exists(
+            os.path.join(out, "all", "config-0", "model-metadata.json"))
+
+        # score with the saved model
+        score_out = str(tmp_path / "scores")
+        sresult = score_game_cli.run([
+            "--data", val, "--model-dir", out,
+            "--output-dir", score_out,
+            "--feature-shards", SHARDS,
+            "--evaluators", "AUC", "--score-breakdown",
+        ])
+        assert sresult["n_scored"] == 450
+        # scoring the same validation data reproduces the AUC to tolerance
+        assert abs(sresult["evaluation"]["AUC"]
+                   - result["best_evaluation"]["AUC"]) < 0.02
+        assert os.path.exists(os.path.join(score_out, "scores.avro"))
+        assert os.path.exists(os.path.join(score_out, "score-breakdown.json"))
+
+        # scoring a non-best saved model (all/config-N) also resolves indexes
+        sresult2 = score_game_cli.run([
+            "--data", val, "--model-dir", os.path.join(out, "all", "config-0"),
+            "--output-dir", str(tmp_path / "scores2"),
+            "--feature-shards", SHARDS,
+        ])
+        assert sresult2["n_scored"] == 450
+
+    def test_bayesian_tuning(self, tmp_path):
+        train = make_avro_dataset(tmp_path / "train.avro", n=500, seed=0)
+        val = make_avro_dataset(tmp_path / "val.avro", n=300, seed=3)
+        out = str(tmp_path / "tuned")
+        result = train_game_cli.run([
+            "--training-data", train, "--validation-data", val,
+            "--output-dir", out,
+            "--feature-shards", SHARDS,
+            "--coordinates", *COORDS,
+            "--update-sequence", "global,perUser",
+            "--tuning", "BAYESIAN", "--tuning-iterations", "5",
+            "--tuning-range", "1e-3:1e3",
+            "--evaluators", "AUC",
+        ])
+        assert result["n_configurations"] == 5
+        assert result["best_evaluation"]["AUC"] > 0.6
+        assert os.path.exists(os.path.join(out, "best", "model-metadata.json"))
+
+
+class TestBuildIndexDriver:
+    def test_builds_per_shard_indexes(self, tmp_path):
+        train = make_avro_dataset(tmp_path / "train.avro", n=100)
+        out = str(tmp_path / "idx")
+        result = build_index_cli.run([
+            "--data", train, "--output-dir", out,
+            "--feature-shards", SHARDS,
+        ])
+        assert result["sizes"]["global"] == 7  # 6 features + intercept
+        assert result["sizes"]["user"] == 3
+        assert os.path.exists(os.path.join(out, "global.json"))

@@ -1,0 +1,76 @@
+"""Native ingest tests: C++ decoder parity with the pure-Python codec."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+from test_cli import make_avro_dataset  # noqa: E402
+
+from photon_ml_tpu import native  # noqa: E402
+from photon_ml_tpu.io import AvroDataReader, FeatureShardConfig  # noqa: E402
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native library unavailable")
+
+SHARDS = (FeatureShardConfig("global", feature_bags=("fixed",)),
+          FeatureShardConfig("user", feature_bags=("user",),
+                             has_intercept=False))
+
+
+class TestNativeReaderParity:
+    def test_game_data_identical_to_pure_python(self, tmp_path):
+        path = make_avro_dataset(tmp_path / "t.avro", n=400, seed=5)
+        fast = AvroDataReader(shard_configs=SHARDS)
+        slow = AvroDataReader(shard_configs=SHARDS, use_native=False)
+        data_f, imaps_f, vocabs_f = fast.read(path, id_columns=("userId",))
+        data_s, imaps_s, vocabs_s = slow.read(path, id_columns=("userId",))
+        for sid in ("global", "user"):
+            assert dict(imaps_f[sid].key_to_index) == \
+                dict(imaps_s[sid].key_to_index)
+            np.testing.assert_allclose(data_f.shards[sid].to_dense(),
+                                       data_s.shards[sid].to_dense(),
+                                       rtol=1e-6)
+        np.testing.assert_array_equal(data_f.labels, data_s.labels)
+        np.testing.assert_array_equal(data_f.offsets, data_s.offsets)
+        np.testing.assert_array_equal(data_f.weights, data_s.weights)
+        assert vocabs_f == vocabs_s
+        np.testing.assert_array_equal(data_f.id_columns["userId"],
+                                      data_s.id_columns["userId"])
+
+    def test_frozen_vocab_and_index_maps(self, tmp_path):
+        train = make_avro_dataset(tmp_path / "train.avro", n=300, seed=0)
+        val = make_avro_dataset(tmp_path / "val.avro", n=200, seed=1)
+        r = AvroDataReader(shard_configs=SHARDS)
+        _, imaps, vocabs = r.read(train, id_columns=("userId",))
+        r2 = AvroDataReader(shard_configs=SHARDS, index_maps=imaps)
+        data_f, _, _ = r2.read(val, id_columns=("userId",),
+                               entity_vocabs=vocabs)
+        r3 = AvroDataReader(shard_configs=SHARDS, index_maps=imaps,
+                            use_native=False)
+        data_s, _, _ = r3.read(val, id_columns=("userId",),
+                               entity_vocabs=vocabs)
+        np.testing.assert_array_equal(data_f.id_columns["userId"],
+                                      data_s.id_columns["userId"])
+        np.testing.assert_allclose(data_f.shards["global"].to_dense(),
+                                   data_s.shards["global"].to_dense(),
+                                   rtol=1e-6)
+
+    def test_multi_file_read(self, tmp_path):
+        d = tmp_path / "data"
+        d.mkdir()
+        make_avro_dataset(d / "part-0.avro", n=100, seed=0)
+        make_avro_dataset(d / "part-1.avro", n=150, seed=1)
+        fast = AvroDataReader(shard_configs=SHARDS)
+        slow = AvroDataReader(shard_configs=SHARDS, use_native=False)
+        data_f, _, vf = fast.read(str(d), id_columns=("userId",))
+        data_s, _, vs = slow.read(str(d), id_columns=("userId",))
+        assert data_f.n_samples == 250
+        assert vf == vs
+        np.testing.assert_array_equal(data_f.id_columns["userId"],
+                                      data_s.id_columns["userId"])
+        np.testing.assert_allclose(data_f.shards["global"].to_dense(),
+                                   data_s.shards["global"].to_dense(),
+                                   rtol=1e-6)
