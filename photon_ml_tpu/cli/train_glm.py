@@ -73,7 +73,19 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=[v.value for v in DataValidationType])
     p.add_argument("--summarization-output", action="store_true",
                    help="write per-feature summary stats avro")
+    p.add_argument("--training-diagnostics", action="store_true",
+                   help="write diagnostics/report.html (bootstrap CIs, "
+                        "Hosmer-Lemeshow, feature importance, fitting curve)")
+    p.add_argument("--diagnostic-bootstrap-replicates", type=_positive_int,
+                   default=16)
     return p
+
+
+def _positive_int(s: str) -> int:
+    v = int(s)
+    if v < 1:
+        raise argparse.ArgumentTypeError(f"must be >= 1, got {v}")
+    return v
 
 
 def _to_glm_data(data, shard_id: str) -> GLMData:
@@ -88,6 +100,70 @@ def _to_glm_data(data, shard_id: str) -> GLMData:
     return GLMData(design=design, labels=jnp.asarray(data.labels),
                    offsets=jnp.asarray(data.offsets),
                    weights=jnp.asarray(data.weights))
+
+
+def _run_diagnostics(args, task, best, glm_train, glm_val, shard, stats, imap,
+                     config, normalization, reg_mask, run_logger) -> str:
+    """The reference driver's DIAGNOSED stage (``--training-diagnostics``):
+    bootstrap CIs, Hosmer-Lemeshow (logistic only), feature importance, and
+    the fitting curve, written as ``diagnostics/report.html``."""
+    from photon_ml_tpu.diagnostics import (
+        bootstrap_coefficients,
+        expected_magnitude_importance,
+        fitting_curve,
+        hosmer_lemeshow,
+        variance_importance,
+        write_report,
+    )
+    from photon_ml_tpu.glm.training import build_problem
+
+    problem = build_problem(task, config, normalization, reg_mask)
+    lam = best.regularization_weight
+    w_t = best.result.w  # transformed-space solution from the sweep
+
+    # replicate solutions live in transformed (normalized) space; report CIs
+    # in original feature space to match the published model coefficients
+    transform = (None if normalization.is_identity
+                 else normalization.model_to_original)
+    boot = bootstrap_coefficients(
+        problem, glm_train, w_t, lam,
+        n_replicates=args.diagnostic_bootstrap_replicates,
+        transform=transform)
+
+    hl = None
+    if task == TaskType.LOGISTIC_REGRESSION:
+        ev_data = glm_val if glm_val is not None else glm_train
+        probs = np.asarray(best.model.predict_mean(ev_data.design,
+                                                   ev_data.offsets))
+        hl = hosmer_lemeshow(probs, np.asarray(ev_data.labels),
+                             np.asarray(ev_data.weights))
+        run_logger.metric(stage="diagnostics", hl_chi_square=hl.chi_square,
+                          hl_p_value=hl.p_value)
+
+    if stats is None:
+        stats = FeatureDataStatistics.from_shard(shard)
+    names = imap.names()
+    coefs = np.asarray(best.model.coefficients.means)
+    importance = [variance_importance(coefs, stats, names=names),
+                  expected_magnitude_importance(coefs, stats, names=names)]
+
+    fitting = None
+    if glm_val is not None:
+        # warm-start every portion from the trained solution (portion optima
+        # are near it; solves still run to their own convergence)
+        fitting = fitting_curve(problem, glm_train, glm_val, w_t, lam)
+
+    return write_report(
+        os.path.join(args.output_dir, "diagnostics", "report.html"),
+        model_summary={
+            "task": task.value,
+            "best lambda": lam,
+            "optimizer": config.optimizer.value,
+            "iterations": int(best.result.iterations),
+            "converged": bool(best.result.converged),
+        },
+        bootstrap=boot, hosmer_lemeshow=hl, importance=importance,
+        fitting=fitting, feature_names=names)
 
 
 def run(argv: Optional[Sequence[str]] = None) -> dict:
@@ -114,6 +190,7 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
         shard = data.shards["global"]
         norm_type = NormalizationType(args.normalization)
         normalization = NoNormalization
+        stats = None
         if norm_type != NormalizationType.NONE or args.summarization_output:
             with timed("Summarize features", run_logger):
                 stats = FeatureDataStatistics.from_shard(shard)
@@ -160,13 +237,17 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                               converged=bool(tm.result.converged))
 
         best_idx = 0
-        if args.validation_data and evaluators:
+        glm_val = None
+        # diagnostics need validation data too (fitting curve, out-of-sample
+        # HL), so read it even when no evaluators are configured
+        if args.validation_data and (evaluators or args.training_diagnostics):
             reader_v = AvroDataReader(shard_configs=reader.shard_configs,
                                       index_maps=index_maps)
             with timed("Read validation data", run_logger):
                 vdata, _, _ = reader_v.read(args.validation_data,
                                             id_columns=id_columns)
             glm_val = _to_glm_data(vdata, "global")
+        if glm_val is not None and evaluators:
             with timed("Validate models", run_logger):
                 best_idx, trained = validate_and_select(
                     trained, evaluators, glm_val,
@@ -189,11 +270,20 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                                  "model.avro"),
                     tm.model, imap,
                     model_id=f"lambda-{tm.regularization_weight:g}")
+        report_path = None
+        if args.training_diagnostics:
+            # the DIAGNOSED stage of the reference driver's state machine
+            with timed("Diagnostics", run_logger):
+                report_path = _run_diagnostics(
+                    args, task, best, glm_train, glm_val, shard, stats, imap,
+                    config, normalization, reg_mask, run_logger)
+
         return {
             "best_lambda": best.regularization_weight,
             "best_evaluation": (best.evaluation.as_dict()
                                 if best.evaluation else None),
             "output_dir": args.output_dir,
+            "diagnostics_report": report_path,
         }
     finally:
         run_logger.close()

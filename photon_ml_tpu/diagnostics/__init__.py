@@ -1,0 +1,32 @@
+"""Training diagnostics (reference ``photon-client/.../diagnostics/``):
+bootstrap coefficient CIs, Hosmer–Lemeshow calibration, feature importance,
+fitting curves, and the HTML report writer."""
+
+from photon_ml_tpu.diagnostics.bootstrap import (
+    BootstrapReport,
+    bootstrap_coefficients,
+    bootstrap_weights,
+)
+from photon_ml_tpu.diagnostics.fitting import FittingReport, fitting_curve
+from photon_ml_tpu.diagnostics.hl import HosmerLemeshowReport, hosmer_lemeshow
+from photon_ml_tpu.diagnostics.importance import (
+    FeatureImportanceReport,
+    expected_magnitude_importance,
+    variance_importance,
+)
+from photon_ml_tpu.diagnostics.reporting import render_report, write_report
+
+__all__ = [
+    "BootstrapReport",
+    "bootstrap_coefficients",
+    "bootstrap_weights",
+    "FittingReport",
+    "fitting_curve",
+    "HosmerLemeshowReport",
+    "hosmer_lemeshow",
+    "FeatureImportanceReport",
+    "expected_magnitude_importance",
+    "variance_importance",
+    "render_report",
+    "write_report",
+]

@@ -44,6 +44,20 @@ class TrainedModel:
     evaluation: Optional[EvaluationResults] = None
 
 
+def build_problem(
+    task: TaskType,
+    config: GLMOptimizationConfiguration,
+    normalization: NormalizationContext = NoNormalization,
+    reg_mask: Optional[Array] = None,
+) -> OptimizationProblem:
+    """The one place the sweep's optimization problem is assembled — shared
+    with the diagnostics stage so bootstrap/fitting solves diagnose exactly
+    the objective that trained the model."""
+    objective = GLMObjective(
+        loss=loss_for_task(task), normalization=normalization, reg_mask=reg_mask)
+    return OptimizationProblem(objective, config)
+
+
 def train_glm_sweep(
     task: TaskType,
     data: GLMData,
@@ -63,9 +77,7 @@ def train_glm_sweep(
     """
     for lam in regularization_weights:
         config.regularization.check_weight(lam)
-    objective = GLMObjective(
-        loss=loss_for_task(task), normalization=normalization, reg_mask=reg_mask)
-    problem = OptimizationProblem(objective, config)
+    problem = build_problem(task, config, normalization, reg_mask)
 
     run = jax.jit(problem.run)
     w = jnp.zeros((data.dim,)) if initial is None else jnp.asarray(initial)

@@ -105,6 +105,27 @@ class TestTrainGlmDriver:
         # fixed effect alone on this data should clear AUC 0.6 easily
         assert result["best_evaluation"]["AUC"] > 0.6
 
+    def test_training_diagnostics(self, tmp_path):
+        train = make_avro_dataset(tmp_path / "train.avro", n=500, seed=0)
+        val = make_avro_dataset(tmp_path / "val.avro", n=300, seed=1)
+        out = str(tmp_path / "out")
+        # no --evaluators: validation data must still feed the diagnostics
+        # (fitting curve + out-of-sample HL); normalization exercises the
+        # transformed->original bootstrap reporting path
+        result = train_glm_cli.run([
+            "--training-data", train, "--validation-data", val,
+            "--output-dir", out,
+            "--regularization-weights", "1",
+            "--normalization", "STANDARDIZATION",
+            "--training-diagnostics",
+            "--diagnostic-bootstrap-replicates", "6",
+        ])
+        path = result["diagnostics_report"]
+        assert path and os.path.exists(path)
+        doc = open(path).read()
+        for section in ("Bootstrap", "Hosmer", "importance", "Fitting curve"):
+            assert section in doc
+
     def test_elastic_net_owlqn(self, tmp_path):
         train = make_avro_dataset(tmp_path / "train.avro", n=400)
         out = str(tmp_path / "out")

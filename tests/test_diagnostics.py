@@ -1,0 +1,197 @@
+"""Diagnostics subsystem tests (reference ``photon-client/.../diagnostics/``):
+bootstrap CIs cover the truth, Hosmer–Lemeshow separates calibrated from
+miscalibrated models, importance ranks dominant features first, fitting
+curves shrink the generalization gap with more data, and the HTML report
+renders every section."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.diagnostics import (
+    bootstrap_coefficients,
+    bootstrap_weights,
+    expected_magnitude_importance,
+    fitting_curve,
+    hosmer_lemeshow,
+    render_report,
+    variance_importance,
+    write_report,
+)
+from photon_ml_tpu.glm.problem import (
+    GLMOptimizationConfiguration,
+    OptimizationProblem,
+)
+from photon_ml_tpu.ops.design import DenseDesign
+from photon_ml_tpu.ops.losses import loss_for_task
+from photon_ml_tpu.ops.objective import GLMData, GLMObjective
+from photon_ml_tpu.optimize import OptimizerConfig
+from photon_ml_tpu.stat import FeatureDataStatistics
+from photon_ml_tpu.game.data import FeatureShard
+from photon_ml_tpu.types import TaskType
+
+
+def _logistic_data(n=400, d=4, seed=0, w_true=None):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d))
+    if w_true is None:
+        w_true = np.linspace(1.5, -1.5, d)
+    p = 1.0 / (1.0 + np.exp(-(x @ w_true)))
+    y = (rng.uniform(size=n) < p).astype(np.float64)
+    data = GLMData(design=DenseDesign(x=jnp.asarray(x)),
+                   labels=jnp.asarray(y),
+                   offsets=jnp.zeros(n),
+                   weights=jnp.ones(n))
+    return data, w_true
+
+
+def _problem():
+    obj = GLMObjective(loss=loss_for_task(TaskType.LOGISTIC_REGRESSION))
+    cfg = GLMOptimizationConfiguration(
+        optimizer_config=OptimizerConfig(max_iterations=60, tolerance=1e-8))
+    return OptimizationProblem(obj, cfg)
+
+
+class TestBootstrap:
+    def test_weights_preserve_total_mass(self):
+        base = jnp.ones(50)
+        w = bootstrap_weights(jax.random.PRNGKey(0), base, n_replicates=8)
+        assert w.shape == (8, 50)
+        # each replicate draws exactly n samples
+        np.testing.assert_allclose(np.asarray(w).sum(axis=1), 50.0)
+
+    def test_padding_rows_get_zero_weight(self):
+        base = jnp.asarray([1.0, 1.0, 0.0, 1.0, 0.0])
+        w = np.asarray(bootstrap_weights(jax.random.PRNGKey(1), base, 16))
+        assert (w[:, 2] == 0).all() and (w[:, 4] == 0).all()
+
+    def test_transform_maps_report_space(self):
+        data, _ = _logistic_data(n=300)
+        problem = _problem()
+        w_hat = problem.run(data, jnp.zeros(4), 1e-3).w
+        key = jax.random.PRNGKey(5)
+        plain = bootstrap_coefficients(problem, data, w_hat, 1e-3,
+                                       n_replicates=4, key=key)
+        scaled = bootstrap_coefficients(problem, data, w_hat, 1e-3,
+                                        n_replicates=4, key=key,
+                                        transform=lambda w: 2.0 * w)
+        np.testing.assert_allclose(scaled.mean, 2.0 * plain.mean, rtol=1e-6)
+        np.testing.assert_allclose(scaled.ci_upper, 2.0 * plain.ci_upper,
+                                   rtol=1e-6)
+
+    def test_ci_covers_truth_and_sign_stability(self):
+        data, w_true = _logistic_data(n=600)
+        problem = _problem()
+        w_hat = problem.run(data, jnp.zeros(4), 1e-3).w
+        rep = bootstrap_coefficients(problem, data, w_hat, lam=1e-3,
+                                     n_replicates=24,
+                                     key=jax.random.PRNGKey(3))
+        assert rep.coefficients.shape == (24, 4)
+        # strong features: CI excludes zero and covers the truth
+        covered = (rep.ci_lower <= w_true) & (w_true <= rep.ci_upper)
+        assert covered.sum() >= 3
+        assert rep.sign_stability[0] > 0.9  # strongest coefficient is stable
+        assert not rep.zero_crossing()[0]
+
+
+class TestHosmerLemeshow:
+    def test_calibrated_model_passes(self):
+        rng = np.random.default_rng(0)
+        p = rng.uniform(0.05, 0.95, size=4000)
+        y = (rng.uniform(size=4000) < p).astype(np.float64)
+        rep = hosmer_lemeshow(p, y)
+        assert rep.degrees_of_freedom == 8
+        assert rep.p_value > 0.05
+        assert rep.well_calibrated()
+        np.testing.assert_allclose(rep.bin_counts.sum(), 4000.0)
+
+    def test_miscalibrated_model_fails(self):
+        rng = np.random.default_rng(1)
+        p = rng.uniform(0.05, 0.95, size=4000)
+        y = (rng.uniform(size=4000) < np.clip(p + 0.25, 0, 1)).astype(np.float64)
+        rep = hosmer_lemeshow(p, y)
+        assert rep.p_value < 0.01
+        assert not rep.well_calibrated()
+
+    def test_weighted_padding_ignored(self):
+        rng = np.random.default_rng(2)
+        p = rng.uniform(0.1, 0.9, size=1000)
+        y = (rng.uniform(size=1000) < p).astype(np.float64)
+        w = np.ones(1000)
+        # duplicate with garbage rows at weight 0
+        p2 = np.concatenate([p, np.full(100, 0.999)])
+        y2 = np.concatenate([y, np.zeros(100)])
+        w2 = np.concatenate([w, np.zeros(100)])
+        a = hosmer_lemeshow(p, y, w)
+        b = hosmer_lemeshow(p2, y2, w2)
+        assert abs(a.chi_square - b.chi_square) < 1e-6
+
+
+class TestImportance:
+    def _stats(self):
+        rng = np.random.default_rng(0)
+        rows = np.repeat(np.arange(100), 3)
+        cols = rng.integers(0, 5, size=300).astype(np.int64)
+        vals = rng.normal(size=300)
+        shard = FeatureShard.from_coo(rows, cols, vals, 100, 5)
+        return FeatureDataStatistics.from_shard(shard)
+
+    def test_variance_ranking_tracks_weight_magnitude(self):
+        stats = self._stats()
+        w = np.array([0.01, 5.0, 0.02, 0.01, 0.03])
+        rep = variance_importance(w, stats, names=[f"f{i}" for i in range(5)])
+        assert rep.names[0] == "f1"
+        assert rep.importance[0] >= rep.importance[-1]
+
+    def test_expected_magnitude_nonnegative_and_sorted(self):
+        stats = self._stats()
+        w = np.array([0.5, -2.0, 0.0, 1.0, -0.1])
+        rep = expected_magnitude_importance(w, stats)
+        assert (rep.importance >= 0).all()
+        assert (np.diff(rep.importance) <= 1e-12).all()
+        assert rep.importance[-1] == 0.0  # zero coefficient -> zero importance
+
+
+class TestFittingCurve:
+    def test_more_data_shrinks_gap(self):
+        train, _ = _logistic_data(n=800, seed=4)
+        val, _ = _logistic_data(n=800, seed=5)
+        rep = fitting_curve(_problem(), train, val, jnp.zeros(4), lam=1e-3,
+                            portions=(0.1, 0.5, 1.0))
+        assert rep.portions.shape == (3,)
+        gaps = rep.generalization_gap()
+        # the gap at full data is below the tiny-portion gap
+        assert gaps[-1] <= gaps[0] + 1e-6
+        assert np.isfinite(rep.train_objective).all()
+        assert np.isfinite(rep.validation_objective).all()
+
+
+class TestReport:
+    def test_render_all_sections(self, tmp_path):
+        train, _ = _logistic_data(n=300, seed=8)
+        val, _ = _logistic_data(n=300, seed=9)
+        problem = _problem()
+        w = problem.run(train, jnp.zeros(4), 1e-3).w
+        boot = bootstrap_coefficients(problem, train, w, 1e-3, n_replicates=8)
+        probs = np.asarray(jax.nn.sigmoid(train.design.x @ w))
+        hl = hosmer_lemeshow(probs, np.asarray(train.labels))
+        rows = np.repeat(np.arange(300), 2)
+        shard = FeatureShard.from_coo(
+            rows, np.tile(np.arange(2), 300).astype(np.int64),
+            np.asarray(train.design.x[:, :2]).ravel(), 300, 4)
+        stats = FeatureDataStatistics.from_shard(shard)
+        imp = variance_importance(np.asarray(w), stats,
+                                  names=[f"f{i}" for i in range(4)])
+        fit = fitting_curve(problem, train, val, jnp.zeros(4), 1e-3,
+                            portions=(0.5, 1.0))
+        doc = render_report(model_summary={"task": "LOGISTIC_REGRESSION"},
+                            bootstrap=boot, hosmer_lemeshow=hl,
+                            importance=[imp], fitting=fit,
+                            feature_names=[f"f{i}" for i in range(4)])
+        for section in ("Bootstrap", "Hosmer", "importance", "Fitting curve",
+                        "<svg"):
+            assert section in doc
+        path = write_report(str(tmp_path / "diag" / "report.html"),
+                            model_summary={"task": "x"}, fitting=fit)
+        assert (tmp_path / "diag" / "report.html").exists()
