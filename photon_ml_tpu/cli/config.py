@@ -15,6 +15,7 @@ semantic fields with an explicit, documented syntax:
     coordId=fixed,shard=global,optimizer=LBFGS,reg=L2,maxIter=80,tol=1e-6
     coordId=random,entity=userId,shard=user,reg=L2,activeUpper=1000,
            activeLower=1,maxFeatures=500
+    coordId=random,entity=userId,shard=user,projector=RANDOM,projectedDim=64
 
 **Regularization weights** (``--grid``)::
 
@@ -30,6 +31,7 @@ import itertools
 from typing import Mapping, Sequence
 
 from photon_ml_tpu.game.data import RandomEffectDatasetConfig
+from photon_ml_tpu.game.projector import ProjectorType
 from photon_ml_tpu.game.estimator import (
     FixedEffectCoordinateConfig,
     RandomEffectCoordinateConfig,
@@ -124,6 +126,10 @@ def parse_coordinate_config(spec: str):
             active_data_lower_bound=int(kv.pop("activeLower", 1)),
             max_active_features=(int(kv.pop("maxFeatures"))
                                  if "maxFeatures" in kv else None),
+            projector_type=ProjectorType(kv.pop("projector",
+                                                "INDEX_MAP").upper()),
+            projected_dim=(int(kv.pop("projectedDim"))
+                           if "projectedDim" in kv else None),
         )
         cfg = RandomEffectCoordinateConfig(
             dataset=ds, optimization=_optimization(kv))

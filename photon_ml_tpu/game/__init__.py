@@ -14,6 +14,10 @@ from photon_ml_tpu.game.data import (  # noqa: F401
     RandomEffectDataset,
     RandomEffectDatasetConfig,
 )
+from photon_ml_tpu.game.projector import (  # noqa: F401
+    ProjectorType,
+    RandomProjector,
+)
 from photon_ml_tpu.game.model import (  # noqa: F401
     FixedEffectModel,
     GameModel,

@@ -33,6 +33,7 @@ from typing import Optional
 import jax.numpy as jnp
 import numpy as np
 
+from photon_ml_tpu.game.projector import ProjectorType, RandomProjector
 from photon_ml_tpu.ops.design import CsrDesign, DenseDesign
 from photon_ml_tpu.ops.objective import GLMData
 from photon_ml_tpu.util import group_starts as _group_starts
@@ -220,6 +221,12 @@ class RandomEffectDatasetConfig:
     #: cap on per-entity features kept (by within-entity support, ties by id;
     #: reference LocalDataset feature pruning). None = all observed.
     max_active_features: Optional[int] = None
+    #: feature-space projector (reference ``projector/ProjectorType.scala``):
+    #: INDEX_MAP compacts each entity's observed features (default);
+    #: RANDOM projects through a shared Gaussian matrix of width
+    #: ``projected_dim`` (reference ``RandomProjection``).
+    projector_type: ProjectorType = ProjectorType.INDEX_MAP
+    projected_dim: Optional[int] = None
     #: bucket shape granularity: per-entity sample/feature counts are padded
     #: up to powers of these growth factors. Every distinct padded
     #: (samples, features) shape is a separate XLA compilation of the
@@ -284,6 +291,9 @@ class RandomEffectDataset:
     passive_sample_idx: np.ndarray  # (p,) int64
     passive_entity_ids: np.ndarray  # (p,) int64
     n_entities_total: int
+    #: set when config.projector_type is RANDOM; buckets then hold projected
+    #: features and models train in the projected space.
+    projector: Optional[RandomProjector] = None
 
     @property
     def n_active_entities(self) -> int:
@@ -325,6 +335,21 @@ class RandomEffectDataset:
             act_entity.append(int(e))
         passive = (np.concatenate(passive_rows) if passive_rows
                    else np.zeros((0,), np.int64))
+
+        n_entities_total = int(entities.max()) + 1 if n and present.any() else 0
+
+        if config.projector_type is ProjectorType.RANDOM:
+            if config.projected_dim is None:
+                raise ValueError("RANDOM projector requires projected_dim")
+            projector = RandomProjector.build(
+                shard.dim, config.projected_dim, config.seed)
+            buckets = _random_projection_buckets(
+                data, shard, active_rows, act_entity, projector, config)
+            return RandomEffectDataset(
+                coordinate_id=coordinate_id, config=config, buckets=buckets,
+                passive_sample_idx=passive,
+                passive_entity_ids=entities[passive],
+                n_entities_total=n_entities_total, projector=projector)
 
         # --- per-entity local feature maps --------------------------------
         # For each active entity: observed shard features (optionally pruned
@@ -385,9 +410,6 @@ class RandomEffectDataset:
                 D = int(d_pad[sel[0]])
                 E = len(sel)
                 x = np.zeros((E, S, D), np.float32)
-                labels = np.zeros((E, S), np.float32)
-                weights = np.zeros((E, S), np.float32)
-                sample_idx = np.full((E, S), -1, np.int64)
                 feature_index = np.full((E, D), -1, np.int64)
 
                 slot_of_entity = np.full(len(active_rows), -1, np.int64)
@@ -399,17 +421,9 @@ class RandomEffectDataset:
                 feature_index[pe, local_idx[sel_pairs]] = pair_feat[sel_pairs]
 
                 # samples: rows of these entities, slot position within entity
-                ent_mask = np.isin(ent_of_active, sel)
-                rows_sel = np.flatnonzero(ent_mask)
-                ent_rows = ent_of_active[rows_sel]
-                row_starts = _group_starts(ent_rows)
-                row_counts = np.diff(np.append(row_starts, len(ent_rows)))
-                pos = np.arange(len(ent_rows)) - np.repeat(row_starts, row_counts)
-                es = slot_of_entity[ent_rows]
-                g = all_active[rows_sel]
-                labels[es, pos] = data.labels[g]
-                weights[es, pos] = data.weights[g]
-                sample_idx[es, pos] = g
+                labels, weights, sample_idx, rows_sel, pos, es = \
+                    _bucket_sample_fill(data, all_active, ent_of_active,
+                                        slot_of_entity, sel, S)
 
                 # nnz values into local dense tensor
                 nnz_sel = np.isin(nnz_ent, sel) & (local_idx[pair_inv] >= 0)
@@ -427,11 +441,87 @@ class RandomEffectDataset:
                     x=x, labels=labels, offsets_zero=True, weights=weights,
                     sample_idx=sample_idx, feature_index=feature_index))
 
-        n_entities_total = int(entities.max()) + 1 if n and present.any() else 0
         return RandomEffectDataset(
             coordinate_id=coordinate_id, config=config, buckets=buckets,
             passive_sample_idx=passive,
             passive_entity_ids=entities[passive],
             n_entities_total=n_entities_total)
+
+
+def _bucket_sample_fill(
+    data: GameData,
+    all_active: np.ndarray,
+    ent_of_active: np.ndarray,
+    slot_of_entity: np.ndarray,
+    sel: np.ndarray,
+    n_slots: int,
+):
+    """Scatter the selected entities' rows into bucket sample slots.
+
+    Shared by the INDEX_MAP and RANDOM bucket builders. Returns
+    ``(labels, weights, sample_idx, rows_sel, pos, es)`` where ``rows_sel``
+    indexes ``all_active``, ``pos`` is each row's slot within its entity and
+    ``es`` its entity's bucket lane.
+    """
+    e = len(sel)
+    labels = np.zeros((e, n_slots), np.float32)
+    weights = np.zeros((e, n_slots), np.float32)
+    sample_idx = np.full((e, n_slots), -1, np.int64)
+    rows_sel = np.flatnonzero(np.isin(ent_of_active, sel))
+    ent_rows = ent_of_active[rows_sel]
+    row_starts = _group_starts(ent_rows)
+    row_counts = np.diff(np.append(row_starts, len(ent_rows)))
+    pos = np.arange(len(ent_rows)) - np.repeat(row_starts, row_counts)
+    es = slot_of_entity[ent_rows]
+    g = all_active[rows_sel]
+    labels[es, pos] = data.labels[g]
+    weights[es, pos] = data.weights[g]
+    sample_idx[es, pos] = g
+    return labels, weights, sample_idx, rows_sel, pos, es
+
+
+def _random_projection_buckets(
+    data: GameData,
+    shard: FeatureShard,
+    active_rows: list[np.ndarray],
+    act_entity: list[int],
+    projector: RandomProjector,
+    config: RandomEffectDatasetConfig,
+) -> list[REBucket]:
+    """Fixed-shape buckets in the shared projected space.
+
+    Every entity shares the feature dim (``projected_dim``), so entities
+    bucket by padded sample count only; ``feature_index`` is the identity
+    into the projected space — model keys live there until
+    ``RandomEffectModel.to_shard_space`` back-projects for export.
+    """
+    buckets: list[REBucket] = []
+    if not active_rows:
+        return buckets
+    all_active = np.concatenate(active_rows)
+    ent_of_active = np.concatenate([
+        np.full(len(r), i, np.int64) for i, r in enumerate(active_rows)])
+    sub = shard.take(all_active)
+    z = projector.project_rows(sub.cols, sub.vals, sub.rows(), len(all_active))
+    d = projector.projected_dim
+    n_samp = np.array([len(r) for r in active_rows], np.int64)
+    s_pad = _geom_at_least(n_samp, config.sample_bucket_growth)
+    for s_key in np.unique(s_pad):
+        sel = np.flatnonzero(s_pad == s_key)
+        S, E = int(s_key), len(sel)
+        x = np.zeros((E, S, d), np.float32)
+        feature_index = np.tile(np.arange(d, dtype=np.int64), (E, 1))
+
+        slot_of_entity = np.full(len(active_rows), -1, np.int64)
+        slot_of_entity[sel] = np.arange(E)
+        labels, weights, sample_idx, rows_sel, pos, es = _bucket_sample_fill(
+            data, all_active, ent_of_active, slot_of_entity, sel, S)
+        x[es, pos, :] = z[rows_sel]
+
+        buckets.append(REBucket(
+            entity_ids=np.array([act_entity[i] for i in sel], np.int64),
+            x=x, labels=labels, offsets_zero=True, weights=weights,
+            sample_idx=sample_idx, feature_index=feature_index))
+    return buckets
 
 

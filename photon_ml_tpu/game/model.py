@@ -24,6 +24,7 @@ from typing import Mapping, Optional
 import numpy as np
 
 from photon_ml_tpu.game.data import FeatureShard, GameData
+from photon_ml_tpu.game.projector import RandomProjector
 from photon_ml_tpu.models.glm import GeneralizedLinearModel
 from photon_ml_tpu.types import TaskType
 
@@ -55,15 +56,23 @@ class RandomEffectModel:
     table score 0 (the reference's behavior for entities dropped by the
     active-data lower bound). ``variances`` is optional, aligned with
     ``coeffs``.
+
+    With a ``projector`` (reference ``projector/RandomProjection.scala``),
+    the table lives in the projected space: ``dim`` is the projected dim,
+    feature ids index projected coordinates, and scoring projects shard
+    features through the shared matrix first. ``to_shard_space`` exports the
+    equivalent original-space model (reference behavior: models projected
+    back after training).
     """
 
     random_effect_type: str
     feature_shard_id: str
     task: TaskType
-    dim: int  # shard vocabulary size
+    dim: int  # key modulus: shard vocabulary size, or projected dim
     keys: np.ndarray  # (k,) int64, sorted
     coeffs: np.ndarray  # (k,) float32
     variances: Optional[np.ndarray] = None
+    projector: Optional["RandomProjector"] = None
 
     @property
     def n_entities(self) -> int:
@@ -98,6 +107,8 @@ class RandomEffectModel:
         if sample_idx is not None:
             shard = shard.take(sample_idx)
             entities = entities[sample_idx]
+        if self.projector is not None:
+            return self._score_projected(shard, entities)
         rows = shard.rows()
         ent_per_nnz = entities[rows]
         valid = ent_per_nnz >= 0
@@ -107,6 +118,53 @@ class RandomEffectModel:
         out = np.zeros(shard.n_samples, np.float64)
         np.add.at(out, rows, shard.vals.astype(np.float64) * w)
         return out.astype(np.float32)
+
+    def _score_projected(self, shard: FeatureShard,
+                         entities: np.ndarray) -> np.ndarray:
+        """Margin v·(Px) per sample: project features to the shared space
+        (dense MXU-friendly block), then join per-entity coefficients."""
+        z = self.projector.project_rows(
+            shard.cols, shard.vals, shard.rows(), shard.n_samples)
+        valid = np.flatnonzero(entities >= 0)
+        out = np.zeros(shard.n_samples, np.float32)
+        if len(valid):
+            d = self.dim
+            # coefficient table per *unique* entity, then gather per sample —
+            # O(u·d) lookups instead of O(n·d)
+            uniq, inv = np.unique(entities[valid], return_inverse=True)
+            ent = np.repeat(uniq, d)
+            feat = np.tile(np.arange(d, dtype=np.int64), len(uniq))
+            table = self.lookup(ent, feat).reshape(len(uniq), d)
+            out[valid] = np.einsum("nd,nd->n", z[valid], table[inv])
+        return out
+
+    def to_shard_space(self) -> "RandomEffectModel":
+        """Back-project a RANDOM-projected model to original feature space
+        (``w = Pᵀ v`` — exact for scoring since margins are linear). The
+        result is dense per entity; used for Avro export parity."""
+        if self.projector is None:
+            return self
+        p = self.projector
+        d, full = p.projected_dim, p.shard_dim
+        if not len(self.keys):
+            return dataclasses.replace(self, dim=full, projector=None)
+        ent = np.unique(self.keys // d)
+        v = np.zeros((len(ent), d), np.float32)
+        pos = np.searchsorted(ent, self.keys // d)
+        v[pos, self.keys % d] = self.coeffs
+        w = p.project_back(v)
+        keys = (ent[:, None] * np.int64(full)
+                + np.arange(full, dtype=np.int64)).ravel()
+        variances = None
+        if self.variances is not None:
+            var_v = np.zeros((len(ent), d), np.float32)
+            var_v[pos, self.keys % d] = self.variances
+            variances = p.project_back_variances(var_v).ravel()
+        return RandomEffectModel(
+            random_effect_type=self.random_effect_type,
+            feature_shard_id=self.feature_shard_id, task=self.task,
+            dim=full, keys=keys, coeffs=w.ravel().astype(np.float32),
+            variances=variances, projector=None)
 
 
 @dataclasses.dataclass(frozen=True)

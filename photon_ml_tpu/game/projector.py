@@ -1,0 +1,98 @@
+"""Random-effect feature-space projectors.
+
+Re-design of the reference's projection layer
+(``photon-api/.../projector/{Projector, ProjectionMatrix,
+ProjectionMatrixBroadcast, IndexMapProjector, RandomProjection,
+LinearSubspaceProjector}.scala`` + ``projector/ProjectorType.scala``), which
+shrinks each per-entity solve to a small feature space:
+
+- **INDEX_MAP** — each entity's observed shard features are compacted to a
+  dense local index range (the reference's ``IndexMapProjector`` /
+  ``LinearSubspaceProjector``). This is the default and is implemented
+  directly inside the bucket build in :mod:`photon_ml_tpu.game.data` — the
+  bucket's ``feature_index`` IS the projection map.
+- **RANDOM** — one shared Gaussian Johnson–Lindenstrauss matrix ``P``
+  (``projected_dim × shard_dim``) projects every entity's features into a
+  common low-dimensional space (the reference's ``RandomProjection`` with the
+  matrix broadcast to executors via ``ProjectionMatrixBroadcast``; here it is
+  simply a host array closed over by the jitted solve). Training happens on
+  ``z = P x``; because margins are linear, the learned ``v`` is exactly
+  equivalent to shard-space coefficients ``w = Pᵀ v``, which is how models
+  are "projected back after training" for output parity.
+
+TPU-first departure: the reference projects models back to the original
+space immediately after training. We keep projected-space models live
+(scoring projects features on the fly — a dense ``(rows, projected_dim)``
+matmul that maps straight onto the MXU) and only materialize the
+back-projection when exporting to the reference's Avro layout
+(:func:`RandomEffectModel.to_shard_space`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+import numpy as np
+
+
+class ProjectorType(str, enum.Enum):
+    """Reference ``projector/ProjectorType.scala``."""
+
+    INDEX_MAP = "INDEX_MAP"
+    RANDOM = "RANDOM"
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomProjector:
+    """Shared Gaussian projection ``P`` with JL scaling 1/sqrt(projected_dim).
+
+    The same matrix serves every entity of the coordinate (reference
+    ``ProjectionMatrixBroadcast``: one matrix broadcast cluster-wide).
+    """
+
+    matrix: np.ndarray  # (projected_dim, shard_dim) float32
+
+    @property
+    def projected_dim(self) -> int:
+        return int(self.matrix.shape[0])
+
+    @property
+    def shard_dim(self) -> int:
+        return int(self.matrix.shape[1])
+
+    @staticmethod
+    def build(shard_dim: int, projected_dim: int, seed: int) -> "RandomProjector":
+        if projected_dim <= 0 or projected_dim > shard_dim:
+            raise ValueError(
+                f"projected_dim must be in [1, shard_dim={shard_dim}], "
+                f"got {projected_dim}")
+        rng = np.random.default_rng(seed)
+        m = rng.normal(size=(projected_dim, shard_dim)).astype(np.float32)
+        m /= np.float32(np.sqrt(projected_dim))
+        return RandomProjector(matrix=m)
+
+    def project_rows(self, cols: np.ndarray, vals: np.ndarray,
+                     rows: np.ndarray, n_rows: int) -> np.ndarray:
+        """Dense projected features ``Z = X Pᵀ`` from COO parts.
+
+        ``rows/cols/vals`` are the CSR triplets of the rows being projected
+        (rows already renumbered 0..n_rows-1). One scatter-accumulated
+        outer-product pass — no shard-dim dense intermediate.
+        """
+        z = np.zeros((n_rows, self.projected_dim), np.float32)
+        if len(cols):
+            contrib = vals[:, None].astype(np.float32) * self.matrix.T[cols]
+            np.add.at(z, rows, contrib)
+        return z
+
+    def project_back(self, v: np.ndarray) -> np.ndarray:
+        """Shard-space coefficients ``w = Pᵀ v`` (exact for scoring:
+        ``w·x = v·Px``). Works on ``(..., projected_dim)`` batches."""
+        return np.asarray(v, np.float32) @ self.matrix
+
+    def project_back_variances(self, var: np.ndarray) -> np.ndarray:
+        """Approximate shard-space variances ``var_w = (P²)ᵀ var_v``
+        (exact under an independent-coordinate posterior; same caveat as the
+        reference's projected-space variance output)."""
+        return np.asarray(var, np.float32) @ (self.matrix ** 2)

@@ -89,7 +89,11 @@ class RandomEffectSolver:
         sample (0 elsewhere — passive scoring is the model's job).
         """
         cfg = dataset.config
-        shard_dim = dim if dim is not None else _shard_dim(dataset)
+        if dataset.projector is not None:
+            # projected space: keys/coefficients live in projected_dim
+            shard_dim = dataset.projector.projected_dim
+        else:
+            shard_dim = dim if dim is not None else _shard_dim(dataset)
         keys_parts: list[np.ndarray] = []
         coef_parts: list[np.ndarray] = []
         var_parts: list[np.ndarray] = []
@@ -132,7 +136,8 @@ class RandomEffectSolver:
             feature_shard_id=cfg.feature_shard_id,
             task=self.task, dim=shard_dim, keys=keys[order],
             coeffs=coeffs[order],
-            variances=None if variances is None else variances[order])
+            variances=None if variances is None else variances[order],
+            projector=dataset.projector)
         return model, scores
 
 

@@ -24,6 +24,7 @@ from typing import Optional
 import numpy as np
 
 from photon_ml_tpu.game.model import FixedEffectModel, GameModel, RandomEffectModel
+from photon_ml_tpu.game.projector import RandomProjector
 from photon_ml_tpu.models.coefficients import Coefficients
 from photon_ml_tpu.models.glm import GeneralizedLinearModel
 from photon_ml_tpu.types import TaskType
@@ -94,11 +95,14 @@ class CheckpointManager:
                 manifest["coordinates"][cid] = {
                     "type": "random", "featureShardId": cm.feature_shard_id,
                     "randomEffectType": cm.random_effect_type, "dim": cm.dim,
-                    "has_variances": cm.variances is not None}
+                    "has_variances": cm.variances is not None,
+                    "has_projector": cm.projector is not None}
                 arrays[f"re:{cid}:keys"] = cm.keys
                 arrays[f"re:{cid}:coeffs"] = cm.coeffs
                 if cm.variances is not None:
                     arrays[f"re:{cid}:variances"] = cm.variances
+                if cm.projector is not None:
+                    arrays[f"re:{cid}:projector"] = cm.projector.matrix
         for cid, sc in state.scores.items():
             arrays[f"scores:{cid}"] = sc
 
@@ -141,7 +145,10 @@ class CheckpointManager:
                     dim=info["dim"], keys=arrays[f"re:{cid}:keys"],
                     coeffs=arrays[f"re:{cid}:coeffs"],
                     variances=(arrays[f"re:{cid}:variances"]
-                               if info["has_variances"] else None))
+                               if info["has_variances"] else None),
+                    projector=(RandomProjector(
+                        matrix=arrays[f"re:{cid}:projector"])
+                        if info.get("has_projector") else None))
         scores = {k.split(":", 1)[1]: arrays[k]
                   for k in arrays.files if k.startswith("scores:")}
         return CoordinateDescentState(

@@ -139,26 +139,49 @@ def save_game_model(
 def _re_records(model: RandomEffectModel, index_map: IndexMap,
                 reverse_vocab: dict[int, str],
                 sparsity_threshold: float) -> Iterator[dict]:
+    """Per-entity ``BayesianLinearModelAvro`` records.
+
+    RANDOM-projected models export in original feature space (reference:
+    models projected back after training); the back-projection is done one
+    entity at a time inside the stream so peak memory stays O(shard_dim)
+    regardless of entity count.
+    """
     names = index_map.names()
     if not len(model.keys):
         return
+    proj = model.projector
     entity_of = model.keys // model.dim
     feat_of = model.keys % model.dim
     starts = np.flatnonzero(np.r_[True, entity_of[1:] != entity_of[:-1]])
     bounds = np.r_[starts, len(model.keys)]
     for s, e in zip(bounds[:-1], bounds[1:]):
         entity = int(entity_of[s])
+        if proj is not None:
+            v = np.zeros(model.dim, np.float32)
+            v[feat_of[s:e]] = model.coeffs[s:e]
+            feats = np.arange(proj.shard_dim, dtype=np.int64)
+            vals = proj.project_back(v)
+            var_vals = None
+            if model.variances is not None:
+                var_v = np.zeros(model.dim, np.float32)
+                var_v[feat_of[s:e]] = model.variances[s:e]
+                var_vals = proj.project_back_variances(var_v)
+        else:
+            feats = feat_of[s:e]
+            vals = model.coeffs[s:e]
+            var_vals = (model.variances[s:e]
+                        if model.variances is not None else None)
         means = []
-        variances = [] if model.variances is not None else None
-        for k in range(s, e):
-            v = float(model.coeffs[k])
+        variances = [] if var_vals is not None else None
+        for idx, (j, v) in enumerate(zip(feats, vals)):
+            v = float(v)
             if abs(v) <= sparsity_threshold:
                 continue
-            name, term = _split_key(names[int(feat_of[k])])
+            name, term = _split_key(names[int(j)])
             means.append({"name": name, "term": term, "value": v})
             if variances is not None:
                 variances.append({"name": name, "term": term,
-                                  "value": float(model.variances[k])})
+                                  "value": float(var_vals[idx])})
         yield {
             "modelId": reverse_vocab.get(entity, str(entity)),
             "modelClass": model.task.value,

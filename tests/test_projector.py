@@ -1,0 +1,160 @@
+"""Projector tests: RANDOM Gaussian projection end-to-end through the RE
+stack (reference ``projector/RandomProjection.scala`` +
+``ProjectionMatrixBroadcast``) and back-projection export parity."""
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.game import (
+    GameData,
+    FeatureShard,
+    ProjectorType,
+    RandomEffectDataset,
+    RandomEffectDatasetConfig,
+    RandomProjector,
+)
+from photon_ml_tpu.game.random_effect import RandomEffectSolver
+from photon_ml_tpu.glm.problem import GLMOptimizationConfiguration
+from photon_ml_tpu.optimize import OptimizerConfig
+from photon_ml_tpu.types import TaskType
+
+from tests.test_game import make_mixed_data
+
+
+def _re_config(**kw):
+    return RandomEffectDatasetConfig(
+        "entityId", "re", projector_type=ProjectorType.RANDOM, **kw)
+
+
+class TestRandomProjector:
+    def test_project_rows_matches_dense(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(20, 30)).astype(np.float32)
+        x[x < 0.3] = 0.0  # sparsify
+        rows, cols = np.nonzero(x)
+        p = RandomProjector.build(30, 8, seed=1)
+        z = p.project_rows(cols.astype(np.int32), x[rows, cols], rows, 20)
+        np.testing.assert_allclose(z, x @ p.matrix.T, rtol=1e-5, atol=1e-5)
+
+    def test_project_back_scoring_exact(self):
+        # w = Pᵀv gives identical margins: w·x == v·(Px) for every x
+        rng = np.random.default_rng(2)
+        p = RandomProjector.build(50, 10, seed=3)
+        v = rng.normal(size=10).astype(np.float32)
+        x = rng.normal(size=(100, 50)).astype(np.float32)
+        np.testing.assert_allclose(
+            x @ p.project_back(v), (x @ p.matrix.T) @ v, rtol=1e-4, atol=1e-4)
+
+    def test_bad_dims_rejected(self):
+        with pytest.raises(ValueError):
+            RandomProjector.build(10, 0, seed=0)
+        with pytest.raises(ValueError):
+            RandomProjector.build(10, 11, seed=0)
+
+    def test_build_requires_projected_dim(self):
+        data, _ = make_mixed_data(n=100, n_entities=5)
+        with pytest.raises(ValueError, match="projected_dim"):
+            RandomEffectDataset.build("re", data, _re_config())
+
+
+class TestProjectedRandomEffects:
+    def _train(self, data, projected_dim=3):
+        ds = RandomEffectDataset.build(
+            "re", data, _re_config(projected_dim=projected_dim))
+        solver = RandomEffectSolver(
+            task=TaskType.LOGISTIC_REGRESSION,
+            config=GLMOptimizationConfiguration(
+                optimizer_config=OptimizerConfig(max_iterations=60)))
+        offsets = np.zeros(data.n_samples, np.float32)
+        model, scores = solver.train(ds, offsets, lam=1.0)
+        return ds, model, scores
+
+    def test_buckets_share_projected_dim(self):
+        data, _ = make_mixed_data(n=500, n_entities=11)
+        ds = RandomEffectDataset.build(
+            "re", data, _re_config(projected_dim=3))
+        assert ds.projector is not None
+        for b in ds.buckets:
+            assert b.x.shape[2] == 3
+            assert (b.feature_index == np.arange(3)).all()
+
+    def test_model_scores_match_bucket_scores(self):
+        # model.score (host projection join) must reproduce the on-device
+        # bucket margins on active samples — the CD accounting invariant
+        data, _ = make_mixed_data(n=400, n_entities=9)
+        ds, model, scores = self._train(data)
+        assert model.projector is ds.projector
+        rescored = model.score(data)
+        active = np.concatenate(
+            [b.sample_idx[b.sample_idx >= 0] for b in ds.buckets])
+        np.testing.assert_allclose(
+            rescored[active], scores[active], rtol=1e-4, atol=1e-5)
+
+    def test_to_shard_space_scoring_identical(self):
+        data, _ = make_mixed_data(n=400, n_entities=9)
+        _, model, _ = self._train(data)
+        back = model.to_shard_space()
+        assert back.projector is None
+        assert back.dim == data.shards["re"].dim
+        np.testing.assert_allclose(
+            back.score(data), model.score(data), rtol=1e-4, atol=1e-5)
+
+    def test_checkpoint_roundtrip_preserves_projector(self, tmp_path):
+        from photon_ml_tpu.game import GameModel
+        from photon_ml_tpu.io.checkpoint import (
+            CheckpointManager,
+            CoordinateDescentState,
+        )
+
+        data, _ = make_mixed_data(n=300, n_entities=7)
+        _, model, scores = self._train(data)
+        mgr = CheckpointManager(str(tmp_path / "ckpt"))
+        state = CoordinateDescentState(
+            sweep=1, coordinate_index=0,
+            model=GameModel(coordinates={"re": model},
+                            task=TaskType.LOGISTIC_REGRESSION),
+            scores={"re": scores})
+        mgr.save(3, state)
+        restored = mgr.restore().model.coordinates["re"]
+        assert restored.projector is not None
+        np.testing.assert_array_equal(restored.projector.matrix,
+                                      model.projector.matrix)
+        np.testing.assert_allclose(restored.score(data), model.score(data),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_export_streams_back_projection(self, tmp_path):
+        # saved Avro must be in shard space with the exact w = Pᵀv values
+        from photon_ml_tpu.game import GameModel
+        from photon_ml_tpu.io.avro import iter_avro_file
+        from photon_ml_tpu.io.index import IndexMap
+        from photon_ml_tpu.io.model_io import save_game_model
+
+        data, _ = make_mixed_data(n=300, n_entities=7)
+        _, model, _ = self._train(data)
+        gm = GameModel(coordinates={"re": model},
+                       task=TaskType.LOGISTIC_REGRESSION)
+        d_re = data.shards["re"].dim
+        imap = IndexMap(key_to_index={f"f{j}": j for j in range(d_re)})
+        vocab = {f"e{k}": k for k in range(7)}
+        out = str(tmp_path / "model")
+        save_game_model(out, gm, {"re": imap}, {"entityId": vocab})
+        part = f"{out}/random-effect/re/coefficients/part-00000.avro"
+        back = model.to_shard_space()
+        for rec in iter_avro_file(part):
+            ent = vocab[rec["modelId"]]
+            expect = back.entity_coefficients(ent)
+            got = {imap.key_to_index[m["name"]]: m["value"]
+                   for m in rec["means"]}
+            for j, v in got.items():
+                np.testing.assert_allclose(v, expect.get(j, 0.0),
+                                           rtol=1e-5, atol=1e-6)
+
+    def test_projection_learns_signal(self):
+        # with projected_dim == d_re the projection is invertible (a.s.), so
+        # the projected solve should recover real predictive signal
+        data, (xf, xr, ent, w_fixed, u) = make_mixed_data(
+            n=2000, d_fixed=2, d_re=4, n_entities=13)
+        _, model, scores = self._train(data, projected_dim=4)
+        true_re = np.einsum("nd,nd->n", xr, u[ent])
+        corr = np.corrcoef(scores, true_re)[0, 1]
+        assert corr > 0.7, corr
