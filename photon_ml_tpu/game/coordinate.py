@@ -112,13 +112,17 @@ class RandomEffectCoordinate:
     task: TaskType
     config: GLMOptimizationConfiguration
     lam: float = 0.0
+    #: optional mesh with an ``"entity"`` axis → entity-parallel solves
+    #: (reference ``RandomEffectDatasetPartitioner`` sharding).
+    mesh: Optional[object] = None
 
     def __post_init__(self):
         self.config.regularization.check_weight(self.lam)
 
     @property
     def solver(self) -> RandomEffectSolver:
-        return RandomEffectSolver(task=self.task, config=self.config)
+        return RandomEffectSolver(task=self.task, config=self.config,
+                                  mesh=self.mesh)
 
     def train(self, offsets: np.ndarray,
               warm_start: Optional[RandomEffectModel] = None,

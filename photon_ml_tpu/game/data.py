@@ -236,6 +236,15 @@ class RandomEffectDatasetConfig:
     feature_bucket_growth: float = 2.0
     seed: int = 20260729
 
+    def __post_init__(self):
+        if (self.projector_type is ProjectorType.RANDOM
+                and self.max_active_features is not None):
+            raise ValueError(
+                "max_active_features applies to the INDEX_MAP projector's "
+                "per-entity feature selection; the RANDOM projector replaces "
+                "feature selection with a shared projection (set "
+                "projected_dim to control its width instead)")
+
 
 def _geom_at_least(x: np.ndarray, growth: float, floor: int = 1) -> np.ndarray:
     """Elementwise next integer power of ``growth`` ≥ max(x, floor)."""

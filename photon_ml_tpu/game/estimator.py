@@ -85,12 +85,18 @@ class GameResult:
 
 @dataclasses.dataclass
 class GameEstimator:
-    """Fits GAME models over a training set for many configurations."""
+    """Fits GAME models over a training set for many configurations.
+
+    ``mesh`` (a :class:`jax.sharding.Mesh` with an ``"entity"`` axis) turns on
+    entity-parallel random-effect solves for every RE coordinate — the
+    multi-chip layout ``dryrun_multichip`` validates.
+    """
 
     task: TaskType
     coordinate_configs: Mapping[str, CoordinateConfig]
     update_sequence: Sequence[str]
     n_cd_iterations: int = 1
+    mesh: Optional[object] = None
 
     def __post_init__(self):
         for cid in self.update_sequence:
@@ -128,7 +134,7 @@ class GameEstimator:
                 out[cid] = RandomEffectCoordinate(
                     coordinate_id=cid, dataset=datasets[cid], data=data,
                     task=self.task, config=ccfg.optimization,
-                    lam=config.lam(cid))
+                    lam=config.lam(cid), mesh=self.mesh)
         return out
 
     # --- fit ---------------------------------------------------------------

@@ -14,6 +14,13 @@ every bucket of the same (samples, features) shape across all CD sweeps.
 Padding correctness: padded sample rows carry weight 0 (contribute nothing);
 padded feature columns are all-zero in x, so with zero init their gradient
 component is 0 and coefficients stay exactly 0.
+
+Entity parallelism (the reference's ``RandomEffectDatasetPartitioner``
+hash-sharding of entities over executors): pass a mesh with an ``"entity"``
+axis and the bucket's entity lanes shard over it via ``shard_map`` — every
+chip solves its slice of entities with ZERO communication (the solves are
+independent by construction), the direct analog of the reference's
+executor-local ``mapValues`` solves.
 """
 
 from __future__ import annotations
@@ -25,6 +32,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from photon_ml_tpu.game.data import RandomEffectDataset, REBucket
 from photon_ml_tpu.game.model import RandomEffectModel
@@ -32,15 +41,22 @@ from photon_ml_tpu.glm.problem import GLMOptimizationConfiguration, Optimization
 from photon_ml_tpu.ops.design import DenseDesign
 from photon_ml_tpu.ops.losses import loss_for_task
 from photon_ml_tpu.ops.objective import GLMData, GLMObjective
+from photon_ml_tpu.parallel.mesh import ENTITY_AXIS
 from photon_ml_tpu.types import TaskType, VarianceComputationType
 
 
 @dataclasses.dataclass(frozen=True)
 class RandomEffectSolver:
-    """Per-coordinate solver configuration bound to a task type."""
+    """Per-coordinate solver configuration bound to a task type.
+
+    ``mesh``/``entity_axis`` opt into entity-parallel solves: bucket entity
+    lanes are padded to a multiple of the axis size and sharded over it.
+    """
 
     task: TaskType
     config: GLMOptimizationConfiguration
+    mesh: Optional[Mesh] = None
+    entity_axis: str = ENTITY_AXIS
 
     def __post_init__(self):
         if self.config.optimizer_config.track_states:
@@ -58,16 +74,52 @@ class RandomEffectSolver:
         """Batched solve: x (E,S,D), labels/offsets/weights (E,S), w0 (E,D)."""
         problem = self._problem()
 
-        def solve_one(xe, ye, oe, we, w0e):
+        def solve_one(xe, ye, oe, we, w0e, lam_):
             data = GLMData(design=DenseDesign(x=xe), labels=ye,
                            offsets=oe, weights=we)
-            result = problem.run(data, w0e, lam)
-            variances = problem.compute_variances(result.w, data, lam)
+            result = problem.run(data, w0e, lam_)
+            variances = problem.compute_variances(result.w, data, lam_)
             if variances is None:
                 variances = jnp.zeros((0,), xe.dtype)
             return result.w, variances, result.converged
 
-        return jax.vmap(solve_one)(x, labels, offsets, weights, w0)
+        batch = jax.vmap(solve_one, in_axes=(0, 0, 0, 0, 0, None))
+        if self.mesh is None:
+            return batch(x, labels, offsets, weights, w0, lam)
+        # Entity-parallel: each device solves its contiguous slice of lanes.
+        # No collectives in the body — independence is the whole point.
+        s = P(self.entity_axis)
+        # check_vma off: the body is collective-free by construction, and the
+        # optimizers' constant-initialized while_loop carries would otherwise
+        # trip the varying-axis check against lane-varying outputs.
+        return shard_map(
+            batch, mesh=self.mesh,
+            in_specs=(s, s, s, s, s, P()),
+            out_specs=(s, s, s), check_vma=False,
+        )(x, labels, offsets, weights, w0, lam)
+
+    def _place(self, x, labels, offsets, weights, w0):
+        """Pad the entity dim to the mesh axis size and shard lanes over it.
+
+        Padded lanes carry all-zero data and weights, so their gradient is
+        exactly the L2 term at w=0 (zero) — they converge immediately and
+        their coefficients stay 0; :meth:`train` slices them off.
+        """
+        if self.mesh is None:
+            return tuple(jnp.asarray(a) for a in (x, labels, offsets, weights, w0))
+        n_dev = self.mesh.shape[self.entity_axis]
+        e = x.shape[0]
+        e_pad = -(-e // n_dev) * n_dev
+        sharding = NamedSharding(self.mesh, P(self.entity_axis))
+
+        def put(a):
+            a = np.asarray(a)
+            if e_pad != e:
+                a = np.concatenate(
+                    [a, np.zeros((e_pad - e,) + a.shape[1:], a.dtype)])
+            return jax.device_put(a, sharding)
+
+        return tuple(put(a) for a in (x, labels, offsets, weights, w0))
 
     @partial(jax.jit, static_argnames=("self",))
     def _margins_bucket(self, x, w):
@@ -104,13 +156,16 @@ class RandomEffectSolver:
             safe_idx = np.maximum(bucket.sample_idx, 0)
             boff = offsets[safe_idx].astype(np.float32) * (bucket.weights > 0)
             w0 = _gather_warm_start(bucket, warm_start, shard_dim)
-            w, variances, _conv = self._solve_bucket(
-                jnp.asarray(bucket.x), jnp.asarray(bucket.labels),
-                jnp.asarray(boff), jnp.asarray(bucket.weights),
-                jnp.asarray(w0), jnp.asarray(lam, jnp.float32))
-            w = np.asarray(w)
-            margins = np.asarray(self._margins_bucket(
-                jnp.asarray(bucket.x), jnp.asarray(w)))
+            e_real = bucket.n_entities
+            x_d, lab_d, off_d, wt_d, w0_d = self._place(
+                bucket.x, bucket.labels, boff, bucket.weights, w0)
+            w_dev, variances, _conv = self._solve_bucket(
+                x_d, lab_d, off_d, wt_d, w0_d, jnp.asarray(lam, jnp.float32))
+            # margins from the already-placed design (x is the dominant
+            # payload; avoid a second host→device copy of it)
+            margins = np.asarray(self._margins_bucket(x_d, w_dev))[:e_real]
+            w = np.asarray(w_dev)[:e_real]
+            variances = np.asarray(variances)[:e_real]
 
             live = bucket.sample_idx >= 0
             scores[bucket.sample_idx[live]] = margins[live]

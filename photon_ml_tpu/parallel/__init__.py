@@ -8,5 +8,7 @@ from photon_ml_tpu.parallel.mesh import (  # noqa: F401
 )
 from photon_ml_tpu.parallel.distributed import (  # noqa: F401
     DistributedGLMObjective,
+    FeatureShardedGLMObjective,
     shard_glm_data,
+    shard_glm_data_features,
 )

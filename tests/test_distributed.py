@@ -126,3 +126,78 @@ class TestDistributedSolve:
         w = jnp.asarray(np.random.default_rng(6).normal(size=data.dim))
         m = np.asarray(dist.margins(w, sharded)).reshape(-1)[:data.n_samples]
         np.testing.assert_allclose(m, np.asarray(obj.margins(w, data)), rtol=1e-10)
+
+
+@pytest.fixture(scope="module")
+def feature_mesh():
+    from photon_ml_tpu.parallel import FEATURE_AXIS, make_mesh
+
+    assert jax.device_count() >= 8
+    return make_mesh({FEATURE_AXIS: 8})
+
+
+@pytest.mark.parametrize("sparse", [False, True], ids=["dense", "csr"])
+class TestFeatureShardedObjective:
+    """TP sharding of the coefficient dim (SURVEY.md §2.10 TP row): every
+    quantity must match the unsharded objective. d=17 over 8 devices
+    exercises feature-dim padding (d_pad=24, 7 dead columns)."""
+
+    def test_value_grad_hvp_match_local(self, feature_mesh, sparse):
+        from photon_ml_tpu.parallel import (
+            FeatureShardedGLMObjective,
+            shard_glm_data_features,
+        )
+
+        data, _ = make_data(sparse=sparse)
+        obj = GLMObjective(loss=LogisticLoss)
+        tp = FeatureShardedGLMObjective(obj, feature_mesh)
+        sharded, d_pad = shard_glm_data_features(
+            data, 8, device_put_mesh=feature_mesh)
+        assert d_pad == 24
+
+        rng = np.random.default_rng(7)
+        w = jnp.asarray(np.concatenate(
+            [rng.normal(size=data.dim), np.zeros(d_pad - data.dim)]))
+        v = jnp.asarray(np.concatenate(
+            [rng.normal(size=data.dim), np.zeros(d_pad - data.dim)]))
+        l2 = 0.7
+
+        f_local, g_local = obj.value_and_grad(w[:data.dim], data, l2)
+        f_tp, g_tp = tp.value_and_grad(w, sharded, l2)
+        np.testing.assert_allclose(float(f_tp), float(f_local), rtol=1e-12)
+        np.testing.assert_allclose(np.asarray(g_tp)[:data.dim],
+                                   np.asarray(g_local), rtol=1e-10, atol=1e-12)
+        # padded columns: zero data, zero w → gradient exactly 0
+        np.testing.assert_array_equal(np.asarray(g_tp)[data.dim:], 0.0)
+
+        hv_local = obj.hvp(w[:data.dim], v[:data.dim], data, l2)
+        hv_tp = tp.hvp(w, v, sharded, l2)
+        np.testing.assert_allclose(np.asarray(hv_tp)[:data.dim],
+                                   np.asarray(hv_local), rtol=1e-10, atol=1e-12)
+
+        m_tp = np.asarray(tp.margins(w, sharded))
+        np.testing.assert_allclose(m_tp, np.asarray(obj.margins(w[:data.dim], data)),
+                                   rtol=1e-10)
+
+    def test_lbfgs_solve_matches_single_device(self, feature_mesh, sparse):
+        from photon_ml_tpu.parallel import (
+            FeatureShardedGLMObjective,
+            shard_glm_data_features,
+        )
+
+        data, _ = make_data(seed=8, sparse=sparse)
+        obj = GLMObjective(loss=LogisticLoss)
+        tp = FeatureShardedGLMObjective(obj, feature_mesh)
+        sharded, d_pad = shard_glm_data_features(
+            data, 8, device_put_mesh=feature_mesh)
+        cfg = OptimizerConfig(max_iterations=200, tolerance=1e-10)
+        l2 = 0.5
+        res_local = jax.jit(lambda w: minimize_lbfgs(
+            lambda wv: obj.value_and_grad(wv, data, l2), w, cfg))(
+                jnp.zeros(data.dim))
+        res_tp = jax.jit(lambda w: minimize_lbfgs(
+            lambda wv: tp.value_and_grad(wv, sharded, l2), w, cfg))(
+                jnp.zeros(d_pad))
+        np.testing.assert_allclose(np.asarray(res_tp.w)[:data.dim],
+                                   np.asarray(res_local.w), atol=1e-8)
+        np.testing.assert_array_equal(np.asarray(res_tp.w)[data.dim:], 0.0)

@@ -155,6 +155,42 @@ class TestRandomEffectSolver:
             # promotes to f64 via x64 test mode — agreement is f32-limited
             np.testing.assert_allclose(got, np.asarray(ref.w), atol=2e-3)
 
+    def test_entity_parallel_matches_single_device(self):
+        """shard_map over the 'entity' mesh axis == unsharded solves.
+
+        The EP analog of the reference sharding entities over executors
+        (``RandomEffectDatasetPartitioner``): results must not depend on the
+        number of devices. 37 entities over 8 devices exercises lane padding.
+        """
+        import jax
+
+        from photon_ml_tpu.parallel.mesh import ENTITY_AXIS, make_mesh
+
+        data, _ = make_mixed_data(n=900, n_entities=37, d_re=4)
+        ds = RandomEffectDataset.build(
+            "re", data, RandomEffectDatasetConfig("entityId", "re"))
+        cfg = GLMOptimizationConfiguration(
+            regularization=L2Regularization,
+            optimizer_config=OptimizerConfig(max_iterations=40, tolerance=1e-8),
+        )
+        offsets = np.random.default_rng(3).normal(
+            size=data.n_samples).astype(np.float32)
+
+        base = RandomEffectSolver(
+            task=TaskType.LOGISTIC_REGRESSION, config=cfg)
+        model0, scores0 = base.train(ds, offsets, lam=0.3, dim=4)
+
+        mesh = make_mesh({ENTITY_AXIS: 8}, devices=jax.devices())
+        ep = RandomEffectSolver(
+            task=TaskType.LOGISTIC_REGRESSION, config=cfg, mesh=mesh)
+        model1, scores1 = ep.train(ds, offsets, lam=0.3, dim=4)
+
+        np.testing.assert_array_equal(model0.keys, model1.keys)
+        # f32 L-BFGS trajectories under different XLA partitionings diverge
+        # at roundoff; same tolerance as the bucketed-vs-independent check
+        np.testing.assert_allclose(model1.coeffs, model0.coeffs, atol=2e-3)
+        np.testing.assert_allclose(scores1, scores0, atol=2e-3)
+
     def test_scores_match_model_score(self):
         data, _ = make_mixed_data(n=400, n_entities=6)
         ds = RandomEffectDataset.build(
@@ -241,6 +277,38 @@ class TestGameEstimator:
         assert best.evaluation is not None
         vals = [r.evaluation.primary[1] for r in results]
         assert best.evaluation.primary[1] == max(vals)
+
+    def test_fit_with_entity_mesh_matches_unsharded(self):
+        """End-to-end estimator path with mesh= set (EP random effects)."""
+        import jax
+
+        from photon_ml_tpu.parallel.mesh import ENTITY_AXIS, make_mesh
+
+        data, _ = make_mixed_data(n=800, n_entities=11)
+
+        def build(mesh):
+            return GameEstimator(
+                task=TaskType.LOGISTIC_REGRESSION,
+                coordinate_configs={
+                    "global": FixedEffectCoordinateConfig(
+                        feature_shard_id="fixed",
+                        optimization=GLMOptimizationConfiguration(
+                            regularization=L2Regularization)),
+                    "perEntity": RandomEffectCoordinateConfig(
+                        dataset=RandomEffectDatasetConfig("entityId", "re"),
+                        optimization=GLMOptimizationConfiguration(
+                            regularization=L2Regularization)),
+                },
+                update_sequence=["global", "perEntity"],
+                n_cd_iterations=1, mesh=mesh)
+
+        grid = [GameOptimizationConfiguration({"global": 0.01, "perEntity": 1.0})]
+        r0 = build(None).fit(data, grid)[0]
+        mesh = make_mesh({ENTITY_AXIS: 8}, devices=jax.devices())
+        r1 = build(mesh).fit(data, grid)[0]
+        s0 = r0.model.score(data)
+        s1 = r1.model.score(data)
+        np.testing.assert_allclose(s1, s0, atol=2e-3)
 
 
 class TestDownSampling:
