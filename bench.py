@@ -3,8 +3,10 @@
 Workload: BASELINE config-1 shape scaled up — L2-regularized logistic
 regression via the on-device compiled L-BFGS loop — the per-iteration
 broadcast + treeAggregate cycle that dominates the reference's wall-clock
-(SURVEY.md §3.1). Design matrix stored bfloat16, margins/gradients accumulated
-f32 on the MXU.
+(SURVEY.md §3.1). Design matrix stored f32: measured on the axon v5e chip,
+bf16 matvec/rmatvec lowers ~2x SLOWER than f32 at this (200k, 1024) shape
+(conversion-dominated), so f32 + the closed-form two-pass value_and_grad
+is the fast configuration.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 ``vs_baseline`` is the speedup of the compiled on-device solve over a
@@ -79,7 +81,7 @@ def _tpu_solve(x, y):
 
     n = x.shape[0]
     data = GLMData(
-        design=DenseDesign(x=jnp.asarray(x, jnp.bfloat16)),
+        design=DenseDesign(x=jnp.asarray(x, jnp.float32)),
         labels=jnp.asarray(y),
         offsets=jnp.zeros((n,), jnp.float32),
         weights=jnp.ones((n,), jnp.float32),

@@ -13,7 +13,8 @@ import json
 import os
 from typing import Optional, Sequence
 
-from photon_ml_tpu.evaluation import parse_evaluators, evaluate_all
+from photon_ml_tpu.evaluation import parse_evaluators
+from photon_ml_tpu.game.transformer import GameTransformer
 from photon_ml_tpu.io import AvroDataReader, load_game_model
 from photon_ml_tpu.io.avro import write_avro_file
 from photon_ml_tpu.io.index import IndexMap
@@ -94,29 +95,29 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
         with timed("Load model", run_logger):
             model = load_game_model(model_dir, index_maps, vocabs)
 
+        transformer = GameTransformer(
+            model=model, evaluators=evaluators,
+            score_breakdown=args.score_breakdown)
         with timed("Score", run_logger):
-            scores = model.score(data)
+            result = transformer.transform(data)
 
         with timed("Write scores", run_logger):
             os.makedirs(args.output_dir, exist_ok=True)
             records = (
                 {"uid": str(i), "predictionScore": float(s),
                  "label": float(l), "metadataMap": None}
-                for i, (s, l) in enumerate(zip(scores, data.labels)))
+                for i, (s, l) in enumerate(zip(result.scores, data.labels)))
             write_avro_file(os.path.join(args.output_dir, "scores.avro"),
                             records, SCORING_RESULT_AVRO)
-            if args.score_breakdown:
-                breakdown = model.score_by_coordinate(data)
+            if result.by_coordinate is not None:
                 with open(os.path.join(args.output_dir,
                                        "score-breakdown.json"), "w") as f:
-                    json.dump({k: v.tolist() for k, v in breakdown.items()}, f)
+                    json.dump({k: v.tolist()
+                               for k, v in result.by_coordinate.items()}, f)
 
         evaluation = None
-        if evaluators:
-            results = evaluate_all(evaluators, scores, data.labels,
-                                   weights=data.weights,
-                                   id_tags=data.id_columns)
-            evaluation = results.as_dict()
+        if result.evaluation is not None:
+            evaluation = result.evaluation.as_dict()
             run_logger.metric(stage="evaluate", **evaluation)
         return {"n_scored": data.n_samples, "evaluation": evaluation,
                 "output_dir": args.output_dir}

@@ -66,9 +66,13 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def run(argv: Optional[Sequence[str]] = None) -> dict:
+    from photon_ml_tpu.events import GLOBAL_BUS
+
     args = build_parser().parse_args(argv)
     task = TaskType(args.task)
     run_logger = RunLogger(args.output_dir)
+    GLOBAL_BUS.post("training_started", driver="train_game",
+                    task=task.value, output_dir=args.output_dir)
     try:
         shard_configs = tuple(parse_feature_shard_config(s)
                               for s in args.feature_shards.split(","))
@@ -147,6 +151,11 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                     search_cls(space).find(evaluate, args.tuning_iterations)
 
         best = GameEstimator.select_best(results)
+        for i, r in enumerate(results):
+            GLOBAL_BUS.post(
+                "configuration_evaluated", index=i,
+                config=dict(r.configuration.regularization_weights),
+                evaluation=r.evaluation.as_dict() if r.evaluation else None)
         if best.evaluation is not None:
             run_logger.metric(stage="best", **best.evaluation.as_dict(),
                               config=dict(best.configuration.regularization_weights))
@@ -163,6 +172,8 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                     save_game_model(
                         os.path.join(args.output_dir, "all", f"config-{i}"),
                         r.model, index_maps, vocabs)
+        GLOBAL_BUS.post("model_saved",
+                        path=os.path.join(args.output_dir, "best"))
         return {
             "best_config": dict(best.configuration.regularization_weights),
             "best_evaluation": (best.evaluation.as_dict()
@@ -171,6 +182,7 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
             "output_dir": args.output_dir,
         }
     finally:
+        GLOBAL_BUS.post("training_finished", driver="train_game")
         run_logger.close()
 
 

@@ -1,0 +1,78 @@
+"""Training lifecycle event bus.
+
+Re-design of the reference's event layer (``photon-client/.../event/...`` ⚠
+SURVEY.md §2.5 — lifecycle events consumed by LinkedIn-internal listeners):
+a tiny synchronous pub/sub bus the drivers post stage events to, so external
+integrations (metrics exporters, progress UIs, experiment trackers) can
+observe a run without the framework depending on them.
+
+Listeners are plain callables ``(TrainingEvent) -> None``; a listener
+exception is logged and swallowed (an observer must never kill a training
+run — same contract as the reference's fire-and-forget event bus).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Any, Callable, Mapping
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainingEvent:
+    """One lifecycle notification.
+
+    Standard event names posted by the drivers (mirroring the reference's
+    lifecycle):
+
+    - ``training_started`` / ``training_finished``
+    - ``stage_started`` / ``stage_finished`` (payload: ``stage``)
+    - ``configuration_evaluated`` (payload: config index, evaluation dict)
+    - ``model_saved`` (payload: output path)
+    """
+
+    name: str
+    payload: Mapping[str, Any] = dataclasses.field(default_factory=dict)
+    timestamp: float = dataclasses.field(default_factory=time.time)
+
+
+Listener = Callable[[TrainingEvent], None]
+
+
+class EventBus:
+    """Synchronous in-process pub/sub (reference event bus equivalent)."""
+
+    def __init__(self) -> None:
+        self._listeners: list[Listener] = []
+
+    def subscribe(self, listener: Listener) -> Callable[[], None]:
+        """Register; returns an unsubscribe callable."""
+        self._listeners.append(listener)
+
+        def unsubscribe() -> None:
+            try:
+                self._listeners.remove(listener)
+            except ValueError:
+                pass
+
+        return unsubscribe
+
+    def post(self, name: str, **payload: Any) -> TrainingEvent:
+        event = TrainingEvent(name=name, payload=payload)
+        for listener in list(self._listeners):
+            try:
+                listener(event)
+            except Exception:  # observers must never kill training
+                logger.exception("event listener failed on %s", name)
+        return event
+
+    def __len__(self) -> int:
+        return len(self._listeners)
+
+
+#: Default process-wide bus the CLI drivers post to; embedders may also pass
+#: their own bus to the drivers.
+GLOBAL_BUS = EventBus()

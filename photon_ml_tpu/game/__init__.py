@@ -37,3 +37,11 @@ from photon_ml_tpu.game.estimator import (  # noqa: F401
     GameOptimizationConfiguration,
     GameResult,
 )
+from photon_ml_tpu.game.transformer import (  # noqa: F401
+    GameTransformer,
+    ModelDataScores,
+)
+from photon_ml_tpu.game.factored import (  # noqa: F401
+    FactoredDesign,
+    FactoredRandomEffectCoordinate,
+)

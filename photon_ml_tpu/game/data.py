@@ -310,7 +310,13 @@ class RandomEffectDataset:
 
     @staticmethod
     def build(coordinate_id: str, data: GameData,
-              config: RandomEffectDatasetConfig) -> "RandomEffectDataset":
+              config: RandomEffectDatasetConfig,
+              projector: Optional[RandomProjector] = None,
+              ) -> "RandomEffectDataset":
+        """``projector`` overrides the seeded Gaussian matrix for the RANDOM
+        path — the factored coordinate passes its LEARNED projection here
+        (reference ``FactoredRandomEffectCoordinate``'s per-iteration
+        projection update)."""
         shard = data.shards[config.feature_shard_id]
         entities = data.id_columns[config.random_effect_type]
         n = data.n_samples
@@ -348,10 +354,11 @@ class RandomEffectDataset:
         n_entities_total = int(entities.max()) + 1 if n and present.any() else 0
 
         if config.projector_type is ProjectorType.RANDOM:
-            if config.projected_dim is None:
-                raise ValueError("RANDOM projector requires projected_dim")
-            projector = RandomProjector.build(
-                shard.dim, config.projected_dim, config.seed)
+            if projector is None:
+                if config.projected_dim is None:
+                    raise ValueError("RANDOM projector requires projected_dim")
+                projector = RandomProjector.build(
+                    shard.dim, config.projected_dim, config.seed)
             buckets = _random_projection_buckets(
                 data, shard, active_rows, act_entity, projector, config)
             return RandomEffectDataset(

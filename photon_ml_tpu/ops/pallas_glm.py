@@ -12,10 +12,16 @@ which also fuses margin/loss/gradient in one sweep per sample):
                    l  += Σ weights_i * loss(m, y_i)   (VPU)
                    g  += X_i^T @ (weights_i * dl(m))  (MXU)
 
-Halving HBM traffic roughly doubles throughput for the bandwidth-bound
-regime the headline bench measures. The kernel is jit/shard_map-safe (the
-distributed layer's psum wraps around it); L2 and normalization stay outside
-(coefficient-space reparameterization, SURVEY.md §7).
+Status (measured on the axon TPU v5e, (200k, 1024) f32): the closed-form
+two-pass XLA path (``GLMObjective._closed_value_and_grad``) currently WINS —
+~3.7 ms/iteration vs ~6.9 ms for this kernel — because the kernel's
+per-block matvec/outer-product shapes under-utilize the MXU while XLA's
+fused matvec pipeline streams near memory bandwidth. The kernel is kept
+behind ``GLMObjective(fused=True)`` as the starting point for a blocked
+multi-row formulation; do not enable it by default without re-measuring.
+It is jit/shard_map-safe (the distributed layer's psum wraps around it);
+L2 and normalization stay outside (coefficient-space reparameterization,
+SURVEY.md §7).
 
 Grid iteration on TPU is sequential, so accumulating into the outputs across
 grid steps (init at block 0) is the standard reduction pattern.
@@ -57,7 +63,8 @@ def _kernel(loss: PointwiseLoss, x_ref, y_ref, off_ref, wt_ref, w_ref,
     dvec = loss.d1(m, y) * wt
     # padded rows carry weight 0; the where guards 0 * inf = nan
     lsum = jnp.sum(jnp.where(wt > 0, wt * lvec, 0.0))
-    loss_ref[0, 0] += lsum
+    # full-slice (1,1) store: Mosaic rejects scalar stores to VMEM
+    loss_ref[:] += lsum.reshape(1, 1)
     grad_ref[:] += jnp.dot(x.T, dvec.reshape(-1, 1).astype(x.dtype),
                            preferred_element_type=jnp.float32)
 

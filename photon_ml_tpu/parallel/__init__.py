@@ -12,3 +12,7 @@ from photon_ml_tpu.parallel.distributed import (  # noqa: F401
     shard_glm_data,
     shard_glm_data_features,
 )
+from photon_ml_tpu.parallel.multihost import (  # noqa: F401
+    global_glm_data_from_local,
+    make_multihost_mesh,
+)

@@ -41,6 +41,12 @@ def _unstack(tree):
     return jax.tree.map(lambda x: x[0], tree)
 
 
+def _l2_value_and_grad(objective: GLMObjective, w: Array, l2):
+    wr = w if objective.reg_mask is None else w * objective.reg_mask
+    l2 = jnp.asarray(l2, w.dtype)
+    return 0.5 * l2 * jnp.vdot(wr, wr), l2 * wr
+
+
 def shard_glm_data(data: GLMData, n_shards: int, *, device_put_mesh: Optional[Mesh] = None,
                    axis: str = DATA_AXIS) -> GLMData:
     """Split a host-resident :class:`GLMData` into ``n_shards`` equal blocks.
@@ -140,7 +146,14 @@ class DistributedGLMObjective:
 
     def value_and_grad(self, w: Array, sharded: GLMData, l2=0.0):
         def body(wv, blk):
-            return jax.value_and_grad(self._global_value_fn(blk, l2))(wv)
+            # loss-only per shard (closed-form fast path inside), explicit
+            # psums: the global gradient is the sum of shard gradients; L2
+            # added after so it counts once
+            val, g = self.objective.value_and_grad(wv, _unstack(blk), 0.0)
+            val = jax.lax.psum(val, self.axis)
+            g = jax.lax.psum(g, self.axis)
+            l2_val, l2_grad = _l2_value_and_grad(self.objective, wv, l2)
+            return val + l2_val, g + l2_grad
 
         return shard_map(body, mesh=self.mesh,
                          in_specs=(P(), P(self.axis)), out_specs=(P(), P()))(w, sharded)

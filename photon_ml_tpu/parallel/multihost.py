@@ -1,0 +1,114 @@
+"""Multi-host (multi-slice) support: global meshes and host-local data feed.
+
+The reference scales across racks with Spark's driver/executor tree
+(``RDD.treeAggregate`` over netty RPC — SURVEY.md §5.8). The TPU-native
+equivalent is multi-controller JAX: every host runs THIS same program,
+``jax.distributed.initialize`` forms the job, and one global
+:class:`jax.sharding.Mesh` spans all slices — collectives ride ICI within a
+slice and DCN between slices. No framework code changes between 1 host and
+N: the mesh axes are the same, the ``shard_map`` bodies are the same.
+
+Mesh layout rule (the scaling-book recipe): put the axis with the
+highest-volume collectives (``data`` — one psum of grad-sized arrays per
+optimizer iteration) INNERMOST so it maps to ICI; put low-volume axes
+(``entity`` — zero collectives; only host-side gather at sweep end) across
+DCN. :func:`make_multihost_mesh` orders axes accordingly.
+
+Data feed: each host reads its own Avro shard (the reference's executor-local
+HDFS reads) and contributes host-local blocks;
+:func:`global_glm_data_from_local` assembles the global sharded
+:class:`GLMData` with ``jax.make_array_from_process_local_data``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from photon_ml_tpu.ops.design import DenseDesign
+from photon_ml_tpu.ops.objective import GLMData
+from photon_ml_tpu.parallel.mesh import DATA_AXIS, ENTITY_AXIS
+
+
+_initialized = False
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Form the multi-controller job (idempotent). On single-host runs this
+    is a no-op; on TPU pods the args come from the environment.
+
+    Must run before ANY backend-touching JAX call — even
+    ``jax.process_count()`` initializes the XLA backend, after which
+    ``jax.distributed.initialize`` refuses to run; hence the module-level
+    flag rather than querying JAX state.
+    """
+    global _initialized
+    if _initialized:
+        return
+    if coordinator_address is None and num_processes is None:
+        return  # single-host
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    _initialized = True
+
+
+def make_multihost_mesh(data_per_slice: Optional[int] = None,
+                        entity_over_slices: bool = False) -> Mesh:
+    """Global mesh over all processes' devices.
+
+    Default: one ``data`` axis over every chip (psum tree spans DCN exactly
+    once at the top, like treeAggregate's depth-2 tree). With
+    ``entity_over_slices``, a 2D ``(entity, data)`` grid: the ``entity``
+    axis runs across slices (DCN) and ``data`` stays within a slice (ICI) —
+    the right layout when random-effect solves dominate, because they need
+    no collectives at all. ``data_per_slice`` overrides the data-axis width
+    (default: one process's device count).
+    """
+    devices = np.array(jax.devices())
+    n = len(devices)
+    if not entity_over_slices and data_per_slice is None:
+        return jax.make_mesh((n,), (DATA_AXIS,))
+    per = (data_per_slice if data_per_slice is not None
+           else n // max(jax.process_count(), 1))
+    if per <= 0 or n % per:
+        raise ValueError(
+            f"data axis width {per} must divide device count {n}")
+    dev_grid = devices.reshape(n // per, per)
+    return Mesh(dev_grid, (ENTITY_AXIS, DATA_AXIS))
+
+
+def global_glm_data_from_local(local: GLMData, mesh: Mesh,
+                               axis: str = DATA_AXIS) -> GLMData:
+    """Assemble a globally-sharded :class:`GLMData` from each process's
+    host-local block (stacked per-local-device layout, as produced by
+    ``shard_glm_data(local, jax.local_device_count())``).
+
+    Every process contributes its own rows; the result's leading dim is the
+    global device count, laid out for the ``data``-axis ``shard_map``
+    objective. Labels/offsets/weights and a dense design all feed through
+    ``jax.make_array_from_process_local_data`` (the host→device bridge the
+    reference gets from Spark partition locality).
+    """
+    sharding = NamedSharding(mesh, P(axis))
+
+    def feed(x: np.ndarray) -> jax.Array:
+        x = np.asarray(x)
+        global_shape = (x.shape[0] * jax.process_count(),) + x.shape[1:]
+        return jax.make_array_from_process_local_data(sharding, x, global_shape)
+
+    if not isinstance(local.design, DenseDesign):
+        raise NotImplementedError(
+            "multi-host feed currently supports dense stacked designs; "
+            "pack sparse shards per-host first")
+    return GLMData(
+        design=DenseDesign(x=feed(local.design.x)),
+        labels=feed(local.labels),
+        offsets=feed(local.offsets),
+        weights=feed(local.weights),
+    )

@@ -1,0 +1,94 @@
+"""Event-bus tests (reference ``photon-client/.../event/`` lifecycle bus)."""
+
+import logging
+
+import numpy as np
+
+from photon_ml_tpu.events import EventBus, GLOBAL_BUS, TrainingEvent
+
+
+class TestEventBus:
+    def test_post_and_unsubscribe(self):
+        bus = EventBus()
+        seen = []
+        unsub = bus.subscribe(seen.append)
+        bus.post("training_started", driver="x")
+        assert len(seen) == 1
+        assert seen[0].name == "training_started"
+        assert seen[0].payload["driver"] == "x"
+        assert seen[0].timestamp > 0
+        unsub()
+        unsub()  # idempotent
+        bus.post("training_finished")
+        assert len(seen) == 1
+
+    def test_listener_exception_swallowed(self, caplog):
+        bus = EventBus()
+        seen = []
+
+        def bad(_event: TrainingEvent):
+            raise RuntimeError("observer bug")
+
+        bus.subscribe(bad)
+        bus.subscribe(seen.append)
+        with caplog.at_level(logging.ERROR):
+            bus.post("stage_started", stage="Train")
+        assert len(seen) == 1  # later listeners still ran
+        assert any("listener failed" in r.message for r in caplog.records)
+
+    def test_timed_posts_stage_events(self):
+        from photon_ml_tpu.logging_util import timed
+
+        seen = []
+        unsub = GLOBAL_BUS.subscribe(seen.append)
+        try:
+            with timed("UnitTestStage"):
+                pass
+        finally:
+            unsub()
+        names = [e.name for e in seen]
+        assert names == ["stage_started", "stage_finished"]
+        assert seen[1].payload["seconds"] >= 0
+
+    def test_train_game_driver_posts_lifecycle(self, tmp_path):
+        """End-to-end: the driver posts started/evaluated/saved/finished."""
+        from photon_ml_tpu.io.data_reader import write_training_examples
+
+        rng = np.random.default_rng(0)
+        n = 120
+        records = []
+        for i in range(n):
+            x = rng.normal(size=3)
+            y = float(rng.uniform() < 1 / (1 + np.exp(-x.sum())))
+            records.append({
+                "uid": str(i), "response": y, "offset": 0.0, "weight": 1.0,
+                "features": [
+                    {"name": f"fixed.f{j}", "term": "", "value": float(v)}
+                    for j, v in enumerate(x)],
+                "metadataMap": {"userId": str(i % 5)},
+            })
+        path = str(tmp_path / "train.avro")
+        write_training_examples(path, records)
+
+        from photon_ml_tpu.cli import train_game
+
+        seen = []
+        unsub = GLOBAL_BUS.subscribe(seen.append)
+        try:
+            train_game.run([
+                "--training-data", path,
+                "--output-dir", str(tmp_path / "out"),
+                "--feature-shards", "global=fixed|intercept",
+                "--coordinates", "fixed=fixed,shard=global,reg=L2",
+                "--update-sequence", "fixed",
+                "--grid", "fixed=1.0",
+                "--evaluators", "AUC",
+            ])
+        finally:
+            unsub()
+        names = [e.name for e in seen]
+        assert names[0] == "training_started"
+        assert names[-1] == "training_finished"
+        assert "configuration_evaluated" in names
+        assert "model_saved" in names
+        assert "stage_started" in names

@@ -311,6 +311,129 @@ class TestGameEstimator:
         np.testing.assert_allclose(s1, s0, atol=2e-3)
 
 
+class TestGameTransformer:
+    def test_transform_matches_model_score(self):
+        data, _ = make_mixed_data(n=600, n_entities=9)
+        est = GameEstimator(
+            task=TaskType.LOGISTIC_REGRESSION,
+            coordinate_configs={
+                "global": FixedEffectCoordinateConfig(
+                    feature_shard_id="fixed",
+                    optimization=GLMOptimizationConfiguration(
+                        regularization=L2Regularization)),
+                "perEntity": RandomEffectCoordinateConfig(
+                    dataset=RandomEffectDatasetConfig("entityId", "re"),
+                    optimization=GLMOptimizationConfiguration(
+                        regularization=L2Regularization)),
+            },
+            update_sequence=["global", "perEntity"])
+        model = est.fit(data, [GameOptimizationConfiguration(
+            {"global": 0.01, "perEntity": 1.0})])[0].model
+
+        from photon_ml_tpu.game.transformer import GameTransformer
+
+        evaluators = parse_evaluators(["AUC"])
+        tf = GameTransformer(model=model, evaluators=evaluators,
+                             score_breakdown=True, predict_response=True)
+        out = tf.transform(data)
+        np.testing.assert_allclose(out.scores, model.score(data), atol=1e-6)
+        # breakdown sums (+offsets) to the total — hard-parts #6 invariant
+        total = data.offsets + sum(out.by_coordinate.values())
+        np.testing.assert_allclose(out.scores, total, atol=1e-5)
+        # predictions = sigmoid(margin) for logistic
+        np.testing.assert_allclose(
+            out.predictions, 1 / (1 + np.exp(-out.scores.astype(np.float64))),
+            atol=1e-6)
+        assert out.evaluation is not None
+        assert 0.5 < out.evaluation.primary[1] <= 1.0
+
+
+class TestFactoredRandomEffect:
+    def make_factored_data(self, n=2500, d_re=12, latent=3, n_entities=21,
+                           seed=0):
+        """Entity coefficients constrained to a shared latent subspace —
+        the regime the factored coordinate is built for."""
+        prng = np.random.default_rng(98765)
+        p_true = prng.normal(size=(latent, d_re)).astype(np.float32)
+        v_true = (1.5 * prng.normal(size=(n_entities, latent))).astype(np.float32)
+        u = v_true @ p_true
+        rng = np.random.default_rng(seed)
+        xr = rng.normal(size=(n, d_re)).astype(np.float32)
+        ent = rng.integers(0, n_entities, size=n)
+        margin = np.einsum("nd,nd->n", xr, u[ent])
+        y = (rng.uniform(size=n) < 1 / (1 + np.exp(-margin))).astype(np.float32)
+
+        def sfd(x):
+            nn, dd = x.shape
+            return FeatureShard.from_coo(
+                np.repeat(np.arange(nn), dd), np.tile(np.arange(dd), nn),
+                x.ravel(), nn, dd)
+
+        return GameData.build(labels=y, shards={"re": sfd(xr)},
+                              id_columns={"entityId": ent})
+
+    def test_factored_design_matches_explicit_kron(self):
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.game.factored import FactoredDesign
+
+        rng = np.random.default_rng(0)
+        n, d, l = 50, 6, 3
+        x = rng.normal(size=(n, d)).astype(np.float32)
+        v = rng.normal(size=(n, l)).astype(np.float32)
+        w = rng.normal(size=(l * d,)).astype(np.float32)
+        g = rng.normal(size=(n,)).astype(np.float32)
+        design = FactoredDesign(x=jnp.asarray(x), v=jnp.asarray(v), latent_dim=l)
+        explicit = np.einsum("nl,nd->nld", v, x).reshape(n, l * d)
+        np.testing.assert_allclose(np.asarray(design.matvec(jnp.asarray(w))),
+                                   explicit @ w, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(design.rmatvec(jnp.asarray(g))),
+                                   explicit.T @ g, rtol=1e-4, atol=1e-4)
+
+    def test_factored_beats_full_rank_on_low_rank_data(self):
+        """With few samples per entity and low-rank truth, sharing the
+        projection should out-generalize the unconstrained random effect."""
+        from photon_ml_tpu.evaluation import evaluate_all
+        from photon_ml_tpu.game.factored import FactoredRandomEffectCoordinate
+        from photon_ml_tpu.game.projector import ProjectorType
+
+        data = self.make_factored_data(n=2500)
+        vdata = self.make_factored_data(n=1200, seed=7)
+        cfg = GLMOptimizationConfiguration(
+            regularization=L2Regularization,
+            optimizer_config=OptimizerConfig(max_iterations=40))
+
+        fact = FactoredRandomEffectCoordinate(
+            coordinate_id="re", data=data,
+            dataset_config=RandomEffectDatasetConfig(
+                "entityId", "re", projector_type=ProjectorType.RANDOM,
+                projected_dim=3),
+            task=TaskType.LOGISTIC_REGRESSION, config=cfg,
+            projection_config=cfg, lam=1.0, lam_projection=1.0,
+            n_factored_iterations=2)
+        model, scores = fact.train(np.zeros(data.n_samples, np.float32))
+        assert np.isfinite(scores).all()
+        # consistency: returned scores == model.score
+        np.testing.assert_allclose(scores, model.score(data), atol=1e-5)
+
+        evaluators = parse_evaluators(["AUC"])
+        auc_factored = evaluate_all(
+            evaluators, model.score(vdata), vdata.labels).primary[1]
+
+        from photon_ml_tpu.game.random_effect import RandomEffectSolver
+
+        full = RandomEffectSolver(task=TaskType.LOGISTIC_REGRESSION, config=cfg)
+        ds = RandomEffectDataset.build(
+            "re", data, RandomEffectDatasetConfig("entityId", "re"))
+        fmodel, _ = full.train(ds, np.zeros(data.n_samples, np.float32),
+                               lam=1.0, dim=12)
+        auc_full = evaluate_all(
+            evaluators, fmodel.score(vdata), vdata.labels).primary[1]
+        # factored must be competitive (it matches the true low-rank model)
+        assert auc_factored > auc_full - 0.01, (auc_factored, auc_full)
+        assert auc_factored > 0.6
+
+
 class TestDownSampling:
     def test_resamples_per_sweep(self):
         from photon_ml_tpu.sampling import BinaryClassificationDownSampler, DownSampler
