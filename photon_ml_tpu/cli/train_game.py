@@ -62,7 +62,60 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--output-all-models", action="store_true")
     p.add_argument("--data-validation", default="VALIDATE_FULL",
                    choices=[v.value for v in DataValidationType])
+    p.add_argument("--model-input-dir",
+                   help="warm-start from a previous train_game output dir "
+                        "(reference partial-retrain path); its feature "
+                        "indexes are reused so coefficients line up")
+    p.add_argument("--locked-coordinates", default="",
+                   help="comma-separated coordinate ids to FREEZE (kept "
+                        "from --model-input-dir, never retrained)")
+    p.add_argument("--checkpoint", action="store_true",
+                   help="write coordinate-boundary checkpoints under "
+                        "<output-dir>/checkpoints (single-config grids)")
+    p.add_argument("--resume", action="store_true",
+                   help="resume from the latest checkpoint in "
+                        "<output-dir>/checkpoints")
+    p.add_argument("--debug-nans", action="store_true",
+                   help="enable jax_debug_nans (fail fast on NaN; §5.2 "
+                        "sanitizer equivalent)")
+    p.add_argument("--model-sparsity-threshold", type=float, default=0.0,
+                   help="drop |coefficient| <= threshold from written "
+                        "models (reference model-sparsity threshold)")
+    p.add_argument("--input-columns", default="",
+                   help="remap record fields, e.g. 'response=label,"
+                        "weight=w' (reference InputColumnsNames)")
     return p
+
+
+def parse_input_columns(spec: str):
+    """'response=label,weight=w' → InputColumnsNames."""
+    from photon_ml_tpu.io.data_reader import InputColumnsNames
+
+    if not spec:
+        return InputColumnsNames()
+    overrides = {}
+    valid = {f.name for f in __import__("dataclasses").fields(InputColumnsNames)}
+    for part in spec.split(","):
+        logical, _, physical = part.partition("=")
+        logical = logical.strip()
+        physical = physical.strip()
+        if logical not in valid or not physical:
+            raise SystemExit(
+                f"bad --input-columns entry {part!r}; logical names: "
+                f"{sorted(valid)}")
+        overrides[logical] = physical
+    return InputColumnsNames(**overrides)
+
+
+def _resolve_model_dir(path: str) -> str:
+    """Accept a run dir (containing best/) or a model dir directly."""
+    path = os.path.normpath(path)
+    if os.path.exists(os.path.join(path, "model-metadata.json")):
+        return path
+    nested = os.path.join(path, "best")
+    if os.path.exists(os.path.join(nested, "model-metadata.json")):
+        return nested
+    raise FileNotFoundError(f"no model-metadata.json under {path!r}")
 
 
 def run(argv: Optional[Sequence[str]] = None) -> dict:
@@ -70,6 +123,10 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
 
     args = build_parser().parse_args(argv)
     task = TaskType(args.task)
+    if args.debug_nans:
+        import jax
+
+        jax.config.update("jax_debug_nans", True)
     run_logger = RunLogger(args.output_dir)
     GLOBAL_BUS.post("training_started", driver="train_game",
                     task=task.value, output_dir=args.output_dir)
@@ -79,6 +136,9 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
         coordinate_configs = dict(parse_coordinate_config(s)
                                   for s in args.coordinates)
         update_sequence = [c for c in args.update_sequence.split(",") if c]
+        locked = [c for c in args.locked_coordinates.split(",") if c]
+        if locked and not args.model_input_dir:
+            raise SystemExit("--locked-coordinates needs --model-input-dir")
         re_types = sorted({
             c.dataset.random_effect_type
             for c in coordinate_configs.values()
@@ -88,10 +148,41 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
         id_columns = tuple(dict.fromkeys(
             re_types + [e.id_tag for e in evaluators if e.id_tag]))
 
-        reader = AvroDataReader(shard_configs=shard_configs)
+        preset_maps = None
+        if args.model_input_dir:
+            from photon_ml_tpu.io.index import IndexMap
+
+            model_dir = _resolve_model_dir(args.model_input_dir)
+            index_dir = os.path.join(os.path.dirname(model_dir)
+                                     if os.path.basename(model_dir) == "best"
+                                     else model_dir, "feature-indexes")
+            if not os.path.isdir(index_dir):
+                index_dir = os.path.join(model_dir, "feature-indexes")
+            preset_maps = {
+                cfg.shard_id: IndexMap.load(
+                    os.path.join(index_dir, f"{cfg.shard_id}.json"))
+                for cfg in shard_configs}
+
+        reader = AvroDataReader(shard_configs=shard_configs,
+                                index_maps=preset_maps,
+                                input_columns=parse_input_columns(
+                                    args.input_columns))
         with timed("Read training data", run_logger):
             data, index_maps, vocabs = reader.read(
                 args.training_data, id_columns=id_columns)
+
+        initial_models = None
+        if args.model_input_dir:
+            from photon_ml_tpu.io import load_game_model
+
+            with timed("Load initial model", run_logger):
+                initial_models = dict(load_game_model(
+                    model_dir, index_maps, vocabs).coordinates)
+            missing = set(locked) - set(initial_models)
+            if missing:
+                raise SystemExit(
+                    f"locked coordinates {sorted(missing)} not present in "
+                    f"the input model")
         with timed("Validate data", run_logger):
             validate_game_data(data, task,
                                DataValidationType(args.data_validation))
@@ -99,7 +190,8 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
         validation = None
         if args.validation_data:
             reader_v = AvroDataReader(shard_configs=shard_configs,
-                                      index_maps=index_maps)
+                                      index_maps=index_maps,
+                                      input_columns=reader.input_columns)
             with timed("Read validation data", run_logger):
                 vdata, _, _ = reader_v.read(
                     args.validation_data, id_columns=id_columns,
@@ -110,6 +202,13 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                             update_sequence=update_sequence,
                             n_cd_iterations=args.cd_iterations)
 
+        checkpoint = None
+        if args.checkpoint or args.resume:
+            from photon_ml_tpu.io.checkpoint import CheckpointManager
+
+            checkpoint = CheckpointManager(
+                os.path.join(args.output_dir, "checkpoints"))
+
         if args.tuning == "NONE":
             grid = parse_grid(args.grid)
             unknown = {cid for g in grid for cid in g} - set(update_sequence)
@@ -118,11 +217,19 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                     f"--grid names unknown coordinates {sorted(unknown)}; "
                     f"update sequence is {update_sequence}")
             configurations = [GameOptimizationConfiguration(g) for g in grid]
+            if checkpoint is not None and len(configurations) != 1:
+                raise SystemExit("--checkpoint/--resume need a single-config "
+                                 "grid (got %d configs)" % len(configurations))
             with timed("Train (grid)", run_logger):
-                results = est.fit(data, configurations, validation=validation)
+                results = est.fit(data, configurations, validation=validation,
+                                  initial_models=initial_models, locked=locked,
+                                  checkpoint=checkpoint, resume=args.resume)
         else:
             if validation is None:
                 raise SystemExit("--tuning needs --validation-data")
+            if checkpoint is not None:
+                raise SystemExit("--checkpoint/--resume don't combine with "
+                                 "--tuning")
             from photon_ml_tpu.hyperparameter.search import (
                 GaussianProcessSearch,
                 ParamRange,
@@ -130,13 +237,17 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
             )
 
             low, high = (float(x) for x in args.tuning_range.split(":"))
-            space = {cid: ParamRange(low, high) for cid in update_sequence}
+            # locked coordinates are frozen — tuning their lambda would
+            # explore a dead axis
+            space = {cid: ParamRange(low, high) for cid in update_sequence
+                     if cid not in locked}
             results = []
-            datasets = est.prepare(data)  # build once across tuning evals
+            datasets = est.prepare(data, locked=locked)  # build once
 
             def evaluate(config: dict) -> float:
                 r = est.fit(data, [GameOptimizationConfiguration(config)],
-                            validation=validation, datasets=datasets)[0]
+                            validation=validation, datasets=datasets,
+                            initial_models=initial_models, locked=locked)[0]
                 results.append(r)
                 return r.evaluation.primary[1]
 
@@ -166,12 +277,14 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                 imap.save(os.path.join(args.output_dir, "feature-indexes",
                                        f"{shard_id}.json"))
             save_game_model(os.path.join(args.output_dir, "best"),
-                            best.model, index_maps, vocabs)
+                            best.model, index_maps, vocabs,
+                            sparsity_threshold=args.model_sparsity_threshold)
             if args.output_all_models:
                 for i, r in enumerate(results):
                     save_game_model(
                         os.path.join(args.output_dir, "all", f"config-{i}"),
-                        r.model, index_maps, vocabs)
+                        r.model, index_maps, vocabs,
+                        sparsity_threshold=args.model_sparsity_threshold)
         GLOBAL_BUS.post("model_saved",
                         path=os.path.join(args.output_dir, "best"))
         return {

@@ -56,9 +56,21 @@ class CoordinateDescent:
         initial_models: Optional[Mapping[str, CoordinateModel]] = None,
         checkpoint=None,  # Optional[photon_ml_tpu.io.checkpoint.CheckpointManager]
         resume: bool = False,
+        locked: Sequence[str] = (),
+        config_fingerprint: Optional[str] = None,
     ) -> CoordinateDescentResult:
+        """``locked`` coordinates (reference partial retrain via
+        ``--model-input-dir``: freeze some coordinates, retrain others) keep
+        their ``initial_models`` entry; their scores participate in the
+        residual accounting but they are never retrained — so they need no
+        entry in ``coordinates`` (and no dataset build)."""
+        locked = set(locked)
+        for cid in locked:
+            if not initial_models or cid not in initial_models:
+                raise KeyError(
+                    f"locked coordinate {cid!r} needs an initial model")
         for cid in self.update_sequence:
-            if cid not in coordinates:
+            if cid not in coordinates and cid not in locked:
                 raise KeyError(f"update sequence names unknown coordinate {cid!r}")
 
         models: dict[str, CoordinateModel] = dict(initial_models or {})
@@ -72,7 +84,7 @@ class CoordinateDescent:
 
         start_sweep, start_coord = 0, 0
         if resume and checkpoint is not None and checkpoint.latest_step() is not None:
-            state = checkpoint.restore()
+            state = checkpoint.restore(expected_fingerprint=config_fingerprint)
             models = dict(state.model.coordinates)
             scores.update({k: v for k, v in state.scores.items() if k in scores})
             start_sweep, start_coord = state.sweep, state.coordinate_index
@@ -86,6 +98,8 @@ class CoordinateDescent:
             for ci, cid in enumerate(self.update_sequence):
                 if sweep == start_sweep and ci < start_coord:
                     continue
+                if cid in locked:
+                    continue  # frozen: scores stay as seeded
                 t0 = time.perf_counter()
                 residual = (total - scores[cid]).astype(np.float32)
                 model, new_scores = coordinates[cid].train(
@@ -105,7 +119,8 @@ class CoordinateDescent:
                             sweep=sweep + (next_ci == 0),
                             coordinate_index=next_ci,
                             model=GameModel(coordinates=dict(models), task=task),
-                            scores=dict(scores)))
+                            scores=dict(scores)),
+                        fingerprint=config_fingerprint)
 
             if validation is not None:
                 vdata, evaluators = validation

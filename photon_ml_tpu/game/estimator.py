@@ -13,6 +13,7 @@ model-selection criterion (reference ``ModelSelection``).
 from __future__ import annotations
 
 import dataclasses
+import json
 import logging
 from typing import Mapping, Optional, Sequence
 
@@ -115,14 +116,33 @@ class GameEstimator:
     mesh: Optional[object] = None
 
     def __post_init__(self):
+        # coordinates may be absent from configs only if locked at fit time
+        # (partial retrain); prepare()/fit() validate against ``locked``
+        pass
+
+    def _check_sequence(self, locked: Sequence[str]) -> None:
+        locked = set(locked)
         for cid in self.update_sequence:
-            if cid not in self.coordinate_configs:
-                raise KeyError(f"update sequence names unknown coordinate {cid!r}")
+            if cid not in self.coordinate_configs and cid not in locked:
+                raise KeyError(
+                    f"update sequence names unknown coordinate {cid!r} "
+                    f"(not configured, not locked)")
+        # a locked coordinate outside the update sequence would silently
+        # vanish from the model and the residual accounting — reject it
+        missing = locked - set(self.update_sequence)
+        if missing:
+            raise ValueError(
+                f"locked coordinates {sorted(missing)} must appear in the "
+                f"update sequence to stay part of the model")
 
     # --- dataset construction (once) --------------------------------------
-    def prepare(self, data: GameData) -> dict[str, object]:
+    def prepare(self, data: GameData,
+                locked: Sequence[str] = ()) -> dict[str, object]:
+        self._check_sequence(locked)
         datasets: dict[str, object] = {}
         for cid in self.update_sequence:
+            if cid in locked:
+                continue  # frozen coordinate: no dataset, no training
             cfg = self.coordinate_configs[cid]
             if isinstance(cfg, FixedEffectCoordinateConfig):
                 datasets[cid] = FixedEffectDataset.build(
@@ -140,9 +160,12 @@ class GameEstimator:
         return datasets
 
     def _coordinates(self, data: GameData, datasets: Mapping[str, object],
-                     config: GameOptimizationConfiguration):
+                     config: GameOptimizationConfiguration,
+                     locked: Sequence[str] = ()):
         out = {}
         for cid in self.update_sequence:
+            if cid in locked:
+                continue
             ccfg = self.coordinate_configs[cid]
             if isinstance(ccfg, FixedEffectCoordinateConfig):
                 out[cid] = FixedEffectCoordinate(
@@ -177,19 +200,37 @@ class GameEstimator:
         configurations: Sequence[GameOptimizationConfiguration],
         validation: Optional[tuple[GameData, Sequence[Evaluator]]] = None,
         datasets: Optional[Mapping[str, object]] = None,
+        initial_models: Optional[Mapping[str, object]] = None,
+        locked: Sequence[str] = (),
+        checkpoint=None,
+        resume: bool = False,
     ) -> list[GameResult]:
         """``datasets`` (from :meth:`prepare`) lets callers that fit many
         times over the same data — e.g. a tuning loop — build the coordinate
-        datasets once."""
+        datasets once. ``initial_models``/``locked`` are the reference's
+        partial-retrain path (warm-start from a saved GameModel; frozen
+        coordinates keep their model and skip training);
+        ``checkpoint``/``resume`` persist/restore coordinate-boundary state
+        (single-configuration fits only — a resumed grid would mis-attribute
+        the restored state to every configuration)."""
+        self._check_sequence(locked)
+        if checkpoint is not None and len(configurations) != 1:
+            raise ValueError("checkpointing supports exactly one configuration")
         if datasets is None:
-            datasets = self.prepare(data)
+            datasets = self.prepare(data, locked=locked)
         cd = CoordinateDescent(update_sequence=self.update_sequence,
                                n_iterations=self.n_cd_iterations)
         results: list[GameResult] = []
         for config in configurations:
-            coordinates = self._coordinates(data, datasets, config)
+            coordinates = self._coordinates(data, datasets, config, locked)
+            fingerprint = json.dumps(
+                sorted(config.regularization_weights.items()))
             cd_result = cd.run(coordinates, data, self.task,
-                               validation=validation)
+                               validation=validation,
+                               initial_models=initial_models,
+                               checkpoint=checkpoint, resume=resume,
+                               locked=locked,
+                               config_fingerprint=fingerprint)
             # the final CD sweep already evaluated this exact model
             evaluation = cd_result.final_evaluation
             results.append(GameResult(

@@ -69,7 +69,12 @@ class CheckpointManager:
                           ignore_errors=True)
 
     # --- save/restore -----------------------------------------------------
-    def save(self, step: int, state: CoordinateDescentState) -> str:
+    def save(self, step: int, state: CoordinateDescentState,
+             fingerprint: Optional[str] = None) -> str:
+        """``fingerprint`` identifies the training configuration (e.g. the
+        regularization weights); restore() refuses state written under a
+        different configuration — resuming lambda=0.1 state into a
+        lambda=10 run would silently mis-attribute the model."""
         final = os.path.join(self.root, f"step-{step}")
         tmp = tempfile.mkdtemp(prefix=f"step-{step}-", suffix=".tmp",
                                dir=self.root)
@@ -78,6 +83,7 @@ class CheckpointManager:
             "sweep": state.sweep,
             "coordinate_index": state.coordinate_index,
             "task": state.model.task.value,
+            "fingerprint": fingerprint,
             "coordinates": {},
         }
         arrays: dict[str, np.ndarray] = {}
@@ -115,7 +121,9 @@ class CheckpointManager:
         self._gc()
         return final
 
-    def restore(self, step: Optional[int] = None) -> CoordinateDescentState:
+    def restore(self, step: Optional[int] = None,
+                expected_fingerprint: Optional[str] = None,
+                ) -> CoordinateDescentState:
         import jax.numpy as jnp
 
         if step is None:
@@ -125,6 +133,13 @@ class CheckpointManager:
         path = os.path.join(self.root, f"step-{step}")
         with open(os.path.join(path, "manifest.json")) as f:
             manifest = json.load(f)
+        saved_fp = manifest.get("fingerprint")
+        if (expected_fingerprint is not None and saved_fp is not None
+                and saved_fp != expected_fingerprint):
+            raise ValueError(
+                f"checkpoint at {path} was written under configuration "
+                f"{saved_fp!r}, but this run is {expected_fingerprint!r}; "
+                f"refusing to resume across configurations")
         arrays = np.load(os.path.join(path, "arrays.npz"))
         task = TaskType(manifest["task"])
         coordinates = {}

@@ -39,7 +39,35 @@ class FeatureShardConfig:
     has_intercept: bool = True
 
 
-def _record_features(record: dict, bags: Optional[Sequence[str]]):
+@dataclasses.dataclass(frozen=True)
+class InputColumnsNames:
+    """Logical → physical record-field remapping
+    (reference ``data/InputColumnsNames.scala``): datasets whose fields are
+    named differently (e.g. ``label`` instead of ``response``) read without
+    rewriting the files."""
+
+    response: str = "response"
+    offset: str = "offset"
+    weight: str = "weight"
+    #: accepted for reference-config parity; the reader never consumes uids
+    #: (scoring output numbers records), so remapping it changes nothing
+    uid: str = "uid"
+    features: str = "features"
+    metadata_map: str = "metadataMap"
+
+    #: fields that actually drive decoding (uid excluded — see above)
+    _DECODE_FIELDS = ("response", "offset", "weight", "features",
+                      "metadata_map")
+
+    @property
+    def is_default(self) -> bool:
+        default = InputColumnsNames()
+        return all(getattr(self, f) == getattr(default, f)
+                   for f in self._DECODE_FIELDS)
+
+
+def _record_features(record: dict, bags: Optional[Sequence[str]],
+                     features_field: str = "features"):
     """Yield (key, value) for the record's features, filtered by bag.
 
     Reference records carry features in a flat list; "bags" select by the
@@ -47,7 +75,7 @@ def _record_features(record: dict, bags: Optional[Sequence[str]]):
     Avro field. We use the common LinkedIn layout: one flat ``features``
     array, bag = prefix before the first ``.`` in ``name`` when present.
     """
-    for f in record.get("features") or ():
+    for f in record.get(features_field) or ():
         name = f["name"]
         if bags is not None:
             bag = name.split(".", 1)[0] if "." in name else name
@@ -71,6 +99,10 @@ class AvroDataReader:
     #: reused for validation/scoring reads so ids line up.
     index_maps: Optional[dict[str, IndexMap]] = None
     use_native: bool = True
+    #: physical field names (reference InputColumnsNames); non-default
+    #: mappings use the Python codec (the native decoder reads the
+    #: canonical layout only).
+    input_columns: InputColumnsNames = InputColumnsNames()
 
     def paths(self, input_path: str) -> list[str]:
         if os.path.isdir(input_path):
@@ -85,7 +117,8 @@ class AvroDataReader:
         keys: dict[str, set] = {c.shard_id: set() for c in self.shard_configs}
         for rec in records:
             for cfg in self.shard_configs:
-                for key, _ in _record_features(rec, cfg.feature_bags):
+                for key, _ in _record_features(rec, cfg.feature_bags,
+                                               self.input_columns.features):
                     keys[cfg.shard_id].add(key)
         return {
             cfg.shard_id: build_index_map(keys[cfg.shard_id],
@@ -104,7 +137,7 @@ class AvroDataReader:
         validation data so entity ids align.
         """
         files = self.paths(input_path)
-        if self.use_native:
+        if self.use_native and self.input_columns.is_default:
             native_out = self._read_native(files, id_columns, entity_vocabs)
             if native_out is not None:
                 return native_out
@@ -125,13 +158,14 @@ class AvroDataReader:
         shard_cols: dict[str, list] = {c.shard_id: [] for c in self.shard_configs}
         shard_vals: dict[str, list] = {c.shard_id: [] for c in self.shard_configs}
 
+        cols = self.input_columns
         for i, rec in enumerate(records):
-            labels[i] = rec["response"]
-            if rec.get("offset") is not None:
-                offsets[i] = rec["offset"]
-            if rec.get("weight") is not None:
-                weights[i] = rec["weight"]
-            meta = rec.get("metadataMap") or {}
+            labels[i] = rec[cols.response]
+            if rec.get(cols.offset) is not None:
+                offsets[i] = rec[cols.offset]
+            if rec.get(cols.weight) is not None:
+                weights[i] = rec[cols.weight]
+            meta = rec.get(cols.metadata_map) or {}
             for c in id_columns:
                 raw = meta.get(c)
                 if raw is None:
@@ -146,7 +180,8 @@ class AvroDataReader:
                 imap = index_maps[cfg.shard_id]
                 rs, cs, vs = (shard_rows[cfg.shard_id],
                               shard_cols[cfg.shard_id], shard_vals[cfg.shard_id])
-                for key, value in _record_features(rec, cfg.feature_bags):
+                for key, value in _record_features(rec, cfg.feature_bags,
+                                                   cols.features):
                     j = imap.key_to_index.get(key)
                     if j is not None:
                         rs.append(i)

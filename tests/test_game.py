@@ -311,6 +311,106 @@ class TestGameEstimator:
         np.testing.assert_allclose(s1, s0, atol=2e-3)
 
 
+def make_music_data(n=4000, d_global=6, d_item=3, n_users=25, n_songs=15,
+                    n_artists=8, seed=0, param_seed=424242):
+    """Yahoo!-Music-shaped data (BASELINE config 5): global features plus
+    user, song, AND artist random effects; songs map many-to-one to artists."""
+    prng = np.random.default_rng(param_seed)
+    w = prng.normal(size=d_global).astype(np.float32)
+    u_user = (1.2 * prng.normal(size=(n_users, d_item))).astype(np.float32)
+    u_song = (0.8 * prng.normal(size=(n_songs, d_item))).astype(np.float32)
+    u_artist = (0.6 * prng.normal(size=(n_artists, d_item))).astype(np.float32)
+    song_artist = prng.integers(0, n_artists, size=n_songs)
+    rng = np.random.default_rng(seed)
+    xg = rng.normal(size=(n, d_global)).astype(np.float32)
+    xi = rng.normal(size=(n, d_item)).astype(np.float32)
+    users = rng.integers(0, n_users, size=n)
+    songs = rng.integers(0, n_songs, size=n)
+    artists = song_artist[songs]
+    margin = (xg @ w + np.einsum("nd,nd->n", xi, u_user[users])
+              + np.einsum("nd,nd->n", xi, u_song[songs])
+              + np.einsum("nd,nd->n", xi, u_artist[artists]))
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-margin))).astype(np.float32)
+
+    def sfd(x):
+        nn, dd = x.shape
+        return FeatureShard.from_coo(
+            np.repeat(np.arange(nn), dd), np.tile(np.arange(dd), nn),
+            x.ravel(), nn, dd)
+
+    return GameData.build(
+        labels=y,
+        shards={"global": sfd(xg), "item": sfd(xi)},
+        id_columns={"userId": users, "songId": songs, "artistId": artists})
+
+
+class TestMultiRandomEffect:
+    """BASELINE config 5: fixed effect + user + song + artist random effects
+    through the full estimator (the reference's multi-coordinate GAME)."""
+
+    def _estimator(self, update_sequence, mesh=None):
+        cfg = GLMOptimizationConfiguration(
+            regularization=L2Regularization,
+            optimizer_config=OptimizerConfig(max_iterations=40))
+        coords = {
+            "global": FixedEffectCoordinateConfig(
+                feature_shard_id="global", optimization=cfg),
+            "perUser": RandomEffectCoordinateConfig(
+                dataset=RandomEffectDatasetConfig("userId", "item"),
+                optimization=cfg),
+            "perSong": RandomEffectCoordinateConfig(
+                dataset=RandomEffectDatasetConfig("songId", "item"),
+                optimization=cfg),
+            "perArtist": RandomEffectCoordinateConfig(
+                dataset=RandomEffectDatasetConfig("artistId", "item"),
+                optimization=cfg),
+        }
+        return GameEstimator(
+            task=TaskType.LOGISTIC_REGRESSION,
+            coordinate_configs={k: coords[k] for k in update_sequence},
+            update_sequence=update_sequence, n_cd_iterations=2, mesh=mesh)
+
+    def test_three_res_beat_one(self):
+        data = make_music_data(n=4000)
+        vdata = make_music_data(n=2000, seed=5)
+        evaluators = parse_evaluators(["AUC"])
+        lam = {"global": 0.01, "perUser": 1.0, "perSong": 1.0, "perArtist": 1.0}
+
+        full_seq = ["global", "perUser", "perSong", "perArtist"]
+        full = self._estimator(full_seq).fit(
+            data, [GameOptimizationConfiguration(lam)],
+            validation=(vdata, evaluators))[0]
+
+        user_only = self._estimator(["global", "perUser"]).fit(
+            data, [GameOptimizationConfiguration(lam)],
+            validation=(vdata, evaluators))[0]
+
+        auc_full = full.evaluation.primary[1]
+        auc_user = user_only.evaluation.primary[1]
+        assert auc_full > auc_user + 0.01, (auc_full, auc_user)
+        assert auc_full > 0.75
+
+        # score-accounting invariant across 4 coordinates
+        total = data.offsets + sum(
+            m.score(data) for m in full.model.coordinates.values())
+        np.testing.assert_allclose(total, full.model.score(data),
+                                   rtol=1e-3, atol=1e-4)
+
+    def test_grouped_metrics_per_entity_type(self):
+        """Sharded evaluators over different id columns (AUC:userId,
+        AUC:songId) — the reference's MultiEvaluator on config 5."""
+        data = make_music_data(n=3000)
+        vdata = make_music_data(n=1500, seed=9)
+        evaluators = parse_evaluators(["AUC", "AUC:userId", "AUC:songId"])
+        lam = {"global": 0.01, "perUser": 1.0, "perSong": 1.0, "perArtist": 1.0}
+        r = self._estimator(["global", "perUser", "perSong", "perArtist"]).fit(
+            data, [GameOptimizationConfiguration(lam)],
+            validation=(vdata, evaluators))[0]
+        d = r.evaluation.as_dict()
+        assert set(d) == {"AUC", "AUC:userId", "AUC:songId"}
+        assert all(0.5 < v <= 1.0 for v in d.values()), d
+
+
 class TestGameTransformer:
     def test_transform_matches_model_score(self):
         data, _ = make_mixed_data(n=600, n_entities=9)
