@@ -84,6 +84,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--input-columns", default="",
                    help="remap record fields, e.g. 'response=label,"
                         "weight=w' (reference InputColumnsNames)")
+    p.add_argument("--profile", action="store_true",
+                   help="write a jax.profiler trace of the training stage "
+                        "to <output-dir>/profile (view with TensorBoard)")
     return p
 
 
@@ -139,10 +142,22 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
         locked = [c for c in args.locked_coordinates.split(",") if c]
         if locked and not args.model_input_dir:
             raise SystemExit("--locked-coordinates needs --model-input-dir")
-        re_types = sorted({
+        re_types = {
             c.dataset.random_effect_type
             for c in coordinate_configs.values()
-            if isinstance(c, RandomEffectCoordinateConfig)})
+            if isinstance(c, RandomEffectCoordinateConfig)}
+        if args.model_input_dir:
+            # locked coordinates have no config entry, but their entity-id
+            # columns must still be read so the loaded model's entity keys
+            # resolve (model-metadata.json records each coordinate's type)
+            import json as _json
+
+            with open(os.path.join(_resolve_model_dir(args.model_input_dir),
+                                   "model-metadata.json")) as f:
+                for info in _json.load(f)["coordinates"].values():
+                    if info["type"] == "random-effect":
+                        re_types.add(info["randomEffectType"])
+        re_types = sorted(re_types)
         evaluators = parse_evaluators(
             [e for e in args.evaluators.split(",") if e])
         id_columns = tuple(dict.fromkeys(
@@ -220,7 +235,11 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
             if checkpoint is not None and len(configurations) != 1:
                 raise SystemExit("--checkpoint/--resume need a single-config "
                                  "grid (got %d configs)" % len(configurations))
-            with timed("Train (grid)", run_logger):
+            from photon_ml_tpu.logging_util import profiled
+
+            with timed("Train (grid)", run_logger), profiled(
+                    os.path.join(args.output_dir, "profile")
+                    if args.profile else None):
                 results = est.fit(data, configurations, validation=validation,
                                   initial_models=initial_models, locked=locked,
                                   checkpoint=checkpoint, resume=args.resume)
@@ -254,7 +273,11 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
             maximize = evaluators[0].maximize
             search_cls = (GaussianProcessSearch if args.tuning == "BAYESIAN"
                           else RandomSearch)
-            with timed(f"Train ({args.tuning} tuning)", run_logger):
+            from photon_ml_tpu.logging_util import profiled
+
+            with timed(f"Train ({args.tuning} tuning)", run_logger), profiled(
+                    os.path.join(args.output_dir, "profile")
+                    if args.profile else None):
                 if args.tuning == "BAYESIAN":
                     search_cls(space, maximize=maximize).find(
                         evaluate, args.tuning_iterations)

@@ -78,6 +78,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "Hosmer-Lemeshow, feature importance, fitting curve)")
     p.add_argument("--diagnostic-bootstrap-replicates", type=_positive_int,
                    default=16)
+    p.add_argument("--profile", action="store_true",
+                   help="write a jax.profiler trace of the training stage "
+                        "to <output-dir>/profile (view with TensorBoard)")
     return p
 
 
@@ -226,7 +229,11 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
             reg_mask = jnp.asarray(mask)
 
         glm_train = _to_glm_data(data, "global")
-        with timed("Train", run_logger):
+        from photon_ml_tpu.logging_util import log_optimizer_trace, profiled
+
+        with timed("Train", run_logger), profiled(
+                os.path.join(args.output_dir, "profile")
+                if args.profile else None):
             trained = train_glm_sweep(
                 task, glm_train, lambdas, config,
                 normalization=normalization, reg_mask=reg_mask)
@@ -235,6 +242,9 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                               value=float(tm.result.value),
                               iterations=int(tm.result.iterations),
                               converged=bool(tm.result.converged))
+            # the reference's OptimizationStatesTracker iteration table
+            log_optimizer_trace(
+                tm.result, f"lambda={tm.regularization_weight:g}", run_logger)
 
         best_idx = 0
         glm_val = None

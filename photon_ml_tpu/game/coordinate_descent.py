@@ -136,6 +136,16 @@ class CoordinateDescent:
         model = GameModel(
             coordinates={cid: models[cid] for cid in self.update_sequence},
             task=task)
+        if validation is not None and final_evaluation is None:
+            # sweep loop fully skipped (resume from a completed checkpoint):
+            # the model is final but unevaluated — evaluate it now so the
+            # caller still gets metrics
+            vdata, evaluators = validation
+            vscores = model.score(vdata)
+            final_evaluation = evaluate_all(
+                evaluators, vscores, vdata.labels, weights=vdata.weights,
+                id_tags=vdata.id_columns)
+            history.append(final_evaluation.as_dict())
         return CoordinateDescentResult(
             model=model, scores=scores, validation_history=history,
             final_evaluation=final_evaluation)

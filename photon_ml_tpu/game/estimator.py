@@ -223,8 +223,16 @@ class GameEstimator:
         results: list[GameResult] = []
         for config in configurations:
             coordinates = self._coordinates(data, datasets, config, locked)
-            fingerprint = json.dumps(
-                sorted(config.regularization_weights.items()))
+            # identify the whole run shape, not just the lambdas: a resumed
+            # checkpoint with a different update sequence / sweep count /
+            # locked set / dataset would silently mis-attribute state
+            fingerprint = json.dumps({
+                "weights": sorted(config.regularization_weights.items()),
+                "update_sequence": list(self.update_sequence),
+                "n_cd_iterations": self.n_cd_iterations,
+                "locked": sorted(locked),
+                "n_samples": data.n_samples,
+            }, sort_keys=True)
             cd_result = cd.run(coordinates, data, self.task,
                                validation=validation,
                                initial_models=initial_models,

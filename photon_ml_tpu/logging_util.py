@@ -58,6 +58,49 @@ class RunLogger:
         self._handlers.clear()
 
 
+def log_optimizer_trace(result, label: str,
+                        run_logger: Optional[RunLogger] = None) -> None:
+    """Dump the per-iteration (value, gradient-norm) table to the run log —
+    the reference's ``OptimizationStatesTracker`` dump users read in the
+    photon log (``enableOptimizationStateTracker``). ``result`` is an
+    :class:`photon_ml_tpu.optimize.OptimizerResult` with traces recorded
+    (``track_states=True``)."""
+    import numpy as np
+
+    values = np.asarray(result.values)
+    gnorms = np.asarray(result.grad_norms)
+    if values.size == 0:
+        return  # traces off
+    n = min(int(result.iterations) + 1, len(values))
+    logger.info("%s: optimization states (%d iterations, converged=%s)",
+                label, max(n - 1, 0), bool(result.converged))
+    for i in range(n):
+        if np.isfinite(values[i]):
+            logger.info("%s: iter %4d  f=%.8e  |g|=%.4e",
+                        label, i, values[i], gnorms[i])
+    if run_logger is not None:
+        run_logger.metric(stage="optimizer_states", label=label,
+                          iterations=int(result.iterations),
+                          converged=bool(result.converged),
+                          final_value=float(values[min(n - 1, len(values) - 1)]))
+
+
+@contextlib.contextmanager
+def profiled(output_dir: Optional[str]) -> Iterator[None]:
+    """``jax.profiler`` trace of a stage (SURVEY.md §5.1: the tracing story
+    replacing the reference's Spark-UI/event-log). View with TensorBoard or
+    xprof; no-op when ``output_dir`` is None."""
+    if not output_dir:
+        yield
+        return
+    import jax
+
+    os.makedirs(output_dir, exist_ok=True)
+    with jax.profiler.trace(output_dir):
+        yield
+    logger.info("profiler trace written to %s", output_dir)
+
+
 @contextlib.contextmanager
 def timed(stage: str, run_logger: Optional[RunLogger] = None) -> Iterator[None]:
     """``with timed("Read data"): ...`` — the reference's ``Timed`` wrapper.

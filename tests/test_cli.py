@@ -263,6 +263,34 @@ class TestTrainGameDriver:
         np.testing.assert_allclose(b2["global"], b1["global"], atol=1e-6)
         assert not np.allclose(b2["perUser"], b1["perUser"], atol=1e-4)
 
+    def test_partial_retrain_with_locked_random_effect(self, tmp_path):
+        """Locking the RANDOM-EFFECT coordinate: its entity-id column must
+        still be read (from the input model's metadata) even though the
+        coordinate has no config entry."""
+        train = make_avro_dataset(tmp_path / "train.avro", n=600, seed=0)
+        out1 = str(tmp_path / "r1")
+        train_game_cli.run([
+            "--training-data", train, "--output-dir", out1,
+            "--feature-shards", SHARDS,
+            "--coordinates", *COORDS,
+            "--update-sequence", "global,perUser",
+            "--grid", "global=0.1", "perUser=1",
+        ])
+        val = make_avro_dataset(tmp_path / "val.avro", n=300, seed=4)
+        out2 = str(tmp_path / "r2")
+        r2 = train_game_cli.run([
+            "--training-data", train, "--validation-data", val,
+            "--output-dir", out2,
+            "--feature-shards", SHARDS,
+            "--coordinates", COORDS[0],  # only the fixed effect configured
+            "--update-sequence", "global,perUser",
+            "--model-input-dir", out1,
+            "--locked-coordinates", "perUser",
+            "--grid", "global=1.0",
+            "--evaluators", "AUC",
+        ])
+        assert r2["best_evaluation"]["AUC"] > 0.6
+
     def test_checkpoint_resume_roundtrip(self, tmp_path):
         """--checkpoint writes coordinate-boundary state; --resume restores
         and completes to the same model as an uninterrupted run."""

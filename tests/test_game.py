@@ -28,6 +28,7 @@ from photon_ml_tpu.game.estimator import (
 )
 from photon_ml_tpu.game.random_effect import RandomEffectSolver
 from photon_ml_tpu.glm.problem import GLMOptimizationConfiguration
+from photon_ml_tpu.testing import dense_shard
 from photon_ml_tpu.ops.regularization import L2Regularization
 from photon_ml_tpu.optimize import OptimizerConfig
 from photon_ml_tpu.types import TaskType
@@ -53,15 +54,9 @@ def make_mixed_data(n=2000, d_fixed=8, d_re=4, n_entities=37, seed=0,
     margin = xf @ w_fixed + np.einsum("nd,nd->n", xr, u[ent])
     y = (rng.uniform(size=n) < 1 / (1 + np.exp(-margin))).astype(np.float32)
 
-    def shard_from_dense(x):
-        n_, d_ = x.shape
-        rows = np.repeat(np.arange(n_), d_)
-        cols = np.tile(np.arange(d_, dtype=np.int32), n_)
-        return FeatureShard.from_coo(rows, cols, x.ravel(), n_, d_)
-
     data = GameData.build(
         labels=y,
-        shards={"fixed": shard_from_dense(xf), "re": shard_from_dense(xr)},
+        shards={"fixed": dense_shard(xf), "re": dense_shard(xr)},
         id_columns={"entityId": ent},
     )
     return data, (xf, xr, ent, w_fixed, u)
@@ -332,15 +327,9 @@ def make_music_data(n=4000, d_global=6, d_item=3, n_users=25, n_songs=15,
               + np.einsum("nd,nd->n", xi, u_artist[artists]))
     y = (rng.uniform(size=n) < 1 / (1 + np.exp(-margin))).astype(np.float32)
 
-    def sfd(x):
-        nn, dd = x.shape
-        return FeatureShard.from_coo(
-            np.repeat(np.arange(nn), dd), np.tile(np.arange(dd), nn),
-            x.ravel(), nn, dd)
-
     return GameData.build(
         labels=y,
-        shards={"global": sfd(xg), "item": sfd(xi)},
+        shards={"global": dense_shard(xg), "item": dense_shard(xi)},
         id_columns={"userId": users, "songId": songs, "artistId": artists})
 
 
@@ -463,13 +452,7 @@ class TestFactoredRandomEffect:
         margin = np.einsum("nd,nd->n", xr, u[ent])
         y = (rng.uniform(size=n) < 1 / (1 + np.exp(-margin))).astype(np.float32)
 
-        def sfd(x):
-            nn, dd = x.shape
-            return FeatureShard.from_coo(
-                np.repeat(np.arange(nn), dd), np.tile(np.arange(dd), nn),
-                x.ravel(), nn, dd)
-
-        return GameData.build(labels=y, shards={"re": sfd(xr)},
+        return GameData.build(labels=y, shards={"re": dense_shard(xr)},
                               id_columns={"entityId": ent})
 
     def test_factored_design_matches_explicit_kron(self):

@@ -1,0 +1,36 @@
+"""Tests for the public photon_ml_tpu.testing module (photon-test-utils)."""
+
+import numpy as np
+
+from photon_ml_tpu import testing as ptu
+
+
+class TestGenerators:
+    def test_make_classification(self):
+        data, x, labels = ptu.make_classification(n=100, d=5, intercept=True,
+                                                  weights=True)
+        assert data.n_samples == 100 and data.dim == 6
+        assert x.shape == (100, 6) and (x[:, -1] == 1.0).all()
+        assert set(np.unique(labels)) <= {0.0, 1.0}
+        assert (np.asarray(data.weights) > 0).all()
+
+    def test_make_mixed_effect(self):
+        data, (xf, xr, ent, w, u) = ptu.make_mixed_effect(
+            n=300, n_entities=7, entity_column="userId")
+        assert data.n_samples == 300
+        assert set(data.shards) == {"fixed", "re"}
+        assert data.id_columns["userId"].max() < 7
+
+    def test_finite_difference_matches_autodiff(self):
+        import jax
+
+        from photon_ml_tpu.ops.losses import LogisticLoss
+        from photon_ml_tpu.ops.objective import GLMObjective
+
+        data, _, _ = ptu.make_classification(n=50, d=4, seed=3)
+        obj = GLMObjective(loss=LogisticLoss)
+        w = np.random.default_rng(0).normal(size=4)
+        fd = ptu.finite_difference_gradient(
+            lambda wv: obj.value(wv, data, 0.5), w)
+        ad = np.asarray(jax.grad(lambda wv: obj.value(wv, data, 0.5))(w))
+        ptu.assert_allclose_coefficients(ad, fd, atol=1e-5)
