@@ -223,6 +223,8 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
 
             checkpoint = CheckpointManager(
                 os.path.join(args.output_dir, "checkpoints"))
+        profile_dir = (os.path.join(args.output_dir, "profile")
+                       if args.profile else None)
 
         if args.tuning == "NONE":
             grid = parse_grid(args.grid)
@@ -237,9 +239,7 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                                  "grid (got %d configs)" % len(configurations))
             from photon_ml_tpu.logging_util import profiled
 
-            with timed("Train (grid)", run_logger), profiled(
-                    os.path.join(args.output_dir, "profile")
-                    if args.profile else None):
+            with timed("Train (grid)", run_logger), profiled(profile_dir):
                 results = est.fit(data, configurations, validation=validation,
                                   initial_models=initial_models, locked=locked,
                                   checkpoint=checkpoint, resume=args.resume)
@@ -275,9 +275,8 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                           else RandomSearch)
             from photon_ml_tpu.logging_util import profiled
 
-            with timed(f"Train ({args.tuning} tuning)", run_logger), profiled(
-                    os.path.join(args.output_dir, "profile")
-                    if args.profile else None):
+            with timed(f"Train ({args.tuning} tuning)", run_logger), \
+                    profiled(profile_dir):
                 if args.tuning == "BAYESIAN":
                     search_cls(space, maximize=maximize).find(
                         evaluate, args.tuning_iterations)

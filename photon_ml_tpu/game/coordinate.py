@@ -55,6 +55,32 @@ def _fixed_train_fn(task: TaskType, config: GLMOptimizationConfiguration):
     return train
 
 
+@lru_cache(maxsize=None)
+def _fixed_train_fn_dist(task: TaskType, config: GLMOptimizationConfiguration,
+                         mesh):
+    """Mesh-sharded variant: the same OptimizationProblem drives the
+    shard_map/psum objective (the collapse of the reference's Distributed vs
+    SingleNode class split — SURVEY.md §2.3). ``data`` is the stacked
+    per-device layout from ``shard_glm_data``."""
+    from photon_ml_tpu.parallel.distributed import DistributedGLMObjective
+
+    dist = DistributedGLMObjective(
+        objective=GLMObjective(loss=loss_for_task(task)), mesh=mesh)
+    problem = OptimizationProblem(dist, config)
+
+    @jax.jit
+    def train(data, w0, lam):
+        result = problem.run(data, w0, lam)
+        variances = problem.compute_variances(result.w, data, lam)
+        # offset-free margins: CD owns the additive-score accounting
+        no_off = dataclasses.replace(
+            data, offsets=jnp.zeros_like(data.offsets))
+        scores = dist.margins(result.w, no_off)  # (n_shards, per)
+        return result, variances, scores
+
+    return train
+
+
 @dataclasses.dataclass(frozen=True)
 class FixedEffectCoordinate:
     """Cluster-wide GLM solve for the global coordinate
@@ -87,14 +113,22 @@ class FixedEffectCoordinate:
         w0 = (jnp.zeros((self.dataset.dim,), jnp.float32)
               if warm_start is None
               else jnp.asarray(warm_start.model.coefficients.means))
-        result, variances, scores = _fixed_train_fn(self.task, self.config)(
+        if self.dataset.n_shards > 1:
+            train_fn = _fixed_train_fn_dist(self.task, self.config,
+                                            self.dataset.mesh)
+        else:
+            train_fn = _fixed_train_fn(self.task, self.config)
+        result, variances, scores = train_fn(
             data, w0, jnp.asarray(self.lam, jnp.float32))
+        scores = np.asarray(scores, np.float32).reshape(-1)
+        if self.dataset.n_shards > 1:
+            scores = scores[:self.dataset.n_samples]  # drop tail padding
         model = FixedEffectModel(
             model=GeneralizedLinearModel(
                 coefficients=Coefficients(means=result.w, variances=variances),
                 task=self.task),
             feature_shard_id=self.dataset.feature_shard_id)
-        return model, np.asarray(scores, np.float32)
+        return model, scores
 
 
 @dataclasses.dataclass(frozen=True)

@@ -166,35 +166,91 @@ class FixedEffectDataset:
     (reference ``data/FixedEffectDataset.scala``).
 
     Holds the device arrays minus offsets — coordinate descent supplies
-    fresh residual offsets every sweep via :meth:`with_offsets`.
+    fresh residual offsets every sweep via :meth:`glm_data`.
+
+    With a ``mesh`` carrying a ``"data"`` axis, the design/labels/weights
+    are built ONCE in the stacked per-device layout of
+    :func:`photon_ml_tpu.parallel.distributed.shard_glm_data` (the
+    reference's RDD partitioning); only the per-sweep offsets are re-placed.
     """
 
     coordinate_id: str
     feature_shard_id: str
-    design: object  # DenseDesign | CsrDesign (device)
+    design: object  # DenseDesign | CsrDesign (device; stacked when sharded)
     labels: jnp.ndarray
     weights: jnp.ndarray
     dim: int
+    n_samples: int = 0
+    mesh: Optional[object] = None  # jax.sharding.Mesh with a "data" axis
+    n_shards: int = 1
 
     @staticmethod
     def build(coordinate_id: str, data: GameData, feature_shard_id: str,
               *, dense_max_dim: int = DENSE_DESIGN_MAX_DIM,
-              dtype=jnp.float32) -> "FixedEffectDataset":
+              dtype=jnp.float32, mesh=None) -> "FixedEffectDataset":
         shard = data.shards[feature_shard_id]
+        # host-resident design first: the sharded branch pads/splits on host
+        # and device_puts per-shard blocks directly — never materializing
+        # the full design in one device's HBM (the whole point of dp)
         if shard.dim <= dense_max_dim:
-            design = DenseDesign(x=jnp.asarray(shard.to_dense(), dtype))
+            host_design = DenseDesign(x=shard.to_dense())
+        else:
+            host_design = CsrDesign(
+                rows=shard.rows().astype(np.int32),
+                cols=shard.cols.astype(np.int32),
+                values=shard.vals,
+                n_rows=shard.n_samples, n_cols=shard.dim)
+
+        from photon_ml_tpu.parallel.mesh import DATA_AXIS
+
+        n_shards = 1
+        if mesh is not None and DATA_AXIS in getattr(mesh, "shape", {}):
+            n_shards = int(mesh.shape[DATA_AXIS])
+        if n_shards > 1:
+            from photon_ml_tpu.parallel.distributed import shard_glm_data
+
+            sharded = shard_glm_data(
+                GLMData(design=host_design, labels=data.labels,
+                        offsets=np.zeros(shard.n_samples, np.float32),
+                        weights=data.weights),
+                n_shards, device_put_mesh=mesh)
+            return FixedEffectDataset(
+                coordinate_id=coordinate_id,
+                feature_shard_id=feature_shard_id,
+                design=sharded.design, labels=sharded.labels,
+                weights=sharded.weights, dim=shard.dim,
+                n_samples=shard.n_samples, mesh=mesh, n_shards=n_shards)
+        if isinstance(host_design, DenseDesign):
+            design = DenseDesign(x=jnp.asarray(host_design.x, dtype))
         else:
             design = CsrDesign(
-                rows=jnp.asarray(shard.rows(), jnp.int32),
-                cols=jnp.asarray(shard.cols, jnp.int32),
-                values=jnp.asarray(shard.vals),
-                n_rows=shard.n_samples, n_cols=shard.dim)
+                rows=jnp.asarray(host_design.rows),
+                cols=jnp.asarray(host_design.cols),
+                values=jnp.asarray(host_design.values),
+                n_rows=host_design.n_rows, n_cols=host_design.n_cols)
         return FixedEffectDataset(
             coordinate_id=coordinate_id, feature_shard_id=feature_shard_id,
             design=design, labels=jnp.asarray(data.labels),
-            weights=jnp.asarray(data.weights), dim=shard.dim)
+            weights=jnp.asarray(data.weights), dim=shard.dim,
+            n_samples=shard.n_samples)
 
     def glm_data(self, offsets) -> GLMData:
+        offsets = np.asarray(offsets, np.float32)
+        if self.n_shards > 1:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            from photon_ml_tpu.parallel.mesh import DATA_AXIS
+
+            per = self.labels.shape[1]
+            padded = np.zeros(self.n_shards * per, np.float32)
+            padded[:len(offsets)] = offsets
+            import jax
+
+            off = jax.device_put(
+                padded.reshape(self.n_shards, per),
+                NamedSharding(self.mesh, PartitionSpec(DATA_AXIS)))
+            return GLMData(design=self.design, labels=self.labels,
+                           offsets=off, weights=self.weights)
         return GLMData(design=self.design, labels=self.labels,
                        offsets=jnp.asarray(offsets, jnp.float32),
                        weights=self.weights)

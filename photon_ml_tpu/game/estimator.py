@@ -104,9 +104,11 @@ class GameResult:
 class GameEstimator:
     """Fits GAME models over a training set for many configurations.
 
-    ``mesh`` (a :class:`jax.sharding.Mesh` with an ``"entity"`` axis) turns on
-    entity-parallel random-effect solves for every RE coordinate — the
-    multi-chip layout ``dryrun_multichip`` validates.
+    ``mesh`` turns on multi-chip training: a ``"data"`` axis shards every
+    fixed-effect solve (psum gradients inside the compiled optimizer), an
+    ``"entity"`` axis shards every random-effect coordinate's bucket lanes.
+    A 2D ``{"data": a, "entity": b}`` mesh does both — the layout
+    ``dryrun_multichip`` validates.
     """
 
     task: TaskType
@@ -146,7 +148,7 @@ class GameEstimator:
             cfg = self.coordinate_configs[cid]
             if isinstance(cfg, FixedEffectCoordinateConfig):
                 datasets[cid] = FixedEffectDataset.build(
-                    cid, data, cfg.feature_shard_id)
+                    cid, data, cfg.feature_shard_id, mesh=self.mesh)
             elif isinstance(cfg, FactoredRandomEffectCoordinateConfig):
                 # rebuilt each alternation around the learned projection
                 datasets[cid] = None

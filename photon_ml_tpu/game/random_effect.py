@@ -59,6 +59,11 @@ class RandomEffectSolver:
     entity_axis: str = ENTITY_AXIS
 
     def __post_init__(self):
+        if (self.mesh is not None
+                and self.entity_axis not in getattr(self.mesh, "shape", {})):
+            # a data-only (or feature-only) mesh has no entity lanes to
+            # shard over — solve unsharded rather than KeyError
+            object.__setattr__(self, "mesh", None)
         if self.config.optimizer_config.track_states:
             # traces would be carried per entity lane; force off
             object.__setattr__(self, "config", dataclasses.replace(

@@ -184,6 +184,32 @@ class DistributedGLMObjective:
         return shard_map(local, mesh=self.mesh,
                          in_specs=(P(), P(self.axis)), out_specs=P(self.axis))(w, sharded)
 
+    # --- second-order contractions (variance computation) ------------------
+    def _psum_of_local(self, fn_name: str, w: Array, sharded: GLMData):
+        """psum of a per-shard l2-free contraction; L2 added once outside."""
+        def body(wv, blk):
+            local = getattr(self.objective, fn_name)(wv, _unstack(blk), 0.0)
+            return jax.lax.psum(local, self.axis)
+
+        return shard_map(body, mesh=self.mesh,
+                         in_specs=(P(), P(self.axis)), out_specs=P())(w, sharded)
+
+    def hessian_diagonal(self, w: Array, sharded: GLMData, l2=0.0) -> Array:
+        """Distributed VarianceComputationType SIMPLE (the reference's
+        ``HessianDiagonalAggregator`` treeAggregate)."""
+        diag = self._psum_of_local("hessian_diagonal", w, sharded)
+        if self.objective.reg_mask is None:
+            return diag + l2
+        return diag + l2 * self.objective.reg_mask
+
+    def hessian_matrix(self, w: Array, sharded: GLMData, l2=0.0) -> Array:
+        """Distributed VarianceComputationType FULL
+        (``HessianMatrixAggregator``)."""
+        h = self._psum_of_local("hessian_matrix", w, sharded)
+        d = w.shape[0]
+        reg = l2 if self.objective.reg_mask is None else l2 * self.objective.reg_mask
+        return h + jnp.diag(jnp.broadcast_to(reg, (d,)))
+
 
 # ---------------------------------------------------------------------------
 # Feature-dimension (tensor-parallel) sharding

@@ -31,7 +31,9 @@ class DownSampler:
         """``sweep`` must vary per CD iteration so each sweep draws a fresh
         sample (the reference creates a new sampled RDD per iteration)."""
         rng = np.random.default_rng((self.seed, sweep))
-        keep = rng.uniform(size=labels.shape[0]) < self.rate
+        # size=shape (not shape[0]): the sharded fixed-effect path hands in
+        # the stacked (n_shards, per) layout
+        keep = rng.uniform(size=labels.shape) < self.rate
         out = np.where(keep, weights / self.rate, 0.0).astype(np.float32)
         return out
 
@@ -46,7 +48,7 @@ class BinaryClassificationDownSampler(DownSampler):
                    sweep: int = 0) -> np.ndarray:
         rng = np.random.default_rng((self.seed, sweep))
         pos = labels > 0.5
-        keep_neg = rng.uniform(size=labels.shape[0]) < self.rate
+        keep_neg = rng.uniform(size=labels.shape) < self.rate
         out = np.where(pos, weights,
                        np.where(keep_neg, weights / self.rate, 0.0))
         return out.astype(np.float32)

@@ -1,29 +1,24 @@
 """Test fixture: force JAX onto CPU with 8 virtual devices.
 
 The moral equivalent of the reference's ``SparkTestUtils.sparkTest`` local[*]
-fixture (``photon-test-utils/.../test/SparkTestUtils.scala``): the *same*
-pjit/shard_map code paths used on a real TPU slice run here on a simulated
-8-device host mesh, so distributed tests need no hardware.
+fixture (``photon-test-utils/.../test/SparkTestUtils.scala``), provided by
+the PUBLIC :mod:`photon_ml_tpu.testing` module (this repo eats its own
+test-utils dog food): the *same* pjit/shard_map code paths used on a real
+TPU slice run here on a simulated 8-device host mesh.
 
-Must run before any ``import jax`` resolves a backend, hence the env mutation
-at conftest import time.
+Must run before any backend resolves, hence at conftest import time. NOTE:
+this environment's sitecustomize.py imports jax at interpreter start and
+registers the axon TPU plugin, capturing the ambient JAX_PLATFORMS=axon
+before any conftest code runs — ``virtual_devices``'s
+``jax.config.update("jax_platforms", "cpu")`` (not env mutation) is what
+reliably pins tests to CPU.
 """
 
-import os
+from photon_ml_tpu.testing import virtual_devices
 
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+virtual_devices(8, force_cpu=True)
 
 import jax  # noqa: E402
-
-# NOTE: this environment's sitecustomize.py imports jax at interpreter start
-# and registers the axon TPU plugin, capturing the ambient JAX_PLATFORMS=axon
-# before any conftest code runs — so mutating os.environ here is too late.
-# jax.config.update after import is the reliable way to pin tests to CPU.
-jax.config.update("jax_platforms", "cpu")
 
 # x64 on the CPU test backend so finite-difference numeric checks are sharp;
 # production code paths stay f32/bf16 on TPU.

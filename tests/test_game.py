@@ -274,10 +274,13 @@ class TestGameEstimator:
         assert best.evaluation.primary[1] == max(vals)
 
     def test_fit_with_entity_mesh_matches_unsharded(self):
-        """End-to-end estimator path with mesh= set (EP random effects)."""
+        """End-to-end estimator path with a 2D dp x ep mesh: the fixed
+        effect shards samples over 'data' (psum'd compiled L-BFGS) and the
+        random effect shards entity lanes over 'entity' — results must match
+        the unsharded fit."""
         import jax
 
-        from photon_ml_tpu.parallel.mesh import ENTITY_AXIS, make_mesh
+        from photon_ml_tpu.parallel.mesh import DATA_AXIS, ENTITY_AXIS, make_mesh
 
         data, _ = make_mixed_data(n=800, n_entities=11)
 
@@ -299,11 +302,16 @@ class TestGameEstimator:
 
         grid = [GameOptimizationConfiguration({"global": 0.01, "perEntity": 1.0})]
         r0 = build(None).fit(data, grid)[0]
-        mesh = make_mesh({ENTITY_AXIS: 8}, devices=jax.devices())
+        mesh = make_mesh({DATA_AXIS: 4, ENTITY_AXIS: 2}, devices=jax.devices())
         r1 = build(mesh).fit(data, grid)[0]
         s0 = r0.model.score(data)
         s1 = r1.model.score(data)
         np.testing.assert_allclose(s1, s0, atol=2e-3)
+        fe0 = np.asarray(
+            r0.model.coordinates["global"].model.coefficients.means)
+        fe1 = np.asarray(
+            r1.model.coordinates["global"].model.coefficients.means)
+        np.testing.assert_allclose(fe1, fe0, atol=2e-3)
 
 
 def make_music_data(n=4000, d_global=6, d_item=3, n_users=25, n_songs=15,
