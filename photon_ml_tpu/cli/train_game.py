@@ -87,7 +87,34 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile", action="store_true",
                    help="write a jax.profiler trace of the training stage "
                         "to <output-dir>/profile (view with TensorBoard)")
+    p.add_argument("--mesh", default="",
+                   help="device mesh axes, e.g. 'data=4,entity=2': shards "
+                        "fixed-effect samples over 'data' (psum'd compiled "
+                        "optimizer) and random-effect entity lanes over "
+                        "'entity'. Default: single device")
     return p
+
+
+def parse_mesh(spec: str):
+    """'data=4,entity=2' → Mesh (None when empty)."""
+    if not spec:
+        return None
+    from photon_ml_tpu.parallel.mesh import make_mesh
+
+    axes = {}
+    for part in spec.split(","):
+        name, _, size = part.partition("=")
+        name = name.strip()
+        try:
+            axes[name] = int(size)
+        except ValueError:
+            raise SystemExit(f"bad --mesh entry {part!r}; want axis=<int>")
+        if name not in ("data", "entity", "feature"):
+            raise SystemExit(
+                f"unknown mesh axis {name!r}; choose from data/entity/feature")
+        if axes[name] < 1:
+            raise SystemExit(f"mesh axis {name!r} must be >= 1, got {axes[name]}")
+    return make_mesh(axes)
 
 
 def parse_input_columns(spec: str):
@@ -126,6 +153,9 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
 
     args = build_parser().parse_args(argv)
     task = TaskType(args.task)
+    # fail fast on a bad mesh spec / device-count mismatch, BEFORE the
+    # (potentially long) Avro reads
+    mesh = parse_mesh(args.mesh)
     if args.debug_nans:
         import jax
 
@@ -215,7 +245,7 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
 
         est = GameEstimator(task=task, coordinate_configs=coordinate_configs,
                             update_sequence=update_sequence,
-                            n_cd_iterations=args.cd_iterations)
+                            n_cd_iterations=args.cd_iterations, mesh=mesh)
 
         checkpoint = None
         if args.checkpoint or args.resume:

@@ -362,6 +362,30 @@ class TestTrainGameDriver:
                 "--grid", "perUser=1",
             ])
 
+    def test_mesh_flag_trains_sharded(self, tmp_path):
+        """--mesh data=4,entity=2 runs the dp x ep estimator path."""
+        from photon_ml_tpu.cli.train_game import parse_mesh
+
+        assert parse_mesh("") is None
+        with pytest.raises(SystemExit):
+            parse_mesh("bogus=2")
+        with pytest.raises(SystemExit):
+            parse_mesh("data=x")
+
+        train = make_avro_dataset(tmp_path / "train.avro", n=600, seed=0)
+        val = make_avro_dataset(tmp_path / "val.avro", n=300, seed=2)
+        r = train_game_cli.run([
+            "--training-data", train, "--validation-data", val,
+            "--output-dir", str(tmp_path / "mesh-out"),
+            "--feature-shards", SHARDS,
+            "--coordinates", *COORDS,
+            "--update-sequence", "global,perUser",
+            "--grid", "global=0.1", "perUser=1",
+            "--evaluators", "AUC",
+            "--mesh", "data=4,entity=2",
+        ])
+        assert r["best_evaluation"]["AUC"] > 0.65
+
     def test_bayesian_tuning(self, tmp_path):
         train = make_avro_dataset(tmp_path / "train.avro", n=500, seed=0)
         val = make_avro_dataset(tmp_path / "val.avro", n=300, seed=3)
