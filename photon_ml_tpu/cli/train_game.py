@@ -105,6 +105,8 @@ def parse_mesh(spec: str):
     for part in spec.split(","):
         name, _, size = part.partition("=")
         name = name.strip()
+        if name in axes:
+            raise SystemExit(f"duplicate mesh axis {name!r}")
         try:
             axes[name] = int(size)
         except ValueError:
@@ -114,7 +116,10 @@ def parse_mesh(spec: str):
                 f"unknown mesh axis {name!r}; choose from data/entity/feature")
         if axes[name] < 1:
             raise SystemExit(f"mesh axis {name!r} must be >= 1, got {axes[name]}")
-    return make_mesh(axes)
+    try:
+        return make_mesh(axes)
+    except ValueError as e:  # e.g. more devices requested than available
+        raise SystemExit(f"--mesh {spec!r}: {e}")
 
 
 def parse_input_columns(spec: str):

@@ -22,7 +22,12 @@ from photon_ml_tpu.data_validation import validate_game_data
 from photon_ml_tpu.evaluation import parse_evaluators
 from photon_ml_tpu.glm.problem import GLMOptimizationConfiguration
 from photon_ml_tpu.glm.training import train_glm_sweep, validate_and_select
-from photon_ml_tpu.io import AvroDataReader, FeatureShardConfig, save_glm_model
+from photon_ml_tpu.io import (
+    AvroDataReader,
+    FeatureShardConfig,
+    save_glm_model,
+    save_glm_model_text,
+)
 from photon_ml_tpu.io.avro import write_avro_file
 from photon_ml_tpu.io.schemas import FEATURE_SUMMARIZATION_RESULT_AVRO
 from photon_ml_tpu.logging_util import RunLogger, timed
@@ -273,13 +278,18 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
             save_glm_model(
                 os.path.join(args.output_dir, "best", "model.avro"),
                 best.model, imap, model_id="best")
+            # the reference driver writes text AND Avro models
+            save_glm_model_text(
+                os.path.join(args.output_dir, "best", "model.txt"),
+                best.model, imap)
             for tm in trained:
+                out_dir = os.path.join(args.output_dir, "all",
+                                       f"lambda-{tm.regularization_weight:g}")
                 save_glm_model(
-                    os.path.join(args.output_dir, "all",
-                                 f"lambda-{tm.regularization_weight:g}",
-                                 "model.avro"),
-                    tm.model, imap,
+                    os.path.join(out_dir, "model.avro"), tm.model, imap,
                     model_id=f"lambda-{tm.regularization_weight:g}")
+                save_glm_model_text(
+                    os.path.join(out_dir, "model.txt"), tm.model, imap)
         report_path = None
         if args.training_diagnostics:
             # the DIAGNOSED stage of the reference driver's state machine

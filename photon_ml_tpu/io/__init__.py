@@ -23,5 +23,6 @@ from photon_ml_tpu.io.model_io import (  # noqa: F401
     load_glm_model,
     save_game_model,
     save_glm_model,
+    save_glm_model_text,
 )
 from photon_ml_tpu.io.checkpoint import CheckpointManager  # noqa: F401

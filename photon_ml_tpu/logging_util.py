@@ -74,10 +74,26 @@ def log_optimizer_trace(result, label: str,
     n = min(int(result.iterations) + 1, len(values))
     logger.info("%s: optimization states (%d iterations, converged=%s)",
                 label, max(n - 1, 0), bool(result.converged))
+    # collapse runs of CONSECUTIVE identical finite (value, |g|) lines — a
+    # stalled tail would otherwise spam max_iterations copies of one state;
+    # a non-finite entry breaks a run and is logged explicitly
+    run_start = None
+    run_end = None
     for i in range(n):
-        if np.isfinite(values[i]):
-            logger.info("%s: iter %4d  f=%.8e  |g|=%.4e",
-                        label, i, values[i], gnorms[i])
+        same = (run_start is not None and np.isfinite(values[i])
+                and i == run_end + 1
+                and values[i] == values[run_start]
+                and gnorms[i] == gnorms[run_start])
+        if same:
+            run_end = i
+            continue
+        if run_start is not None and run_end > run_start:
+            logger.info("%s:   ... unchanged through iter %d", label, run_end)
+        logger.info("%s: iter %4d  f=%.8e  |g|=%.4e",
+                    label, i, values[i], gnorms[i])
+        run_start = run_end = i
+    if run_start is not None and run_end > run_start:
+        logger.info("%s:   ... unchanged through iter %d", label, run_end)
     if run_logger is not None:
         run_logger.metric(stage="optimizer_states", label=label,
                           iterations=int(result.iterations),

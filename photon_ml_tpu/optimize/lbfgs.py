@@ -61,6 +61,7 @@ class _State:
     it: Array
     converged: Array
     failed: Array  # line search found no decrease
+    stalls: Array  # int32: consecutive accepted steps with zero fp progress
     values: Array
     grad_norms: Array
 
@@ -143,6 +144,7 @@ def minimize_lbfgs(fun: ValueAndGrad, w0: Array,
         it=jnp.int32(0),
         converged=gnorm0 <= tol,
         failed=jnp.asarray(False),
+        stalls=jnp.int32(0),
         values=values, grad_norms=gnorms,
     )
 
@@ -171,6 +173,12 @@ def minimize_lbfgs(fun: ValueAndGrad, w0: Array,
         values, gnorms = record_trace(
             s.values, s.grad_norms, it,
             jnp.where(ok, f_new, s.f), jnp.where(ok, gnorm, jnp.linalg.norm(s.g)))
+        # Stall: an "accepted" step with no representable decrease (the
+        # Armijo bound rounds to f at working precision). A single flat step
+        # can still precede useful movement near the optimum, so require TWO
+        # consecutive stalls before terminating; convergence is still judged
+        # by the gradient test alone.
+        stalls = jnp.where(ok & (f_new >= s.f), s.stalls + 1, jnp.int32(0))
         return _State(
             w=jnp.where(ok, w_new, s.w),
             f=jnp.where(ok, f_new, s.f),
@@ -178,7 +186,8 @@ def minimize_lbfgs(fun: ValueAndGrad, w0: Array,
             s_hist=s_hist, y_hist=y_hist, rho=rho, n_pairs=n_pairs,
             it=it,
             converged=ok & (gnorm <= tol),
-            failed=~ok,
+            failed=(~ok) | (stalls >= 2),
+            stalls=stalls,
             values=values, grad_norms=gnorms,
         )
 

@@ -80,6 +80,7 @@ def minimize_owlqn(fun: ValueAndGrad, w0: Array, l1_weight,
         rho=jnp.zeros((m,), w0.dtype),
         n_pairs=jnp.int32(0), it=jnp.int32(0),
         converged=pgnorm0 <= tol, failed=jnp.asarray(False),
+        stalls=jnp.int32(0),
         values=values, grad_norms=gnorms,
     )
 
@@ -126,13 +127,17 @@ def minimize_owlqn(fun: ValueAndGrad, w0: Array, l1_weight,
             s.values, s.grad_norms, it,
             jnp.where(ok, f_new, s.f),
             jnp.where(ok, pgnorm, jnp.linalg.norm(s.pg)))
+        # stall termination: two consecutive accepted steps with no
+        # representable decrease (see minimize_lbfgs)
+        stalls = jnp.where(ok & (f_new >= s.f), s.stalls + 1, jnp.int32(0))
         return State(
             w=jnp.where(ok, w_new, s.w),
             f=jnp.where(ok, f_new, s.f),
             g=jnp.where(ok, g_new, s.g),
             pg=jnp.where(ok, pg_new, s.pg),
             s_hist=s_hist, y_hist=y_hist, rho=rho, n_pairs=n_pairs,
-            it=it, converged=ok & (pgnorm <= tol), failed=~ok,
+            it=it, converged=ok & (pgnorm <= tol),
+            failed=(~ok) | (stalls >= 2), stalls=stalls,
             values=values, grad_norms=gnorms,
         )
 
@@ -158,5 +163,6 @@ class _State:
     it: Array
     converged: Array
     failed: Array
+    stalls: Array
     values: Array
     grad_norms: Array

@@ -104,6 +104,13 @@ class TestTrainGlmDriver:
         assert os.path.exists(os.path.join(out, "metrics.jsonl"))
         # fixed effect alone on this data should clear AUC 0.6 easily
         assert result["best_evaluation"]["AUC"] > 0.6
+        # text model alongside the Avro (reference Driver writes both):
+        # tab-separated name/term/value lines, |value|-descending
+        with open(os.path.join(out, "best", "model.txt")) as f:
+            lines = [ln.rstrip("\n").split("\t") for ln in f]
+        assert lines and all(len(ln) == 3 for ln in lines)
+        vals = [abs(float(v)) for _, _, v in lines]
+        assert vals == sorted(vals, reverse=True)
 
     def test_training_diagnostics(self, tmp_path):
         train = make_avro_dataset(tmp_path / "train.avro", n=500, seed=0)

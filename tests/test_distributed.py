@@ -198,6 +198,8 @@ class TestFeatureShardedObjective:
         res_tp = jax.jit(lambda w: minimize_lbfgs(
             lambda wv: tp.value_and_grad(wv, sharded, l2), w, cfg))(
                 jnp.zeros(d_pad))
+        # both runs stop at the shared optimum, but stall termination may
+        # trigger an iteration apart — compare at solver, not fp, precision
         np.testing.assert_allclose(np.asarray(res_tp.w)[:data.dim],
-                                   np.asarray(res_local.w), atol=1e-8)
+                                   np.asarray(res_local.w), atol=1e-6)
         np.testing.assert_array_equal(np.asarray(res_tp.w)[data.dim:], 0.0)
