@@ -385,27 +385,35 @@ class RandomEffectDataset:
         uniq, seg_start, seg_count = np.unique(
             ent_sorted, return_index=True, return_counts=True)
 
-        # --- active/passive split per entity ------------------------------
-        active_rows: list[np.ndarray] = []
-        passive_rows: list[np.ndarray] = []
-        act_entity: list[int] = []
+        # --- active/passive split per entity (fully vectorized: no Python
+        # loop over entities — this is the path that must survive the
+        # reference's "hundreds of millions of entities" regime) -----------
+        lower = config.active_data_lower_bound
         upper = config.active_data_upper_bound
-        for e, s0, c in zip(uniq, seg_start, seg_count):
-            rows_e = sample_rows[s0:s0 + c]
-            if c < config.active_data_lower_bound:
-                passive_rows.append(rows_e)
-                continue
-            if upper is not None and c > upper:
-                keep = rng.choice(c, size=upper, replace=False)
-                keep_mask = np.zeros(c, bool)
-                keep_mask[keep] = True
-                active_rows.append(rows_e[keep_mask])
-                passive_rows.append(rows_e[~keep_mask])
-            else:
-                active_rows.append(rows_e)
-            act_entity.append(int(e))
-        passive = (np.concatenate(passive_rows) if passive_rows
-                   else np.zeros((0,), np.int64))
+        n_rows = len(sample_rows)
+        seg_of_row = np.repeat(np.arange(len(uniq)), seg_count)
+        entity_active = seg_count >= lower
+        keep = np.ones(n_rows, bool)
+        if upper is not None:
+            # reservoir-equivalent subsample: random rank within each
+            # entity's segment, keep ranks < upper (uniform without
+            # replacement, one global vectorized pass)
+            keys = rng.random(n_rows)
+            order2 = np.lexsort((keys, seg_of_row))
+            ranks = np.empty(n_rows, np.int64)
+            ranks[order2] = np.arange(n_rows) - np.repeat(seg_start, seg_count)
+            keep = ranks < upper
+        active_mask = entity_active[seg_of_row] & keep
+        passive = sample_rows[~active_mask]
+        all_active = sample_rows[active_mask]
+        active_seg = np.flatnonzero(entity_active)
+        act_entity = uniq[active_seg].astype(np.int64)
+        n_active = len(act_entity)
+        dense_of_seg = np.full(len(uniq), -1, np.int64)
+        dense_of_seg[active_seg] = np.arange(n_active)
+        #: dense active-entity index per active row (rows stay grouped by
+        #: entity and in original order within an entity)
+        ent_of_active = dense_of_seg[seg_of_row[active_mask]]
 
         n_entities_total = int(entities.max()) + 1 if n and present.any() else 0
 
@@ -416,7 +424,8 @@ class RandomEffectDataset:
                 projector = RandomProjector.build(
                     shard.dim, config.projected_dim, config.seed)
             buckets = _random_projection_buckets(
-                data, shard, active_rows, act_entity, projector, config)
+                data, shard, all_active, ent_of_active, act_entity,
+                projector, config)
             return RandomEffectDataset(
                 coordinate_id=coordinate_id, config=config, buckets=buckets,
                 passive_sample_idx=passive,
@@ -426,11 +435,6 @@ class RandomEffectDataset:
         # --- per-entity local feature maps --------------------------------
         # For each active entity: observed shard features (optionally pruned
         # to the top max_active_features by support), compact-indexed.
-        ent_of_active = np.concatenate([
-            np.full(len(r), i, np.int64) for i, r in enumerate(active_rows)
-        ]) if active_rows else np.zeros((0,), np.int64)
-        all_active = (np.concatenate(active_rows) if active_rows
-                      else np.zeros((0,), np.int64))
         sub = shard.take(all_active)  # CSR over active rows, entity-grouped
         nnz_ent = np.repeat(ent_of_active, sub.row_counts())  # entity per nnz
 
@@ -460,19 +464,20 @@ class RandomEffectDataset:
         starts_k = _group_starts(kept_ent)
         counts_k = np.diff(np.append(starts_k, len(kept_ent)))
         local_idx[kept] = np.arange(len(kept_ent)) - np.repeat(starts_k, counts_k)
-        n_feat_per_entity = np.zeros(len(active_rows), np.int64)
+        n_feat_per_entity = np.zeros(n_active, np.int64)
         if len(kept_ent):
             ent_u, ent_c = np.unique(kept_ent, return_counts=True)
             n_feat_per_entity[ent_u] = ent_c
 
-        n_samp_per_entity = np.array([len(r) for r in active_rows], np.int64)
+        n_samp_per_entity = np.bincount(ent_of_active, minlength=n_active
+                                        ).astype(np.int64)
         # one active-row index per nnz (loop-invariant over buckets)
         nnz_rows_local = np.repeat(
             np.arange(len(all_active)), sub.row_counts())
 
         # --- bucketing by (padded samples, padded features) ----------------
         buckets: list[REBucket] = []
-        if len(active_rows):
+        if n_active:
             s_pad = _geom_at_least(n_samp_per_entity, config.sample_bucket_growth)
             d_pad = _geom_at_least(n_feat_per_entity, config.feature_bucket_growth)
             bucket_key = s_pad * np.int64(1 << 40) + d_pad
@@ -484,7 +489,7 @@ class RandomEffectDataset:
                 x = np.zeros((E, S, D), np.float32)
                 feature_index = np.full((E, D), -1, np.int64)
 
-                slot_of_entity = np.full(len(active_rows), -1, np.int64)
+                slot_of_entity = np.full(n_active, -1, np.int64)
                 slot_of_entity[sel] = np.arange(E)
 
                 # features
@@ -509,7 +514,7 @@ class RandomEffectDataset:
                 np.add.at(x, (e_nnz, s_nnz, d_nnz), sub.vals[take])
 
                 buckets.append(REBucket(
-                    entity_ids=np.array([act_entity[i] for i in sel], np.int64),
+                    entity_ids=act_entity[sel],
                     x=x, labels=labels, offsets_zero=True, weights=weights,
                     sample_idx=sample_idx, feature_index=feature_index))
 
@@ -555,8 +560,9 @@ def _bucket_sample_fill(
 def _random_projection_buckets(
     data: GameData,
     shard: FeatureShard,
-    active_rows: list[np.ndarray],
-    act_entity: list[int],
+    all_active: np.ndarray,
+    ent_of_active: np.ndarray,
+    act_entity: np.ndarray,
     projector: RandomProjector,
     config: RandomEffectDatasetConfig,
 ) -> list[REBucket]:
@@ -568,15 +574,13 @@ def _random_projection_buckets(
     ``RandomEffectModel.to_shard_space`` back-projects for export.
     """
     buckets: list[REBucket] = []
-    if not active_rows:
+    n_active = len(act_entity)
+    if not n_active:
         return buckets
-    all_active = np.concatenate(active_rows)
-    ent_of_active = np.concatenate([
-        np.full(len(r), i, np.int64) for i, r in enumerate(active_rows)])
     sub = shard.take(all_active)
     z = projector.project_rows(sub.cols, sub.vals, sub.rows(), len(all_active))
     d = projector.projected_dim
-    n_samp = np.array([len(r) for r in active_rows], np.int64)
+    n_samp = np.bincount(ent_of_active, minlength=n_active).astype(np.int64)
     s_pad = _geom_at_least(n_samp, config.sample_bucket_growth)
     for s_key in np.unique(s_pad):
         sel = np.flatnonzero(s_pad == s_key)
@@ -584,14 +588,14 @@ def _random_projection_buckets(
         x = np.zeros((E, S, d), np.float32)
         feature_index = np.tile(np.arange(d, dtype=np.int64), (E, 1))
 
-        slot_of_entity = np.full(len(active_rows), -1, np.int64)
+        slot_of_entity = np.full(n_active, -1, np.int64)
         slot_of_entity[sel] = np.arange(E)
         labels, weights, sample_idx, rows_sel, pos, es = _bucket_sample_fill(
             data, all_active, ent_of_active, slot_of_entity, sel, S)
         x[es, pos, :] = z[rows_sel]
 
         buckets.append(REBucket(
-            entity_ids=np.array([act_entity[i] for i in sel], np.int64),
+            entity_ids=act_entity[sel],
             x=x, labels=labels, offsets_zero=True, weights=weights,
             sample_idx=sample_idx, feature_index=feature_index))
     return buckets
