@@ -24,4 +24,68 @@ deliberately omitted (see SURVEY.md provenance caveat).
 
 __version__ = "0.1.0"
 
-from photon_ml_tpu.types import TaskType  # noqa: F401
+from photon_ml_tpu.types import (  # noqa: F401
+    DataValidationType,
+    NormalizationType,
+    OptimizerType,
+    RegularizationType,
+    TaskType,
+    VarianceComputationType,
+)
+
+# NOTE: lazy imports keep `import photon_ml_tpu` light (no jax import until
+# a submodule is touched); these are the supported public entry points.
+_PUBLIC = {
+    # core math
+    "GLMData": "photon_ml_tpu.ops.objective",
+    "GLMObjective": "photon_ml_tpu.ops.objective",
+    "DenseDesign": "photon_ml_tpu.ops.design",
+    "CsrDesign": "photon_ml_tpu.ops.design",
+    "loss_for_task": "photon_ml_tpu.ops.losses",
+    # optimizers
+    "OptimizerConfig": "photon_ml_tpu.optimize",
+    "OptimizerResult": "photon_ml_tpu.optimize",
+    "minimize_lbfgs": "photon_ml_tpu.optimize",
+    "minimize_owlqn": "photon_ml_tpu.optimize",
+    "minimize_tron": "photon_ml_tpu.optimize",
+    # GLM training
+    "GLMOptimizationConfiguration": "photon_ml_tpu.glm",
+    "train_glm_sweep": "photon_ml_tpu.glm",
+    # GAME
+    "GameData": "photon_ml_tpu.game",
+    "GameEstimator": "photon_ml_tpu.game",
+    "GameOptimizationConfiguration": "photon_ml_tpu.game",
+    "GameTransformer": "photon_ml_tpu.game",
+    "GameModel": "photon_ml_tpu.game",
+    "CoordinateDescent": "photon_ml_tpu.game",
+    "RandomEffectDatasetConfig": "photon_ml_tpu.game",
+    # evaluation
+    "parse_evaluators": "photon_ml_tpu.evaluation",
+    "evaluate_all": "photon_ml_tpu.evaluation",
+    # IO
+    "AvroDataReader": "photon_ml_tpu.io",
+    "save_game_model": "photon_ml_tpu.io",
+    "load_game_model": "photon_ml_tpu.io",
+    # parallel
+    "make_mesh": "photon_ml_tpu.parallel",
+    "DistributedGLMObjective": "photon_ml_tpu.parallel",
+    "FeatureShardedGLMObjective": "photon_ml_tpu.parallel",
+}
+
+__all__ = sorted(_PUBLIC) + [
+    "DataValidationType", "NormalizationType", "OptimizerType",
+    "RegularizationType", "TaskType", "VarianceComputationType",
+]
+
+
+def __getattr__(name: str):
+    target = _PUBLIC.get(name)
+    if target is None:
+        raise AttributeError(f"module 'photon_ml_tpu' has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(target), name)
+
+
+def __dir__():  # PEP 562 pairing: expose lazy names to dir()/completion
+    return sorted(set(__all__) | set(globals()))

@@ -394,10 +394,13 @@ class RandomEffectDataset:
         seg_of_row = np.repeat(np.arange(len(uniq)), seg_count)
         entity_active = seg_count >= lower
         keep = np.ones(n_rows, bool)
-        if upper is not None:
+        if (upper is not None and seg_count.size
+                and int(seg_count.max()) > upper):
             # reservoir-equivalent subsample: random rank within each
             # entity's segment, keep ranks < upper (uniform without
-            # replacement, one global vectorized pass)
+            # replacement, one global vectorized pass). Skipped entirely
+            # when no entity exceeds the bound — the common case shouldn't
+            # pay the O(n log n) lexsort.
             keys = rng.random(n_rows)
             order2 = np.lexsort((keys, seg_of_row))
             ranks = np.empty(n_rows, np.int64)

@@ -442,6 +442,52 @@ class TestMultiRandomEffect:
         assert all(0.5 < v <= 1.0 for v in d.values()), d
 
 
+class TestWideSparseFixedEffect:
+    def test_csr_fixed_effect_sharded_matches_unsharded(self):
+        """A shard wider than DENSE_DESIGN_MAX_DIM takes the CSR path; the
+        dp-sharded solve must match the unsharded one (the reference's
+        sparse-feature fixed effect regime)."""
+        import jax
+
+        from photon_ml_tpu.ops.design import CsrDesign
+        from photon_ml_tpu.parallel.mesh import DATA_AXIS, make_mesh
+
+        rng = np.random.default_rng(0)
+        n, d, nnz_per_row = 600, 5000, 10  # d > DENSE_DESIGN_MAX_DIM=4096
+        rows = np.repeat(np.arange(n), nnz_per_row)
+        cols = rng.integers(0, d, size=n * nnz_per_row).astype(np.int32)
+        vals = rng.normal(size=n * nnz_per_row).astype(np.float32)
+        y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+        data = GameData.build(
+            labels=y,
+            shards={"wide": FeatureShard.from_coo(rows, cols, vals, n, d)})
+
+        cfg = GLMOptimizationConfiguration(
+            regularization=L2Regularization,
+            optimizer_config=OptimizerConfig(max_iterations=30))
+
+        ds0 = FixedEffectDataset.build("fe", data, "wide")
+        assert isinstance(ds0.design, CsrDesign)
+        c0 = FixedEffectCoordinate(
+            coordinate_id="fe", dataset=ds0,
+            task=TaskType.LOGISTIC_REGRESSION, config=cfg, lam=0.5)
+        m0, s0 = c0.train(np.zeros(n, np.float32))
+
+        mesh = make_mesh({DATA_AXIS: 8}, devices=jax.devices())
+        ds1 = FixedEffectDataset.build("fe", data, "wide", mesh=mesh)
+        assert ds1.n_shards == 8
+        c1 = FixedEffectCoordinate(
+            coordinate_id="fe", dataset=ds1,
+            task=TaskType.LOGISTIC_REGRESSION, config=cfg, lam=0.5)
+        m1, s1 = c1.train(np.zeros(n, np.float32))
+
+        np.testing.assert_allclose(
+            np.asarray(m1.model.coefficients.means),
+            np.asarray(m0.model.coefficients.means), atol=5e-4)
+        np.testing.assert_allclose(s1, s0, atol=5e-4)
+        assert s1.shape == (n,)
+
+
 class TestGameTransformer:
     def test_transform_matches_model_score(self):
         data, _ = make_mixed_data(n=600, n_entities=9)
