@@ -305,6 +305,13 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                 results.append(r)
                 return r.evaluation.primary[1]
 
+            def release_datasets():
+                # tuning holds the datasets across fits; drop the cached
+                # device placements (HBM) once the search is done
+                for ds in datasets.values():
+                    if hasattr(ds, "clear_device_cache"):
+                        ds.clear_device_cache()
+
             maximize = evaluators[0].maximize
             search_cls = (GaussianProcessSearch if args.tuning == "BAYESIAN"
                           else RandomSearch)
@@ -317,6 +324,7 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                         evaluate, args.tuning_iterations)
                 else:
                     search_cls(space).find(evaluate, args.tuning_iterations)
+            release_datasets()
 
         best = GameEstimator.select_best(results)
         for i, r in enumerate(results):

@@ -103,28 +103,36 @@ class RandomEffectSolver:
             out_specs=(s, s, s), check_vma=False,
         )(x, labels, offsets, weights, w0, lam)
 
-    def _place(self, x, labels, offsets, weights, w0):
+    def _put(self, a):
         """Pad the entity dim to the mesh axis size and shard lanes over it.
 
         Padded lanes carry all-zero data and weights, so their gradient is
         exactly the L2 term at w=0 (zero) — they converge immediately and
         their coefficients stay 0; :meth:`train` slices them off.
         """
+        a = np.asarray(a)
         if self.mesh is None:
-            return tuple(jnp.asarray(a) for a in (x, labels, offsets, weights, w0))
+            return jnp.asarray(a)
         n_dev = self.mesh.shape[self.entity_axis]
-        e = x.shape[0]
+        e = a.shape[0]
         e_pad = -(-e // n_dev) * n_dev
-        sharding = NamedSharding(self.mesh, P(self.entity_axis))
+        if e_pad != e:
+            a = np.concatenate(
+                [a, np.zeros((e_pad - e,) + a.shape[1:], a.dtype)])
+        return jax.device_put(a, NamedSharding(self.mesh, P(self.entity_axis)))
 
-        def put(a):
-            a = np.asarray(a)
-            if e_pad != e:
-                a = np.concatenate(
-                    [a, np.zeros((e_pad - e,) + a.shape[1:], a.dtype)])
-            return jax.device_put(a, sharding)
-
-        return tuple(put(a) for a in (x, labels, offsets, weights, w0))
+    def _static_arrays(self, dataset: RandomEffectDataset, i: int,
+                       bucket: REBucket):
+        """Device placements of the per-sweep-invariant bucket arrays,
+        cached on the dataset so each CD sweep re-uploads only the small
+        dynamic inputs (offsets, warm starts)."""
+        key = (i, self.mesh, self.entity_axis)
+        cached = dataset._device_cache.get(key)
+        if cached is None:
+            cached = (self._put(bucket.x), self._put(bucket.labels),
+                      self._put(bucket.weights))
+            dataset._device_cache[key] = cached
+        return cached
 
     @partial(jax.jit, static_argnames=("self",))
     def _margins_bucket(self, x, w):
@@ -157,13 +165,13 @@ class RandomEffectSolver:
         scores = np.zeros(offsets.shape[0], np.float32)
         want_var = self.config.variance_type != VarianceComputationType.NONE
 
-        for bucket in dataset.buckets:
+        for i, bucket in enumerate(dataset.buckets):
             safe_idx = np.maximum(bucket.sample_idx, 0)
             boff = offsets[safe_idx].astype(np.float32) * (bucket.weights > 0)
             w0 = _gather_warm_start(bucket, warm_start, shard_dim)
             e_real = bucket.n_entities
-            x_d, lab_d, off_d, wt_d, w0_d = self._place(
-                bucket.x, bucket.labels, boff, bucket.weights, w0)
+            x_d, lab_d, wt_d = self._static_arrays(dataset, i, bucket)
+            off_d, w0_d = self._put(boff), self._put(w0)
             w_dev, variances, _conv = self._solve_bucket(
                 x_d, lab_d, off_d, wt_d, w0_d, jnp.asarray(lam, jnp.float32))
             # margins from the already-placed design (x is the dominant

@@ -1,0 +1,86 @@
+"""DataValidators tests (reference ``photon-client/.../DataValidators.scala``):
+per-task label legality, finite features/weights/offsets, FULL vs SAMPLE vs
+DISABLED modes."""
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.data_validation import DataValidationError, validate_game_data
+from photon_ml_tpu.game.data import GameData
+from photon_ml_tpu.testing import dense_shard
+from photon_ml_tpu.types import DataValidationType, TaskType
+
+
+def make(labels=None, weights=None, offsets=None, x=None, n=20):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, 3)).astype(np.float32) if x is None else x
+    return GameData.build(
+        labels=np.zeros(n, np.float32) if labels is None else labels,
+        weights=weights, offsets=offsets,
+        shards={"s": dense_shard(x)})
+
+
+class TestValidators:
+    def test_clean_data_passes_all_tasks(self):
+        data = make(labels=np.asarray([0.0, 1.0] * 10, np.float32))
+        for task in TaskType:
+            validate_game_data(data, task)
+
+    def test_binary_tasks_reject_non_binary_labels(self):
+        data = make(labels=np.linspace(0, 2, 20).astype(np.float32))
+        for task in (TaskType.LOGISTIC_REGRESSION,
+                     TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM):
+            with pytest.raises(DataValidationError, match="0/1"):
+                validate_game_data(data, task)
+        # but linear regression accepts them
+        validate_game_data(data, TaskType.LINEAR_REGRESSION)
+
+    def test_poisson_rejects_negative_labels(self):
+        data = make(labels=np.asarray([-1.0] + [1.0] * 19, np.float32))
+        with pytest.raises(DataValidationError, match="labels >= 0"):
+            validate_game_data(data, TaskType.POISSON_REGRESSION)
+
+    def test_nonfinite_rejected_everywhere(self):
+        bad_label = make(labels=np.asarray([np.nan] + [0.0] * 19, np.float32))
+        with pytest.raises(DataValidationError, match="labels"):
+            validate_game_data(bad_label, TaskType.LINEAR_REGRESSION)
+
+        bad_weight = make(weights=np.asarray([-1.0] + [1.0] * 19, np.float32))
+        with pytest.raises(DataValidationError, match="weights"):
+            validate_game_data(bad_weight, TaskType.LINEAR_REGRESSION)
+
+        bad_offset = make(offsets=np.asarray([np.inf] + [0.0] * 19, np.float32))
+        with pytest.raises(DataValidationError, match="offsets"):
+            validate_game_data(bad_offset, TaskType.LINEAR_REGRESSION)
+
+        x = np.ones((20, 3), np.float32)
+        x[3, 1] = np.nan
+        bad_feat = make(x=x)
+        with pytest.raises(DataValidationError, match="feature values"):
+            validate_game_data(bad_feat, TaskType.LINEAR_REGRESSION)
+
+    def test_disabled_skips_everything(self):
+        bad = make(labels=np.full(20, np.nan, np.float32))
+        validate_game_data(bad, TaskType.LINEAR_REGRESSION,
+                           DataValidationType.VALIDATE_DISABLED)
+
+    def test_sample_mode_checks_subset_only(self):
+        # 5 bad rows out of 1000: a 10% sample catches at least one with
+        # p ≈ 1 - 0.9^5 ≈ 0.41 per seed — over 40 seeds, catching
+        # everything or nothing is (0.41^40 / 0.59^40)-improbable even if a
+        # numpy upgrade reshuffles the Generator stream. FULL always raises.
+        labels = np.zeros(1000, np.float32)
+        labels[[100, 300, 500, 700, 900]] = np.nan
+        data = make(labels=labels, n=1000)
+        with pytest.raises(DataValidationError):
+            validate_game_data(data, TaskType.LINEAR_REGRESSION,
+                               DataValidationType.VALIDATE_FULL)
+        caught = 0
+        for seed in range(40):
+            try:
+                validate_game_data(data, TaskType.LINEAR_REGRESSION,
+                                   DataValidationType.VALIDATE_SAMPLE,
+                                   seed=seed)
+            except DataValidationError:
+                caught += 1
+        assert 0 < caught < 40  # it samples: sometimes hits, sometimes not
