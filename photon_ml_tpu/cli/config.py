@@ -33,6 +33,7 @@ from typing import Mapping, Sequence
 from photon_ml_tpu.game.data import RandomEffectDatasetConfig
 from photon_ml_tpu.game.projector import ProjectorType
 from photon_ml_tpu.game.estimator import (
+    FactoredRandomEffectCoordinateConfig,
     FixedEffectCoordinateConfig,
     RandomEffectCoordinateConfig,
 )
@@ -115,9 +116,26 @@ def parse_coordinate_config(spec: str):
         cfg = FixedEffectCoordinateConfig(
             feature_shard_id=shard, optimization=_optimization(kv),
             downsampler=downsampler)
-    elif kind == "random":
+    elif kind in ("random", "factored"):
         entity = kv.pop("entity")
         shard = kv.pop("shard")
+        cache = kv.pop("cacheBuckets", "true").lower()
+        if cache not in ("true", "false"):
+            raise ValueError(
+                f"cacheBuckets must be true or false, got {cache!r}")
+        if kind == "factored":
+            # the learned projection IS the RANDOM projector; accept a
+            # redundant projector=RANDOM, reject anything else
+            projector = kv.pop("projector", "RANDOM").upper()
+            if projector != "RANDOM":
+                raise ValueError(
+                    f"factored coordinates always use the RANDOM projector "
+                    f"(the projection is the trained object); got "
+                    f"projector={projector!r}")
+            projector_type = ProjectorType.RANDOM
+        else:
+            projector_type = ProjectorType(
+                kv.pop("projector", "INDEX_MAP").upper())
         ds = RandomEffectDatasetConfig(
             random_effect_type=entity,
             feature_shard_id=shard,
@@ -126,15 +144,23 @@ def parse_coordinate_config(spec: str):
             active_data_lower_bound=int(kv.pop("activeLower", 1)),
             max_active_features=(int(kv.pop("maxFeatures"))
                                  if "maxFeatures" in kv else None),
-            projector_type=ProjectorType(kv.pop("projector",
-                                                "INDEX_MAP").upper()),
+            projector_type=projector_type,
             projected_dim=(int(kv.pop("projectedDim"))
                            if "projectedDim" in kv else None),
+            cache_device_buckets=cache == "true",
         )
-        cfg = RandomEffectCoordinateConfig(
-            dataset=ds, optimization=_optimization(kv))
+        if kind == "factored":
+            cfg = FactoredRandomEffectCoordinateConfig(
+                dataset=ds,
+                lam_projection=float(kv.pop("lamProjection", 0.0)),
+                n_factored_iterations=int(kv.pop("factoredIterations", 2)),
+                optimization=_optimization(kv))
+        else:
+            cfg = RandomEffectCoordinateConfig(
+                dataset=ds, optimization=_optimization(kv))
     else:
-        raise ValueError(f"coordinate kind must be fixed|random, got {kind!r}")
+        raise ValueError(
+            f"coordinate kind must be fixed|random|factored, got {kind!r}")
     if kv:
         raise ValueError(f"unknown coordinate options {sorted(kv)} in {spec!r}")
     return cid, cfg

@@ -37,11 +37,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="optional evaluation of the scored output")
     p.add_argument("--score-breakdown", action="store_true",
                    help="also write per-coordinate scores json")
+    p.add_argument("--input-columns", default="",
+                   help="remap record fields, e.g. 'response=label' "
+                        "(reference InputColumnsNames)")
     return p
 
 
 def run(argv: Optional[Sequence[str]] = None) -> dict:
     from photon_ml_tpu.cli.config import parse_feature_shard_config
+    from photon_ml_tpu.io.data_reader import parse_input_columns
 
     args = build_parser().parse_args(argv)
     run_logger = RunLogger(args.output_dir)
@@ -86,7 +90,9 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
             re_types + [e.id_tag for e in evaluators if e.id_tag]))
 
         reader = AvroDataReader(shard_configs=shard_configs,
-                                index_maps=index_maps)
+                                index_maps=index_maps,
+                                input_columns=parse_input_columns(
+                                    args.input_columns))
         with timed("Read data", run_logger):
             # entity vocab must match training; rebuilt from data then used
             # for lookups — entities unseen at training score 0 for REs

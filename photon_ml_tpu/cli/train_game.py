@@ -22,6 +22,7 @@ from photon_ml_tpu.cli.config import (
 from photon_ml_tpu.data_validation import validate_game_data
 from photon_ml_tpu.evaluation import parse_evaluators
 from photon_ml_tpu.game.estimator import (
+    FactoredRandomEffectCoordinateConfig,
     GameEstimator,
     GameOptimizationConfiguration,
     RandomEffectCoordinateConfig,
@@ -122,24 +123,9 @@ def parse_mesh(spec: str):
         raise SystemExit(f"--mesh {spec!r}: {e}")
 
 
-def parse_input_columns(spec: str):
-    """'response=label,weight=w' → InputColumnsNames."""
-    from photon_ml_tpu.io.data_reader import InputColumnsNames
-
-    if not spec:
-        return InputColumnsNames()
-    overrides = {}
-    valid = {f.name for f in __import__("dataclasses").fields(InputColumnsNames)}
-    for part in spec.split(","):
-        logical, _, physical = part.partition("=")
-        logical = logical.strip()
-        physical = physical.strip()
-        if logical not in valid or not physical:
-            raise SystemExit(
-                f"bad --input-columns entry {part!r}; logical names: "
-                f"{sorted(valid)}")
-        overrides[logical] = physical
-    return InputColumnsNames(**overrides)
+# canonical home is the io layer, next to InputColumnsNames; re-exported
+# here for backward compatibility
+from photon_ml_tpu.io.data_reader import parse_input_columns  # noqa: E402,F401
 
 
 def _resolve_model_dir(path: str) -> str:
@@ -180,7 +166,8 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
         re_types = {
             c.dataset.random_effect_type
             for c in coordinate_configs.values()
-            if isinstance(c, RandomEffectCoordinateConfig)}
+            if isinstance(c, (RandomEffectCoordinateConfig,
+                              FactoredRandomEffectCoordinateConfig))}
         if args.model_input_dir:
             # locked coordinates have no config entry, but their entity-id
             # columns must still be read so the loaded model's entity keys

@@ -28,6 +28,7 @@ from photon_ml_tpu.io import (
     save_glm_model,
     save_glm_model_text,
 )
+from photon_ml_tpu.io.data_reader import parse_input_columns
 from photon_ml_tpu.io.avro import write_avro_file
 from photon_ml_tpu.io.schemas import FEATURE_SUMMARIZATION_RESULT_AVRO
 from photon_ml_tpu.logging_util import RunLogger, timed
@@ -86,6 +87,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile", action="store_true",
                    help="write a jax.profiler trace of the training stage "
                         "to <output-dir>/profile (view with TensorBoard)")
+    p.add_argument("--debug-nans", action="store_true",
+                   help="enable jax_debug_nans (fail fast on NaN). Strict "
+                        "debugging mode: also flags the line search's "
+                        "legitimate NaN-probing on overflowing trial steps, "
+                        "so use to LOCATE a NaN, not for production runs")
+    p.add_argument("--input-columns", default="",
+                   help="remap record fields, e.g. 'response=label' "
+                        "(reference InputColumnsNames)")
     return p
 
 
@@ -177,15 +186,21 @@ def _run_diagnostics(args, task, best, glm_train, glm_val, shard, stats, imap,
 def run(argv: Optional[Sequence[str]] = None) -> dict:
     args = build_parser().parse_args(argv)
     task = TaskType(args.task)
+    if args.debug_nans:
+        import jax
+
+        jax.config.update("jax_debug_nans", True)
     run_logger = RunLogger(args.output_dir)
     try:
         evaluators = parse_evaluators(
             [e for e in args.evaluators.split(",") if e])
         id_columns = tuple(dict.fromkeys(
             e.id_tag for e in evaluators if e.id_tag))
-        reader = AvroDataReader(shard_configs=(
-            FeatureShardConfig("global", feature_bags=None,
-                               has_intercept=not args.no_intercept),))
+        reader = AvroDataReader(
+            shard_configs=(
+                FeatureShardConfig("global", feature_bags=None,
+                                   has_intercept=not args.no_intercept),),
+            input_columns=parse_input_columns(args.input_columns))
         with timed("Read training data", run_logger):
             data, index_maps, _ = reader.read(args.training_data,
                                               id_columns=id_columns)
@@ -257,7 +272,8 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
         # HL), so read it even when no evaluators are configured
         if args.validation_data and (evaluators or args.training_diagnostics):
             reader_v = AvroDataReader(shard_configs=reader.shard_configs,
-                                      index_maps=index_maps)
+                                      index_maps=index_maps,
+                                      input_columns=reader.input_columns)
             with timed("Read validation data", run_logger):
                 vdata, _, _ = reader_v.read(args.validation_data,
                                             id_columns=id_columns)

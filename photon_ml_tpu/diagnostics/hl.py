@@ -49,10 +49,15 @@ def _hl_core(probs: Array, labels: Array, weights: Array, n_bins: int):
 
     # equal-count cutpoints from the live-sample quantiles; padding rows bin
     # by their raw probability but contribute nothing — their weight is 0 in
-    # every segment sum
+    # every segment sum. +inf (not NaN) sentinel for dead rows so the flow
+    # stays jax_debug_nans-clean: sort floats them to the top and the
+    # quantile positions are computed over the live count only.
     qs = jnp.linspace(0.0, 1.0, n_bins + 1)[1:-1]
-    p_for_quantile = jnp.where(live, p, jnp.nan)
-    cuts = jnp.nanquantile(p_for_quantile, qs)
+    p_sorted = jnp.sort(jnp.where(live, p, jnp.inf))
+    n_live = jnp.sum(live.astype(jnp.int32))
+    pos = jnp.clip((qs * (n_live - 1)).astype(jnp.int32), 0,
+                   jnp.maximum(n_live - 1, 0))
+    cuts = p_sorted[pos]
     bins = jnp.searchsorted(cuts, p, side="right")
 
     counts = jax.ops.segment_sum(w, bins, num_segments=n_bins)

@@ -290,6 +290,12 @@ class RandomEffectDatasetConfig:
     #: compute. 4.0 keeps shape count ~log4(max entity size) ≈ a handful.
     sample_bucket_growth: float = 4.0
     feature_bucket_growth: float = 2.0
+    #: keep the static bucket arrays resident on device across CD sweeps
+    #: (one upload total instead of one per sweep). Peak HBM then holds ALL
+    #: buckets of the coordinate; turn off for coordinates whose total
+    #: bucket payload exceeds device memory (reverts to upload-and-drop
+    #: per sweep).
+    cache_device_buckets: bool = True
     seed: int = 20260729
 
     def __post_init__(self):

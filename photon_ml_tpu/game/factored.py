@@ -119,6 +119,13 @@ class FactoredRandomEffectCoordinate:
     def latent_dim(self) -> int:
         return int(self.dataset_config.projected_dim)
 
+    @property
+    def _ds_config(self) -> RandomEffectDatasetConfig:
+        """Per-alternation datasets are single-use — caching their bucket
+        device placements would pin ALL buckets in HBM for zero reuse."""
+        return dataclasses.replace(self.dataset_config,
+                                   cache_device_buckets=False)
+
     def _latent_table(self, latent: RandomEffectModel,
                       entities: np.ndarray) -> np.ndarray:
         """Per-sample latent coefficients from the entity table (0 for
@@ -176,7 +183,7 @@ class FactoredRandomEffectCoordinate:
         for _ in range(max(1, self.n_factored_iterations)):
             projector = RandomProjector(matrix=p)
             dataset = RandomEffectDataset.build(
-                self.coordinate_id, self.data, self.dataset_config,
+                self.coordinate_id, self.data, self._ds_config,
                 projector=projector)
             latent, _scores = solver.train(
                 dataset, offsets, self.lam, warm_start=latent)
@@ -185,7 +192,7 @@ class FactoredRandomEffectCoordinate:
         # final latent solve so the returned (v, P) pair is consistent
         projector = RandomProjector(matrix=p)
         dataset = RandomEffectDataset.build(
-            self.coordinate_id, self.data, self.dataset_config,
+            self.coordinate_id, self.data, self._ds_config,
             projector=projector)
         latent, _ = solver.train(dataset, offsets, self.lam, warm_start=latent)
         scores = latent.score(self.data)

@@ -125,7 +125,12 @@ class RandomEffectSolver:
                        bucket: REBucket):
         """Device placements of the per-sweep-invariant bucket arrays,
         cached on the dataset so each CD sweep re-uploads only the small
-        dynamic inputs (offsets, warm starts)."""
+        dynamic inputs (offsets, warm starts). With
+        ``config.cache_device_buckets`` off, reverts to upload-and-drop
+        (peak HBM = one bucket instead of all of them)."""
+        if not dataset.config.cache_device_buckets:
+            return (self._put(bucket.x), self._put(bucket.labels),
+                    self._put(bucket.weights))
         key = (i, self.mesh, self.entity_axis)
         cached = dataset._device_cache.get(key)
         if cached is None:

@@ -66,6 +66,25 @@ class InputColumnsNames:
                    for f in self._DECODE_FIELDS)
 
 
+def parse_input_columns(spec: str) -> InputColumnsNames:
+    """'response=label,weight=w' → :class:`InputColumnsNames` (the CLI
+    drivers' shared ``--input-columns`` parser)."""
+    if not spec:
+        return InputColumnsNames()
+    overrides = {}
+    valid = {f.name for f in dataclasses.fields(InputColumnsNames)}
+    for part in spec.split(","):
+        logical, _, physical = part.partition("=")
+        logical = logical.strip()
+        physical = physical.strip()
+        if logical not in valid or not physical:
+            raise SystemExit(
+                f"bad --input-columns entry {part!r}; logical names: "
+                f"{sorted(valid)}")
+        overrides[logical] = physical
+    return InputColumnsNames(**overrides)
+
+
 def _record_features(record: dict, bags: Optional[Sequence[str]],
                      features_field: str = "features"):
     """Yield (key, value) for the record's features, filtered by bag.

@@ -62,7 +62,7 @@ class OptimizerResult:
     """What every minimizer returns (a pytree, so it can flow out of jit/vmap).
 
     ``values``/``grad_norms`` are fixed-length ``(max_iterations + 1,)`` traces
-    padded with NaN beyond ``iterations`` — the reference's
+    padded with +inf beyond ``iterations`` — the reference's
     ``OptimizationStatesTracker`` as arrays.
     """
 
@@ -83,9 +83,12 @@ def init_trace(config: OptimizerConfig, f0: Array, gnorm0: Array) -> tuple[Array
         empty = jnp.zeros((0,), dtype=jnp.float32)
         return empty, empty
     n = config.max_iterations + 1
-    values = jnp.full((n,), jnp.nan, dtype=jnp.float32).at[0].set(
+    # +inf (not NaN) padding beyond the recorded iterations: consumers
+    # filter with isfinite either way, and NaN padding would trip
+    # jax_debug_nans (the --debug-nans driver flag) on allocation
+    values = jnp.full((n,), jnp.inf, dtype=jnp.float32).at[0].set(
         f0.astype(jnp.float32))
-    gnorms = jnp.full((n,), jnp.nan, dtype=jnp.float32).at[0].set(
+    gnorms = jnp.full((n,), jnp.inf, dtype=jnp.float32).at[0].set(
         gnorm0.astype(jnp.float32))
     return values, gnorms
 

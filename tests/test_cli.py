@@ -369,6 +369,25 @@ class TestTrainGameDriver:
                 "--grid", "perUser=1",
             ])
 
+    def test_factored_coordinate_dsl(self, tmp_path):
+        """'coordId=factored,...' trains a factored random effect through
+        the driver (legacy reference FactoredRandomEffectCoordinate)."""
+        train = make_avro_dataset(tmp_path / "train.avro", n=600, seed=0)
+        val = make_avro_dataset(tmp_path / "val.avro", n=300, seed=2)
+        r = train_game_cli.run([
+            "--training-data", train, "--validation-data", val,
+            "--output-dir", str(tmp_path / "fact-out"),
+            "--feature-shards", SHARDS,
+            "--coordinates", COORDS[0],
+            "perUser=factored,entity=userId,shard=user,projectedDim=2,"
+            "factoredIterations=1,lamProjection=0.5,reg=L2,"
+            "cacheBuckets=false",
+            "--update-sequence", "global,perUser",
+            "--grid", "global=0.1", "perUser=1",
+            "--evaluators", "AUC",
+        ])
+        assert r["best_evaluation"]["AUC"] > 0.6
+
     def test_mesh_flag_trains_sharded(self, tmp_path):
         """--mesh data=4,entity=2 runs the dp x ep estimator path."""
         from photon_ml_tpu.cli.train_game import parse_mesh

@@ -156,6 +156,21 @@ class TestDistributedSolve:
             np.asarray(dist.hessian_matrix(w, sharded, 0.3)),
             np.asarray(obj.hessian_matrix(w, data, 0.3)), rtol=1e-10)
 
+    def test_deterministic_across_runs(self, mesh):
+        """SURVEY §5.2: the psum reduction is bitwise deterministic —
+        repeated evaluation of the same sharded objective produces identical
+        bits (the reproducibility property Spark's treeAggregate also has
+        for a fixed partitioning)."""
+        data, _ = make_data(seed=12)
+        obj = GLMObjective(loss=LogisticLoss)
+        dist = DistributedGLMObjective(obj, mesh)
+        sharded = shard_glm_data(data, 8, device_put_mesh=mesh)
+        w = jnp.asarray(np.random.default_rng(13).normal(size=data.dim))
+        f1, g1 = dist.value_and_grad(w, sharded, 0.5)
+        f2, g2 = dist.value_and_grad(w, sharded, 0.5)
+        assert float(f1) == float(f2)
+        np.testing.assert_array_equal(np.asarray(g1), np.asarray(g2))
+
     def test_margins_roundtrip(self, mesh):
         data, x = make_data(seed=5)
         obj = GLMObjective(loss=LogisticLoss)
