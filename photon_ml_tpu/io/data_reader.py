@@ -265,6 +265,12 @@ class AvroDataReader:
                     for f in futures:
                         f.cancel()
                     return None
+                except BaseException:
+                    # decode error (corrupt file, etc.): don't burn time
+                    # decoding the rest before propagating
+                    for f in futures:
+                        f.cancel()
+                    raise
         else:
             decoded = [decode(files[0])]
             if decoded[0] is None:
