@@ -17,7 +17,7 @@ batched matmul, HBM holds one copy of X.
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, Optional, Sequence
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp

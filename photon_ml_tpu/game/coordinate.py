@@ -24,7 +24,6 @@ from photon_ml_tpu.game.data import (
 )
 from photon_ml_tpu.game.model import (
     FixedEffectModel,
-    GameModel,
     RandomEffectModel,
 )
 from photon_ml_tpu.game.random_effect import RandomEffectSolver

@@ -17,8 +17,6 @@ import json
 import logging
 from typing import Mapping, Optional, Sequence
 
-import numpy as np
-
 from photon_ml_tpu.evaluation import EvaluationResults, Evaluator
 from photon_ml_tpu.game.coordinate import (
     FixedEffectCoordinate,

@@ -17,8 +17,6 @@ import json
 import os
 from typing import Iterable, Mapping, Optional
 
-import numpy as np
-
 from photon_ml_tpu.types import INTERCEPT_KEY, feature_key
 
 

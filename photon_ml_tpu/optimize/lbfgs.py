@@ -26,7 +26,6 @@ though the iteration paths differ; parity is asserted on solutions, not paths
 from __future__ import annotations
 
 import dataclasses
-from functools import partial
 
 import jax
 import jax.numpy as jnp

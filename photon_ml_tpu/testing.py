@@ -17,8 +17,6 @@ device count must be set before JAX initializes a backend — call
 from __future__ import annotations
 
 import os
-from typing import Optional
-
 import numpy as np
 
 
