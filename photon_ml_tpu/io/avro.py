@@ -10,7 +10,8 @@ module implements the subset of the Avro 1.x spec those schemas need:
 - binary encoding: zigzag-varint longs, length-prefixed bytes, block-encoded
   arrays/maps, union = long index + value;
 - container files: ``Obj\\x01`` magic, metadata map (schema JSON + codec),
-  16-byte sync marker, data blocks with ``null`` or ``deflate`` codec.
+  16-byte sync marker, data blocks with ``null``, ``deflate``, or ``snappy``
+  codec (snappy implemented here from the format spec — no wheel needed).
 
 Schemas are plain Python dicts in the ``.avsc`` JSON form. Unknown/unneeded
 spec corners (recursive types, aliases, logical types) raise cleanly.
@@ -221,7 +222,7 @@ def read_datum(buf: BinaryIO, schema: Schema, names: dict) -> Any:
 def write_avro_file(path: str, records: Iterable[dict], schema: Schema,
                     *, codec: str = "deflate", block_records: int = 4096) -> int:
     """Write an Avro object-container file; returns the record count."""
-    if codec not in ("null", "deflate"):
+    if codec not in ("null", "deflate", "snappy"):
         raise ValueError(f"unsupported codec {codec!r}")
     sync = os.urandom(SYNC_SIZE)
     names: dict = {}
@@ -250,6 +251,9 @@ def write_avro_file(path: str, records: Iterable[dict], schema: Schema,
             payload = buf.getvalue()
             if codec == "deflate":
                 payload = zlib.compress(payload)[2:-4]  # raw deflate per spec
+            elif codec == "snappy":
+                crc = (zlib.crc32(payload) & 0xFFFFFFFF).to_bytes(4, "big")
+                payload = snappy_compress(payload) + crc
             write_long(f, len(block))
             write_long(f, len(payload))
             f.write(payload)
@@ -285,8 +289,9 @@ def iter_avro_file(path: str) -> Iterator[dict]:
                 meta[k] = f.read(size)
         schema = json.loads(meta["avro.schema"].decode())
         codec = meta.get("avro.codec", b"null").decode()
-        if codec not in ("null", "deflate"):
-            raise ValueError(f"unsupported codec {codec!r}")
+        if codec not in ("null", "deflate", "snappy"):
+            raise ValueError(f"unsupported codec {codec!r} "
+                             f"(supported: null, deflate, snappy)")
         sync = f.read(SYNC_SIZE)
         while True:
             try:
@@ -297,6 +302,12 @@ def iter_avro_file(path: str) -> Iterator[dict]:
             payload = f.read(size)
             if codec == "deflate":
                 payload = zlib.decompress(payload, -15)
+            elif codec == "snappy":
+                # snappy(payload) + 4-byte big-endian CRC32 of the plaintext
+                body, crc = payload[:-4], payload[-4:]
+                payload = snappy_decompress(body)
+                if zlib.crc32(payload) & 0xFFFFFFFF != int.from_bytes(crc, "big"):
+                    raise ValueError(f"{path}: snappy block CRC mismatch")
             if f.read(SYNC_SIZE) != sync:
                 raise ValueError(f"{path}: sync marker mismatch (corrupt block)")
             buf = io.BytesIO(payload)
@@ -306,3 +317,111 @@ def iter_avro_file(path: str) -> Iterator[dict]:
 
 def read_avro_file(path: str) -> list[dict]:
     return list(iter_avro_file(path))
+
+
+# ---------------------------------------------------------------------------
+# Snappy block codec (pure Python)
+# ---------------------------------------------------------------------------
+# Hadoop-written Avro is very commonly snappy-compressed; there is no snappy
+# wheel in this environment, so decompression is implemented directly from
+# the format spec (https://github.com/google/snappy/blob/main/format_description.txt).
+# Avro's snappy codec frames each block as snappy(payload) + 4-byte big-endian
+# CRC32 of the UNCOMPRESSED payload.
+
+
+def snappy_decompress(data: bytes) -> bytes:
+    """Decompress one raw snappy block."""
+    pos = 0
+    # varint32 uncompressed length
+    length = 0
+    shift = 0
+    while True:
+        if pos >= len(data):
+            raise ValueError("snappy: truncated preamble")
+        b = data[pos]
+        pos += 1
+        length |= (b & 0x7F) << shift
+        shift += 7
+        if not b & 0x80:
+            break
+    out = bytearray()
+    n = len(data)
+    while pos < n:
+        tag = data[pos]
+        pos += 1
+        kind = tag & 3
+        if kind == 0:  # literal
+            elem = tag >> 2
+            if elem < 60:
+                lit_len = elem + 1
+            else:
+                n_bytes = elem - 59
+                if pos + n_bytes > n:
+                    raise ValueError("snappy: truncated literal length")
+                lit_len = int.from_bytes(data[pos:pos + n_bytes], "little") + 1
+                pos += n_bytes
+            if pos + lit_len > n:
+                raise ValueError("snappy: truncated literal")
+            out += data[pos:pos + lit_len]
+            pos += lit_len
+            continue
+        if kind == 1:  # copy, 1-byte offset
+            if pos + 1 > n:
+                raise ValueError("snappy: truncated copy")
+            cp_len = ((tag >> 2) & 0x7) + 4
+            offset = ((tag >> 5) << 8) | data[pos]
+            pos += 1
+        elif kind == 2:  # copy, 2-byte offset
+            if pos + 2 > n:
+                raise ValueError("snappy: truncated copy")
+            cp_len = (tag >> 2) + 1
+            offset = int.from_bytes(data[pos:pos + 2], "little")
+            pos += 2
+        else:  # copy, 4-byte offset
+            if pos + 4 > n:
+                raise ValueError("snappy: truncated copy")
+            cp_len = (tag >> 2) + 1
+            offset = int.from_bytes(data[pos:pos + 4], "little")
+            pos += 4
+        if offset == 0 or offset > len(out):
+            raise ValueError("snappy: invalid copy offset")
+        start = len(out) - offset
+        if offset >= cp_len:  # non-overlapping (the common case): one slice
+            out += out[start:start + cp_len]
+        else:  # overlapping copy: byte-at-a-time semantics
+            for i in range(cp_len):
+                out.append(out[start + i])
+    if len(out) != length:
+        raise ValueError(
+            f"snappy: decompressed {len(out)} bytes, expected {length}")
+    return bytes(out)
+
+
+def snappy_compress(data: bytes) -> bytes:
+    """Literal-only snappy encoding (valid, not size-optimal) — enough to
+    WRITE snappy files other readers accept; real compression only matters
+    for data we produce, which defaults to deflate."""
+    out = bytearray()
+    # varint32 length
+    length = len(data)
+    while True:
+        b = length & 0x7F
+        length >>= 7
+        if length:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            break
+    pos = 0
+    while pos < len(data):
+        chunk = data[pos:pos + 65536]
+        lit_len = len(chunk) - 1
+        if lit_len < 60:
+            out.append(lit_len << 2)
+        else:
+            n_bytes = (lit_len.bit_length() + 7) // 8
+            out.append((59 + n_bytes) << 2)
+            out += lit_len.to_bytes(n_bytes, "little")
+        out += chunk
+        pos += len(chunk)
+    return bytes(out)
