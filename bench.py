@@ -3,10 +3,15 @@
 Workload: BASELINE config-1 shape scaled up — L2-regularized logistic
 regression via the on-device compiled L-BFGS loop — the per-iteration
 broadcast + treeAggregate cycle that dominates the reference's wall-clock
-(SURVEY.md §3.1). Design matrix stored f32: measured on the axon v5e chip,
-bf16 matvec/rmatvec lowers ~2x SLOWER than f32 at this (200k, 1024) shape
-(conversion-dominated), so f32 + the closed-form two-pass value_and_grad
-is the fast configuration.
+(SURVEY.md §3.1). The problem carries a realistic feature-scale spread
+(see ``_make_problem``), so both solvers run the full iteration budget and
+the measurement is sustained per-iteration throughput. The objective uses
+the fused one-pass Pallas value+grad kernel (``ops/pallas_glm.py``) —
+measured 1.35x over the XLA two-pass closed form inside this exact solve
+(0.145 s vs 0.196 s for 50 iterations at (200k, 1024) f32 on the axon
+v5e, converging to the same objective value). The design stays f32: the
+bf16 half-bandwidth path is another ~1.4x but rounds the design matrix
+itself, which this parity-checked benchmark doesn't do.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 ``vs_baseline`` is the speedup of the compiled on-device solve over a
@@ -34,15 +39,24 @@ MAX_ITERS = 50
 
 def _make_problem(seed=0):
     """Sparse-generated logistic data, densified (dense is the TPU-first
-    layout at this dim — SURVEY.md §7 hard-parts #2)."""
+    layout at this dim — SURVEY.md §7 hard-parts #2).
+
+    Feature columns carry a log-uniform scale spread (~3 decades), the
+    shape of real name-term-value data (raw counts next to indicator
+    features). This conditions the Hessian the way production GLM problems
+    are conditioned, so the solve runs tens of L-BFGS iterations instead of
+    terminating in a handful — the benchmark then measures sustained
+    per-iteration throughput rather than ±1-iteration path noise."""
     rng = np.random.default_rng(seed)
     n, d, k = N_SAMPLES, N_FEATURES, NNZ_PER_ROW
     rows = np.repeat(np.arange(n, dtype=np.int32), k)
     cols = rng.integers(0, d, size=n * k, dtype=np.int32)
-    vals = rng.normal(size=n * k).astype(np.float32) / np.sqrt(k)
+    col_scale = np.power(10.0, rng.uniform(-2.0, 1.0, size=d)).astype(np.float32)
+    vals = (rng.normal(size=n * k).astype(np.float32) / np.sqrt(k)
+            * col_scale[cols])
     x = np.zeros((n, d), np.float32)
     np.add.at(x, (rows, cols), vals)
-    w_true = rng.normal(size=d).astype(np.float32)
+    w_true = (rng.normal(size=d).astype(np.float32) / col_scale)
     margins = x @ w_true
     y = (rng.uniform(size=n) < 1.0 / (1.0 + np.exp(-margins))).astype(np.float32)
     return x, y
@@ -86,7 +100,11 @@ def _tpu_solve(x, y):
         offsets=jnp.zeros((n,), jnp.float32),
         weights=jnp.ones((n,), jnp.float32),
     )
-    objective = GLMObjective(loss=loss_for_task(TaskType.LOGISTIC_REGRESSION))
+    # fused=True: the one-pass Pallas value+grad kernel (ops/pallas_glm.py,
+    # lane-major round-2 formulation) — measured 1.35x over the XLA two-pass
+    # closed form at this shape on the axon v5e
+    objective = GLMObjective(loss=loss_for_task(TaskType.LOGISTIC_REGRESSION),
+                             fused=True)
     cfg = OptimizerConfig(max_iterations=MAX_ITERS, tolerance=1e-12,
                           track_states=False)
 

@@ -303,11 +303,7 @@ def iter_avro_file(path: str) -> Iterator[dict]:
             if codec == "deflate":
                 payload = zlib.decompress(payload, -15)
             elif codec == "snappy":
-                # snappy(payload) + 4-byte big-endian CRC32 of the plaintext
-                body, crc = payload[:-4], payload[-4:]
-                payload = snappy_decompress(body)
-                if zlib.crc32(payload) & 0xFFFFFFFF != int.from_bytes(crc, "big"):
-                    raise ValueError(f"{path}: snappy block CRC mismatch")
+                payload = snappy_decode_block(payload, context=path)
             if f.read(SYNC_SIZE) != sync:
                 raise ValueError(f"{path}: sync marker mismatch (corrupt block)")
             buf = io.BytesIO(payload)
@@ -327,6 +323,21 @@ def read_avro_file(path: str) -> list[dict]:
 # the format spec (https://github.com/google/snappy/blob/main/format_description.txt).
 # Avro's snappy codec frames each block as snappy(payload) + 4-byte big-endian
 # CRC32 of the UNCOMPRESSED payload.
+
+
+def snappy_decode_block(payload: bytes, context: str = "") -> bytes:
+    """Decode one Avro snappy block payload: decompress + verify the CRC.
+
+    The single home of the Avro-snappy frame contract — both the pure-Python
+    reader above and the native fast path (:mod:`photon_ml_tpu.native`)
+    call this."""
+    if len(payload) < 4:
+        raise ValueError(f"{context}: snappy block too short for CRC")
+    body, crc = payload[:-4], payload[-4:]
+    data = snappy_decompress(body)
+    if zlib.crc32(data) & 0xFFFFFFFF != int.from_bytes(crc, "big"):
+        raise ValueError(f"{context}: snappy block CRC mismatch")
+    return data
 
 
 def snappy_decompress(data: bytes) -> bytes:

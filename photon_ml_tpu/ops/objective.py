@@ -95,6 +95,10 @@ class GLMObjective:
     #: designs with identity normalization — other cases fall back to
     #: autodiff transparently). See photon_ml_tpu/ops/pallas_glm.py.
     fused: bool = False
+    #: testing only: run the fused kernel through the Pallas interpreter on
+    #: non-TPU backends instead of falling back to the closed form. The
+    #: interpreter is orders of magnitude slower than XLA — never in prod.
+    fused_interpret: bool = False
 
     # --- margins ----------------------------------------------------------
     def margins(self, w: Array, data: GLMData) -> Array:
@@ -102,8 +106,13 @@ class GLMObjective:
         return data.design.matvec(w_eff) + margin_shift + data.offsets
 
     # --- objective value --------------------------------------------------
+    def _reg_w(self, w: Array) -> Array:
+        """Coefficients as seen by the L2 term (reg_mask selects, e.g. to
+        exempt the intercept) — single home of the mask semantics."""
+        return w if self.reg_mask is None else w * self.reg_mask
+
     def _l2_term(self, w: Array, l2) -> Array:
-        wr = w if self.reg_mask is None else w * self.reg_mask
+        wr = self._reg_w(w)
         return 0.5 * l2 * jnp.vdot(wr, wr)
 
     def value(self, w: Array, data: GLMData, l2=0.0) -> Array:
@@ -121,17 +130,21 @@ class GLMObjective:
 
     # --- derivatives ------------------------------------------------------
     def value_and_grad(self, w: Array, data: GLMData, l2=0.0) -> tuple[Array, Array]:
-        if (self.fused and isinstance(data.design, DenseDesign)
+        # Mosaic lowering needs a TPU: off-TPU, fused falls back to the fast
+        # closed form rather than the (orders-of-magnitude slower) Pallas
+        # interpreter; tests opt into the interpreter via fused_interpret.
+        on_tpu = jax.default_backend() == "tpu"
+        if (self.fused and (on_tpu or self.fused_interpret)
+                and isinstance(data.design, DenseDesign)
                 and self.normalization.is_identity):
             from photon_ml_tpu.ops.pallas_glm import fused_value_and_grad
 
             value, grad = fused_value_and_grad(
                 self.loss, data.design.x, w, data.labels, data.offsets,
-                data.weights)
+                data.weights, interpret=not on_tpu)
             l2 = jnp.asarray(l2, value.dtype)
-            wr = w if self.reg_mask is None else w * self.reg_mask
-            return (value + 0.5 * l2 * jnp.vdot(wr, wr),
-                    grad + l2 * wr)
+            return (value + self._l2_term(w, l2),
+                    grad + l2 * self._reg_w(w))
         if self.normalization.is_identity:
             return self._closed_value_and_grad(w, data, l2)
         return jax.value_and_grad(self.value)(w, data, l2)
@@ -153,8 +166,7 @@ class GLMObjective:
         dl = jnp.where(live, data.weights * self.loss.d1(m_safe, data.labels),
                        0.0)
         g = data.design.rmatvec(dl).astype(w.dtype)
-        wr = w if self.reg_mask is None else w * self.reg_mask
-        return value, g + jnp.asarray(l2, w.dtype) * wr
+        return value, g + jnp.asarray(l2, w.dtype) * self._reg_w(w)
 
     def grad(self, w: Array, data: GLMData, l2=0.0) -> Array:
         return jax.grad(self.value)(w, data, l2)
