@@ -136,6 +136,7 @@ def parse_coordinate_config(spec: str):
         else:
             projector_type = ProjectorType(
                 kv.pop("projector", "INDEX_MAP").upper())
+        buckets = kv.pop("buckets", "geometric").lower()
         ds = RandomEffectDatasetConfig(
             random_effect_type=entity,
             feature_shard_id=shard,
@@ -148,6 +149,9 @@ def parse_coordinate_config(spec: str):
             projected_dim=(int(kv.pop("projectedDim"))
                            if "projectedDim" in kv else None),
             cache_device_buckets=cache == "true",
+            bucket_strategy=buckets,
+            max_sample_buckets=int(kv.pop("maxSampleBuckets", 8)),
+            max_feature_buckets=int(kv.pop("maxFeatureBuckets", 4)),
         )
         if kind == "factored":
             cfg = FactoredRandomEffectCoordinateConfig(

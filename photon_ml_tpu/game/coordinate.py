@@ -101,9 +101,12 @@ class FixedEffectCoordinate:
     def __post_init__(self):
         self.config.regularization.check_weight(self.lam)
 
-    def train(self, offsets: np.ndarray,
+    def train(self, offsets,
               warm_start: Optional[FixedEffectModel] = None,
-              sweep: int = 0) -> tuple[FixedEffectModel, np.ndarray]:
+              sweep: int = 0) -> tuple[FixedEffectModel, jax.Array]:
+        """``offsets`` may be host numpy or a device array (coordinate
+        descent keeps the residual accounting on device); the returned
+        ``scores`` is a device vector."""
         data = self.dataset.glm_data(offsets)
         if self.downsampler is not None:
             weights = self.downsampler.downsample(
@@ -119,7 +122,7 @@ class FixedEffectCoordinate:
             train_fn = _fixed_train_fn(self.task, self.config)
         result, variances, scores = train_fn(
             data, w0, jnp.asarray(self.lam, jnp.float32))
-        scores = np.asarray(scores, np.float32).reshape(-1)
+        scores = scores.reshape(-1)
         if self.dataset.n_shards > 1:
             scores = scores[:self.dataset.n_samples]  # drop tail padding
         model = FixedEffectModel(
@@ -157,16 +160,18 @@ class RandomEffectCoordinate:
         return RandomEffectSolver(task=self.task, config=self.config,
                                   mesh=self.mesh)
 
-    def train(self, offsets: np.ndarray,
+    def train(self, offsets,
               warm_start: Optional[RandomEffectModel] = None,
-              sweep: int = 0) -> tuple[RandomEffectModel, np.ndarray]:
+              sweep: int = 0) -> tuple[RandomEffectModel, jax.Array]:
         shard_dim = self.data.shards[self.dataset.config.feature_shard_id].dim
         model, scores = self.solver.train(
             self.dataset, offsets, self.lam, warm_start, dim=shard_dim)
         passive = self.dataset.passive_sample_idx
         if len(passive):
             # reference passiveData scoring: trained model, scored-only rows
-            scores[passive] = model.score(self.data, sample_idx=passive)
+            # (host join; one small H2D of the passive scores)
+            scores = scores.at[passive].set(
+                jnp.asarray(model.score(self.data, sample_idx=passive)))
         return model, scores
 
 

@@ -73,24 +73,41 @@ class CoordinateDescent:
             if cid not in coordinates and cid not in locked:
                 raise KeyError(f"update sequence names unknown coordinate {cid!r}")
 
+        import jax.numpy as jnp
+
         models: dict[str, CoordinateModel] = dict(initial_models or {})
-        scores: dict[str, np.ndarray] = {
+        # The score decomposition lives ON DEVICE for the whole run (ROADMAP
+        # "score-path device residency"): residual arithmetic and the
+        # coordinates' score gathers/scatters happen where the margins are
+        # computed, so a CD sweep moves no O(n_samples) vectors host↔device.
+        # Host copies are made only at checkpoint saves and in the result.
+        scores: dict[str, jnp.ndarray] = {
+            cid: jnp.zeros(data.n_samples, jnp.float32)
+            for cid in self.update_sequence}
+        # host mirror for checkpointing: synced incrementally (only the
+        # just-trained coordinate is copied back per step) so a checkpointed
+        # run still moves one score vector D2H per coordinate step, not K
+        host_scores: dict[str, np.ndarray] = {
             cid: np.zeros(data.n_samples, np.float32)
             for cid in self.update_sequence}
         # seed scores from initial models (partial-retrain warm start path)
         for cid, model in models.items():
             if cid in scores:
-                scores[cid] = model.score(data).astype(np.float32)
+                host_scores[cid] = model.score(data).astype(np.float32)
+                scores[cid] = jnp.asarray(host_scores[cid])
 
         start_sweep, start_coord = 0, 0
         if resume and checkpoint is not None and checkpoint.latest_step() is not None:
             state = checkpoint.restore(expected_fingerprint=config_fingerprint)
             models = dict(state.model.coordinates)
-            scores.update({k: v for k, v in state.scores.items() if k in scores})
+            for k, v in state.scores.items():
+                if k in scores:
+                    host_scores[k] = np.asarray(v, np.float32)
+                    scores[k] = jnp.asarray(host_scores[k])
             start_sweep, start_coord = state.sweep, state.coordinate_index
             logger.info("resumed from checkpoint: sweep %d coordinate %d",
                         start_sweep, start_coord)
-        total = data.offsets + sum(scores.values())
+        total = jnp.asarray(data.offsets, jnp.float32) + sum(scores.values())
 
         history: list[dict[str, float]] = []
         final_evaluation = None
@@ -101,7 +118,7 @@ class CoordinateDescent:
                 if cid in locked:
                     continue  # frozen: scores stay as seeded
                 t0 = time.perf_counter()
-                residual = (total - scores[cid]).astype(np.float32)
+                residual = total - scores[cid]
                 model, new_scores = coordinates[cid].train(
                     residual, models.get(cid), sweep=sweep)
                 models[cid] = model
@@ -112,6 +129,8 @@ class CoordinateDescent:
                 if checkpoint is not None:
                     from photon_ml_tpu.io.checkpoint import CoordinateDescentState
 
+                    # sync ONLY the trained coordinate to the host mirror
+                    host_scores[cid] = np.asarray(new_scores, np.float32)
                     next_ci = (ci + 1) % len(self.update_sequence)
                     checkpoint.save(
                         sweep * len(self.update_sequence) + ci + 1,
@@ -119,7 +138,7 @@ class CoordinateDescent:
                             sweep=sweep + (next_ci == 0),
                             coordinate_index=next_ci,
                             model=GameModel(coordinates=dict(models), task=task),
-                            scores=dict(scores)),
+                            scores=dict(host_scores)),
                         fingerprint=config_fingerprint)
 
             if validation is not None:
@@ -147,5 +166,7 @@ class CoordinateDescent:
                 id_tags=vdata.id_columns)
             history.append(final_evaluation.as_dict())
         return CoordinateDescentResult(
-            model=model, scores=scores, validation_history=history,
+            model=model,
+            scores={k: np.asarray(v, np.float32) for k, v in scores.items()},
+            validation_history=history,
             final_evaluation=final_evaluation)

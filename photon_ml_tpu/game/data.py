@@ -235,19 +235,23 @@ class FixedEffectDataset:
             n_samples=shard.n_samples)
 
     def glm_data(self, offsets) -> GLMData:
-        offsets = np.asarray(offsets, np.float32)
+        """Bind per-sweep residual offsets (host numpy or device array —
+        a device residual never round-trips through the host)."""
         if self.n_shards > 1:
             from jax.sharding import NamedSharding, PartitionSpec
 
             from photon_ml_tpu.parallel.mesh import DATA_AXIS
 
-            per = self.labels.shape[1]
-            padded = np.zeros(self.n_shards * per, np.float32)
-            padded[:len(offsets)] = offsets
             import jax
 
+            per = self.labels.shape[1]
+            offsets = jnp.asarray(offsets, jnp.float32)
+            pad = self.n_shards * per - offsets.shape[0]
+            if pad:
+                offsets = jnp.concatenate(
+                    [offsets, jnp.zeros((pad,), jnp.float32)])
             off = jax.device_put(
-                padded.reshape(self.n_shards, per),
+                offsets.reshape(self.n_shards, per),
                 NamedSharding(self.mesh, PartitionSpec(DATA_AXIS)))
             return GLMData(design=self.design, labels=self.labels,
                            offsets=off, weights=self.weights)
@@ -290,6 +294,18 @@ class RandomEffectDatasetConfig:
     #: compute. 4.0 keeps shape count ~log4(max entity size) ≈ a handful.
     sample_bucket_growth: float = 4.0
     feature_bucket_growth: float = 2.0
+    #: "geometric" pads each dim to a growth-factor power (above);
+    #: "histogram" chooses ≤max_{sample,feature}_buckets padded sizes from
+    #: the actual entity-size distribution by a min-total-padding partition
+    #: (ROADMAP bucket autotuning). The DP is per-dimension optimal: total
+    #: padded samples (resp. features) is minimal for the given shape
+    #: budget — so with a budget ≥ the geometric scheme's shape count it
+    #: never pads a dimension more than geometric does. (The E·S·D product
+    #: is not jointly optimized; a very tight budget can lose on it.)
+    #: Correctness is identical either way — padding is masked.
+    bucket_strategy: str = "geometric"
+    max_sample_buckets: int = 8
+    max_feature_buckets: int = 4
     #: keep the static bucket arrays resident on device across CD sweeps
     #: (one upload total instead of one per sweep). Peak HBM then holds ALL
     #: buckets of the coordinate; turn off for coordinates whose total
@@ -306,6 +322,14 @@ class RandomEffectDatasetConfig:
                 "per-entity feature selection; the RANDOM projector replaces "
                 "feature selection with a shared projection (set "
                 "projected_dim to control its width instead)")
+        if self.bucket_strategy not in ("geometric", "histogram"):
+            raise ValueError(
+                f"unknown bucket_strategy {self.bucket_strategy!r} "
+                "(expected 'geometric' or 'histogram')")
+        if self.max_sample_buckets < 1 or self.max_feature_buckets < 1:
+            raise ValueError(
+                "max_sample_buckets and max_feature_buckets must be ≥ 1 "
+                f"(got {self.max_sample_buckets}/{self.max_feature_buckets})")
 
 
 def _geom_at_least(x: np.ndarray, growth: float, floor: int = 1) -> np.ndarray:
@@ -314,6 +338,59 @@ def _geom_at_least(x: np.ndarray, growth: float, floor: int = 1) -> np.ndarray:
     exp = np.ceil(np.log(x) / np.log(growth) - 1e-9).astype(np.int64)
     out = np.ceil(np.power(growth, exp)).astype(np.int64)
     return np.maximum(out, x)  # guard against fp rounding down
+
+
+#: unique-size cap for the histogram DP: above this, sizes are pre-quantized
+#: to a 2% geometric grid (keeps the O(K·m²) DP trivial at any entity count)
+_HIST_MAX_UNIQUE = 512
+
+
+def _histogram_pad(x: np.ndarray, max_buckets: int, floor: int = 1) -> np.ndarray:
+    """Elementwise padded size via a min-total-padding ≤max_buckets partition.
+
+    Power-law entity sizes (SURVEY.md §3 "straggler entities") make fixed
+    geometric growth pad-heavy; this picks the padded sizes FROM the observed
+    size distribution. DP over the sorted unique sizes: the cost of one
+    bucket covering sizes (v_i..v_j] is v_j · (count in the range) — total
+    padded rows, since every member pads to the bucket max. O(K·m²) with
+    m ≤ _HIST_MAX_UNIQUE after quantization; exact when m is under the cap.
+    """
+    x = np.maximum(np.asarray(x, np.int64), floor)
+    v, c = np.unique(x, return_counts=True)
+    if len(v) > _HIST_MAX_UNIQUE:
+        # quantize UP to a fine geometric grid first (padding stays valid)
+        xq = _geom_at_least(x, 1.02, floor)
+        v, c = np.unique(xq, return_counts=True)
+        x = xq
+    m = len(v)
+    k_max = min(max_buckets, m)
+    # W[j] = total count of sizes ≤ v_{j-1} (prefix, 1-indexed)
+    w = np.zeros(m + 1, np.int64)
+    np.cumsum(c, out=w[1:])
+    inf = np.int64(1) << 60
+    # dp[k][j] = min Σ padded rows covering the first j unique sizes with
+    # exactly k buckets; group (i..j] costs v[j-1] * (W[j] - W[i])
+    dp = np.full((k_max + 1, m + 1), inf)
+    dp[0, 0] = 0
+    parent = np.zeros((k_max + 1, m + 1), np.int64)
+    lower = np.arange(m)[:, None] <= np.arange(m)[None, :]  # i ≤ j-1
+    for k in range(1, k_max + 1):
+        # cand[i, j-1] = dp[k-1][i] + v[j-1] * (W[j] - W[i])
+        cand = dp[k - 1, :m, None] + v[None, :] * (w[1:][None, :] - w[:m, None])
+        cand = np.where(lower & (dp[k - 1, :m, None] < inf), cand, inf)
+        dp[k, 1:] = cand.min(axis=0)
+        parent[k, 1:] = cand.argmin(axis=0)
+    # more buckets never costs more: take the best k for covering all m
+    k_best = int(np.argmin(dp[1:, m])) + 1
+    bounds = []
+    j = m
+    for k in range(k_best, 0, -1):
+        bounds.append(int(v[j - 1]))
+        j = int(parent[k, j])
+    bounds = np.array(sorted(set(bounds)), np.int64)
+    # pad each size to its bucket boundary
+    pos = np.searchsorted(bounds, x, side="left")
+    return bounds[pos]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -500,8 +577,16 @@ class RandomEffectDataset:
         # --- bucketing by (padded samples, padded features) ----------------
         buckets: list[REBucket] = []
         if n_active:
-            s_pad = _geom_at_least(n_samp_per_entity, config.sample_bucket_growth)
-            d_pad = _geom_at_least(n_feat_per_entity, config.feature_bucket_growth)
+            if config.bucket_strategy == "histogram":
+                s_pad = _histogram_pad(n_samp_per_entity,
+                                       config.max_sample_buckets)
+                d_pad = _histogram_pad(n_feat_per_entity,
+                                       config.max_feature_buckets)
+            else:
+                s_pad = _geom_at_least(n_samp_per_entity,
+                                       config.sample_bucket_growth)
+                d_pad = _geom_at_least(n_feat_per_entity,
+                                       config.feature_bucket_growth)
             bucket_key = s_pad * np.int64(1 << 40) + d_pad
             for key in np.unique(bucket_key):
                 sel = np.flatnonzero(bucket_key == key)
@@ -603,7 +688,10 @@ def _random_projection_buckets(
     z = projector.project_rows(sub.cols, sub.vals, sub.rows(), len(all_active))
     d = projector.projected_dim
     n_samp = np.bincount(ent_of_active, minlength=n_active).astype(np.int64)
-    s_pad = _geom_at_least(n_samp, config.sample_bucket_growth)
+    if config.bucket_strategy == "histogram":
+        s_pad = _histogram_pad(n_samp, config.max_sample_buckets)
+    else:
+        s_pad = _geom_at_least(n_samp, config.sample_bucket_growth)
     for s_key in np.unique(s_pad):
         sel = np.flatnonzero(s_pad == s_key)
         S, E = int(s_key), len(sel)

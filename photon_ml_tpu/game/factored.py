@@ -159,9 +159,9 @@ class FactoredRandomEffectCoordinate:
         return np.asarray(result.w, np.float32).reshape(
             self.latent_dim, x_dev.shape[1])
 
-    def train(self, offsets: np.ndarray,
+    def train(self, offsets,
               warm_start: Optional[RandomEffectModel] = None,
-              sweep: int = 0) -> tuple[RandomEffectModel, np.ndarray]:
+              sweep: int = 0) -> tuple[RandomEffectModel, jax.Array]:
         shard = self.data.shards[self.dataset_config.feature_shard_id]
         if warm_start is not None and warm_start.projector is not None:
             p = warm_start.projector.matrix
@@ -195,5 +195,7 @@ class FactoredRandomEffectCoordinate:
             self.coordinate_id, self.data, self._ds_config,
             projector=projector)
         latent, _ = solver.train(dataset, offsets, self.lam, warm_start=latent)
-        scores = latent.score(self.data)
+        # active+passive scoring via the host model table; scores return to
+        # device per the Coordinate contract (CD's accounting is on-device)
+        scores = jnp.asarray(latent.score(self.data), jnp.float32)
         return latent, scores

@@ -122,20 +122,30 @@ class RandomEffectSolver:
         return jax.device_put(a, NamedSharding(self.mesh, P(self.entity_axis)))
 
     def _static_arrays(self, dataset: RandomEffectDataset, i: int,
-                       bucket: REBucket):
+                       bucket: REBucket, n: int):
         """Device placements of the per-sweep-invariant bucket arrays,
         cached on the dataset so each CD sweep re-uploads only the small
-        dynamic inputs (offsets, warm starts). With
-        ``config.cache_device_buckets`` off, reverts to upload-and-drop
-        (peak HBM = one bucket instead of all of them)."""
-        if not dataset.config.cache_device_buckets:
+        dynamic inputs (warm starts). Two index arrays ride along: the
+        clipped gather index (entity-padded with 0 — harmless, padded rows
+        are weight-0) for the residual-offset gather, and the scatter index
+        (dead rows → ``n``, dropped by the ``mode="drop"`` scatter;
+        deliberately NOT entity-padded, since zero-padding a scatter index
+        would alias sample 0). With ``config.cache_device_buckets`` off,
+        reverts to upload-and-drop (peak HBM = one bucket instead of all)."""
+
+        def build():
             return (self._put(bucket.x), self._put(bucket.labels),
-                    self._put(bucket.weights))
+                    self._put(bucket.weights),
+                    self._put(np.maximum(bucket.sample_idx, 0)),
+                    jnp.asarray(np.where(bucket.sample_idx >= 0,
+                                         bucket.sample_idx, n)))
+
+        if not dataset.config.cache_device_buckets:
+            return build()
         key = (i, self.mesh, self.entity_axis)
         cached = dataset._device_cache.get(key)
         if cached is None:
-            cached = (self._put(bucket.x), self._put(bucket.labels),
-                      self._put(bucket.weights))
+            cached = build()
             dataset._device_cache[key] = cached
         return cached
 
@@ -147,16 +157,19 @@ class RandomEffectSolver:
     def train(
         self,
         dataset: RandomEffectDataset,
-        offsets: np.ndarray,
+        offsets,
         lam: float,
         warm_start: Optional[RandomEffectModel] = None,
         dim: Optional[int] = None,
-    ) -> tuple[RandomEffectModel, np.ndarray]:
+    ) -> tuple[RandomEffectModel, jnp.ndarray]:
         """Train all buckets; returns (model, per-sample active scores).
 
         ``offsets`` is the global residual-offset vector coordinate descent
-        supplies; ``scores`` is this coordinate's margin on every active
-        sample (0 elsewhere — passive scoring is the model's job).
+        supplies — host numpy or a device array; it stays on device either
+        way (bucket gathers use device-cached sample indices, so a CD sweep
+        moves no O(n_samples) data host→device). ``scores`` is a DEVICE
+        vector of this coordinate's margin on every active sample
+        (0 elsewhere — passive scoring is the model's job).
         """
         cfg = dataset.config
         if dataset.projector is not None:
@@ -167,26 +180,30 @@ class RandomEffectSolver:
         keys_parts: list[np.ndarray] = []
         coef_parts: list[np.ndarray] = []
         var_parts: list[np.ndarray] = []
-        scores = np.zeros(offsets.shape[0], np.float32)
+        n = offsets.shape[0]
+        offsets_dev = jnp.asarray(offsets, jnp.float32)
+        scores = jnp.zeros(n, jnp.float32)
         want_var = self.config.variance_type != VarianceComputationType.NONE
 
         for i, bucket in enumerate(dataset.buckets):
-            safe_idx = np.maximum(bucket.sample_idx, 0)
-            boff = offsets[safe_idx].astype(np.float32) * (bucket.weights > 0)
             w0 = _gather_warm_start(bucket, warm_start, shard_dim)
             e_real = bucket.n_entities
-            x_d, lab_d, wt_d = self._static_arrays(dataset, i, bucket)
-            off_d, w0_d = self._put(boff), self._put(w0)
+            x_d, lab_d, wt_d, idx_d, store_d = self._static_arrays(
+                dataset, i, bucket, n)
+            boff = _bucket_offsets(offsets_dev, idx_d, wt_d)
+            w0_d = self._put(w0)
             w_dev, variances, _conv = self._solve_bucket(
-                x_d, lab_d, off_d, wt_d, w0_d, jnp.asarray(lam, jnp.float32))
+                x_d, lab_d, boff, wt_d, w0_d, jnp.asarray(lam, jnp.float32))
             # margins from the already-placed design (x is the dominant
-            # payload; avoid a second host→device copy of it)
-            margins = np.asarray(self._margins_bucket(x_d, w_dev))[:e_real]
+            # payload; avoid a second host→device copy of it), scattered
+            # into the device score vector — dead rows carry index n, which
+            # mode="drop" discards (negative indices would WRAP, not drop)
+            margins = self._margins_bucket(x_d, w_dev)[:e_real]
+            scores = scores.at[store_d].set(margins, mode="drop")
+            # the model table is host-side (searchsorted join): one D2H of
+            # the (entities, local-dim) coefficients — the model itself
             w = np.asarray(w_dev)[:e_real]
             variances = np.asarray(variances)[:e_real]
-
-            live = bucket.sample_idx >= 0
-            scores[bucket.sample_idx[live]] = margins[live]
 
             fmask = bucket.feature_index >= 0
             ent = np.broadcast_to(bucket.entity_ids[:, None],
@@ -212,6 +229,14 @@ class RandomEffectSolver:
             variances=None if variances is None else variances[order],
             projector=dataset.projector)
         return model, scores
+
+
+@jax.jit
+def _bucket_offsets(offsets_dev, idx_d, wt_d):
+    """Gather each bucket row's residual offset on device (zero for padded
+    rows — their weight is 0, and the margin must stay finite)."""
+    flat = jnp.take(offsets_dev, idx_d.reshape(-1), mode="clip")
+    return flat.reshape(idx_d.shape) * (wt_d > 0)
 
 
 def _shard_dim(dataset: RandomEffectDataset) -> int:

@@ -74,6 +74,14 @@ class TestConfigDSL:
         assert cfg.dataset.active_data_upper_bound == 100
         assert cfg.dataset.max_active_features == 50
         assert cfg.optimization.regularization.alpha == 0.7
+        cid, cfg = parse_coordinate_config(
+            "perU=random,entity=userId,shard=u,buckets=histogram,"
+            "maxSampleBuckets=5")
+        assert cfg.dataset.bucket_strategy == "histogram"
+        assert cfg.dataset.max_sample_buckets == 5
+        with pytest.raises(ValueError):
+            parse_coordinate_config(
+                "perU=random,entity=u,shard=u,buckets=bogus")
         with pytest.raises(ValueError):
             parse_coordinate_config("x=fixed,shard=g,bogus=1")
 
