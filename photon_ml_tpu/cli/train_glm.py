@@ -32,7 +32,7 @@ from photon_ml_tpu.io.data_reader import parse_input_columns
 from photon_ml_tpu.io.avro import write_avro_file
 from photon_ml_tpu.io.schemas import FEATURE_SUMMARIZATION_RESULT_AVRO
 from photon_ml_tpu.logging_util import RunLogger, timed
-from photon_ml_tpu.ops.design import CsrDesign, DenseDesign
+from photon_ml_tpu.ops.design import ChunkedSparseDesign, DenseDesign
 from photon_ml_tpu.ops.normalization import NoNormalization, build_normalization
 from photon_ml_tpu.ops.objective import GLMData
 from photon_ml_tpu.ops.regularization import RegularizationContext
@@ -110,10 +110,9 @@ def _to_glm_data(data, shard_id: str) -> GLMData:
     if shard.dim <= DENSE_MAX_DIM:
         design = DenseDesign(x=jnp.asarray(shard.to_dense()))
     else:
-        design = CsrDesign(rows=jnp.asarray(shard.rows(), jnp.int32),
-                           cols=jnp.asarray(shard.cols, jnp.int32),
-                           values=jnp.asarray(shard.vals),
-                           n_rows=shard.n_samples, n_cols=shard.dim)
+        design = ChunkedSparseDesign.from_coo(
+            shard.rows(), shard.cols, shard.vals,
+            n_rows=shard.n_samples, n_cols=shard.dim)
     return GLMData(design=design, labels=jnp.asarray(data.labels),
                    offsets=jnp.asarray(data.offsets),
                    weights=jnp.asarray(data.weights))

@@ -118,4 +118,170 @@ class CsrDesign:
         )
 
 
-Design = Union[DenseDesign, CsrDesign]
+def _chunk_sorted(keys: np.ndarray, payload_idx: np.ndarray, n_keys: int,
+                  chunk: int) -> tuple[np.ndarray, np.ndarray]:
+    """Chunk entries sorted by ``keys`` into fixed-width groups per key.
+
+    Returns ``(gather, chunk_key)``: ``gather`` is an ``(M, chunk)`` int64 index into
+    the payload (−1 = padding slot), ``chunk_key`` ``(M,)`` the key id of
+    each chunk. A key with k entries occupies ceil(k/chunk) chunks.
+    """
+    counts = np.bincount(keys, minlength=n_keys)
+    present = np.flatnonzero(counts)
+    n_chunks_per = -(-counts[present] // chunk)
+    total = int(n_chunks_per.sum())
+    chunk_key = np.repeat(present, n_chunks_per).astype(np.int32)
+    # entry positions: within-key offset → (chunk row, slot)
+    starts = np.zeros(len(present) + 1, np.int64)
+    np.cumsum(counts[present], out=starts[1:])
+    chunk_starts = np.zeros(len(present) + 1, np.int64)
+    np.cumsum(n_chunks_per, out=chunk_starts[1:])
+    within = np.arange(len(keys)) - np.repeat(starts[:-1], counts[present])
+    chunk_row = np.repeat(chunk_starts[:-1], counts[present]) + within // chunk
+    slot = within % chunk
+    gather = np.full((total, chunk), -1, np.int64)
+    gather[chunk_row, slot] = payload_idx
+    return gather, chunk_key
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class ChunkedSparseDesign:
+    """Dual chunked-COO sparse design: scatters shrunk by chunk partial sums.
+
+    Motivation (measured on the axon TPU v5e, 12.8M nnz, d=100k):
+    ``CsrDesign``'s per-nnz ``segment_sum`` margins cost ~116 ms and its
+    scatter-add transpose ~89 ms, while a gather + fixed-width row-sum of
+    the same entries costs ~5 ms — XLA lowers large scatters serially on
+    TPU, but gathers and lane reductions stream. So this layout stores the
+    entries TWICE, pre-sorted on host at build time:
+
+    - row-major: ``(Mr, C)`` values/col-ids with one row id per chunk —
+      margins = per-chunk ``Σ v·w[col]`` then a segment-sum of ONLY
+      ``Mr ≈ nnz/C + n`` partials;
+    - col-major: ``(Mc, C)`` values/row-ids with one col id per chunk —
+      the gradient transpose the same way into ``d`` bins.
+
+    Chunk padding carries ``value = 0`` (contributes nothing). The chunk
+    width trades padding (small C) against scatter length (large C); the
+    builder defaults to the per-key median rounded to a multiple of 8,
+    clamped to [8, 128]. 2x memory vs CsrDesign — the price of replacing
+    both big scatters. This is the counterpart of the reference's executor-
+    local hash-map gradient accumulation in
+    ``function/glm/ValueAndGradientAggregator.scala``, re-shaped for a
+    machine that hates random writes and loves wide reads.
+    """
+
+    rvals: Array  # (Mr, C) f32
+    rcols: Array  # (Mr, C) int32
+    rrow: Array  # (Mr,) int32 — row id per chunk (non-decreasing)
+    cvals: Array  # (Mc, C) f32
+    crows: Array  # (Mc, C) int32
+    ccol: Array  # (Mc,) int32 — col id per chunk (non-decreasing)
+    n_rows: int = dataclasses.field(metadata=dict(static=True))
+    n_cols: int = dataclasses.field(metadata=dict(static=True))
+
+    @property
+    def n_samples(self) -> int:
+        return self.n_rows
+
+    @property
+    def dim(self) -> int:
+        return self.n_cols
+
+    @staticmethod
+    def _gather2d(table: Array, idx: Array) -> Array:
+        """``table[idx]`` for a 2D index array via a FLAT gather + reshape —
+        XLA lowers a gather with a 2D start-index array ~30x slower on TPU
+        (measured 129 ms vs 4.3 ms for 13M indices)."""
+        return jnp.take(table, idx.reshape(-1), axis=0).reshape(idx.shape)
+
+    def matvec(self, w: Array) -> Array:
+        acc = jnp.promote_types(jnp.promote_types(self.rvals.dtype, w.dtype),
+                                jnp.float32)
+        part = jnp.sum((self.rvals * self._gather2d(w, self.rcols)
+                        ).astype(acc), axis=-1)
+        return jax.ops.segment_sum(part, self.rrow, num_segments=self.n_rows,
+                                   indices_are_sorted=True)
+
+    def rmatvec(self, g: Array) -> Array:
+        acc = jnp.promote_types(jnp.promote_types(self.cvals.dtype, g.dtype),
+                                jnp.float32)
+        part = jnp.sum((self.cvals * self._gather2d(g, self.crows)
+                        ).astype(acc), axis=-1)
+        return jax.ops.segment_sum(part, self.ccol, num_segments=self.n_cols,
+                                   indices_are_sorted=True)
+
+    def rmatvec_squared(self, g: Array) -> Array:
+        """``(X²)ᵀ g`` — the Hessian-diagonal contraction (values squared)."""
+        acc = jnp.promote_types(jnp.promote_types(self.cvals.dtype, g.dtype),
+                                jnp.float32)
+        part = jnp.sum((jnp.square(self.cvals)
+                        * self._gather2d(g, self.crows)).astype(acc),
+                       axis=-1)
+        return jax.ops.segment_sum(part, self.ccol, num_segments=self.n_cols,
+                                   indices_are_sorted=True)
+
+    @staticmethod
+    def default_chunk(counts: np.ndarray) -> int:
+        """Median nnz of the non-empty keys, rounded to 8 in [8, 128]."""
+        nz = counts[counts > 0]
+        if not len(nz):
+            return 8
+        med = int(np.median(nz))
+        return int(np.clip(-(-med // 8) * 8, 8, 128))
+
+    @staticmethod
+    def layout_numpy(rows, cols, vals, *, row_chunk: int | None = None,
+                     col_chunk: int | None = None) -> dict:
+        """Host-side chunk layouts as numpy arrays (for stacking/sharding)."""
+        rows = np.asarray(rows, np.int64)
+        cols = np.asarray(cols, np.int64)
+        vals = np.asarray(vals, np.float32)
+        live = vals != 0  # drop explicit zero padding from CSR-style inputs
+        rows, cols, vals = rows[live], cols[live], vals[live]
+        if row_chunk is None:
+            row_chunk = ChunkedSparseDesign.default_chunk(
+                np.bincount(rows) if len(rows) else np.zeros(1, np.int64))
+        if col_chunk is None:
+            col_chunk = ChunkedSparseDesign.default_chunk(
+                np.bincount(cols) if len(cols) else np.zeros(1, np.int64))
+
+        def layout(keys, chunk):
+            order = np.argsort(keys, kind="stable")
+            gather, chunk_key = _chunk_sorted(
+                keys[order], order,
+                max(int(keys.max()) + 1 if len(keys) else 1, 1), chunk)
+            pad = gather < 0
+            safe = np.where(pad, 0, gather)
+            v = np.where(pad, 0.0, vals[safe] if len(vals) else 0.0
+                         ).astype(np.float32)
+            return v, safe, chunk_key
+
+        rvals, r_src, rrow = layout(rows, row_chunk)
+        cvals, c_src, ccol = layout(cols, col_chunk)
+        safe_cols = cols[r_src] if len(cols) else np.zeros_like(r_src)
+        safe_rows = rows[c_src] if len(rows) else np.zeros_like(c_src)
+        return dict(
+            rvals=rvals, rcols=safe_cols.astype(np.int32), rrow=rrow,
+            cvals=cvals, crows=safe_rows.astype(np.int32), ccol=ccol,
+            row_chunk=row_chunk, col_chunk=col_chunk)
+
+    @staticmethod
+    def from_coo(rows, cols, vals, n_rows: int, n_cols: int,
+                 row_chunk: int | None = None, col_chunk: int | None = None,
+                 ) -> "ChunkedSparseDesign":
+        """Build both layouts from host COO triplets. Duplicate (row, col)
+        entries occupy separate slots and accumulate in every contraction,
+        the same semantics as CsrDesign."""
+        lay = ChunkedSparseDesign.layout_numpy(
+            rows, cols, vals, row_chunk=row_chunk, col_chunk=col_chunk)
+        return ChunkedSparseDesign(
+            rvals=jnp.asarray(lay["rvals"]), rcols=jnp.asarray(lay["rcols"]),
+            rrow=jnp.asarray(lay["rrow"]),
+            cvals=jnp.asarray(lay["cvals"]), crows=jnp.asarray(lay["crows"]),
+            ccol=jnp.asarray(lay["ccol"]),
+            n_rows=int(n_rows), n_cols=int(n_cols))
+
+
+Design = Union[DenseDesign, CsrDesign, ChunkedSparseDesign]

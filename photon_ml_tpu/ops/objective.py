@@ -38,7 +38,12 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from photon_ml_tpu.ops.design import CsrDesign, DenseDesign, Design
+from photon_ml_tpu.ops.design import (
+    ChunkedSparseDesign,
+    CsrDesign,
+    DenseDesign,
+    Design,
+)
 from photon_ml_tpu.ops.losses import PointwiseLoss
 from photon_ml_tpu.ops.normalization import NormalizationContext, NoNormalization
 
@@ -172,10 +177,21 @@ class GLMObjective:
         return jax.grad(self.value)(w, data, l2)
 
     def hvp(self, w: Array, v: Array, data: GLMData, l2=0.0) -> Array:
-        """Exact Hessian-vector product via forward-over-reverse autodiff.
+        """Exact Hessian-vector product. Replaces
+        ``HessianVectorAggregator.scala``; feeds TRON's inner CG.
 
-        Replaces ``HessianVectorAggregator.scala``; feeds TRON's inner CG.
+        Identity-normalization path is closed form —
+        ``Xᵀ(weight·d2·(Xv)) + l2·v`` — through the design's forward/
+        transpose fast paths (autodiff would differentiate through
+        ``matvec``, and the backward of a sparse gather is the giant
+        scatter the chunked design exists to avoid). Normalized objectives
+        fall back to forward-over-reverse autodiff.
         """
+        if self.normalization.is_identity:
+            d2w = self._d2_weights(w, data)
+            hv = data.design.rmatvec(d2w * data.design.matvec(v)).astype(w.dtype)
+            reg = l2 if self.reg_mask is None else l2 * self.reg_mask
+            return hv + jnp.asarray(reg, w.dtype) * v
         g = lambda w_: jax.grad(self.value)(w_, data, l2)
         return jax.jvp(g, (w,), (v,))[1]
 
@@ -206,6 +222,14 @@ class GLMObjective:
                 x = x * factors
             diag = jnp.einsum("nd,n->d", jnp.square(x), d2,
                               preferred_element_type=jnp.promote_types(x.dtype, jnp.float32))
+        elif isinstance(design, ChunkedSparseDesign):
+            if self.normalization.shifts is not None:
+                raise NotImplementedError(
+                    "hessian_diagonal with shift-normalization on sparse designs")
+            # Σ_i d2_i (f_j x_ij)² = f_j² · Σ_i d2_i x_ij²
+            diag = design.rmatvec_squared(d2)
+            if factors is not None:
+                diag = diag * jnp.square(factors)
         elif isinstance(design, CsrDesign):
             if self.normalization.shifts is not None:
                 raise NotImplementedError(

@@ -29,7 +29,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
-from photon_ml_tpu.ops.design import CsrDesign, DenseDesign
+from photon_ml_tpu.ops.design import ChunkedSparseDesign, CsrDesign, DenseDesign
 from photon_ml_tpu.ops.objective import GLMData, GLMObjective
 from photon_ml_tpu.parallel.mesh import DATA_AXIS, FEATURE_AXIS
 
@@ -76,25 +76,54 @@ def shard_glm_data(data: GLMData, n_shards: int, *, device_put_mesh: Optional[Me
         xp = np.zeros((n_pad, x.shape[1]), x.dtype)
         xp[:n] = x
         sharded_design = DenseDesign(x=jnp.asarray(xp.reshape(n_shards, per, x.shape[1])))
-    elif isinstance(design, CsrDesign):
+    elif isinstance(design, (CsrDesign, ChunkedSparseDesign)):
+        if isinstance(design, ChunkedSparseDesign):
+            raise TypeError(
+                "shard_glm_data splits by row from COO; pass the host "
+                "CsrDesign and the sharded layout is built chunked per block")
         rows = np.asarray(design.rows)
         cols = np.asarray(design.cols)
         vals = np.asarray(design.values)
         block_of = rows // per
         local_row = rows % per
-        counts = np.bincount(block_of, minlength=n_shards)
-        budget = int(counts.max()) if counts.size else 0
-        r = np.zeros((n_shards, budget), np.int32)
-        c = np.zeros((n_shards, budget), np.int32)
-        v = np.zeros((n_shards, budget), vals.dtype)
+        # per-block chunked layouts (ChunkedSparseDesign: the dual
+        # gather+partial-sum form that replaces the big scatters), with
+        # common chunk widths and chunk counts padded to the block max so
+        # the blocks stack into one leading-device-dim pytree
+        live = vals != 0
+        row_chunk = ChunkedSparseDesign.default_chunk(
+            np.bincount(local_row[live], minlength=per))
+        col_chunk = ChunkedSparseDesign.default_chunk(
+            np.bincount(cols[live], minlength=design.n_cols))
+        lays = []
         for b in range(n_shards):
             sel = block_of == b
-            k = int(counts[b])
-            r[b, :k] = local_row[sel]
-            c[b, :k] = cols[sel]
-            v[b, :k] = vals[sel]
-        sharded_design = CsrDesign(
-            rows=jnp.asarray(r), cols=jnp.asarray(c), values=jnp.asarray(v),
+            lays.append(ChunkedSparseDesign.layout_numpy(
+                local_row[sel], cols[sel], vals[sel],
+                row_chunk=row_chunk, col_chunk=col_chunk))
+        mr = max(lay["rrow"].shape[0] for lay in lays)
+        mc = max(lay["ccol"].shape[0] for lay in lays)
+
+        def pad_stack(key, m, fill):
+            outs = []
+            for lay in lays:
+                a = lay[key]
+                pad_n = m - a.shape[0]
+                if pad_n:
+                    pad_block = np.full((pad_n,) + a.shape[1:], fill, a.dtype)
+                    a = np.concatenate([a, pad_block])
+                outs.append(a)
+            return jnp.asarray(np.stack(outs))
+
+        sharded_design = ChunkedSparseDesign(
+            rvals=pad_stack("rvals", mr, 0.0),
+            rcols=pad_stack("rcols", mr, 0),
+            # pad segment ids with the LAST id so sortedness holds; padded
+            # chunks carry value 0 and contribute nothing
+            rrow=pad_stack("rrow", mr, max(per - 1, 0)),
+            cvals=pad_stack("cvals", mc, 0.0),
+            crows=pad_stack("crows", mc, 0),
+            ccol=pad_stack("ccol", mc, max(design.n_cols - 1, 0)),
             n_rows=per, n_cols=design.n_cols)
     else:
         raise TypeError(type(design))
@@ -169,6 +198,22 @@ class DistributedGLMObjective:
         return self.value_and_grad(w, sharded, l2)[1]
 
     def hvp(self, w: Array, v: Array, sharded: GLMData, l2=0.0):
+        if self.objective.normalization.is_identity:
+            # closed form per shard (the design's forward/transpose fast
+            # paths — autodiff's gather backward would re-create the per-nnz
+            # scatter the chunked sparse layout exists to avoid), psum'd;
+            # L2 curvature added once outside
+            def body(wv, tangent, blk):
+                local = self.objective.hvp(wv, tangent, _unstack(blk), 0.0)
+                return jax.lax.psum(local, self.axis)
+
+            hv = shard_map(body, mesh=self.mesh,
+                           in_specs=(P(), P(), P(self.axis)),
+                           out_specs=P())(w, v, sharded)
+            reg = (l2 if self.objective.reg_mask is None
+                   else l2 * self.objective.reg_mask)
+            return hv + jnp.asarray(reg, w.dtype) * v
+
         def body(wv, tangent, blk):
             g = jax.grad(self._global_value_fn(blk, l2))
             return jax.jvp(g, (wv,), (tangent,))[1]

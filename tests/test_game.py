@@ -467,7 +467,8 @@ class TestWideSparseFixedEffect:
             optimizer_config=OptimizerConfig(max_iterations=30))
 
         ds0 = FixedEffectDataset.build("fe", data, "wide")
-        assert isinstance(ds0.design, CsrDesign)
+        from photon_ml_tpu.ops.design import ChunkedSparseDesign
+        assert isinstance(ds0.design, ChunkedSparseDesign)
         c0 = FixedEffectCoordinate(
             coordinate_id="fe", dataset=ds0,
             task=TaskType.LOGISTIC_REGRESSION, config=cfg, lam=0.5)
