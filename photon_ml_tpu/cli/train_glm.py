@@ -95,6 +95,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--input-columns", default="",
                    help="remap record fields, e.g. 'response=label' "
                         "(reference InputColumnsNames)")
+    p.add_argument("--design-dtype", default="float32",
+                   choices=["float32", "bfloat16"],
+                   help="storage dtype of a DENSE design matrix. bfloat16 "
+                        "halves HBM traffic (the solve is bandwidth-bound; "
+                        "~1.4x faster with the fused kernel) but rounds the "
+                        "features to ~3 decimal digits, perturbing the "
+                        "optimum — keep float32 where exact reference "
+                        "parity matters")
     return p
 
 
@@ -105,11 +113,13 @@ def _positive_int(s: str) -> int:
     return v
 
 
-def _to_glm_data(data, shard_id: str) -> GLMData:
+def _to_glm_data(data, shard_id: str, dtype=jnp.float32) -> GLMData:
     shard = data.shards[shard_id]
     if shard.dim <= DENSE_MAX_DIM:
-        design = DenseDesign(x=jnp.asarray(shard.to_dense()))
+        design = DenseDesign(x=jnp.asarray(shard.to_dense(), dtype))
     else:
+        # sparse chunked layouts keep f32 values (nnz dominates memory far
+        # less than a dense design; bf16 applies to the dense path only)
         design = ChunkedSparseDesign.from_coo(
             shard.rows(), shard.cols, shard.vals,
             n_rows=shard.n_samples, n_cols=shard.dim)
@@ -247,7 +257,9 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
             mask[imap.key_to_index[INTERCEPT_KEY]] = 0.0
             reg_mask = jnp.asarray(mask)
 
-        glm_train = _to_glm_data(data, "global")
+        design_dtype = (jnp.bfloat16 if args.design_dtype == "bfloat16"
+                        else jnp.float32)
+        glm_train = _to_glm_data(data, "global", dtype=design_dtype)
         from photon_ml_tpu.logging_util import log_optimizer_trace, profiled
 
         with timed("Train", run_logger), profiled(
@@ -276,7 +288,7 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
             with timed("Read validation data", run_logger):
                 vdata, _, _ = reader_v.read(args.validation_data,
                                             id_columns=id_columns)
-            glm_val = _to_glm_data(vdata, "global")
+            glm_val = _to_glm_data(vdata, "global", dtype=design_dtype)
         if glm_val is not None and evaluators:
             with timed("Validate models", run_logger):
                 best_idx, trained = validate_and_select(

@@ -40,9 +40,14 @@ CoordinateModel = Union[FixedEffectModel, RandomEffectModel]
 
 @lru_cache(maxsize=None)
 def _fixed_train_fn(task: TaskType, config: GLMOptimizationConfiguration):
-    """One compiled fixed-effect train step per (task, config)."""
+    """One compiled fixed-effect train step per (task, config).
+
+    ``fused=True`` engages the one-pass Pallas value+grad kernel on TPU for
+    dense designs (transparent fallback otherwise — ops/pallas_glm.py). The
+    mesh-sharded variant below keeps the XLA path until the kernel has run
+    under shard_map on real multi-chip hardware."""
     problem = OptimizationProblem(
-        GLMObjective(loss=loss_for_task(task)), config)
+        GLMObjective(loss=loss_for_task(task), fused=True), config)
 
     @jax.jit
     def train(data, w0, lam):

@@ -176,7 +176,7 @@ class FixedEffectDataset:
 
     coordinate_id: str
     feature_shard_id: str
-    design: object  # DenseDesign | CsrDesign (device; stacked when sharded)
+    design: object  # DenseDesign | ChunkedSparseDesign (device; stacked when sharded)
     labels: jnp.ndarray
     weights: jnp.ndarray
     dim: int

@@ -6,7 +6,7 @@ per-sample dot products in JVM loops
 ``function/glm/ValueAndGradientAggregator.scala``). TPUs want the opposite:
 large, fixed-shape, batched contractions that XLA can tile onto the MXU.
 
-Two representations, both jit/vmap-safe pytrees:
+Three representations, all jit/vmap-safe pytrees:
 
 - :class:`DenseDesign` — an ``(n, d)`` matrix; margins are one matmul. Right
   choice whenever ``d`` is modest (a1a's 123 features) or data is dense after
@@ -15,7 +15,10 @@ Two representations, both jit/vmap-safe pytrees:
   nnz budget; margins via ``segment_sum`` and the gradient transpose via a
   scatter-add, both XLA-native. Padding entries carry ``value = 0`` so they
   contribute nothing to either pass. Right choice for the reference's
-  sparse-feature regime (millions of features, ~hundreds of nnz/row).
+  sparse-feature regime (millions of features, ~hundreds of nnz/row) —
+  superseded on TPU by :class:`ChunkedSparseDesign` (below), which replaces
+  both per-nnz ops with gathers + chunk partial sums; CsrDesign remains the
+  COO container/reference implementation.
 
 Autodiff through ``matvec`` gives the gradient/Hvp aggregation for free —
 XLA transposes a matmul into a matmul and a gather into a scatter — which is

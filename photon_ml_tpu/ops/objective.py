@@ -120,6 +120,12 @@ class GLMObjective:
         wr = self._reg_w(w)
         return 0.5 * l2 * jnp.vdot(wr, wr)
 
+    def reg_curvature(self, l2):
+        """The L2 term's Hessian diagonal — single home of the 0/1-mask
+        curvature convention (d²/dw² of 0.5·l2·||w·mask||² = l2·mask for a
+        0/1 mask; shared by the distributed wrappers)."""
+        return l2 if self.reg_mask is None else l2 * self.reg_mask
+
     def value(self, w: Array, data: GLMData, l2=0.0) -> Array:
         live = data.weights > 0
         m = self.margins(w, data)
@@ -190,8 +196,7 @@ class GLMObjective:
         if self.normalization.is_identity:
             d2w = self._d2_weights(w, data)
             hv = data.design.rmatvec(d2w * data.design.matvec(v)).astype(w.dtype)
-            reg = l2 if self.reg_mask is None else l2 * self.reg_mask
-            return hv + jnp.asarray(reg, w.dtype) * v
+            return hv + jnp.asarray(self.reg_curvature(l2), w.dtype) * v
         g = lambda w_: jax.grad(self.value)(w_, data, l2)
         return jax.jvp(g, (w,), (v,))[1]
 
@@ -239,9 +244,7 @@ class GLMObjective:
             diag = jnp.zeros((design.dim,), contrib.dtype).at[design.cols].add(contrib)
         else:
             raise TypeError(type(design))
-        if self.reg_mask is None:
-            return diag + l2
-        return diag + l2 * self.reg_mask
+        return diag + self.reg_curvature(l2)
 
     def hessian_matrix(self, w: Array, data: GLMData, l2=0.0) -> Array:
         """Full ``(d, d)`` Hessian (VarianceComputationType FULL; replaces
@@ -259,5 +262,5 @@ class GLMObjective:
             x = x * self.normalization.factors
         h = jnp.einsum("nd,n,ne->de", x, d2, x,
                        preferred_element_type=jnp.promote_types(x.dtype, jnp.float32))
-        reg = l2 if self.reg_mask is None else l2 * self.reg_mask
-        return h + jnp.diag(jnp.broadcast_to(reg, (data.dim,)))
+        return h + jnp.diag(jnp.broadcast_to(self.reg_curvature(l2),
+                                             (data.dim,)))

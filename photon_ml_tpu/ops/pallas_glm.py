@@ -88,15 +88,20 @@ def _kernel(loss: PointwiseLoss, x_ref, y_ref, off_ref, wt_ref, w_ref,
     off = off_ref[0]
     wt = wt_ref[0]
 
-    # precision=HIGHEST: the MXU's default f32 handling is a single bf16
-    # pass (~1e-3 relative — measured 40x worse gradients than the XLA
-    # closed form, enough to disturb L-BFGS paths); HIGHEST selects the
-    # multi-pass f32 emulation. No wall-clock cost: the kernel is HBM-bound.
+    # precision=HIGHEST for f32 designs: the MXU's default f32 handling is
+    # a single bf16 pass (~1e-3 relative — measured 40x worse gradients
+    # than the XLA closed form, enough to disturb L-BFGS paths); HIGHEST
+    # selects the multi-pass f32 emulation at no wall-clock cost (the
+    # kernel is HBM-bound). bf16 designs keep DEFAULT — requesting an
+    # fp32-contract on bf16 operands is rejected by Mosaic ("Bad lhs
+    # type"), and bf16 storage has already rounded the data anyway.
+    precision = (jax.lax.Precision.HIGHEST if x.dtype == jnp.float32
+                 else jax.lax.Precision.DEFAULT)
     m = jax.lax.dot_general(
         w.astype(x.dtype), x,
         dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST) + off  # (1, B)
+        precision=precision) + off  # (1, B)
     # padded rows carry weight 0: evaluate them at margin 0 (finite) AND
     # zero-weight the output — the double-where guard of GLMObjective.value
     live = wt > 0
@@ -108,7 +113,7 @@ def _kernel(loss: PointwiseLoss, x_ref, y_ref, off_ref, wt_ref, w_ref,
         dvec.astype(x.dtype), x,
         dimension_numbers=(((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST)  # (1, D)
+        precision=precision)  # (1, D)
 
 
 def _default_block_rows(dtype) -> int:

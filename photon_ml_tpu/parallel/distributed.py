@@ -91,10 +91,15 @@ def shard_glm_data(data: GLMData, n_shards: int, *, device_put_mesh: Optional[Me
         # common chunk widths and chunk counts padded to the block max so
         # the blocks stack into one leading-device-dim pytree
         live = vals != 0
+        # per-BLOCK key counts pick the width: blocks partition rows, so
+        # global per-row counts equal per-block ones; columns appear in
+        # every block, so count (block, col) pairs — merging across blocks
+        # would inflate the medians (and the padding) ~n_shards x
         row_chunk = ChunkedSparseDesign.default_chunk(
-            np.bincount(local_row[live], minlength=per))
+            np.bincount(rows[live], minlength=n))
         col_chunk = ChunkedSparseDesign.default_chunk(
-            np.bincount(cols[live], minlength=design.n_cols))
+            np.bincount(block_of[live] * np.int64(design.n_cols)
+                        + cols[live]))
         lays = []
         for b in range(n_shards):
             sel = block_of == b
@@ -210,9 +215,8 @@ class DistributedGLMObjective:
             hv = shard_map(body, mesh=self.mesh,
                            in_specs=(P(), P(), P(self.axis)),
                            out_specs=P())(w, v, sharded)
-            reg = (l2 if self.objective.reg_mask is None
-                   else l2 * self.objective.reg_mask)
-            return hv + jnp.asarray(reg, w.dtype) * v
+            return hv + jnp.asarray(self.objective.reg_curvature(l2),
+                                    w.dtype) * v
 
         def body(wv, tangent, blk):
             g = jax.grad(self._global_value_fn(blk, l2))
@@ -243,17 +247,15 @@ class DistributedGLMObjective:
         """Distributed VarianceComputationType SIMPLE (the reference's
         ``HessianDiagonalAggregator`` treeAggregate)."""
         diag = self._psum_of_local("hessian_diagonal", w, sharded)
-        if self.objective.reg_mask is None:
-            return diag + l2
-        return diag + l2 * self.objective.reg_mask
+        return diag + self.objective.reg_curvature(l2)
 
     def hessian_matrix(self, w: Array, sharded: GLMData, l2=0.0) -> Array:
         """Distributed VarianceComputationType FULL
         (``HessianMatrixAggregator``)."""
         h = self._psum_of_local("hessian_matrix", w, sharded)
         d = w.shape[0]
-        reg = l2 if self.objective.reg_mask is None else l2 * self.objective.reg_mask
-        return h + jnp.diag(jnp.broadcast_to(reg, (d,)))
+        return h + jnp.diag(jnp.broadcast_to(
+            jnp.asarray(self.objective.reg_curvature(l2)), (d,)))
 
 
 # ---------------------------------------------------------------------------

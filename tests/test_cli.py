@@ -120,6 +120,24 @@ class TestTrainGlmDriver:
         vals = [abs(float(v)) for _, _, v in lines]
         assert vals == sorted(vals, reverse=True)
 
+    def test_bfloat16_design(self, tmp_path):
+        """--design-dtype bfloat16 trains end-to-end and lands near the f32
+        solution (bf16 rounds features, so agreement is loose)."""
+        train = make_avro_dataset(tmp_path / "train.avro", n=800, seed=0)
+        val = make_avro_dataset(tmp_path / "val.avro", n=400, seed=1)
+        results = {}
+        for dt in ("float32", "bfloat16"):
+            out = str(tmp_path / f"out-{dt}")
+            results[dt] = train_glm_cli.run([
+                "--training-data", train, "--validation-data", val,
+                "--output-dir", out, "--task", "LOGISTIC_REGRESSION",
+                "--regularization-weights", "1", "--evaluators", "AUC",
+                "--design-dtype", dt,
+            ])
+        auc32 = results["float32"]["best_evaluation"]["AUC"]
+        auc16 = results["bfloat16"]["best_evaluation"]["AUC"]
+        assert abs(auc32 - auc16) < 0.02
+
     def test_training_diagnostics(self, tmp_path):
         train = make_avro_dataset(tmp_path / "train.avro", n=500, seed=0)
         val = make_avro_dataset(tmp_path / "val.avro", n=300, seed=1)
