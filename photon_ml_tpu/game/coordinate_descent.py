@@ -124,7 +124,10 @@ class CoordinateDescent:
                 models[cid] = model
                 total = residual + new_scores
                 scores[cid] = new_scores
-                logger.info("sweep %d coordinate %s trained in %.2fs",
+                # dispatch time: device work may still be in flight (async
+                # dispatch is what lets the next coordinate's host prep
+                # overlap); the sweep wall-clock is the honest total
+                logger.info("sweep %d coordinate %s dispatched in %.2fs",
                             sweep, cid, time.perf_counter() - t0)
                 if checkpoint is not None:
                     from photon_ml_tpu.io.checkpoint import CoordinateDescentState

@@ -154,6 +154,55 @@ class RandomEffectSolver:
         return jnp.einsum("esd,ed->es", x, w,
                           preferred_element_type=jnp.float32)
 
+    def _warm_compile(self, dataset: RandomEffectDataset) -> None:
+        """Pre-compile every distinct bucket shape CONCURRENTLY.
+
+        Each distinct (entities, samples, features) bucket shape is its own
+        XLA program; compiling lazily inside the bucket loop serializes the
+        compiles because the model-table D2H after each solve blocks until
+        that bucket finishes. XLA compilation releases the GIL, so a thread
+        pool can overlap the compiles up to the backend compiler's own
+        concurrency — sweep-0 on an 8-shape power-law coordinate measured
+        81 s → 69 s on the axon remote compiler (which serializes most of
+        the work server-side); a host-local libtpu compile parallelizes
+        properly. Keyed per dataset; later sweeps hit jit's own cache and
+        skip this entirely.
+        """
+        if getattr(dataset, "_warm_compiled", None) == (self.mesh,):
+            return
+        shapes = sorted({(bucket.x.shape, bucket.labels.shape)
+                         for bucket in dataset.buckets})
+        if len(shapes) <= 1:
+            object.__setattr__(dataset, "_warm_compiled", (self.mesh,))
+            return
+
+        def compile_one(shape_pair):
+            # the NORMAL call path on all-zero dummies: lower().compile()
+            # would build an AOT executable that the jit dispatch cache never
+            # sees (it would recompile on first real call). Dummies go
+            # through the same _put placement as the real arguments — the
+            # jit cache keys on sharding, so a differently-placed dummy
+            # would compile a program the real call never uses. Zero data
+            # makes the wasted execution converge immediately (gradient =
+            # L2 at w=0 = 0 for every lane).
+            xs, ls = shape_pair
+            f32 = np.float32
+            args = (self._put(np.zeros(xs, f32)), self._put(np.zeros(ls, f32)),
+                    self._put(np.zeros(ls, f32)), self._put(np.zeros(ls, f32)),
+                    self._put(np.zeros((xs[0], xs[2]), f32)),
+                    jnp.zeros((), jnp.float32))
+            jax.block_until_ready(self._solve_bucket(*args))
+
+        import concurrent.futures as cf
+
+        # upload-and-drop mode bounds peak HBM to ~one bucket; concurrent
+        # dummy placements would hold one design per worker, so serialize
+        workers = (1 if not dataset.config.cache_device_buckets
+                   else min(8, len(shapes)))
+        with cf.ThreadPoolExecutor(max_workers=workers) as pool:
+            list(pool.map(compile_one, shapes))
+        object.__setattr__(dataset, "_warm_compiled", (self.mesh,))
+
     def train(
         self,
         dataset: RandomEffectDataset,
@@ -184,6 +233,33 @@ class RandomEffectSolver:
         offsets_dev = jnp.asarray(offsets, jnp.float32)
         scores = jnp.zeros(n, jnp.float32)
         want_var = self.config.variance_type != VarianceComputationType.NONE
+        self._warm_compile(dataset)
+
+        # Phase 1 — dispatch every bucket's solve/margins/scatter without a
+        # single device sync: jax dispatch is async, so all bucket programs
+        # queue back-to-back on the device while the host runs ahead. A D2H
+        # inside the loop (the old structure) would block bucket i+1's
+        # dispatch on bucket i's completion. EXCEPT in upload-and-drop mode
+        # (cache_device_buckets=False): queued programs pin every bucket's
+        # design in HBM, which is exactly what that flag bounds — there the
+        # loop syncs per bucket so bucket i's x frees before i+1 uploads.
+        streaming = not cfg.cache_device_buckets
+        lam_dev = jnp.asarray(lam, jnp.float32)
+        pending = []
+
+        def collect(bucket, e_real, w_dev, variances):
+            # one D2H of the (entities, local-dim) coefficients — the model
+            # itself — and host table assembly
+            w = np.asarray(w_dev)[:e_real]
+            variances = np.asarray(variances)[:e_real]
+            fmask = bucket.feature_index >= 0
+            ent = np.broadcast_to(bucket.entity_ids[:, None],
+                                  bucket.feature_index.shape)
+            keys_parts.append(
+                ent[fmask] * np.int64(shard_dim) + bucket.feature_index[fmask])
+            coef_parts.append(w[fmask].astype(np.float32))
+            if want_var and np.asarray(variances).size:
+                var_parts.append(np.asarray(variances)[fmask].astype(np.float32))
 
         for i, bucket in enumerate(dataset.buckets):
             w0 = _gather_warm_start(bucket, warm_start, shard_dim)
@@ -193,26 +269,23 @@ class RandomEffectSolver:
             boff = _bucket_offsets(offsets_dev, idx_d, wt_d)
             w0_d = self._put(w0)
             w_dev, variances, _conv = self._solve_bucket(
-                x_d, lab_d, boff, wt_d, w0_d, jnp.asarray(lam, jnp.float32))
+                x_d, lab_d, boff, wt_d, w0_d, lam_dev)
             # margins from the already-placed design (x is the dominant
             # payload; avoid a second host→device copy of it), scattered
             # into the device score vector — dead rows carry index n, which
             # mode="drop" discards (negative indices would WRAP, not drop)
             margins = self._margins_bucket(x_d, w_dev)[:e_real]
             scores = scores.at[store_d].set(margins, mode="drop")
-            # the model table is host-side (searchsorted join): one D2H of
-            # the (entities, local-dim) coefficients — the model itself
-            w = np.asarray(w_dev)[:e_real]
-            variances = np.asarray(variances)[:e_real]
+            if streaming:
+                # force completion so this bucket's buffers can be dropped
+                jax.block_until_ready(scores)
+                collect(bucket, e_real, w_dev, variances)
+            else:
+                pending.append((bucket, e_real, w_dev, variances))
 
-            fmask = bucket.feature_index >= 0
-            ent = np.broadcast_to(bucket.entity_ids[:, None],
-                                  bucket.feature_index.shape)
-            keys_parts.append(
-                ent[fmask] * np.int64(shard_dim) + bucket.feature_index[fmask])
-            coef_parts.append(w[fmask].astype(np.float32))
-            if want_var and np.asarray(variances).size:
-                var_parts.append(np.asarray(variances)[fmask].astype(np.float32))
+        # Phase 2 — collect (cached-bucket mode)
+        for bucket, e_real, w_dev, variances in pending:
+            collect(bucket, e_real, w_dev, variances)
 
         keys = (np.concatenate(keys_parts) if keys_parts
                 else np.zeros((0,), np.int64))

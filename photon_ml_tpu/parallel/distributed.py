@@ -97,9 +97,13 @@ def shard_glm_data(data: GLMData, n_shards: int, *, device_put_mesh: Optional[Me
         # would inflate the medians (and the padding) ~n_shards x
         row_chunk = ChunkedSparseDesign.default_chunk(
             np.bincount(rows[live], minlength=n))
-        col_chunk = ChunkedSparseDesign.default_chunk(
-            np.bincount(block_of[live] * np.int64(design.n_cols)
-                        + cols[live]))
+        # unique, not bincount: a dense (n_shards * n_cols) count array
+        # would be tens of GB in the wide-sparse regime this path serves;
+        # default_chunk only looks at nonzero counts anyway
+        _, blockcol_counts = np.unique(
+            block_of[live] * np.int64(design.n_cols) + cols[live],
+            return_counts=True)
+        col_chunk = ChunkedSparseDesign.default_chunk(blockcol_counts)
         lays = []
         for b in range(n_shards):
             sel = block_of == b
