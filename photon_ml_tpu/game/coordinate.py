@@ -10,7 +10,7 @@ where ``scores`` is this coordinate's margin contribution per global sample.
 from __future__ import annotations
 
 import dataclasses
-from functools import lru_cache
+from functools import lru_cache, partial
 from typing import Optional, Union
 
 import jax
@@ -174,10 +174,58 @@ class RandomEffectCoordinate:
         passive = self.dataset.passive_sample_idx
         if len(passive):
             # reference passiveData scoring: trained model, scored-only rows
-            # (host join; one small H2D of the passive scores)
-            scores = scores.at[passive].set(
-                jnp.asarray(model.score(self.data, sample_idx=passive)))
+            if (model.coeffs_device is not None and len(model.keys)
+                    and model.projector is None):
+                scores = self._passive_scores_device(model, scores)
+            else:
+                # host join fallback (projected / loaded / empty models)
+                scores = scores.at[passive].set(
+                    jnp.asarray(model.score(self.data, sample_idx=passive)))
         return model, scores
+
+    def _passive_scores_device(self, model: RandomEffectModel,
+                               scores: jax.Array) -> jax.Array:
+        """Passive rows scored on device: the (entity, feature) → table-slot
+        join is STATIC across sweeps (the model's key set is determined by
+        the dataset, not the coefficients), so the searchsorted positions,
+        found-masks and per-row segment ids are computed once on host and
+        cached; each sweep is then one gather from the model's device
+        coefficient table + a segment-sum — no host join, no per-sweep H2D
+        of O(passive) scores."""
+        cache = self.dataset._device_cache
+        ctx = cache.get(("passive",))
+        if ctx is None:
+            passive = self.dataset.passive_sample_idx
+            shard = self.data.shards[self.dataset.config.feature_shard_id]
+            sub = shard.take(passive)
+            rows = sub.rows()
+            ents = self.data.id_columns[
+                self.dataset.config.random_effect_type][passive][rows]
+            q = ents.astype(np.int64) * np.int64(model.dim) + \
+                sub.cols.astype(np.int64)
+            keys = model.keys
+            pos = np.searchsorted(keys, q)
+            pos = np.minimum(pos, max(len(keys) - 1, 0))
+            found = ((ents >= 0) & (keys[pos] == q) if len(keys)
+                     else np.zeros(q.shape, bool))
+            ctx = (jnp.asarray(sub.vals), jnp.asarray(pos),
+                   jnp.asarray(found), jnp.asarray(rows),
+                   jnp.asarray(passive), len(passive))
+            cache[("passive",)] = ctx
+        vals_d, pos_d, found_d, rows_d, passive_d, n_passive = ctx
+        sc = _passive_segment_scores(
+            model.coeffs_device, vals_d, pos_d, found_d, rows_d, n_passive)
+        return scores.at[passive_d].set(sc)
+
+
+@partial(jax.jit, static_argnames=("n_passive",))
+def _passive_segment_scores(coeffs_device, vals_d, pos_d, found_d, rows_d,
+                            n_passive: int):
+    coeff = jnp.where(found_d,
+                      jnp.take(coeffs_device, pos_d, mode="clip"), 0.0)
+    return jax.ops.segment_sum(
+        (vals_d * coeff).astype(jnp.float32), rows_d,
+        num_segments=n_passive, indices_are_sorted=True)
 
 
 Coordinate = Union[FixedEffectCoordinate, RandomEffectCoordinate]

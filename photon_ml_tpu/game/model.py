@@ -73,6 +73,11 @@ class RandomEffectModel:
     coeffs: np.ndarray  # (k,) float32
     variances: Optional[np.ndarray] = None
     projector: Optional["RandomProjector"] = None
+    #: same values as ``coeffs`` still resident on device (set by the
+    #: solver; None after IO round-trips) — lets coordinate descent's
+    #: passive scoring run on-device instead of re-uploading the table
+    coeffs_device: Optional[object] = dataclasses.field(
+        default=None, compare=False, repr=False)
 
     @property
     def n_entities(self) -> int:

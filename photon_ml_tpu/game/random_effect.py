@@ -246,6 +246,7 @@ class RandomEffectSolver:
         streaming = not cfg.cache_device_buckets
         lam_dev = jnp.asarray(lam, jnp.float32)
         pending = []
+        dev_coeff_parts: list[jnp.ndarray] = []
 
         def collect(bucket, e_real, w_dev, variances):
             # one D2H of the (entities, local-dim) coefficients — the model
@@ -276,6 +277,26 @@ class RandomEffectSolver:
             # mode="drop" discards (negative indices would WRAP, not drop)
             margins = self._margins_bucket(x_d, w_dev)[:e_real]
             scores = scores.at[store_d].set(margins, mode="drop")
+            # device copy of this bucket's model coefficients, in the same
+            # host-table order (the flat kept-feature index is static):
+            # feeds the model's coeffs_device for on-device passive scoring.
+            # Projected datasets never consume it (their passive scoring
+            # projects through the host path) — skip the work.
+            if dataset.projector is not None:
+                if streaming:
+                    jax.block_until_ready(scores)
+                    collect(bucket, e_real, w_dev, variances)
+                else:
+                    pending.append((bucket, e_real, w_dev, variances))
+                continue
+            ck = ("coeffidx", i)
+            cidx = dataset._device_cache.get(ck)
+            if cidx is None:
+                cidx = jnp.asarray(
+                    np.flatnonzero(bucket.feature_index >= 0))
+                dataset._device_cache[ck] = cidx
+            dev_coeff_parts.append(
+                w_dev[:e_real].reshape(-1)[cidx].astype(jnp.float32))
             if streaming:
                 # force completion so this bucket's buffers can be dropped
                 jax.block_until_ready(scores)
@@ -294,13 +315,24 @@ class RandomEffectSolver:
         variances = (np.concatenate(var_parts)
                      if want_var and var_parts else None)
         order = np.argsort(keys, kind="stable")
+        # device mirror of the sorted coefficient table (static permutation,
+        # cached) — consumed by the coordinate's on-device passive scoring
+        coeffs_device = None
+        if dev_coeff_parts:
+            ok = ("order",)
+            order_dev = dataset._device_cache.get(ok)
+            if order_dev is None:
+                order_dev = jnp.asarray(order)
+                dataset._device_cache[ok] = order_dev
+            coeffs_device = jnp.concatenate(dev_coeff_parts)[order_dev]
         model = RandomEffectModel(
             random_effect_type=cfg.random_effect_type,
             feature_shard_id=cfg.feature_shard_id,
             task=self.task, dim=shard_dim, keys=keys[order],
             coeffs=coeffs[order],
             variances=None if variances is None else variances[order],
-            projector=dataset.projector)
+            projector=dataset.projector,
+            coeffs_device=coeffs_device)
         return model, scores
 
 

@@ -784,3 +784,35 @@ class TestHistogramBucketing:
             b.n_entities * b.x.shape[2] for b in ds.buckets)
         assert pad_samples(hist) <= pad_samples(geo)
         assert pad_features(hist) <= pad_features(geo)
+
+
+class TestDevicePassiveScoring:
+    def test_device_passive_matches_host_join(self):
+        """Active bounds force passive rows; the cached on-device passive
+        scoring must agree with the model's host searchsorted join."""
+        data, _ = make_mixed_data(n=1200, n_entities=19)
+        cfg = GLMOptimizationConfiguration(
+            optimizer_config=OptimizerConfig(max_iterations=40),
+            regularization=L2Regularization)
+        ds = RandomEffectDataset.build(
+            "re", data,
+            RandomEffectDatasetConfig("entityId", "re",
+                                      active_data_upper_bound=20,
+                                      active_data_lower_bound=5))
+        assert len(ds.passive_sample_idx) > 0
+        coord = RandomEffectCoordinate(
+            "re", ds, data, TaskType.LOGISTIC_REGRESSION, cfg, lam=0.5)
+        offsets = np.random.default_rng(0).normal(
+            size=data.n_samples).astype(np.float32)
+        # two sweeps: the second exercises the cached static join structures
+        model, scores = coord.train(offsets)
+        model2, scores2 = coord.train(offsets, warm_start=model)
+        assert model.coeffs_device is not None
+        passive = ds.passive_sample_idx
+        for m, s in ((model, scores), (model2, scores2)):
+            host = m.score(data, sample_idx=passive)
+            np.testing.assert_allclose(np.asarray(s)[passive], host,
+                                       rtol=1e-4, atol=1e-5)
+        # device coefficient mirror must equal the host table
+        np.testing.assert_allclose(np.asarray(model.coeffs_device),
+                                   model.coeffs, rtol=1e-6)
