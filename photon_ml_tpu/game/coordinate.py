@@ -195,19 +195,15 @@ class RandomEffectCoordinate:
         cache = self.dataset._device_cache
         ctx = cache.get(("passive",))
         if ctx is None:
+            from photon_ml_tpu.game.model import key_join
+
             passive = self.dataset.passive_sample_idx
             shard = self.data.shards[self.dataset.config.feature_shard_id]
             sub = shard.take(passive)
             rows = sub.rows()
             ents = self.data.id_columns[
                 self.dataset.config.random_effect_type][passive][rows]
-            q = ents.astype(np.int64) * np.int64(model.dim) + \
-                sub.cols.astype(np.int64)
-            keys = model.keys
-            pos = np.searchsorted(keys, q)
-            pos = np.minimum(pos, max(len(keys) - 1, 0))
-            found = ((ents >= 0) & (keys[pos] == q) if len(keys)
-                     else np.zeros(q.shape, bool))
+            pos, found = key_join(model.keys, model.dim, ents, sub.cols)
             ctx = (jnp.asarray(sub.vals), jnp.asarray(pos),
                    jnp.asarray(found), jnp.asarray(rows),
                    jnp.asarray(passive), len(passive))

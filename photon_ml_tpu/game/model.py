@@ -47,6 +47,26 @@ class FixedEffectModel:
         return out.astype(np.float32)
 
 
+def key_join(keys: np.ndarray, dim: int, entity_ids: np.ndarray,
+             feature_ids: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Sorted-table join for (entity, feature) pairs: ``(pos, found)``.
+
+    The single home of the ``entity·dim + feature`` searchsorted-and-verify
+    idiom (model lookup, the passive-scoring cache, the device warm-start
+    cache). ``found`` is False for negative entity/feature ids and for pairs
+    absent from ``keys``; ``pos`` is clipped in-range everywhere so it is
+    always safe to gather with.
+    """
+    valid = (np.asarray(entity_ids) >= 0) & (np.asarray(feature_ids) >= 0)
+    q = (np.maximum(entity_ids, 0).astype(np.int64) * np.int64(dim)
+         + np.maximum(feature_ids, 0).astype(np.int64))
+    pos = np.searchsorted(keys, q)
+    pos = np.minimum(pos, max(len(keys) - 1, 0))
+    found = (valid & (keys[pos] == q) if len(keys)
+             else np.zeros(q.shape, bool))
+    return pos, found
+
+
 @dataclasses.dataclass(frozen=True)
 class RandomEffectModel:
     """Per-entity coefficient table for one random-effect coordinate.
@@ -85,11 +105,8 @@ class RandomEffectModel:
 
     def lookup(self, entity_ids: np.ndarray, feature_ids: np.ndarray) -> np.ndarray:
         """Coefficient for each (entity, feature) pair; 0 where absent."""
-        q = entity_ids.astype(np.int64) * self.dim + feature_ids.astype(np.int64)
-        pos = np.searchsorted(self.keys, q)
-        pos = np.minimum(pos, max(len(self.keys) - 1, 0))
-        found = (self.keys[pos] == q) if len(self.keys) else np.zeros(q.shape, bool)
-        out = np.zeros(q.shape, np.float32)
+        pos, found = key_join(self.keys, self.dim, entity_ids, feature_ids)
+        out = np.zeros(found.shape, np.float32)
         out[found] = self.coeffs[pos[found]]
         return out
 

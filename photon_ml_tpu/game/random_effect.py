@@ -154,6 +154,46 @@ class RandomEffectSolver:
         return jnp.einsum("esd,ed->es", x, w,
                           preferred_element_type=jnp.float32)
 
+    def _warm_start_device(self, dataset: RandomEffectDataset, i: int,
+                           bucket: REBucket,
+                           warm: Optional[RandomEffectModel],
+                           shard_dim: int):
+        """Warm-start coefficients gathered ON DEVICE from the previous
+        sweep's coefficient table, or None for the host fallback.
+
+        Symmetric with the passive-scoring join: the (bucket slot →
+        model-table position) map is static across sweeps (both the bucket's
+        feature layout and the model's key set are dataset-determined), so
+        it's computed once; each sweep is then one device gather — no host
+        lookup and no (entities × local-dim) H2D per bucket per sweep."""
+        if (warm is None or warm.coeffs_device is None
+                or warm.projector is not None or not len(warm.keys)
+                or warm.dim != shard_dim):
+            return None
+        key = ("warmidx", i, self.mesh, self.entity_axis)
+        ctx = dataset._device_cache.get(key)
+        # validate against the cached key TABLE, not just its shape: a warm
+        # model keyed differently (trained on another dataset in-process)
+        # would otherwise gather wrong coefficients through a stale join.
+        # In the production CD chain keys are identical every sweep, so this
+        # is one memcmp per bucket per sweep.
+        if ctx is not None and not (
+                len(ctx[0]) == len(warm.keys)
+                and np.array_equal(ctx[0], warm.keys)):
+            ctx = None
+        if ctx is None:
+            from photon_ml_tpu.game.model import key_join
+
+            fi = bucket.feature_index  # (E, D_local)
+            ent = np.broadcast_to(bucket.entity_ids[:, None], fi.shape)
+            pos, found = key_join(warm.keys, shard_dim, ent, fi)
+            # _put entity-pads with zeros: found pads False, so padded
+            # lanes warm-start at exactly 0
+            ctx = (warm.keys, self._put(pos), self._put(found))
+            dataset._device_cache[key] = ctx
+        _, pos_d, found_d = ctx
+        return _warm_gather(warm.coeffs_device, pos_d, found_d)
+
     def _warm_compile(self, dataset: RandomEffectDataset) -> None:
         """Pre-compile every distinct bucket shape CONCURRENTLY.
 
@@ -263,12 +303,15 @@ class RandomEffectSolver:
                 var_parts.append(np.asarray(variances)[fmask].astype(np.float32))
 
         for i, bucket in enumerate(dataset.buckets):
-            w0 = _gather_warm_start(bucket, warm_start, shard_dim)
             e_real = bucket.n_entities
             x_d, lab_d, wt_d, idx_d, store_d = self._static_arrays(
                 dataset, i, bucket, n)
             boff = _bucket_offsets(offsets_dev, idx_d, wt_d)
-            w0_d = self._put(w0)
+            w0_d = self._warm_start_device(dataset, i, bucket, warm_start,
+                                           shard_dim)
+            if w0_d is None:
+                w0_d = self._put(
+                    _gather_warm_start(bucket, warm_start, shard_dim))
             w_dev, variances, _conv = self._solve_bucket(
                 x_d, lab_d, boff, wt_d, w0_d, lam_dev)
             # margins from the already-placed design (x is the dominant
@@ -334,6 +377,13 @@ class RandomEffectSolver:
             projector=dataset.projector,
             coeffs_device=coeffs_device)
         return model, scores
+
+
+@jax.jit
+def _warm_gather(coeffs_device, pos_d, found_d):
+    flat = jnp.take(coeffs_device, pos_d.reshape(-1), mode="clip")
+    return jnp.where(found_d, flat.reshape(pos_d.shape), 0.0
+                     ).astype(jnp.float32)
 
 
 @jax.jit

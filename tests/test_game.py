@@ -816,3 +816,28 @@ class TestDevicePassiveScoring:
         # device coefficient mirror must equal the host table
         np.testing.assert_allclose(np.asarray(model.coeffs_device),
                                    model.coeffs, rtol=1e-6)
+
+    def test_device_warm_start_matches_host_gather(self):
+        """Sweep-2 solves must be identical whether the warm start comes
+        from the device coefficient mirror or the host table gather."""
+        import dataclasses as dc
+
+        data, _ = make_mixed_data(n=900, n_entities=17)
+        cfg = GLMOptimizationConfiguration(
+            optimizer_config=OptimizerConfig(max_iterations=40),
+            regularization=L2Regularization)
+        ds = RandomEffectDataset.build(
+            "re", data, RandomEffectDatasetConfig("entityId", "re"))
+        solver = RandomEffectSolver(task=TaskType.LOGISTIC_REGRESSION,
+                                    config=cfg)
+        offsets = np.zeros(900, np.float32)
+        model1, _ = solver.train(ds, offsets, lam=0.5)
+        assert model1.coeffs_device is not None
+        m_dev, s_dev = solver.train(ds, offsets, lam=0.5, warm_start=model1)
+        host_warm = dc.replace(model1, coeffs_device=None)
+        m_host, s_host = solver.train(ds, offsets, lam=0.5,
+                                      warm_start=host_warm)
+        np.testing.assert_allclose(m_dev.coeffs, m_host.coeffs,
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(s_dev), np.asarray(s_host),
+                                   rtol=1e-5, atol=1e-6)
