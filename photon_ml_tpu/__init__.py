@@ -41,6 +41,7 @@ _PUBLIC = {
     "GLMObjective": "photon_ml_tpu.ops.objective",
     "DenseDesign": "photon_ml_tpu.ops.design",
     "CsrDesign": "photon_ml_tpu.ops.design",
+    "ChunkedSparseDesign": "photon_ml_tpu.ops.design",
     "loss_for_task": "photon_ml_tpu.ops.losses",
     # optimizers
     "OptimizerConfig": "photon_ml_tpu.optimize",

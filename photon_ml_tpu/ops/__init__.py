@@ -8,5 +8,9 @@ from photon_ml_tpu.ops.losses import (  # noqa: F401
 )
 from photon_ml_tpu.ops.regularization import RegularizationContext  # noqa: F401
 from photon_ml_tpu.ops.normalization import NormalizationContext  # noqa: F401
-from photon_ml_tpu.ops.design import CsrDesign, DenseDesign  # noqa: F401
+from photon_ml_tpu.ops.design import (  # noqa: F401
+    ChunkedSparseDesign,
+    CsrDesign,
+    DenseDesign,
+)
 from photon_ml_tpu.ops.objective import GLMData, GLMObjective  # noqa: F401
