@@ -143,8 +143,11 @@ class RandomEffectCoordinate:
     """Per-entity solves for one random-effect coordinate
     (reference ``algorithm/RandomEffectCoordinate.scala``).
 
-    Active samples are scored in the bucket layout on device; passive samples
-    (and any future data) go through the model's host-side join.
+    Active samples are scored in the bucket layout on device; passive
+    samples score on device too via the cached static key-table join
+    (:meth:`_passive_scores_device`), with the model's host-side join as
+    the fallback for projected/loaded models. Unseen future data goes
+    through the model/transformer host path.
     """
 
     coordinate_id: str
