@@ -105,6 +105,21 @@ class GLMObjective:
     #: interpreter is orders of magnitude slower than XLA — never in prod.
     fused_interpret: bool = False
 
+    def __post_init__(self):
+        # The closed-form paths (reg_curvature, _closed_value_and_grad) and
+        # the autodiff of value() agree only for a 0/1 mask: the L2 term is
+        # 0.5*l2*||w*mask||², whose true curvature is l2*mask² — equal to
+        # the l2*mask the closed forms use iff mask ∈ {0, 1}.
+        if self.reg_mask is not None and not isinstance(
+                self.reg_mask, jax.core.Tracer):
+            import numpy as np
+
+            vals = np.asarray(self.reg_mask)
+            if not np.all((vals == 0) | (vals == 1)):
+                raise ValueError(
+                    "reg_mask must be a 0/1 selector vector; got values "
+                    f"outside {{0, 1}}: {vals[(vals != 0) & (vals != 1)][:5]}")
+
     # --- margins ----------------------------------------------------------
     def margins(self, w: Array, data: GLMData) -> Array:
         w_eff, margin_shift = self.normalization.transform_coefficients(w)
@@ -148,17 +163,22 @@ class GLMObjective:
         if (self.fused and (on_tpu or self.fused_interpret)
                 and isinstance(data.design, DenseDesign)
                 and self.normalization.is_identity):
-            from photon_ml_tpu.ops.pallas_glm import fused_value_and_grad
+            from photon_ml_tpu.ops.pallas_glm import (
+                auto_block_rows,
+                fused_value_and_grad,
+            )
 
-            value, grad = fused_value_and_grad(
-                self.loss, data.design.x, w, data.labels, data.offsets,
-                data.weights, interpret=not on_tpu)
-            l2 = jnp.asarray(l2, value.dtype)
-            return (value + self._l2_term(w, l2),
-                    grad + l2 * self._reg_w(w))
-        if self.normalization.is_identity:
-            return self._closed_value_and_grad(w, data, l2)
-        return jax.value_and_grad(self.value)(w, data, l2)
+            # Shapes with no tile-aligned dividing block would force the
+            # kernel to copy (pad) the full design per evaluation — a net
+            # loss vs the closed form; skip the kernel for those.
+            if auto_block_rows(data.n_samples, data.design.x.dtype) is not None:
+                value, grad = fused_value_and_grad(
+                    self.loss, data.design.x, w, data.labels, data.offsets,
+                    data.weights, interpret=not on_tpu)
+                l2 = jnp.asarray(l2, value.dtype)
+                return (value + self._l2_term(w, l2),
+                        grad + l2 * self._reg_w(w))
+        return self._closed_value_and_grad(w, data, l2)
 
     def _closed_value_and_grad(self, w, data, l2) -> tuple[Array, Array]:
         """Closed-form (value, grad): margins computed ONCE, two passes over
@@ -167,6 +187,11 @@ class GLMObjective:
         wall-clock in the HBM-bound regime (measured on TPU v5e); GLM
         gradients are simple enough (``g = X'(weight·dl)``) that autodiff
         buys nothing here. Same double-where padding guards as :meth:`value`.
+
+        Normalization enters by chain rule: the transformed column is
+        ``f_j·(x_ij − s_j)``, so ``g = f ∘ (Xᵀdl − s·Σdl)`` — no scaled
+        design is ever materialized (reference: normalization-aware
+        ``ValueAndGradientAggregator.scala``).
         """
         live = data.weights > 0
         m = self.margins(w, data)
@@ -176,7 +201,13 @@ class GLMObjective:
                  + self._l2_term(w, l2))
         dl = jnp.where(live, data.weights * self.loss.d1(m_safe, data.labels),
                        0.0)
-        g = data.design.rmatvec(dl).astype(w.dtype)
+        g = data.design.rmatvec(dl)
+        norm = self.normalization
+        if norm.shifts is not None:
+            g = g - norm.shifts * jnp.sum(dl)
+        if norm.factors is not None:
+            g = g * norm.factors
+        g = g.astype(w.dtype)
         return value, g + jnp.asarray(l2, w.dtype) * self._reg_w(w)
 
     def grad(self, w: Array, data: GLMData, l2=0.0) -> Array:
@@ -186,19 +217,25 @@ class GLMObjective:
         """Exact Hessian-vector product. Replaces
         ``HessianVectorAggregator.scala``; feeds TRON's inner CG.
 
-        Identity-normalization path is closed form —
-        ``Xᵀ(weight·d2·(Xv)) + l2·v`` — through the design's forward/
-        transpose fast paths (autodiff would differentiate through
-        ``matvec``, and the backward of a sparse gather is the giant
-        scatter the chunked design exists to avoid). Normalized objectives
-        fall back to forward-over-reverse autodiff.
+        Closed form — ``X'ᵀ(weight·d2·(X'v)) + l2·v`` with the normalized
+        column ``x'_ij = f_j·(x_ij − s_j)`` expanded by chain rule — through
+        the design's forward/transpose fast paths (autodiff would
+        differentiate through ``matvec``, and the backward of a sparse
+        gather is the giant scatter the chunked design exists to avoid).
         """
-        if self.normalization.is_identity:
-            d2w = self._d2_weights(w, data)
-            hv = data.design.rmatvec(d2w * data.design.matvec(v)).astype(w.dtype)
-            return hv + jnp.asarray(self.reg_curvature(l2), w.dtype) * v
-        g = lambda w_: jax.grad(self.value)(w_, data, l2)
-        return jax.jvp(g, (w,), (v,))[1]
+        norm = self.normalization
+        u = v if norm.factors is None else v * norm.factors
+        t = data.design.matvec(u)
+        if norm.shifts is not None:
+            t = t - jnp.vdot(u, norm.shifts)
+        d2t = self._d2_weights(w, data) * t
+        hv = data.design.rmatvec(d2t)
+        if norm.shifts is not None:
+            hv = hv - norm.shifts * jnp.sum(d2t)
+        if norm.factors is not None:
+            hv = hv * norm.factors
+        return (hv.astype(w.dtype)
+                + jnp.asarray(self.reg_curvature(l2), w.dtype) * v)
 
     # --- closed-form second-order contractions (for variance) -------------
     def _d2_weights(self, w: Array, data: GLMData) -> Array:
@@ -227,21 +264,25 @@ class GLMObjective:
                 x = x * factors
             diag = jnp.einsum("nd,n->d", jnp.square(x), d2,
                               preferred_element_type=jnp.promote_types(x.dtype, jnp.float32))
-        elif isinstance(design, ChunkedSparseDesign):
-            if self.normalization.shifts is not None:
-                raise NotImplementedError(
-                    "hessian_diagonal with shift-normalization on sparse designs")
-            # Σ_i d2_i (f_j x_ij)² = f_j² · Σ_i d2_i x_ij²
-            diag = design.rmatvec_squared(d2)
+        elif isinstance(design, (ChunkedSparseDesign, CsrDesign)):
+            # Σ_i d2_i (x_ij − s_j)² expands analytically over the sparse
+            # pattern: Σ d2 x² − 2 s_j Σ d2 x + s_j² Σ d2, where the first
+            # two column sums draw only on stored entries and the last term
+            # covers the implicit zeros ((0 − s_j)² = s_j²) for free.
+            if isinstance(design, ChunkedSparseDesign):
+                sq = design.rmatvec_squared(d2)
+            else:
+                contrib = jnp.square(design.values) * jnp.take(d2, design.rows)
+                sq = jnp.zeros((design.dim,), contrib.dtype).at[design.cols].add(contrib)
+            shifts = self.normalization.shifts
+            if shifts is None:
+                diag = sq
+            else:
+                lin = design.rmatvec(d2)
+                diag = sq - 2.0 * shifts * lin + jnp.square(shifts) * jnp.sum(d2)
             if factors is not None:
+                # transformed column is f_j·(x_ij − s_j): factor² scales out
                 diag = diag * jnp.square(factors)
-        elif isinstance(design, CsrDesign):
-            if self.normalization.shifts is not None:
-                raise NotImplementedError(
-                    "hessian_diagonal with shift-normalization on sparse designs")
-            vals = design.values if factors is None else design.values * jnp.take(factors, design.cols)
-            contrib = jnp.square(vals) * jnp.take(d2, design.rows)
-            diag = jnp.zeros((design.dim,), contrib.dtype).at[design.cols].add(contrib)
         else:
             raise TypeError(type(design))
         return diag + self.reg_curvature(l2)

@@ -145,6 +145,34 @@ def _dividing_block_rows(n: int, cap: int, tile: int) -> int | None:
     return None
 
 
+def _rounded_block(n: int, cap: int, tile: int) -> int:
+    """Tile-align ``cap`` against ``n`` rows — a block covering the whole
+    (unpadded) array is accepted as-is by Mosaic, anything smaller must be a
+    multiple of the dtype's sublane tile."""
+    b = min(cap, max(n, tile))
+    if b < n:
+        b = max(tile, b // tile * tile)
+    return b
+
+
+def auto_block_rows(n: int, dtype) -> int | None:
+    """The block size auto mode will stream with NO per-call copy, or None.
+
+    ``None`` means :func:`fused_value_and_grad` in auto mode would have to
+    ``jnp.pad`` the full design inside the traced objective on every
+    evaluation — the regression documented in :func:`_dividing_block_rows`.
+    Callers (``GLMObjective.value_and_grad``) use this to fall back to the
+    XLA closed form for such shapes instead of paying the copy. This IS the
+    kernel's auto-mode selection (``fused_value_and_grad`` calls it), so the
+    predicate cannot drift from the executor.
+    """
+    tile = _sublane_tile(dtype)
+    b = _rounded_block(n, _default_block_rows(dtype), tile)
+    if n % b == 0:
+        return b
+    return _dividing_block_rows(n, _default_block_rows(dtype), tile)
+
+
 @functools.partial(jax.jit, static_argnames=("loss", "block_rows", "interpret"))
 def fused_value_and_grad(loss: PointwiseLoss, x, w, labels, offsets, weights,
                          *, block_rows: int | None = None,
@@ -157,20 +185,15 @@ def fused_value_and_grad(loss: PointwiseLoss, x, w, labels, offsets, weights,
     """
     n, d = x.shape
     tile = _sublane_tile(x.dtype)
-    explicit = block_rows is not None
     if block_rows is None:
-        block_rows = _default_block_rows(x.dtype)
-    # b must be a multiple of the dtype's sublane tile — unless the block
-    # covers the whole (unpadded) array, which Mosaic accepts as-is
-    b = min(block_rows, max(n, tile))
-    if b < n:
-        b = max(tile, b // tile * tile)
-    if n % b != 0 and not explicit:
-        # auto mode prefers a dividing block (no-copy); an explicit
-        # block_rows is honored (tile-rounded) via the padding path
-        divisor = _dividing_block_rows(n, block_rows, tile)
-        if divisor is not None:
-            b = divisor
+        # auto mode prefers a dividing block (no-copy); one shared selector
+        # (auto_block_rows) so the objective's skip-predicate cannot drift
+        b = auto_block_rows(n, x.dtype)
+        if b is None:  # no dividing block: padding path
+            b = _rounded_block(n, _default_block_rows(x.dtype), tile)
+    else:
+        # an explicit block_rows is honored (tile-rounded), padding if needed
+        b = _rounded_block(n, block_rows, tile)
     n_blocks = pl.cdiv(n, b)
     n_pad = n_blocks * b
     if n_pad != n:

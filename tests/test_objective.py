@@ -427,3 +427,106 @@ class TestChunkedSparseDesign:
         ref = np.asarray(GLMObjective(LogisticLoss).hessian_diagonal(
             w, data_dense, 0.2), np.float64)
         np.testing.assert_allclose(diag, ref, rtol=1e-3, atol=1e-4)
+
+    def test_shift_normalization_sparse_second_order(self):
+        """STANDARDIZATION (factors + shifts) composed with sparse designs
+        must produce the same grad/Hvp/Hessian-diagonal/Hessian-matrix as a
+        dense design holding the explicitly transformed features — the
+        reference's NormalizationContext composing freely with
+        HessianDiagonalAggregator et al. (round-1 gap: these raised)."""
+        from photon_ml_tpu.ops.design import ChunkedSparseDesign
+
+        r, c, v, n, d = self._coo(seed=11, frac=0.25)
+        rng = np.random.default_rng(12)
+        x = np.zeros((n, d), np.float64)
+        x[r, c] = v
+        # intercept column: all-ones, factor 1, shift 0
+        x[:, d - 1] = 1.0
+        rr, cc = np.nonzero(x)
+        vv = x[rr, cc]
+        factors = np.r_[rng.uniform(0.5, 2.0, size=d - 1), 1.0]
+        shifts = np.r_[rng.normal(size=d - 1), 0.0]
+        ctx = NormalizationContext(factors=jnp.asarray(factors),
+                                   shifts=jnp.asarray(shifts),
+                                   intercept_index=d - 1)
+        labels = (rng.random(n) < 0.5).astype(np.float64)
+        offsets = rng.normal(size=n)
+        weights = rng.uniform(0.5, 2.0, size=n)
+        mk = lambda design: GLMData(
+            design=design, labels=jnp.asarray(labels),
+            offsets=jnp.asarray(offsets), weights=jnp.asarray(weights))
+        designs = {
+            "csr": CsrDesign(rows=jnp.asarray(rr, jnp.int32),
+                             cols=jnp.asarray(cc, jnp.int32),
+                             values=jnp.asarray(vv), n_rows=n, n_cols=d),
+            "chunked": ChunkedSparseDesign.from_coo(rr, cc, vv, n, d),
+        }
+        # dense reference: explicitly transformed features, no context
+        x_t = (x - shifts) * factors
+        ref_data = GLMData(design=DenseDesign(jnp.asarray(x_t)),
+                           labels=jnp.asarray(labels),
+                           offsets=jnp.asarray(offsets),
+                           weights=jnp.asarray(weights))
+        ref_obj = GLMObjective(LogisticLoss)
+        w = jnp.asarray(rng.normal(size=d) * 0.3)
+        vec = jnp.asarray(rng.normal(size=d))
+        l2 = 0.4
+        rv, rg = ref_obj.value_and_grad(w, ref_data, l2)
+        rh = ref_obj.hvp(w, vec, ref_data, l2)
+        rdiag = ref_obj.hessian_diagonal(w, ref_data, l2)
+        rmat = ref_obj.hessian_matrix(w, ref_data, l2)
+        for name, design in designs.items():
+            obj = GLMObjective(LogisticLoss, normalization=ctx)
+            data = mk(design)
+            val, g = obj.value_and_grad(w, data, l2)
+            np.testing.assert_allclose(float(val), float(rv), rtol=1e-10,
+                                       err_msg=name)
+            np.testing.assert_allclose(np.asarray(g), np.asarray(rg),
+                                       rtol=1e-8, atol=1e-10, err_msg=name)
+            np.testing.assert_allclose(np.asarray(obj.hvp(w, vec, data, l2)),
+                                       np.asarray(rh), rtol=1e-8, atol=1e-10,
+                                       err_msg=name)
+            # rtol 1e-6: the analytic shift expansion (Σd2·x² − 2sΣd2·x +
+            # s²Σd2) cancels more than the dense (x−s)² form does
+            np.testing.assert_allclose(
+                np.asarray(obj.hessian_diagonal(w, data, l2)),
+                np.asarray(rdiag), rtol=1e-6, atol=1e-9, err_msg=name)
+            np.testing.assert_allclose(
+                np.asarray(obj.hessian_matrix(w, data, l2)),
+                np.asarray(rmat), rtol=1e-6, atol=1e-9, err_msg=name)
+
+
+def test_reg_mask_must_be_binary():
+    """The closed-form curvature convention (l2·mask) is only consistent
+    with the L2 term 0.5·l2·||w·mask||² for a 0/1 mask; anything else is
+    rejected at construction."""
+    with pytest.raises(ValueError, match="0/1"):
+        GLMObjective(LogisticLoss, reg_mask=jnp.asarray([1.0, 0.5, 0.0]))
+    # 0/1 masks (any dtype) are fine
+    GLMObjective(LogisticLoss, reg_mask=jnp.asarray([1.0, 0.0, 1.0]))
+
+
+def test_fused_auto_falls_back_for_nondividing_shapes():
+    """A row count with no tile-aligned divisor ≥128 would force the fused
+    kernel to pad (copy) the design per evaluation; auto mode must report
+    no no-copy block so the objective takes the closed form instead."""
+    from photon_ml_tpu.ops.pallas_glm import auto_block_rows
+
+    assert auto_block_rows(1024, jnp.float32) is not None
+    assert auto_block_rows(100, jnp.float32) == 100  # whole-array block
+    # 2^a * prime with prime > cap/8: divisors ≥128 don't exist below cap
+    assert auto_block_rows(8 * 1021, jnp.float32) is None  # 1021 prime
+    # the objective silently falls back (interpret path would otherwise run)
+    rng = np.random.default_rng(0)
+    n, d = 8 * 1021, 16
+    data = GLMData(
+        design=DenseDesign(jnp.asarray(rng.normal(size=(n, d)), jnp.float32)),
+        labels=jnp.asarray((rng.random(n) < 0.5).astype(np.float32)),
+        offsets=jnp.zeros(n, jnp.float32), weights=jnp.ones(n, jnp.float32))
+    obj = GLMObjective(LogisticLoss, fused=True, fused_interpret=True)
+    w = jnp.asarray(rng.normal(size=d), jnp.float32)
+    v_fused, g_fused = obj.value_and_grad(w, data, 0.1)
+    v_ref, g_ref = GLMObjective(LogisticLoss).value_and_grad(w, data, 0.1)
+    np.testing.assert_allclose(float(v_fused), float(v_ref), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(g_fused), np.asarray(g_ref),
+                               rtol=1e-5, atol=1e-6)
