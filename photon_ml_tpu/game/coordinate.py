@@ -196,8 +196,15 @@ class RandomEffectCoordinate:
         coefficient table + a segment-sum — no host join, no per-sweep H2D
         of O(passive) scores."""
         cache = self.dataset._device_cache
-        ctx = cache.get(("passive",))
-        if ctx is None:
+        entry = cache.get(("passive",))
+        if entry is not None:
+            # the join is only static for THIS model's key table — a model
+            # trained from a different dataset in-process must not reuse it
+            # (mirrors the warm-start cache's key-table guard)
+            keys_cached, ctx = entry
+            if not np.array_equal(keys_cached, model.keys):
+                entry = None
+        if entry is None:
             from photon_ml_tpu.game.model import key_join
 
             passive = self.dataset.passive_sample_idx
@@ -210,7 +217,7 @@ class RandomEffectCoordinate:
             ctx = (jnp.asarray(sub.vals), jnp.asarray(pos),
                    jnp.asarray(found), jnp.asarray(rows),
                    jnp.asarray(passive), len(passive))
-            cache[("passive",)] = ctx
+            cache[("passive",)] = (np.array(model.keys, copy=True), ctx)
         vals_d, pos_d, found_d, rows_d, passive_d, n_passive = ctx
         sc = _passive_segment_scores(
             model.coeffs_device, vals_d, pos_d, found_d, rows_d, n_passive)

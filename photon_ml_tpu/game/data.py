@@ -361,8 +361,14 @@ def _histogram_pad(x: np.ndarray, max_buckets: int, floor: int = 1) -> np.ndarra
     x = np.maximum(np.asarray(x, np.int64), floor)
     v, c = np.unique(x, return_counts=True)
     if len(v) > _HIST_MAX_UNIQUE:
-        # quantize UP to a fine geometric grid first (padding stays valid)
-        xq = _geom_at_least(x, 1.02, floor)
+        # quantize UP to a geometric grid (padding stays valid) whose growth
+        # is derived from the observed range, so the grid point count — and
+        # with it the DP's m — is actually bounded by _HIST_MAX_UNIQUE at
+        # any size range (a fixed 2% growth is not: 1e9/1 spans ~1000 steps)
+        lo = max(floor, int(v[0]))
+        growth = max(1.02,
+                     (float(v[-1]) / lo) ** (1.0 / (_HIST_MAX_UNIQUE - 1)))
+        xq = _geom_at_least(x, growth, floor)
         v, c = np.unique(xq, return_counts=True)
         x = xq
     m = len(v)

@@ -142,7 +142,10 @@ class RandomEffectSolver:
 
         if not dataset.config.cache_device_buckets:
             return build()
-        key = (i, self.mesh, self.entity_axis)
+        # n (the dead-row scatter sentinel) is baked into the built index,
+        # so it must key the cache: the same dataset reused with a
+        # different-length offsets vector gets a fresh sentinel.
+        key = (i, n, self.mesh, self.entity_axis)
         cached = dataset._device_cache.get(key)
         if cached is None:
             cached = build()

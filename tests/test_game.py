@@ -739,6 +739,32 @@ class TestHistogramBucketing:
         assert (pad >= sizes).all()
         assert len(np.unique(pad)) <= 8
 
+    def test_histogram_quantization_grid_is_bounded(self):
+        """The pre-quantization grid must keep the DP's unique-size count m
+        under _HIST_MAX_UNIQUE at ANY size range (a fixed 2% growth spans
+        ~1000 grid points over 1..1e9); the growth is derived from the
+        observed range to enforce the cap."""
+        from photon_ml_tpu.game.data import (
+            _HIST_MAX_UNIQUE,
+            _geom_at_least,
+            _histogram_pad,
+        )
+
+        rng = np.random.default_rng(2)
+        # log-uniform sizes over 9 decades — the range the fixed grid missed
+        sizes = np.exp(rng.uniform(0, np.log(1e9), size=20_000)).astype(
+            np.int64)
+        # the internal quantization formula keeps the grid under the cap
+        lo = max(1, int(sizes.min()))
+        growth = max(1.02,
+                     (float(sizes.max()) / lo) ** (1.0 / (_HIST_MAX_UNIQUE - 1)))
+        xq = _geom_at_least(sizes, growth, 1)
+        assert len(np.unique(xq)) <= _HIST_MAX_UNIQUE
+        assert (xq >= sizes).all()
+        pad = _histogram_pad(sizes, 16)
+        assert (pad >= sizes).all()
+        assert len(np.unique(pad)) <= 16
+
     def test_histogram_dataset_matches_geometric_training(self):
         """Same solves, different padding: the trained random-effect models
         must agree (padding is masked; SURVEY.md §7 hard-parts #1)."""
