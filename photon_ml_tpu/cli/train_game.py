@@ -88,6 +88,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile", action="store_true",
                    help="write a jax.profiler trace of the training stage "
                         "to <output-dir>/profile (view with TensorBoard)")
+    p.add_argument("--multihost", action="store_true",
+                   help="form a multi-controller job before touching any "
+                        "device (jax.distributed.initialize from "
+                        "PHOTON_COORDINATOR_ADDRESS/NUM_PROCESSES/PROCESS_ID "
+                        "env vars, or JAX cluster auto-detection on TPU "
+                        "pods). Every process runs this same command on the "
+                        "SAME data (shared filesystem); --mesh then spans "
+                        "all hosts' chips so collectives ride ICI+DCN, and "
+                        "only process 0 writes outputs. Per-host data "
+                        "sharding is the library-level "
+                        "parallel.multihost.global_glm_data_multihost feed")
     p.add_argument("--mesh", default="",
                    help="device mesh axes, e.g. 'data=4,entity=2': shards "
                         "fixed-effect samples over 'data' (psum'd compiled "
@@ -128,6 +139,12 @@ def parse_mesh(spec: str):
 from photon_ml_tpu.io.data_reader import parse_input_columns  # noqa: E402,F401
 
 
+def _process_index() -> int:
+    import jax
+
+    return jax.process_index()
+
+
 def _resolve_model_dir(path: str) -> str:
     """Accept a run dir (containing best/) or a model dir directly."""
     path = os.path.normpath(path)
@@ -144,6 +161,15 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
 
     args = build_parser().parse_args(argv)
     task = TaskType(args.task)
+    if args.multihost:
+        # must precede parse_mesh: forming the job is only possible before
+        # the first backend-touching call
+        from photon_ml_tpu.parallel import multihost
+
+        multihost.initialize(auto=True)
+    from photon_ml_tpu.parallel.multihost import is_chief
+
+    chief = is_chief()
     # fail fast on a bad mesh spec / device-count mismatch, BEFORE the
     # (potentially long) Avro reads
     mesh = parse_mesh(args.mesh)
@@ -151,7 +177,12 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
         import jax
 
         jax.config.update("jax_debug_nans", True)
-    run_logger = RunLogger(args.output_dir)
+    # non-chief processes log under a per-process subdir: on the shared
+    # filesystem --multihost mandates, N processes appending to one
+    # photon.log/metrics.jsonl would interleave and duplicate every line
+    log_dir = args.output_dir if chief else os.path.join(
+        args.output_dir, "workers", f"proc-{_process_index()}")
+    run_logger = RunLogger(log_dir)
     GLOBAL_BUS.post("training_started", driver="train_game",
                     task=task.value, output_dir=args.output_dir)
     try:
@@ -243,8 +274,25 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
         if args.checkpoint or args.resume:
             from photon_ml_tpu.io.checkpoint import CheckpointManager
 
+            # non-chief: read-only, so --resume stays in lockstep with the
+            # chief's checkpoints without racing its writes
             checkpoint = CheckpointManager(
-                os.path.join(args.output_dir, "checkpoints"))
+                os.path.join(args.output_dir, "checkpoints"),
+                read_only=not chief)
+            import jax
+
+            if jax.process_count() > 1:
+                # agree on the resume point ONCE, before training: each
+                # process polling the shared filesystem independently would
+                # race the chief's own saves (collective: all processes
+                # must reach this broadcast)
+                import numpy as _np
+                from jax.experimental import multihost_utils
+
+                step = checkpoint.latest_step() if chief else None
+                agreed = int(multihost_utils.broadcast_one_to_all(
+                    _np.int64(-1 if step is None else step)))
+                checkpoint.pin_step(None if agreed < 0 else agreed)
         profile_dir = (os.path.join(args.output_dir, "profile")
                        if args.profile else None)
 
@@ -323,22 +371,23 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
             run_logger.metric(stage="best", **best.evaluation.as_dict(),
                               config=dict(best.configuration.regularization_weights))
 
-        with timed("Save models", run_logger):
-            os.makedirs(args.output_dir, exist_ok=True)
-            for shard_id, imap in index_maps.items():
-                imap.save(os.path.join(args.output_dir, "feature-indexes",
-                                       f"{shard_id}.json"))
-            save_game_model(os.path.join(args.output_dir, "best"),
-                            best.model, index_maps, vocabs,
+        if chief:
+            with timed("Save models", run_logger):
+                os.makedirs(args.output_dir, exist_ok=True)
+                for shard_id, imap in index_maps.items():
+                    imap.save(os.path.join(args.output_dir, "feature-indexes",
+                                           f"{shard_id}.json"))
+                save_game_model(os.path.join(args.output_dir, "best"),
+                                best.model, index_maps, vocabs,
+                                sparsity_threshold=args.model_sparsity_threshold)
+                if args.output_all_models:
+                    for i, r in enumerate(results):
+                        save_game_model(
+                            os.path.join(args.output_dir, "all", f"config-{i}"),
+                            r.model, index_maps, vocabs,
                             sparsity_threshold=args.model_sparsity_threshold)
-            if args.output_all_models:
-                for i, r in enumerate(results):
-                    save_game_model(
-                        os.path.join(args.output_dir, "all", f"config-{i}"),
-                        r.model, index_maps, vocabs,
-                        sparsity_threshold=args.model_sparsity_threshold)
-        GLOBAL_BUS.post("model_saved",
-                        path=os.path.join(args.output_dir, "best"))
+            GLOBAL_BUS.post("model_saved",
+                            path=os.path.join(args.output_dir, "best"))
         return {
             "best_config": dict(best.configuration.regularization_weights),
             "best_evaluation": (best.evaluation.as_dict()

@@ -41,15 +41,39 @@ class CoordinateDescentState:
 
 
 class CheckpointManager:
-    """Writes/reads checkpoint steps under a root directory."""
+    """Writes/reads checkpoint steps under a root directory.
 
-    def __init__(self, root: str, *, keep: int = 3):
+    ``read_only=True`` turns :meth:`save` into a no-op — for non-chief
+    processes of a multi-controller job sharing one filesystem, which must
+    resume from (and stay in lockstep with) the chief's checkpoints but
+    must not race its writes.
+    """
+
+    def __init__(self, root: str, *, keep: int = 3, read_only: bool = False):
         self.root = root
         self.keep = keep
-        os.makedirs(root, exist_ok=True)
+        self.read_only = read_only
+        self._pinned = False
+        self._pinned_step: Optional[int] = None
+        if not read_only:
+            os.makedirs(root, exist_ok=True)
+
+    def pin_step(self, step: Optional[int]) -> None:
+        """Freeze what :meth:`latest_step` answers. Multi-controller jobs
+        must agree on the resume point BEFORE training (each process polling
+        the shared filesystem independently races the chief's own saves —
+        a late worker would resume from a step the chief wrote after
+        starting, desynchronizing the collective schedules); the chief
+        reads the filesystem once and broadcasts the step to everyone."""
+        self._pinned = True
+        self._pinned_step = step
 
     # --- step bookkeeping -------------------------------------------------
     def steps(self) -> list[int]:
+        if not os.path.isdir(self.root):
+            # read-only managers never mkdir; a worker may probe before the
+            # chief's first save lands on the shared filesystem
+            return []
         out = []
         for name in os.listdir(self.root):
             if name.startswith("step-") and not name.endswith(".tmp"):
@@ -60,6 +84,8 @@ class CheckpointManager:
         return sorted(out)
 
     def latest_step(self) -> Optional[int]:
+        if self._pinned:
+            return self._pinned_step
         steps = self.steps()
         return steps[-1] if steps else None
 
@@ -75,6 +101,8 @@ class CheckpointManager:
         regularization weights); restore() refuses state written under a
         different configuration — resuming lambda=0.1 state into a
         lambda=10 run would silently mis-attribute the model."""
+        if self.read_only:
+            return os.path.join(self.root, f"step-{step}")
         final = os.path.join(self.root, f"step-{step}")
         tmp = tempfile.mkdtemp(prefix=f"step-{step}-", suffix=".tmp",
                                dir=self.root)

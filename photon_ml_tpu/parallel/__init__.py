@@ -9,10 +9,15 @@ from photon_ml_tpu.parallel.mesh import (  # noqa: F401
 from photon_ml_tpu.parallel.distributed import (  # noqa: F401
     DistributedGLMObjective,
     FeatureShardedGLMObjective,
+    ShardBudget,
+    shard_budget,
     shard_glm_data,
     shard_glm_data_features,
 )
 from photon_ml_tpu.parallel.multihost import (  # noqa: F401
+    allreduce_shard_budget,
     global_glm_data_from_local,
+    global_glm_data_multihost,
+    local_axis_blocks,
     make_multihost_mesh,
 )

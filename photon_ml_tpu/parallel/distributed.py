@@ -47,8 +47,50 @@ def _l2_value_and_grad(objective: GLMObjective, w: Array, l2):
     return 0.5 * l2 * jnp.vdot(wr, wr), l2 * wr
 
 
+@dataclasses.dataclass(frozen=True)
+class ShardBudget:
+    """Shared shape budget for building agreeing shard layouts on
+    independent hosts (SPMD demands identical leaf shapes on every process;
+    a host with more rows or denser data would otherwise stack taller or
+    wider blocks). Sparse-only fields are 0 for dense designs ("local
+    choice"). Computed per-host via :func:`shard_budget`, max-reduced by
+    :func:`photon_ml_tpu.parallel.multihost.allreduce_shard_budget`."""
+
+    rows_per_shard: int
+    row_chunk: int = 0
+    col_chunk: int = 0
+    row_chunks: int = 0  # padded per-block row-major chunk count (mr)
+    col_chunks: int = 0  # padded per-block col-major chunk count (mc)
+
+    def to_array(self) -> np.ndarray:
+        return np.array([self.rows_per_shard, self.row_chunk, self.col_chunk,
+                         self.row_chunks, self.col_chunks], np.int64)
+
+    @staticmethod
+    def from_array(a) -> "ShardBudget":
+        a = np.asarray(a, np.int64)
+        return ShardBudget(*(int(v) for v in a))
+
+
+def shard_budget(sharded: GLMData) -> ShardBudget:
+    """Read back the shape budget a stacked layout was built with, so hosts
+    can compare (and max-reduce) theirs before a multi-host feed."""
+    per = int(sharded.labels.shape[1])
+    design = sharded.design
+    if isinstance(design, ChunkedSparseDesign):
+        return ShardBudget(
+            rows_per_shard=per,
+            row_chunk=int(design.rvals.shape[2]),
+            col_chunk=int(design.cvals.shape[2]),
+            row_chunks=int(design.rvals.shape[1]),
+            col_chunks=int(design.cvals.shape[1]))
+    return ShardBudget(rows_per_shard=per)
+
+
 def shard_glm_data(data: GLMData, n_shards: int, *, device_put_mesh: Optional[Mesh] = None,
-                   axis: str = DATA_AXIS) -> GLMData:
+                   axis: str = DATA_AXIS,
+                   budget: Optional[ShardBudget] = None,
+                   host_stage: bool = False) -> GLMData:
     """Split a host-resident :class:`GLMData` into ``n_shards`` equal blocks.
 
     Returns a GLMData whose leaves have a leading ``n_shards`` dimension
@@ -57,10 +99,19 @@ def shard_glm_data(data: GLMData, n_shards: int, *, device_put_mesh: Optional[Me
     to the max per-block nnz. If ``device_put_mesh`` is given, leaves are
     placed with the leading dim sharded over ``axis`` so each block lives on
     its device (the host→device feed the reference does via Spark partition
-    locality).
+    locality). ``host_stage=True`` keeps the leaves as numpy arrays — for
+    feeds that do their own host→device transfer (the multihost path), so
+    the full local dataset never detours through one device's HBM.
     """
+    _j = np.ascontiguousarray if host_stage else jnp.asarray
     n = data.n_samples
     per = math.ceil(n / n_shards)
+    if budget is not None:
+        if budget.rows_per_shard < per:
+            raise ValueError(
+                f"budget.rows_per_shard={budget.rows_per_shard} cannot hold "
+                f"{n} rows over {n_shards} shards (need ≥ {per})")
+        per = budget.rows_per_shard
     n_pad = per * n_shards
 
     labels = np.zeros((n_pad,), np.asarray(data.labels).dtype)
@@ -75,7 +126,7 @@ def shard_glm_data(data: GLMData, n_shards: int, *, device_put_mesh: Optional[Me
         x = np.asarray(design.x)
         xp = np.zeros((n_pad, x.shape[1]), x.dtype)
         xp[:n] = x
-        sharded_design = DenseDesign(x=jnp.asarray(xp.reshape(n_shards, per, x.shape[1])))
+        sharded_design = DenseDesign(x=_j(xp.reshape(n_shards, per, x.shape[1])))
     elif isinstance(design, (CsrDesign, ChunkedSparseDesign)):
         if isinstance(design, ChunkedSparseDesign):
             raise TypeError(
@@ -95,15 +146,18 @@ def shard_glm_data(data: GLMData, n_shards: int, *, device_put_mesh: Optional[Me
         # global per-row counts equal per-block ones; columns appear in
         # every block, so count (block, col) pairs — merging across blocks
         # would inflate the medians (and the padding) ~n_shards x
-        row_chunk = ChunkedSparseDesign.default_chunk(
-            np.bincount(rows[live], minlength=n))
-        # unique, not bincount: a dense (n_shards * n_cols) count array
-        # would be tens of GB in the wide-sparse regime this path serves;
-        # default_chunk only looks at nonzero counts anyway
-        _, blockcol_counts = np.unique(
-            block_of[live] * np.int64(design.n_cols) + cols[live],
-            return_counts=True)
-        col_chunk = ChunkedSparseDesign.default_chunk(blockcol_counts)
+        if budget is not None and budget.row_chunk and budget.col_chunk:
+            row_chunk, col_chunk = budget.row_chunk, budget.col_chunk
+        else:
+            row_chunk = ChunkedSparseDesign.default_chunk(
+                np.bincount(rows[live], minlength=n))
+            # unique, not bincount: a dense (n_shards * n_cols) count array
+            # would be tens of GB in the wide-sparse regime this path
+            # serves; default_chunk only looks at nonzero counts anyway
+            _, blockcol_counts = np.unique(
+                block_of[live] * np.int64(design.n_cols) + cols[live],
+                return_counts=True)
+            col_chunk = ChunkedSparseDesign.default_chunk(blockcol_counts)
         lays = []
         for b in range(n_shards):
             sel = block_of == b
@@ -112,6 +166,14 @@ def shard_glm_data(data: GLMData, n_shards: int, *, device_put_mesh: Optional[Me
                 row_chunk=row_chunk, col_chunk=col_chunk))
         mr = max(lay["rrow"].shape[0] for lay in lays)
         mc = max(lay["ccol"].shape[0] for lay in lays)
+        if budget is not None and budget.row_chunks and budget.col_chunks:
+            if budget.row_chunks < mr or budget.col_chunks < mc:
+                raise ValueError(
+                    f"budget chunk counts (mr={budget.row_chunks}, "
+                    f"mc={budget.col_chunks}) below this host's layout "
+                    f"(mr={mr}, mc={mc}) — compute the budget from the "
+                    f"same data")
+            mr, mc = budget.row_chunks, budget.col_chunks
 
         def pad_stack(key, m, fill):
             outs = []
@@ -122,7 +184,7 @@ def shard_glm_data(data: GLMData, n_shards: int, *, device_put_mesh: Optional[Me
                     pad_block = np.full((pad_n,) + a.shape[1:], fill, a.dtype)
                     a = np.concatenate([a, pad_block])
                 outs.append(a)
-            return jnp.asarray(np.stack(outs))
+            return _j(np.stack(outs))
 
         sharded_design = ChunkedSparseDesign(
             rvals=pad_stack("rvals", mr, 0.0),
@@ -139,9 +201,9 @@ def shard_glm_data(data: GLMData, n_shards: int, *, device_put_mesh: Optional[Me
 
     out = GLMData(
         design=sharded_design,
-        labels=jnp.asarray(labels.reshape(n_shards, per)),
-        offsets=jnp.asarray(offsets.reshape(n_shards, per)),
-        weights=jnp.asarray(weights.reshape(n_shards, per)),
+        labels=_j(labels.reshape(n_shards, per)),
+        offsets=_j(offsets.reshape(n_shards, per)),
+        weights=_j(weights.reshape(n_shards, per)),
     )
     if device_put_mesh is not None:
         sharding = NamedSharding(device_put_mesh, P(axis))
@@ -207,27 +269,19 @@ class DistributedGLMObjective:
         return self.value_and_grad(w, sharded, l2)[1]
 
     def hvp(self, w: Array, v: Array, sharded: GLMData, l2=0.0):
-        if self.objective.normalization.is_identity:
-            # closed form per shard (the design's forward/transpose fast
-            # paths — autodiff's gather backward would re-create the per-nnz
-            # scatter the chunked sparse layout exists to avoid), psum'd;
-            # L2 curvature added once outside
-            def body(wv, tangent, blk):
-                local = self.objective.hvp(wv, tangent, _unstack(blk), 0.0)
-                return jax.lax.psum(local, self.axis)
-
-            hv = shard_map(body, mesh=self.mesh,
-                           in_specs=(P(), P(), P(self.axis)),
-                           out_specs=P())(w, v, sharded)
-            return hv + jnp.asarray(self.objective.reg_curvature(l2),
-                                    w.dtype) * v
-
+        # closed form per shard for every normalization (GLMObjective.hvp
+        # expands the affine transform by chain rule; autodiff's gather
+        # backward would re-create the per-nnz scatter the chunked sparse
+        # layout exists to avoid), psum'd; L2 curvature added once outside
         def body(wv, tangent, blk):
-            g = jax.grad(self._global_value_fn(blk, l2))
-            return jax.jvp(g, (wv,), (tangent,))[1]
+            local = self.objective.hvp(wv, tangent, _unstack(blk), 0.0)
+            return jax.lax.psum(local, self.axis)
 
-        return shard_map(body, mesh=self.mesh,
-                         in_specs=(P(), P(), P(self.axis)), out_specs=P())(w, v, sharded)
+        hv = shard_map(body, mesh=self.mesh,
+                       in_specs=(P(), P(), P(self.axis)),
+                       out_specs=P())(w, v, sharded)
+        return hv + jnp.asarray(self.objective.reg_curvature(l2),
+                                w.dtype) * v
 
     def margins(self, w: Array, sharded: GLMData) -> Array:
         """Per-sample margins in the stacked (n_shards, per) layout."""

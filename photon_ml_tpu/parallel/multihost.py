@@ -28,8 +28,13 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from photon_ml_tpu.ops.design import DenseDesign
+from photon_ml_tpu.ops.design import ChunkedSparseDesign, DenseDesign
 from photon_ml_tpu.ops.objective import GLMData
+from photon_ml_tpu.parallel.distributed import (
+    ShardBudget,
+    shard_budget,
+    shard_glm_data,
+)
 from photon_ml_tpu.parallel.mesh import DATA_AXIS, ENTITY_AXIS
 
 
@@ -38,9 +43,16 @@ _initialized = False
 
 def initialize(coordinator_address: Optional[str] = None,
                num_processes: Optional[int] = None,
-               process_id: Optional[int] = None) -> None:
+               process_id: Optional[int] = None,
+               *, auto: bool = False) -> None:
     """Form the multi-controller job (idempotent). On single-host runs this
     is a no-op; on TPU pods the args come from the environment.
+
+    Resolution order: explicit args → ``PHOTON_COORDINATOR_ADDRESS`` /
+    ``PHOTON_NUM_PROCESSES`` / ``PHOTON_PROCESS_ID`` env vars (how the
+    drivers' ``--multihost`` flag is fed on CPU/GPU clusters) → with
+    ``auto=True``, bare ``jax.distributed.initialize()`` (JAX's own cluster
+    auto-detection: TPU pod metadata, Slurm, etc.).
 
     Must run before ANY backend-touching JAX call — even
     ``jax.process_count()`` initializes the XLA backend, after which
@@ -51,11 +63,29 @@ def initialize(coordinator_address: Optional[str] = None,
     if _initialized:
         return
     if coordinator_address is None and num_processes is None:
-        return  # single-host
+        import os
+
+        coordinator_address = os.environ.get("PHOTON_COORDINATOR_ADDRESS")
+        n = os.environ.get("PHOTON_NUM_PROCESSES")
+        num_processes = int(n) if n else None
+        pid = os.environ.get("PHOTON_PROCESS_ID")
+        process_id = int(pid) if pid else process_id
+        if coordinator_address is None and num_processes is None:
+            if auto:
+                jax.distributed.initialize()
+                _initialized = True
+            return  # single-host
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id)
     _initialized = True
+
+
+def is_chief() -> bool:
+    """True on the process that should write outputs (the reference's
+    driver/executor asymmetry collapses to "process 0 writes, everyone
+    computes" — collectives keep all processes in lockstep either way)."""
+    return jax.process_index() == 0
 
 
 def make_multihost_mesh(data_per_slice: Optional[int] = None,
@@ -83,31 +113,161 @@ def make_multihost_mesh(data_per_slice: Optional[int] = None,
     return Mesh(dev_grid, (ENTITY_AXIS, DATA_AXIS))
 
 
+def allreduce_shard_budget(local: ShardBudget) -> ShardBudget:
+    """Max-reduce a :class:`ShardBudget` across all processes so every host
+    builds identically-shaped shard stacks (identity on single-process
+    runs). The max is correct field-wise: a larger rows-per-shard or chunk
+    count only adds inert zero-padding on the smaller hosts."""
+    if jax.process_count() == 1:
+        return local
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(local.to_array())
+    return ShardBudget.from_array(np.max(np.asarray(gathered), axis=0))
+
+
+def local_axis_blocks(mesh: Mesh, axis: str = DATA_AXIS) -> int:
+    """How many distinct ``axis`` coordinates this process's devices cover —
+    the number of data blocks this process must feed. NOT simply
+    ``local_device_count``: on a 2D ``(entity, data)`` mesh each data block
+    is replicated across the entity lanes, so feeding one block per local
+    device would over-split the data (and the per-device leading dim would
+    silently drop rows in the shard_map body's ``[0]`` unstack)."""
+    names = list(mesh.axis_names)
+    axis_pos = names.index(axis)
+    devs = np.asarray(mesh.devices)
+    me = jax.process_index()
+    coords = {idx[axis_pos] for idx in np.ndindex(devs.shape)
+              if devs[idx].process_index == me}
+    if not coords:
+        raise ValueError(f"process {me} owns no devices in mesh {mesh}")
+    return len(coords)
+
+
+def global_glm_data_multihost(host_data: GLMData, mesh: Mesh,
+                              axis: str = DATA_AXIS) -> GLMData:
+    """One-call multi-host feed: shard this process's host-resident data
+    into its share of the mesh's ``axis`` blocks, reconcile the layout
+    budget across processes, and assemble the globally-sharded
+    :class:`GLMData`.
+
+    The two-pass build (local layout → budget allreduce → rebuild only when
+    another host needs bigger blocks) is the TPU-native analog of the
+    reference letting Spark pick partition sizes per executor: here shapes
+    must agree globally, so hosts agree on the max and pad with weight-0
+    rows / zero-value chunks, which contribute exactly nothing.
+    """
+    n_local = local_axis_blocks(mesh, axis)
+    # host_stage: the stack stays in numpy — make_array_from_process_local_data
+    # below is the one host→device transfer (a jnp stack would detour the
+    # whole local dataset through the default device's HBM).
+    #
+    # Two agreement rounds, both unconditional (allgather is a collective —
+    # every process must call it the same number of times):
+    # 1. agree on the bucket GEOMETRY (rows-per-shard, chunk widths) — a
+    #    host given a larger ``per`` re-buckets rows into fewer, denser
+    #    blocks, so chunk COUNTS measured at the old geometry are invalid;
+    # 2. re-measure chunk counts at the agreed geometry, then agree on
+    #    their max. Padding to a larger count is always legal, so round 2
+    #    is a fixed point — no host can need a third round.
+    local = shard_glm_data(host_data, n_local, host_stage=True)
+    b0 = shard_budget(local)
+    geo = allreduce_shard_budget(b0)
+    if (geo.rows_per_shard, geo.row_chunk, geo.col_chunk) != (
+            b0.rows_per_shard, b0.row_chunk, b0.col_chunk):
+        local = shard_glm_data(
+            host_data, n_local, host_stage=True,
+            budget=ShardBudget(rows_per_shard=geo.rows_per_shard,
+                               row_chunk=geo.row_chunk,
+                               col_chunk=geo.col_chunk))
+    b1 = shard_budget(local)
+    final = allreduce_shard_budget(b1)
+    if final != b1:
+        local = shard_glm_data(host_data, n_local, budget=final,
+                               host_stage=True)
+    return global_glm_data_from_local(local, mesh, axis)
+
+
 def global_glm_data_from_local(local: GLMData, mesh: Mesh,
                                axis: str = DATA_AXIS) -> GLMData:
     """Assemble a globally-sharded :class:`GLMData` from each process's
-    host-local block (stacked per-local-device layout, as produced by
-    ``shard_glm_data(local, jax.local_device_count())``).
+    host-local block (stacked per-block layout, as produced by
+    ``shard_glm_data(local, local_axis_blocks(mesh))``).
 
     Every process contributes its own rows; the result's leading dim is the
     global device count, laid out for the ``data``-axis ``shard_map``
-    objective. Labels/offsets/weights and a dense design all feed through
-    ``jax.make_array_from_process_local_data`` (the host→device bridge the
-    reference gets from Spark partition locality).
+    objective. Labels/offsets/weights and the design — dense, or the
+    chunked sparse layout (each of whose six leaves stacks the same way) —
+    all feed through ``jax.make_array_from_process_local_data`` (the
+    host→device bridge the reference gets from Spark partition locality;
+    ``function/glm/DistributedGLMLossFunction.scala`` reads its partitions
+    off executor-local HDFS the same one-host-one-block way).
+
+    Cross-host contract (unverifiable locally, like any SPMD invariant):
+    every process must present identical leaf shapes — same rows-per-device
+    ``per``, and for sparse designs the same chunk widths and padded chunk
+    counts. :func:`allreduce_shard_budget` reconciles per-host budgets;
+    :func:`global_glm_data_multihost` does the whole dance in one call.
     """
     sharding = NamedSharding(mesh, P(axis))
+    n_local = local_axis_blocks(mesh, axis)
+    n_axis = mesh.shape[axis]
+    if n_axis % n_local:
+        raise ValueError(
+            f"this process covers {n_local} of the {n_axis} {axis!r}-axis "
+            f"blocks — non-uniform process layouts are not supported")
+    scale = n_axis // n_local
+    if jax.process_count() > 1:
+        # Each data-axis block must be OWNED by exactly one process: if a
+        # block's replicas span processes (e.g. the entity axis crosses
+        # hosts), every owner would feed its own different rows into what
+        # the sharding declares to be one replicated block — silently
+        # dropping every non-zeroth host's data from psums. Partition the
+        # data axis across processes (make_multihost_mesh() default) and
+        # put cross-host axes on entity only when data is within-host.
+        names = list(mesh.axis_names)
+        axis_pos = names.index(axis)
+        devs = np.asarray(mesh.devices)
+        owners: dict[int, set[int]] = {}
+        for idx in np.ndindex(devs.shape):
+            owners.setdefault(idx[axis_pos], set()).add(
+                devs[idx].process_index)
+        shared = [c for c, procs in owners.items() if len(procs) > 1]
+        if shared:
+            raise ValueError(
+                f"{axis!r}-axis blocks {shared[:4]} are replicated across "
+                f"processes in this mesh; the per-process feed cannot "
+                f"guarantee replicas agree — use a mesh whose {axis!r} "
+                f"axis partitions processes")
 
-    def feed(x: np.ndarray) -> jax.Array:
+    def feed(x) -> jax.Array:
         x = np.asarray(x)
-        global_shape = (x.shape[0] * jax.process_count(),) + x.shape[1:]
+        if x.shape[0] != n_local:
+            raise ValueError(
+                f"local stack has {x.shape[0]} blocks; this process's "
+                f"devices cover {n_local} {axis!r}-axis blocks — build with "
+                f"shard_glm_data(data, local_axis_blocks(mesh))")
+        global_shape = (x.shape[0] * scale,) + x.shape[1:]
         return jax.make_array_from_process_local_data(sharding, x, global_shape)
 
-    if not isinstance(local.design, DenseDesign):
-        raise NotImplementedError(
-            "multi-host feed currently supports dense stacked designs; "
-            "pack sparse shards per-host first")
+    design = local.design
+    if isinstance(design, DenseDesign):
+        fed = DenseDesign(x=feed(design.x))
+    elif isinstance(design, ChunkedSparseDesign):
+        fed = ChunkedSparseDesign(
+            rvals=feed(design.rvals), rcols=feed(design.rcols),
+            rrow=feed(design.rrow), cvals=feed(design.cvals),
+            crows=feed(design.crows), ccol=feed(design.ccol),
+            n_rows=design.n_rows, n_cols=design.n_cols)
+    else:
+        raise TypeError(
+            f"multi-host feed takes the stacked per-block layout from "
+            f"shard_glm_data (DenseDesign or ChunkedSparseDesign); got "
+            f"{type(design).__name__} — run shard_glm_data("
+            f"local, local_axis_blocks(mesh)) first, or use "
+            f"global_glm_data_multihost for the whole dance")
     return GLMData(
-        design=DenseDesign(x=feed(local.design.x)),
+        design=fed,
         labels=feed(local.labels),
         offsets=feed(local.offsets),
         weights=feed(local.weights),

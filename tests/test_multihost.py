@@ -1,0 +1,249 @@
+"""Multi-host feed and budget reconciliation.
+
+The reference's cross-machine story is Spark's driver/executor tree
+(``function/glm/DistributedGLMLossFunction.scala`` treeAggregate over racks);
+here it is multi-controller JAX. Single-process tests drive the REAL feed
+path (``jax.make_array_from_process_local_data`` with process_count=1) on
+the 8-device virtual mesh; a genuine 2-process smoke test forms a
+``jax.distributed`` job over subprocess workers and runs the same psum'd
+objective across process boundaries.
+"""
+
+import os
+import subprocess
+import sys
+import socket
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.ops.design import CsrDesign, DenseDesign
+from photon_ml_tpu.ops.losses import LogisticLoss
+from photon_ml_tpu.ops.objective import GLMData, GLMObjective
+from photon_ml_tpu.parallel import (
+    DATA_AXIS,
+    DistributedGLMObjective,
+    ShardBudget,
+    allreduce_shard_budget,
+    global_glm_data_from_local,
+    global_glm_data_multihost,
+    shard_budget,
+    shard_glm_data,
+)
+from photon_ml_tpu.parallel.mesh import make_mesh
+
+
+def _problem(n=96, d=13, seed=0, sparse=False):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    if sparse:
+        x[rng.uniform(size=(n, d)) < 0.6] = 0.0
+        rows, cols = np.nonzero(x)
+        design = CsrDesign(rows=rows.astype(np.int32),
+                           cols=cols.astype(np.int32),
+                           values=x[rows, cols], n_rows=n, n_cols=d)
+    else:
+        design = DenseDesign(x=jnp.asarray(x))
+    labels = (rng.uniform(size=n) < 0.5).astype(np.float32)
+    data = GLMData(design=design, labels=jnp.asarray(labels),
+                   offsets=jnp.asarray(rng.normal(size=n).astype(np.float32)),
+                   weights=jnp.asarray(
+                       rng.uniform(0.5, 2.0, size=n).astype(np.float32)))
+    dense = GLMData(design=DenseDesign(x=jnp.asarray(x)), labels=data.labels,
+                    offsets=data.offsets, weights=data.weights)
+    return data, dense
+
+
+@pytest.mark.parametrize("sparse", [False, True])
+def test_single_process_feed_matches_direct_sharding(sparse):
+    """global_glm_data_multihost with process_count=1 must produce the same
+    objective value/gradient as the direct single-host shard + device_put
+    path, for dense and chunked-sparse designs alike."""
+    data, dense = _problem(sparse=sparse)
+    mesh = make_mesh({DATA_AXIS: 8})
+    obj = GLMObjective(LogisticLoss)
+    dist = DistributedGLMObjective(objective=obj, mesh=mesh)
+    w = jnp.asarray(np.random.default_rng(1).normal(size=data.dim),
+                    jnp.float32)
+
+    fed = global_glm_data_multihost(data, mesh)
+    v_fed, g_fed = dist.value_and_grad(w, fed, 0.3)
+
+    direct = shard_glm_data(data, 8, device_put_mesh=mesh)
+    v_dir, g_dir = dist.value_and_grad(w, direct, 0.3)
+    np.testing.assert_allclose(float(v_fed), float(v_dir), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(g_fed), np.asarray(g_dir),
+                               rtol=1e-5, atol=1e-6)
+
+    # and both agree with the unsharded single-device objective
+    v_ref, g_ref = obj.value_and_grad(w, dense, 0.3)
+    np.testing.assert_allclose(float(v_fed), float(v_ref), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(g_fed), np.asarray(g_ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_wider_budget_only_adds_inert_padding():
+    """A budget bigger than locally needed (what a denser remote host forces)
+    must not change the objective: extra rows are weight-0, extra chunks are
+    value-0."""
+    data, dense = _problem(sparse=True)
+    mesh = make_mesh({DATA_AXIS: 8})
+    obj = GLMObjective(LogisticLoss)
+    dist = DistributedGLMObjective(objective=obj, mesh=mesh)
+    w = jnp.asarray(np.random.default_rng(2).normal(size=data.dim),
+                    jnp.float32)
+
+    natural = shard_budget(shard_glm_data(data, 8))
+    wide = ShardBudget(rows_per_shard=natural.rows_per_shard + 3,
+                       row_chunk=natural.row_chunk,
+                       col_chunk=natural.col_chunk,
+                       row_chunks=natural.row_chunks + 5,
+                       col_chunks=natural.col_chunks + 2)
+    fed = shard_glm_data(data, 8, device_put_mesh=mesh, budget=wide)
+    assert shard_budget(fed) == wide
+    v, g = dist.value_and_grad(w, fed, 0.3)
+    v_ref, g_ref = obj.value_and_grad(w, dense, 0.3)
+    np.testing.assert_allclose(float(v), float(v_ref), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_feed_on_2d_mesh_keeps_every_row():
+    """On an (entity, data) mesh the feed must produce one block per DATA
+    coordinate, replicated over entity lanes — feeding one block per device
+    would give each device a 2-deep stack whose second block the shard_map
+    body silently drops (regression: value came back halved)."""
+    from photon_ml_tpu.parallel import ENTITY_AXIS
+    from photon_ml_tpu.parallel.multihost import local_axis_blocks
+
+    data, dense = _problem()
+    mesh = make_mesh({ENTITY_AXIS: 2, DATA_AXIS: 4})
+    assert local_axis_blocks(mesh, DATA_AXIS) == 4
+    obj = GLMObjective(LogisticLoss)
+    dist = DistributedGLMObjective(objective=obj, mesh=mesh)
+    w = jnp.asarray(np.random.default_rng(3).normal(size=data.dim),
+                    jnp.float32)
+    fed = global_glm_data_multihost(data, mesh)
+    v, g = dist.value_and_grad(w, fed, 0.3)
+    v_ref, g_ref = obj.value_and_grad(w, dense, 0.3)
+    np.testing.assert_allclose(float(v), float(v_ref), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_budget_too_small_is_rejected():
+    data, _ = _problem(sparse=True)
+    natural = shard_budget(shard_glm_data(data, 8))
+    with pytest.raises(ValueError, match="rows_per_shard"):
+        shard_glm_data(data, 8, budget=ShardBudget(
+            rows_per_shard=natural.rows_per_shard - 1))
+
+
+def test_allreduce_budget_single_process_is_identity():
+    b = ShardBudget(12, 8, 16, 30, 40)
+    assert allreduce_shard_budget(b) == b
+    # round-trip through the wire format
+    assert ShardBudget.from_array(b.to_array()) == b
+
+
+def test_feed_rejects_raw_csr_with_guidance():
+    data, _ = _problem(sparse=True)
+    mesh = make_mesh({DATA_AXIS: 8})
+    with pytest.raises(TypeError, match="shard_glm_data"):
+        global_glm_data_from_local(data, mesh)
+
+
+_WORKER = r"""
+import sys
+port, pid = sys.argv[1], int(sys.argv[2])
+from photon_ml_tpu.testing import virtual_devices
+virtual_devices(2, force_cpu=True)  # 2 local CPU devices per process
+from photon_ml_tpu.parallel import multihost
+multihost.initialize(f"localhost:{port}", 2, pid)
+import jax
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 4, jax.devices()
+import numpy as np
+import jax.numpy as jnp
+from photon_ml_tpu.ops.design import DenseDesign
+from photon_ml_tpu.ops.objective import GLMData, GLMObjective
+from photon_ml_tpu.ops.losses import LogisticLoss
+from photon_ml_tpu.parallel import DistributedGLMObjective, \
+    global_glm_data_multihost
+from photon_ml_tpu.parallel.multihost import make_multihost_mesh, is_chief
+
+# deterministic global problem; each process holds its half (different sizes
+# — process 1 one row short — so the budget allreduce is actually exercised)
+rng = np.random.default_rng(0)
+n, d = 64, 5
+x = rng.normal(size=(n, d)).astype(np.float32)
+labels = (rng.uniform(size=n) < 0.5).astype(np.float32)
+weights = np.ones(n, np.float32)
+lo, hi = (0, 33) if pid == 0 else (33, 64)
+local = GLMData(design=DenseDesign(x=jnp.asarray(x[lo:hi])),
+                labels=jnp.asarray(labels[lo:hi]),
+                offsets=jnp.zeros(hi - lo, jnp.float32),
+                weights=jnp.asarray(weights[lo:hi]))
+mesh = make_multihost_mesh()
+fed = global_glm_data_multihost(local, mesh)
+obj = GLMObjective(LogisticLoss)
+dist = DistributedGLMObjective(objective=obj, mesh=mesh)
+w = np.asarray(rng.normal(size=d), np.float32)
+val, grad = dist.value_and_grad(jnp.asarray(w), fed, 0.1)
+val = float(val); grad = np.asarray(grad)
+
+# numpy reference on the full data (no jax collectives involved)
+m = x @ w
+p = 1.0 / (1.0 + np.exp(-m))
+ref_val = float(np.sum(np.log1p(np.exp(-np.abs(m))) + np.maximum(m, 0) - m * labels)
+                + 0.5 * 0.1 * np.dot(w, w))
+ref_grad = x.T @ (p - labels) + 0.1 * w
+assert abs(val - ref_val) < 1e-3 * abs(ref_val), (val, ref_val)
+assert np.allclose(grad, ref_grad, rtol=1e-4, atol=1e-4), (grad, ref_grad)
+assert is_chief() == (pid == 0)
+print(f"MULTIHOST_OK {pid}", flush=True)
+"""
+
+
+@pytest.mark.slow
+def test_two_process_distributed_smoke(tmp_path):
+    """Genuine cross-process SPMD: two workers form a jax.distributed job
+    over loopback, feed host-local halves (of different sizes) through the
+    budget-reconciled multihost path, and the psum'd objective must match a
+    numpy computation on the full data."""
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # workers pin their own device count
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+         env.get("PYTHONPATH", "")])
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), str(port), str(pid)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for pid in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        # kill both, then drain whatever each wrote so the failure shows it
+        for p in procs:
+            p.kill()
+        drained = []
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=10)
+            except Exception:
+                out = "<no output recovered>"
+            drained.append(out or "<empty>")
+        pytest.fail("multihost workers timed out:\n" + "\n".join(drained))
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} rc={p.returncode}:\n{out}"
+        assert f"MULTIHOST_OK {pid}" in out, out
