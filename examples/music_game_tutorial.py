@@ -72,12 +72,12 @@ def generate(path: str, n: int, seed: int, *, n_users=120, n_songs=60,
     return path
 
 
-def main() -> None:
+def main(argv=None) -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--workdir", default="/tmp/photon-tpu-music-demo")
     parser.add_argument("--n-train", type=int, default=8000)
     parser.add_argument("--n-validation", type=int, default=3000)
-    args = parser.parse_args()
+    args = parser.parse_args(argv)
 
     os.makedirs(args.workdir, exist_ok=True)
     train = generate(os.path.join(args.workdir, "train.avro"),

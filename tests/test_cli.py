@@ -548,3 +548,26 @@ class TestBuildIndexDriver:
         assert result["sizes"]["global"] == 7  # 6 features + intercept
         assert result["sizes"]["user"] == 3
         assert os.path.exists(os.path.join(out, "global.json"))
+
+
+class TestMusicTutorial:
+    def test_tutorial_runs_end_to_end(self, tmp_path):
+        """The flagship walkthrough (examples/music_game_tutorial.py — the
+        reference's Yahoo! Music wiki recipe) must stay green: generate,
+        train 4 coordinates, score, evaluate — at tiny sizes."""
+        import sys
+
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                        os.pardir, "examples"))
+        try:
+            import music_game_tutorial
+        finally:
+            sys.path.pop(0)
+        music_game_tutorial.main([
+            "--workdir", str(tmp_path / "demo"),
+            "--n-train", "500", "--n-validation", "200"])
+        # the pipeline wrote a loadable model and scores
+        assert os.path.exists(
+            os.path.join(tmp_path, "demo", "model", "best",
+                         "model-metadata.json"))
+        assert os.path.isdir(os.path.join(tmp_path, "demo", "scores"))
