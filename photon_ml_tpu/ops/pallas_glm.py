@@ -31,6 +31,27 @@ Measured on the axon TPU v5e at (200k, 1024), 50-iteration compiled loop
                                                    precision note in _kernel)
     this kernel, bf16, B=1024      1.85 ms/iter   (1.95x; design stored bf16)
 
+Round-2 block-size sweep (same shape, 50-iter fori_loop, best of 3):
+
+    f32  B=400 (auto)   2.658 ms/iter   308 GB/s effective — 91% of the
+                        ~340 GB/s practical single-op ceiling measured on
+                        this box; the f32 kernel is AT the bandwidth wall.
+    f32  B=800          VMEM OOM (19.7 MB scoped > 16 MB limit)
+    bf16 B=800 (auto)   1.947 ms/iter   210 GB/s eff
+    bf16 B=1000         3.890 ms/iter   (sublane-hostile: 1000 % 16 != 0
+                        after rounding → padding path)
+    bf16 B=1600         2.630 ms/iter
+    bf16 B=2000         1.908 ms/iter   215 GB/s eff
+
+bf16 is NOT bandwidth-bound: halving the bytes recovered only 1.37x over
+fused f32, flat across block sizes — the M=1 matvec shape leaves 127/128
+MXU rows idle, so at bf16's byte rate the kernel hits the issue/compute
+wall (~210 GB/s effective) before the HBM wall (~340). End-to-end the
+bf16-design solve still measures ~1.4–1.5x over the f32 fused solve
+(101 ms vs 150 ms, 50 iterations) because line-search evaluations share
+the same kernel. Auto block sizes (f32 400, bf16 800) are within 2% of
+the best measured; no retune needed.
+
 In auto mode the block size prefers the largest ≤-cap divisor of n (see
 ``_dividing_block_rows``; at n=200k f32 that's B=400) so X streams in
 place — padding the row dim means `jnp.pad` copying the FULL design inside
