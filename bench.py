@@ -214,7 +214,12 @@ def bench_random_effect():
     from photon_ml_tpu.types import TaskType
 
     data, xr, y, ent = _make_re_problem()
-    cfg = RandomEffectDatasetConfig("entityId", "re")
+    # histogram bucketing: ≤5 padded shapes (vs ~10 geometric) — every
+    # distinct shape is a fresh XLA compile, the cold-run cost that
+    # dominates a fresh-process bench through the remote-compile tunnel
+    cfg = RandomEffectDatasetConfig("entityId", "re",
+                                    bucket_strategy="histogram",
+                                    max_sample_buckets=5)
     t0 = time.perf_counter()
     dataset = RandomEffectDataset.build("perEntity", data, cfg)
     build_s = time.perf_counter() - t0
@@ -317,13 +322,13 @@ def _host_cd_sweep(xf, xi, user, song, y, lam_fixed, lam_re, sweeps=1):
     scores = {"global": np.zeros(n), "perUser": np.zeros(n),
               "perSong": np.zeros(n)}
 
-    def logistic(xd, off, lam, w0):
+    def logistic(xd, yl, off, lam, w0):
         def f(w):
             m = xd @ w + off
-            loss = (np.logaddexp(0.0, -np.where(yy_loc > 0.5, m, -m)).sum()
+            loss = (np.logaddexp(0.0, -np.where(yl > 0.5, m, -m)).sum()
                     + 0.5 * lam * w @ w)
             p = 1.0 / (1.0 + np.exp(-m))
-            return loss, xd.T @ (p - yy_loc) + lam * w
+            return loss, xd.T @ (p - yl) + lam * w
 
         return scipy.optimize.minimize(
             f, w0, jac=True, method="L-BFGS-B",
@@ -334,8 +339,7 @@ def _host_cd_sweep(xf, xi, user, song, y, lam_fixed, lam_re, sweeps=1):
     for _ in range(sweeps):
         # fixed effect
         off = scores["perUser"] + scores["perSong"]
-        yy_loc = yy
-        w_f = logistic(xf.astype(np.float64), off, lam_fixed, w_f)
+        w_f = logistic(xf.astype(np.float64), yy, off, lam_fixed, w_f)
         scores["global"] = xf @ w_f
         # random effects
         for cid, ids in (("perUser", user), ("perSong", song)):
@@ -350,9 +354,8 @@ def _host_cd_sweep(xf, xi, user, song, y, lam_fixed, lam_re, sweeps=1):
                 hi = starts[k + 1] if k + 1 < len(starts) else n
                 sel = order[lo:hi]
                 xd = xi[sel].astype(np.float64)
-                yy_loc = yy[sel]
                 w0 = re_models[cid].get(e, np.zeros(CD_D_RE))
-                w_e = logistic(xd, off_all[sel], lam_re, w0)
+                w_e = logistic(xd, yy[sel], off_all[sel], lam_re, w0)
                 re_models[cid][e] = w_e
                 new_scores[sel] = xd @ w_e
             scores[cid] = new_scores
@@ -383,10 +386,14 @@ def bench_cd_sweep():
             "global": FixedEffectCoordinateConfig(
                 feature_shard_id="fixed", optimization=opt),
             "perUser": RandomEffectCoordinateConfig(
-                dataset=RandomEffectDatasetConfig("userId", "item"),
+                dataset=RandomEffectDatasetConfig(
+                    "userId", "item", bucket_strategy="histogram",
+                    max_sample_buckets=4),
                 optimization=opt),
             "perSong": RandomEffectCoordinateConfig(
-                dataset=RandomEffectDatasetConfig("songId", "item"),
+                dataset=RandomEffectDatasetConfig(
+                    "songId", "item", bucket_strategy="histogram",
+                    max_sample_buckets=4),
                 optimization=opt),
         },
         update_sequence=["global", "perUser", "perSong"],

@@ -89,7 +89,14 @@ class OptimizationProblem:
         cfg = self.config.optimizer_config
         if self.config.optimizer == OptimizerType.TRON:
             hvp = lambda w, v: self.objective.hvp(w, v, data, l2)
-            return minimize_tron(fun, hvp, w0, cfg)
+            # operator form only when it pays: the fused one-pass Hvp
+            # kernel per CG product, d2 pass hoisted per outer iteration
+            # (measured 1.5x on the TRON bench shape; forcing it onto the
+            # plain closed form measured slower — see hvp_prefers_operator)
+            prefers = getattr(self.objective, "hvp_prefers_operator", None)
+            hvp_at = ((lambda w: self.objective.hvp_operator(w, data, l2))
+                      if prefers is not None and prefers(data) else None)
+            return minimize_tron(fun, hvp, w0, cfg, hvp_at=hvp_at)
         if self.config.regularization.has_l1:
             return minimize_owlqn(fun, w0, l1, cfg)
         return minimize_lbfgs(fun, w0, cfg)

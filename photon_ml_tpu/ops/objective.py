@@ -155,29 +155,34 @@ class GLMObjective:
         return jnp.sum(contrib) + self._l2_term(w, l2)
 
     # --- derivatives ------------------------------------------------------
-    def value_and_grad(self, w: Array, data: GLMData, l2=0.0) -> tuple[Array, Array]:
-        # Mosaic lowering needs a TPU: off-TPU, fused falls back to the fast
-        # closed form rather than the (orders-of-magnitude slower) Pallas
-        # interpreter; tests opt into the interpreter via fused_interpret.
+    def _fused_eligible(self, data: GLMData) -> bool:
+        """Single home of the fused-kernel gate (shared by value_and_grad,
+        hvp_prefers_operator, hvp_operator — they must not drift): Mosaic
+        lowering needs a TPU (tests opt into the interpreter via
+        fused_interpret), dense design, identity normalization, and a
+        no-copy auto block (shapes with no tile-aligned dividing block
+        would force the kernel to re-pad the full design per evaluation —
+        a net loss vs the closed form)."""
         on_tpu = jax.default_backend() == "tpu"
-        if (self.fused and (on_tpu or self.fused_interpret)
+        if not (self.fused and (on_tpu or self.fused_interpret)
                 and isinstance(data.design, DenseDesign)
                 and self.normalization.is_identity):
-            from photon_ml_tpu.ops.pallas_glm import (
-                auto_block_rows,
-                fused_value_and_grad,
-            )
+            return False
+        from photon_ml_tpu.ops.pallas_glm import auto_block_rows
 
-            # Shapes with no tile-aligned dividing block would force the
-            # kernel to copy (pad) the full design per evaluation — a net
-            # loss vs the closed form; skip the kernel for those.
-            if auto_block_rows(data.n_samples, data.design.x.dtype) is not None:
-                value, grad = fused_value_and_grad(
-                    self.loss, data.design.x, w, data.labels, data.offsets,
-                    data.weights, interpret=not on_tpu)
-                l2 = jnp.asarray(l2, value.dtype)
-                return (value + self._l2_term(w, l2),
-                        grad + l2 * self._reg_w(w))
+        return auto_block_rows(data.n_samples, data.design.x.dtype) is not None
+
+    def value_and_grad(self, w: Array, data: GLMData, l2=0.0) -> tuple[Array, Array]:
+        if self._fused_eligible(data):
+            from photon_ml_tpu.ops.pallas_glm import fused_value_and_grad
+
+            value, grad = fused_value_and_grad(
+                self.loss, data.design.x, w, data.labels, data.offsets,
+                data.weights,
+                interpret=jax.default_backend() != "tpu")
+            l2 = jnp.asarray(l2, value.dtype)
+            return (value + self._l2_term(w, l2),
+                    grad + l2 * self._reg_w(w))
         return self._closed_value_and_grad(w, data, l2)
 
     def _closed_value_and_grad(self, w, data, l2) -> tuple[Array, Array]:
@@ -216,26 +221,61 @@ class GLMObjective:
     def hvp(self, w: Array, v: Array, data: GLMData, l2=0.0) -> Array:
         """Exact Hessian-vector product. Replaces
         ``HessianVectorAggregator.scala``; feeds TRON's inner CG.
+        One-shot form of :meth:`hvp_operator`.
+        """
+        return self.hvp_operator(w, data, l2)(v)
 
-        Closed form — ``X'ᵀ(weight·d2·(X'v)) + l2·v`` with the normalized
-        column ``x'_ij = f_j·(x_ij − s_j)`` expanded by chain rule — through
-        the design's forward/transpose fast paths (autodiff would
+    def hvp_prefers_operator(self, data: GLMData) -> bool:
+        """True when :meth:`hvp_operator` actually buys wall-clock — i.e.
+        the fused one-pass Hvp kernel will engage. Forcing the hoisted
+        operator form onto the plain closed form measured SLOWER than
+        letting XLA's loop-invariant code motion handle the d2 pass
+        (1280 ms vs 987 ms on the TRON bench shape), so TRON only asks for
+        the operator when the kernel is available."""
+        return self._fused_eligible(data)
+
+    def hvp_operator(self, w: Array, data: GLMData, l2=0.0):
+        """``v ↦ Hv`` at fixed ``w`` — the shape TRON's inner CG wants.
+
+        The margin-dependent ``d2`` weights are computed ONCE here (one
+        pass over the design); each returned product is then a single
+        further design traversal: the fused Pallas one-pass kernel on TPU
+        for dense identity-normalization objectives, else the closed form
+        ``X'ᵀ(d2·(X'v)) + l2·v`` with the normalized column
+        ``x'_ij = f_j·(x_ij − s_j)`` expanded by chain rule (autodiff would
         differentiate through ``matvec``, and the backward of a sparse
         gather is the giant scatter the chunked design exists to avoid).
         """
         norm = self.normalization
-        u = v if norm.factors is None else v * norm.factors
-        t = data.design.matvec(u)
-        if norm.shifts is not None:
-            t = t - jnp.vdot(u, norm.shifts)
-        d2t = self._d2_weights(w, data) * t
-        hv = data.design.rmatvec(d2t)
-        if norm.shifts is not None:
-            hv = hv - norm.shifts * jnp.sum(d2t)
-        if norm.factors is not None:
-            hv = hv * norm.factors
-        return (hv.astype(w.dtype)
-                + jnp.asarray(self.reg_curvature(l2), w.dtype) * v)
+        d2w = self._d2_weights(w, data)
+        reg = jnp.asarray(self.reg_curvature(l2), w.dtype)
+
+        if self._fused_eligible(data):
+            from photon_ml_tpu.ops.pallas_glm import fused_hvp
+
+            x = data.design.x
+            interpret = jax.default_backend() != "tpu"
+
+            def apply_fused(v: Array) -> Array:
+                hv = fused_hvp(x, v, d2w, interpret=interpret)
+                return hv.astype(w.dtype) + reg * v
+
+            return apply_fused
+
+        def apply(v: Array) -> Array:
+            u = v if norm.factors is None else v * norm.factors
+            t = data.design.matvec(u)
+            if norm.shifts is not None:
+                t = t - jnp.vdot(u, norm.shifts)
+            d2t = d2w * t
+            hv = data.design.rmatvec(d2t)
+            if norm.shifts is not None:
+                hv = hv - norm.shifts * jnp.sum(d2t)
+            if norm.factors is not None:
+                hv = hv * norm.factors
+            return hv.astype(w.dtype) + reg * v
+
+        return apply
 
     # --- closed-form second-order contractions (for variance) -------------
     def _d2_weights(self, w: Array, data: GLMData) -> Array:
@@ -292,9 +332,10 @@ class GLMObjective:
         ``HessianMatrixAggregator.scala``). Only for small ``d`` — the
         reference has the same restriction."""
         if not isinstance(data.design, DenseDesign):
-            # Materialize through Hvp columns for sparse designs.
+            # Materialize through Hvp columns for sparse designs; the
+            # operator form computes the d2 weights once for all columns.
             eye = jnp.eye(data.dim, dtype=w.dtype)
-            return jax.vmap(lambda v: self.hvp(w, v, data, l2))(eye).T
+            return jax.vmap(self.hvp_operator(w, data, l2))(eye).T
         d2 = self._d2_weights(w, data)
         x = data.design.x
         if self.normalization.shifts is not None:

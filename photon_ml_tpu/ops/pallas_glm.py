@@ -263,3 +263,89 @@ def fused_value_and_grad(loss: PointwiseLoss, x, w, labels, offsets, weights,
     )
     value, grad = out
     return value[0, 0], grad[0, :]
+
+
+def _hvp_kernel(x_ref, d2_ref, v_ref, out_ref):
+    """One-pass GLM Hessian-vector product: out = Xᵀ(d2 ∘ (Xv)).
+
+    Same lane-major shape discipline as :func:`_kernel` — both
+    contractions are 1-row matmuls against the SAME resident x block, so
+    the design streams through VMEM exactly once per product (the XLA
+    closed form reads it twice: matvec then rmatvec). ``d2`` is the
+    precomputed per-sample weight·d2loss vector — margin-dependent only
+    through ``w``, so TRON's inner CG (many products at fixed ``w``)
+    amortizes its computation to zero.
+    """
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    x = x_ref[:]  # (B, D)
+    v = v_ref[:]  # (1, D) f32
+    d2 = d2_ref[0]  # (1, B)
+    precision = (jax.lax.Precision.HIGHEST if x.dtype == jnp.float32
+                 else jax.lax.Precision.DEFAULT)
+    t = jax.lax.dot_general(
+        v.astype(x.dtype), x,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=precision)  # (1, B) = (Xv)ᵀ for this block
+    out_ref[:] += jax.lax.dot_general(
+        (d2 * t).astype(x.dtype), x,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=precision)  # (1, D)
+
+
+@functools.partial(jax.jit, static_argnames=("block_rows", "interpret"))
+def fused_hvp(x, v, d2w, *, block_rows: int | None = None,
+              interpret: bool = False):
+    """``Xᵀ(d2w ∘ (Xv))`` in ONE pass over ``x`` (no L2 term — caller adds).
+
+    ``x`` is ``(n, d)``; ``v`` ``(d,)`` f32; ``d2w`` ``(n,)`` the
+    weight-and-padding-masked second derivatives (0 on padded rows, which
+    then contribute exactly nothing). Block selection mirrors
+    :func:`fused_value_and_grad` via the shared :func:`auto_block_rows`.
+    """
+    n, d = x.shape
+    tile = _sublane_tile(x.dtype)
+    if block_rows is None:
+        b = auto_block_rows(n, x.dtype)
+        if b is None:  # no dividing block: padding path
+            b = _rounded_block(n, _default_block_rows(x.dtype), tile)
+    else:
+        b = _rounded_block(n, block_rows, tile)
+    n_blocks = pl.cdiv(n, b)
+    n_pad = n_blocks * b
+    if n_pad != n:
+        x = jnp.pad(x, ((0, n_pad - n), (0, 0)))
+        d2w = jnp.pad(d2w, (0, n_pad - n))
+
+    f32 = jnp.float32
+    itemsize = jnp.dtype(x.dtype).itemsize
+    out = pl.pallas_call(
+        _hvp_kernel,
+        grid=(n_blocks,),
+        in_specs=[
+            pl.BlockSpec((b, d), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, b), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, d), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, d), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((1, d), f32),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * n_pad * d,
+            transcendentals=0,
+            bytes_accessed=n_pad * d * itemsize,
+        ),
+        interpret=interpret,
+    )(
+        x,
+        d2w.astype(f32).reshape(n_blocks, 1, b),
+        v.astype(f32).reshape(1, -1),
+    )
+    return out[0, :]

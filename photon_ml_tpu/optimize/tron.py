@@ -97,10 +97,16 @@ def _trcg(hvp, g: Array, delta: Array, max_cg: int):
 
 
 def minimize_tron(fun: ValueAndGrad, hvp: Hvp, w0: Array,
-                  config: OptimizerConfig = OptimizerConfig()) -> OptimizerResult:
+                  config: OptimizerConfig = OptimizerConfig(),
+                  *, hvp_at=None) -> OptimizerResult:
     """Trust-region Newton minimization of a twice-differentiable ``fun``.
 
     ``hvp(w, v)`` must return the exact Hessian-vector product at ``w``.
+    ``hvp_at(w) -> (v -> Hv)``, when given, takes precedence: the operator
+    is built once per outer iteration, so work that depends only on ``w``
+    (a GLM's margin/d2 pass over the design) is hoisted out of the inner
+    CG loop explicitly instead of trusting XLA's loop-invariant code
+    motion, and the product itself can be a fused one-pass kernel.
     Jittable and vmappable.
     """
     f0, g0 = fun(w0)
@@ -118,7 +124,8 @@ def minimize_tron(fun: ValueAndGrad, hvp: Hvp, w0: Array,
         return (~s.converged) & (~s.failed) & (s.it < config.max_iterations)
 
     def body(s):
-        step, at_boundary, prered = _trcg(lambda v: hvp(s.w, v), s.g, s.delta,
+        op = hvp_at(s.w) if hvp_at is not None else (lambda v: hvp(s.w, v))
+        step, at_boundary, prered = _trcg(op, s.g, s.delta,
                                           config.cg_max_iterations)
         snorm = jnp.linalg.norm(step)
         w_new = s.w + step

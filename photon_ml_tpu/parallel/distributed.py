@@ -283,6 +283,14 @@ class DistributedGLMObjective:
         return hv + jnp.asarray(self.objective.reg_curvature(l2),
                                 w.dtype) * v
 
+    # NOTE no hvp_operator here, deliberately: single-chip measurement
+    # showed force-hoisting the plain closed form out of TRON's CG loop is
+    # SLOWER than XLA's own loop-invariant code motion (1280 ms vs 987 ms
+    # on the bench shape) — the operator form only pays when the per-product
+    # work itself gets cheaper (the fused Pallas kernel, which does not yet
+    # run under shard_map). OptimizationProblem's hvp_prefers_operator gate
+    # keeps distributed TRON on the per-call hvp above.
+
     def margins(self, w: Array, sharded: GLMData) -> Array:
         """Per-sample margins in the stacked (n_shards, per) layout."""
         def local(wv, blk):

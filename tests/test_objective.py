@@ -530,3 +530,36 @@ def test_fused_auto_falls_back_for_nondividing_shapes():
     np.testing.assert_allclose(float(v_fused), float(v_ref), rtol=1e-6)
     np.testing.assert_allclose(np.asarray(g_fused), np.asarray(g_ref),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_fused_hvp_matches_closed_form():
+    """The one-pass Pallas Hvp kernel (TRON's inner-CG product) must match
+    the closed form X'(d2*(Xv)) through the interpreter, including padded
+    (weight-0) rows contributing nothing."""
+    from photon_ml_tpu.ops.pallas_glm import fused_hvp
+
+    rng = np.random.default_rng(3)
+    n, d = 96, 24
+    x = jnp.asarray(rng.normal(size=(n, d)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=d), jnp.float32)
+    v = jnp.asarray(rng.normal(size=d), jnp.float32)
+    weights = np.ones(n, np.float32)
+    weights[-7:] = 0.0  # padding rows
+    data = GLMData(design=DenseDesign(x=x),
+                   labels=jnp.asarray((rng.random(n) < 0.5).astype(np.float32)),
+                   offsets=jnp.asarray(rng.normal(size=n), jnp.float32),
+                   weights=jnp.asarray(weights))
+    obj = GLMObjective(LogisticLoss)
+    d2w = obj._d2_weights(w, data)
+    got = fused_hvp(x, v, d2w, interpret=True)
+    want = obj.hvp(w, v, data, 0.0)  # closed form, no L2 (kernel adds none)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+    # operator path end-to-end with the interpreter-backed fused kernel
+    obj_f = GLMObjective(LogisticLoss, fused=True, fused_interpret=True)
+    assert obj_f.hvp_prefers_operator(data)
+    got_op = obj_f.hvp_operator(w, data, 0.3)(v)
+    want_l2 = obj.hvp(w, v, data, 0.3)
+    np.testing.assert_allclose(np.asarray(got_op), np.asarray(want_l2),
+                               rtol=1e-5, atol=1e-5)
