@@ -137,6 +137,16 @@ def _kernel(loss: PointwiseLoss, x_ref, y_ref, off_ref, wt_ref, w_ref,
         precision=precision)  # (1, D)
 
 
+def _out_struct(x, shape, dtype):
+    """ShapeDtypeStruct for a kernel output, carrying the input's varying
+    manual axes: under shard_map, outputs vary over the same mesh axes as
+    the design block — without the vma the checker rejects the
+    pallas_call. One home for both kernels so the plumbing cannot drift."""
+    vma = getattr(jax.typeof(x), "vma", frozenset()) or None
+    return (jax.ShapeDtypeStruct(shape, dtype) if vma is None
+            else jax.ShapeDtypeStruct(shape, dtype, vma=vma))
+
+
 def _default_block_rows(dtype) -> int:
     if dtype == jnp.bfloat16:
         return DEFAULT_BLOCK_ROWS_BF16
@@ -245,8 +255,8 @@ def fused_value_and_grad(loss: PointwiseLoss, x, w, labels, offsets, weights,
             pl.BlockSpec((1, d), lambda i: (0, 0), memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((1, 1), f32),
-            jax.ShapeDtypeStruct((1, d), f32),
+            _out_struct(x, (1, 1), f32),
+            _out_struct(x, (1, d), f32),
         ],
         cost_estimate=pl.CostEstimate(
             flops=4 * n_pad * d,
@@ -336,7 +346,7 @@ def fused_hvp(x, v, d2w, *, block_rows: int | None = None,
         ],
         out_specs=pl.BlockSpec((1, d), lambda i: (0, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((1, d), f32),
+        out_shape=_out_struct(x, (1, d), f32),
         cost_estimate=pl.CostEstimate(
             flops=4 * n_pad * d,
             transcendentals=0,
