@@ -1,0 +1,142 @@
+// Native Avro scoring-output writer: flat numpy columns ->
+// ScoringResultAvro container file, exposed through a C ABI consumed via
+// ctypes (photon_ml_tpu/native.py).
+//
+// Role: the output half of the native IO path.  The reference writes
+// ScoringResultAvro across Spark executors
+// (photon-client/.../cli/game/scoring/GameScoringDriver.scala); here one
+// host drains the device's score vector, and the pure-Python record
+// encoder (~100k records/s) becomes the wall on 10^7+-row batch scoring.
+// This writer emits the exact SCORING_RESULT_AVRO shape
+// (photon_ml_tpu/io/schemas.py) from columnar buffers.
+//
+// Scope: uid (union null|string; generated decimal indices when the caller
+// passes no uid buffer), predictionScore double, label union null|double,
+// metadataMap always null.  Codec: null (uncompressed) — scoring output is
+// typically consumed immediately; callers wanting compression use the
+// Python writer.
+//
+// Build: compiled into libphoton_native.so next to avro_reader.cc
+// (photon_ml_tpu/native.py).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+void append_long(std::vector<uint8_t>& out, int64_t v) {
+  // zigzag + varint (Avro long)
+  uint64_t u = (static_cast<uint64_t>(v) << 1) ^
+               static_cast<uint64_t>(v >> 63);
+  while (u >= 0x80) {
+    out.push_back(static_cast<uint8_t>(u) | 0x80);
+    u >>= 7;
+  }
+  out.push_back(static_cast<uint8_t>(u));
+}
+
+void append_double(std::vector<uint8_t>& out, double v) {
+  // Avro double: 8 bytes little-endian IEEE 754
+  uint64_t bits;
+  std::memcpy(&bits, &v, 8);
+  for (int i = 0; i < 8; ++i) {
+    out.push_back(static_cast<uint8_t>(bits >> (8 * i)));
+  }
+}
+
+void append_bytes(std::vector<uint8_t>& out, const char* s, size_t len) {
+  append_long(out, static_cast<int64_t>(len));
+  out.insert(out.end(), reinterpret_cast<const uint8_t*>(s),
+             reinterpret_cast<const uint8_t*>(s) + len);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Writes a ScoringResultAvro container.  Arguments:
+//   path: output file
+//   schema_json/schema_len: the writer schema (Python passes
+//     io/schemas.py::SCORING_RESULT_AVRO so the two cannot drift)
+//   scores[n]: predictionScore column
+//   labels[n]: label column, or NULL (labels written as union-null)
+//   uid_bytes/uid_offsets: concatenated utf-8 uids with n+1 offsets, or
+//     NULL -> uids are the decimal record indices
+//   block_records: records per Avro block (sync marker between blocks)
+// Returns n on success, -1 on IO failure.
+int64_t photon_write_scoring_results(const char* path,
+                                     const char* schema_json,
+                                     int64_t schema_len,
+                                     const double* scores,
+                                     const double* labels,
+                                     const char* uid_bytes,
+                                     const int64_t* uid_offsets, int64_t n,
+                                     int64_t block_records) {
+  std::FILE* f = std::fopen(path, "wb");
+  if (!f) return -1;
+  // deterministic sync marker (the spec wants 16 bytes, not entropy)
+  static const uint8_t sync[16] = {'p', 'h', 'o', 't', 'o', 'n', '-', 't',
+                                   'p', 'u', '-', 's', 'c', 'o', 'r', 'e'};
+
+  std::vector<uint8_t> buf;
+  buf.reserve(1 << 16);
+  // header: magic, metadata map {avro.schema, avro.codec}, sync
+  const uint8_t magic[4] = {'O', 'b', 'j', 1};
+  buf.insert(buf.end(), magic, magic + 4);
+  append_long(buf, 2);  // metadata map: one block of 2 entries
+  append_bytes(buf, "avro.schema", 11);
+  append_bytes(buf, schema_json, static_cast<size_t>(schema_len));
+  append_bytes(buf, "avro.codec", 10);
+  append_bytes(buf, "null", 4);
+  append_long(buf, 0);  // end of map
+  buf.insert(buf.end(), sync, sync + 16);
+  if (std::fwrite(buf.data(), 1, buf.size(), f) != buf.size()) {
+    std::fclose(f);
+    return -1;
+  }
+
+  if (block_records <= 0) block_records = 65536;
+  std::vector<uint8_t> block;
+  block.reserve(static_cast<size_t>(block_records) * 24);
+  char uid_scratch[24];
+  for (int64_t start = 0; start < n; start += block_records) {
+    int64_t count = n - start < block_records ? n - start : block_records;
+    block.clear();
+    for (int64_t i = start; i < start + count; ++i) {
+      append_long(block, 1);  // uid union: branch 1 = string
+      if (uid_bytes) {
+        int64_t lo = uid_offsets[i], hi = uid_offsets[i + 1];
+        append_bytes(block, uid_bytes + lo, static_cast<size_t>(hi - lo));
+      } else {
+        int len = std::snprintf(uid_scratch, sizeof uid_scratch, "%lld",
+                                static_cast<long long>(i));
+        append_bytes(block, uid_scratch, static_cast<size_t>(len));
+      }
+      append_double(block, scores[i]);
+      if (labels) {
+        append_long(block, 1);  // label union: branch 1 = double
+        append_double(block, labels[i]);
+      } else {
+        append_long(block, 0);  // null
+      }
+      append_long(block, 0);  // metadataMap union: null
+    }
+    buf.clear();
+    append_long(buf, count);
+    append_long(buf, static_cast<int64_t>(block.size()));
+    bool ok = std::fwrite(buf.data(), 1, buf.size(), f) == buf.size() &&
+              std::fwrite(block.data(), 1, block.size(), f) == block.size() &&
+              std::fwrite(sync, 1, 16, f) == 16;
+    if (!ok) {
+      std::fclose(f);
+      return -1;
+    }
+  }
+  if (std::fclose(f) != 0) return -1;
+  return n;
+}
+
+}  // extern "C"

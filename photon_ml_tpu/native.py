@@ -26,6 +26,7 @@ from photon_ml_tpu.io import avro as avro_mod
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _SRC = os.path.join(_REPO_ROOT, "native", "avro_reader.cc")
+_SRC_WRITER = os.path.join(_REPO_ROOT, "native", "avro_writer.cc")
 _BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
 _LIB = os.path.join(_BUILD_DIR, "libphoton_native.so")
 
@@ -40,7 +41,7 @@ _FIELDS = ("uid", "response", "offset", "weight", "features", "metadataMap")
 def _build() -> bool:
     os.makedirs(_BUILD_DIR, exist_ok=True)
     cmd = ["g++", "-std=c++17", "-O2", "-shared", "-fPIC", "-o", _LIB,
-           _SRC, "-lz"]
+           _SRC, _SRC_WRITER, "-lz"]
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
     except (OSError, subprocess.TimeoutExpired):
@@ -53,8 +54,19 @@ def _load() -> Optional[ctypes.CDLL]:
     with _lock:
         if _lib is not None or _load_failed:
             return _lib
-        if not os.path.exists(_LIB) or \
-                os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
+        try:
+            src_mtime = max(os.path.getmtime(_SRC),
+                            os.path.getmtime(_SRC_WRITER))
+        except OSError:
+            # sources absent (installed wheel without the native tree):
+            # unbuildable → degrade to the Python fallback, never raise
+            src_mtime = None
+        if src_mtime is None and not os.path.exists(_LIB):
+            _load_failed = True
+            return None
+        if not os.path.exists(_LIB) or (
+                src_mtime is not None
+                and os.path.getmtime(_LIB) < src_mtime):
             if not _build():
                 _load_failed = True
                 return None
@@ -95,6 +107,14 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_char_p,
             np.ctypeslib.ndpointer(dtype=np.int64, flags="C_CONTIGUOUS")]
         lib.photon_result_free.argtypes = [ctypes.c_void_p]
+        lib.photon_write_scoring_results.restype = ctypes.c_int64
+        lib.photon_write_scoring_results.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64,
+            np.ctypeslib.ndpointer(dtype=np.float64, flags="C_CONTIGUOUS"),
+            ctypes.c_void_p,  # labels (f64*) or NULL
+            ctypes.c_char_p,  # uid bytes or NULL
+            ctypes.c_void_p,  # uid offsets (i64*) or NULL
+            ctypes.c_int64, ctypes.c_int64]
         _lib = lib
         return _lib
 
@@ -293,3 +313,51 @@ def decode_training_file(path: str, id_keys: Sequence[str] = ()
             feature_keys=feature_keys, id_cols=id_cols, id_vocabs=id_vocabs)
     finally:
         lib.photon_result_free(rp)
+
+
+def write_scoring_results(path: str, scores: np.ndarray,
+                          labels: Optional[np.ndarray] = None,
+                          uids: Optional[Sequence[str]] = None,
+                          block_records: int = 65536) -> bool:
+    """Write a ``ScoringResultAvro`` container via the native writer.
+
+    Columns in, container out — the output half of the native IO path
+    (measured ~5M rows/s vs ~100k for the pure-Python record encoder —
+    ~50x; see ``native/avro_writer.cc``).
+    ``uids=None`` writes decimal record indices (what ``score_game``
+    emits). Returns False when the native library is unavailable, in which
+    case the caller falls back to :func:`photon_ml_tpu.io.avro.write_avro_file`.
+    """
+    lib = _load()
+    if lib is None:
+        return False
+    from photon_ml_tpu.io.schemas import SCORING_RESULT_AVRO
+
+    schema = json.dumps(SCORING_RESULT_AVRO).encode()
+    scores = np.ascontiguousarray(scores, np.float64)
+    if scores.ndim != 1:
+        raise ValueError(f"scores must be 1-D, got shape {scores.shape}")
+    n = scores.shape[0]
+    labels_ptr = None
+    labels_arr = None
+    if labels is not None:
+        labels_arr = np.ascontiguousarray(labels, np.float64)
+        if labels_arr.shape != (n,):
+            raise ValueError(
+                f"labels must be shape ({n},), got {labels_arr.shape}")
+        labels_ptr = labels_arr.ctypes.data_as(ctypes.c_void_p)
+    uid_bytes = None
+    uid_off_ptr = None
+    uid_off = None
+    if uids is not None:
+        encoded = [u.encode() for u in uids]
+        if len(encoded) != n:
+            raise ValueError("uids length mismatch")
+        uid_off = np.zeros(n + 1, np.int64)
+        np.cumsum([len(b) for b in encoded], out=uid_off[1:])
+        uid_bytes = b"".join(encoded)
+        uid_off_ptr = uid_off.ctypes.data_as(ctypes.c_void_p)
+    wrote = lib.photon_write_scoring_results(
+        path.encode(), schema, len(schema), scores, labels_ptr,
+        uid_bytes, uid_off_ptr, n, block_records)
+    return wrote == n

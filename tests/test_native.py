@@ -119,3 +119,46 @@ class TestSnappyThroughNative:
         open(path, "wb").write(bytes(blob))
         with pytest.raises(ValueError):
             native.decode_training_file(path)
+
+
+class TestNativeScoringWriter:
+    def test_roundtrip_through_python_codec(self, tmp_path):
+        """The native ScoringResultAvro writer's output must read back
+        record-identical through the pure-Python codec (the two sides of
+        the IO path validate each other)."""
+        from photon_ml_tpu import native
+        from photon_ml_tpu.io.avro import iter_avro_file
+
+        if not native.available():
+            pytest.skip("native library unavailable")
+        rng = np.random.default_rng(0)
+        n = 5_000
+        scores = rng.normal(size=n)
+        labels = (rng.uniform(size=n) < 0.5).astype(np.float64)
+        path = str(tmp_path / "scores.avro")
+        assert native.write_scoring_results(path, scores, labels)
+        recs = list(iter_avro_file(path))
+        assert len(recs) == n
+        got_scores = np.array([r["predictionScore"] for r in recs])
+        got_labels = np.array([r["label"] for r in recs])
+        np.testing.assert_array_equal(got_scores, scores)
+        np.testing.assert_array_equal(got_labels, labels)
+        assert [r["uid"] for r in recs[:3]] == ["0", "1", "2"]
+        assert all(r["metadataMap"] is None for r in recs[:10])
+
+    def test_explicit_uids_and_no_labels(self, tmp_path):
+        from photon_ml_tpu import native
+        from photon_ml_tpu.io.avro import iter_avro_file
+
+        if not native.available():
+            pytest.skip("native library unavailable")
+        scores = np.array([1.5, -2.25, 0.0])
+        uids = ["a", "", "longer-uid-🙂"]
+        path = str(tmp_path / "scores.avro")
+        assert native.write_scoring_results(path, scores, uids=uids,
+                                            block_records=2)  # forces 2 blocks
+        recs = list(iter_avro_file(path))
+        assert [r["uid"] for r in recs] == uids
+        assert all(r["label"] is None for r in recs)
+        np.testing.assert_array_equal(
+            np.array([r["predictionScore"] for r in recs]), scores)
