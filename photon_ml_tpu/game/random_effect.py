@@ -293,9 +293,12 @@ class RandomEffectSolver:
 
         def collect(bucket, e_real, w_dev, variances):
             # one D2H of the (entities, local-dim) coefficients — the model
-            # itself — and host table assembly
-            w = np.asarray(w_dev)[:e_real]
-            variances = np.asarray(variances)[:e_real]
+            # itself — then host table assembly (streaming mode only; the
+            # cached-bucket path batches all buckets into a single D2H)
+            collect_host(bucket, np.asarray(w_dev)[:e_real],
+                         np.asarray(variances)[:e_real])
+
+        def collect_host(bucket, w, variances):
             fmask = bucket.feature_index >= 0
             ent = np.broadcast_to(bucket.entity_ids[:, None],
                                   bucket.feature_index.shape)
@@ -350,9 +353,26 @@ class RandomEffectSolver:
             else:
                 pending.append((bucket, e_real, w_dev, variances))
 
-        # Phase 2 — collect (cached-bucket mode)
-        for bucket, e_real, w_dev, variances in pending:
-            collect(bucket, e_real, w_dev, variances)
+        # Phase 2 — collect (cached-bucket mode): every pending bucket's
+        # coefficient (and variance) table rides ONE concatenated
+        # device→host transfer, split on host — per-bucket D2H syncs cost
+        # ~100 ms each through a tunneled device and serialized the tail
+        # of the sweep
+        if pending:
+            flat_w = [w_dev[:e_real].reshape(-1)
+                      for (_b, e_real, w_dev, _v) in pending]
+            flat_v = [jnp.asarray(v)[:e_real].reshape(-1)
+                      for (_b, e_real, _w, v) in pending]
+            w_sizes = [int(a.shape[0]) for a in flat_w]
+            v_sizes = [int(a.shape[0]) for a in flat_v]
+            batched = np.asarray(jnp.concatenate(flat_w + flat_v))
+            bounds = np.cumsum([0] + w_sizes + v_sizes)
+            nb = len(pending)
+            for k, (bucket, e_real, _w, _v) in enumerate(pending):
+                w_np = batched[bounds[k]:bounds[k + 1]].reshape(e_real, -1)
+                v_np = batched[bounds[nb + k]:bounds[nb + k + 1]].reshape(
+                    e_real, -1)
+                collect_host(bucket, w_np, v_np)
 
         keys = (np.concatenate(keys_parts) if keys_parts
                 else np.zeros((0,), np.int64))
