@@ -28,6 +28,10 @@ shapes), mixed precision, and ingest:
 5. ``avro_ingest_rows_per_sec`` — Avro container → columnar GameData
    through the C++ native decoder (reference ``AvroDataReader.scala``);
    ``vs_baseline`` = speedup over the pure-Python codec on the same data.
+6. ``avro_scoring_write_rows_per_sec`` — columnar scores →
+   ``ScoringResultAvro`` through the C++ native writer (reference
+   ``GameScoringDriver.scala`` output); ``vs_baseline`` = speedup over the
+   pure-Python record encoder at the same (null) codec.
 
 NOTE timing sync: on the axon PJRT platform ``jax.block_until_ready`` does
 not block; the reliable barrier is a device→host transfer (``float(x)``).
@@ -481,6 +485,37 @@ def bench_ingest():
     py_rate = INGEST_PY_ROWS / py_s
     _emit("avro_ingest_rows_per_sec", native_rate, "rows/s",
           native_rate / py_rate)
+
+    # scoring OUTPUT: the native columnar writer vs the Python record
+    # encoder (the reference's ScoringResultAvro write path)
+    from photon_ml_tpu import native
+    from photon_ml_tpu.io.avro import write_avro_file
+    from photon_ml_tpu.io.schemas import SCORING_RESULT_AVRO
+
+    if native.available():
+        rng = np.random.default_rng(1)
+        n_w = 400_000
+        scores = rng.normal(size=n_w)
+        labels = (rng.uniform(size=n_w) < 0.5).astype(np.float64)
+        with tempfile.TemporaryDirectory() as tmp:
+            t0 = time.perf_counter()
+            ok = native.write_scoring_results(
+                os.path.join(tmp, "s.avro"), scores, labels)
+            nat_w = n_w / (time.perf_counter() - t0)
+            if not ok:
+                raise RuntimeError("native scoring write failed")
+            n_py = 40_000
+            recs = ({"uid": str(i), "predictionScore": float(scores[i]),
+                     "label": float(labels[i]), "metadataMap": None}
+                    for i in range(n_py))
+            t0 = time.perf_counter()
+            # codec null on BOTH sides: the ratio measures the encoders,
+            # not zlib (the native writer emits uncompressed containers)
+            write_avro_file(os.path.join(tmp, "p.avro"), recs,
+                            SCORING_RESULT_AVRO, codec="null")
+            py_w = n_py / (time.perf_counter() - t0)
+        _emit("avro_scoring_write_rows_per_sec", nat_w, "rows/s",
+              nat_w / py_w)
 
 
 def main(argv=None):
