@@ -473,11 +473,15 @@ class RandomEffectDataset:
     def build(coordinate_id: str, data: GameData,
               config: RandomEffectDatasetConfig,
               projector: Optional[RandomProjector] = None,
+              use_native: Optional[bool] = None,
               ) -> "RandomEffectDataset":
         """``projector`` overrides the seeded Gaussian matrix for the RANDOM
         path — the factored coordinate passes its LEARNED projection here
         (reference ``FactoredRandomEffectCoordinate``'s per-iteration
-        projection update)."""
+        projection update). ``use_native`` pins the bucket packer
+        (``native/bucket_pack.cc`` vs the numpy formulation — identical
+        outputs, see tests/test_native.py::TestNativeBucketPackParity);
+        None auto-picks native when the library loads."""
         shard = data.shards[config.feature_shard_id]
         entities = data.id_columns[config.random_effect_type]
         n = data.n_samples
@@ -487,8 +491,19 @@ class RandomEffectDataset:
         order = np.argsort(entities[present], kind="stable")
         sample_rows = np.flatnonzero(present)[order]  # samples grouped by entity
         ent_sorted = entities[sample_rows]
-        uniq, seg_start, seg_count = np.unique(
-            ent_sorted, return_index=True, return_counts=True)
+        # segment boundaries by linear scan — ent_sorted is already sorted,
+        # np.unique would pay a second O(n log n) sort for nothing
+        if len(ent_sorted):
+            bound = np.empty(len(ent_sorted), bool)
+            bound[0] = True
+            np.not_equal(ent_sorted[1:], ent_sorted[:-1], out=bound[1:])
+            seg_start = np.flatnonzero(bound)
+            uniq = ent_sorted[seg_start]
+            seg_count = np.diff(np.append(seg_start, len(ent_sorted)))
+        else:
+            seg_start = np.zeros(0, np.int64)
+            uniq = np.zeros(0, np.int64)
+            seg_count = np.zeros(0, np.int64)
 
         # --- active/passive split per entity (fully vectorized: no Python
         # loop over entities — this is the path that must survive the
@@ -540,118 +555,196 @@ class RandomEffectDataset:
                 passive_entity_ids=entities[passive],
                 n_entities_total=n_entities_total, projector=projector)
 
-        # --- per-entity local feature maps --------------------------------
-        # For each active entity: observed shard features (optionally pruned
-        # to the top max_active_features by support), compact-indexed.
-        sub = shard.take(all_active)  # CSR over active rows, entity-grouped
-        nnz_ent = np.repeat(ent_of_active, sub.row_counts())  # entity per nnz
-
-        # count support per (entity, feature)
-        pair_keys = nnz_ent * np.int64(shard.dim) + sub.cols.astype(np.int64)
-        uniq_pairs, pair_inv, pair_support = np.unique(
-            pair_keys, return_inverse=True, return_counts=True)
-        pair_ent = uniq_pairs // shard.dim
-        pair_feat = uniq_pairs % shard.dim
-
-        # prune: rank features within entity by (-support, feature id)
-        if config.max_active_features is not None:
-            rank_order = np.lexsort((pair_feat, -pair_support, pair_ent))
-            ranked_ent = pair_ent[rank_order]
-            starts = _group_starts(ranked_ent)
-            rank_within = np.arange(len(ranked_ent)) - np.repeat(
-                starts, np.diff(np.append(starts, len(ranked_ent))))
-            kept_sorted = rank_within < config.max_active_features
-            kept = np.zeros(len(uniq_pairs), bool)
-            kept[rank_order] = kept_sorted
-        else:
-            kept = np.ones(len(uniq_pairs), bool)
-
-        # local index of each kept pair within its entity (order: feature id)
-        local_idx = np.full(len(uniq_pairs), -1, np.int64)
-        kept_ent = pair_ent[kept]
-        starts_k = _group_starts(kept_ent)
-        counts_k = np.diff(np.append(starts_k, len(kept_ent)))
-        local_idx[kept] = np.arange(len(kept_ent)) - np.repeat(starts_k, counts_k)
-        n_feat_per_entity = np.zeros(n_active, np.int64)
-        if len(kept_ent):
-            ent_u, ent_c = np.unique(kept_ent, return_counts=True)
-            n_feat_per_entity[ent_u] = ent_c
-
-        n_samp_per_entity = np.bincount(ent_of_active, minlength=n_active
-                                        ).astype(np.int64)
-        # one active-row index per nnz (loop-invariant over buckets)
-        nnz_rows_local = np.repeat(
-            np.arange(len(all_active)), sub.row_counts())
-
-        # --- bucketing by (padded samples, padded features) ----------------
-        buckets: list[REBucket] = []
-        if n_active:
-            if config.bucket_strategy == "histogram":
-                s_pad = _histogram_pad(n_samp_per_entity,
-                                       config.max_sample_buckets)
-                d_pad = _histogram_pad(n_feat_per_entity,
-                                       config.max_feature_buckets)
-            else:
-                s_pad = _geom_at_least(n_samp_per_entity,
-                                       config.sample_bucket_growth)
-                d_pad = _geom_at_least(n_feat_per_entity,
-                                       config.feature_bucket_growth)
-            bucket_key = s_pad * np.int64(1 << 40) + d_pad
-            # bucket id per entity, gathered ONCE onto pairs/nnz/rows: the
-            # per-bucket membership tests below are then O(len) compares
-            # instead of np.isin's sort-based lookups over the full nnz
-            # array per bucket (measured: the dominant build cost at 10^7
-            # rows — O(buckets × nnz) turned into O(nnz))
-            uniq_keys, bucket_of_entity = np.unique(bucket_key,
-                                                    return_inverse=True)
-            pair_bucket = bucket_of_entity[pair_ent]
-            nnz_bucket = bucket_of_entity[nnz_ent]
-            row_bucket = bucket_of_entity[ent_of_active]
-            nnz_kept = local_idx[pair_inv] >= 0
-            for bi, key in enumerate(uniq_keys):
-                sel = np.flatnonzero(bucket_key == key)
-                S = int(s_pad[sel[0]])
-                D = int(d_pad[sel[0]])
-                E = len(sel)
-                x = np.zeros((E, S, D), np.float32)
-                feature_index = np.full((E, D), -1, np.int64)
-
-                slot_of_entity = np.full(n_active, -1, np.int64)
-                slot_of_entity[sel] = np.arange(E)
-
-                # features
-                sel_pairs = kept & (pair_bucket == bi)
-                pe = slot_of_entity[pair_ent[sel_pairs]]
-                feature_index[pe, local_idx[sel_pairs]] = pair_feat[sel_pairs]
-
-                # samples: rows of these entities, slot position within entity
-                labels, weights, sample_idx, rows_sel, pos, es = \
-                    _bucket_sample_fill(data, all_active, ent_of_active,
-                                        slot_of_entity, sel, S,
-                                        rows_sel=np.flatnonzero(
-                                            row_bucket == bi))
-
-                # nnz values into local dense tensor
-                nnz_sel = (nnz_bucket == bi) & nnz_kept
-                # local sample position for each nnz: position of its active row
-                pos_of_active_row = np.full(len(all_active), -1, np.int64)
-                pos_of_active_row[rows_sel] = pos
-                take = nnz_sel
-                e_nnz = slot_of_entity[nnz_ent[take]]
-                s_nnz = pos_of_active_row[nnz_rows_local[take]]
-                d_nnz = local_idx[pair_inv[take]]
-                np.add.at(x, (e_nnz, s_nnz, d_nnz), sub.vals[take])
-
-                buckets.append(REBucket(
-                    entity_ids=act_entity[sel],
-                    x=x, labels=labels, offsets_zero=True, weights=weights,
-                    sample_idx=sample_idx, feature_index=feature_index))
-
+        # --- bucket pack: native single-pass packer when available --------
+        buckets = _index_map_buckets(data, shard, all_active, ent_of_active,
+                                     act_entity, config, use_native)
         return RandomEffectDataset(
             coordinate_id=coordinate_id, config=config, buckets=buckets,
             passive_sample_idx=passive,
             passive_entity_ids=entities[passive],
             n_entities_total=n_entities_total)
+
+
+def _padded_shapes(n_samp_per_entity: np.ndarray, n_feat_per_entity: np.ndarray,
+                   config: RandomEffectDatasetConfig
+                   ) -> tuple[np.ndarray, np.ndarray]:
+    """Per-entity padded (samples, features) per the configured strategy."""
+    if config.bucket_strategy == "histogram":
+        return (_histogram_pad(n_samp_per_entity, config.max_sample_buckets),
+                _histogram_pad(n_feat_per_entity, config.max_feature_buckets))
+    return (_geom_at_least(n_samp_per_entity, config.sample_bucket_growth),
+            _geom_at_least(n_feat_per_entity, config.feature_bucket_growth))
+
+
+def _index_map_buckets(data: GameData, shard: FeatureShard,
+                       all_active: np.ndarray, ent_of_active: np.ndarray,
+                       act_entity: np.ndarray,
+                       config: RandomEffectDatasetConfig,
+                       use_native: Optional[bool]) -> list[REBucket]:
+    """INDEX_MAP bucket construction, native fast path with numpy fallback.
+
+    Both produce identical buckets (same order, same arrays); the native
+    packer (``native/bucket_pack.cc``) replaces the numpy path's full sorts
+    of the nnz stream with two linear passes — the difference between ~45 s
+    and ~2 s at 10^7 rows (VERDICT r2 "host-side GAME wall")."""
+    n_active = len(act_entity)
+    if not n_active:
+        return []
+    if use_native is None or use_native:
+        from photon_ml_tpu import native
+
+        if native.available():
+            bks = _index_map_buckets_native(
+                data, shard, all_active, ent_of_active, act_entity, config)
+            if bks is not None:
+                return bks
+        if use_native:
+            raise RuntimeError("native bucket packer requested but the "
+                               "native library is unavailable")
+    return _index_map_buckets_numpy(
+        data, shard, all_active, ent_of_active, act_entity, config)
+
+
+def _index_map_buckets_native(data, shard, all_active, ent_of_active,
+                              act_entity, config):
+    from photon_ml_tpu import native
+
+    n_active = len(act_entity)
+    n_samp_per_entity = np.bincount(ent_of_active, minlength=n_active
+                                    ).astype(np.int64)
+    ent_starts = np.zeros(n_active + 1, np.int64)
+    np.cumsum(n_samp_per_entity, out=ent_starts[1:])
+    # dtype/contiguity contract lives in the native wrappers' ndpointer
+    # argtypes; FeatureShard/GameData already store these exact dtypes
+    indptr, cols, vals = shard.indptr, shard.cols, shard.vals
+    aa = np.ascontiguousarray(all_active, np.int64)
+    scratch = native.BucketPackScratch(shard.dim)
+    n_feat_per_entity = native.re_feature_counts(
+        indptr, cols, aa, ent_starts, shard.dim, config.max_active_features,
+        scratch)
+    if n_feat_per_entity is None:
+        return None
+    s_pad, d_pad = _padded_shapes(n_samp_per_entity, n_feat_per_entity, config)
+    bucket_key = s_pad * np.int64(1 << 40) + d_pad
+    labels32, weights32 = data.labels, data.weights
+    buckets: list[REBucket] = []
+    for key in np.unique(bucket_key):
+        sel = np.flatnonzero(bucket_key == key)
+        packed = native.re_bucket_fill(
+            indptr, cols, vals, aa, ent_starts, labels32, weights32, sel,
+            int(s_pad[sel[0]]), int(d_pad[sel[0]]), shard.dim,
+            config.max_active_features, scratch)
+        if packed is None:
+            return None
+        x, labels, weights, sample_idx, feature_index = packed
+        buckets.append(REBucket(
+            entity_ids=act_entity[sel], x=x, labels=labels,
+            offsets_zero=True, weights=weights, sample_idx=sample_idx,
+            feature_index=feature_index))
+    return buckets
+
+
+def _index_map_buckets_numpy(data, shard, all_active, ent_of_active,
+                             act_entity, config):
+    n_active = len(act_entity)
+    # --- per-entity local feature maps --------------------------------
+    # For each active entity: observed shard features (optionally pruned
+    # to the top max_active_features by support), compact-indexed.
+    sub = shard.take(all_active)  # CSR over active rows, entity-grouped
+    nnz_ent = np.repeat(ent_of_active, sub.row_counts())  # entity per nnz
+
+    # count support per (entity, feature)
+    pair_keys = nnz_ent * np.int64(shard.dim) + sub.cols.astype(np.int64)
+    uniq_pairs, pair_inv, pair_support = np.unique(
+        pair_keys, return_inverse=True, return_counts=True)
+    pair_ent = uniq_pairs // shard.dim
+    pair_feat = uniq_pairs % shard.dim
+
+    # prune: rank features within entity by (-support, feature id)
+    if config.max_active_features is not None:
+        rank_order = np.lexsort((pair_feat, -pair_support, pair_ent))
+        ranked_ent = pair_ent[rank_order]
+        starts = _group_starts(ranked_ent)
+        rank_within = np.arange(len(ranked_ent)) - np.repeat(
+            starts, np.diff(np.append(starts, len(ranked_ent))))
+        kept_sorted = rank_within < config.max_active_features
+        kept = np.zeros(len(uniq_pairs), bool)
+        kept[rank_order] = kept_sorted
+    else:
+        kept = np.ones(len(uniq_pairs), bool)
+
+    # local index of each kept pair within its entity (order: feature id)
+    local_idx = np.full(len(uniq_pairs), -1, np.int64)
+    kept_ent = pair_ent[kept]
+    starts_k = _group_starts(kept_ent)
+    counts_k = np.diff(np.append(starts_k, len(kept_ent)))
+    local_idx[kept] = np.arange(len(kept_ent)) - np.repeat(starts_k, counts_k)
+    n_feat_per_entity = np.zeros(n_active, np.int64)
+    if len(kept_ent):
+        ent_u, ent_c = np.unique(kept_ent, return_counts=True)
+        n_feat_per_entity[ent_u] = ent_c
+
+    n_samp_per_entity = np.bincount(ent_of_active, minlength=n_active
+                                    ).astype(np.int64)
+    # one active-row index per nnz (loop-invariant over buckets)
+    nnz_rows_local = np.repeat(
+        np.arange(len(all_active)), sub.row_counts())
+
+    # --- bucketing by (padded samples, padded features) ----------------
+    buckets: list[REBucket] = []
+    s_pad, d_pad = _padded_shapes(n_samp_per_entity, n_feat_per_entity, config)
+    bucket_key = s_pad * np.int64(1 << 40) + d_pad
+    # bucket id per entity, gathered ONCE onto pairs/nnz/rows: the
+    # per-bucket membership tests below are then O(len) compares
+    # instead of np.isin's sort-based lookups over the full nnz
+    # array per bucket (measured: the dominant build cost at 10^7
+    # rows — O(buckets × nnz) turned into O(nnz))
+    uniq_keys, bucket_of_entity = np.unique(bucket_key,
+                                            return_inverse=True)
+    pair_bucket = bucket_of_entity[pair_ent]
+    nnz_bucket = bucket_of_entity[nnz_ent]
+    row_bucket = bucket_of_entity[ent_of_active]
+    nnz_kept = local_idx[pair_inv] >= 0
+    for bi, key in enumerate(uniq_keys):
+        sel = np.flatnonzero(bucket_key == key)
+        S = int(s_pad[sel[0]])
+        D = int(d_pad[sel[0]])
+        E = len(sel)
+        x = np.zeros((E, S, D), np.float32)
+        feature_index = np.full((E, D), -1, np.int64)
+
+        slot_of_entity = np.full(n_active, -1, np.int64)
+        slot_of_entity[sel] = np.arange(E)
+
+        # features
+        sel_pairs = kept & (pair_bucket == bi)
+        pe = slot_of_entity[pair_ent[sel_pairs]]
+        feature_index[pe, local_idx[sel_pairs]] = pair_feat[sel_pairs]
+
+        # samples: rows of these entities, slot position within entity
+        labels, weights, sample_idx, rows_sel, pos, es = \
+            _bucket_sample_fill(data, all_active, ent_of_active,
+                                slot_of_entity, sel, S,
+                                rows_sel=np.flatnonzero(
+                                    row_bucket == bi))
+
+        # nnz values into local dense tensor
+        nnz_sel = (nnz_bucket == bi) & nnz_kept
+        # local sample position for each nnz: position of its active row
+        pos_of_active_row = np.full(len(all_active), -1, np.int64)
+        pos_of_active_row[rows_sel] = pos
+        take = nnz_sel
+        e_nnz = slot_of_entity[nnz_ent[take]]
+        s_nnz = pos_of_active_row[nnz_rows_local[take]]
+        d_nnz = local_idx[pair_inv[take]]
+        np.add.at(x, (e_nnz, s_nnz, d_nnz), sub.vals[take])
+
+        buckets.append(REBucket(
+            entity_ids=act_entity[sel],
+            x=x, labels=labels, offsets_zero=True, weights=weights,
+            sample_idx=sample_idx, feature_index=feature_index))
+
+    return buckets
 
 
 def _bucket_sample_fill(

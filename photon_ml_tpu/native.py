@@ -27,6 +27,7 @@ from photon_ml_tpu.io import avro as avro_mod
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _SRC = os.path.join(_REPO_ROOT, "native", "avro_reader.cc")
 _SRC_WRITER = os.path.join(_REPO_ROOT, "native", "avro_writer.cc")
+_SRC_BUCKET = os.path.join(_REPO_ROOT, "native", "bucket_pack.cc")
 _BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
 _LIB = os.path.join(_BUILD_DIR, "libphoton_native.so")
 
@@ -41,7 +42,7 @@ _FIELDS = ("uid", "response", "offset", "weight", "features", "metadataMap")
 def _build() -> bool:
     os.makedirs(_BUILD_DIR, exist_ok=True)
     cmd = ["g++", "-std=c++17", "-O2", "-shared", "-fPIC", "-o", _LIB,
-           _SRC, _SRC_WRITER, "-lz"]
+           _SRC, _SRC_WRITER, _SRC_BUCKET, "-lz"]
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
     except (OSError, subprocess.TimeoutExpired):
@@ -56,7 +57,8 @@ def _load() -> Optional[ctypes.CDLL]:
             return _lib
         try:
             src_mtime = max(os.path.getmtime(_SRC),
-                            os.path.getmtime(_SRC_WRITER))
+                            os.path.getmtime(_SRC_WRITER),
+                            os.path.getmtime(_SRC_BUCKET))
         except OSError:
             # sources absent (installed wheel without the native tree):
             # unbuildable → degrade to the Python fallback, never raise
@@ -107,6 +109,20 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_char_p,
             np.ctypeslib.ndpointer(dtype=np.int64, flags="C_CONTIGUOUS")]
         lib.photon_result_free.argtypes = [ctypes.c_void_p]
+        _i64p = np.ctypeslib.ndpointer(dtype=np.int64, flags="C_CONTIGUOUS")
+        _i32p = np.ctypeslib.ndpointer(dtype=np.int32, flags="C_CONTIGUOUS")
+        _f32p = np.ctypeslib.ndpointer(dtype=np.float32, flags="C_CONTIGUOUS")
+        lib.photon_re_feature_counts.restype = None
+        lib.photon_re_feature_counts.argtypes = [
+            _i64p, _i32p, _i64p, _i64p,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            _i64p, _i64p, _i64p]
+        lib.photon_re_bucket_fill.restype = None
+        lib.photon_re_bucket_fill.argtypes = [
+            _i64p, _i32p, _f32p, _i64p, _i64p, _f32p, _f32p, _i64p,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, _i64p, _i64p, _i64p, _i64p,
+            _f32p, _f32p, _f32p, _i64p, _i64p]
         lib.photon_write_scoring_results.restype = ctypes.c_int64
         lib.photon_write_scoring_results.argtypes = [
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64,
@@ -361,3 +377,66 @@ def write_scoring_results(path: str, scores: np.ndarray,
         path.encode(), schema, len(schema), scores, labels_ptr,
         uid_bytes, uid_off_ptr, n, block_records)
     return wrote == n
+
+
+class BucketPackScratch:
+    """Shared dim-sized scratch for one dataset build's packer calls.
+
+    The stamp arrays are -1-initialized once here and shared across every
+    pass-A/pass-B call of a single build (the C side stamps with dense
+    entity ids, which never repeat across calls — see bucket_pack.cc's
+    scratch contract). Pass A and pass B need DISTINCT stamp arrays."""
+
+    def __init__(self, dim: int):
+        self.stamp_a = np.full(dim, -1, np.int64)
+        self.stamp_b = np.full(dim, -1, np.int64)
+        self.kept_stamp = np.full(dim, -1, np.int64)
+        self.support = np.empty(dim, np.int64)
+        self.local = np.empty(dim, np.int64)
+
+
+def re_feature_counts(indptr: np.ndarray, cols: np.ndarray,
+                      all_active: np.ndarray, ent_starts: np.ndarray,
+                      dim: int, max_active_features: Optional[int],
+                      scratch: BucketPackScratch) -> Optional[np.ndarray]:
+    """Per-entity distinct-feature counts (post-pruning) over entity-grouped
+    active rows — pass A of the native bucket packer
+    (``native/bucket_pack.cc``). None when the library is unavailable; the
+    caller falls back to the numpy formulation. Arrays must be C-contiguous
+    with the documented dtypes (ctypes ndpointer enforces this)."""
+    lib = _load()
+    if lib is None:
+        return None
+    n_entities = len(ent_starts) - 1
+    out = np.empty(n_entities, np.int64)
+    lib.photon_re_feature_counts(
+        indptr, cols, all_active, ent_starts, n_entities, int(dim),
+        -1 if max_active_features is None else int(max_active_features),
+        scratch.stamp_a, scratch.support, out)
+    return out
+
+
+def re_bucket_fill(indptr, cols, vals, all_active, ent_starts,
+                   labels_all, weights_all, sel, S: int, D: int,
+                   dim: int, max_active_features: Optional[int],
+                   scratch: BucketPackScratch):
+    """Pack one bucket's (E, S, D) tensors — pass B of the native bucket
+    packer. Returns ``(x, labels, weights, sample_idx, feature_index)``
+    matching the numpy path exactly, or None when unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    sel = np.ascontiguousarray(sel, np.int64)
+    e = len(sel)
+    x = np.zeros((e, S, D), np.float32)
+    labels = np.zeros((e, S), np.float32)
+    weights = np.zeros((e, S), np.float32)
+    sample_idx = np.full((e, S), -1, np.int64)
+    feature_index = np.full((e, D), -1, np.int64)
+    lib.photon_re_bucket_fill(
+        indptr, cols, vals, all_active, ent_starts, labels_all, weights_all,
+        sel, e, int(S), int(D), int(dim),
+        -1 if max_active_features is None else int(max_active_features),
+        scratch.stamp_b, scratch.support, scratch.kept_stamp, scratch.local,
+        x, labels, weights, sample_idx, feature_index)
+    return x, labels, weights, sample_idx, feature_index

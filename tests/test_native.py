@@ -162,3 +162,63 @@ class TestNativeScoringWriter:
         assert all(r["label"] is None for r in recs)
         np.testing.assert_array_equal(
             np.array([r["predictionScore"] for r in recs]), scores)
+
+
+class TestNativeBucketPackParity:
+    """native/bucket_pack.cc must reproduce the numpy bucket pack exactly
+    (photon_ml_tpu/game/data.py::_index_map_buckets_{native,numpy})."""
+
+    @staticmethod
+    def _messy_game_data(seed=0, n=600, n_entities=40, dim=37):
+        """Sparse rows with varying nnz, DUPLICATE (row, col) entries,
+        empty rows, missing entity ids, and weighted samples."""
+        from photon_ml_tpu.game.data import FeatureShard, GameData
+
+        rng = np.random.default_rng(seed)
+        rows, cols, vals = [], [], []
+        for r in range(n):
+            k = int(rng.integers(0, 9))  # 0 => empty row
+            rr = rng.integers(0, dim, size=k)  # duplicates possible
+            rows.extend([r] * k)
+            cols.extend(rr.tolist())
+            vals.extend(rng.normal(size=k).tolist())
+        shard = FeatureShard.from_coo(
+            np.array(rows, np.int64), np.array(cols, np.int32),
+            np.array(vals, np.float32), n_samples=n, dim=dim)
+        ent = rng.integers(-1, n_entities, size=n).astype(np.int64)
+        return GameData.build(
+            labels=(rng.uniform(size=n) < 0.5).astype(np.float32),
+            shards={"re": shard},
+            weights=rng.uniform(0.5, 2.0, size=n).astype(np.float32),
+            id_columns={"entityId": ent})
+
+    @pytest.mark.parametrize("cfg_kwargs", [
+        {},
+        {"bucket_strategy": "histogram", "max_sample_buckets": 3,
+         "max_feature_buckets": 2},
+        {"max_active_features": 4},
+        {"active_data_lower_bound": 5, "active_data_upper_bound": 12},
+        {"max_active_features": 3, "bucket_strategy": "histogram"},
+    ])
+    def test_native_matches_numpy(self, cfg_kwargs):
+        from photon_ml_tpu.game.data import (
+            RandomEffectDataset,
+            RandomEffectDatasetConfig,
+        )
+
+        data = self._messy_game_data()
+        cfg = RandomEffectDatasetConfig("entityId", "re", **cfg_kwargs)
+        fast = RandomEffectDataset.build("re", data, cfg, use_native=True)
+        slow = RandomEffectDataset.build("re", data, cfg, use_native=False)
+        np.testing.assert_array_equal(fast.passive_sample_idx,
+                                      slow.passive_sample_idx)
+        assert len(fast.buckets) == len(slow.buckets)
+        for bf, bs in zip(fast.buckets, slow.buckets):
+            np.testing.assert_array_equal(bf.entity_ids, bs.entity_ids)
+            np.testing.assert_array_equal(bf.feature_index, bs.feature_index)
+            np.testing.assert_array_equal(bf.sample_idx, bs.sample_idx)
+            np.testing.assert_array_equal(bf.labels, bs.labels)
+            np.testing.assert_array_equal(bf.weights, bs.weights)
+            # duplicate (row, col) entries accumulate in both paths; order
+            # of accumulation may differ => allclose, not equal
+            np.testing.assert_allclose(bf.x, bs.x, rtol=1e-6, atol=1e-6)
