@@ -32,9 +32,27 @@ shapes), mixed precision, and ingest:
    ``ScoringResultAvro`` through the C++ native writer (reference
    ``GameScoringDriver.scala`` output); ``vs_baseline`` = speedup over the
    pure-Python record encoder at the same (null) codec.
+7. ``game_end_to_end_rows_per_sec`` — the full GAME training driver on a
+   music-shaped Avro file: ingest → index maps → bucket build → CD sweeps →
+   model + metadata written (reference ``GameTrainingDriver.scala`` "Read
+   data"→"Save models" wall — the number the north-star 200-executor-Spark
+   comparison is actually about); ``vs_baseline`` = speedup over a composite
+   of the SAME run's measured host rates (pure-Python ingest + host
+   numpy/scipy CD sweep), i.e. 1/rate_e2e vs 1/rate_py_ingest +
+   1/rate_host_cd — each component measured in this process, composition
+   documented inline.
 
 NOTE timing sync: on the axon PJRT platform ``jax.block_until_ready`` does
 not block; the reliable barrier is a device→host transfer (``float(x)``).
+
+NOTE compile budget: a fresh process pays ~10–40 s per XLA compile through
+the axon remote-compile tunnel, across ~20 distinct shapes in this suite —
+that (plus the since-fixed 45 s host bucket build) is what timed out the
+round-2 harness run (BENCH_r02.json rc=124). main() therefore enables JAX's
+persistent compilation cache (measured here: 66 s cold → 1.6 s warm for a
+fresh process) keyed to the repo checkout, and the big Avro fixtures are
+content-cached under the system temp dir so reruns skip the pure-Python
+encode.
 """
 
 from __future__ import annotations
@@ -71,11 +89,65 @@ CD_HOST_ROWS = 50_000  # host-baseline slice (scaled proportionally)
 INGEST_ROWS = 120_000
 INGEST_PY_ROWS = 12_000  # pure-Python codec rows (30x slower; scaled)
 
+# end-to-end driver shape (music-like, sized so the timed section is the
+# pipeline, not the synthetic-file prep)
+E2E_ROWS = 200_000
+E2E_USERS = 8_000
+E2E_SONGS = 3_000
+
+
+def _setup_compile_cache():
+    import jax
+
+    cache_dir = os.environ.get(
+        "PHOTON_BENCH_COMPILE_CACHE",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_cache"))
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+
+def _cached_fixture(name: str, fn, *args) -> str:
+    """Deterministic Avro fixtures cached across bench runs (the pure-Python
+    encode of a 1e5-row file costs ~10 s — prep, not measurement).
+
+    ``fn(path, *args)`` generates the file. The cache key folds in ``args``
+    and ``fn``'s own bytecode, so editing the generator or its parameters
+    invalidates the cached file instead of silently benchmarking stale
+    data. Per-user temp name + unique staging file avoid cross-user
+    collisions and concurrent-run races in the shared temp dir."""
+    import hashlib
+
+    tag = hashlib.sha1(repr(args).encode() + b"|"
+                       + fn.__code__.co_code).hexdigest()[:10]
+    path = os.path.join(
+        tempfile.gettempdir(),
+        f"photon_bench_{os.getuid()}_{name}_{tag}.avro")
+    if not os.path.exists(path):
+        fd, tmp = tempfile.mkstemp(dir=tempfile.gettempdir(),
+                                   suffix=".avro.tmp")
+        os.close(fd)
+        try:
+            fn(tmp, *args)
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+    return path
+
+
+_T0 = time.perf_counter()
+
 
 def _emit(metric: str, value: float, unit: str, vs_baseline: float, **extra):
     line = {"metric": metric, "value": round(value, 1), "unit": unit,
             "vs_baseline": round(vs_baseline, 3)}
     line.update(extra)
+    # suite-elapsed stamp: makes the per-bench budget visible in the
+    # artifact (the round-2 harness run timed out with 3/6 metrics and no
+    # way to see where the time went)
+    line["t_s"] = round(time.perf_counter() - _T0, 1)
     print(json.dumps(line), flush=True)
 
 
@@ -435,6 +507,7 @@ def bench_cd_sweep():
     _emit("game_cd_sweep_samples_per_sec", tpu_rate, "samples/s",
           tpu_rate / host_rate, n_rows=int(CD_ROWS),
           n_entities=int(CD_USERS + CD_SONGS), sweep_wall_s=round(tpu_s, 2))
+    return host_rate
 
 
 # --------------------------------------------------------------------------
@@ -463,28 +536,26 @@ def bench_ingest():
     from photon_ml_tpu.io.data_reader import AvroDataReader
 
     shard_cfg = (parse_feature_shard_config("f=f|intercept"),)
-    with tempfile.TemporaryDirectory() as tmp:
-        big = _write_ingest_file(os.path.join(tmp, "big.avro"), INGEST_ROWS)
-        reader = AvroDataReader(shard_configs=shard_cfg)
-        reader.read(big, id_columns=["userId"])  # warm (index build etc.)
-        t0 = time.perf_counter()
-        reader_n = AvroDataReader(shard_configs=shard_cfg)
-        data, _, _ = reader_n.read(big, id_columns=["userId"])
-        native_s = time.perf_counter() - t0
-        assert data.n_samples == INGEST_ROWS
+    big = _cached_fixture("ingest", _write_ingest_file, INGEST_ROWS)
+    small = _cached_fixture("ingest", _write_ingest_file, INGEST_PY_ROWS)
+    reader = AvroDataReader(shard_configs=shard_cfg)
+    reader.read(big, id_columns=["userId"])  # warm (index build etc.)
+    t0 = time.perf_counter()
+    reader_n = AvroDataReader(shard_configs=shard_cfg)
+    data, _, _ = reader_n.read(big, id_columns=["userId"])
+    native_s = time.perf_counter() - t0
+    assert data.n_samples == INGEST_ROWS
 
-        small = _write_ingest_file(os.path.join(tmp, "small.avro"),
-                                   INGEST_PY_ROWS)
-        t0 = time.perf_counter()
-        reader_p = AvroDataReader(shard_configs=shard_cfg, use_native=False)
-        pdata, _, _ = reader_p.read(small, id_columns=["userId"])
-        py_s = time.perf_counter() - t0
-        assert pdata.n_samples == INGEST_PY_ROWS
+    t0 = time.perf_counter()
+    reader_p = AvroDataReader(shard_configs=shard_cfg, use_native=False)
+    pdata, _, _ = reader_p.read(small, id_columns=["userId"])
+    py_s = time.perf_counter() - t0
+    assert pdata.n_samples == INGEST_PY_ROWS
 
     native_rate = INGEST_ROWS / native_s
-    py_rate = INGEST_PY_ROWS / py_s
+    py_ingest_rate = INGEST_PY_ROWS / py_s
     _emit("avro_ingest_rows_per_sec", native_rate, "rows/s",
-          native_rate / py_rate)
+          native_rate / py_ingest_rate)
 
     # scoring OUTPUT: the native columnar writer vs the Python record
     # encoder (the reference's ScoringResultAvro write path)
@@ -516,22 +587,133 @@ def bench_ingest():
             py_w = n_py / (time.perf_counter() - t0)
         _emit("avro_scoring_write_rows_per_sec", nat_w, "rows/s",
               nat_w / py_w)
+    return py_ingest_rate
+
+
+# --------------------------------------------------------------------------
+# 7. end-to-end GAME training driver (Avro in -> model written)
+# --------------------------------------------------------------------------
+
+def _write_e2e_file(path, n=E2E_ROWS, users=E2E_USERS, songs=E2E_SONGS):
+    """Music-shaped TrainingExampleAvro: a global bag (6 of 32 features),
+    an item bag (4 of 8), user+song ids, labels planted from user/song
+    factors so the CD sweep has real structure to recover."""
+    from photon_ml_tpu.io.data_reader import write_training_examples
+
+    rng = np.random.default_rng(99)
+    d_fixed, d_item = 32, 8
+    w_fixed = rng.normal(size=d_fixed)
+    uu = rng.normal(size=(users, d_item))
+    us = 0.7 * rng.normal(size=(songs, d_item))
+    pu = 1.0 / np.arange(1, users + 1); pu /= pu.sum()
+    ps = 1.0 / np.arange(1, songs + 1); ps /= ps.sum()
+    user = rng.choice(users, size=n, p=pu)
+    song = rng.choice(songs, size=n, p=ps)
+
+    def records():
+        for i in range(n):
+            fi = rng.choice(d_fixed, size=6, replace=False)
+            fv = rng.normal(size=6)
+            ii = rng.choice(d_item, size=4, replace=False)
+            iv = rng.normal(size=4)
+            margin = (fv @ w_fixed[fi] / np.sqrt(6)
+                      + iv @ uu[user[i]][ii] + iv @ us[song[i]][ii])
+            label = float(rng.uniform() < 1.0 / (1.0 + np.exp(-margin)))
+            feats = ([{"name": f"g.x{j}", "term": "", "value": float(v)}
+                      for j, v in zip(fi, fv)]
+                     + [{"name": f"it.x{j}", "term": "", "value": float(v)}
+                        for j, v in zip(ii, iv)])
+            yield {"uid": str(i), "response": label, "offset": None,
+                   "weight": None, "features": feats,
+                   "metadataMap": {"userId": f"u{user[i]}",
+                                   "songId": f"s{song[i]}"}}
+
+    write_training_examples(path, records())
+
+
+def bench_end_to_end(host_cd_rate=None, py_ingest_rate=None):
+    """The whole driver, timed from Avro open to model-on-disk — the
+    reference's "Read data"→"Save models" wall (GameTrainingDriver.scala).
+
+    Baseline composition: a reference-style host pipeline pays (at least)
+    the pure-Python ingest PLUS the host CD sweep, both measured in this
+    same process on this same machine; serial composition of rates is the
+    lower bound on its wall (write/model-IO excluded — favors the
+    baseline). When called standalone (--only e2e) the components are
+    measured here first at reduced sizes."""
+    from photon_ml_tpu.cli import train_game as train_game_cli
+
+    train = _cached_fixture("e2e", _write_e2e_file, E2E_ROWS, E2E_USERS,
+                            E2E_SONGS)
+    if host_cd_rate is None or py_ingest_rate is None:
+        # standalone mode: measure the components on documented slices
+        from photon_ml_tpu.cli.config import parse_feature_shard_config
+        from photon_ml_tpu.io.data_reader import AvroDataReader
+
+        small = _cached_fixture("ingest", _write_ingest_file,
+                                INGEST_PY_ROWS)
+        t0 = time.perf_counter()
+        AvroDataReader(
+            shard_configs=(parse_feature_shard_config("f=f|intercept"),),
+            use_native=False).read(small, id_columns=["userId"])
+        py_ingest_rate = INGEST_PY_ROWS / (time.perf_counter() - t0)
+        frac = CD_HOST_ROWS / CD_ROWS
+        _, (hxf, hxi, hu, hs, hy) = _make_cd_problem(
+            CD_HOST_ROWS, max(int(CD_USERS * frac), 1),
+            max(int(CD_SONGS * frac), 1), seed=1)
+        t0 = time.perf_counter()
+        _host_cd_sweep(hxf, hxi, hu, hs, hy, 1e-3, 1.0)
+        host_cd_rate = CD_HOST_ROWS / (time.perf_counter() - t0)
+
+    args = [
+        "--training-data", train,
+        "--feature-shards", "global=g|intercept,item=it|noIntercept",
+        "--coordinates",
+        "global=fixed,shard=global,reg=L2,maxIter=25",
+        ("perUser=random,entity=userId,shard=item,reg=L2,maxIter=25,"
+         "buckets=histogram,maxSampleBuckets=4"),
+        ("perSong=random,entity=songId,shard=item,reg=L2,maxIter=25,"
+         "buckets=histogram,maxSampleBuckets=4"),
+        "--update-sequence", "global,perUser,perSong",
+        "--cd-iterations", "1",
+        "--grid", "global=0.001", "perUser=1", "perSong=1",
+        "--data-validation", "VALIDATE_DISABLED",
+    ]
+    with tempfile.TemporaryDirectory() as tmp:
+        train_game_cli.run(args + ["--output-dir", os.path.join(tmp, "w")])
+        t0 = time.perf_counter()  # second run: warm jit, cold data path
+        out = os.path.join(tmp, "out")
+        result = train_game_cli.run(args + ["--output-dir", out])
+        wall = time.perf_counter() - t0
+        assert os.path.exists(os.path.join(out, "best", "model-metadata.json"))
+    del result  # model artifacts asserted above; no validation pass here
+    e2e_rate = E2E_ROWS / wall
+    base_rate = 1.0 / (1.0 / py_ingest_rate + 1.0 / host_cd_rate)
+    _emit("game_end_to_end_rows_per_sec", e2e_rate, "rows/s",
+          e2e_rate / base_rate, n_rows=int(E2E_ROWS),
+          wall_s=round(wall, 2))
 
 
 def main(argv=None):
     import argparse
 
     p = argparse.ArgumentParser()
-    p.add_argument("--only", choices=["glm", "re", "cd", "ingest"],
+    p.add_argument("--only",
+                   choices=["glm", "re", "cd", "ingest", "e2e"],
                    help="run a single benchmark instead of the full suite")
     args = p.parse_args(argv)
-    benches = {"glm": bench_glm, "re": bench_random_effect,
-               "cd": bench_cd_sweep, "ingest": bench_ingest}
+    _setup_compile_cache()
     if args.only:
-        benches[args.only]()
+        {"glm": bench_glm, "re": bench_random_effect,
+         "cd": bench_cd_sweep, "ingest": bench_ingest,
+         "e2e": bench_end_to_end}[args.only]()
         return
-    for fn in benches.values():
-        fn()
+    bench_glm()
+    bench_random_effect()
+    host_cd_rate = bench_cd_sweep()
+    py_ingest_rate = bench_ingest()
+    bench_end_to_end(host_cd_rate=host_cd_rate,
+                     py_ingest_rate=py_ingest_rate)
 
 
 if __name__ == "__main__":

@@ -1,20 +1,24 @@
-// Native Avro scoring-output writer: flat numpy columns ->
-// ScoringResultAvro container file, exposed through a C ABI consumed via
-// ctypes (photon_ml_tpu/native.py).
+// Native Avro output writers: flat numpy columns -> Avro container files,
+// exposed through a C ABI consumed via ctypes (photon_ml_tpu/native.py).
 //
-// Role: the output half of the native IO path.  The reference writes
-// ScoringResultAvro across Spark executors
-// (photon-client/.../cli/game/scoring/GameScoringDriver.scala); here one
-// host drains the device's score vector, and the pure-Python record
-// encoder (~100k records/s) becomes the wall on 10^7+-row batch scoring.
-// This writer emits the exact SCORING_RESULT_AVRO shape
-// (photon_ml_tpu/io/schemas.py) from columnar buffers.
+// Role: the output half of the native IO path.  Two writers:
 //
-// Scope: uid (union null|string; generated decimal indices when the caller
-// passes no uid buffer), predictionScore double, label union null|double,
-// metadataMap always null.  Codec: null (uncompressed) — scoring output is
-// typically consumed immediately; callers wanting compression use the
-// Python writer.
+//   photon_write_scoring_results — ScoringResultAvro (the reference writes
+//   these across Spark executors,
+//   photon-client/.../cli/game/scoring/GameScoringDriver.scala); here one
+//   host drains the device's score vector, and the pure-Python record
+//   encoder (~100k records/s) becomes the wall on 10^7+-row batch scoring.
+//
+//   photon_write_re_models — per-entity BayesianLinearModelAvro records
+//   (the reference's random-effect model part-files,
+//   photon-client/.../data/avro/ModelProcessingUtils.scala); a GAME save
+//   writes one record per entity and the Python encoder made "Save models"
+//   cost ~4 s for 11k entities — measured as the single largest stage of a
+//   warm end-to-end driver run.
+//
+// Both emit the exact schemas of photon_ml_tpu/io/schemas.py from columnar
+// buffers.  Codec: null (uncompressed) — callers wanting compression use
+// the Python writer.
 //
 // Build: compiled into libphoton_native.so next to avro_reader.cc
 // (photon_ml_tpu/native.py).
@@ -22,6 +26,7 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <random>
 #include <string>
 #include <vector>
 
@@ -53,6 +58,45 @@ void append_bytes(std::vector<uint8_t>& out, const char* s, size_t len) {
              reinterpret_cast<const uint8_t*>(s) + len);
 }
 
+// Random 16-byte sync marker, as the Avro spec requires (split readers
+// locate block boundaries by scanning for these bytes — a fixed marker
+// could collide with record payload and mis-split the file).
+void fill_sync(uint8_t sync[16]) {
+  std::random_device rd;
+  for (int i = 0; i < 16; i += 4) {
+    uint32_t w = rd();
+    std::memcpy(sync + i, &w, 4);
+  }
+}
+
+// Container header: magic, {avro.schema, avro.codec=null} metadata, sync.
+bool write_header(std::FILE* f, const char* schema_json, int64_t schema_len,
+                  const uint8_t sync[16]) {
+  std::vector<uint8_t> buf;
+  buf.reserve(1 << 16);
+  const uint8_t magic[4] = {'O', 'b', 'j', 1};
+  buf.insert(buf.end(), magic, magic + 4);
+  append_long(buf, 2);  // metadata map: one block of 2 entries
+  append_bytes(buf, "avro.schema", 11);
+  append_bytes(buf, schema_json, static_cast<size_t>(schema_len));
+  append_bytes(buf, "avro.codec", 10);
+  append_bytes(buf, "null", 4);
+  append_long(buf, 0);  // end of map
+  buf.insert(buf.end(), sync, sync + 16);
+  return std::fwrite(buf.data(), 1, buf.size(), f) == buf.size();
+}
+
+// One null-codec block: count, byte length, payload, sync.
+bool write_block(std::FILE* f, int64_t count,
+                 const std::vector<uint8_t>& block, const uint8_t sync[16]) {
+  std::vector<uint8_t> head;
+  append_long(head, count);
+  append_long(head, static_cast<int64_t>(block.size()));
+  return std::fwrite(head.data(), 1, head.size(), f) == head.size() &&
+         std::fwrite(block.data(), 1, block.size(), f) == block.size() &&
+         std::fwrite(sync, 1, 16, f) == 16;
+}
+
 }  // namespace
 
 extern "C" {
@@ -77,23 +121,9 @@ int64_t photon_write_scoring_results(const char* path,
                                      int64_t block_records) {
   std::FILE* f = std::fopen(path, "wb");
   if (!f) return -1;
-  // deterministic sync marker (the spec wants 16 bytes, not entropy)
-  static const uint8_t sync[16] = {'p', 'h', 'o', 't', 'o', 'n', '-', 't',
-                                   'p', 'u', '-', 's', 'c', 'o', 'r', 'e'};
-
-  std::vector<uint8_t> buf;
-  buf.reserve(1 << 16);
-  // header: magic, metadata map {avro.schema, avro.codec}, sync
-  const uint8_t magic[4] = {'O', 'b', 'j', 1};
-  buf.insert(buf.end(), magic, magic + 4);
-  append_long(buf, 2);  // metadata map: one block of 2 entries
-  append_bytes(buf, "avro.schema", 11);
-  append_bytes(buf, schema_json, static_cast<size_t>(schema_len));
-  append_bytes(buf, "avro.codec", 10);
-  append_bytes(buf, "null", 4);
-  append_long(buf, 0);  // end of map
-  buf.insert(buf.end(), sync, sync + 16);
-  if (std::fwrite(buf.data(), 1, buf.size(), f) != buf.size()) {
+  uint8_t sync[16];
+  fill_sync(sync);
+  if (!write_header(f, schema_json, schema_len, sync)) {
     std::fclose(f);
     return -1;
   }
@@ -124,19 +154,94 @@ int64_t photon_write_scoring_results(const char* path,
       }
       append_long(block, 0);  // metadataMap union: null
     }
-    buf.clear();
-    append_long(buf, count);
-    append_long(buf, static_cast<int64_t>(block.size()));
-    bool ok = std::fwrite(buf.data(), 1, buf.size(), f) == buf.size() &&
-              std::fwrite(block.data(), 1, block.size(), f) == block.size() &&
-              std::fwrite(sync, 1, 16, f) == 16;
-    if (!ok) {
+    if (!write_block(f, count, block, sync)) {
       std::fclose(f);
       return -1;
     }
   }
   if (std::fclose(f) != 0) return -1;
   return n;
+}
+
+// Writes per-entity BayesianLinearModelAvro records from columnar buffers.
+// Arguments:
+//   path, schema_json/schema_len: as above (Python passes
+//     io/schemas.py::BAYESIAN_LINEAR_MODEL_AVRO)
+//   n_models: record count
+//   id_bytes/id_offsets: concatenated utf-8 modelIds with n_models+1 offsets
+//   model_class_bytes/model_class_len: one shared string written as both
+//     modelClass and lossFunction (union branch 1)
+//   rec_indptr: (n_models+1) coefficient ranges per record
+//   name_ids: (n_coeffs) indices into the name/term tables
+//   values: (n_coeffs) coefficient means
+//   variances: (n_coeffs) or NULL -> variances written as union-null
+//   name_bytes/name_offsets, term_bytes/term_offsets: feature-name and
+//     term tables (index aligned), n_names+1 offsets
+//   block_records: records per Avro block
+// Returns n_models on success, -1 on IO failure.
+int64_t photon_write_re_models(
+    const char* path, const char* schema_json, int64_t schema_len,
+    int64_t n_models, const char* id_bytes, const int64_t* id_offsets,
+    const char* model_class_bytes, int64_t model_class_len,
+    const int64_t* rec_indptr, const int32_t* name_ids, const double* values,
+    const double* variances, const char* name_bytes,
+    const int64_t* name_offsets, const char* term_bytes,
+    const int64_t* term_offsets, int64_t block_records) {
+  std::FILE* f = std::fopen(path, "wb");
+  if (!f) return -1;
+  uint8_t sync[16];
+  fill_sync(sync);
+  if (!write_header(f, schema_json, schema_len, sync)) {
+    std::fclose(f);
+    return -1;
+  }
+
+  if (block_records <= 0) block_records = 4096;
+  std::vector<uint8_t> block;
+  auto append_ntv_array = [&](int64_t lo, int64_t hi, const double* vals) {
+    // Avro array: one count block of items, then the 0 terminator
+    if (hi > lo) {
+      append_long(block, hi - lo);
+      for (int64_t k = lo; k < hi; ++k) {
+        const int32_t j = name_ids[k];
+        append_bytes(block, name_bytes + name_offsets[j],
+                     static_cast<size_t>(name_offsets[j + 1] -
+                                         name_offsets[j]));
+        append_bytes(block, term_bytes + term_offsets[j],
+                     static_cast<size_t>(term_offsets[j + 1] -
+                                         term_offsets[j]));
+        append_double(block, vals[k]);
+      }
+    }
+    append_long(block, 0);
+  };
+  for (int64_t start = 0; start < n_models; start += block_records) {
+    int64_t count =
+        n_models - start < block_records ? n_models - start : block_records;
+    block.clear();
+    for (int64_t i = start; i < start + count; ++i) {
+      append_bytes(block, id_bytes + id_offsets[i],
+                   static_cast<size_t>(id_offsets[i + 1] - id_offsets[i]));
+      for (int rep = 0; rep < 2; ++rep) {  // modelClass, lossFunction
+        append_long(block, 1);  // union branch 1 = string
+        append_bytes(block, model_class_bytes,
+                     static_cast<size_t>(model_class_len));
+      }
+      append_ntv_array(rec_indptr[i], rec_indptr[i + 1], values);
+      if (variances) {
+        append_long(block, 1);  // union branch 1 = array
+        append_ntv_array(rec_indptr[i], rec_indptr[i + 1], variances);
+      } else {
+        append_long(block, 0);  // null
+      }
+    }
+    if (!write_block(f, count, block, sync)) {
+      std::fclose(f);
+      return -1;
+    }
+  }
+  if (std::fclose(f) != 0) return -1;
+  return n_models;
 }
 
 }  // extern "C"

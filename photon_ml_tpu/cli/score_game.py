@@ -115,7 +115,9 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
             from photon_ml_tpu import native
 
             # columnar native writer (~50x the record encoder); the Python
-            # codec is the transparent fallback, producing the same records
+            # codec is the transparent fallback — codec pinned to null so
+            # both paths emit identical container properties, not just
+            # identical records
             if not native.write_scoring_results(
                     out_path, np.asarray(result.scores, np.float64),
                     np.asarray(data.labels, np.float64)):
@@ -123,7 +125,8 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                     {"uid": str(i), "predictionScore": float(s),
                      "label": float(l), "metadataMap": None}
                     for i, (s, l) in enumerate(zip(result.scores, data.labels)))
-                write_avro_file(out_path, records, SCORING_RESULT_AVRO)
+                write_avro_file(out_path, records, SCORING_RESULT_AVRO,
+                                codec="null")
             if result.by_coordinate is not None:
                 with open(os.path.join(args.output_dir,
                                        "score-breakdown.json"), "w") as f:

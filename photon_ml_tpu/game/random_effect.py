@@ -157,6 +157,126 @@ class RandomEffectSolver:
         return jnp.einsum("esd,ed->es", x, w,
                           preferred_element_type=jnp.float32)
 
+    @partial(jax.jit, static_argnames=("self", "e_reals"))
+    def _sweep_fused(self, offsets_dev, lam, statics, warm_ctxs, coeffs_warm,
+                     cidxs, e_reals):
+        """One program for the WHOLE coordinate sweep: per bucket, gather
+        residual offsets, gather warm starts from the previous sweep's
+        coefficient table, solve, compute margins, scatter into the score
+        vector; plus the flat coefficient/variance table for the single
+        model D2H and the device coefficient mirror (passive scoring).
+
+        The per-bucket formulation dispatched ~6 programs per bucket per
+        sweep; through the axon tunnel each program costs a fixed ~0.1–1 s
+        of dispatch+execute overhead, which made an 8-bucket coordinate's
+        sweep ~10 s of wall for ~1 s of device work. One fused program pays
+        the overhead once (and on any hardware saves launch+sync cost).
+        ``coeffs_warm`` is sized to the dataset's full key-table length from
+        sweep 0 (zeros — every ``found`` is False), so a single compilation
+        serves the cold sweep and every warm sweep."""
+        scores = jnp.zeros_like(offsets_dev)
+        flat_w: list[jnp.ndarray] = []
+        flat_v: list[jnp.ndarray] = []
+        coef_parts: list[jnp.ndarray] = []
+        for (x_d, lab_d, wt_d, idx_d, store_d), (pos_d, found_d), cidx, \
+                e_real in zip(statics, warm_ctxs, cidxs, e_reals):
+            boff = jnp.take(offsets_dev, idx_d.reshape(-1),
+                            mode="clip").reshape(idx_d.shape) * (wt_d > 0)
+            w0 = jnp.where(
+                found_d,
+                jnp.take(coeffs_warm, pos_d.reshape(-1),
+                         mode="clip").reshape(pos_d.shape),
+                0.0).astype(jnp.float32)
+            w_dev, variances, _conv = self._solve_bucket(
+                x_d, lab_d, boff, wt_d, w0, lam)
+            margins = self._margins_bucket(x_d, w_dev)[:e_real]
+            scores = scores.at[store_d].set(margins, mode="drop")
+            flat_w.append(w_dev[:e_real].reshape(-1))
+            flat_v.append(jnp.asarray(variances)[:e_real].reshape(-1))
+            coef_parts.append(
+                w_dev[:e_real].reshape(-1)[cidx].astype(jnp.float32))
+        batched = jnp.concatenate(flat_w + flat_v)
+        return scores, batched, jnp.concatenate(coef_parts)
+
+    def _warm_ctx(self, dataset: RandomEffectDataset, i: int,
+                  bucket: REBucket, warm: Optional[RandomEffectModel],
+                  shard_dim: int):
+        """(pos, found) join of bucket slots into the model key table — the
+        single home of the warm-join cache (used by the fused sweep's
+        in-program gather AND the per-bucket _warm_start_device path).
+        With no usable warm model the cached zero-join (found all-False)
+        keeps the program signature — and so the compilation — identical to
+        warm sweeps."""
+        if (warm is not None and len(warm.keys) and warm.dim == shard_dim
+                and warm.projector is None):
+            key = ("warmidx", i, self.mesh, self.entity_axis)
+            ctx = dataset._device_cache.get(key)
+            # validate against the cached key TABLE, not just its shape: a
+            # warm model keyed differently (trained on another dataset
+            # in-process) would otherwise gather wrong coefficients through
+            # a stale join. In the production CD chain keys are identical
+            # every sweep, so this is one memcmp per bucket per sweep.
+            if ctx is not None and not (
+                    len(ctx[0]) == len(warm.keys)
+                    and np.array_equal(ctx[0], warm.keys)):
+                ctx = None
+            if ctx is None:
+                from photon_ml_tpu.game.model import key_join
+
+                fi = bucket.feature_index  # (E, D_local)
+                ent = np.broadcast_to(bucket.entity_ids[:, None], fi.shape)
+                pos, found = key_join(warm.keys, shard_dim, ent, fi)
+                # _put entity-pads with zeros: found pads False, so padded
+                # lanes warm-start at exactly 0
+                ctx = (warm.keys, self._put(pos), self._put(found))
+                dataset._device_cache[key] = ctx
+            return ctx[1], ctx[2]
+        key = ("zeroctx", i, self.mesh, self.entity_axis)
+        ctx = dataset._device_cache.get(key)
+        if ctx is None:
+            shape = bucket.feature_index.shape
+            ctx = (self._put(np.zeros(shape, np.int64)),
+                   self._put(np.zeros(shape, bool)))
+            dataset._device_cache[key] = ctx
+        return ctx
+
+    def _coef_idx(self, dataset: RandomEffectDataset, i: int,
+                  bucket: REBucket):
+        ck = ("coeffidx", i)
+        cidx = dataset._device_cache.get(ck)
+        if cidx is None:
+            cidx = jnp.asarray(np.flatnonzero(bucket.feature_index >= 0))
+            dataset._device_cache[ck] = cidx
+        return cidx
+
+    def _key_table_len(self, dataset: RandomEffectDataset) -> int:
+        """Length of the model key table this dataset will produce (one key
+        per kept (entity, feature) slot) — the warm-coefficient arg size."""
+        return sum(int((b.feature_index >= 0).sum()) for b in dataset.buckets)
+
+    def _zero_coeffs(self, dataset: RandomEffectDataset):
+        """All-zero warm-coefficient table sized like the real one, so the
+        cold sweep shares the warm sweeps' compilation (cached: the fused
+        program's cache also keys on argument identity-ish placement)."""
+        key = ("zerocoeffs",)
+        z = dataset._device_cache.get(key)
+        if z is None:
+            z = jnp.zeros((max(self._key_table_len(dataset), 1),),
+                          jnp.float32)
+            dataset._device_cache[key] = z
+        return z
+
+    @staticmethod
+    def _join_warm(dataset: RandomEffectDataset) -> None:
+        """Wait for a background pre-compile started at estimator
+        prepare() time (so its cache loads overlap the fixed-effect
+        stage)."""
+        import threading
+
+        th = getattr(dataset, "_warm_thread", None)
+        if th is not None and th is not threading.current_thread():
+            th.join()
+
     def _warm_start_device(self, dataset: RandomEffectDataset, i: int,
                            bucket: REBucket,
                            warm: Optional[RandomEffectModel],
@@ -173,32 +293,20 @@ class RandomEffectSolver:
                 or warm.projector is not None or not len(warm.keys)
                 or warm.dim != shard_dim):
             return None
-        key = ("warmidx", i, self.mesh, self.entity_axis)
-        ctx = dataset._device_cache.get(key)
-        # validate against the cached key TABLE, not just its shape: a warm
-        # model keyed differently (trained on another dataset in-process)
-        # would otherwise gather wrong coefficients through a stale join.
-        # In the production CD chain keys are identical every sweep, so this
-        # is one memcmp per bucket per sweep.
-        if ctx is not None and not (
-                len(ctx[0]) == len(warm.keys)
-                and np.array_equal(ctx[0], warm.keys)):
-            ctx = None
-        if ctx is None:
-            from photon_ml_tpu.game.model import key_join
-
-            fi = bucket.feature_index  # (E, D_local)
-            ent = np.broadcast_to(bucket.entity_ids[:, None], fi.shape)
-            pos, found = key_join(warm.keys, shard_dim, ent, fi)
-            # _put entity-pads with zeros: found pads False, so padded
-            # lanes warm-start at exactly 0
-            ctx = (warm.keys, self._put(pos), self._put(found))
-            dataset._device_cache[key] = ctx
-        _, pos_d, found_d = ctx
+        pos_d, found_d = self._warm_ctx(dataset, i, bucket, warm, shard_dim)
         return _warm_gather(warm.coeffs_device, pos_d, found_d)
 
-    def _warm_compile(self, dataset: RandomEffectDataset) -> None:
-        """Pre-compile every distinct bucket shape CONCURRENTLY.
+    def _warm_compile(self, dataset: RandomEffectDataset,
+                      n: Optional[int] = None) -> None:
+        """Pre-compile the dataset's solver programs.
+
+        With ``n`` (the sample count) and a fused-eligible dataset
+        (device-cached buckets, no projector) this compiles THE fused sweep
+        program itself on the real static arrays — which also performs the
+        bucket uploads and join builds train() will reuse — against an
+        all-zero offsets/warm signature that matches every later sweep.
+        Otherwise falls back to per-bucket-shape compiles (streaming and
+        projected datasets keep the per-bucket dispatch path).
 
         Each distinct (entities, samples, features) bucket shape is its own
         XLA program; compiling lazily inside the bucket loop serializes the
@@ -211,11 +319,35 @@ class RandomEffectSolver:
         properly. Keyed per dataset; later sweeps hit jit's own cache and
         skip this entirely.
         """
+        import threading
+
+        # a background pre-compile started at estimator prepare() time (so
+        # cache loads overlap the fixed-effect stage) finishes first; train
+        # then finds the flag set and skips
+        th = getattr(dataset, "_warm_thread", None)
+        if th is not None and th is not threading.current_thread():
+            th.join()
         if getattr(dataset, "_warm_compiled", None) == (self.mesh,):
+            return
+        if (n is not None and dataset.config.cache_device_buckets
+                and dataset.projector is None and dataset.buckets):
+            buckets = dataset.buckets
+            statics = tuple(self._static_arrays(dataset, i, b, n)
+                            for i, b in enumerate(buckets))
+            warm_ctxs = tuple(self._warm_ctx(dataset, i, b, None, 0)
+                              for i, b in enumerate(buckets))
+            cidxs = tuple(self._coef_idx(dataset, i, b)
+                          for i, b in enumerate(buckets))
+            out = self._sweep_fused(
+                jnp.zeros((n,), jnp.float32), jnp.zeros((), jnp.float32),
+                statics, warm_ctxs, self._zero_coeffs(dataset), cidxs,
+                tuple(b.n_entities for b in buckets))
+            np.asarray(out[1][:1])  # D2H: the only reliable barrier on axon
+            object.__setattr__(dataset, "_warm_compiled", (self.mesh,))
             return
         shapes = sorted({(bucket.x.shape, bucket.labels.shape)
                          for bucket in dataset.buckets})
-        if len(shapes) <= 1:
+        if not shapes:
             object.__setattr__(dataset, "_warm_compiled", (self.mesh,))
             return
 
@@ -276,7 +408,12 @@ class RandomEffectSolver:
         offsets_dev = jnp.asarray(offsets, jnp.float32)
         scores = jnp.zeros(n, jnp.float32)
         want_var = self.config.variance_type != VarianceComputationType.NONE
-        self._warm_compile(dataset)
+        self._join_warm(dataset)
+        if not cfg.cache_device_buckets or dataset.projector is not None:
+            # per-bucket dispatch path: overlap the per-shape compiles
+            # (the fused path is one program — compiling it right before
+            # calling it would gain nothing)
+            self._warm_compile(dataset)
 
         # Phase 1 — dispatch every bucket's solve/margins/scatter without a
         # single device sync: jax dispatch is async, so all bucket programs
@@ -290,6 +427,8 @@ class RandomEffectSolver:
         lam_dev = jnp.asarray(lam, jnp.float32)
         pending = []
         dev_coeff_parts: list[jnp.ndarray] = []
+        fused = (not streaming and dataset.projector is None
+                 and len(dataset.buckets) > 0)
 
         def collect(bucket, e_real, w_dev, variances):
             # one D2H of the (entities, local-dim) coefficients — the model
@@ -308,7 +447,48 @@ class RandomEffectSolver:
             if want_var and np.asarray(variances).size:
                 var_parts.append(np.asarray(variances)[fmask].astype(np.float32))
 
-        for i, bucket in enumerate(dataset.buckets):
+        if fused:
+            # One program for the whole sweep + one D2H for the model table
+            # (see _sweep_fused). The per-bucket path below survives for the
+            # streaming (upload-and-drop) and projected modes.
+            buckets = dataset.buckets
+            statics = tuple(self._static_arrays(dataset, i, b, n)
+                            for i, b in enumerate(buckets))
+            warm_ctxs = tuple(
+                self._warm_ctx(dataset, i, b, warm_start, shard_dim)
+                for i, b in enumerate(buckets))
+            usable_warm = (warm_start is not None and len(warm_start.keys)
+                           and warm_start.dim == shard_dim
+                           and warm_start.projector is None)
+            if usable_warm:
+                coeffs_warm = (warm_start.coeffs_device
+                               if warm_start.coeffs_device is not None
+                               else jnp.asarray(
+                                   np.asarray(warm_start.coeffs, np.float32)))
+            else:
+                coeffs_warm = self._zero_coeffs(dataset)
+            cidxs = tuple(self._coef_idx(dataset, i, b)
+                          for i, b in enumerate(buckets))
+            e_reals = tuple(b.n_entities for b in buckets)
+            scores, batched_dev, coeffs_unsorted = self._sweep_fused(
+                offsets_dev, lam_dev, statics, warm_ctxs, coeffs_warm,
+                cidxs, e_reals)
+            dev_coeff_parts.append(coeffs_unsorted)
+            batched = np.asarray(batched_dev)  # the sweep's single D2H
+            d_of = [int(b.x.shape[2]) for b in buckets]
+            w_sizes = [b.n_entities * d for b, d in zip(buckets, d_of)]
+            v_sizes = [b.n_entities * (d if want_var else 0)
+                       for b, d in zip(buckets, d_of)]
+            bounds = np.cumsum([0] + w_sizes + v_sizes)
+            nb = len(buckets)
+            for k, bucket in enumerate(buckets):
+                w_np = batched[bounds[k]:bounds[k + 1]].reshape(
+                    bucket.n_entities, -1)
+                v_np = batched[bounds[nb + k]:bounds[nb + k + 1]].reshape(
+                    bucket.n_entities, -1)
+                collect_host(bucket, w_np, v_np)
+
+        for i, bucket in enumerate(() if fused else dataset.buckets):
             e_real = bucket.n_entities
             x_d, lab_d, wt_d, idx_d, store_d = self._static_arrays(
                 dataset, i, bucket, n)
@@ -338,14 +518,9 @@ class RandomEffectSolver:
                 else:
                     pending.append((bucket, e_real, w_dev, variances))
                 continue
-            ck = ("coeffidx", i)
-            cidx = dataset._device_cache.get(ck)
-            if cidx is None:
-                cidx = jnp.asarray(
-                    np.flatnonzero(bucket.feature_index >= 0))
-                dataset._device_cache[ck] = cidx
             dev_coeff_parts.append(
-                w_dev[:e_real].reshape(-1)[cidx].astype(jnp.float32))
+                w_dev[:e_real].reshape(-1)[self._coef_idx(dataset, i, bucket)]
+                .astype(jnp.float32))
             if streaming:
                 # force completion so this bucket's buffers can be dropped
                 jax.block_until_ready(scores)

@@ -131,6 +131,16 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_char_p,  # uid bytes or NULL
             ctypes.c_void_p,  # uid offsets (i64*) or NULL
             ctypes.c_int64, ctypes.c_int64]
+        _f64p = np.ctypeslib.ndpointer(dtype=np.float64, flags="C_CONTIGUOUS")
+        lib.photon_write_re_models.restype = ctypes.c_int64
+        lib.photon_write_re_models.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_char_p, _i64p,
+            ctypes.c_char_p, ctypes.c_int64,
+            _i64p, _i32p, _f64p,
+            ctypes.c_void_p,  # variances (f64*) or NULL
+            ctypes.c_char_p, _i64p, ctypes.c_char_p, _i64p,
+            ctypes.c_int64]
         _lib = lib
         return _lib
 
@@ -393,6 +403,53 @@ class BucketPackScratch:
         self.kept_stamp = np.full(dim, -1, np.int64)
         self.support = np.empty(dim, np.int64)
         self.local = np.empty(dim, np.int64)
+
+
+def _concat_strings(strings) -> tuple[bytes, np.ndarray]:
+    """Concatenated utf-8 bytes + (n+1,) offsets for a string sequence."""
+    encoded = [s.encode() for s in strings]
+    offs = np.zeros(len(encoded) + 1, np.int64)
+    np.cumsum([len(b) for b in encoded], out=offs[1:])
+    return b"".join(encoded), offs
+
+
+def write_re_models(path: str, model_ids, model_class: str,
+                    rec_indptr: np.ndarray, name_ids: np.ndarray,
+                    values: np.ndarray, variances: Optional[np.ndarray],
+                    names, terms, block_records: int = 4096) -> bool:
+    """Write per-entity ``BayesianLinearModelAvro`` records via the native
+    writer (``native/avro_writer.cc::photon_write_re_models``).
+
+    ``rec_indptr`` gives each record's [lo, hi) span in the flat
+    ``name_ids``/``values``/``variances`` columns; ``name_ids`` index the
+    ``names``/``terms`` tables. ``model_class`` is written as both
+    modelClass and lossFunction (matching the Python path). Returns False
+    when the native library is unavailable; the caller falls back to
+    :func:`photon_ml_tpu.io.avro.write_avro_file`."""
+    lib = _load()
+    if lib is None:
+        return False
+    from photon_ml_tpu.io.schemas import BAYESIAN_LINEAR_MODEL_AVRO
+
+    schema = json.dumps(BAYESIAN_LINEAR_MODEL_AVRO).encode()
+    id_bytes, id_offs = _concat_strings(model_ids)
+    name_bytes, name_offs = _concat_strings(names)
+    term_bytes, term_offs = _concat_strings(terms)
+    rec_indptr = np.ascontiguousarray(rec_indptr, np.int64)
+    name_ids = np.ascontiguousarray(name_ids, np.int32)
+    values = np.ascontiguousarray(values, np.float64)
+    n_models = len(rec_indptr) - 1
+    var_ptr = None
+    var_arr = None
+    if variances is not None:
+        var_arr = np.ascontiguousarray(variances, np.float64)
+        var_ptr = var_arr.ctypes.data_as(ctypes.c_void_p)
+    mc = model_class.encode()
+    wrote = lib.photon_write_re_models(
+        path.encode(), schema, len(schema), n_models, id_bytes, id_offs,
+        mc, len(mc), rec_indptr, name_ids, values, var_ptr,
+        name_bytes, name_offs, term_bytes, term_offs, block_records)
+    return wrote == n_models
 
 
 def re_feature_counts(indptr: np.ndarray, cols: np.ndarray,

@@ -67,6 +67,19 @@ def initialize(coordinator_address: Optional[str] = None,
 
         coordinator_address = os.environ.get("PHOTON_COORDINATOR_ADDRESS")
         n = os.environ.get("PHOTON_NUM_PROCESSES")
+        if bool(coordinator_address) != bool(n):
+            # one without the other would fall through to
+            # jax.distributed.initialize with a None field and die with an
+            # obscure backend error; name the missing variable instead.
+            # (PHOTON_PROCESS_ID stays optional: it defaults to the
+            # process_id argument, and a leftover value on a single-host
+            # run is harmless.)
+            missing = ("PHOTON_NUM_PROCESSES" if coordinator_address
+                       else "PHOTON_COORDINATOR_ADDRESS")
+            raise ValueError(
+                f"multi-host environment is partially set: {missing} is "
+                "missing — set both PHOTON_COORDINATOR_ADDRESS and "
+                "PHOTON_NUM_PROCESSES (or neither, for single-host)")
         num_processes = int(n) if n else None
         pid = os.environ.get("PHOTON_PROCESS_ID")
         process_id = int(pid) if pid else process_id

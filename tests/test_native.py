@@ -222,3 +222,90 @@ class TestNativeBucketPackParity:
             # duplicate (row, col) entries accumulate in both paths; order
             # of accumulation may differ => allclose, not equal
             np.testing.assert_allclose(bf.x, bs.x, rtol=1e-6, atol=1e-6)
+
+
+class TestNativeREModelWriter:
+    """photon_write_re_models must be record-identical to the Python
+    _re_records + write_avro_file path."""
+
+    @staticmethod
+    def _model(variances=True, seed=0):
+        from photon_ml_tpu.game.model import RandomEffectModel
+        from photon_ml_tpu.types import TaskType, feature_key
+
+        rng = np.random.default_rng(seed)
+        dim, ents = 7, 25
+        keys = []
+        for e in range(ents):
+            feats = rng.choice(dim, size=rng.integers(1, dim + 1),
+                               replace=False)
+            keys.extend(sorted(int(e) * dim + f for f in feats))
+        keys = np.array(keys, np.int64)
+        model = RandomEffectModel(
+            random_effect_type="userId", feature_shard_id="s",
+            task=TaskType.LOGISTIC_REGRESSION, dim=dim, keys=keys,
+            coeffs=rng.normal(size=len(keys)).astype(np.float32),
+            variances=(rng.uniform(0.1, 1.0, size=len(keys))
+                       .astype(np.float32) if variances else None))
+        from photon_ml_tpu.io.index import IndexMap
+
+        imap = IndexMap({feature_key(f"f{j}", "t" if j % 2 else ""): j
+                         for j in range(dim)})
+        reverse = {e: f"user{e}" for e in range(ents)}
+        return model, imap, reverse
+
+    @pytest.mark.parametrize("variances,threshold", [
+        (True, 0.0), (False, 0.0), (True, 0.5),
+    ])
+    def test_record_identical_to_python(self, tmp_path, variances, threshold):
+        from photon_ml_tpu.io.avro import iter_avro_file, write_avro_file
+        from photon_ml_tpu.io.model_io import (
+            _re_records,
+            _save_re_model_native,
+        )
+        from photon_ml_tpu.io.schemas import BAYESIAN_LINEAR_MODEL_AVRO
+
+        model, imap, reverse = self._model(variances=variances)
+        fast = str(tmp_path / "fast.avro")
+        slow = str(tmp_path / "slow.avro")
+        assert _save_re_model_native(fast, model, reverse, imap, threshold)
+        write_avro_file(slow, _re_records(model, imap, reverse, threshold),
+                        BAYESIAN_LINEAR_MODEL_AVRO, codec="null")
+        recs_f = list(iter_avro_file(fast))
+        recs_s = list(iter_avro_file(slow))
+        assert recs_f == recs_s
+        assert len(recs_f) == 25
+
+    def test_game_model_roundtrip_through_native_save(self, tmp_path):
+        """save_game_model (native fast path) -> load_game_model recovers
+        the same coefficient table."""
+        from photon_ml_tpu.game.model import FixedEffectModel, GameModel
+        from photon_ml_tpu.io.index import IndexMap
+        from photon_ml_tpu.io.model_io import load_game_model, save_game_model
+        from photon_ml_tpu.models.coefficients import Coefficients
+        from photon_ml_tpu.models.glm import GeneralizedLinearModel
+        from photon_ml_tpu.types import TaskType, feature_key
+        import jax.numpy as jnp
+
+        model, imap, reverse = self._model()
+        fe_imap = IndexMap({feature_key(f"g{j}"): j for j in range(4)})
+        game = GameModel(
+            task=TaskType.LOGISTIC_REGRESSION,
+            coordinates={
+                "global": FixedEffectModel(
+                    model=GeneralizedLinearModel(
+                        coefficients=Coefficients(
+                            means=jnp.arange(4, dtype=jnp.float32)),
+                        task=TaskType.LOGISTIC_REGRESSION),
+                    feature_shard_id="g"),
+                "perUser": model,
+            })
+        out = str(tmp_path / "m")
+        imaps = {"s": imap, "g": fe_imap}
+        vocabs = {"userId": {v: k for k, v in reverse.items()}}
+        save_game_model(out, game, imaps, vocabs)
+        loaded = load_game_model(out, imaps, vocabs)
+        re2 = loaded.coordinates["perUser"]
+        np.testing.assert_array_equal(re2.keys, model.keys)
+        np.testing.assert_allclose(re2.coeffs, model.coeffs, rtol=1e-6)
+        np.testing.assert_allclose(re2.variances, model.variances, rtol=1e-6)
