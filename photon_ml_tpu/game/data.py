@@ -160,6 +160,19 @@ class GameData:
 # ---------------------------------------------------------------------------
 
 
+def host_design_for_shard(shard: FeatureShard, dense_max_dim: int):
+    """Host-resident design for a fixed-effect shard: densified at or below
+    ``dense_max_dim`` (MXU path), CSR above it. The single home of the
+    dense/sparse cutover — the single- and multi-process feeds must agree."""
+    if shard.dim <= dense_max_dim:
+        return DenseDesign(x=shard.to_dense())
+    return CsrDesign(
+        rows=shard.rows().astype(np.int32),
+        cols=shard.cols.astype(np.int32),
+        values=shard.vals,
+        n_rows=shard.n_samples, n_cols=shard.dim)
+
+
 @dataclasses.dataclass(frozen=True)
 class FixedEffectDataset:
     """Device-ready data for one fixed-effect coordinate
@@ -192,14 +205,7 @@ class FixedEffectDataset:
         # host-resident design first: the sharded branch pads/splits on host
         # and device_puts per-shard blocks directly — never materializing
         # the full design in one device's HBM (the whole point of dp)
-        if shard.dim <= dense_max_dim:
-            host_design = DenseDesign(x=shard.to_dense())
-        else:
-            host_design = CsrDesign(
-                rows=shard.rows().astype(np.int32),
-                cols=shard.cols.astype(np.int32),
-                values=shard.vals,
-                n_rows=shard.n_samples, n_cols=shard.dim)
+        host_design = host_design_for_shard(shard, dense_max_dim)
 
         from photon_ml_tpu.parallel.mesh import DATA_AXIS
 

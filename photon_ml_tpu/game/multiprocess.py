@@ -1,0 +1,503 @@
+"""Multi-process GAME training: entity-partitioned random effects.
+
+The reference trains random effects sharded across machines: rows are
+shuffled so each Spark executor owns complete entities
+(``photon-api/.../data/RandomEffectDatasetPartitioner.scala`` — a
+frequency-balanced partition map), the per-entity solves then run
+executor-local with zero communication
+(``algorithm/RandomEffectCoordinate.scala``), and the model stays an RDD
+sharded the same way. The multi-controller-JAX analog implemented here:
+
+- **Entity partition** (:func:`balanced_entity_partition`): a deterministic,
+  frequency-balanced (longest-processing-time greedy) assignment
+  entity → process, computed identically on every process from globally
+  allreduced entity row counts.
+- **Row shuffle** (:func:`exchange_rows`): each process starts from its own
+  arbitrary row shard (host-local Avro reads) and keeps exactly the rows
+  whose owner it is. Implemented over the host allgather collective —
+  O(total) received per process, like Spark's shuffle volume at its
+  reduce side; JAX exposes no host-side point-to-point, and the exchange
+  runs once per RE entity type at dataset-build time, not per sweep.
+- **Per-process datasets**: the fixed effect feeds the global ``data``-axis
+  mesh via :func:`~photon_ml_tpu.parallel.multihost.global_glm_data_multihost`
+  (one psum'd global solve — every process participates); each
+  :class:`~photon_ml_tpu.game.data.RandomEffectDataset` is built
+  per-process over that process's OWN entities only and solved on LOCAL
+  devices — the executor-local zero-comm solve, verbatim.
+- **Row-local score accounting**: coordinate-descent residuals live on the
+  process that owns the row; the score invariant
+  ``total = offsets + Σ_c scores[c]`` holds per-process. A random-effect
+  coordinate whose entity type differs from the primary row partition
+  exchanges residuals/scores through a host allgather per sweep (the
+  analog of the reference's per-iteration score join shuffle).
+- **Model assembly**: at sweep end the per-process random-effect
+  (key, coefficient) tables allgather into the identical global
+  :class:`~photon_ml_tpu.game.model.RandomEffectModel` on every process;
+  the fixed-effect model is already replicated by the psum'd solve. The
+  chief process writes outputs.
+
+Every collective here degenerates to the identity on a single process, so
+the whole pipeline runs (and is unit-tested) single-process; the 2-process
+loopback test in ``tests/test_multihost.py`` exercises the real collectives
+and asserts equality with the single-process result.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import logging
+from typing import Mapping, Optional, Sequence
+
+import numpy as np
+
+from photon_ml_tpu.game.data import (
+    DENSE_DESIGN_MAX_DIM,
+    FeatureShard,
+    GameData,
+    RandomEffectDataset,
+    RandomEffectDatasetConfig,
+    host_design_for_shard,
+)
+from photon_ml_tpu.game.model import (
+    FixedEffectModel,
+    GameModel,
+    RandomEffectModel,
+)
+from photon_ml_tpu.glm.problem import GLMOptimizationConfiguration
+from photon_ml_tpu.models.coefficients import Coefficients
+from photon_ml_tpu.models.glm import GeneralizedLinearModel
+from photon_ml_tpu.ops.objective import GLMData
+from photon_ml_tpu.types import TaskType
+
+logger = logging.getLogger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# Entity partition (RandomEffectDatasetPartitioner analog)
+# ---------------------------------------------------------------------------
+
+
+def balanced_entity_partition(row_counts: np.ndarray,
+                              n_processes: int) -> np.ndarray:
+    """Frequency-balanced entity → process assignment.
+
+    Longest-processing-time greedy: entities sorted by row count
+    descending (ties by entity id, so the result is deterministic — every
+    process must compute the SAME partition from the same counts), each
+    assigned to the least-loaded process. The reference's
+    ``RandomEffectDatasetPartitioner`` builds the same kind of map from a
+    sampled frequency table.
+
+    Returns an ``(n_entities,)`` int32 array of process ids. Entities with
+    zero rows are still assigned (round-robin via the same greedy), so the
+    map is total.
+    """
+    counts = np.asarray(row_counts, np.int64)
+    n_processes = int(n_processes)
+    if n_processes <= 1:
+        return np.zeros(len(counts), np.int32)
+    order = np.lexsort((np.arange(len(counts)), -counts))
+    owner = np.zeros(len(counts), np.int32)
+    # (load, process) heap — process index tie-breaks deterministically
+    heap = [(0, p) for p in range(n_processes)]
+    heapq.heapify(heap)
+    for e in order:
+        load, p = heapq.heappop(heap)
+        owner[e] = p
+        heapq.heappush(heap, (load + int(counts[e]), p))
+    return owner
+
+
+# ---------------------------------------------------------------------------
+# Row shuffle
+# ---------------------------------------------------------------------------
+
+
+def exchange_rows(game_local: GameData, dest_local: np.ndarray,
+                  ) -> tuple[GameData, np.ndarray]:
+    """All-to-all row shuffle: keep the rows this process owns.
+
+    ``dest_local`` gives the destination process of each local row. Global
+    row ids are defined as (process-order offset + local index) — the
+    concatenation order of the host allgather — and the returned rows are
+    sorted by global id, so every process's view of "its" rows is a
+    deterministic slice of one global ordering (what makes the
+    multi-process result comparable to a single-process run row-for-row).
+
+    Returns ``(owned GameData, owned global row ids)``.
+    """
+    import jax
+
+    from photon_ml_tpu.parallel.multihost import allgather_concat
+
+    me = jax.process_index()
+    dest_local = np.asarray(dest_local, np.int32)
+    if jax.process_count() == 1:
+        keep = np.flatnonzero(dest_local == me)
+        return _take_rows(game_local, keep), keep.astype(np.int64)
+
+    dest = allgather_concat(dest_local)
+    keep = np.flatnonzero(dest == me).astype(np.int64)
+
+    labels = allgather_concat(game_local.labels)[keep]
+    offsets = allgather_concat(game_local.offsets)[keep]
+    weights = allgather_concat(game_local.weights)[keep]
+    id_columns = {k: allgather_concat(v)[keep]
+                  for k, v in game_local.id_columns.items()}
+    shards = {}
+    for name, shard in game_local.shards.items():
+        counts = allgather_concat(shard.row_counts().astype(np.int64))
+        cols = allgather_concat(shard.cols)
+        vals = allgather_concat(shard.vals)
+        indptr = np.zeros(len(counts) + 1, np.int64)
+        np.cumsum(counts, out=indptr[1:])
+        shards[name] = FeatureShard(
+            indptr=indptr, cols=cols, vals=vals, dim=shard.dim).take(keep)
+    return GameData(labels=labels, offsets=offsets, weights=weights,
+                    shards=shards, id_columns=id_columns), keep
+
+
+def _take_rows(game: GameData, rows: np.ndarray) -> GameData:
+    return GameData(
+        labels=game.labels[rows],
+        offsets=game.offsets[rows],
+        weights=game.weights[rows],
+        shards={k: s.take(rows) for k, s in game.shards.items()},
+        id_columns={k: v[rows] for k, v in game.id_columns.items()})
+
+
+def owner_of_rows(entities: np.ndarray, owner_of_entity: np.ndarray,
+                  global_rows: np.ndarray, n_processes: int) -> np.ndarray:
+    """Destination process per row: the row's entity's owner; rows with no
+    entity (id < 0) spread round-robin by global row id so the fixed effect
+    still sees balanced shards."""
+    entities = np.asarray(entities, np.int64)
+    dest = np.where(entities >= 0,
+                    owner_of_entity[np.maximum(entities, 0)],
+                    (np.asarray(global_rows, np.int64) % n_processes
+                     ).astype(np.int32))
+    return dest.astype(np.int32)
+
+
+# ---------------------------------------------------------------------------
+# Multi-process fixed-effect dataset (global data-axis feed, re-fed offsets)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class MultiProcessFixedEffectDataset:
+    """Fixed-effect data fed once onto the global ``data``-axis mesh; only
+    the per-sweep residual offsets are re-fed (the multi-process analog of
+    :class:`~photon_ml_tpu.game.data.FixedEffectDataset`'s per-sweep
+    ``glm_data``). Rows are this process's owned rows; every process's
+    blocks compose into the one global sharded layout.
+    """
+
+    coordinate_id: str
+    feature_shard_id: str
+    design: object
+    labels: object
+    weights: object
+    dim: int
+    n_local_rows: int
+    n_local_blocks: int
+    rows_per_shard: int
+    mesh: object
+    n_shards: int
+
+    @staticmethod
+    def build(coordinate_id: str, game_owned: GameData,
+              feature_shard_id: str, mesh,
+              *, dense_max_dim: int = DENSE_DESIGN_MAX_DIM,
+              ) -> "MultiProcessFixedEffectDataset":
+        from photon_ml_tpu.parallel.mesh import DATA_AXIS
+        from photon_ml_tpu.parallel.multihost import (
+            global_glm_data_multihost,
+            local_axis_blocks,
+        )
+
+        shard = game_owned.shards[feature_shard_id]
+        host_design = host_design_for_shard(shard, dense_max_dim)
+        local = GLMData(design=host_design, labels=game_owned.labels,
+                        offsets=np.zeros(shard.n_samples, np.float32),
+                        weights=game_owned.weights)
+        fed = global_glm_data_multihost(local, mesh)
+        return MultiProcessFixedEffectDataset(
+            coordinate_id=coordinate_id, feature_shard_id=feature_shard_id,
+            design=fed.design, labels=fed.labels, weights=fed.weights,
+            dim=shard.dim, n_local_rows=shard.n_samples,
+            n_local_blocks=local_axis_blocks(mesh, DATA_AXIS),
+            rows_per_shard=int(fed.labels.shape[1]), mesh=mesh,
+            n_shards=int(mesh.shape[DATA_AXIS]))
+
+    def glm_data(self, local_offsets) -> GLMData:
+        """Bind this process's residual offsets into the global layout."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from photon_ml_tpu.parallel.mesh import DATA_AXIS
+
+        per = self.rows_per_shard
+        off = np.zeros(self.n_local_blocks * per, np.float32)
+        off[:self.n_local_rows] = np.asarray(local_offsets, np.float32)
+        global_shape = (self.n_shards, per)
+        fed = jax.make_array_from_process_local_data(
+            NamedSharding(self.mesh, P(DATA_AXIS)),
+            off.reshape(self.n_local_blocks, per), global_shape)
+        return GLMData(design=self.design, labels=self.labels,
+                       offsets=fed, weights=self.weights)
+
+    def local_scores(self, scores) -> np.ndarray:
+        """Pull this process's rows out of a globally-sharded ``(n_shards,
+        rows_per_shard)`` score array (drop local tail padding). Shards are
+        deduped by data-axis block: on a mesh with extra axes the score
+        vector is replicated across them, and counting each replica would
+        duplicate rows."""
+        by_block = {}
+        for s in scores.addressable_shards:
+            by_block.setdefault(s.index[0].start or 0, s)
+        flat = np.concatenate([np.asarray(by_block[k].data).reshape(-1)
+                               for k in sorted(by_block)])
+        return flat[:self.n_local_rows]
+
+
+# ---------------------------------------------------------------------------
+# The multi-process coordinate-descent driver
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class MultiProcessGameResult:
+    model: GameModel  # identical on every process
+    #: this process's rows: global ids and per-coordinate scores
+    global_rows: np.ndarray
+    scores: dict[str, np.ndarray]
+
+
+@dataclasses.dataclass(frozen=True)
+class _REPlan:
+    config: RandomEffectDatasetConfig
+    optimization: GLMOptimizationConfiguration
+    #: owned rows for THIS coordinate's entity type
+    game: GameData
+    global_rows: np.ndarray
+    dataset: RandomEffectDataset
+    #: True when this coordinate's rows coincide with the primary partition
+    primary: bool
+
+
+def _allgather_rowvec(global_rows: np.ndarray, values: np.ndarray,
+                      n_global: int) -> np.ndarray:
+    """Assemble a replicated global row vector from per-process slices."""
+    from photon_ml_tpu.parallel.multihost import allgather_concat
+
+    rows = allgather_concat(np.asarray(global_rows, np.int64))
+    vals = allgather_concat(np.asarray(values, np.float32))
+    out = np.zeros(n_global, np.float32)
+    out[rows] = vals
+    return out
+
+
+def train_game_multiprocess(
+    game_local: GameData,
+    task: TaskType,
+    coordinate_configs: Mapping[str, object],
+    update_sequence: Sequence[str],
+    lam: Mapping[str, float],
+    n_cd_iterations: int = 1,
+    fe_mesh=None,
+    re_mesh=None,
+) -> MultiProcessGameResult:
+    """Run GAME coordinate descent across all processes.
+
+    ``game_local`` is THIS process's row shard (any partition — e.g. its
+    host-local Avro files); ``coordinate_configs`` maps coordinate id to
+    :class:`~photon_ml_tpu.game.estimator.FixedEffectCoordinateConfig` or
+    :class:`~photon_ml_tpu.game.estimator.RandomEffectCoordinateConfig`.
+    The primary row partition follows the FIRST random-effect coordinate in
+    ``update_sequence`` (additional RE types exchange residuals per sweep);
+    with no random effects, rows stay on their reading process.
+
+    ``fe_mesh`` must be a global mesh with a ``data`` axis (default:
+    :func:`~photon_ml_tpu.parallel.multihost.make_multihost_mesh`);
+    ``re_mesh`` an optional LOCAL mesh with an ``entity`` axis for the
+    per-process bucket solves.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.game.coordinate import (
+        RandomEffectCoordinate,
+        _fixed_train_fn_dist,
+    )
+    from photon_ml_tpu.game.estimator import (
+        FixedEffectCoordinateConfig,
+        RandomEffectCoordinateConfig,
+    )
+    from photon_ml_tpu.parallel.multihost import (
+        allgather_concat,
+        allreduce_max,
+        allreduce_sum,
+        make_multihost_mesh,
+    )
+
+    n_proc = jax.process_count()
+    for cid in update_sequence:
+        if cid not in coordinate_configs:
+            raise KeyError(f"update sequence names unknown coordinate {cid!r}")
+
+    n_local = game_local.n_samples
+    n_global = int(allreduce_sum(np.array([n_local], np.int64))[0])
+    base = np.concatenate([[0], np.cumsum(
+        allgather_concat(np.array([n_local], np.int64)))])[
+        jax.process_index()]
+    local_global_rows = base + np.arange(n_local, dtype=np.int64)
+
+    # --- entity partitions: one owner map per RE entity type --------------
+    re_types = [coordinate_configs[cid].dataset.random_effect_type
+                for cid in update_sequence
+                if isinstance(coordinate_configs[cid],
+                              RandomEffectCoordinateConfig)]
+    owner_by_type: dict[str, np.ndarray] = {}
+    for t in dict.fromkeys(re_types):  # ordered unique
+        ents = game_local.id_columns[t]
+        n_ent = int(allreduce_max(
+            np.array([ents.max() + 1 if len(ents) else 0], np.int64))[0])
+        counts = allreduce_sum(np.bincount(
+            ents[ents >= 0], minlength=max(n_ent, 1)).astype(np.int64))
+        owner_by_type[t] = balanced_entity_partition(counts, n_proc)
+
+    # --- primary row partition + shuffle ----------------------------------
+    primary_type = re_types[0] if re_types else None
+    if primary_type is not None:
+        dest = owner_of_rows(game_local.id_columns[primary_type],
+                             owner_by_type[primary_type],
+                             local_global_rows, n_proc)
+    else:
+        dest = np.full(n_local, jax.process_index(), np.int32)
+    game_primary, primary_rows = exchange_rows(game_local, dest)
+
+    # --- per-coordinate builds --------------------------------------------
+    if fe_mesh is None:
+        fe_mesh = make_multihost_mesh()
+    fe_datasets: dict[str, MultiProcessFixedEffectDataset] = {}
+    re_plans: dict[str, _REPlan] = {}
+    for cid in update_sequence:
+        cfg = coordinate_configs[cid]
+        if isinstance(cfg, FixedEffectCoordinateConfig):
+            if cfg.downsampler is not None:
+                # per-sweep downsampling draws per-row randomness; the
+                # per-process draws would silently diverge from the
+                # single-process run this module promises equality with
+                raise NotImplementedError(
+                    f"coordinate {cid!r}: downsamplers are not supported in "
+                    "multi-process training yet (per-process sampling would "
+                    "diverge from the single-process result)")
+            fe_datasets[cid] = MultiProcessFixedEffectDataset.build(
+                cid, game_primary, cfg.feature_shard_id, fe_mesh)
+        elif isinstance(cfg, RandomEffectCoordinateConfig):
+            t = cfg.dataset.random_effect_type
+            if t == primary_type:
+                game_c, rows_c, is_primary = game_primary, primary_rows, True
+            else:
+                dest_c = owner_of_rows(
+                    game_local.id_columns[t], owner_by_type[t],
+                    local_global_rows, n_proc)
+                game_c, rows_c = exchange_rows(game_local, dest_c)
+                is_primary = False
+            # drop entities this process does NOT own from training: rows
+            # of owned entities are complete here by construction, so the
+            # per-process dataset covers exactly its entities
+            ds = RandomEffectDataset.build(cid, game_c, cfg.dataset)
+            re_plans[cid] = _REPlan(
+                config=cfg.dataset, optimization=cfg.optimization,
+                game=game_c, global_rows=rows_c, dataset=ds,
+                primary=is_primary)
+        else:
+            raise TypeError(
+                f"coordinate {cid!r}: multi-process training supports fixed "
+                f"and random effects (got {type(cfg).__name__})")
+
+    # --- coordinate descent with row-local score accounting ---------------
+    scores: dict[str, np.ndarray] = {
+        cid: np.zeros(len(primary_rows), np.float32)
+        for cid in update_sequence}
+    total = game_primary.offsets.astype(np.float32) + 0.0
+    models: dict[str, object] = {}
+    re_local_models: dict[str, RandomEffectModel] = {}
+
+    for sweep in range(n_cd_iterations):
+        for cid in update_sequence:
+            cfg = coordinate_configs[cid]
+            residual = total - scores[cid]
+            if cid in fe_datasets:
+                ds = fe_datasets[cid]
+                data = ds.glm_data(residual)
+                w0 = (jnp.zeros((ds.dim,), jnp.float32)
+                      if cid not in models else
+                      jnp.asarray(models[cid].model.coefficients.means))
+                train_fn = _fixed_train_fn_dist(
+                    task, cfg.optimization, fe_mesh)
+                result, variances, g_scores = train_fn(
+                    data, w0, jnp.asarray(lam.get(cid, 0.0), jnp.float32))
+                new_scores = ds.local_scores(g_scores)
+                models[cid] = FixedEffectModel(
+                    model=GeneralizedLinearModel(
+                        coefficients=Coefficients(
+                            means=np.asarray(result.w),
+                            variances=(None if variances is None
+                                       else np.asarray(variances))),
+                        task=task),
+                    feature_shard_id=ds.feature_shard_id)
+            else:
+                plan = re_plans[cid]
+                coord = RandomEffectCoordinate(
+                    coordinate_id=cid, dataset=plan.dataset, data=plan.game,
+                    task=task, config=plan.optimization,
+                    lam=lam.get(cid, 0.0), mesh=re_mesh)
+                if plan.primary:
+                    res_c = residual
+                else:
+                    # residuals live on primary owners; this coordinate's
+                    # rows live on ITS entity owners — exchange via the
+                    # replicated global vector (the reference's score join)
+                    g_res = _allgather_rowvec(primary_rows, residual,
+                                              n_global)
+                    res_c = g_res[plan.global_rows]
+                model_c, scores_c = coord.train(
+                    res_c, re_local_models.get(cid), sweep=sweep)
+                re_local_models[cid] = model_c
+                sc = np.asarray(scores_c, np.float32)
+                if plan.primary:
+                    new_scores = sc
+                else:
+                    g_sc = _allgather_rowvec(plan.global_rows, sc, n_global)
+                    new_scores = g_sc[primary_rows]
+            total = residual + new_scores
+            scores[cid] = new_scores
+            logger.info("mp sweep %d coordinate %s done", sweep, cid)
+
+    # --- model assembly: allgather RE tables ------------------------------
+    for cid, local_model in re_local_models.items():
+        keys = allgather_concat(local_model.keys)
+        coeffs = allgather_concat(local_model.coeffs)
+        has_var = local_model.variances is not None
+        variances = (allgather_concat(local_model.variances)
+                     if has_var else None)
+        order = np.argsort(keys, kind="stable")
+        models[cid] = RandomEffectModel(
+            random_effect_type=local_model.random_effect_type,
+            feature_shard_id=local_model.feature_shard_id,
+            task=task, dim=local_model.dim,
+            keys=keys[order], coeffs=coeffs[order],
+            variances=None if variances is None else variances[order],
+            # RANDOM-projected models keep their (shared, seed-derived —
+            # identical on every process) projector so scoring still maps
+            # shard features into the projected key space
+            projector=local_model.projector)
+
+    model = GameModel(
+        coordinates={cid: models[cid] for cid in update_sequence}, task=task)
+    return MultiProcessGameResult(
+        model=model, global_rows=primary_rows, scores=scores)

@@ -15,7 +15,10 @@ from photon_ml_tpu.parallel.distributed import (  # noqa: F401
     shard_glm_data_features,
 )
 from photon_ml_tpu.parallel.multihost import (  # noqa: F401
+    allgather_concat,
+    allreduce_max,
     allreduce_shard_budget,
+    allreduce_sum,
     global_glm_data_from_local,
     global_glm_data_multihost,
     local_axis_blocks,

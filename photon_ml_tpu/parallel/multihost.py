@@ -139,6 +139,62 @@ def allreduce_shard_budget(local: ShardBudget) -> ShardBudget:
     return ShardBudget.from_array(np.max(np.asarray(gathered), axis=0))
 
 
+def _gather_stack(x: np.ndarray) -> np.ndarray:
+    """``process_allgather`` with a stacked leading process axis, safe for
+    any 64-bit payload even when ``jax_enable_x64`` is off (jax would
+    silently downcast; entity keys ``entity*dim + feature`` overflow int32,
+    and float64 would lose precision only on P>1 runs — the worst kind of
+    divergence). 8-byte dtypes ride through as uint32 word pairs."""
+    from jax.experimental import multihost_utils
+
+    x = np.ascontiguousarray(x)
+    if x.dtype.itemsize == 8 and not jax.config.jax_enable_x64:
+        dtype = x.dtype
+        words = x.view(np.uint32).reshape(x.shape + (2,))
+        gathered = np.asarray(multihost_utils.process_allgather(words))
+        assert gathered.dtype == np.uint32, gathered.dtype
+        return np.ascontiguousarray(gathered).view(dtype).reshape(
+            gathered.shape[:-1])
+    return np.asarray(multihost_utils.process_allgather(x))
+
+
+def allgather_concat(x: np.ndarray) -> np.ndarray:
+    """Concatenate each process's (variable-length, axis-0) array in process
+    order — the host-side collective behind multi-process model assembly and
+    the entity-shuffle (reference: Spark's shuffle/collect). Identity on
+    single-process runs. Shapes beyond axis 0 must agree; axis-0 lengths are
+    equalized by zero-padding to the max before the gather (collectives need
+    equal shapes), then the padding is dropped per-process."""
+    x = np.asarray(x)
+    if jax.process_count() == 1:
+        return x
+    lens = _gather_stack(np.array([x.shape[0]], np.int64)).reshape(-1)
+    m = int(lens.max())
+    if m == 0:
+        return x
+    pad = [(0, m - x.shape[0])] + [(0, 0)] * (x.ndim - 1)
+    gathered = _gather_stack(np.pad(x, pad))
+    return np.concatenate(
+        [gathered[p, :int(lens[p])] for p in range(len(lens))], axis=0)
+
+
+def allreduce_sum(x: np.ndarray) -> np.ndarray:
+    """Element-wise sum across processes (identity single-process) — e.g.
+    global entity row counts from per-process bincounts."""
+    x = np.asarray(x)
+    if jax.process_count() == 1:
+        return x
+    return _gather_stack(x).sum(axis=0).astype(x.dtype)
+
+
+def allreduce_max(x: np.ndarray) -> np.ndarray:
+    """Element-wise max across processes (identity single-process)."""
+    x = np.asarray(x)
+    if jax.process_count() == 1:
+        return x
+    return _gather_stack(x).max(axis=0).astype(x.dtype)
+
+
 def local_axis_blocks(mesh: Mesh, axis: str = DATA_AXIS) -> int:
     """How many distinct ``axis`` coordinates this process's devices cover —
     the number of data blocks this process must feed. NOT simply

@@ -207,17 +207,15 @@ print(f"MULTIHOST_OK {pid}", flush=True)
 """
 
 
-@pytest.mark.slow
-def test_two_process_distributed_smoke(tmp_path):
-    """Genuine cross-process SPMD: two workers form a jax.distributed job
-    over loopback, feed host-local halves (of different sizes) through the
-    budget-reconciled multihost path, and the psum'd objective must match a
-    numpy computation on the full data."""
+def _run_two_workers(tmp_path, script_text: str, ok_token: str,
+                     timeout: float = 240):
+    """Launch two loopback jax.distributed workers running ``script_text``
+    (argv: port, pid) and assert both exit 0 printing ``<ok_token> <pid>``."""
     with socket.socket() as s:
         s.bind(("localhost", 0))
         port = s.getsockname()[1]
     script = tmp_path / "worker.py"
-    script.write_text(_WORKER)
+    script.write_text(script_text)
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)  # workers pin their own device count
     env["PYTHONPATH"] = os.pathsep.join(
@@ -230,7 +228,7 @@ def test_two_process_distributed_smoke(tmp_path):
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=240)
+            out, _ = p.communicate(timeout=timeout)
             outs.append(out)
     except subprocess.TimeoutExpired:
         # kill both, then drain whatever each wrote so the failure shows it
@@ -246,4 +244,106 @@ def test_two_process_distributed_smoke(tmp_path):
         pytest.fail("multihost workers timed out:\n" + "\n".join(drained))
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {pid} rc={p.returncode}:\n{out}"
-        assert f"MULTIHOST_OK {pid}" in out, out
+        assert f"{ok_token} {pid}" in out, out
+
+
+@pytest.mark.slow
+def test_two_process_distributed_smoke(tmp_path):
+    """Genuine cross-process SPMD: two workers form a jax.distributed job
+    over loopback, feed host-local halves (of different sizes) through the
+    budget-reconciled multihost path, and the psum'd objective must match a
+    numpy computation on the full data."""
+    _run_two_workers(tmp_path, _WORKER, "MULTIHOST_OK")
+
+
+_GAME_WORKER = r"""
+import sys
+port, pid = sys.argv[1], int(sys.argv[2])
+from photon_ml_tpu.testing import virtual_devices
+virtual_devices(2, force_cpu=True)  # 2 local CPU devices per process
+from photon_ml_tpu.parallel import multihost
+multihost.initialize(f"localhost:{port}", 2, pid)
+import jax
+import numpy as np
+from photon_ml_tpu.testing import make_mixed_effect
+from photon_ml_tpu.game.data import RandomEffectDatasetConfig
+from photon_ml_tpu.game.estimator import (
+    FixedEffectCoordinateConfig, GameEstimator,
+    GameOptimizationConfiguration, RandomEffectCoordinateConfig)
+from photon_ml_tpu.game.multiprocess import (
+    train_game_multiprocess, _take_rows)
+from photon_ml_tpu.glm.problem import GLMOptimizationConfiguration
+from photon_ml_tpu.optimize import OptimizerConfig
+from photon_ml_tpu.ops.regularization import L2Regularization
+from photon_ml_tpu.parallel.multihost import allgather_concat
+from photon_ml_tpu.types import TaskType
+
+# both workers regenerate the identical global problem, then keep only
+# their own contiguous row shard — the "each host reads its own files" setup
+game, _ = make_mixed_effect(n=240, d_fixed=5, d_re=3, n_entities=13, seed=5)
+n = game.n_samples
+lo, hi = (0, n // 2) if pid == 0 else (n // 2, n)
+local = _take_rows(game, np.arange(lo, hi))
+
+opt = GLMOptimizationConfiguration(
+    regularization=L2Regularization,
+    optimizer_config=OptimizerConfig(max_iterations=40))
+configs = {
+    "global": FixedEffectCoordinateConfig("fixed", opt),
+    "perEntity": RandomEffectCoordinateConfig(
+        RandomEffectDatasetConfig("entityId", "re"), opt),
+}
+seq = ["global", "perEntity"]
+lam = {"global": 1e-3, "perEntity": 0.5}
+
+mp = train_game_multiprocess(
+    local, TaskType.LOGISTIC_REGRESSION, configs, seq, lam,
+    n_cd_iterations=2)
+
+# every process must own SOME rows (the partition spread work)
+re_model = mp.model.coordinates["perEntity"]
+assert len(mp.global_rows) > 0, "process owns no rows"
+
+# the assembled model must be IDENTICAL on both processes
+w = np.asarray(mp.model.coordinates["global"].model.coefficients.means)
+both_w = allgather_concat(w).reshape(2, -1)
+assert np.array_equal(both_w[0], both_w[1]), "fixed model differs"
+both_k = allgather_concat(re_model.keys).reshape(2, -1)
+assert np.array_equal(both_k[0], both_k[1]), "RE keys differ"
+both_c = allgather_concat(re_model.coeffs).reshape(2, -1)
+assert np.array_equal(both_c[0], both_c[1]), "RE coeffs differ"
+
+# equality with a single-process run on the full data (local-only compute,
+# so only worker 0 pays for it; no collectives inside)
+if pid == 0:
+    est = GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION, coordinate_configs=configs,
+        update_sequence=seq, n_cd_iterations=2)
+    ref = est.fit(game, [GameOptimizationConfiguration(lam)])[0]
+    w_ref = np.asarray(
+        ref.model.coordinates["global"].model.coefficients.means)
+    np.testing.assert_allclose(w, w_ref, atol=2e-3, rtol=2e-2)
+    re_ref = ref.model.coordinates["perEntity"]
+    assert np.array_equal(np.sort(both_k[0]), re_ref.keys), (
+        "multi-process RE key set differs from single-process")
+    # align by key (allgather order is process order, not key order)
+    order = np.argsort(both_k[0], kind="stable")
+    np.testing.assert_allclose(both_c[0][order], re_ref.coeffs,
+                               atol=2e-3, rtol=2e-2)
+    s_mp = mp.model.score(game)
+    s_ref = ref.model.score(game)
+    np.testing.assert_allclose(s_mp, s_ref, atol=5e-3)
+print(f"MULTIPROC_GAME_OK {pid}", flush=True)
+"""
+
+
+@pytest.mark.slow
+def test_two_process_game_cd(tmp_path):
+    """Full GAME coordinate descent across two real processes: dp fixed
+    effect on the global data mesh, entity-partitioned random effect solved
+    process-locally, model table assembled by allgather — asserting the
+    result is identical across processes and equal (to float tolerance) to
+    the single-process run (VERDICT r2 item 3; reference
+    ``data/RandomEffectDatasetPartitioner.scala``)."""
+    _run_two_workers(tmp_path, _GAME_WORKER, "MULTIPROC_GAME_OK",
+                     timeout=420)

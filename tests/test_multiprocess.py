@@ -1,0 +1,194 @@
+"""Multi-process GAME machinery, single-process degenerate path.
+
+Every collective in :mod:`photon_ml_tpu.game.multiprocess` is the identity
+on one process, so the partition/shuffle/CD pipeline is fully exercisable
+here; the genuine 2-process run (real allgathers, real jax.distributed) is
+``tests/test_multihost.py::test_two_process_game_cd``.
+"""
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.game.data import RandomEffectDatasetConfig
+from photon_ml_tpu.game.estimator import (
+    FixedEffectCoordinateConfig,
+    GameEstimator,
+    GameOptimizationConfiguration,
+    RandomEffectCoordinateConfig,
+)
+from photon_ml_tpu.game.multiprocess import (
+    balanced_entity_partition,
+    exchange_rows,
+    owner_of_rows,
+    train_game_multiprocess,
+)
+from photon_ml_tpu.glm.problem import GLMOptimizationConfiguration
+from photon_ml_tpu.optimize import OptimizerConfig
+from photon_ml_tpu.parallel.mesh import DATA_AXIS, make_mesh
+from photon_ml_tpu.testing import make_mixed_effect
+from photon_ml_tpu.types import TaskType
+
+
+class TestBalancedEntityPartition:
+    def test_single_process_all_zero(self):
+        assert (balanced_entity_partition(np.array([5, 3, 1]), 1) == 0).all()
+
+    def test_deterministic(self):
+        counts = np.random.default_rng(0).integers(1, 100, size=200)
+        a = balanced_entity_partition(counts, 4)
+        b = balanced_entity_partition(counts, 4)
+        np.testing.assert_array_equal(a, b)
+
+    def test_balanced_on_power_law(self):
+        # power-law entity sizes — the reference partitioner's whole reason
+        rng = np.random.default_rng(7)
+        counts = (1000 / np.arange(1, 301)).astype(np.int64)
+        owner = balanced_entity_partition(counts, 4)
+        loads = np.bincount(owner, weights=counts, minlength=4)
+        assert loads.max() <= 1.1 * loads.mean() + counts.max()
+
+    def test_total_map_includes_zero_count_entities(self):
+        owner = balanced_entity_partition(np.array([0, 0, 10, 0]), 2)
+        assert owner.shape == (4,)
+        assert set(np.unique(owner)) <= {0, 1}
+
+    def test_big_entities_spread(self):
+        # two huge entities must land on different processes
+        owner = balanced_entity_partition(np.array([100, 100, 1, 1]), 2)
+        assert owner[0] != owner[1]
+
+
+class TestExchangeRows:
+    def test_single_process_identity(self):
+        game, _ = make_mixed_effect(n=50, d_fixed=4, d_re=2, n_entities=5)
+        owned, rows = exchange_rows(game, np.zeros(50, np.int32))
+        np.testing.assert_array_equal(rows, np.arange(50))
+        np.testing.assert_array_equal(owned.labels, game.labels)
+        np.testing.assert_array_equal(
+            owned.shards["fixed"].vals, game.shards["fixed"].vals)
+
+    def test_single_process_subset(self):
+        game, _ = make_mixed_effect(n=40, d_fixed=4, d_re=2, n_entities=5)
+        dest = (np.arange(40) % 2).astype(np.int32)  # half "owned elsewhere"
+        owned, rows = exchange_rows(game, dest)
+        np.testing.assert_array_equal(rows, np.arange(0, 40, 2))
+        np.testing.assert_array_equal(owned.labels, game.labels[::2])
+        dense = game.shards["re"].to_dense()
+        np.testing.assert_allclose(owned.shards["re"].to_dense(), dense[::2])
+
+    def test_owner_of_rows_routes_missing_ids_round_robin(self):
+        ents = np.array([0, -1, 1, -1], np.int64)
+        owner_map = np.array([1, 0], np.int32)
+        dest = owner_of_rows(ents, owner_map, np.arange(4), 2)
+        np.testing.assert_array_equal(dest, [1, 1, 0, 1])
+
+
+class TestTrainMultiprocessSingleProcess:
+    """P=1: the multi-process driver must equal the standard estimator."""
+
+    @pytest.fixture(scope="class")
+    def problem(self):
+        game, _ = make_mixed_effect(n=400, d_fixed=6, d_re=3, n_entities=11,
+                                    seed=3)
+        from photon_ml_tpu.ops.regularization import L2Regularization
+
+        opt = GLMOptimizationConfiguration(
+            regularization=L2Regularization,
+            optimizer_config=OptimizerConfig(max_iterations=40))
+        configs = {
+            "global": FixedEffectCoordinateConfig("fixed", opt),
+            "perEntity": RandomEffectCoordinateConfig(
+                RandomEffectDatasetConfig("entityId", "re"), opt),
+        }
+        lam = {"global": 1e-3, "perEntity": 0.5}
+        return game, configs, lam
+
+    def test_matches_estimator(self, problem):
+        game, configs, lam = problem
+        seq = ["global", "perEntity"]
+        mp = train_game_multiprocess(
+            game, TaskType.LOGISTIC_REGRESSION, configs, seq, lam,
+            n_cd_iterations=2)
+        # baseline: the standard estimator on the SAME 8-device data mesh
+        est = GameEstimator(
+            task=TaskType.LOGISTIC_REGRESSION, coordinate_configs=configs,
+            update_sequence=seq, n_cd_iterations=2,
+            mesh=make_mesh({DATA_AXIS: 8}))
+        ref = est.fit(game, [GameOptimizationConfiguration(lam)])[0]
+
+        w_mp = np.asarray(
+            mp.model.coordinates["global"].model.coefficients.means)
+        w_ref = np.asarray(
+            ref.model.coordinates["global"].model.coefficients.means)
+        np.testing.assert_allclose(w_mp, w_ref, atol=1e-4, rtol=1e-4)
+
+        re_mp = mp.model.coordinates["perEntity"]
+        re_ref = ref.model.coordinates["perEntity"]
+        np.testing.assert_array_equal(re_mp.keys, re_ref.keys)
+        np.testing.assert_allclose(re_mp.coeffs, re_ref.coeffs,
+                                   atol=1e-4, rtol=1e-4)
+
+        # score parity on the training data (full-model join path)
+        np.testing.assert_allclose(
+            mp.model.score(game), ref.model.score(game), atol=1e-4)
+
+        # row-local score decomposition invariant
+        np.testing.assert_array_equal(mp.global_rows, np.arange(400))
+        total = game.offsets + sum(mp.scores.values())
+        rejoin = sum(m.score(game) for m in mp.model.coordinates.values())
+        np.testing.assert_allclose(total, game.offsets + rejoin, atol=2e-3)
+
+    def test_no_random_effect_fixed_only(self, problem):
+        game, configs, lam = problem
+        mp = train_game_multiprocess(
+            game, TaskType.LOGISTIC_REGRESSION,
+            {"global": configs["global"]}, ["global"], lam,
+            n_cd_iterations=1)
+        assert set(mp.model.coordinates) == {"global"}
+        assert np.isfinite(
+            np.asarray(mp.model.coordinates["global"]
+                       .model.coefficients.means)).all()
+
+    def test_unknown_coordinate_rejected(self, problem):
+        game, configs, lam = problem
+        with pytest.raises(KeyError, match="unknown coordinate"):
+            train_game_multiprocess(
+                game, TaskType.LOGISTIC_REGRESSION, configs,
+                ["global", "nope"], lam)
+
+    def test_downsampler_rejected(self, problem):
+        game, configs, lam = problem
+        from photon_ml_tpu.sampling import DownSampler
+
+        bad = {"global": dataclasses_replace_fe(
+            configs["global"], downsampler=DownSampler(rate=0.5))}
+        with pytest.raises(NotImplementedError, match="downsampler"):
+            train_game_multiprocess(
+                game, TaskType.LOGISTIC_REGRESSION, bad, ["global"], lam)
+
+    def test_random_projector_model_scores(self, problem):
+        """The assembled model must keep the shared projector so scoring
+        maps shard features into the projected key space."""
+        game, configs, lam = problem
+        from photon_ml_tpu.game.projector import ProjectorType
+
+        cfg = RandomEffectCoordinateConfig(
+            RandomEffectDatasetConfig(
+                "entityId", "re", projector_type=ProjectorType.RANDOM,
+                projected_dim=2),
+            configs["perEntity"].optimization)
+        seq = ["global", "perEntity"]
+        mp = train_game_multiprocess(
+            game, TaskType.LOGISTIC_REGRESSION,
+            {"global": configs["global"], "perEntity": cfg}, seq, lam)
+        re_model = mp.model.coordinates["perEntity"]
+        assert re_model.projector is not None
+        s = re_model.score(game)
+        assert np.isfinite(s).all()
+        assert np.abs(s).max() > 0, "projected model scored identically zero"
+
+
+def dataclasses_replace_fe(cfg, **kw):
+    import dataclasses
+
+    return dataclasses.replace(cfg, **kw)
