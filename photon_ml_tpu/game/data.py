@@ -341,6 +341,20 @@ class RandomEffectDatasetConfig:
                 f"(got {self.max_sample_buckets}/{self.max_feature_buckets})")
 
 
+def _hash_uniform(ids: np.ndarray, seed: int) -> np.ndarray:
+    """Uniform [0,1) key per id via a splitmix64 finalizer — a stateless,
+    partition-invariant substitute for a sequential rng stream: the key of a
+    row depends only on (seed, its global id), never on which other rows
+    share the batch."""
+    z = (np.asarray(ids, np.uint64)
+         + np.uint64((seed * 0x9E3779B97F4A7C15) & 0xFFFFFFFFFFFFFFFF))
+    z = (z + np.uint64(0x9E3779B97F4A7C15))
+    z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    z = z ^ (z >> np.uint64(31))
+    return z.astype(np.float64) / float(2**64)
+
+
 def _geom_at_least(x: np.ndarray, growth: float, floor: int = 1) -> np.ndarray:
     """Elementwise next integer power of ``growth`` ≥ max(x, floor)."""
     x = np.maximum(np.asarray(x, np.int64), floor)
@@ -480,6 +494,7 @@ class RandomEffectDataset:
               config: RandomEffectDatasetConfig,
               projector: Optional[RandomProjector] = None,
               use_native: Optional[bool] = None,
+              sample_uids: Optional[np.ndarray] = None,
               ) -> "RandomEffectDataset":
         """``projector`` overrides the seeded Gaussian matrix for the RANDOM
         path — the factored coordinate passes its LEARNED projection here
@@ -487,11 +502,16 @@ class RandomEffectDataset:
         projection update). ``use_native`` pins the bucket packer
         (``native/bucket_pack.cc`` vs the numpy formulation — identical
         outputs, see tests/test_native.py::TestNativeBucketPackParity);
-        None auto-picks native when the library loads."""
+        None auto-picks native when the library loads. ``sample_uids``
+        (default ``arange(n)``) are the stable global ids keying the
+        active-bound subsample draw — multi-process training passes each
+        row's global id so the kept subset is identical under any row
+        partition."""
         shard = data.shards[config.feature_shard_id]
         entities = data.id_columns[config.random_effect_type]
         n = data.n_samples
-        rng = np.random.default_rng(config.seed)
+        if sample_uids is None:
+            sample_uids = np.arange(n, dtype=np.int64)
 
         present = entities >= 0
         order = np.argsort(entities[present], kind="stable")
@@ -526,8 +546,12 @@ class RandomEffectDataset:
             # entity's segment, keep ranks < upper (uniform without
             # replacement, one global vectorized pass). Skipped entirely
             # when no entity exceeds the bound — the common case shouldn't
-            # pay the O(n log n) lexsort.
-            keys = rng.random(n_rows)
+            # pay the O(n log n) lexsort. The rank key is a counter-based
+            # hash of (seed, global sample id) — NOT a sequential rng
+            # stream — so the kept subset is a pure per-row function:
+            # identical under any row partition (multi-process builds) and
+            # stable when other entities' rows come or go.
+            keys = _hash_uniform(sample_uids[sample_rows], config.seed)
             order2 = np.lexsort((keys, seg_of_row))
             ranks = np.empty(n_rows, np.int64)
             ranks[order2] = np.arange(n_rows) - np.repeat(seg_start, seg_count)

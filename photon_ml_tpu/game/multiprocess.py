@@ -90,8 +90,9 @@ def balanced_entity_partition(row_counts: np.ndarray,
     sampled frequency table.
 
     Returns an ``(n_entities,)`` int32 array of process ids. Entities with
-    zero rows are still assigned (round-robin via the same greedy), so the
-    map is total.
+    zero rows are still assigned (they all land on whatever process is
+    least-loaded after the real entities — harmless, they carry no data),
+    so the map is total.
     """
     counts = np.asarray(row_counts, np.int64)
     n_processes = int(n_processes)
@@ -348,10 +349,10 @@ def train_game_multiprocess(
             raise KeyError(f"update sequence names unknown coordinate {cid!r}")
 
     n_local = game_local.n_samples
-    n_global = int(allreduce_sum(np.array([n_local], np.int64))[0])
-    base = np.concatenate([[0], np.cumsum(
-        allgather_concat(np.array([n_local], np.int64)))])[
-        jax.process_index()]
+    # one gather yields both the global row count and this process's base
+    counts = allgather_concat(np.array([n_local], np.int64))
+    n_global = int(counts.sum())
+    base = int(np.concatenate([[0], np.cumsum(counts)])[jax.process_index()])
     local_global_rows = base + np.arange(n_local, dtype=np.int64)
 
     # --- entity partitions: one owner map per RE entity type --------------
@@ -370,13 +371,31 @@ def train_game_multiprocess(
 
     # --- primary row partition + shuffle ----------------------------------
     primary_type = re_types[0] if re_types else None
-    if primary_type is not None:
+    if primary_type is None:
+        # fixed-effects only: rows stay where they were read — no shuffle
+        game_primary, primary_rows = game_local, local_global_rows
+    else:
+        # ship only what the primary-partition coordinates read: fixed
+        # shards + the primary RE coordinate's shard and entity column
+        # (non-primary coordinates run their own slim exchange below)
+        need_shards = set()
+        for cid in update_sequence:
+            cfg = coordinate_configs[cid]
+            if isinstance(cfg, FixedEffectCoordinateConfig):
+                need_shards.add(cfg.feature_shard_id)
+            elif (isinstance(cfg, RandomEffectCoordinateConfig)
+                  and cfg.dataset.random_effect_type == primary_type):
+                need_shards.add(cfg.dataset.feature_shard_id)
+        slim_primary = GameData(
+            labels=game_local.labels, offsets=game_local.offsets,
+            weights=game_local.weights,
+            shards={k: v for k, v in game_local.shards.items()
+                    if k in need_shards},
+            id_columns={primary_type: game_local.id_columns[primary_type]})
         dest = owner_of_rows(game_local.id_columns[primary_type],
                              owner_by_type[primary_type],
                              local_global_rows, n_proc)
-    else:
-        dest = np.full(n_local, jax.process_index(), np.int32)
-    game_primary, primary_rows = exchange_rows(game_local, dest)
+        game_primary, primary_rows = exchange_rows(slim_primary, dest)
 
     # --- per-coordinate builds --------------------------------------------
     if fe_mesh is None:
@@ -401,15 +420,26 @@ def train_game_multiprocess(
             if t == primary_type:
                 game_c, rows_c, is_primary = game_primary, primary_rows, True
             else:
+                # exchange only what this coordinate reads — its feature
+                # shard and entity column — not the whole dataset (the
+                # allgather otherwise ships every shard to every process)
+                slim = GameData(
+                    labels=game_local.labels, offsets=game_local.offsets,
+                    weights=game_local.weights,
+                    shards={cfg.dataset.feature_shard_id:
+                            game_local.shards[cfg.dataset.feature_shard_id]},
+                    id_columns={t: game_local.id_columns[t]})
                 dest_c = owner_of_rows(
                     game_local.id_columns[t], owner_by_type[t],
                     local_global_rows, n_proc)
-                game_c, rows_c = exchange_rows(game_local, dest_c)
+                game_c, rows_c = exchange_rows(slim, dest_c)
                 is_primary = False
-            # drop entities this process does NOT own from training: rows
-            # of owned entities are complete here by construction, so the
-            # per-process dataset covers exactly its entities
-            ds = RandomEffectDataset.build(cid, game_c, cfg.dataset)
+            # rows of owned entities are complete here by construction, so
+            # the per-process dataset covers exactly its entities; global
+            # row ids key the active-bound subsample draw so the kept
+            # subset matches the single-process build exactly
+            ds = RandomEffectDataset.build(cid, game_c, cfg.dataset,
+                                           sample_uids=rows_c)
             re_plans[cid] = _REPlan(
                 config=cfg.dataset, optimization=cfg.optimization,
                 game=game_c, global_rows=rows_c, dataset=ds,

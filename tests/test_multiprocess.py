@@ -157,10 +157,12 @@ class TestTrainMultiprocessSingleProcess:
                 ["global", "nope"], lam)
 
     def test_downsampler_rejected(self, problem):
+        import dataclasses
+
         game, configs, lam = problem
         from photon_ml_tpu.sampling import DownSampler
 
-        bad = {"global": dataclasses_replace_fe(
+        bad = {"global": dataclasses.replace(
             configs["global"], downsampler=DownSampler(rate=0.5))}
         with pytest.raises(NotImplementedError, match="downsampler"):
             train_game_multiprocess(
@@ -188,7 +190,40 @@ class TestTrainMultiprocessSingleProcess:
         assert np.abs(s).max() > 0, "projected model scored identically zero"
 
 
-def dataclasses_replace_fe(cfg, **kw):
-    import dataclasses
+class TestSubsamplePartitionInvariance:
+    """The active-bound reservoir draw must be a pure function of
+    (seed, global sample id): a per-process build over a row subset keeps
+    exactly the rows the single-process build keeps."""
 
-    return dataclasses.replace(cfg, **kw)
+    def test_upper_bound_draw_is_partition_invariant(self):
+        from photon_ml_tpu.game.data import (
+            RandomEffectDataset,
+            RandomEffectDatasetConfig,
+        )
+        from photon_ml_tpu.game.multiprocess import _take_rows
+
+        game, _ = make_mixed_effect(n=600, d_fixed=4, d_re=3, n_entities=6,
+                                    seed=9)
+        cfg = RandomEffectDatasetConfig("entityId", "re",
+                                        active_data_upper_bound=20)
+        full = RandomEffectDataset.build("re", game, cfg)
+
+        # partition rows: entities {0,2,4} -> part A, {1,3,5} -> part B
+        ents = game.id_columns["entityId"]
+        rows_a = np.flatnonzero(ents % 2 == 0).astype(np.int64)
+        part_a = RandomEffectDataset.build(
+            "re", _take_rows(game, rows_a), cfg, sample_uids=rows_a)
+
+        def active_rows(ds, uids):
+            out = set()
+            for b in ds.buckets:
+                sel = b.sample_idx[b.sample_idx >= 0]
+                out.update(int(u) for u in uids[sel])
+            return out
+
+        full_rows = active_rows(full, np.arange(game.n_samples))
+        a_rows = active_rows(part_a, rows_a)
+        expected = {r for r in full_rows if ents[r] % 2 == 0}
+        assert a_rows == expected, (
+            "per-process subsample kept different rows than the "
+            "single-process draw")
