@@ -40,12 +40,38 @@ class CoordinateDescentResult:
     final_evaluation: object = None  # Optional[EvaluationResults]
 
 
+def _device_memory_bytes() -> int:
+    """Best-effort per-device memory limit (used by the score-memory
+    guard); a conservative 16 GiB (v5e HBM) when the backend won't say."""
+    import jax
+
+    try:
+        stats = jax.devices()[0].memory_stats()
+        if stats and "bytes_limit" in stats:
+            return int(stats["bytes_limit"])
+    except Exception:
+        pass
+    return 16 << 30
+
+
 @dataclasses.dataclass(frozen=True)
 class CoordinateDescent:
-    """Drives the sweep loop over an ordered update sequence."""
+    """Drives the sweep loop over an ordered update sequence.
+
+    ``max_score_memory_bytes`` guards the memory cliff of the
+    device-resident score decomposition: the run holds K+1 vectors of
+    ``n_samples`` f32 on device (K coordinate scores + the running total).
+    The DESIGN hits HBM first in practice (≥8x the footprint — ROADMAP
+    item 5), but past ~2-3 B samples/chip the decomposition itself stops
+    fitting; rather than an opaque allocator failure mid-sweep, the run
+    refuses up front with guidance. ``None`` → half the device's memory;
+    the sharded-score prototype (tests/test_sharded_scores.py) is the
+    escape hatch when a workload genuinely crosses the cliff.
+    """
 
     update_sequence: Sequence[str]
     n_iterations: int = 1
+    max_score_memory_bytes: Optional[int] = None
 
     def run(
         self,
@@ -74,6 +100,23 @@ class CoordinateDescent:
                 raise KeyError(f"update sequence names unknown coordinate {cid!r}")
 
         import jax.numpy as jnp
+
+        # memory-cliff guard: K coordinate score vectors + the running
+        # total, all device-resident f32 for the whole run
+        score_bytes = (len(self.update_sequence) + 1) * data.n_samples * 4
+        budget = (self.max_score_memory_bytes
+                  if self.max_score_memory_bytes is not None
+                  else _device_memory_bytes() // 2)
+        if score_bytes > budget:
+            raise ValueError(
+                f"score decomposition needs {score_bytes / 2**30:.1f} GiB "
+                f"device memory ({len(self.update_sequence)}+1 vectors x "
+                f"{data.n_samples} samples x 4 B) — over the "
+                f"{budget / 2**30:.1f} GiB budget. Shard the run across "
+                f"more chips/processes (game/multiprocess.py), or raise "
+                f"max_score_memory_bytes if you know the design fits; the "
+                f"data-sharded score prototype is "
+                f"tests/test_sharded_scores.py (ROADMAP item 5)")
 
         models: dict[str, CoordinateModel] = dict(initial_models or {})
         # The score decomposition lives ON DEVICE for the whole run (ROADMAP

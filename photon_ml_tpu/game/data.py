@@ -38,9 +38,20 @@ from photon_ml_tpu.ops.design import CsrDesign, DenseDesign
 from photon_ml_tpu.ops.objective import GLMData
 from photon_ml_tpu.util import group_starts as _group_starts
 
-#: Fixed-effect designs at or below this width are densified (MXU path);
-#: wider ones stay sparse.
+#: Fixed-effect designs at or below this width always densify (MXU path)
+#: when they fit the byte cap; above it the measured crossover rule decides.
 DENSE_DESIGN_MAX_DIM = 4096
+#: largest measured dim/(nnz-per-row) ratio at which the dense layout still
+#: beat the chunked-sparse one on-chip (tools/layout_crossover.py).
+DENSE_CROSSOVER_NNZ_MULT = 512
+#: per-device byte cap for a densified design — a wide-but-dense shard must
+#: not densify itself into an OOM (v5e HBM is 16 GiB; the solve also holds
+#: gradients, scores and, under GAME, the RE buckets).
+DENSE_DESIGN_MAX_BYTES = 4 << 30
+#: HOST byte cap for the densified design: the build materializes the full
+#: (n, d) float32 array in host RAM before any device split, so the
+#: per-device cap alone would let an 8-shard build allocate 8x it on host.
+DENSE_DESIGN_MAX_HOST_BYTES = 8 << 30
 
 
 @dataclasses.dataclass(frozen=True)
@@ -160,11 +171,77 @@ class GameData:
 # ---------------------------------------------------------------------------
 
 
-def host_design_for_shard(shard: FeatureShard, dense_max_dim: int):
-    """Host-resident design for a fixed-effect shard: densified at or below
-    ``dense_max_dim`` (MXU path), CSR above it. The single home of the
-    dense/sparse cutover — the single- and multi-process feeds must agree."""
-    if shard.dim <= dense_max_dim:
+def choose_dense_design(shard: FeatureShard, *, n_shards: int = 1,
+                        dense_max_dim: Optional[int] = None) -> bool:
+    """Dense vs chunked-sparse layout pick for a fixed-effect design —
+    the measured crossover rule (VERDICT r2 item 4, SURVEY.md §7
+    hard-part #2). With ``dense_max_dim`` given, the old hard threshold
+    applies unchanged (explicit caller override).
+
+    Measured on the axon TPU v5e, 2026-07-31 (`tools/layout_crossover.py`:
+    chained jitted ``value_and_grad`` iterations, min-of-2 passes, D2H
+    sync; k = nnz/row; n scaled so the dense tensor is ~1 GB):
+
+    ====== ===== ========= ========== ========
+    d      k     dense_ms  sparse_ms  winner
+    ====== ===== ========= ========== ========
+    512    8     15.1      66.0       dense 4.4x
+    512    128   13.6      901.0      dense 66x
+    2048   8     16.0      23.1       dense 1.4x
+    4096   8     15.9      16.9       dense 1.06x
+    8192   8     15.9      12.8       sparse 1.2x
+    8192   32    11.7      25.2       dense 2.2x
+    16384  32    16.1      21.4       dense 1.3x
+    16384  128   19.7      56.7       dense 2.9x
+    65536  8-128 (bytes)   17-54      sparse
+    ====== ===== ========= ========== ========
+
+    Model behind the numbers: the dense iteration streams ``n*d*4`` bytes
+    at ~170 GB/s effective (two-pass closed form), while the chunked
+    sparse iteration pays ~16-20 ns/nnz (two XLA random-gather passes) —
+    so dense wins while ``d ≲ 600*k``. The rule uses 512, the largest
+    measured d/k where dense still won, and caps the dense tensor's
+    per-device bytes so a billion-row shard can't densify into an OOM.
+    """
+    return choose_dense_design_stats(shard.n_samples, shard.dim, shard.nnz,
+                                     n_shards=n_shards,
+                                     dense_max_dim=dense_max_dim)
+
+
+def choose_dense_design_stats(n_samples: int, dim: int, nnz: int, *,
+                              n_shards: int = 1,
+                              dense_max_dim: Optional[int] = None,
+                              n_local_samples: Optional[int] = None) -> bool:
+    """The rule of :func:`choose_dense_design` on explicit statistics —
+    multi-process training calls this with GLOBALLY allreduced (n, nnz) so
+    every process picks the same layout (an SPMD program must agree).
+    ``n_local_samples`` bounds the HOST materialization (the build holds
+    the full local (n, d) float32 array before the device split); defaults
+    to ``n_samples`` (single-process: local = global)."""
+    if dense_max_dim is not None:
+        return dim <= dense_max_dim
+    n_local = n_samples if n_local_samples is None else n_local_samples
+    if n_local * dim * 4 > DENSE_DESIGN_MAX_HOST_BYTES:
+        return False
+    if n_samples * dim * 4 // max(n_shards, 1) > DENSE_DESIGN_MAX_BYTES:
+        return False
+    if dim <= DENSE_DESIGN_MAX_DIM:
+        return True
+    return dim <= DENSE_CROSSOVER_NNZ_MULT * (nnz / max(n_samples, 1))
+
+
+def host_design_for_shard(shard: FeatureShard, *,
+                          dense_max_dim: Optional[int] = None,
+                          n_shards: int = 1,
+                          force_dense: Optional[bool] = None):
+    """Host-resident design for a fixed-effect shard, laid out per
+    :func:`choose_dense_design`. The single home of the dense/sparse
+    cutover — the single- and multi-process feeds must agree
+    (``force_dense`` carries a decision already agreed across processes)."""
+    dense = (force_dense if force_dense is not None
+             else choose_dense_design(shard, n_shards=n_shards,
+                                      dense_max_dim=dense_max_dim))
+    if dense:
         return DenseDesign(x=shard.to_dense())
     return CsrDesign(
         rows=shard.rows().astype(np.int32),
@@ -199,19 +276,19 @@ class FixedEffectDataset:
 
     @staticmethod
     def build(coordinate_id: str, data: GameData, feature_shard_id: str,
-              *, dense_max_dim: int = DENSE_DESIGN_MAX_DIM,
+              *, dense_max_dim: Optional[int] = None,
               dtype=jnp.float32, mesh=None) -> "FixedEffectDataset":
         shard = data.shards[feature_shard_id]
-        # host-resident design first: the sharded branch pads/splits on host
-        # and device_puts per-shard blocks directly — never materializing
-        # the full design in one device's HBM (the whole point of dp)
-        host_design = host_design_for_shard(shard, dense_max_dim)
-
         from photon_ml_tpu.parallel.mesh import DATA_AXIS
 
         n_shards = 1
         if mesh is not None and DATA_AXIS in getattr(mesh, "shape", {}):
             n_shards = int(mesh.shape[DATA_AXIS])
+        # host-resident design first: the sharded branch pads/splits on host
+        # and device_puts per-shard blocks directly — never materializing
+        # the full design in one device's HBM (the whole point of dp)
+        host_design = host_design_for_shard(
+            shard, dense_max_dim=dense_max_dim, n_shards=n_shards)
         if n_shards > 1:
             from photon_ml_tpu.parallel.distributed import shard_glm_data
 

@@ -114,6 +114,9 @@ class GameEstimator:
     update_sequence: Sequence[str]
     n_cd_iterations: int = 1
     mesh: Optional[object] = None
+    #: plumbed to CoordinateDescent's score-memory guard (None = half the
+    #: device's memory; the guard's error message names this knob)
+    max_score_memory_bytes: Optional[int] = None
 
     def __post_init__(self):
         # coordinates may be absent from configs only if locked at fit time
@@ -238,8 +241,10 @@ class GameEstimator:
             raise ValueError("checkpointing supports exactly one configuration")
         if datasets is None:
             datasets = self.prepare(data, locked=locked)
-        cd = CoordinateDescent(update_sequence=self.update_sequence,
-                               n_iterations=self.n_cd_iterations)
+        cd = CoordinateDescent(
+            update_sequence=self.update_sequence,
+            n_iterations=self.n_cd_iterations,
+            max_score_memory_bytes=self.max_score_memory_bytes)
         results: list[GameResult] = []
         for config in configurations:
             coordinates = self._coordinates(data, datasets, config, locked)

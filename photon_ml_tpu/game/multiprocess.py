@@ -52,7 +52,6 @@ from typing import Mapping, Optional, Sequence
 import numpy as np
 
 from photon_ml_tpu.game.data import (
-    DENSE_DESIGN_MAX_DIM,
     FeatureShard,
     GameData,
     RandomEffectDataset,
@@ -210,7 +209,7 @@ class MultiProcessFixedEffectDataset:
     @staticmethod
     def build(coordinate_id: str, game_owned: GameData,
               feature_shard_id: str, mesh,
-              *, dense_max_dim: int = DENSE_DESIGN_MAX_DIM,
+              *, dense_max_dim: Optional[int] = None,
               ) -> "MultiProcessFixedEffectDataset":
         from photon_ml_tpu.parallel.mesh import DATA_AXIS
         from photon_ml_tpu.parallel.multihost import (
@@ -218,8 +217,24 @@ class MultiProcessFixedEffectDataset:
             local_axis_blocks,
         )
 
+        from photon_ml_tpu.game.data import choose_dense_design_stats
+        from photon_ml_tpu.parallel.multihost import (
+            allreduce_max,
+            allreduce_sum,
+        )
+
         shard = game_owned.shards[feature_shard_id]
-        host_design = host_design_for_shard(shard, dense_max_dim)
+        # layout decision on GLOBAL stats: local (n, nnz) differ per
+        # process, and an SPMD program needs every process on one layout.
+        # The host cap uses the LARGEST process's local n (the binding
+        # host materialization), max-reduced so everyone agrees.
+        g = allreduce_sum(np.array([shard.n_samples, shard.nnz], np.int64))
+        n_loc = int(allreduce_max(np.array([shard.n_samples], np.int64))[0])
+        dense = choose_dense_design_stats(
+            int(g[0]), shard.dim, int(g[1]),
+            n_shards=int(mesh.shape[DATA_AXIS]), dense_max_dim=dense_max_dim,
+            n_local_samples=n_loc)
+        host_design = host_design_for_shard(shard, force_dense=dense)
         local = GLMData(design=host_design, labels=game_owned.labels,
                         offsets=np.zeros(shard.n_samples, np.float32),
                         weights=game_owned.weights)

@@ -157,9 +157,9 @@ class RandomEffectSolver:
         return jnp.einsum("esd,ed->es", x, w,
                           preferred_element_type=jnp.float32)
 
-    @partial(jax.jit, static_argnames=("self", "e_reals"))
+    @partial(jax.jit, static_argnames=("self", "e_reals", "out_sharding"))
     def _sweep_fused(self, offsets_dev, lam, statics, warm_ctxs, coeffs_warm,
-                     cidxs, e_reals):
+                     cidxs, e_reals, out_sharding=None):
         """One program for the WHOLE coordinate sweep: per bucket, gather
         residual offsets, gather warm starts from the previous sweep's
         coefficient table, solve, compute margins, scatter into the score
@@ -195,6 +195,12 @@ class RandomEffectSolver:
             flat_v.append(jnp.asarray(variances)[:e_real].reshape(-1))
             coef_parts.append(
                 w_dev[:e_real].reshape(-1)[cidx].astype(jnp.float32))
+        if out_sharding is not None:
+            # keep the score vector in the caller's (e.g. data-axis) layout:
+            # without the constraint GSPMD replicates the scatter output,
+            # silently un-sharding the CD score decomposition
+            # (tests/test_sharded_scores.py — ROADMAP item 5 prototype)
+            scores = jax.lax.with_sharding_constraint(scores, out_sharding)
         batched = jnp.concatenate(flat_w + flat_v)
         return scores, batched, jnp.concatenate(coef_parts)
 
@@ -470,9 +476,16 @@ class RandomEffectSolver:
             cidxs = tuple(self._coef_idx(dataset, i, b)
                           for i, b in enumerate(buckets))
             e_reals = tuple(b.n_entities for b in buckets)
+            # preserve a caller-supplied data sharding on the score vector
+            # (sharded-score prototype; None = default single-layout path)
+            from jax.sharding import NamedSharding as _NS
+
+            off_sharding = getattr(offsets_dev, "sharding", None)
+            out_sharding = (off_sharding if isinstance(off_sharding, _NS)
+                            and tuple(off_sharding.spec) else None)
             scores, batched_dev, coeffs_unsorted = self._sweep_fused(
                 offsets_dev, lam_dev, statics, warm_ctxs, coeffs_warm,
-                cidxs, e_reals)
+                cidxs, e_reals, out_sharding=out_sharding)
             dev_coeff_parts.append(coeffs_unsorted)
             batched = np.asarray(batched_dev)  # the sweep's single D2H
             d_of = [int(b.x.shape[2]) for b in buckets]

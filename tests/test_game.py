@@ -450,16 +450,19 @@ class TestMultiRandomEffect:
 
 class TestWideSparseFixedEffect:
     def test_csr_fixed_effect_sharded_matches_unsharded(self):
-        """A shard wider than DENSE_DESIGN_MAX_DIM takes the CSR path; the
-        dp-sharded solve must match the unsharded one (the reference's
-        sparse-feature fixed effect regime)."""
+        """A wide sparse shard on the chunked path; the dp-sharded solve
+        must match the unsharded one (the reference's sparse-feature fixed
+        effect regime). ``dense_max_dim`` is pinned explicitly: the auto
+        crossover rule (choose_dense_design) would pick DENSE at this
+        (d=5000, k=10) point — 5000 < 512*10 — which is exactly its job;
+        this test exists to exercise the sparse path."""
         import jax
 
         from photon_ml_tpu.ops.design import CsrDesign
         from photon_ml_tpu.parallel.mesh import DATA_AXIS, make_mesh
 
         rng = np.random.default_rng(0)
-        n, d, nnz_per_row = 600, 5000, 10  # d > DENSE_DESIGN_MAX_DIM=4096
+        n, d, nnz_per_row = 600, 5000, 10
         rows = np.repeat(np.arange(n), nnz_per_row)
         cols = rng.integers(0, d, size=n * nnz_per_row).astype(np.int32)
         vals = rng.normal(size=n * nnz_per_row).astype(np.float32)
@@ -472,7 +475,8 @@ class TestWideSparseFixedEffect:
             regularization=L2Regularization,
             optimizer_config=OptimizerConfig(max_iterations=30))
 
-        ds0 = FixedEffectDataset.build("fe", data, "wide")
+        ds0 = FixedEffectDataset.build("fe", data, "wide",
+                               dense_max_dim=4096)
         from photon_ml_tpu.ops.design import ChunkedSparseDesign
         assert isinstance(ds0.design, ChunkedSparseDesign)
         c0 = FixedEffectCoordinate(
@@ -481,7 +485,8 @@ class TestWideSparseFixedEffect:
         m0, s0 = c0.train(np.zeros(n, np.float32))
 
         mesh = make_mesh({DATA_AXIS: 8}, devices=jax.devices())
-        ds1 = FixedEffectDataset.build("fe", data, "wide", mesh=mesh)
+        ds1 = FixedEffectDataset.build("fe", data, "wide", mesh=mesh,
+                               dense_max_dim=4096)
         assert ds1.n_shards == 8
         c1 = FixedEffectCoordinate(
             coordinate_id="fe", dataset=ds1,

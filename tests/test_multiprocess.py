@@ -190,6 +190,73 @@ class TestTrainMultiprocessSingleProcess:
         assert np.abs(s).max() > 0, "projected model scored identically zero"
 
 
+class TestDenseSparseCrossover:
+    """The measured auto layout pick (tools/layout_crossover.py table)."""
+
+    def _shard(self, n, d, k, seed=0):
+        from photon_ml_tpu.game.data import FeatureShard
+
+        rng = np.random.default_rng(seed)
+        rows = np.repeat(np.arange(n), k)
+        cols = rng.integers(0, d, size=n * k).astype(np.int32)
+        vals = rng.normal(size=n * k).astype(np.float32)
+        return FeatureShard.from_coo(rows, cols, vals, n, d)
+
+    def test_narrow_always_dense(self):
+        from photon_ml_tpu.game.data import choose_dense_design
+
+        assert choose_dense_design(self._shard(500, 512, 8))
+
+    def test_wide_dense_enough_rows_picks_dense(self):
+        from photon_ml_tpu.game.data import choose_dense_design
+
+        # d=5000, k=32: 5000 < 512*32 — dense wins on-chip (measured)
+        assert choose_dense_design(self._shard(500, 5000, 32))
+
+    def test_wide_sparse_picks_sparse(self):
+        from photon_ml_tpu.game.data import choose_dense_design
+
+        # d=8192, k=8: 8192 > 512*8 — sparse won on-chip (measured 1.25x)
+        assert not choose_dense_design(self._shard(500, 8192, 8))
+
+    def test_bytes_cap_blocks_huge_dense(self):
+        from photon_ml_tpu.game.data import choose_dense_design_stats
+
+        # 1e9 rows x 512 dims = 2 TB dense — must stay sparse at any k
+        assert not choose_dense_design_stats(10**9, 512, 10**9 * 128)
+        # sharding over enough devices re-admits dense on the DEVICE cap,
+        # but only when each process's host slice also fits the host cap
+        assert not choose_dense_design_stats(10**9, 512, 10**9 * 128,
+                                             n_shards=1024)
+        assert choose_dense_design_stats(10**9, 512, 10**9 * 128,
+                                         n_shards=1024,
+                                         n_local_samples=10**6)
+        # the device cap binds alone when the host slice is small
+        assert not choose_dense_design_stats(10**9, 512, 10**9 * 128,
+                                             n_shards=2,
+                                             n_local_samples=10**6)
+        assert choose_dense_design_stats(10**6, 512, 10**6 * 128)
+
+    def test_explicit_override_wins(self):
+        from photon_ml_tpu.game.data import choose_dense_design
+
+        s = self._shard(500, 5000, 32)
+        assert not choose_dense_design(s, dense_max_dim=4096)
+        assert choose_dense_design(s, dense_max_dim=8192)
+
+    def test_build_uses_the_rule(self):
+        from photon_ml_tpu.game.data import FixedEffectDataset, GameData
+        from photon_ml_tpu.ops.design import ChunkedSparseDesign, DenseDesign
+
+        for d, k, expect in ((5000, 32, DenseDesign),
+                             (8192, 8, ChunkedSparseDesign)):
+            shard = self._shard(400, d, k)
+            game = GameData.build(
+                labels=np.zeros(400, np.float32), shards={"s": shard})
+            ds = FixedEffectDataset.build("fe", game, "s")
+            assert isinstance(ds.design, expect), (d, k, type(ds.design))
+
+
 class TestSubsamplePartitionInvariance:
     """The active-bound reservoir draw must be a pure function of
     (seed, global sample id): a per-process build over a row subset keeps
