@@ -108,6 +108,17 @@ def _setup_compile_cache():
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
 
+def _generator_tag(fn, args) -> str:
+    """Cache key for a generator function: args + bytecode + CONSTANTS.
+    ``co_code`` alone stores only indices into ``co_consts`` — editing a
+    literal (a seed, a scale) would otherwise silently reuse stale data."""
+    import hashlib
+
+    return hashlib.sha1(
+        repr(args).encode() + b"|" + fn.__code__.co_code + b"|"
+        + repr(fn.__code__.co_consts).encode()).hexdigest()[:10]
+
+
 def _cached_fixture(name: str, fn, *args) -> str:
     """Deterministic Avro fixtures cached across bench runs (the pure-Python
     encode of a 1e5-row file costs ~10 s — prep, not measurement).
@@ -117,10 +128,7 @@ def _cached_fixture(name: str, fn, *args) -> str:
     invalidates the cached file instead of silently benchmarking stale
     data. Per-user temp name + unique staging file avoid cross-user
     collisions and concurrent-run races in the shared temp dir."""
-    import hashlib
-
-    tag = hashlib.sha1(repr(args).encode() + b"|"
-                       + fn.__code__.co_code).hexdigest()[:10]
+    tag = _generator_tag(fn, args)
     path = os.path.join(
         tempfile.gettempdir(),
         f"photon_bench_{os.getuid()}_{name}_{tag}.avro")
@@ -135,6 +143,32 @@ def _cached_fixture(name: str, fn, *args) -> str:
             if os.path.exists(tmp):
                 os.unlink(tmp)
     return path
+
+
+def _cached_npz(name: str, fn, *args) -> dict:
+    """Deterministic numpy fixtures cached across bench runs (generating
+    the 10M-row random-effect problem costs ~40 s of rng/alias-sampling —
+    prep, not measurement). Same keying discipline as
+    :func:`_cached_fixture`: args + the generator's bytecode."""
+    import hashlib
+
+    tag = _generator_tag(fn, args)
+    path = os.path.join(
+        tempfile.gettempdir(),
+        f"photon_bench_{os.getuid()}_{name}_{tag}.npz")
+    if not os.path.exists(path):
+        arrays = fn(*args)
+        fd, tmp = tempfile.mkstemp(dir=tempfile.gettempdir(),
+                                   suffix=".npz.tmp")
+        os.close(fd)
+        try:
+            with open(tmp, "wb") as f:
+                np.savez(f, **arrays)
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+    return dict(np.load(path))
 
 
 _T0 = time.perf_counter()
@@ -258,12 +292,7 @@ def bench_glm():
 # 3. random-effect bucketed solve at scale
 # --------------------------------------------------------------------------
 
-def _make_re_problem(n=None, n_entities=None, d=RE_DIM, seed=0):
-    from photon_ml_tpu.game.data import GameData
-    from photon_ml_tpu.testing import dense_shard
-
-    n = RE_ROWS if n is None else n
-    n_entities = RE_ENTITIES if n_entities is None else n_entities
+def _gen_re_arrays(n, n_entities, d, seed):
     prng = np.random.default_rng(4242)
     u = (1.2 * prng.normal(size=(n_entities, d))).astype(np.float32)
     rng = np.random.default_rng(seed)
@@ -275,6 +304,17 @@ def _make_re_problem(n=None, n_entities=None, d=RE_DIM, seed=0):
     ent = rng.choice(n_entities, size=n, p=probs).astype(np.int64)
     margin = np.einsum("nd,nd->n", xr, u[ent])
     y = (rng.uniform(size=n) < 1.0 / (1.0 + np.exp(-margin))).astype(np.float32)
+    return {"xr": xr, "y": y, "ent": ent}
+
+
+def _make_re_problem(n=None, n_entities=None, d=RE_DIM, seed=0):
+    from photon_ml_tpu.game.data import GameData
+    from photon_ml_tpu.testing import dense_shard
+
+    n = RE_ROWS if n is None else n
+    n_entities = RE_ENTITIES if n_entities is None else n_entities
+    a = _cached_npz("re", _gen_re_arrays, n, n_entities, d, seed)
+    xr, y, ent = a["xr"], a["y"], a["ent"]
     data = GameData.build(
         labels=y, shards={"re": dense_shard(xr)},
         id_columns={"entityId": ent})
@@ -361,17 +401,14 @@ def bench_random_effect():
 # 4. full coordinate-descent sweep (fixed + 2 random effects)
 # --------------------------------------------------------------------------
 
-def _make_cd_problem(n, users, songs, seed=0):
-    from photon_ml_tpu.game.data import GameData
-    from photon_ml_tpu.testing import dense_shard
-
+def _gen_cd_arrays(n, users, songs, seed, d_fixed, d_re):
     prng = np.random.default_rng(777)
-    w_fixed = prng.normal(size=CD_D_FIXED).astype(np.float32)
-    uu = (1.0 * prng.normal(size=(users, CD_D_RE))).astype(np.float32)
-    us = (0.7 * prng.normal(size=(songs, CD_D_RE))).astype(np.float32)
+    w_fixed = prng.normal(size=d_fixed).astype(np.float32)
+    uu = (1.0 * prng.normal(size=(users, d_re))).astype(np.float32)
+    us = (0.7 * prng.normal(size=(songs, d_re))).astype(np.float32)
     rng = np.random.default_rng(seed)
-    xf = rng.normal(size=(n, CD_D_FIXED)).astype(np.float32)
-    xi = rng.normal(size=(n, CD_D_RE)).astype(np.float32)
+    xf = rng.normal(size=(n, d_fixed)).astype(np.float32)
+    xi = rng.normal(size=(n, d_re)).astype(np.float32)
     pu = 1.0 / np.arange(1, users + 1); pu /= pu.sum()
     ps = 1.0 / np.arange(1, songs + 1); ps /= ps.sum()
     user = rng.choice(users, size=n, p=pu).astype(np.int64)
@@ -379,6 +416,16 @@ def _make_cd_problem(n, users, songs, seed=0):
     margin = (xf @ w_fixed + np.einsum("nd,nd->n", xi, uu[user])
               + np.einsum("nd,nd->n", xi, us[song]))
     y = (rng.uniform(size=n) < 1.0 / (1.0 + np.exp(-margin))).astype(np.float32)
+    return {"xf": xf, "xi": xi, "user": user, "song": song, "y": y}
+
+
+def _make_cd_problem(n, users, songs, seed=0):
+    from photon_ml_tpu.game.data import GameData
+    from photon_ml_tpu.testing import dense_shard
+
+    a = _cached_npz("cd", _gen_cd_arrays, n, users, songs, seed,
+                    CD_D_FIXED, CD_D_RE)
+    xf, xi, user, song, y = a["xf"], a["xi"], a["user"], a["song"], a["y"]
     data = GameData.build(
         labels=y,
         shards={"fixed": dense_shard(xf),
@@ -708,12 +755,18 @@ def main(argv=None):
          "cd": bench_cd_sweep, "ingest": bench_ingest,
          "e2e": bench_end_to_end}[args.only]()
         return
+    # Order = risk management for the harness wall budget: the metrics the
+    # round-2 artifact MISSED (cd sweep, ingest, write, e2e — rc=124) run
+    # right after the fast headline solves; the random-effect bench (the
+    # slowest: 10M-row bucket upload + 150-entity scipy baseline, and
+    # already captured in BENCH_r02.json) goes last, so a timeout costs
+    # the least-new information.
     bench_glm()
-    bench_random_effect()
     host_cd_rate = bench_cd_sweep()
     py_ingest_rate = bench_ingest()
     bench_end_to_end(host_cd_rate=host_cd_rate,
                      py_ingest_rate=py_ingest_rate)
+    bench_random_effect()
 
 
 if __name__ == "__main__":

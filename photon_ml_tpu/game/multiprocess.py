@@ -211,16 +211,13 @@ class MultiProcessFixedEffectDataset:
               feature_shard_id: str, mesh,
               *, dense_max_dim: Optional[int] = None,
               ) -> "MultiProcessFixedEffectDataset":
-        from photon_ml_tpu.parallel.mesh import DATA_AXIS
-        from photon_ml_tpu.parallel.multihost import (
-            global_glm_data_multihost,
-            local_axis_blocks,
-        )
-
         from photon_ml_tpu.game.data import choose_dense_design_stats
+        from photon_ml_tpu.parallel.mesh import DATA_AXIS
         from photon_ml_tpu.parallel.multihost import (
             allreduce_max,
             allreduce_sum,
+            global_glm_data_multihost,
+            local_axis_blocks,
         )
 
         shard = game_owned.shards[feature_shard_id]

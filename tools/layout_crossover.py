@@ -6,7 +6,7 @@ objective for the SAME synthetic problem in both layouts across a
 crossover: the largest dense dim (per nnz/row) at which the dense-padded
 design still beats :class:`~photon_ml_tpu.ops.design.ChunkedSparseDesign`.
 
-The result feeds ``photon_ml_tpu/game/data.py::choose_fixed_effect_layout``
+The result feeds ``photon_ml_tpu/game/data.py::choose_dense_design``
 (the automatic layout pick — VERDICT r2 item 4, SURVEY.md §7 hard-part #2);
 the measured table lives in that function's docstring. Re-run this script
 after any toolchain bump:
@@ -86,7 +86,7 @@ def main():
     import jax
 
     # ~30 s/shape through the remote-compile tunnel without it (bench.py
-    # compile-budget note); 32 shapes in this grid
+    # compile-budget note); 18 (d, k) points x 2 layouts in this grid
     import os
     import tempfile
 
