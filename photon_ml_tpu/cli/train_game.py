@@ -93,12 +93,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "device (jax.distributed.initialize from "
                         "PHOTON_COORDINATOR_ADDRESS/NUM_PROCESSES/PROCESS_ID "
                         "env vars, or JAX cluster auto-detection on TPU "
-                        "pods). Every process runs this same command on the "
-                        "SAME data (shared filesystem); --mesh then spans "
-                        "all hosts' chips so collectives ride ICI+DCN, and "
-                        "only process 0 writes outputs. Per-host data "
-                        "sharding is the library-level "
-                        "parallel.multihost.global_glm_data_multihost feed")
+                        "pods). Every process runs this same command; with "
+                        ">1 process, training routes through the entity-"
+                        "partitioned multi-process path: each process reads "
+                        "its share of the input FILE LIST (provide at least "
+                        "one file per process on a shared filesystem), "
+                        "feature indexes and entity vocabularies are unioned "
+                        "globally, the fixed effect trains on one global "
+                        "data mesh (built automatically — do not pass "
+                        "--mesh), random effects solve process-locally, and "
+                        "only process 0 writes outputs. Single-config grid "
+                        "only; no --checkpoint/--locked-coordinates/"
+                        "--model-input-dir/--tuning yet")
     p.add_argument("--mesh", default="",
                    help="device mesh axes, e.g. 'data=4,entity=2': shards "
                         "fixed-effect samples over 'data' (psum'd compiled "
@@ -170,12 +176,31 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
     from photon_ml_tpu.parallel.multihost import is_chief
 
     chief = is_chief()
+    import jax
+
+    # >1 process: route training through the entity-partitioned
+    # multi-process path (game/multiprocess.py) — per-process file reads,
+    # global id agreement, dp fixed effect on the global mesh,
+    # process-local random-effect solves, allgathered model
+    multiproc = args.multihost and jax.process_count() > 1
+    if multiproc:
+        unsupported = [
+            (args.mesh, "--mesh (the multi-process path builds its own "
+                        "global data mesh)"),
+            (args.tuning != "NONE", "--tuning"),
+            (args.checkpoint or args.resume, "--checkpoint/--resume"),
+            (args.locked_coordinates, "--locked-coordinates"),
+            (args.model_input_dir, "--model-input-dir"),
+        ]
+        bad = [msg for flag, msg in unsupported if flag]
+        if bad:
+            raise SystemExit(
+                "multi-process --multihost training does not support: "
+                + ", ".join(bad))
     # fail fast on a bad mesh spec / device-count mismatch, BEFORE the
     # (potentially long) Avro reads
     mesh = parse_mesh(args.mesh)
     if args.debug_nans:
-        import jax
-
         jax.config.update("jax_debug_nans", True)
     # non-chief processes log under a per-process subdir: on the shared
     # filesystem --multihost mandates, N processes appending to one
@@ -236,8 +261,28 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                                 input_columns=parse_input_columns(
                                     args.input_columns))
         with timed("Read training data", run_logger):
-            data, index_maps, vocabs = reader.read(
-                args.training_data, id_columns=id_columns)
+            if multiproc:
+                # each process reads its share of the file list (the
+                # reference's executor-local reads), then ids are unioned
+                # into one global feature index / entity vocabulary
+                all_files = reader.paths(args.training_data)
+                if len(all_files) < jax.process_count():
+                    raise SystemExit(
+                        f"--multihost with {jax.process_count()} processes "
+                        f"needs at least that many input files "
+                        f"(got {len(all_files)}; split the data)")
+                my_files = all_files[jax.process_index()::jax.process_count()]
+                data, index_maps, vocabs = reader.read(
+                    my_files, id_columns=id_columns)
+                from photon_ml_tpu.game.multiprocess import (
+                    reconcile_global_ids,
+                )
+
+                data, index_maps, vocabs = reconcile_global_ids(
+                    data, index_maps, vocabs, id_columns)
+            else:
+                data, index_maps, vocabs = reader.read(
+                    args.training_data, id_columns=id_columns)
 
         initial_models = None
         if args.model_input_dir:
@@ -279,8 +324,6 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
             checkpoint = CheckpointManager(
                 os.path.join(args.output_dir, "checkpoints"),
                 read_only=not chief)
-            import jax
-
             if jax.process_count() > 1:
                 # agree on the resume point ONCE, before training: each
                 # process polling the shared filesystem independently would
@@ -309,10 +352,41 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                                  "grid (got %d configs)" % len(configurations))
             from photon_ml_tpu.logging_util import profiled
 
-            with timed("Train (grid)", run_logger), profiled(profile_dir):
-                results = est.fit(data, configurations, validation=validation,
-                                  initial_models=initial_models, locked=locked,
-                                  checkpoint=checkpoint, resume=args.resume)
+            if multiproc:
+                from photon_ml_tpu.evaluation import evaluate_all
+                from photon_ml_tpu.game.estimator import GameResult
+                from photon_ml_tpu.game.multiprocess import (
+                    train_game_multiprocess,
+                )
+
+                results = []
+                with timed("Train (grid, multi-process)", run_logger), \
+                        profiled(profile_dir):
+                    # grid points run sequentially — each is one
+                    # collective-symmetric training all processes join
+                    for config in configurations:
+                        mp = train_game_multiprocess(
+                            data, task, coordinate_configs, update_sequence,
+                            config.regularization_weights,
+                            n_cd_iterations=args.cd_iterations)
+                        evaluation, history = None, []
+                        if validation is not None:
+                            vdata, evs = validation
+                            evaluation = evaluate_all(
+                                evs, mp.model.score(vdata), vdata.labels,
+                                weights=vdata.weights,
+                                id_tags=vdata.id_columns)
+                            history = [evaluation.as_dict()]
+                        results.append(GameResult(
+                            model=mp.model, configuration=config,
+                            evaluation=evaluation,
+                            validation_history=history))
+            else:
+                with timed("Train (grid)", run_logger), profiled(profile_dir):
+                    results = est.fit(
+                        data, configurations, validation=validation,
+                        initial_models=initial_models, locked=locked,
+                        checkpoint=checkpoint, resume=args.resume)
         else:
             if validation is None:
                 raise SystemExit("--tuning needs --validation-data")

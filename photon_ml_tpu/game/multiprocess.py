@@ -181,6 +181,72 @@ def owner_of_rows(entities: np.ndarray, owner_of_entity: np.ndarray,
 
 
 # ---------------------------------------------------------------------------
+# Global id agreement (feature index maps + entity vocabularies)
+# ---------------------------------------------------------------------------
+
+
+def reconcile_global_ids(data: GameData, index_maps, vocabs,
+                         id_columns=()):
+    """Make per-process feature index maps and entity vocabularies GLOBAL.
+
+    Under multi-process training each process reads its own file subset
+    (the reference's executor-local HDFS reads), so locally-built feature
+    indices and entity vocabularies disagree across processes. This unions
+    the key sets through a host allgather, rebuilds them in the canonical
+    deterministic order (:func:`~photon_ml_tpu.io.index.build_index_map`'s
+    sorted order for features — identical to what a single-process read of
+    ALL files would build — and sorted raw ids for vocabularies), and
+    remaps this process's columns in place.
+
+    Returns the remapped ``(data, index_maps, vocabs)``. Collective: every
+    process must call with the same shard/vocab key sets requested
+    (``id_columns`` pins the vocabulary iteration order, since a process
+    that saw no rows for a column would otherwise skip its collectives).
+    """
+    from photon_ml_tpu.io.index import build_index_map
+    from photon_ml_tpu.parallel.multihost import allgather_concat_strings
+    from photon_ml_tpu.types import INTERCEPT_KEY
+
+    new_maps = {}
+    new_shards = dict(data.shards)
+    for sid in sorted(index_maps):
+        imap = index_maps[sid]
+        local_names = imap.names()
+        union = set(allgather_concat_strings(local_names))
+        gmap = build_index_map(union,
+                               add_intercept=INTERCEPT_KEY in union)
+        perm = np.array([gmap.key_to_index[k] for k in local_names],
+                        np.int32)
+        shard = data.shards[sid]
+        new_shards[sid] = dataclasses.replace(
+            shard, cols=(perm[shard.cols] if len(shard.cols)
+                         else shard.cols), dim=len(gmap))
+        new_maps[sid] = gmap
+
+    new_vocabs = {}
+    new_ids = dict(data.id_columns)
+    for col in sorted(set(id_columns) | set(vocabs)):
+        vocab = vocabs.get(col, {})
+        # vocab values are a permutation of range(len): invert to the
+        # local id -> raw string table (every slot gets filled)
+        local_names = [""] * len(vocab)
+        for k, i in vocab.items():
+            local_names[i] = k
+        union = sorted(set(allgather_concat_strings(local_names)))
+        gvocab = {k: i for i, k in enumerate(union)}
+        perm = np.array([gvocab[k] for k in local_names], np.int64)
+        ids = data.id_columns.get(col)
+        if ids is not None and len(perm):
+            new_ids[col] = np.where(ids >= 0, perm[np.maximum(ids, 0)],
+                                    np.int64(-1))
+        new_vocabs[col] = gvocab
+
+    return GameData(labels=data.labels, offsets=data.offsets,
+                    weights=data.weights, shards=new_shards,
+                    id_columns=new_ids), new_maps, new_vocabs
+
+
+# ---------------------------------------------------------------------------
 # Multi-process fixed-effect dataset (global data-axis feed, re-fed offsets)
 # ---------------------------------------------------------------------------
 

@@ -123,8 +123,13 @@ class AvroDataReader:
     #: canonical layout only).
     input_columns: InputColumnsNames = InputColumnsNames()
 
-    def paths(self, input_path: str) -> list[str]:
-        if os.path.isdir(input_path):
+    def paths(self, input_path) -> list[str]:
+        """Resolve a directory / glob / single file — or an explicit list
+        of files (the multi-process drivers partition the file list across
+        processes, the reference's executor-local read assignment)."""
+        if isinstance(input_path, (list, tuple)):
+            found = [str(p) for p in input_path]
+        elif os.path.isdir(input_path):
             found = sorted(globmod.glob(os.path.join(input_path, "*.avro")))
         else:
             found = sorted(globmod.glob(input_path)) or [input_path]
@@ -144,14 +149,17 @@ class AvroDataReader:
                                           add_intercept=cfg.has_intercept)
             for cfg in self.shard_configs}
 
-    def read(self, input_path: str,
+    def read(self, input_path: "str | Sequence[str]",
              id_columns: Sequence[str] = (),
              entity_vocabs: Optional[dict[str, dict[str, int]]] = None,
              ) -> tuple[GameData, dict[str, IndexMap], dict[str, dict[str, int]]]:
         """Read records → (GameData, index maps, entity vocabularies).
 
-        ``id_columns`` names metadataMap keys to turn into entity-id columns
-        (GAME random-effect types and grouped-metric tags). Vocabularies map
+        ``input_path`` is a directory / glob / single file, or an explicit
+        list of files (multi-process drivers pass each process's share of
+        the file list — see :meth:`paths`). ``id_columns`` names
+        metadataMap keys to turn into entity-id columns (GAME
+        random-effect types and grouped-metric tags). Vocabularies map
         raw string ids → dense ints; pass training vocabs when reading
         validation data so entity ids align.
         """

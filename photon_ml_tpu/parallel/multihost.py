@@ -187,6 +187,27 @@ def allreduce_sum(x: np.ndarray) -> np.ndarray:
     return _gather_stack(x).sum(axis=0).astype(x.dtype)
 
 
+def allgather_concat_strings(strings) -> list[str]:
+    """Concatenate every process's list of strings in process order
+    (identity single-process) — the collective behind global feature-index
+    and entity-vocabulary agreement. Strings ride as a lengths gather plus
+    one flat utf-8 byte gather (jax collectives carry no string dtype)."""
+    strings = list(strings)
+    if jax.process_count() == 1:
+        return strings
+    data = [s.encode("utf-8") for s in strings]
+    lens = allgather_concat(np.array([len(b) for b in data], np.int64))
+    buf = allgather_concat(
+        np.frombuffer(b"".join(data), np.uint8).copy()
+        if data else np.zeros(0, np.uint8))
+    out, off = [], 0
+    for ln in lens:
+        ln = int(ln)
+        out.append(bytes(buf[off:off + ln]).decode("utf-8"))
+        off += ln
+    return out
+
+
 def allreduce_max(x: np.ndarray) -> np.ndarray:
     """Element-wise max across processes (identity single-process)."""
     x = np.asarray(x)

@@ -245,6 +245,7 @@ def _run_two_workers(tmp_path, script_text: str, ok_token: str,
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {pid} rc={p.returncode}:\n{out}"
         assert f"{ok_token} {pid}" in out, out
+    return outs
 
 
 @pytest.mark.slow
@@ -335,6 +336,100 @@ if pid == 0:
     np.testing.assert_allclose(s_mp, s_ref, atol=5e-3)
 print(f"MULTIPROC_GAME_OK {pid}", flush=True)
 """
+
+
+def _write_game_avro(path, n, seed, n_users=11, d_fixed=4, d_user=2,
+                     param_seed=99):
+    """Mixed-effect TrainingExampleAvro records (bag 'fixed' + bag 'user',
+    userId in metadataMap) — the test_cli generator shape, split-friendly."""
+    from photon_ml_tpu.io.data_reader import write_training_examples
+
+    prng = np.random.default_rng(param_seed)
+    w = prng.normal(size=d_fixed)
+    u = 1.5 * prng.normal(size=(n_users, d_user))
+    rng = np.random.default_rng(seed)
+    xf = rng.normal(size=(n, d_fixed))
+    xu = rng.normal(size=(n, d_user))
+    users = rng.integers(0, n_users, size=n)
+    margin = xf @ w + np.einsum("nd,nd->n", xu, u[users])
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-margin))).astype(float)
+    records = []
+    for i in range(n):
+        feats = [{"name": f"fixed.x{j}", "term": "", "value": float(xf[i, j])}
+                 for j in range(d_fixed)]
+        feats += [{"name": f"user.z{j}", "term": "", "value": float(xu[i, j])}
+                  for j in range(d_user)]
+        records.append({
+            "uid": f"{seed}-{i}", "response": float(y[i]), "offset": None,
+            "weight": None, "features": feats,
+            "metadataMap": {"userId": f"u{users[i]}"},
+        })
+    write_training_examples(str(path), records)
+    return str(path)
+
+
+_DRIVER_WORKER = r"""
+import sys, json
+port, pid = sys.argv[1], int(sys.argv[2])
+from photon_ml_tpu.testing import virtual_devices
+virtual_devices(2, force_cpu=True)
+from photon_ml_tpu.parallel import multihost
+multihost.initialize(f"localhost:{port}", 2, pid)
+from photon_ml_tpu.cli import train_game
+argv = json.loads('@ARGS@') + ["--output-dir", "@OUT@", "--multihost"]
+out = train_game.run(argv)
+print("DRIVER_RESULT", json.dumps(out["best_evaluation"]))
+print(f"MULTIPROC_DRIVER_OK {pid}", flush=True)
+"""
+
+
+@pytest.mark.slow
+def test_two_process_train_game_driver(tmp_path):
+    """The FULL train_game driver across two real processes: per-process
+    file reads, global feature-index/vocabulary agreement, entity-
+    partitioned training, chief-gated model write — and the validation AUC
+    must match a single-process run of the same driver on the same files."""
+    import json
+
+    from photon_ml_tpu.cli import train_game as train_game_cli
+
+    train_dir = tmp_path / "train"
+    train_dir.mkdir()
+    for i in range(4):
+        _write_game_avro(train_dir / f"part-{i}.avro", n=120, seed=i)
+    val = _write_game_avro(tmp_path / "val.avro", n=240, seed=9)
+
+    argv_common = [
+        "--training-data", str(train_dir),
+        "--validation-data", val,
+        "--feature-shards", "global=fixed|intercept,user=user|noIntercept",
+        "--coordinates", "global=fixed,shard=global,reg=L2",
+        "perUser=random,entity=userId,shard=user,reg=L2",
+        "--update-sequence", "global,perUser",
+        "--grid", "global=0.01", "perUser=1",
+        "--evaluators", "AUC",
+    ]
+    base = train_game_cli.run(
+        argv_common + ["--output-dir", str(tmp_path / "out-sp")])
+    base_auc = base["best_evaluation"]["AUC"]
+    assert base_auc > 0.6  # the problem must be learnable at all
+
+    script = (_DRIVER_WORKER
+              .replace("@ARGS@", json.dumps(argv_common))
+              .replace("@OUT@", str(tmp_path / "out-mp")))
+    outs = _run_two_workers(tmp_path, script, "MULTIPROC_DRIVER_OK",
+                            timeout=420)
+    mp_eval = None
+    for line in outs[0].splitlines():
+        if line.startswith("DRIVER_RESULT "):
+            mp_eval = json.loads(line.split(" ", 1)[1])
+    assert mp_eval is not None, outs[0]
+    assert abs(mp_eval["AUC"] - base_auc) < 5e-3, (mp_eval, base_auc)
+    # chief wrote the model; the non-chief logged under its own subdir
+    assert os.path.exists(
+        os.path.join(tmp_path, "out-mp", "best", "model-metadata.json"))
+    assert os.path.exists(
+        os.path.join(tmp_path, "out-mp", "workers", "proc-1"))
 
 
 @pytest.mark.slow

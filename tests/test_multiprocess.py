@@ -257,6 +257,43 @@ class TestDenseSparseCrossover:
             assert isinstance(ds.design, expect), (d, k, type(ds.design))
 
 
+class TestReconcileGlobalIds:
+    def test_single_process_canonicalizes(self):
+        from photon_ml_tpu.game.data import GameData
+        from photon_ml_tpu.game.multiprocess import reconcile_global_ids
+        from photon_ml_tpu.io.index import build_index_map
+        from photon_ml_tpu.testing import dense_shard
+
+        x = np.eye(4, 2, dtype=np.float32)
+        data = GameData.build(
+            labels=np.zeros(4, np.float32),
+            shards={"s": dense_shard(x)},
+            id_columns={"u": np.array([1, 0, -1, 1], np.int64)})
+        vocabs = {"u": {"zz": 0, "aa": 1}}  # insertion order, not sorted
+        imaps = {"s": build_index_map(["s.a", "s.b"], add_intercept=False)}
+        d2, m2, v2 = reconcile_global_ids(data, imaps, vocabs, ["u"])
+        # feature maps were already canonical (sorted) — identity
+        assert m2["s"].key_to_index == imaps["s"].key_to_index
+        np.testing.assert_array_equal(d2.shards["s"].cols,
+                                      data.shards["s"].cols)
+        # vocab re-sorted; ids remapped, missing (-1) preserved
+        assert v2["u"] == {"aa": 0, "zz": 1}
+        np.testing.assert_array_equal(d2.id_columns["u"], [0, 1, -1, 0])
+
+    def test_column_without_rows_still_collective_safe(self):
+        from photon_ml_tpu.game.data import GameData
+        from photon_ml_tpu.game.multiprocess import reconcile_global_ids
+        from photon_ml_tpu.testing import dense_shard
+
+        data = GameData.build(
+            labels=np.zeros(2, np.float32),
+            shards={"s": dense_shard(np.ones((2, 1), np.float32))},
+            id_columns={"u": np.full(2, -1, np.int64)})
+        d2, _, v2 = reconcile_global_ids(data, {}, {}, ["u"])
+        assert v2["u"] == {}
+        np.testing.assert_array_equal(d2.id_columns["u"], [-1, -1])
+
+
 class TestSubsamplePartitionInvariance:
     """The active-bound reservoir draw must be a pure function of
     (seed, global sample id): a per-process build over a row subset keeps
