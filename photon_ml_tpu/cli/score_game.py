@@ -42,6 +42,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--input-columns", default="",
                    help="remap record fields, e.g. 'response=label' "
                         "(reference InputColumnsNames)")
+    p.add_argument("--multihost", action="store_true",
+                   help="multi-controller scoring: every process runs this "
+                        "same command, reads its share of the input FILE "
+                        "LIST (at least one file per process), scores with "
+                        "the shared model, and writes its own "
+                        "scores-part-<pid>.avro; evaluation (if requested) "
+                        "is computed on the globally gathered scores — the "
+                        "reference's per-partition scoring map + shuffle-"
+                        "side evaluation (GameScoringDriver.scala)")
     return p
 
 
@@ -50,7 +59,17 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
     from photon_ml_tpu.io.data_reader import parse_input_columns
 
     args = build_parser().parse_args(argv)
-    run_logger = RunLogger(args.output_dir)
+    if args.multihost:
+        from photon_ml_tpu.parallel import multihost
+
+        multihost.initialize(auto=True)
+    import jax
+
+    multiproc = args.multihost and jax.process_count() > 1
+    chief = jax.process_index() == 0
+    log_dir = args.output_dir if chief else os.path.join(
+        args.output_dir, "workers", f"proc-{jax.process_index()}")
+    run_logger = RunLogger(log_dir)
     try:
         model_dir = os.path.normpath(args.model_dir)
         if not os.path.exists(os.path.join(model_dir, "model-metadata.json")):
@@ -98,20 +117,49 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
         with timed("Read data", run_logger):
             # entity vocab must match training; rebuilt from data then used
             # for lookups — entities unseen at training score 0 for REs
-            data, _, vocabs = reader.read(args.data, id_columns=id_columns)
+            if multiproc:
+                from photon_ml_tpu.game.multiprocess import (
+                    process_file_share,
+                )
+
+                data, _, vocabs = reader.read(
+                    process_file_share(reader, args.data),
+                    id_columns=id_columns)
+                if evaluators:
+                    # grouped metrics compare id tags across processes —
+                    # agree on one global id space for them. The model's
+                    # RE lookups only need LOCAL consistency (each process
+                    # keys its own table from its own vocab), but one
+                    # id space serves both, so reconcile for all columns.
+                    from photon_ml_tpu.game.multiprocess import (
+                        reconcile_vocabs,
+                    )
+
+                    data, vocabs = reconcile_vocabs(data, vocabs,
+                                                    id_columns)
+            else:
+                data, _, vocabs = reader.read(args.data,
+                                              id_columns=id_columns)
 
         with timed("Load model", run_logger):
             model = load_game_model(model_dir, index_maps, vocabs)
 
         transformer = GameTransformer(
-            model=model, evaluators=evaluators,
+            model=model,
+            evaluators=() if multiproc else evaluators,
             score_breakdown=args.score_breakdown)
         with timed("Score", run_logger):
             result = transformer.transform(data)
 
         with timed("Write scores", run_logger):
             os.makedirs(args.output_dir, exist_ok=True)
-            out_path = os.path.join(args.output_dir, "scores.avro")
+            # multi-process: one part file per process (the reference's
+            # per-partition part-NNNNN outputs); single-process keeps the
+            # plain scores.avro name
+            out_path = os.path.join(
+                args.output_dir,
+                f"scores-part-{jax.process_index():05d}.avro"
+                if multiproc else "scores.avro")
             from photon_ml_tpu import native
 
             # columnar native writer (~50x the record encoder); the Python
@@ -128,16 +176,46 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                 write_avro_file(out_path, records, SCORING_RESULT_AVRO,
                                 codec="null")
             if result.by_coordinate is not None:
-                with open(os.path.join(args.output_dir,
-                                       "score-breakdown.json"), "w") as f:
+                # per-process part name under multi-process: concurrent
+                # writers to one shared file would clobber each other
+                bd = (f"score-breakdown-part-{jax.process_index():05d}.json"
+                      if multiproc else "score-breakdown.json")
+                with open(os.path.join(args.output_dir, bd), "w") as f:
                     json.dump({k: v.tolist()
                                for k, v in result.by_coordinate.items()}, f)
 
         evaluation = None
-        if result.evaluation is not None:
+        n_scored = data.n_samples
+        if multiproc:
+            from photon_ml_tpu.parallel.multihost import (
+                allgather_concat,
+                allreduce_sum,
+            )
+
+            n_scored = int(allreduce_sum(
+                np.array([data.n_samples], np.int64))[0])
+            if evaluators:
+                # global evaluation on the gathered scores (every process
+                # computes the same numbers; chief logs) — the analog of
+                # the reference evaluating scored RDDs with shuffles
+                from photon_ml_tpu.evaluation import evaluate_all
+
+                g_scores = allgather_concat(
+                    np.asarray(result.scores, np.float32))
+                g_labels = allgather_concat(
+                    np.asarray(data.labels, np.float32))
+                g_weights = allgather_concat(
+                    np.asarray(data.weights, np.float32))
+                g_tags = {c: allgather_concat(data.id_columns[c])
+                          for c in sorted(data.id_columns)}
+                g_eval = evaluate_all(evaluators, g_scores, g_labels,
+                                      weights=g_weights, id_tags=g_tags)
+                evaluation = g_eval.as_dict()
+                run_logger.metric(stage="evaluate", **evaluation)
+        elif result.evaluation is not None:
             evaluation = result.evaluation.as_dict()
             run_logger.metric(stage="evaluate", **evaluation)
-        return {"n_scored": data.n_samples, "evaluation": evaluation,
+        return {"n_scored": n_scored, "evaluation": evaluation,
                 "output_dir": args.output_dir}
     finally:
         run_logger.close()

@@ -265,19 +265,14 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                 # each process reads its share of the file list (the
                 # reference's executor-local reads), then ids are unioned
                 # into one global feature index / entity vocabulary
-                all_files = reader.paths(args.training_data)
-                if len(all_files) < jax.process_count():
-                    raise SystemExit(
-                        f"--multihost with {jax.process_count()} processes "
-                        f"needs at least that many input files "
-                        f"(got {len(all_files)}; split the data)")
-                my_files = all_files[jax.process_index()::jax.process_count()]
-                data, index_maps, vocabs = reader.read(
-                    my_files, id_columns=id_columns)
                 from photon_ml_tpu.game.multiprocess import (
+                    process_file_share,
                     reconcile_global_ids,
                 )
 
+                data, index_maps, vocabs = reader.read(
+                    process_file_share(reader, args.training_data),
+                    id_columns=id_columns)
                 data, index_maps, vocabs = reconcile_global_ids(
                     data, index_maps, vocabs, id_columns)
             else:

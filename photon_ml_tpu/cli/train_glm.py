@@ -103,6 +103,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "features to ~3 decimal digits, perturbing the "
                         "optimum — keep float32 where exact reference "
                         "parity matters")
+    p.add_argument("--multihost", action="store_true",
+                   help="form a multi-controller job before touching any "
+                        "device (jax.distributed.initialize from PHOTON_* "
+                        "env vars or cluster auto-detection). With >1 "
+                        "process: each process reads its share of the input "
+                        "FILE LIST (at least one file per process), the "
+                        "feature index and summary statistics are unioned "
+                        "globally, every lambda solves as ONE psum'd sweep "
+                        "over the global data mesh, and only process 0 "
+                        "writes outputs. Not combinable with "
+                        "--training-diagnostics or --design-dtype bfloat16 "
+                        "yet")
     return p
 
 
@@ -195,11 +207,27 @@ def _run_diagnostics(args, task, best, glm_train, glm_val, shard, stats, imap,
 def run(argv: Optional[Sequence[str]] = None) -> dict:
     args = build_parser().parse_args(argv)
     task = TaskType(args.task)
-    if args.debug_nans:
-        import jax
+    if args.multihost:
+        from photon_ml_tpu.parallel import multihost
 
+        multihost.initialize(auto=True)
+    import jax
+
+    multiproc = args.multihost and jax.process_count() > 1
+    chief = jax.process_index() == 0
+    if multiproc:
+        bad = [msg for flag, msg in (
+            (args.training_diagnostics, "--training-diagnostics"),
+            (args.design_dtype == "bfloat16", "--design-dtype bfloat16"),
+        ) if flag]
+        if bad:
+            raise SystemExit("multi-process --multihost training does not "
+                             "support: " + ", ".join(bad))
+    if args.debug_nans:
         jax.config.update("jax_debug_nans", True)
-    run_logger = RunLogger(args.output_dir)
+    run_logger = RunLogger(
+        args.output_dir if chief else os.path.join(
+            args.output_dir, "workers", f"proc-{jax.process_index()}"))
     try:
         evaluators = parse_evaluators(
             [e for e in args.evaluators.split(",") if e])
@@ -211,8 +239,20 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                                    has_intercept=not args.no_intercept),),
             input_columns=parse_input_columns(args.input_columns))
         with timed("Read training data", run_logger):
-            data, index_maps, _ = reader.read(args.training_data,
-                                              id_columns=id_columns)
+            if multiproc:
+                from photon_ml_tpu.game.multiprocess import (
+                    process_file_share,
+                    reconcile_global_ids,
+                )
+
+                data, index_maps, vocabs = reader.read(
+                    process_file_share(reader, args.training_data),
+                    id_columns=id_columns)
+                data, index_maps, vocabs = reconcile_global_ids(
+                    data, index_maps, vocabs, id_columns)
+            else:
+                data, index_maps, _ = reader.read(args.training_data,
+                                                  id_columns=id_columns)
         imap = index_maps["global"]
 
         with timed("Validate data", run_logger):
@@ -225,8 +265,11 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
         stats = None
         if norm_type != NormalizationType.NONE or args.summarization_output:
             with timed("Summarize features", run_logger):
-                stats = FeatureDataStatistics.from_shard(shard)
-            if args.summarization_output:
+                # allreduce: global statistics when rows span processes
+                # (identity single-process), so the normalization context —
+                # part of the OBJECTIVE — is identical everywhere
+                stats = FeatureDataStatistics.from_shard(shard).allreduce()
+            if args.summarization_output and chief:
                 write_avro_file(
                     os.path.join(args.output_dir, "summary.avro"),
                     stats.to_records(imap.names()),
@@ -259,7 +302,25 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
 
         design_dtype = (jnp.bfloat16 if args.design_dtype == "bfloat16"
                         else jnp.float32)
-        glm_train = _to_glm_data(data, "global", dtype=design_dtype)
+        fe_mesh = None
+        if multiproc:
+            # global data-axis mesh; every process feeds its own rows
+            from photon_ml_tpu.game.data import host_design_for_shard
+            from photon_ml_tpu.parallel.multihost import (
+                global_glm_data_multihost,
+                make_multihost_mesh,
+            )
+
+            fe_mesh = make_multihost_mesh()
+            host = GLMData(
+                design=host_design_for_shard(shard,
+                                             dense_max_dim=DENSE_MAX_DIM),
+                labels=data.labels,
+                offsets=data.offsets,
+                weights=data.weights)
+            glm_train = global_glm_data_multihost(host, fe_mesh)
+        else:
+            glm_train = _to_glm_data(data, "global", dtype=design_dtype)
         from photon_ml_tpu.logging_util import log_optimizer_trace, profiled
 
         with timed("Train", run_logger), profiled(
@@ -267,7 +328,8 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                 if args.profile else None):
             trained = train_glm_sweep(
                 task, glm_train, lambdas, config,
-                normalization=normalization, reg_mask=reg_mask)
+                normalization=normalization, reg_mask=reg_mask,
+                mesh=fe_mesh, dim=len(imap) if multiproc else None)
         for tm in trained:
             run_logger.metric(stage="train", regularization_weight=tm.regularization_weight,
                               value=float(tm.result.value),
@@ -299,24 +361,26 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                                   regularization_weight=tm.regularization_weight,
                                   **tm.evaluation.as_dict())
 
-        with timed("Save models", run_logger):
-            imap.save(os.path.join(args.output_dir, "feature-index.json"))
-            best = trained[best_idx]
-            save_glm_model(
-                os.path.join(args.output_dir, "best", "model.avro"),
-                best.model, imap, model_id="best")
-            # the reference driver writes text AND Avro models
-            save_glm_model_text(
-                os.path.join(args.output_dir, "best", "model.txt"),
-                best.model, imap)
-            for tm in trained:
-                out_dir = os.path.join(args.output_dir, "all",
-                                       f"lambda-{tm.regularization_weight:g}")
+        best = trained[best_idx]
+        if chief:
+            with timed("Save models", run_logger):
+                imap.save(os.path.join(args.output_dir, "feature-index.json"))
                 save_glm_model(
-                    os.path.join(out_dir, "model.avro"), tm.model, imap,
-                    model_id=f"lambda-{tm.regularization_weight:g}")
+                    os.path.join(args.output_dir, "best", "model.avro"),
+                    best.model, imap, model_id="best")
+                # the reference driver writes text AND Avro models
                 save_glm_model_text(
-                    os.path.join(out_dir, "model.txt"), tm.model, imap)
+                    os.path.join(args.output_dir, "best", "model.txt"),
+                    best.model, imap)
+                for tm in trained:
+                    out_dir = os.path.join(
+                        args.output_dir, "all",
+                        f"lambda-{tm.regularization_weight:g}")
+                    save_glm_model(
+                        os.path.join(out_dir, "model.avro"), tm.model, imap,
+                        model_id=f"lambda-{tm.regularization_weight:g}")
+                    save_glm_model_text(
+                        os.path.join(out_dir, "model.txt"), tm.model, imap)
         report_path = None
         if args.training_diagnostics:
             # the DIAGNOSED stage of the reference driver's state machine

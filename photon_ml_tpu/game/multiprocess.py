@@ -180,6 +180,23 @@ def owner_of_rows(entities: np.ndarray, owner_of_entity: np.ndarray,
     return dest.astype(np.int32)
 
 
+def process_file_share(reader, input_path) -> list[str]:
+    """This process's share of the input file list — the multi-process
+    drivers' read assignment (each process reads ``files[pid::n]``, the
+    executor-local reads of the reference). Raises when there are fewer
+    files than processes (an empty-handed process would feed zero rows and
+    desync shard budgets)."""
+    import jax
+
+    all_files = reader.paths(input_path)
+    if len(all_files) < jax.process_count():
+        raise SystemExit(
+            f"--multihost with {jax.process_count()} processes needs at "
+            f"least that many input files (got {len(all_files)}; split "
+            f"the data)")
+    return all_files[jax.process_index()::jax.process_count()]
+
+
 # ---------------------------------------------------------------------------
 # Global id agreement (feature index maps + entity vocabularies)
 # ---------------------------------------------------------------------------
@@ -223,6 +240,19 @@ def reconcile_global_ids(data: GameData, index_maps, vocabs,
                          else shard.cols), dim=len(gmap))
         new_maps[sid] = gmap
 
+    data = dataclasses.replace(data, shards=new_shards)
+    data, new_vocabs = reconcile_vocabs(data, vocabs, id_columns)
+    return data, new_maps, new_vocabs
+
+
+def reconcile_vocabs(data: GameData, vocabs, id_columns=()):
+    """The entity-vocabulary half of :func:`reconcile_global_ids` alone —
+    for drivers whose FEATURE index maps are preset (scoring loads them
+    with the model and must not re-key the coefficient tables) but whose
+    grouped-metric id tags still need one global id space. Collective;
+    identity-shaped at one process (modulo canonical re-sort)."""
+    from photon_ml_tpu.parallel.multihost import allgather_concat_strings
+
     new_vocabs = {}
     new_ids = dict(data.id_columns)
     for col in sorted(set(id_columns) | set(vocabs)):
@@ -241,9 +271,7 @@ def reconcile_global_ids(data: GameData, index_maps, vocabs,
                                     np.int64(-1))
         new_vocabs[col] = gvocab
 
-    return GameData(labels=data.labels, offsets=data.offsets,
-                    weights=data.weights, shards=new_shards,
-                    id_columns=new_ids), new_maps, new_vocabs
+    return dataclasses.replace(data, id_columns=new_ids), new_vocabs
 
 
 # ---------------------------------------------------------------------------

@@ -49,6 +49,7 @@ def build_problem(
     config: GLMOptimizationConfiguration,
     normalization: NormalizationContext = NoNormalization,
     reg_mask: Optional[Array] = None,
+    mesh=None,
 ) -> OptimizationProblem:
     """The one place the sweep's optimization problem is assembled — shared
     with the diagnostics stage so bootstrap/fitting solves diagnose exactly
@@ -58,10 +59,24 @@ def build_problem(
     value+grad runs the one-pass Pallas kernel (1.35x in-solve — see
     ops/pallas_glm.py); every other combination transparently takes the
     closed-form/autodiff path, so the flag is safe to set unconditionally.
+
+    With a ``mesh`` (carrying a ``data`` axis) the objective becomes the
+    shard_map/psum :class:`~photon_ml_tpu.parallel.distributed.
+    DistributedGLMObjective` over it — the sweep then expects the stacked
+    per-device data layout (``shard_glm_data`` /
+    ``global_glm_data_multihost``) and runs one psum per iteration; on a
+    multi-controller job every process executes the same sweep in lockstep
+    (the reference's per-iteration broadcast + treeAggregate,
+    ``ModelTraining.scala``).
     """
     objective = GLMObjective(
         loss=loss_for_task(task), normalization=normalization,
         reg_mask=reg_mask, fused=True)
+    if mesh is not None:
+        from photon_ml_tpu.parallel.distributed import DistributedGLMObjective
+
+        return OptimizationProblem(
+            DistributedGLMObjective(objective=objective, mesh=mesh), config)
     return OptimizationProblem(objective, config)
 
 
@@ -74,20 +89,26 @@ def train_glm_sweep(
     reg_mask: Optional[Array] = None,
     initial: Optional[Array] = None,
     warm_start: bool = True,
+    mesh=None,
+    dim: Optional[int] = None,
 ) -> list[TrainedModel]:
     """Train one GLM per regularization weight with warm starts.
 
     Weights are processed in descending order (strongest regularization first,
     the stable warm-start direction the reference uses); the returned list
     follows that order. ``reg_mask`` excludes coefficients (e.g. the
-    intercept) from regularization.
+    intercept) from regularization. With ``mesh``, ``data`` must be the
+    stacked per-device layout (see :func:`build_problem`) and ``dim`` names
+    the coefficient length (the stacked layout's ``dim`` property reflects
+    block shapes, not the model).
     """
     for lam in regularization_weights:
         config.regularization.check_weight(lam)
-    problem = build_problem(task, config, normalization, reg_mask)
+    problem = build_problem(task, config, normalization, reg_mask, mesh=mesh)
 
     run = jax.jit(problem.run)
-    w = jnp.zeros((data.dim,)) if initial is None else jnp.asarray(initial)
+    d = data.dim if dim is None else dim
+    w = jnp.zeros((d,)) if initial is None else jnp.asarray(initial)
 
     out: list[TrainedModel] = []
     for lam in sorted(regularization_weights, reverse=True):

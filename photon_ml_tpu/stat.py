@@ -59,6 +59,39 @@ class FeatureDataStatistics:
             mean=mean, variance=variance, min=vmin, max=vmax,
             max_magnitude=max_magnitude, num_nonzeros=nnz, count=n)
 
+    def allreduce(self) -> "FeatureDataStatistics":
+        """Combine per-process statistics into the global ones (identity on
+        a single process) — multi-process drivers compute normalization
+        contexts from these so every process transforms the objective
+        identically. Means/variances recombine through the moment sums
+        (s1, s2); min/max/nnz reduce directly. (At a per-process count of
+        exactly 1 the unbiased-variance denominator makes the recovered s2
+        approximate; a 1-row process shard is degenerate anyway.)"""
+        import jax
+
+        if jax.process_count() == 1:
+            return self
+        from photon_ml_tpu.parallel.multihost import (
+            allreduce_max,
+            allreduce_sum,
+        )
+
+        n = self.count
+        s1 = self.mean * n
+        s2 = self.variance * max(n - 1, 1) + n * np.square(self.mean)
+        n_g = int(allreduce_sum(np.array([n], np.int64))[0])
+        s1_g = allreduce_sum(s1)
+        s2_g = allreduce_sum(s2)
+        mean = s1_g / max(n_g, 1)
+        variance = np.maximum(
+            (s2_g - n_g * np.square(mean)) / max(n_g - 1, 1), 0.0)
+        vmin = -allreduce_max(-self.min)
+        vmax = allreduce_max(self.max)
+        return FeatureDataStatistics(
+            mean=mean, variance=variance, min=vmin, max=vmax,
+            max_magnitude=np.maximum(np.abs(vmin), np.abs(vmax)),
+            num_nonzeros=allreduce_sum(self.num_nonzeros), count=n_g)
+
     def to_records(self, names: list[str]):
         """FeatureSummarizationResultAvro-shaped records."""
         from photon_ml_tpu.io.model_io import _split_key

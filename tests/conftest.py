@@ -23,3 +23,23 @@ import jax  # noqa: E402
 # x64 on the CPU test backend so finite-difference numeric checks are sharp;
 # production code paths stay f32/bf16 on TPU.
 jax.config.update("jax_enable_x64", True)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_per_module():
+    """Drop compiled-executable caches between test modules.
+
+    The full suite compiles hundreds of distinct XLA CPU programs in one
+    process; observed on this box (2026-07-31, jax 0.9.0): after ~25 min /
+    a few hundred compilations the NEXT compile segfaults inside
+    ``backend_compile_and_load`` — reproducibly, at whatever test happens
+    to sit at that point in the ordering (three runs, three different
+    victims, all mid-compile). Bounding per-process compile-cache state by
+    clearing between modules keeps each module's peak well below the
+    crash threshold; the cost is re-compiling shared helpers per module
+    (~seconds each on CPU).
+    """
+    yield
+    jax.clear_caches()

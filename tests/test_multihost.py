@@ -340,32 +340,14 @@ print(f"MULTIPROC_GAME_OK {pid}", flush=True)
 
 def _write_game_avro(path, n, seed, n_users=11, d_fixed=4, d_user=2,
                      param_seed=99):
-    """Mixed-effect TrainingExampleAvro records (bag 'fixed' + bag 'user',
-    userId in metadataMap) — the test_cli generator shape, split-friendly."""
-    from photon_ml_tpu.io.data_reader import write_training_examples
+    """Mixed-effect TrainingExampleAvro file — delegates to test_cli's
+    generator (one home for the record shape the CLI drivers read) with
+    the smaller dims these multi-file 2-process tests use."""
+    from test_cli import make_avro_dataset
 
-    prng = np.random.default_rng(param_seed)
-    w = prng.normal(size=d_fixed)
-    u = 1.5 * prng.normal(size=(n_users, d_user))
-    rng = np.random.default_rng(seed)
-    xf = rng.normal(size=(n, d_fixed))
-    xu = rng.normal(size=(n, d_user))
-    users = rng.integers(0, n_users, size=n)
-    margin = xf @ w + np.einsum("nd,nd->n", xu, u[users])
-    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-margin))).astype(float)
-    records = []
-    for i in range(n):
-        feats = [{"name": f"fixed.x{j}", "term": "", "value": float(xf[i, j])}
-                 for j in range(d_fixed)]
-        feats += [{"name": f"user.z{j}", "term": "", "value": float(xu[i, j])}
-                  for j in range(d_user)]
-        records.append({
-            "uid": f"{seed}-{i}", "response": float(y[i]), "offset": None,
-            "weight": None, "features": feats,
-            "metadataMap": {"userId": f"u{users[i]}"},
-        })
-    write_training_examples(str(path), records)
-    return str(path)
+    return make_avro_dataset(path, n=n, d_fixed=d_fixed, d_user=d_user,
+                             n_users=n_users, seed=seed,
+                             param_seed=param_seed)
 
 
 _DRIVER_WORKER = r"""
@@ -430,6 +412,152 @@ def test_two_process_train_game_driver(tmp_path):
         os.path.join(tmp_path, "out-mp", "best", "model-metadata.json"))
     assert os.path.exists(
         os.path.join(tmp_path, "out-mp", "workers", "proc-1"))
+
+
+_GLM_WORKER = r"""
+import sys, json
+port, pid = sys.argv[1], int(sys.argv[2])
+from photon_ml_tpu.testing import virtual_devices
+virtual_devices(2, force_cpu=True)
+from photon_ml_tpu.parallel import multihost
+multihost.initialize(f"localhost:{port}", 2, pid)
+from photon_ml_tpu.cli import train_glm
+out = train_glm.run(json.loads('@ARGS@'))
+print("GLM_RESULT", json.dumps(
+    {"best_lambda": out["best_lambda"],
+     "best_evaluation": out["best_evaluation"]}))
+print(f"MULTIPROC_GLM_OK {pid}", flush=True)
+"""
+
+
+@pytest.mark.slow
+def test_two_process_train_glm_driver(tmp_path):
+    """The legacy GLM driver across two real processes: per-process file
+    reads, global feature-index and summary-statistics agreement (the
+    normalization context is part of the objective, so it must be identical
+    everywhere), one psum'd warm-started lambda sweep — equal to the
+    single-process run."""
+    import json
+
+    from photon_ml_tpu.cli import train_glm as train_glm_cli
+
+    train_dir = tmp_path / "train"
+    train_dir.mkdir()
+    for i in range(4):
+        _write_game_avro(train_dir / f"part-{i}.avro", n=110, seed=i)
+    val = _write_game_avro(tmp_path / "val.avro", n=240, seed=9)
+
+    argv_common = [
+        "--training-data", str(train_dir),
+        "--validation-data", val,
+        "--regularization-type", "L2",
+        "--regularization-weights", "10;0.1",
+        "--normalization", "STANDARDIZATION",
+        # model selection by logistic loss: strictly lambda-sensitive, so
+        # the best-lambda pick is stable under float-level noise (AUC can
+        # TIE across lambdas — L2 shrinkage roughly preserves rankings —
+        # and a tie's winner would flip on psum summation order)
+        "--evaluators", "LOGISTIC_LOSS,AUC",
+    ]
+    base = train_glm_cli.run(
+        argv_common + ["--output-dir", str(tmp_path / "glm-sp")])
+    base_auc = base["best_evaluation"]["AUC"]
+    assert base_auc > 0.55
+
+    script = (_GLM_WORKER.replace("@ARGS@", json.dumps(
+        argv_common + ["--output-dir", str(tmp_path / "glm-mp"),
+                       "--multihost"])))
+    outs = _run_two_workers(tmp_path, script, "MULTIPROC_GLM_OK",
+                            timeout=420)
+    mp = None
+    for line in outs[0].splitlines():
+        if line.startswith("GLM_RESULT "):
+            mp = json.loads(line.split(" ", 1)[1])
+    assert mp is not None, outs[0]
+    assert mp["best_lambda"] == base["best_lambda"]
+    assert abs(mp["best_evaluation"]["AUC"] - base_auc) < 5e-3, (mp, base_auc)
+    assert abs(mp["best_evaluation"]["LOGISTIC_LOSS"]
+               - base["best_evaluation"]["LOGISTIC_LOSS"]) < 5e-3
+    assert os.path.exists(
+        os.path.join(tmp_path, "glm-mp", "best", "model.avro"))
+    assert os.path.exists(
+        os.path.join(tmp_path, "glm-mp", "workers", "proc-1"))
+
+
+_SCORE_WORKER = r"""
+import sys, json
+port, pid = sys.argv[1], int(sys.argv[2])
+from photon_ml_tpu.testing import virtual_devices
+virtual_devices(2, force_cpu=True)
+from photon_ml_tpu.parallel import multihost
+multihost.initialize(f"localhost:{port}", 2, pid)
+from photon_ml_tpu.cli import score_game
+out = score_game.run(json.loads('@ARGS@'))
+print("SCORE_RESULT", json.dumps(out))
+print(f"MULTIPROC_SCORE_OK {pid}", flush=True)
+"""
+
+
+@pytest.mark.slow
+def test_two_process_score_game_driver(tmp_path):
+    """Multi-process batch scoring: each process scores its file share and
+    writes its own part file; the gathered evaluation (plain + grouped AUC)
+    must match the single-process scoring run."""
+    import json
+
+    from photon_ml_tpu.cli import score_game as score_game_cli
+    from photon_ml_tpu.cli import train_game as train_game_cli
+    from photon_ml_tpu.io.avro import iter_avro_file
+
+    train_dir = tmp_path / "train"
+    train_dir.mkdir()
+    n_total = 0
+    for i in range(4):
+        _write_game_avro(train_dir / f"part-{i}.avro", n=110, seed=i)
+        n_total += 110
+
+    shards = "global=fixed|intercept,user=user|noIntercept"
+    model_out = str(tmp_path / "model")
+    train_game_cli.run([
+        "--training-data", str(train_dir),
+        "--output-dir", model_out,
+        "--feature-shards", shards,
+        "--coordinates", "global=fixed,shard=global,reg=L2",
+        "perUser=random,entity=userId,shard=user,reg=L2",
+        "--update-sequence", "global,perUser",
+        "--grid", "global=0.01", "perUser=1",
+    ])
+
+    score_argv = [
+        "--data", str(train_dir),
+        "--model-dir", model_out,
+        "--feature-shards", shards,
+        "--evaluators", "AUC,AUC:userId",
+    ]
+    base = score_game_cli.run(
+        score_argv + ["--output-dir", str(tmp_path / "score-sp")])
+
+    script = (_SCORE_WORKER.replace("@ARGS@", json.dumps(
+        score_argv + ["--output-dir", str(tmp_path / "score-mp"),
+                      "--multihost"])))
+    outs = _run_two_workers(tmp_path, script, "MULTIPROC_SCORE_OK",
+                            timeout=420)
+    mp = None
+    for line in outs[0].splitlines():
+        if line.startswith("SCORE_RESULT "):
+            mp = json.loads(line.split(" ", 1)[1])
+    assert mp is not None, outs[0]
+    assert mp["n_scored"] == n_total
+    for k, v in base["evaluation"].items():
+        assert abs(mp["evaluation"][k] - v) < 1e-5, (k, mp["evaluation"], v)
+    # each process wrote its own part; together they cover every row
+    rows = 0
+    for pid in range(2):
+        part = os.path.join(tmp_path, "score-mp",
+                            f"scores-part-{pid:05d}.avro")
+        assert os.path.exists(part), part
+        rows += sum(1 for _ in iter_avro_file(part))
+    assert rows == n_total
 
 
 @pytest.mark.slow
