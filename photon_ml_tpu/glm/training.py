@@ -123,6 +123,63 @@ def train_glm_sweep(
     return out
 
 
+def train_glm_sweep_batched(
+    task: TaskType,
+    data: GLMData,
+    regularization_weights: Sequence[float],
+    config: GLMOptimizationConfiguration = GLMOptimizationConfiguration(),
+    normalization: NormalizationContext = NoNormalization,
+    reg_mask: Optional[Array] = None,
+) -> list[TrainedModel]:
+    """ALL-lambda batched sweep: one vmapped solve over the lambda axis.
+
+    The TPU-first alternative to :func:`train_glm_sweep`'s sequential
+    warm-started loop (the reference's ``ModelTraining.scala`` semantics):
+    every optimizer iteration touches the design ONCE for all lambdas, so
+    per-element design costs amortize K-fold. The trade: no warm starts
+    (lanes are independent, each runs from zero to its own masked
+    convergence) and the batched program runs until the SLOWEST lane
+    stops. Results are returned in the same descending-lambda order.
+
+    Measured on the axon TPU v5e, 2026-07-31 (5 lambdas '100;10;1;0.1;
+    0.01', D2H-sync timing, min of 3) — the verdict is LAYOUT-DEPENDENT:
+
+    - dense 200k x 1024, 50 iters: sequential 0.75 s, batched 1.27 s —
+      **0.59x, a loss**. The dense sequential path runs the fused Pallas
+      kernel at the HBM wall and warm starts slash late-lane iterations;
+      the vmapped solve takes the unfused path and repays those savings.
+    - chunked-sparse 3.2M nnz, d=20k, 30 iters: sequential 4.34 s,
+      batched 2.49 s — **1.74x**. Here the per-iteration cost is XLA's
+      random gather (~16-20 ns/nnz, tools/layout_crossover.py) whose
+      indices are lambda-independent, so the gather hoists out of the
+      vmap and K lanes share one pass.
+
+    Use batched for wide-sparse sweeps; keep sequential (the default, and
+    the reference's exact semantics) for dense designs.
+    """
+    for lam in regularization_weights:
+        config.regularization.check_weight(lam)
+    problem = build_problem(task, config, normalization, reg_mask)
+    lams = sorted((float(l) for l in regularization_weights), reverse=True)
+
+    # data/w0 as explicit unbatched args (in_axes=None), NOT a closure: a
+    # closed-over device array becomes an HLO constant — a GB-scale design
+    # baked into the program (and rejected by remote-compile size limits)
+    run = jax.jit(jax.vmap(problem.run, in_axes=(None, None, 0)))
+    batched = run(data, jnp.zeros((data.dim,)),
+                  jnp.asarray(lams, jnp.float32))
+
+    out: list[TrainedModel] = []
+    for i, lam in enumerate(lams):
+        result = jax.tree.map(lambda x: x[i], batched)
+        variances = problem.compute_variances(result.w, data, lam)
+        coeffs = Coefficients(means=result.w, variances=variances)
+        model = GeneralizedLinearModel(
+            coefficients=to_original_space(coeffs, normalization), task=task)
+        out.append(TrainedModel(float(lam), model, result))
+    return out
+
+
 def to_original_space(coeffs: Coefficients, normalization: NormalizationContext
                       ) -> Coefficients:
     """Map transformed-space coefficients (and variances, which scale by the

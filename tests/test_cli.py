@@ -138,6 +138,27 @@ class TestTrainGlmDriver:
         auc16 = results["bfloat16"]["best_evaluation"]["AUC"]
         assert abs(auc32 - auc16) < 0.02
 
+    def test_batched_sweep_mode(self, tmp_path):
+        """--sweep-mode batched (one vmapped solve over all lambdas) picks
+        the same model the sequential warm-started sweep picks."""
+        train = make_avro_dataset(tmp_path / "train.avro", n=800, seed=0)
+        val = make_avro_dataset(tmp_path / "val.avro", n=400, seed=1)
+        results = {}
+        for mode in ("sequential", "batched"):
+            out = str(tmp_path / f"out-{mode}")
+            results[mode] = train_glm_cli.run([
+                "--training-data", train, "--validation-data", val,
+                "--output-dir", out, "--task", "LOGISTIC_REGRESSION",
+                "--regularization-weights", "10;1;0.1",
+                "--evaluators", "LOGISTIC_LOSS,AUC",
+                "--sweep-mode", mode,
+            ])
+        assert (results["batched"]["best_lambda"]
+                == results["sequential"]["best_lambda"])
+        for k in ("AUC", "LOGISTIC_LOSS"):
+            assert abs(results["batched"]["best_evaluation"][k]
+                       - results["sequential"]["best_evaluation"][k]) < 1e-3
+
     def test_training_diagnostics(self, tmp_path):
         train = make_avro_dataset(tmp_path / "train.avro", n=500, seed=0)
         val = make_avro_dataset(tmp_path / "val.avro", n=300, seed=1)

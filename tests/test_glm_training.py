@@ -149,6 +149,32 @@ class TestSweep:
         norms = [float(jnp.linalg.norm(m.model.coefficients.means)) for m in models]
         assert norms[0] < norms[1] < norms[2]
 
+    def test_batched_sweep_matches_sequential(self):
+        """The vmapped all-lambda sweep must reach the same optima the
+        warm-started sequential sweep reaches (convex problems, tight
+        tolerance — paths differ, fixed points don't)."""
+        from photon_ml_tpu.glm.training import train_glm_sweep_batched
+
+        data, _, _ = make_classification(seed=8)
+        cfg = GLMOptimizationConfiguration(regularization=L2Regularization,
+                                           optimizer_config=TIGHT)
+        lams = [10.0, 1.0, 0.1]
+        seq = train_glm_sweep(TaskType.LOGISTIC_REGRESSION, data, lams, cfg)
+        bat = train_glm_sweep_batched(
+            TaskType.LOGISTIC_REGRESSION, data, lams, cfg)
+        assert ([m.regularization_weight for m in bat]
+                == [m.regularization_weight for m in seq])
+        for s, b in zip(seq, bat):
+            # both solvers stop within working-precision of the optimum
+            # (stall-terminated at TIGHT tolerance); the fixed points agree
+            assert float(b.result.grad_norm) < 1e-4
+            assert float(s.result.grad_norm) < 1e-4
+            np.testing.assert_allclose(
+                np.asarray(b.model.coefficients.means),
+                np.asarray(s.model.coefficients.means),
+                atol=1e-4, rtol=1e-3,
+                err_msg=f"lambda={s.regularization_weight}")
+
     def test_validate_and_select(self):
         data, x, labels = make_classification(seed=6)
         val, _, _ = make_classification(seed=7)
