@@ -761,11 +761,29 @@ def main(argv=None):
     # slowest: 10M-row bucket upload + 150-entity scipy baseline, and
     # already captured in BENCH_r02.json) goes last, so a timeout costs
     # the least-new information.
+    def drain():
+        # drop the previous bench's device buffers/compiled executables and
+        # host garbage BEFORE the next one: the native bucket packer's
+        # latency-bound walk measured 6 s in a lean process but 19-60 s
+        # with earlier benches' multi-GB residue still resident (page-table
+        # pressure on the random row gather) — the cleanup keeps each
+        # bench's number a property of the bench, not of suite order
+        import gc
+
+        import jax
+
+        jax.clear_caches()
+        gc.collect()
+
     bench_glm()
+    drain()
     host_cd_rate = bench_cd_sweep()
+    drain()
     py_ingest_rate = bench_ingest()
+    drain()
     bench_end_to_end(host_cd_rate=host_cd_rate,
                      py_ingest_rate=py_ingest_rate)
+    drain()
     bench_random_effect()
 
 
