@@ -102,8 +102,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "globally, the fixed effect trains on one global "
                         "data mesh (built automatically — do not pass "
                         "--mesh), random effects solve process-locally, and "
-                        "only process 0 writes outputs. Single-config grid "
-                        "only; no --checkpoint/--locked-coordinates/"
+                        "only process 0 writes outputs. --checkpoint/"
+                        "--resume persist per-process sweep-boundary state "
+                        "(single-config grid). No --locked-coordinates/"
                         "--model-input-dir/--tuning yet")
     p.add_argument("--mesh", default="",
                    help="device mesh axes, e.g. 'data=4,entity=2': shards "
@@ -188,7 +189,6 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
             (args.mesh, "--mesh (the multi-process path builds its own "
                         "global data mesh)"),
             (args.tuning != "NONE", "--tuning"),
-            (args.checkpoint or args.resume, "--checkpoint/--resume"),
             (args.locked_coordinates, "--locked-coordinates"),
             (args.model_input_dir, "--model-input-dir"),
         ]
@@ -311,7 +311,9 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                             n_cd_iterations=args.cd_iterations, mesh=mesh)
 
         checkpoint = None
-        if args.checkpoint or args.resume:
+        if (args.checkpoint or args.resume) and not multiproc:
+            # multiproc uses its own per-process sweep-boundary state files
+            # (created in the training branch below), not this manager
             from photon_ml_tpu.io.checkpoint import CheckpointManager
 
             # non-chief: read-only, so --resume stays in lockstep with the
@@ -342,7 +344,9 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                     f"--grid names unknown coordinates {sorted(unknown)}; "
                     f"update sequence is {update_sequence}")
             configurations = [GameOptimizationConfiguration(g) for g in grid]
-            if checkpoint is not None and len(configurations) != 1:
+            if ((checkpoint is not None
+                 or (multiproc and (args.checkpoint or args.resume)))
+                    and len(configurations) != 1):
                 raise SystemExit("--checkpoint/--resume need a single-config "
                                  "grid (got %d configs)" % len(configurations))
             from photon_ml_tpu.logging_util import profiled
@@ -354,6 +358,13 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                     train_game_multiprocess,
                 )
 
+                # multi-process checkpoints are per-process sweep-boundary
+                # state files (game/multiprocess.py), not the single-process
+                # CheckpointManager format
+                mp_ckpt = None
+                if args.checkpoint or args.resume:
+                    mp_ckpt = os.path.join(args.output_dir,
+                                           "checkpoints-mp")
                 results = []
                 with timed("Train (grid, multi-process)", run_logger), \
                         profiled(profile_dir):
@@ -363,7 +374,8 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                         mp = train_game_multiprocess(
                             data, task, coordinate_configs, update_sequence,
                             config.regularization_weights,
-                            n_cd_iterations=args.cd_iterations)
+                            n_cd_iterations=args.cd_iterations,
+                            checkpoint_dir=mp_ckpt, resume=args.resume)
                         evaluation, history = None, []
                         if validation is not None:
                             vdata, evs = validation

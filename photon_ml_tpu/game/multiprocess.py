@@ -47,6 +47,7 @@ from __future__ import annotations
 import dataclasses
 import heapq
 import logging
+import os
 from typing import Mapping, Optional, Sequence
 
 import numpy as np
@@ -382,6 +383,135 @@ class MultiProcessGameResult:
     scores: dict[str, np.ndarray]
 
 
+# ---------------------------------------------------------------------------
+# Sweep-boundary checkpointing (per-process state files)
+# ---------------------------------------------------------------------------
+#
+# The single-process CoordinateDescent checkpoints per coordinate step
+# (io/checkpoint.py). Multi-process state is row-partitioned — each
+# process's residual scores cover only ITS rows and its random-effect
+# tables only ITS entities — so each process persists its own shard
+# (proc-<pid>/sweep-<k>.npz, atomic tmp+rename) at every sweep boundary,
+# fingerprint-guarded like the single-process manager. Resume agrees on
+# min(latest sweep) across processes, so a process that died mid-save
+# just replays its last complete sweep. The reference's recovery story is
+# the same shape: deterministic re-entry from written models (SURVEY §5.3).
+
+
+def _mp_ckpt_dir(root: str) -> str:
+    import jax
+
+    return os.path.join(root, f"proc-{jax.process_index()}")
+
+
+def _mp_ckpt_save(root: str, sweep: int, fingerprint: str,
+                  scores: Mapping[str, np.ndarray],
+                  re_local_models: Mapping[str, RandomEffectModel],
+                  fe_models: Mapping[str, FixedEffectModel]) -> None:
+    d = _mp_ckpt_dir(root)
+    os.makedirs(d, exist_ok=True)
+    payload: dict[str, np.ndarray] = {}
+    for cid, s in scores.items():
+        payload[f"score::{cid}"] = np.asarray(s, np.float32)
+    for cid, m in re_local_models.items():
+        payload[f"rekeys::{cid}"] = m.keys
+        payload[f"recoef::{cid}"] = m.coeffs
+        if m.variances is not None:
+            payload[f"revar::{cid}"] = m.variances
+        payload[f"remeta::{cid}"] = np.array(
+            [m.dim], np.int64)
+    for cid, m in fe_models.items():
+        payload[f"few::{cid}"] = np.asarray(m.model.coefficients.means,
+                                            np.float32)
+        v = m.model.coefficients.variances
+        if v is not None:
+            payload[f"fev::{cid}"] = np.asarray(v, np.float32)
+    payload["fingerprint"] = np.frombuffer(
+        fingerprint.encode("utf-8"), np.uint8)
+    tmp = os.path.join(d, f".sweep-{sweep}.npz.tmp")
+    with open(tmp, "wb") as f:
+        np.savez(f, **payload)
+    os.replace(tmp, os.path.join(d, f"sweep-{sweep}.npz"))
+    # prune like the single-process manager (io/checkpoint.py keep=3): a
+    # 10M-row score decomposition is ~10s of MB per sweep per process
+    kept = sorted(
+        (int(n[len("sweep-"):-len(".npz")]) for n in os.listdir(d)
+         if n.startswith("sweep-") and n.endswith(".npz")), reverse=True)
+    for old in kept[3:]:
+        try:
+            os.unlink(os.path.join(d, f"sweep-{old}.npz"))
+        except OSError:
+            pass
+
+
+def _mp_ckpt_latest(root: str) -> int:
+    """Latest complete sweep saved by THIS process (-1: none)."""
+    d = _mp_ckpt_dir(root)
+    if not os.path.isdir(d):
+        return -1
+    best = -1
+    for name in os.listdir(d):
+        if name.startswith("sweep-") and name.endswith(".npz"):
+            try:
+                best = max(best, int(name[len("sweep-"):-len(".npz")]))
+            except ValueError:
+                pass
+    return best
+
+
+def _mp_ckpt_load(root: str, sweep: int, fingerprint: str, task,
+                  re_templates: Mapping[str, RandomEffectModel],
+                  fe_templates: Mapping[str, object]):
+    """Restore this process's (scores, re_local_models, fe_models).
+
+    ``re_templates``/``fe_templates`` carry the non-array fields (types,
+    shard ids, the seed-derived projector) from the current configuration
+    — state files hold arrays only, and a configuration mismatch is
+    caught by the fingerprint (which hashes the run shape AND every
+    coordinate's configuration repr)."""
+    with np.load(os.path.join(_mp_ckpt_dir(root),
+                              f"sweep-{sweep}.npz")) as z:
+        saved_fp = bytes(z["fingerprint"]).decode("utf-8")
+        if saved_fp != fingerprint:
+            raise ValueError(
+                f"checkpoint fingerprint mismatch under {root!r}: saved "
+                f"{saved_fp!r} != current {fingerprint!r} — the run "
+                "configuration or row partition changed; delete the "
+                "checkpoint directory to start fresh")
+        scores = {k[len("score::"):]: z[k] for k in z.files
+                  if k.startswith("score::")}
+        re_models = {}
+        for k in z.files:
+            if not k.startswith("rekeys::"):
+                continue
+            cid = k[len("rekeys::"):]
+            t = re_templates[cid]
+            re_models[cid] = RandomEffectModel(
+                random_effect_type=t.random_effect_type,
+                feature_shard_id=t.feature_shard_id, task=task,
+                dim=int(z[f"remeta::{cid}"][0]),
+                keys=z[f"rekeys::{cid}"], coeffs=z[f"recoef::{cid}"],
+                variances=(z[f"revar::{cid}"]
+                           if f"revar::{cid}" in z.files else None),
+                # seed-derived, identical on every process — must survive
+                # resume or a projected-space model would score raw ids
+                projector=t.projector)
+        fe_models = {}
+        for k in z.files:
+            if not k.startswith("few::"):
+                continue
+            cid = k[len("few::"):]
+            fe_models[cid] = FixedEffectModel(
+                model=GeneralizedLinearModel(
+                    coefficients=Coefficients(
+                        means=z[k],
+                        variances=(z[f"fev::{cid}"]
+                                   if f"fev::{cid}" in z.files else None)),
+                    task=task),
+                feature_shard_id=fe_templates[cid].feature_shard_id)
+    return scores, re_models, fe_models
+
+
 @dataclasses.dataclass(frozen=True)
 class _REPlan:
     config: RandomEffectDatasetConfig
@@ -415,6 +545,8 @@ def train_game_multiprocess(
     n_cd_iterations: int = 1,
     fe_mesh=None,
     re_mesh=None,
+    checkpoint_dir: Optional[str] = None,
+    resume: bool = False,
 ) -> MultiProcessGameResult:
     """Run GAME coordinate descent across all processes.
 
@@ -559,11 +691,60 @@ def train_game_multiprocess(
     scores: dict[str, np.ndarray] = {
         cid: np.zeros(len(primary_rows), np.float32)
         for cid in update_sequence}
-    total = game_primary.offsets.astype(np.float32) + 0.0
     models: dict[str, object] = {}
     re_local_models: dict[str, RandomEffectModel] = {}
 
-    for sweep in range(n_cd_iterations):
+    start_sweep = 0
+    fingerprint = None
+    if checkpoint_dir is not None:
+        import hashlib
+        import json
+
+        fingerprint = hashlib.sha1(json.dumps({
+            "n_proc": n_proc,
+            "task": str(task),
+            "sequence": list(update_sequence),
+            "lam": sorted((c, float(lam.get(c, 0.0)))
+                          for c in update_sequence),
+            # every coordinate's full configuration (optimizer, bounds,
+            # regularization, shard ids) — resuming under a changed config
+            # must fail loudly, not blend incompatible state
+            "configs": {c: repr(coordinate_configs[c])
+                        for c in update_sequence},
+            "n_global": n_global,
+            "rows": hashlib.sha1(
+                np.ascontiguousarray(primary_rows).tobytes()).hexdigest(),
+        }, sort_keys=True).encode()).hexdigest()
+        if resume:
+            # every process resumes from the newest sweep ALL of them
+            # completed (a process that died mid-save replays its last
+            # complete one)
+            latest = -allreduce_max(
+                np.array([-_mp_ckpt_latest(checkpoint_dir)], np.int64))
+            agreed = int(latest[0])
+            if agreed >= 0:
+                re_templates = {
+                    cid: RandomEffectModel(
+                        random_effect_type=p.config.random_effect_type,
+                        feature_shard_id=p.config.feature_shard_id,
+                        task=task, dim=0, keys=np.zeros(0, np.int64),
+                        coeffs=np.zeros(0, np.float32),
+                        projector=p.dataset.projector)
+                    for cid, p in re_plans.items()}
+                saved_scores, re_local_models, fe_models = _mp_ckpt_load(
+                    checkpoint_dir, agreed, fingerprint, task,
+                    re_templates, fe_datasets)
+                scores.update(saved_scores)
+                models.update(fe_models)
+                # the RE coordinates' contribution to the GLOBAL model also
+                # comes back from the local tables at assembly time below
+                start_sweep = agreed + 1
+                logger.info("mp resumed from checkpoint sweep %d", agreed)
+
+    total = game_primary.offsets.astype(np.float32) + sum(
+        scores[cid] for cid in update_sequence)
+
+    for sweep in range(start_sweep, n_cd_iterations):
         for cid in update_sequence:
             cfg = coordinate_configs[cid]
             residual = total - scores[cid]
@@ -613,6 +794,11 @@ def train_game_multiprocess(
             total = residual + new_scores
             scores[cid] = new_scores
             logger.info("mp sweep %d coordinate %s done", sweep, cid)
+        if checkpoint_dir is not None:
+            _mp_ckpt_save(checkpoint_dir, sweep, fingerprint, scores,
+                          re_local_models,
+                          {cid: m for cid, m in models.items()
+                           if cid in fe_datasets})
 
     # --- model assembly: allgather RE tables ------------------------------
     for cid, local_model in re_local_models.items():

@@ -294,6 +294,83 @@ class TestReconcileGlobalIds:
         np.testing.assert_array_equal(d2.id_columns["u"], [-1, -1])
 
 
+class TestMultiprocessCheckpoint:
+    """Sweep-boundary checkpoint/resume of the multi-process CD driver
+    (single-process here — the state files are per-process either way)."""
+
+    def _setup(self):
+        from photon_ml_tpu.ops.regularization import L2Regularization
+
+        game, _ = make_mixed_effect(n=300, d_fixed=5, d_re=3, n_entities=9,
+                                    seed=4)
+        opt = GLMOptimizationConfiguration(
+            regularization=L2Regularization,
+            optimizer_config=OptimizerConfig(max_iterations=30))
+        configs = {
+            "global": FixedEffectCoordinateConfig("fixed", opt),
+            "perEntity": RandomEffectCoordinateConfig(
+                RandomEffectDatasetConfig("entityId", "re"), opt),
+        }
+        lam = {"global": 1e-3, "perEntity": 0.5}
+        return game, configs, ["global", "perEntity"], lam
+
+    def test_resume_reproduces_straight_run(self, tmp_path):
+        game, configs, seq, lam = self._setup()
+        straight = train_game_multiprocess(
+            game, TaskType.LOGISTIC_REGRESSION, configs, seq, lam,
+            n_cd_iterations=2)
+
+        ck = str(tmp_path / "ck")
+        train_game_multiprocess(
+            game, TaskType.LOGISTIC_REGRESSION, configs, seq, lam,
+            n_cd_iterations=1, checkpoint_dir=ck)
+        resumed = train_game_multiprocess(
+            game, TaskType.LOGISTIC_REGRESSION, configs, seq, lam,
+            n_cd_iterations=2, checkpoint_dir=ck, resume=True)
+
+        w_a = np.asarray(
+            straight.model.coordinates["global"].model.coefficients.means)
+        w_b = np.asarray(
+            resumed.model.coordinates["global"].model.coefficients.means)
+        np.testing.assert_allclose(w_b, w_a, atol=1e-5, rtol=1e-4)
+        re_a = straight.model.coordinates["perEntity"]
+        re_b = resumed.model.coordinates["perEntity"]
+        np.testing.assert_array_equal(re_b.keys, re_a.keys)
+        np.testing.assert_allclose(re_b.coeffs, re_a.coeffs,
+                                   atol=1e-5, rtol=1e-4)
+
+    def test_fingerprint_mismatch_rejected(self, tmp_path):
+        game, configs, seq, lam = self._setup()
+        ck = str(tmp_path / "ck")
+        train_game_multiprocess(
+            game, TaskType.LOGISTIC_REGRESSION, configs, seq, lam,
+            n_cd_iterations=1, checkpoint_dir=ck)
+        with pytest.raises(ValueError, match="fingerprint mismatch"):
+            train_game_multiprocess(
+                game, TaskType.LOGISTIC_REGRESSION, configs, seq,
+                {"global": 1e-3, "perEntity": 2.0},  # different lambda
+                n_cd_iterations=2, checkpoint_dir=ck, resume=True)
+
+    def test_resume_past_end_returns_final_model(self, tmp_path):
+        game, configs, seq, lam = self._setup()
+        ck = str(tmp_path / "ck")
+        full = train_game_multiprocess(
+            game, TaskType.LOGISTIC_REGRESSION, configs, seq, lam,
+            n_cd_iterations=2, checkpoint_dir=ck)
+        again = train_game_multiprocess(
+            game, TaskType.LOGISTIC_REGRESSION, configs, seq, lam,
+            n_cd_iterations=2, checkpoint_dir=ck, resume=True)
+        np.testing.assert_allclose(
+            np.asarray(
+                again.model.coordinates["global"].model.coefficients.means),
+            np.asarray(
+                full.model.coordinates["global"].model.coefficients.means),
+            atol=1e-6)
+        np.testing.assert_allclose(
+            again.model.coordinates["perEntity"].coeffs,
+            full.model.coordinates["perEntity"].coeffs, atol=1e-6)
+
+
 class TestSubsamplePartitionInvariance:
     """The active-bound reservoir draw must be a pure function of
     (seed, global sample id): a per-process build over a row subset keeps
