@@ -173,6 +173,9 @@ def _cached_npz(name: str, fn, *args) -> dict:
 
 _T0 = time.perf_counter()
 
+# every _emit line, in order — the terminal summary line replays them all
+_RESULTS: list[dict] = []
+
 
 def _emit(metric: str, value: float, unit: str, vs_baseline: float, **extra):
     line = {"metric": metric, "value": round(value, 1), "unit": unit,
@@ -182,7 +185,47 @@ def _emit(metric: str, value: float, unit: str, vs_baseline: float, **extra):
     # artifact (the round-2 harness run timed out with 3/6 metrics and no
     # way to see where the time went)
     line["t_s"] = round(time.perf_counter() - _T0, 1)
+    _RESULTS.append(line)
     print(json.dumps(line), flush=True)
+
+
+def _emit_summary():
+    """The LAST stdout line: one JSON object holding EVERY metric.
+
+    Two consecutive harness runs produced half-empty official scoreboards
+    (round 2: rc=124 truncation; round 3: rc=0 but only the output TAIL is
+    preserved, and five of seven metric lines scrolled out of it). The
+    driver parses the final JSON line of the tail, so a terminal
+    aggregate line makes the artifact complete by construction — including
+    each metric's extras (bucket_build_s, per-stage e2e seconds, ...).
+    Headline value/vs_baseline = the end-to-end driver metric (the
+    north-star-shaped number) when present, else the first metric."""
+    if not _RESULTS:
+        return
+    # a retried/process-group SIGTERM landing mid-print would truncate the
+    # very line this function exists to guarantee — ignore further TERMs
+    # for the final write
+    import signal
+
+    try:
+        signal.signal(signal.SIGTERM, signal.SIG_IGN)
+    except (ValueError, OSError):
+        pass  # non-main thread or exotic platform: emit anyway
+    head = next((r for r in _RESULTS
+                 if r["metric"] == "game_end_to_end_rows_per_sec"),
+                _RESULTS[0])
+    summary = {
+        "metric": "suite_summary",
+        "value": head["value"],
+        "unit": head["unit"] + " (headline: " + head["metric"] + ")",
+        "vs_baseline": head["vs_baseline"],
+        "n_metrics": len(_RESULTS),
+        "suite_wall_s": round(time.perf_counter() - _T0, 1),
+        "metrics": {r["metric"]: {k: v for k, v in r.items()
+                                  if k != "metric"}
+                    for r in _RESULTS},
+    }
+    print(json.dumps(summary), flush=True)
 
 
 # --------------------------------------------------------------------------
@@ -750,10 +793,23 @@ def main(argv=None):
                    help="run a single benchmark instead of the full suite")
     args = p.parse_args(argv)
     _setup_compile_cache()
+    # a harness timeout delivers SIGTERM, whose default disposition kills
+    # the process without running finally blocks — convert it to SystemExit
+    # so the summary still prints (the round-2 rc=124 artifact would have
+    # been complete with this)
+    import signal
+
+    def _sigterm(signum, frame):
+        raise SystemExit(124)
+
+    signal.signal(signal.SIGTERM, _sigterm)
     if args.only:
-        {"glm": bench_glm, "re": bench_random_effect,
-         "cd": bench_cd_sweep, "ingest": bench_ingest,
-         "e2e": bench_end_to_end}[args.only]()
+        try:
+            {"glm": bench_glm, "re": bench_random_effect,
+             "cd": bench_cd_sweep, "ingest": bench_ingest,
+             "e2e": bench_end_to_end}[args.only]()
+        finally:
+            _emit_summary()
         return
     # Order = risk management for the harness wall budget: the metrics the
     # round-2 artifact MISSED (cd sweep, ingest, write, e2e — rc=124) run
@@ -775,16 +831,22 @@ def main(argv=None):
         jax.clear_caches()
         gc.collect()
 
-    bench_glm()
-    drain()
-    host_cd_rate = bench_cd_sweep()
-    drain()
-    py_ingest_rate = bench_ingest()
-    drain()
-    bench_end_to_end(host_cd_rate=host_cd_rate,
-                     py_ingest_rate=py_ingest_rate)
-    drain()
-    bench_random_effect()
+    # the summary is emitted from a finally so that even a partial run
+    # (timeout kill arrives between benches, one bench raises) leaves a
+    # terminal line with everything measured so far
+    try:
+        bench_glm()
+        drain()
+        host_cd_rate = bench_cd_sweep()
+        drain()
+        py_ingest_rate = bench_ingest()
+        drain()
+        bench_end_to_end(host_cd_rate=host_cd_rate,
+                         py_ingest_rate=py_ingest_rate)
+        drain()
+        bench_random_effect()
+    finally:
+        _emit_summary()
 
 
 if __name__ == "__main__":
