@@ -90,7 +90,14 @@ class RandomEffectModel:
     task: TaskType
     dim: int  # key modulus: shard vocabulary size, or projected dim
     keys: np.ndarray  # (k,) int64, sorted
-    coeffs: np.ndarray  # (k,) float32
+    #: (k,) float32 — the solver may install a zero-arg THUNK returning
+    #: ``(coeffs, variances)`` instead of the arrays: the device→host pull
+    #: of the coefficient table then happens on first ACCESS, not at
+    #: construction, so coordinate descent can dispatch the next
+    #: coordinate's programs while this one's are still executing (each
+    #: eager pull was a full pipeline barrier). ``__getattribute__``
+    #: materializes transparently; everything downstream sees ndarrays.
+    coeffs: np.ndarray
     variances: Optional[np.ndarray] = None
     projector: Optional["RandomProjector"] = None
     #: same values as ``coeffs`` still resident on device (set by the
@@ -98,6 +105,17 @@ class RandomEffectModel:
     #: passive scoring run on-device instead of re-uploading the table
     coeffs_device: Optional[object] = dataclasses.field(
         default=None, compare=False, repr=False)
+
+    def __getattribute__(self, name):
+        if name in ("coeffs", "variances"):
+            val = object.__getattribute__(self, name)
+            if callable(val):
+                c, v = val()
+                object.__setattr__(self, "coeffs", c)
+                object.__setattr__(self, "variances", v)
+                return object.__getattribute__(self, name)
+            return val
+        return object.__getattribute__(self, name)
 
     @property
     def n_entities(self) -> int:
@@ -195,6 +213,53 @@ class GameModel:
 
     coordinates: Mapping[str, FixedEffectModel | RandomEffectModel]
     task: TaskType
+
+    def materialize(self) -> None:
+        """Pull every coordinate's device-resident table host-side in ONE
+        concatenated transfer (each individual pull pays a full host↔device
+        round trip — ~0.1 s apiece through a tunneled device). Random-effect
+        models expose their pending sweep payload on the lazy-coeffs thunk;
+        fixed-effect coefficients are jax arrays. No-op when everything is
+        already host-resident."""
+        import jax
+
+        import jax.numpy as jnp
+
+        jobs = []  # (install_fn, flat_device_array)
+        for m in self.coordinates.values():
+            if isinstance(m, RandomEffectModel):
+                thunk = object.__getattribute__(m, "coeffs")
+                dev = getattr(thunk, "device_payload", None) \
+                    if callable(thunk) else None
+                if dev is None:
+                    continue
+
+                def install_re(flat, m=m, thunk=thunk):
+                    c, v = thunk(flat)
+                    object.__setattr__(m, "coeffs", c)
+                    object.__setattr__(m, "variances", v)
+
+                jobs.append((install_re, dev))
+            elif isinstance(m, FixedEffectModel):
+                coeffs = m.model.coefficients
+                for field in ("means", "variances"):
+                    arr = getattr(coeffs, field)
+                    if isinstance(arr, jax.Array):
+
+                        def install_fe(flat, coeffs=coeffs, field=field,
+                                       shape=arr.shape):
+                            object.__setattr__(coeffs, field,
+                                               flat.reshape(shape))
+
+                        jobs.append((install_fe, arr.reshape(-1)))
+        if not jobs:
+            return
+        sizes = [int(d.shape[0]) for _, d in jobs]
+        flat = np.asarray(
+            jnp.concatenate([d.astype(jnp.float32) for _, d in jobs]))
+        bounds = np.cumsum([0] + sizes)
+        for (install, _), lo, hi in zip(jobs, bounds[:-1], bounds[1:]):
+            install(flat[lo:hi])
 
     def score(self, data: GameData) -> np.ndarray:
         """Total margin per sample: offsets + sum of coordinate scores."""

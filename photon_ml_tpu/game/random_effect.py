@@ -45,6 +45,30 @@ from photon_ml_tpu.parallel.mesh import ENTITY_AXIS
 from photon_ml_tpu.types import TaskType, VarianceComputationType
 
 
+# Sweep-program signatures this PROCESS has already compiled+executed once.
+# _warm_compile's zero-data warm run exists to pay the XLA compile (and its
+# jit-dispatch-cache insertion) off the critical path — but a driver called
+# twice in one process (bench warm runs, sweeps over configs, notebooks)
+# would re-EXECUTE the whole zero sweep on device per call: ~0.9 s of the
+# warm e2e wall was train() joining a background thread that was re-running
+# an already-compiled program on zeros. Holds HASHES of (solver, sample
+# count, bucket shapes, warm-table length) signatures — storing the tuples
+# themselves would retain solvers/meshes forever in a long sweep process; a
+# hash collision merely skips one warm-up (jit compiles at first real call).
+_PRECOMPILED: set[int] = set()
+
+
+def _bucket_keys(bucket: REBucket, shard_dim: int) -> np.ndarray:
+    """Model-table keys for one bucket's kept (entity, feature) slots —
+    ``entity_id * shard_dim + shard_feature_id`` over ``feature_index >= 0``,
+    in bucket slot order. The single home of the key layout: the host table
+    assembly and the dataset-static key cache must agree exactly."""
+    fmask = bucket.feature_index >= 0
+    ent = np.broadcast_to(bucket.entity_ids[:, None],
+                          bucket.feature_index.shape)
+    return ent[fmask] * np.int64(shard_dim) + bucket.feature_index[fmask]
+
+
 @dataclasses.dataclass(frozen=True)
 class RandomEffectSolver:
     """Per-coordinate solver configuration bound to a task type.
@@ -103,12 +127,14 @@ class RandomEffectSolver:
             out_specs=(s, s, s), check_vma=False,
         )(x, labels, offsets, weights, w0, lam)
 
-    def _put(self, a):
+    def _put(self, a, pad_value=0):
         """Pad the entity dim to the mesh axis size and shard lanes over it.
 
-        Padded lanes carry all-zero data and weights, so their gradient is
-        exactly the L2 term at w=0 (zero) — they converge immediately and
-        their coefficients stay 0; :meth:`train` slices them off.
+        Padded lanes carry all-zero data and weights (``pad_value=0``), so
+        their gradient is exactly the L2 term at w=0 (zero) — they converge
+        immediately and their coefficients stay 0; :meth:`train` slices them
+        off. The compact index arrays pad with ``-1`` instead: their masks
+        (row/col >= 0) then treat padded lanes as fully absent.
         """
         a = np.asarray(a)
         if self.mesh is None:
@@ -118,7 +144,7 @@ class RandomEffectSolver:
         e_pad = -(-e // n_dev) * n_dev
         if e_pad != e:
             a = np.concatenate(
-                [a, np.zeros((e_pad - e,) + a.shape[1:], a.dtype)])
+                [a, np.full((e_pad - e,) + a.shape[1:], pad_value, a.dtype)])
         return jax.device_put(a, NamedSharding(self.mesh, P(self.entity_axis)))
 
     def _static_arrays(self, dataset: RandomEffectDataset, i: int,
@@ -152,6 +178,42 @@ class RandomEffectSolver:
             dataset._device_cache[key] = cached
         return cached
 
+    def _compact_shared(self, dataset: RandomEffectDataset):
+        """Per-run shared device arrays for the compact-upload sweep:
+        ``(dense shard image, labels, weights)`` — or None when the dataset
+        carries no source data or the shard is too wide to densify.
+
+        The padded ``(E, S, D)`` bucket tensors are pure gathers of these
+        through the bucket's sample/feature index maps, so shipping the
+        indices and gathering ON DEVICE replaces ~3-4x-inflated bucket
+        uploads with one compact CSR upload shared by every coordinate on
+        the same shard — decisive on a ~35 MB/s host↔device link, and
+        fewer bytes moved on any hardware."""
+        data = dataset.source_data
+        if data is None or dataset.projector is not None:
+            return None
+        shard_x = data.device_dense_shard(dataset.config.feature_shard_id)
+        if shard_x is None:
+            return None
+        return shard_x, data.device_labels(), data.device_weights()
+
+    def _compact_arrays(self, dataset: RandomEffectDataset, i: int,
+                        bucket: REBucket):
+        """Device placements of one bucket's index maps (the ONLY per-bucket
+        upload in compact mode): sample_idx (E, S) int32 with -1 padding,
+        feature_index (E, D) int32 with -1 padding. The fused program
+        derives the gather/scatter indices, masks, and all three data
+        tensors from them."""
+        key = ("compact", i, self.mesh, self.entity_axis)
+        cached = dataset._device_cache.get(key)
+        if cached is None:
+            cached = (
+                self._put(bucket.sample_idx.astype(np.int32), pad_value=-1),
+                self._put(bucket.feature_index.astype(np.int32),
+                          pad_value=-1))
+            dataset._device_cache[key] = cached
+        return cached
+
     @partial(jax.jit, static_argnames=("self",))
     def _margins_bucket(self, x, w):
         return jnp.einsum("esd,ed->es", x, w,
@@ -159,7 +221,7 @@ class RandomEffectSolver:
 
     @partial(jax.jit, static_argnames=("self", "e_reals", "out_sharding"))
     def _sweep_fused(self, offsets_dev, lam, statics, warm_ctxs, coeffs_warm,
-                     cidxs, e_reals, out_sharding=None):
+                     cidxs, e_reals, out_sharding=None, shared=None):
         """One program for the WHOLE coordinate sweep: per bucket, gather
         residual offsets, gather warm starts from the previous sweep's
         coefficient table, solve, compute margins, scatter into the score
@@ -173,15 +235,44 @@ class RandomEffectSolver:
         the overhead once (and on any hardware saves launch+sync cost).
         ``coeffs_warm`` is sized to the dataset's full key-table length from
         sweep 0 (zeros — every ``found`` is False), so a single compilation
-        serves the cold sweep and every warm sweep."""
+        serves the cold sweep and every warm sweep.
+
+        Two statics layouts per bucket:
+
+        - compact (2-tuple, with ``shared``): ``(sample_idx, feature_index)``
+          int32 index maps (-1 = padding); the program gathers the bucket's
+          x/labels/weights out of the ``shared`` (dense shard image, labels,
+          weights) arrays — the only per-bucket H2D is the index maps.
+        - fat (5-tuple): pre-filled ``(x, labels, weights, gather_idx,
+          scatter_idx)`` host tensors, for datasets without source data or
+          whose shard is too wide to densify.
+        """
         scores = jnp.zeros_like(offsets_dev)
+        n = offsets_dev.shape[0]
         flat_w: list[jnp.ndarray] = []
         flat_v: list[jnp.ndarray] = []
         coef_parts: list[jnp.ndarray] = []
-        for (x_d, lab_d, wt_d, idx_d, store_d), (pos_d, found_d), cidx, \
+        for statics_k, (pos_d, found_d), cidx, \
                 e_real in zip(statics, warm_ctxs, cidxs, e_reals):
-            boff = jnp.take(offsets_dev, idx_d.reshape(-1),
-                            mode="clip").reshape(idx_d.shape) * (wt_d > 0)
+            if len(statics_k) == 2:
+                idx_d, fi_d = statics_k
+                shard_x, labels_g, weights_g = shared
+                clip = jnp.maximum(idx_d, 0)
+                rmask = idx_d >= 0
+                fclip = jnp.maximum(fi_d, 0)
+                cmask = fi_d >= 0
+                x_d = (shard_x[clip[:, :, None], fclip[:, None, :]]
+                       * rmask[:, :, None] * cmask[:, None, :])
+                lab_d = labels_g[clip]
+                wt_d = weights_g[clip] * rmask
+                boff = offsets_dev[clip] * rmask
+                store_d = jnp.where(rmask, idx_d, n)
+                full_scatter = True  # padded lanes carry index n -> dropped
+            else:
+                x_d, lab_d, wt_d, idx_d, store_d = statics_k
+                boff = jnp.take(offsets_dev, idx_d.reshape(-1),
+                                mode="clip").reshape(idx_d.shape) * (wt_d > 0)
+                full_scatter = False  # store_d is (e_real, S)
             w0 = jnp.where(
                 found_d,
                 jnp.take(coeffs_warm, pos_d.reshape(-1),
@@ -189,7 +280,9 @@ class RandomEffectSolver:
                 0.0).astype(jnp.float32)
             w_dev, variances, _conv = self._solve_bucket(
                 x_d, lab_d, boff, wt_d, w0, lam)
-            margins = self._margins_bucket(x_d, w_dev)[:e_real]
+            margins = self._margins_bucket(x_d, w_dev)
+            if not full_scatter:
+                margins = margins[:e_real]
             scores = scores.at[store_d].set(margins, mode="drop")
             flat_w.append(w_dev[:e_real].reshape(-1))
             flat_v.append(jnp.asarray(variances)[:e_real].reshape(-1))
@@ -338,21 +431,37 @@ class RandomEffectSolver:
         if (n is not None and dataset.config.cache_device_buckets
                 and dataset.projector is None and dataset.buckets):
             buckets = dataset.buckets
-            statics = tuple(self._static_arrays(dataset, i, b, n)
-                            for i, b in enumerate(buckets))
+            # the uploads/joins below are per-DATASET work train() reuses —
+            # always worth doing here (overlapped with the fixed-effect
+            # stage); only the zero-data execution is skippable when this
+            # process already compiled the program
+            shared = self._compact_shared(dataset)
+            if shared is not None:
+                statics = tuple(self._compact_arrays(dataset, i, b)
+                                for i, b in enumerate(buckets))
+            else:
+                statics = tuple(self._static_arrays(dataset, i, b, n)
+                                for i, b in enumerate(buckets))
             warm_ctxs = tuple(self._warm_ctx(dataset, i, b, None, 0)
                               for i, b in enumerate(buckets))
             cidxs = tuple(self._coef_idx(dataset, i, b)
                           for i, b in enumerate(buckets))
-            out = self._sweep_fused(
-                jnp.zeros((n,), jnp.float32), jnp.zeros((), jnp.float32),
-                statics, warm_ctxs, self._zero_coeffs(dataset), cidxs,
-                tuple(b.n_entities for b in buckets))
-            np.asarray(out[1][:1])  # D2H: the only reliable barrier on axon
+            sig = hash((self, n, shared is not None,
+                        tuple((b.x.shape, b.labels.shape, b.n_entities)
+                              for b in buckets),
+                        self._key_table_len(dataset)))
+            if sig not in _PRECOMPILED:
+                out = self._sweep_fused(
+                    jnp.zeros((n,), jnp.float32), jnp.zeros((), jnp.float32),
+                    statics, warm_ctxs, self._zero_coeffs(dataset), cidxs,
+                    tuple(b.n_entities for b in buckets), shared=shared)
+                np.asarray(out[1][:1])  # D2H: the only reliable barrier on axon
+                _PRECOMPILED.add(sig)
             object.__setattr__(dataset, "_warm_compiled", (self.mesh,))
             return
         shapes = sorted({(bucket.x.shape, bucket.labels.shape)
                          for bucket in dataset.buckets})
+        shapes = [s for s in shapes if hash((self, s)) not in _PRECOMPILED]
         if not shapes:
             object.__setattr__(dataset, "_warm_compiled", (self.mesh,))
             return
@@ -373,6 +482,7 @@ class RandomEffectSolver:
                     self._put(np.zeros((xs[0], xs[2]), f32)),
                     jnp.zeros((), jnp.float32))
             jax.block_until_ready(self._solve_bucket(*args))
+            _PRECOMPILED.add(hash((self, shape_pair)))
 
         import concurrent.futures as cf
 
@@ -445,10 +555,7 @@ class RandomEffectSolver:
 
         def collect_host(bucket, w, variances):
             fmask = bucket.feature_index >= 0
-            ent = np.broadcast_to(bucket.entity_ids[:, None],
-                                  bucket.feature_index.shape)
-            keys_parts.append(
-                ent[fmask] * np.int64(shard_dim) + bucket.feature_index[fmask])
+            keys_parts.append(_bucket_keys(bucket, shard_dim))
             coef_parts.append(w[fmask].astype(np.float32))
             if want_var and np.asarray(variances).size:
                 var_parts.append(np.asarray(variances)[fmask].astype(np.float32))
@@ -458,8 +565,13 @@ class RandomEffectSolver:
             # (see _sweep_fused). The per-bucket path below survives for the
             # streaming (upload-and-drop) and projected modes.
             buckets = dataset.buckets
-            statics = tuple(self._static_arrays(dataset, i, b, n)
-                            for i, b in enumerate(buckets))
+            shared = self._compact_shared(dataset)
+            if shared is not None:
+                statics = tuple(self._compact_arrays(dataset, i, b)
+                                for i, b in enumerate(buckets))
+            else:
+                statics = tuple(self._static_arrays(dataset, i, b, n)
+                                for i, b in enumerate(buckets))
             warm_ctxs = tuple(
                 self._warm_ctx(dataset, i, b, warm_start, shard_dim)
                 for i, b in enumerate(buckets))
@@ -485,23 +597,72 @@ class RandomEffectSolver:
                             and tuple(off_sharding.spec) else None)
             scores, batched_dev, coeffs_unsorted = self._sweep_fused(
                 offsets_dev, lam_dev, statics, warm_ctxs, coeffs_warm,
-                cidxs, e_reals, out_sharding=out_sharding)
-            dev_coeff_parts.append(coeffs_unsorted)
-            batched = np.asarray(batched_dev)  # the sweep's single D2H
+                cidxs, e_reals, out_sharding=out_sharding, shared=shared)
             d_of = [int(b.x.shape[2]) for b in buckets]
             w_sizes = [b.n_entities * d for b, d in zip(buckets, d_of)]
             v_sizes = [b.n_entities * (d if want_var else 0)
                        for b, d in zip(buckets, d_of)]
             bounds = np.cumsum([0] + w_sizes + v_sizes)
             nb = len(buckets)
-            for k, bucket in enumerate(buckets):
-                w_np = batched[bounds[k]:bounds[k + 1]].reshape(
-                    bucket.n_entities, -1)
-                v_np = batched[bounds[nb + k]:bounds[nb + k + 1]].reshape(
-                    bucket.n_entities, -1)
-                collect_host(bucket, w_np, v_np)
+            # the key table and its sort order are DATASET-static (derived
+            # from bucket entity/feature indexes, not coefficients) — cached
+            hk_key = ("hostkeys", shard_dim)
+            hk = dataset._device_cache.get(hk_key)
+            if hk is None:
+                kp = [_bucket_keys(b, shard_dim) for b in buckets]
+                keys_all = (np.concatenate(kp) if kp
+                            else np.zeros((0,), np.int64))
+                order0 = np.argsort(keys_all, kind="stable")
+                hk = (keys_all[order0], order0)
+                dataset._device_cache[hk_key] = hk
+            keys_sorted, order = hk
 
-        for i, bucket in enumerate(() if fused else dataset.buckets):
+            def host_tables(injected=None, batched_dev=batched_dev,
+                            buckets=buckets, bounds=bounds, nb=nb,
+                            order=order, want_var=want_var):
+                # the sweep's single D2H, deferred to first coeffs access:
+                # coordinate descent can dispatch the NEXT coordinate while
+                # this one's programs are still executing (the eager pull
+                # was a full pipeline barrier per coordinate).
+                # ``injected`` lets GameModel.materialize batch this pull
+                # with every other coordinate's into one transfer.
+                batched = (np.asarray(batched_dev) if injected is None
+                           else np.asarray(injected))
+                cp, vp = [], []
+                for k, bucket in enumerate(buckets):
+                    fmask = bucket.feature_index >= 0
+                    w_np = batched[bounds[k]:bounds[k + 1]].reshape(
+                        bucket.n_entities, -1)
+                    cp.append(w_np[fmask].astype(np.float32))
+                    if want_var:
+                        v_np = batched[bounds[nb + k]:bounds[nb + k + 1]
+                                       ].reshape(bucket.n_entities, -1)
+                        if v_np.size:
+                            vp.append(v_np[fmask].astype(np.float32))
+                coeffs = (np.concatenate(cp) if cp
+                          else np.zeros((0,), np.float32))
+                variances = (np.concatenate(vp)[order]
+                             if want_var and vp else None)
+                return coeffs[order], variances
+
+            host_tables.device_payload = batched_dev
+            ok = ("order",)
+            order_dev = dataset._device_cache.get(ok)
+            if order_dev is None:
+                order_dev = jnp.asarray(order)
+                dataset._device_cache[ok] = order_dev
+            coeffs_device = coeffs_unsorted[order_dev]
+            model = RandomEffectModel(
+                random_effect_type=cfg.random_effect_type,
+                feature_shard_id=cfg.feature_shard_id,
+                task=self.task, dim=shard_dim, keys=keys_sorted,
+                coeffs=host_tables,
+                variances=host_tables if want_var else None,
+                projector=dataset.projector,
+                coeffs_device=coeffs_device)
+            return model, scores
+
+        for i, bucket in enumerate(dataset.buckets):  # non-fused modes only
             e_real = bucket.n_entities
             x_d, lab_d, wt_d, idx_d, store_d = self._static_arrays(
                 dataset, i, bucket, n)

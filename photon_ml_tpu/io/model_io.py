@@ -129,6 +129,9 @@ def save_game_model(
 ) -> None:
     """Write the reference's fixed-effect/random-effect directory tree."""
     os.makedirs(output_dir, exist_ok=True)
+    # one combined device→host pull for every coordinate's tables (vs one
+    # round trip per coordinate as each writer touches its arrays)
+    model.materialize()
     metadata = {"task": model.task.value, "coordinates": {}}
     for cid, cm in model.coordinates.items():
         if isinstance(cm, FixedEffectModel):
