@@ -776,12 +776,26 @@ def bench_end_to_end(host_cd_rate=None, py_ingest_rate=None):
         result = train_game_cli.run(args + ["--output-dir", out])
         wall = time.perf_counter() - t0
         assert os.path.exists(os.path.join(out, "best", "model-metadata.json"))
+        # per-stage breakdown from the driver's own metrics.jsonl (the
+        # reference logs the same stage walls via Timed.scala)
+        stages = {}
+        metrics_path = os.path.join(out, "metrics.jsonl")
+        if os.path.exists(metrics_path):
+            with open(metrics_path) as f:
+                for line in f:
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue  # blank/truncated line must not kill the run
+                    if "stage" in rec and "seconds" in rec:
+                        stages[rec["stage"]] = round(
+                            stages.get(rec["stage"], 0.0) + rec["seconds"], 3)
     del result  # model artifacts asserted above; no validation pass here
     e2e_rate = E2E_ROWS / wall
     base_rate = 1.0 / (1.0 / py_ingest_rate + 1.0 / host_cd_rate)
     _emit("game_end_to_end_rows_per_sec", e2e_rate, "rows/s",
           e2e_rate / base_rate, n_rows=int(E2E_ROWS),
-          wall_s=round(wall, 2))
+          wall_s=round(wall, 2), stage_s=stages)
 
 
 def main(argv=None):

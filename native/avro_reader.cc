@@ -78,6 +78,21 @@ struct Reader {
     return true;
   }
 
+  // Zero-copy variant: the returned span aliases the block buffer, which
+  // outlives the record decode — callers must consume it before the next
+  // block. Saves one heap string per call in the per-feature hot loop.
+  bool read_string_view(const char** s, size_t* len) {
+    int64_t n = read_long();
+    if (n < 0 || !need(static_cast<size_t>(n))) {
+      ok = false;
+      return false;
+    }
+    *s = reinterpret_cast<const char*>(p);
+    *len = static_cast<size_t>(n);
+    p += n;
+    return true;
+  }
+
   bool skip_string() {
     int64_t n = read_long();
     if (n < 0 || !need(static_cast<size_t>(n))) {
@@ -126,7 +141,7 @@ constexpr double kNaN = __builtin_nan("");
 // null first (branch 0 = null).
 bool decode_record(Reader& r, const int* field_order, const uint8_t* null_first,
                    const std::vector<std::string>& id_keys, Result* out,
-                   std::string* scratch) {
+                   std::string* scratch, std::string* keybuf) {
   double response = kNaN, offs = kNaN, weight = kNaN;
   std::vector<int32_t> ids(id_keys.size(), -1);
   for (int f = 0; f < 6; ++f) {
@@ -161,14 +176,19 @@ bool decode_record(Reader& r, const int* field_order, const uint8_t* null_first,
             r.read_long();  // byte size, unused
           }
           for (int64_t i = 0; i < count; ++i) {
-            if (!r.read_string(scratch)) return false;
-            std::string key = *scratch;
-            if (!r.read_string(scratch)) return false;
-            key.push_back('\x01');
-            key.append(*scratch);
+            // name + '\x01' + term assembled in a REUSED buffer: the
+            // per-feature `std::string key = ...` copy was ~2M small
+            // allocations per 200k-record file
+            const char* s1;
+            size_t l1;
+            if (!r.read_string_view(&s1, &l1)) return false;
+            keybuf->assign(s1, l1);
+            keybuf->push_back('\x01');
+            if (!r.read_string_view(&s1, &l1)) return false;
+            keybuf->append(s1, l1);
             double v = r.read_double();
             if (!r.ok) return false;
-            out->feat_key.push_back(out->feat_keys.intern(key));
+            out->feat_key.push_back(out->feat_keys.intern(*keybuf));
             out->feat_val.push_back(v);
           }
         }
@@ -188,11 +208,13 @@ bool decode_record(Reader& r, const int* field_order, const uint8_t* null_first,
             r.read_long();
           }
           for (int64_t i = 0; i < count; ++i) {
-            if (!r.read_string(scratch)) return false;
-            std::string k = *scratch;
+            const char* ks;
+            size_t kl;
+            if (!r.read_string_view(&ks, &kl)) return false;
             if (!r.read_string(scratch)) return false;
             for (size_t c = 0; c < id_keys.size(); ++c) {
-              if (id_keys[c] == k) {
+              if (id_keys[c].size() == kl
+                  && std::memcmp(id_keys[c].data(), ks, kl) == 0) {
                 ids[c] = out->id_vocabs[c].intern(*scratch);
               }
             }
@@ -277,6 +299,7 @@ void* photon_decode_blocks(const uint8_t* blocks, int64_t blocks_len,
   Reader file{blocks, blocks + blocks_len};
   std::vector<uint8_t> scratch_block;
   std::string scratch;
+  std::string keybuf;
   while (file.p < file.end) {
     int64_t n_records = file.read_long();
     int64_t size = file.read_long();
@@ -304,7 +327,7 @@ void* photon_decode_blocks(const uint8_t* blocks, int64_t blocks_len,
     }
     for (int64_t i = 0; i < n_records; ++i) {
       if (!decode_record(rec, field_order, null_first, id_keys, out,
-                         &scratch)) {
+                         &scratch, &keybuf)) {
         out->error = "record decode error";
         return out;
       }

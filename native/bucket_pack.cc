@@ -190,4 +190,33 @@ void photon_re_bucket_fill(const int64_t* indptr, const int32_t* cols,
   }
 }
 
+// Pass B' (indices only): sample_idx + feature_index without filling the
+// (E, S, D) tensors.  The device-side compact path reconstructs
+// x/labels/weights by gathers through these index maps, so the fat fill —
+// the dominant host cost of a bucket build (a ~3-4x-padded memset+scatter)
+// — is skipped entirely unless some host path later materializes it.
+// Same scratch contract as pass B for `stamp`/`support`.
+void photon_re_bucket_indices(const int64_t* indptr, const int32_t* cols,
+                              const int64_t* all_active,
+                              const int64_t* ent_starts, const int64_t* sel,
+                              int64_t E, int64_t S, int64_t D,
+                              int64_t max_active_features, int64_t* stamp,
+                              int64_t* support, int64_t* sample_idx,
+                              int64_t* feature_index) {
+  std::vector<int32_t> observed;
+  for (int64_t ei = 0; ei < E; ++ei) {
+    const int64_t e = sel[ei];
+    scan_entity(indptr, cols, all_active, ent_starts, e, stamp, support,
+                observed, ent_starts[e + 1]);
+    select_features(observed, support, max_active_features);
+    int64_t* fi = feature_index + ei * D;
+    for (size_t l = 0; l < observed.size(); ++l)
+      fi[l] = static_cast<int64_t>(observed[l]);
+    int64_t* se = sample_idx + ei * S;
+    int64_t s = 0;
+    for (int64_t r = ent_starts[e]; r < ent_starts[e + 1]; ++r, ++s)
+      se[s] = all_active[r];
+  }
+}
+
 }  // extern "C"

@@ -600,20 +600,43 @@ class REBucket:
     """
 
     entity_ids: np.ndarray  # (E,) int64 — global entity index
-    x: np.ndarray  # (E, S, D) float32
+    #: (E, S, D) float32 — the native build installs a zero-arg THUNK
+    #: returning ``(x, labels, weights)`` instead when the solver's compact
+    #: device path makes the host fill unnecessary (the fill is the
+    #: dominant host cost of a bucket build); ``__getattribute__``
+    #: materializes transparently on first access.
+    x: np.ndarray
     labels: np.ndarray  # (E, S) float32
     offsets_zero: bool  # offsets supplied per sweep; kept for clarity
     weights: np.ndarray  # (E, S) float32 (0 = padding)
     sample_idx: np.ndarray  # (E, S) int64 global sample row of each slot (-1 pad)
     feature_index: np.ndarray  # (E, D) int64 shard-global feature ids (-1 pad)
 
+    def __getattribute__(self, name):
+        if name in ("x", "labels", "weights"):
+            val = object.__getattribute__(self, name)
+            if callable(val):
+                x, labels, weights = val()
+                object.__setattr__(self, "x", x)
+                object.__setattr__(self, "labels", labels)
+                object.__setattr__(self, "weights", weights)
+                return object.__getattribute__(self, name)
+            return val
+        return object.__getattribute__(self, name)
+
     @property
     def n_entities(self) -> int:
         return int(self.entity_ids.shape[0])
 
     @property
+    def tensor_shape(self) -> tuple[int, int, int]:
+        """(E, S, D) without materializing a lazy ``x``."""
+        e, s = self.sample_idx.shape
+        return (e, s, int(self.feature_index.shape[1]))
+
+    @property
     def shape(self) -> tuple[int, int]:
-        return (int(self.x.shape[1]), int(self.x.shape[2]))
+        return self.tensor_shape[1:]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -829,13 +852,48 @@ def _index_map_buckets_native(data, shard, all_active, ent_of_active,
     s_pad, d_pad = _padded_shapes(n_samp_per_entity, n_feat_per_entity, config)
     bucket_key = s_pad * np.int64(1 << 40) + d_pad
     labels32, weights32 = data.labels, data.weights
+    # indices-only build when the solver's compact device path will
+    # reconstruct the fat tensors on device: the (E, S, D) host fill (a
+    # ~3-4x-padded memset+scatter) is deferred to a lazy thunk that almost
+    # nothing ever calls. Conservative gate — mirrors _compact_shared's
+    # densify bound; a config that later needs the fat path just pays the
+    # fill at first access.
+    # (RANDOM-projected configs never reach this builder, so projector-free
+    # is already guaranteed here)
+    indices_only = (config.cache_device_buckets
+                    and shard.n_samples * shard.dim * 4
+                    <= DENSE_DESIGN_MAX_BYTES)
     buckets: list[REBucket] = []
     for key in np.unique(bucket_key):
         sel = np.flatnonzero(bucket_key == key)
+        S, D = int(s_pad[sel[0]]), int(d_pad[sel[0]])
+        if indices_only:
+            packed = native.re_bucket_indices(
+                indptr, cols, aa, ent_starts, sel, S, D,
+                config.max_active_features, scratch)
+            if packed is None:
+                return None
+            sample_idx, feature_index = packed
+
+            def fill(sel=sel, S=S, D=D):
+                fresh = native.BucketPackScratch(shard.dim)
+                out = native.re_bucket_fill(
+                    indptr, cols, vals, aa, ent_starts, labels32, weights32,
+                    sel, S, D, shard.dim, config.max_active_features, fresh)
+                if out is None:
+                    raise RuntimeError(
+                        "native library became unavailable for the deferred "
+                        "bucket fill")
+                return out[0], out[1], out[2]
+
+            buckets.append(REBucket(
+                entity_ids=act_entity[sel], x=fill, labels=fill,
+                offsets_zero=True, weights=fill, sample_idx=sample_idx,
+                feature_index=feature_index))
+            continue
         packed = native.re_bucket_fill(
             indptr, cols, vals, aa, ent_starts, labels32, weights32, sel,
-            int(s_pad[sel[0]]), int(d_pad[sel[0]]), shard.dim,
-            config.max_active_features, scratch)
+            S, D, shard.dim, config.max_active_features, scratch)
         if packed is None:
             return None
         x, labels, weights, sample_idx, feature_index = packed

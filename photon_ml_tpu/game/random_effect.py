@@ -192,10 +192,29 @@ class RandomEffectSolver:
         data = dataset.source_data
         if data is None or dataset.projector is not None:
             return None
+        if self.mesh is not None:
+            # entity-mesh runs keep the fat path: its per-bucket tensors
+            # shard 1/n_dev per device, whereas the shared dense image would
+            # be REPLICATED into every device's HBM by GSPMD — near the
+            # densify byte cap that regresses peak memory by n_dev x
+            return None
         shard_x = data.device_dense_shard(dataset.config.feature_shard_id)
         if shard_x is None:
             return None
         return shard_x, data.device_labels(), data.device_weights()
+
+    def _sweep_statics(self, dataset: RandomEffectDataset, n: int):
+        """(shared, statics) for the fused sweep — compact when eligible,
+        fat otherwise. Single home of the selection so train() and
+        _warm_compile() can never pre-compile different layouts."""
+        shared = self._compact_shared(dataset)
+        if shared is not None:
+            statics = tuple(self._compact_arrays(dataset, i, b)
+                            for i, b in enumerate(dataset.buckets))
+        else:
+            statics = tuple(self._static_arrays(dataset, i, b, n)
+                            for i, b in enumerate(dataset.buckets))
+        return shared, statics
 
     def _compact_arrays(self, dataset: RandomEffectDataset, i: int,
                         bucket: REBucket):
@@ -266,6 +285,11 @@ class RandomEffectSolver:
                 lab_d = labels_g[clip]
                 wt_d = weights_g[clip] * rmask
                 boff = offsets_dev[clip] * rmask
+                # materialize the gathered tensors ONCE: without the
+                # barrier XLA is free to fuse the gathers into the solver's
+                # while_loop body and re-gather every optimizer iteration
+                x_d, lab_d, wt_d, boff = jax.lax.optimization_barrier(
+                    (x_d, lab_d, wt_d, boff))
                 store_d = jnp.where(rmask, idx_d, n)
                 full_scatter = True  # padded lanes carry index n -> dropped
             else:
@@ -435,19 +459,13 @@ class RandomEffectSolver:
             # always worth doing here (overlapped with the fixed-effect
             # stage); only the zero-data execution is skippable when this
             # process already compiled the program
-            shared = self._compact_shared(dataset)
-            if shared is not None:
-                statics = tuple(self._compact_arrays(dataset, i, b)
-                                for i, b in enumerate(buckets))
-            else:
-                statics = tuple(self._static_arrays(dataset, i, b, n)
-                                for i, b in enumerate(buckets))
+            shared, statics = self._sweep_statics(dataset, n)
             warm_ctxs = tuple(self._warm_ctx(dataset, i, b, None, 0)
                               for i, b in enumerate(buckets))
             cidxs = tuple(self._coef_idx(dataset, i, b)
                           for i, b in enumerate(buckets))
             sig = hash((self, n, shared is not None,
-                        tuple((b.x.shape, b.labels.shape, b.n_entities)
+                        tuple((b.tensor_shape, b.n_entities)
                               for b in buckets),
                         self._key_table_len(dataset)))
             if sig not in _PRECOMPILED:
@@ -459,7 +477,7 @@ class RandomEffectSolver:
                 _PRECOMPILED.add(sig)
             object.__setattr__(dataset, "_warm_compiled", (self.mesh,))
             return
-        shapes = sorted({(bucket.x.shape, bucket.labels.shape)
+        shapes = sorted({(bucket.tensor_shape, bucket.tensor_shape[:2])
                          for bucket in dataset.buckets})
         shapes = [s for s in shapes if hash((self, s)) not in _PRECOMPILED]
         if not shapes:
@@ -565,13 +583,7 @@ class RandomEffectSolver:
             # (see _sweep_fused). The per-bucket path below survives for the
             # streaming (upload-and-drop) and projected modes.
             buckets = dataset.buckets
-            shared = self._compact_shared(dataset)
-            if shared is not None:
-                statics = tuple(self._compact_arrays(dataset, i, b)
-                                for i, b in enumerate(buckets))
-            else:
-                statics = tuple(self._static_arrays(dataset, i, b, n)
-                                for i, b in enumerate(buckets))
+            shared, statics = self._sweep_statics(dataset, n)
             warm_ctxs = tuple(
                 self._warm_ctx(dataset, i, b, warm_start, shard_dim)
                 for i, b in enumerate(buckets))
@@ -598,7 +610,7 @@ class RandomEffectSolver:
             scores, batched_dev, coeffs_unsorted = self._sweep_fused(
                 offsets_dev, lam_dev, statics, warm_ctxs, coeffs_warm,
                 cidxs, e_reals, out_sharding=out_sharding, shared=shared)
-            d_of = [int(b.x.shape[2]) for b in buckets]
+            d_of = [b.tensor_shape[2] for b in buckets]
             w_sizes = [b.n_entities * d for b, d in zip(buckets, d_of)]
             v_sizes = [b.n_entities * (d if want_var else 0)
                        for b, d in zip(buckets, d_of)]
