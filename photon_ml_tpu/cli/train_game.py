@@ -184,19 +184,10 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
     # global id agreement, dp fixed effect on the global mesh,
     # process-local random-effect solves, allgathered model
     multiproc = args.multihost and jax.process_count() > 1
-    if multiproc:
-        unsupported = [
-            (args.mesh, "--mesh (the multi-process path builds its own "
-                        "global data mesh)"),
-            (args.tuning != "NONE", "--tuning"),
-            (args.locked_coordinates, "--locked-coordinates"),
-            (args.model_input_dir, "--model-input-dir"),
-        ]
-        bad = [msg for flag, msg in unsupported if flag]
-        if bad:
-            raise SystemExit(
-                "multi-process --multihost training does not support: "
-                + ", ".join(bad))
+    if multiproc and args.mesh:
+        raise SystemExit(
+            "multi-process --multihost training does not support --mesh "
+            "(the multi-process path builds its own global data mesh)")
     # fail fast on a bad mesh spec / device-count mismatch, BEFORE the
     # (potentially long) Avro reads
     mesh = parse_mesh(args.mesh)
@@ -310,6 +301,35 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                             update_sequence=update_sequence,
                             n_cd_iterations=args.cd_iterations, mesh=mesh)
 
+        def _mp_fit(config, mp_ckpt=None):
+            """One collective-symmetric multi-process fit, evaluated and
+            wrapped as a GameResult — shared by the grid and tuning paths
+            so their result assembly can never drift apart."""
+            from photon_ml_tpu.evaluation import evaluate_all
+            from photon_ml_tpu.game.estimator import GameResult
+            from photon_ml_tpu.game.multiprocess import (
+                train_game_multiprocess,
+            )
+
+            mp = train_game_multiprocess(
+                data, task, coordinate_configs, update_sequence,
+                config.regularization_weights,
+                n_cd_iterations=args.cd_iterations,
+                checkpoint_dir=mp_ckpt, resume=args.resume,
+                initial_models=initial_models, locked=locked,
+                validation=validation)
+            evaluation = None
+            if validation is not None:
+                vdata, evs = validation
+                # per-sweep history is tracked inside the run; the final
+                # EvaluationResults object is re-derived for model selection
+                evaluation = evaluate_all(
+                    evs, mp.model.score(vdata), vdata.labels,
+                    weights=vdata.weights, id_tags=vdata.id_columns)
+            return GameResult(
+                model=mp.model, configuration=config, evaluation=evaluation,
+                validation_history=list(mp.validation_history))
+
         checkpoint = None
         if (args.checkpoint or args.resume) and not multiproc:
             # multiproc uses its own per-process sweep-boundary state files
@@ -352,12 +372,6 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
             from photon_ml_tpu.logging_util import profiled
 
             if multiproc:
-                from photon_ml_tpu.evaluation import evaluate_all
-                from photon_ml_tpu.game.estimator import GameResult
-                from photon_ml_tpu.game.multiprocess import (
-                    train_game_multiprocess,
-                )
-
                 # multi-process checkpoints are per-process sweep-boundary
                 # state files (game/multiprocess.py), not the single-process
                 # CheckpointManager format
@@ -371,23 +385,7 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                     # grid points run sequentially — each is one
                     # collective-symmetric training all processes join
                     for config in configurations:
-                        mp = train_game_multiprocess(
-                            data, task, coordinate_configs, update_sequence,
-                            config.regularization_weights,
-                            n_cd_iterations=args.cd_iterations,
-                            checkpoint_dir=mp_ckpt, resume=args.resume)
-                        evaluation, history = None, []
-                        if validation is not None:
-                            vdata, evs = validation
-                            evaluation = evaluate_all(
-                                evs, mp.model.score(vdata), vdata.labels,
-                                weights=vdata.weights,
-                                id_tags=vdata.id_columns)
-                            history = [evaluation.as_dict()]
-                        results.append(GameResult(
-                            model=mp.model, configuration=config,
-                            evaluation=evaluation,
-                            validation_history=history))
+                        results.append(_mp_fit(config, mp_ckpt))
             else:
                 with timed("Train (grid)", run_logger), profiled(profile_dir):
                     results = est.fit(
@@ -397,7 +395,8 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
         else:
             if validation is None:
                 raise SystemExit("--tuning needs --validation-data")
-            if checkpoint is not None:
+            if (checkpoint is not None
+                    or (multiproc and (args.checkpoint or args.resume))):
                 raise SystemExit("--checkpoint/--resume don't combine with "
                                  "--tuning")
             from photon_ml_tpu.hyperparameter.search import (
@@ -412,21 +411,35 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
             space = {cid: ParamRange(low, high) for cid in update_sequence
                      if cid not in locked}
             results = []
-            datasets = est.prepare(data, locked=locked)  # build once
+            if multiproc:
+                # every process runs the IDENTICAL search loop: the search
+                # is deterministic (seeded) and each observation — the
+                # validation metric of a collective-symmetric training —
+                # is computed identically on every process, so the
+                # candidate sequence never diverges
+                def evaluate(config: dict) -> float:
+                    r = _mp_fit(GameOptimizationConfiguration(config))
+                    results.append(r)
+                    return r.evaluation.primary[1]
 
-            def evaluate(config: dict) -> float:
-                r = est.fit(data, [GameOptimizationConfiguration(config)],
-                            validation=validation, datasets=datasets,
-                            initial_models=initial_models, locked=locked)[0]
-                results.append(r)
-                return r.evaluation.primary[1]
+                def release_datasets():
+                    pass  # per-fit datasets are process-local temporaries
+            else:
+                datasets = est.prepare(data, locked=locked)  # build once
 
-            def release_datasets():
-                # tuning holds the datasets across fits; drop the cached
-                # device placements (HBM) once the search is done
-                for ds in datasets.values():
-                    if hasattr(ds, "clear_device_cache"):
-                        ds.clear_device_cache()
+                def evaluate(config: dict) -> float:
+                    r = est.fit(data, [GameOptimizationConfiguration(config)],
+                                validation=validation, datasets=datasets,
+                                initial_models=initial_models, locked=locked)[0]
+                    results.append(r)
+                    return r.evaluation.primary[1]
+
+                def release_datasets():
+                    # tuning holds the datasets across fits; drop the cached
+                    # device placements (HBM) once the search is done
+                    for ds in datasets.values():
+                        if hasattr(ds, "clear_device_cache"):
+                            ds.clear_device_cache()
 
             maximize = evaluators[0].maximize
             search_cls = (GaussianProcessSearch if args.tuning == "BAYESIAN"

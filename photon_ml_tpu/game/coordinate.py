@@ -118,8 +118,16 @@ class FixedEffectCoordinate:
         ``scores`` is a device vector."""
         data = self.dataset.glm_data(offsets)
         if self.downsampler is not None:
+            # uids = global row ids in the data's layout (the stacked dp
+            # layout is contiguous row blocks, so a plain arange reshape is
+            # the id map; padded tail rows draw too but carry weight 0).
+            # Keyed draws make the sample identical across 1-chip, dp, and
+            # multi-process runs of the same data.
+            labels_np = np.asarray(data.labels)
+            uids = np.arange(labels_np.size, dtype=np.int64).reshape(
+                labels_np.shape)
             weights = self.downsampler.downsample(
-                np.asarray(data.labels), np.asarray(data.weights), sweep=sweep)
+                labels_np, np.asarray(data.weights), sweep=sweep, uids=uids)
             data = dataclasses.replace(data, weights=jnp.asarray(weights))
         w0 = (jnp.zeros((self.dataset.dim,), jnp.float32)
               if warm_start is None

@@ -39,6 +39,7 @@ from photon_ml_tpu.game.projector import ProjectorType, RandomProjector
 from photon_ml_tpu.ops.design import CsrDesign, DenseDesign
 from photon_ml_tpu.ops.objective import GLMData
 from photon_ml_tpu.util import group_starts as _group_starts
+from photon_ml_tpu.util import hash_uniform as _hash_uniform
 
 #: Fixed-effect designs at or below this width always densify (MXU path)
 #: when they fit the byte cap; above it the measured crossover rule decides.
@@ -507,18 +508,6 @@ class RandomEffectDatasetConfig:
                 f"(got {self.max_sample_buckets}/{self.max_feature_buckets})")
 
 
-def _hash_uniform(ids: np.ndarray, seed: int) -> np.ndarray:
-    """Uniform [0,1) key per id via a splitmix64 finalizer — a stateless,
-    partition-invariant substitute for a sequential rng stream: the key of a
-    row depends only on (seed, its global id), never on which other rows
-    share the batch."""
-    z = (np.asarray(ids, np.uint64)
-         + np.uint64((seed * 0x9E3779B97F4A7C15) & 0xFFFFFFFFFFFFFFFF))
-    z = (z + np.uint64(0x9E3779B97F4A7C15))
-    z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
-    z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
-    z = z ^ (z >> np.uint64(31))
-    return z.astype(np.float64) / float(2**64)
 
 
 def _geom_at_least(x: np.ndarray, growth: float, floor: int = 1) -> np.ndarray:
@@ -863,6 +852,12 @@ def _index_map_buckets_native(data, shard, all_active, ent_of_active,
     indices_only = (config.cache_device_buckets
                     and shard.n_samples * shard.dim * 4
                     <= DENSE_DESIGN_MAX_BYTES)
+    # one scratch shared by every deferred fill of this build (created on
+    # first use): the stamp contract holds — each bucket fills at most once
+    # (REBucket caches the materialization) and buckets hold disjoint
+    # entities — and per-fill fresh scratch would memset dim-sized arrays
+    # per bucket when a fat-path consumer materializes them all
+    lazy_scratch: list = []
     buckets: list[REBucket] = []
     for key in np.unique(bucket_key):
         sel = np.flatnonzero(bucket_key == key)
@@ -876,10 +871,12 @@ def _index_map_buckets_native(data, shard, all_active, ent_of_active,
             sample_idx, feature_index = packed
 
             def fill(sel=sel, S=S, D=D):
-                fresh = native.BucketPackScratch(shard.dim)
+                if not lazy_scratch:
+                    lazy_scratch.append(native.BucketPackScratch(shard.dim))
                 out = native.re_bucket_fill(
                     indptr, cols, vals, aa, ent_starts, labels32, weights32,
-                    sel, S, D, shard.dim, config.max_active_features, fresh)
+                    sel, S, D, shard.dim, config.max_active_features,
+                    lazy_scratch[0])
                 if out is None:
                     raise RuntimeError(
                         "native library became unavailable for the deferred "

@@ -183,19 +183,44 @@ def owner_of_rows(entities: np.ndarray, owner_of_entity: np.ndarray,
 
 def process_file_share(reader, input_path) -> list[str]:
     """This process's share of the input file list — the multi-process
-    drivers' read assignment (each process reads ``files[pid::n]``, the
-    executor-local reads of the reference). Raises when there are fewer
-    files than processes (an empty-handed process would feed zero rows and
-    desync shard budgets)."""
+    drivers' read assignment (the executor-local reads of the reference).
+
+    Shares are CONTIGUOUS runs of the sorted file list (size-balanced by
+    cumulative file bytes), not strided: the global row ids every process
+    derives from the process-concat order then coincide with the
+    single-process sequential read order, which is what keeps every
+    per-global-row-id keyed draw (down-sampling, active-bound subsampling)
+    bit-identical to the single-process run. A strided share would permute
+    the id ↔ record mapping and silently change the sampled sets.
+
+    Raises when there are fewer files than processes (an empty-handed
+    process would feed zero rows and desync shard budgets)."""
     import jax
 
     all_files = reader.paths(input_path)
-    if len(all_files) < jax.process_count():
+    n_proc = jax.process_count()
+    if len(all_files) < n_proc:
         raise SystemExit(
-            f"--multihost with {jax.process_count()} processes needs at "
+            f"--multihost with {n_proc} processes needs at "
             f"least that many input files (got {len(all_files)}; split "
             f"the data)")
-    return all_files[jax.process_index()::jax.process_count()]
+    sizes = np.array([max(os.path.getsize(f), 1) for f in all_files],
+                     np.float64)
+    # cut the cumulative-size curve into n_proc near-equal spans, keeping
+    # every span non-empty (each process must read at least one file)
+    cum = np.cumsum(sizes)
+    targets = cum[-1] * (np.arange(1, n_proc) / n_proc)
+    cuts = np.searchsorted(cum, targets, side="left") + 1
+    # enforce strictly increasing interior cuts within [1, len-...] so no
+    # share is empty even with one huge file
+    bounds = [0]
+    for i, c in enumerate(cuts):
+        lo = bounds[-1] + 1
+        hi = len(all_files) - (n_proc - 1 - i)
+        bounds.append(int(min(max(c, lo), hi)))
+    bounds.append(len(all_files))
+    pid = jax.process_index()
+    return all_files[bounds[pid]:bounds[pid + 1]]
 
 
 # ---------------------------------------------------------------------------
@@ -339,22 +364,31 @@ class MultiProcessFixedEffectDataset:
             rows_per_shard=int(fed.labels.shape[1]), mesh=mesh,
             n_shards=int(mesh.shape[DATA_AXIS]))
 
-    def glm_data(self, local_offsets) -> GLMData:
-        """Bind this process's residual offsets into the global layout."""
+    def _feed_rowvec(self, local_values) -> object:
+        """Place one per-local-row float32 vector into the global
+        ``(n_shards, rows_per_shard)`` data-axis layout (tail zero-padded)."""
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from photon_ml_tpu.parallel.mesh import DATA_AXIS
 
         per = self.rows_per_shard
-        off = np.zeros(self.n_local_blocks * per, np.float32)
-        off[:self.n_local_rows] = np.asarray(local_offsets, np.float32)
-        global_shape = (self.n_shards, per)
-        fed = jax.make_array_from_process_local_data(
+        buf = np.zeros(self.n_local_blocks * per, np.float32)
+        buf[:self.n_local_rows] = np.asarray(local_values, np.float32)
+        return jax.make_array_from_process_local_data(
             NamedSharding(self.mesh, P(DATA_AXIS)),
-            off.reshape(self.n_local_blocks, per), global_shape)
-        return GLMData(design=self.design, labels=self.labels,
-                       offsets=fed, weights=self.weights)
+            buf.reshape(self.n_local_blocks, per),
+            (self.n_shards, per))
+
+    def glm_data(self, local_offsets, local_weights=None) -> GLMData:
+        """Bind this process's residual offsets into the global layout.
+        ``local_weights`` (per-sweep down-sampled weights) replaces the
+        static weight vector for this solve only."""
+        return GLMData(
+            design=self.design, labels=self.labels,
+            offsets=self._feed_rowvec(local_offsets),
+            weights=(self.weights if local_weights is None
+                     else self._feed_rowvec(local_weights)))
 
     def local_scores(self, scores) -> np.ndarray:
         """Pull this process's rows out of a globally-sharded ``(n_shards,
@@ -381,6 +415,9 @@ class MultiProcessGameResult:
     #: this process's rows: global ids and per-coordinate scores
     global_rows: np.ndarray
     scores: dict[str, np.ndarray]
+    #: per-sweep validation metric dicts (empty without a validation set) —
+    #: identical on every process
+    validation_history: list = dataclasses.field(default_factory=list)
 
 
 # ---------------------------------------------------------------------------
@@ -407,10 +444,18 @@ def _mp_ckpt_dir(root: str) -> str:
 def _mp_ckpt_save(root: str, sweep: int, fingerprint: str,
                   scores: Mapping[str, np.ndarray],
                   re_local_models: Mapping[str, RandomEffectModel],
-                  fe_models: Mapping[str, FixedEffectModel]) -> None:
+                  fe_models: Mapping[str, FixedEffectModel],
+                  validation_history: Sequence[Mapping] = ()) -> None:
+    import json as _json
+
     d = _mp_ckpt_dir(root)
     os.makedirs(d, exist_ok=True)
     payload: dict[str, np.ndarray] = {}
+    if validation_history:
+        # per-sweep metric dicts ride along so a resumed run returns the
+        # FULL history, not just the sweeps after the resume point
+        payload["history"] = np.frombuffer(
+            _json.dumps(list(validation_history)).encode("utf-8"), np.uint8)
     for cid, s in scores.items():
         payload[f"score::{cid}"] = np.asarray(s, np.float32)
     for cid, m in re_local_models.items():
@@ -509,7 +554,12 @@ def _mp_ckpt_load(root: str, sweep: int, fingerprint: str, task,
                                    if f"fev::{cid}" in z.files else None)),
                     task=task),
                 feature_shard_id=fe_templates[cid].feature_shard_id)
-    return scores, re_models, fe_models
+        history = []
+        if "history" in z.files:
+            import json as _json
+
+            history = _json.loads(bytes(z["history"]).decode("utf-8"))
+    return scores, re_models, fe_models, history
 
 
 @dataclasses.dataclass(frozen=True)
@@ -547,6 +597,9 @@ def train_game_multiprocess(
     re_mesh=None,
     checkpoint_dir: Optional[str] = None,
     resume: bool = False,
+    initial_models: Optional[Mapping[str, object]] = None,
+    locked: Sequence[str] = (),
+    validation: Optional[tuple] = None,
 ) -> MultiProcessGameResult:
     """Run GAME coordinate descent across all processes.
 
@@ -562,6 +615,15 @@ def train_game_multiprocess(
     :func:`~photon_ml_tpu.parallel.multihost.make_multihost_mesh`);
     ``re_mesh`` an optional LOCAL mesh with an ``entity`` axis for the
     per-process bucket solves.
+
+    ``initial_models``/``locked`` are the reference's partial-retrain path,
+    with single-process semantics: every process holds the (identical,
+    loaded-from-disk) initial models, scores are seeded row-locally, locked
+    coordinates keep their model and are never retrained. ``validation``
+    (``(GameData, evaluators)``; the validation data must be read in full
+    on EVERY process) enables per-sweep validation tracking: the global
+    model is assembled at each sweep boundary and evaluated — identical on
+    every process since model and data are. History is in the result.
     """
     import jax
     import jax.numpy as jnp
@@ -582,8 +644,20 @@ def train_game_multiprocess(
     )
 
     n_proc = jax.process_count()
+    locked = set(locked)
+    initial_models = dict(initial_models or {})
+    for cid in locked:
+        if cid not in initial_models:
+            raise KeyError(f"locked coordinate {cid!r} needs an initial model")
+    missing_seq = locked - set(update_sequence)
+    if missing_seq:
+        # single-process semantics (GameEstimator._check_sequence): a locked
+        # coordinate outside the sequence would silently drop from the model
+        raise ValueError(
+            f"locked coordinates {sorted(missing_seq)} must appear in the "
+            f"update sequence")
     for cid in update_sequence:
-        if cid not in coordinate_configs:
+        if cid not in coordinate_configs and cid not in locked:
             raise KeyError(f"update sequence names unknown coordinate {cid!r}")
 
     n_local = game_local.n_samples
@@ -594,10 +668,13 @@ def train_game_multiprocess(
     local_global_rows = base + np.arange(n_local, dtype=np.int64)
 
     # --- entity partitions: one owner map per RE entity type --------------
+    # locked coordinates never train, so they need no dataset build, no
+    # entity partition, and no say in the primary row partition
     re_types = [coordinate_configs[cid].dataset.random_effect_type
                 for cid in update_sequence
-                if isinstance(coordinate_configs[cid],
-                              RandomEffectCoordinateConfig)]
+                if cid not in locked
+                and isinstance(coordinate_configs[cid],
+                               RandomEffectCoordinateConfig)]
     owner_by_type: dict[str, np.ndarray] = {}
     for t in dict.fromkeys(re_types):  # ordered unique
         ents = game_local.id_columns[t]
@@ -618,6 +695,8 @@ def train_game_multiprocess(
         # (non-primary coordinates run their own slim exchange below)
         need_shards = set()
         for cid in update_sequence:
+            if cid in locked:
+                continue
             cfg = coordinate_configs[cid]
             if isinstance(cfg, FixedEffectCoordinateConfig):
                 need_shards.add(cfg.feature_shard_id)
@@ -641,16 +720,12 @@ def train_game_multiprocess(
     fe_datasets: dict[str, MultiProcessFixedEffectDataset] = {}
     re_plans: dict[str, _REPlan] = {}
     for cid in update_sequence:
+        if cid in locked:
+            continue  # frozen: no dataset, scores seeded from the model
         cfg = coordinate_configs[cid]
         if isinstance(cfg, FixedEffectCoordinateConfig):
-            if cfg.downsampler is not None:
-                # per-sweep downsampling draws per-row randomness; the
-                # per-process draws would silently diverge from the
-                # single-process run this module promises equality with
-                raise NotImplementedError(
-                    f"coordinate {cid!r}: downsamplers are not supported in "
-                    "multi-process training yet (per-process sampling would "
-                    "diverge from the single-process result)")
+            # (downsamplers are supported: the per-sweep draw is the keyed
+            # per-global-row-id hash, identical under any row partition)
             fe_datasets[cid] = MultiProcessFixedEffectDataset.build(
                 cid, game_primary, cfg.feature_shard_id, fe_mesh)
         elif isinstance(cfg, RandomEffectCoordinateConfig):
@@ -694,8 +769,26 @@ def train_game_multiprocess(
     models: dict[str, object] = {}
     re_local_models: dict[str, RandomEffectModel] = {}
 
+    # seed from initial models (partial-retrain warm start; single-process
+    # CD semantics): scores computed ROW-LOCALLY on the original read
+    # partition — game_local holds every shard/id column, where the slim
+    # primary exchange ships only what training reads — then mapped onto
+    # the primary partition through the replicated global vector
+    for cid, m0 in initial_models.items():
+        if cid not in update_sequence:
+            continue
+        models[cid] = m0
+        if isinstance(m0, RandomEffectModel) and cid not in locked:
+            # the GLOBAL table warm-starts the local solves (the bucket →
+            # key-table join handles the superset transparently)
+            re_local_models[cid] = m0
+        sc_local = np.asarray(m0.score(game_local), np.float32)
+        g = _allgather_rowvec(local_global_rows, sc_local, n_global)
+        scores[cid] = g[primary_rows].astype(np.float32)
+
     start_sweep = 0
     fingerprint = None
+    resumed_history: list = []
     if checkpoint_dir is not None:
         import hashlib
         import json
@@ -709,8 +802,15 @@ def train_game_multiprocess(
             # every coordinate's full configuration (optimizer, bounds,
             # regularization, shard ids) — resuming under a changed config
             # must fail loudly, not blend incompatible state
-            "configs": {c: repr(coordinate_configs[c])
+            "configs": {c: repr(coordinate_configs.get(c))
                         for c in update_sequence},
+            "locked": sorted(locked),
+            # resuming under different seed models must fail loudly too
+            "initial": {c: hashlib.sha1(np.asarray(
+                m.coeffs if isinstance(m, RandomEffectModel)
+                else m.model.coefficients.means,
+                np.float32).tobytes()).hexdigest()
+                for c, m in sorted(initial_models.items())},
             "n_global": n_global,
             "rows": hashlib.sha1(
                 np.ascontiguousarray(primary_rows).tobytes()).hexdigest(),
@@ -731,9 +831,11 @@ def train_game_multiprocess(
                         coeffs=np.zeros(0, np.float32),
                         projector=p.dataset.projector)
                     for cid, p in re_plans.items()}
-                saved_scores, re_local_models, fe_models = _mp_ckpt_load(
+                (saved_scores, saved_re, fe_models,
+                 resumed_history) = _mp_ckpt_load(
                     checkpoint_dir, agreed, fingerprint, task,
                     re_templates, fe_datasets)
+                re_local_models.update(saved_re)
                 scores.update(saved_scores)
                 models.update(fe_models)
                 # the RE coordinates' contribution to the GLOBAL model also
@@ -744,13 +846,52 @@ def train_game_multiprocess(
     total = game_primary.offsets.astype(np.float32) + sum(
         scores[cid] for cid in update_sequence)
 
+    def _assemble_global_model() -> GameModel:
+        """Allgather the per-process RE tables into the (identical on every
+        process) global model — at sweep boundaries when validation tracks
+        per-sweep metrics, and once at the end."""
+        out = dict(models)
+        for cid, local_model in re_local_models.items():
+            if local_model is initial_models.get(cid):
+                continue  # still the seeded global table — nothing local
+            keys = allgather_concat(local_model.keys)
+            coeffs = allgather_concat(local_model.coeffs)
+            has_var = local_model.variances is not None
+            variances = (allgather_concat(local_model.variances)
+                         if has_var else None)
+            order = np.argsort(keys, kind="stable")
+            out[cid] = RandomEffectModel(
+                random_effect_type=local_model.random_effect_type,
+                feature_shard_id=local_model.feature_shard_id,
+                task=task, dim=local_model.dim,
+                keys=keys[order], coeffs=coeffs[order],
+                variances=None if variances is None else variances[order],
+                # RANDOM-projected models keep their (shared, seed-derived —
+                # identical on every process) projector so scoring still
+                # maps shard features into the projected key space
+                projector=local_model.projector)
+        return GameModel(
+            coordinates={cid: out[cid] for cid in update_sequence},
+            task=task)
+
+    validation_history: list[dict] = list(resumed_history)
     for sweep in range(start_sweep, n_cd_iterations):
         for cid in update_sequence:
+            if cid in locked:
+                continue  # frozen: scores stay as seeded
             cfg = coordinate_configs[cid]
             residual = total - scores[cid]
             if cid in fe_datasets:
                 ds = fe_datasets[cid]
-                data = ds.glm_data(residual)
+                w_sweep = None
+                if cfg.downsampler is not None:
+                    # keyed per-global-row-id draw: the kept set is a pure
+                    # per-row function, so every partition of the rows —
+                    # including the single-process run — samples identically
+                    w_sweep = cfg.downsampler.downsample(
+                        game_primary.labels, game_primary.weights,
+                        sweep=sweep, uids=primary_rows)
+                data = ds.glm_data(residual, local_weights=w_sweep)
                 w0 = (jnp.zeros((ds.dim,), jnp.float32)
                       if cid not in models else
                       jnp.asarray(models[cid].model.coefficients.means))
@@ -794,32 +935,32 @@ def train_game_multiprocess(
             total = residual + new_scores
             scores[cid] = new_scores
             logger.info("mp sweep %d coordinate %s done", sweep, cid)
+        if validation is not None:
+            # per-sweep validation tracking (single-process CD semantics:
+            # CoordinateDescent evaluates every sweep). Model and
+            # validation data are identical on every process, so each
+            # evaluates independently and identically — no collective.
+            from photon_ml_tpu.evaluation import evaluate_all
+
+            vdata, evaluators = validation
+            gm = _assemble_global_model()
+            results = evaluate_all(
+                evaluators, gm.score(vdata), vdata.labels,
+                weights=vdata.weights, id_tags=vdata.id_columns)
+            validation_history.append(results.as_dict())
+            logger.info("mp sweep %d validation: %s", sweep, results)
         if checkpoint_dir is not None:
+            # saved AFTER the sweep's validation entry so a resume returns
+            # the full per-sweep history, not just the post-resume tail
             _mp_ckpt_save(checkpoint_dir, sweep, fingerprint, scores,
-                          re_local_models,
+                          {cid: m for cid, m in re_local_models.items()
+                           if m is not initial_models.get(cid)},
                           {cid: m for cid, m in models.items()
-                           if cid in fe_datasets})
+                           if cid in fe_datasets},
+                          validation_history=validation_history)
 
     # --- model assembly: allgather RE tables ------------------------------
-    for cid, local_model in re_local_models.items():
-        keys = allgather_concat(local_model.keys)
-        coeffs = allgather_concat(local_model.coeffs)
-        has_var = local_model.variances is not None
-        variances = (allgather_concat(local_model.variances)
-                     if has_var else None)
-        order = np.argsort(keys, kind="stable")
-        models[cid] = RandomEffectModel(
-            random_effect_type=local_model.random_effect_type,
-            feature_shard_id=local_model.feature_shard_id,
-            task=task, dim=local_model.dim,
-            keys=keys[order], coeffs=coeffs[order],
-            variances=None if variances is None else variances[order],
-            # RANDOM-projected models keep their (shared, seed-derived —
-            # identical on every process) projector so scoring still maps
-            # shard features into the projected key space
-            projector=local_model.projector)
-
-    model = GameModel(
-        coordinates={cid: models[cid] for cid in update_sequence}, task=task)
+    model = _assemble_global_model()
     return MultiProcessGameResult(
-        model=model, global_rows=primary_rows, scores=scores)
+        model=model, global_rows=primary_rows, scores=scores,
+        validation_history=validation_history)

@@ -11,8 +11,20 @@ re-weighted by ``1/rate`` so the objective stays an unbiased estimate.
 from __future__ import annotations
 
 import dataclasses
+from typing import Optional
 
 import numpy as np
+
+from photon_ml_tpu.util import hash_uniform
+
+
+def _sweep_uniform(uids: np.ndarray, seed: int, sweep: int) -> np.ndarray:
+    """Per-row uniform draw keyed by (seed, sweep, global row id) — a pure
+    per-row function, so the kept set is identical under any row partition
+    (the property multi-process training's sp==mp equality rests on)."""
+    return hash_uniform(
+        np.maximum(np.asarray(uids, np.int64), 0),
+        seed ^ ((sweep + 1) * 0x5851F42D4C957F2D) & 0x7FFFFFFFFFFFFFFF)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -26,16 +38,26 @@ class DownSampler:
         if not 0.0 < self.rate < 1.0:
             raise ValueError(f"down-sampling rate must be in (0, 1): {self.rate}")
 
-    def downsample(self, labels: np.ndarray, weights: np.ndarray,
-                   sweep: int = 0) -> np.ndarray:
+    def _keep(self, labels: np.ndarray, sweep: int,
+              uids: Optional[np.ndarray]) -> np.ndarray:
         """``sweep`` must vary per CD iteration so each sweep draws a fresh
-        sample (the reference creates a new sampled RDD per iteration)."""
+        sample (the reference creates a new sampled RDD per iteration).
+        With ``uids`` (global row ids, same shape as ``labels``; negatives
+        = padding) the draw is the counter-based per-row hash — identical
+        under any row partition; without, a sequential rng stream over the
+        batch shape (direct API use)."""
+        if uids is not None:
+            return _sweep_uniform(uids, self.seed, sweep) < self.rate
         rng = np.random.default_rng((self.seed, sweep))
         # size=shape (not shape[0]): the sharded fixed-effect path hands in
         # the stacked (n_shards, per) layout
-        keep = rng.uniform(size=labels.shape) < self.rate
-        out = np.where(keep, weights / self.rate, 0.0).astype(np.float32)
-        return out
+        return rng.uniform(size=labels.shape) < self.rate
+
+    def downsample(self, labels: np.ndarray, weights: np.ndarray,
+                   sweep: int = 0,
+                   uids: Optional[np.ndarray] = None) -> np.ndarray:
+        keep = self._keep(labels, sweep, uids)
+        return np.where(keep, weights / self.rate, 0.0).astype(np.float32)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -45,10 +67,10 @@ class BinaryClassificationDownSampler(DownSampler):
     negatives kept with probability ``rate`` and re-weighted ``1/rate``."""
 
     def downsample(self, labels: np.ndarray, weights: np.ndarray,
-                   sweep: int = 0) -> np.ndarray:
-        rng = np.random.default_rng((self.seed, sweep))
+                   sweep: int = 0,
+                   uids: Optional[np.ndarray] = None) -> np.ndarray:
         pos = labels > 0.5
-        keep_neg = rng.uniform(size=labels.shape) < self.rate
+        keep_neg = self._keep(labels, sweep, uids)
         out = np.where(pos, weights,
                        np.where(keep_neg, weights / self.rate, 0.0))
         return out.astype(np.float32)

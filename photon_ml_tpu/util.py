@@ -15,3 +15,19 @@ def group_starts(sorted_ids: np.ndarray) -> np.ndarray:
     new[0] = True
     np.not_equal(sorted_ids[1:], sorted_ids[:-1], out=new[1:])
     return np.flatnonzero(new)
+
+
+def hash_uniform(ids: np.ndarray, seed: int) -> np.ndarray:
+    """Uniform [0,1) key per id via a splitmix64 finalizer — a stateless,
+    partition-invariant substitute for a sequential rng stream: the key of
+    a row depends only on (seed, its global id), never on which other rows
+    share the batch. This is what makes subsampling and down-sampling draws
+    identical under ANY row partition (multi-process training equals the
+    single-process run by construction)."""
+    z = (np.asarray(ids, np.uint64)
+         + np.uint64((seed * 0x9E3779B97F4A7C15) & 0xFFFFFFFFFFFFFFFF))
+    z = (z + np.uint64(0x9E3779B97F4A7C15))
+    z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    z = z ^ (z >> np.uint64(31))
+    return z.astype(np.float64) / float(2**64)

@@ -334,6 +334,61 @@ if pid == 0:
     s_mp = mp.model.score(game)
     s_ref = ref.model.score(game)
     np.testing.assert_allclose(s_mp, s_ref, atol=5e-3)
+
+# --- capability 2: per-sweep validation + downsampled fixed effect --------
+import dataclasses as _dc
+from photon_ml_tpu.evaluation import parse_evaluator
+from photon_ml_tpu.sampling import BinaryClassificationDownSampler
+
+sampled = dict(configs)
+sampled["global"] = _dc.replace(
+    configs["global"],
+    downsampler=BinaryClassificationDownSampler(rate=0.7, seed=11))
+evaluators = [parse_evaluator("AUC")]
+mp2 = train_game_multiprocess(
+    local, TaskType.LOGISTIC_REGRESSION, sampled, seq, lam,
+    n_cd_iterations=2, validation=(game, evaluators))
+assert len(mp2.validation_history) == 2, mp2.validation_history
+if pid == 0:
+    est2 = GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION, coordinate_configs=sampled,
+        update_sequence=seq, n_cd_iterations=2)
+    ref2 = est2.fit(game, [GameOptimizationConfiguration(lam)],
+                    validation=(game, evaluators))[0]
+    # keyed downsampling: the kept set is partition-invariant, so the
+    # 2-process model equals the single-process one
+    np.testing.assert_allclose(
+        np.asarray(mp2.model.coordinates["global"].model.coefficients.means),
+        np.asarray(ref2.model.coordinates["global"].model.coefficients.means),
+        atol=2e-3, rtol=2e-2)
+    # per-sweep validation tracking equals single-process CD semantics
+    assert len(ref2.validation_history) == 2
+    for h_mp, h_ref in zip(mp2.validation_history, ref2.validation_history):
+        for k in h_ref:
+            assert abs(h_mp[k] - h_ref[k]) < 1e-3, (k, h_mp, h_ref)
+
+# --- capability 3: warm start + locked coordinate -------------------------
+init = dict(mp.model.coordinates)
+mp3 = train_game_multiprocess(
+    local, TaskType.LOGISTIC_REGRESSION, configs, seq, lam,
+    n_cd_iterations=1, initial_models=init, locked=["global"])
+w_locked = np.asarray(
+    mp3.model.coordinates["global"].model.coefficients.means)
+assert np.array_equal(
+    w_locked, np.asarray(init["global"].model.coefficients.means)), (
+    "locked coordinate was retrained")
+both_w3 = allgather_concat(np.asarray(
+    mp3.model.coordinates["perEntity"].coeffs)).reshape(2, -1)
+assert np.array_equal(both_w3[0], both_w3[1]), "warm-start model differs"
+if pid == 0:
+    ref3 = est.fit(game, [GameOptimizationConfiguration(lam)],
+                   initial_models=init, locked=["global"])[0]
+    k3 = mp3.model.coordinates["perEntity"].keys
+    order3 = np.argsort(k3, kind="stable")
+    np.testing.assert_allclose(
+        np.asarray(mp3.model.coordinates["perEntity"].coeffs)[order3],
+        np.asarray(ref3.model.coordinates["perEntity"].coeffs),
+        atol=2e-3, rtol=2e-2)
 print(f"MULTIPROC_GAME_OK {pid}", flush=True)
 """
 
@@ -412,6 +467,52 @@ def test_two_process_train_game_driver(tmp_path):
         os.path.join(tmp_path, "out-mp", "best", "model-metadata.json"))
     assert os.path.exists(
         os.path.join(tmp_path, "out-mp", "workers", "proc-1"))
+
+
+@pytest.mark.slow
+def test_two_process_train_game_driver_tuning(tmp_path):
+    """--tuning at 2 processes (round-3 verdict: the cluster regime must
+    support the tuning loop): every process runs the identical seeded
+    search over collective-symmetric fits, so the chosen best — and its
+    validation metric — must match the single-process driver run."""
+    import json
+
+    from photon_ml_tpu.cli import train_game as train_game_cli
+
+    train_dir = tmp_path / "train"
+    train_dir.mkdir()
+    for i in range(4):
+        _write_game_avro(train_dir / f"part-{i}.avro", n=120, seed=i)
+    val = _write_game_avro(tmp_path / "val.avro", n=240, seed=9)
+
+    argv_common = [
+        "--training-data", str(train_dir),
+        "--validation-data", val,
+        "--feature-shards", "global=fixed|intercept,user=user|noIntercept",
+        "--coordinates", "global=fixed,shard=global,reg=L2",
+        "perUser=random,entity=userId,shard=user,reg=L2",
+        "--update-sequence", "global,perUser",
+        "--evaluators", "AUC",
+        "--tuning", "RANDOM", "--tuning-iterations", "2",
+        "--tuning-range", "0.01:10",
+    ]
+    base = train_game_cli.run(
+        argv_common + ["--output-dir", str(tmp_path / "out-sp")])
+    base_auc = base["best_evaluation"]["AUC"]
+
+    script = (_DRIVER_WORKER
+              .replace("@ARGS@", json.dumps(argv_common))
+              .replace("@OUT@", str(tmp_path / "out-mp")))
+    outs = _run_two_workers(tmp_path, script, "MULTIPROC_DRIVER_OK",
+                            timeout=420)
+    mp_eval = None
+    for line in outs[0].splitlines():
+        if line.startswith("DRIVER_RESULT "):
+            mp_eval = json.loads(line.split(" ", 1)[1])
+    assert mp_eval is not None, outs[0]
+    assert abs(mp_eval["AUC"] - base_auc) < 5e-3, (mp_eval, base_auc)
+    assert os.path.exists(
+        os.path.join(tmp_path, "out-mp", "best", "model-metadata.json"))
 
 
 _GLM_WORKER = r"""

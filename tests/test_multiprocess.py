@@ -156,17 +156,108 @@ class TestTrainMultiprocessSingleProcess:
                 game, TaskType.LOGISTIC_REGRESSION, configs,
                 ["global", "nope"], lam)
 
-    def test_downsampler_rejected(self, problem):
+    def test_downsampler_matches_estimator(self, problem):
+        """Multi-process downsampling uses the keyed per-global-row-id
+        draw, so the kept set — and therefore the solve — is identical to
+        the single-process run (the divergence that used to force a
+        NotImplementedError)."""
         import dataclasses
 
         game, configs, lam = problem
+        from photon_ml_tpu.sampling import BinaryClassificationDownSampler
+
+        ds = BinaryClassificationDownSampler(rate=0.6, seed=11)
+        sampled = dict(configs)
+        sampled["global"] = dataclasses.replace(
+            configs["global"], downsampler=ds)
+        seq = ["global", "perEntity"]
+        mp = train_game_multiprocess(
+            game, TaskType.LOGISTIC_REGRESSION, sampled, seq, lam,
+            n_cd_iterations=2)
+        est = GameEstimator(
+            task=TaskType.LOGISTIC_REGRESSION, coordinate_configs=sampled,
+            update_sequence=seq, n_cd_iterations=2)
+        ref = est.fit(game, [GameOptimizationConfiguration(lam)])[0]
+        np.testing.assert_allclose(
+            np.asarray(mp.model.coordinates["global"]
+                       .model.coefficients.means),
+            np.asarray(ref.model.coordinates["global"]
+                       .model.coefficients.means),
+            atol=1e-4, rtol=1e-4)
+
+    def test_keyed_downsample_partition_invariant(self):
+        """The kept set of a row depends only on its global id."""
         from photon_ml_tpu.sampling import DownSampler
 
-        bad = {"global": dataclasses.replace(
-            configs["global"], downsampler=DownSampler(rate=0.5))}
-        with pytest.raises(NotImplementedError, match="downsampler"):
-            train_game_multiprocess(
-                game, TaskType.LOGISTIC_REGRESSION, bad, ["global"], lam)
+        ds = DownSampler(rate=0.5, seed=3)
+        labels = np.zeros(100, np.float32)
+        weights = np.ones(100, np.float32)
+        uids = np.arange(100, dtype=np.int64)
+        full = ds.downsample(labels, weights, sweep=1, uids=uids)
+        # any shuffled partition of the same ids draws identically per row
+        perm = np.random.default_rng(0).permutation(100)
+        part = ds.downsample(labels[perm], weights[perm], sweep=1,
+                             uids=uids[perm])
+        np.testing.assert_array_equal(full[perm], part)
+        # and a fresh sweep draws a different sample
+        assert not np.array_equal(
+            full, ds.downsample(labels, weights, sweep=2, uids=uids))
+
+    def test_warm_start_and_locked_match_estimator(self, problem):
+        """--model-input-dir semantics: warm starts seed every coordinate;
+        locked coordinates keep their model and are never retrained —
+        identical to the single-process CD."""
+        game, configs, lam = problem
+        seq = ["global", "perEntity"]
+        # first: a plain run to produce the initial model
+        base = train_game_multiprocess(
+            game, TaskType.LOGISTIC_REGRESSION, configs, seq, lam,
+            n_cd_iterations=1)
+        init = dict(base.model.coordinates)
+
+        # locked fixed effect + retrained random effect
+        mp = train_game_multiprocess(
+            game, TaskType.LOGISTIC_REGRESSION, configs, seq, lam,
+            n_cd_iterations=1, initial_models=init, locked=["global"])
+        est = GameEstimator(
+            task=TaskType.LOGISTIC_REGRESSION, coordinate_configs=configs,
+            update_sequence=seq, n_cd_iterations=1)
+        ref = est.fit(game, [GameOptimizationConfiguration(lam)],
+                      initial_models=init, locked=["global"])[0]
+        # locked coordinate: exactly the initial coefficients
+        np.testing.assert_array_equal(
+            np.asarray(mp.model.coordinates["global"]
+                       .model.coefficients.means),
+            np.asarray(init["global"].model.coefficients.means))
+        re_mp = mp.model.coordinates["perEntity"]
+        re_ref = ref.model.coordinates["perEntity"]
+        np.testing.assert_array_equal(re_mp.keys, re_ref.keys)
+        np.testing.assert_allclose(re_mp.coeffs, re_ref.coeffs,
+                                   atol=1e-4, rtol=1e-4)
+
+    def test_per_sweep_validation_history_matches_estimator(self, problem):
+        """validation_history must have single-process semantics: one entry
+        per sweep, matching CoordinateDescent's per-sweep evaluation."""
+        from photon_ml_tpu.evaluation import parse_evaluator
+
+        game, configs, lam = problem
+        seq = ["global", "perEntity"]
+        evaluators = [parse_evaluator("AUC")]
+        mp = train_game_multiprocess(
+            game, TaskType.LOGISTIC_REGRESSION, configs, seq, lam,
+            n_cd_iterations=2, validation=(game, evaluators))
+        est = GameEstimator(
+            task=TaskType.LOGISTIC_REGRESSION, coordinate_configs=configs,
+            update_sequence=seq, n_cd_iterations=2)
+        ref = est.fit(game, [GameOptimizationConfiguration(lam)],
+                      validation=(game, evaluators))[0]
+        assert len(mp.validation_history) == 2
+        assert len(ref.validation_history) == 2
+        for h_mp, h_ref in zip(mp.validation_history,
+                               ref.validation_history):
+            assert h_mp.keys() == h_ref.keys()
+            for k in h_mp:
+                np.testing.assert_allclose(h_mp[k], h_ref[k], atol=1e-4)
 
     def test_random_projector_model_scores(self, problem):
         """The assembled model must keep the shared projector so scoring
