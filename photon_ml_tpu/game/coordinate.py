@@ -89,6 +89,26 @@ def _fixed_train_fn_dist(task: TaskType, config: GLMOptimizationConfiguration,
     return train
 
 
+@lru_cache(maxsize=None)
+def _factored_projection_cache(task: TaskType,
+                               config: GLMOptimizationConfiguration, mesh):
+    """One compiled distributed projection solve per (task, config, mesh)
+    for the multi-process factored coordinate: the implicit Khatri-Rao
+    design shards over the data axis and the solve psums — the same
+    machinery as the distributed fixed effect, driving ``vec(P)``."""
+    from photon_ml_tpu.parallel.distributed import DistributedGLMObjective
+
+    dist = DistributedGLMObjective(
+        objective=GLMObjective(loss=loss_for_task(task)), mesh=mesh)
+    problem = OptimizationProblem(dist, config)
+
+    @jax.jit
+    def run(data, w0, lam):
+        return problem.run(data, w0, lam)
+
+    return run
+
+
 @dataclasses.dataclass(frozen=True)
 class FixedEffectCoordinate:
     """Cluster-wide GLM solve for the global coordinate

@@ -445,7 +445,8 @@ def _mp_ckpt_save(root: str, sweep: int, fingerprint: str,
                   scores: Mapping[str, np.ndarray],
                   re_local_models: Mapping[str, RandomEffectModel],
                   fe_models: Mapping[str, FixedEffectModel],
-                  validation_history: Sequence[Mapping] = ()) -> None:
+                  validation_history: Sequence[Mapping] = (),
+                  trained_projection_cids: frozenset = frozenset()) -> None:
     import json as _json
 
     d = _mp_ckpt_dir(root)
@@ -465,6 +466,13 @@ def _mp_ckpt_save(root: str, sweep: int, fingerprint: str,
             payload[f"revar::{cid}"] = m.variances
         payload[f"remeta::{cid}"] = np.array(
             [m.dim], np.int64)
+        if m.projector is not None and cid in trained_projection_cids:
+            # a FACTORED coordinate's projection is TRAINED state (not
+            # seed-derived like the RANDOM projector, which the load path
+            # reconstructs from config) — it must survive resume or
+            # restored latents would score through the initial P
+            payload[f"reproj::{cid}"] = np.asarray(
+                m.projector.matrix, np.float32)
     for cid, m in fe_models.items():
         payload[f"few::{cid}"] = np.asarray(m.model.coefficients.means,
                                             np.float32)
@@ -531,6 +539,15 @@ def _mp_ckpt_load(root: str, sweep: int, fingerprint: str, task,
                 continue
             cid = k[len("rekeys::"):]
             t = re_templates[cid]
+            if f"reproj::{cid}" in z.files:
+                # trained projection (factored coordinate) restored verbatim
+                from photon_ml_tpu.game.projector import RandomProjector
+
+                projector = RandomProjector(matrix=z[f"reproj::{cid}"])
+            else:
+                # seed-derived, identical on every process — must survive
+                # resume or a projected-space model would score raw ids
+                projector = t.projector
             re_models[cid] = RandomEffectModel(
                 random_effect_type=t.random_effect_type,
                 feature_shard_id=t.feature_shard_id, task=task,
@@ -538,9 +555,7 @@ def _mp_ckpt_load(root: str, sweep: int, fingerprint: str, task,
                 keys=z[f"rekeys::{cid}"], coeffs=z[f"recoef::{cid}"],
                 variances=(z[f"revar::{cid}"]
                            if f"revar::{cid}" in z.files else None),
-                # seed-derived, identical on every process — must survive
-                # resume or a projected-space model would score raw ids
-                projector=t.projector)
+                projector=projector)
         fe_models = {}
         for k in z.files:
             if not k.startswith("few::"):
@@ -563,6 +578,17 @@ def _mp_ckpt_load(root: str, sweep: int, fingerprint: str, task,
 
 
 @dataclasses.dataclass(frozen=True)
+class _FactoredPlan:
+    """Per-process plan for a factored coordinate: owned rows + config (the
+    per-alternation datasets rebuild around the trained projection)."""
+
+    cfg: object  # FactoredRandomEffectCoordinateConfig
+    game: GameData
+    global_rows: np.ndarray
+    primary: bool
+
+
+@dataclasses.dataclass(frozen=True)
 class _REPlan:
     config: RandomEffectDatasetConfig
     optimization: GLMOptimizationConfiguration
@@ -572,6 +598,69 @@ class _REPlan:
     dataset: RandomEffectDataset
     #: True when this coordinate's rows coincide with the primary partition
     primary: bool
+
+
+def _train_factored_mp(coord, global_rows: np.ndarray, offsets,
+                       warm, fe_mesh):
+    """Multi-process factored training: the per-entity LATENT solves run
+    process-local exactly like any random effect (rows are grouped with
+    their owned entities), and the shared-projection update — a GLM in
+    ``vec(P)`` — runs as one psum'd global solve over the data mesh, the
+    same machinery as the fixed effect. Mirrors
+    :meth:`FactoredRandomEffectCoordinate.train` step for step; global row
+    ids key the active-bound subsample so dataset builds stay
+    partition-invariant."""
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.game.coordinate import _factored_projection_cache
+    from photon_ml_tpu.game.factored import FactoredDesign
+    from photon_ml_tpu.game.projector import RandomProjector
+    from photon_ml_tpu.game.random_effect import RandomEffectSolver
+    from photon_ml_tpu.parallel.multihost import global_glm_data_multihost
+
+    shard = coord.data.shards[coord.dataset_config.feature_shard_id]
+    if warm is not None and warm.projector is not None:
+        p = warm.projector.matrix
+    else:
+        p = RandomProjector.build(
+            shard.dim, coord.latent_dim, coord.dataset_config.seed).matrix
+    solver = RandomEffectSolver(task=coord.task, config=coord.config,
+                                mesh=coord.mesh)
+    x_host = shard.to_dense()
+    entities = coord.data.id_columns[coord.dataset_config.random_effect_type]
+    # one compiled DISTRIBUTED projection solve per (task, config, mesh):
+    # the Khatri-Rao design rows shard over the global data mesh and the
+    # solve psums, so every process computes the identical shared projection
+    run_fn = _factored_projection_cache(
+        coord.task, coord.projection_config, fe_mesh)
+    offsets_np = np.asarray(offsets, np.float32)
+    latent = warm
+    for _ in range(max(1, coord.n_factored_iterations)):
+        projector = RandomProjector(matrix=p)
+        dataset = RandomEffectDataset.build(
+            coord.coordinate_id, coord.data, coord._ds_config,
+            projector=projector, sample_uids=global_rows)
+        latent, _ = solver.train(dataset, offsets_np, coord.lam,
+                                 warm_start=latent)
+        v = coord._latent_table(latent, entities).astype(np.float32)
+        local = GLMData(
+            design=FactoredDesign(x=x_host, v=v,
+                                  latent_dim=coord.latent_dim),
+            labels=coord.data.labels, offsets=offsets_np,
+            weights=coord.data.weights)
+        fed = global_glm_data_multihost(local, fe_mesh)
+        result = run_fn(fed, jnp.asarray(p.reshape(-1)),
+                        jnp.asarray(coord.lam_projection, jnp.float32))
+        p = np.asarray(result.w, np.float32).reshape(
+            coord.latent_dim, x_host.shape[1])
+    # final latent solve so the returned (v, P) pair is consistent
+    projector = RandomProjector(matrix=p)
+    dataset = RandomEffectDataset.build(
+        coord.coordinate_id, coord.data, coord._ds_config,
+        projector=projector, sample_uids=global_rows)
+    latent, _ = solver.train(dataset, offsets_np, coord.lam,
+                             warm_start=latent)
+    return latent, np.asarray(latent.score(coord.data), np.float32)
 
 
 def _allgather_rowvec(global_rows: np.ndarray, values: np.ndarray,
@@ -633,6 +722,7 @@ def train_game_multiprocess(
         _fixed_train_fn_dist,
     )
     from photon_ml_tpu.game.estimator import (
+        FactoredRandomEffectCoordinateConfig,
         FixedEffectCoordinateConfig,
         RandomEffectCoordinateConfig,
     )
@@ -674,7 +764,8 @@ def train_game_multiprocess(
                 for cid in update_sequence
                 if cid not in locked
                 and isinstance(coordinate_configs[cid],
-                               RandomEffectCoordinateConfig)]
+                               (RandomEffectCoordinateConfig,
+                                FactoredRandomEffectCoordinateConfig))]
     owner_by_type: dict[str, np.ndarray] = {}
     for t in dict.fromkeys(re_types):  # ordered unique
         ents = game_local.id_columns[t]
@@ -700,7 +791,8 @@ def train_game_multiprocess(
             cfg = coordinate_configs[cid]
             if isinstance(cfg, FixedEffectCoordinateConfig):
                 need_shards.add(cfg.feature_shard_id)
-            elif (isinstance(cfg, RandomEffectCoordinateConfig)
+            elif (isinstance(cfg, (RandomEffectCoordinateConfig,
+                                   FactoredRandomEffectCoordinateConfig))
                   and cfg.dataset.random_effect_type == primary_type):
                 need_shards.add(cfg.dataset.feature_shard_id)
         slim_primary = GameData(
@@ -719,6 +811,7 @@ def train_game_multiprocess(
         fe_mesh = make_multihost_mesh()
     fe_datasets: dict[str, MultiProcessFixedEffectDataset] = {}
     re_plans: dict[str, _REPlan] = {}
+    factored_plans: dict[str, _FactoredPlan] = {}
     for cid in update_sequence:
         if cid in locked:
             continue  # frozen: no dataset, scores seeded from the model
@@ -728,7 +821,8 @@ def train_game_multiprocess(
             # per-global-row-id hash, identical under any row partition)
             fe_datasets[cid] = MultiProcessFixedEffectDataset.build(
                 cid, game_primary, cfg.feature_shard_id, fe_mesh)
-        elif isinstance(cfg, RandomEffectCoordinateConfig):
+        elif isinstance(cfg, (RandomEffectCoordinateConfig,
+                              FactoredRandomEffectCoordinateConfig)):
             t = cfg.dataset.random_effect_type
             if t == primary_type:
                 game_c, rows_c, is_primary = game_primary, primary_rows, True
@@ -747,6 +841,14 @@ def train_game_multiprocess(
                     local_global_rows, n_proc)
                 game_c, rows_c = exchange_rows(slim, dest_c)
                 is_primary = False
+            if isinstance(cfg, FactoredRandomEffectCoordinateConfig):
+                # latent solves are process-local like any random effect;
+                # datasets rebuild per alternation (the projector is the
+                # trained object), so the plan carries data, not a dataset
+                factored_plans[cid] = _FactoredPlan(
+                    cfg=cfg, game=game_c, global_rows=rows_c,
+                    primary=is_primary)
+                continue
             # rows of owned entities are complete here by construction, so
             # the per-process dataset covers exactly its entities; global
             # row ids key the active-bound subsample draw so the kept
@@ -759,8 +861,9 @@ def train_game_multiprocess(
                 primary=is_primary)
         else:
             raise TypeError(
-                f"coordinate {cid!r}: multi-process training supports fixed "
-                f"and random effects (got {type(cfg).__name__})")
+                f"coordinate {cid!r}: multi-process training supports fixed, "
+                f"random, and factored random effects "
+                f"(got {type(cfg).__name__})")
 
     # --- coordinate descent with row-local score accounting ---------------
     scores: dict[str, np.ndarray] = {
@@ -831,6 +934,14 @@ def train_game_multiprocess(
                         coeffs=np.zeros(0, np.float32),
                         projector=p.dataset.projector)
                     for cid, p in re_plans.items()}
+                re_templates.update({
+                    cid: RandomEffectModel(
+                        random_effect_type=p.cfg.dataset.random_effect_type,
+                        feature_shard_id=p.cfg.dataset.feature_shard_id,
+                        task=task, dim=0, keys=np.zeros(0, np.int64),
+                        coeffs=np.zeros(0, np.float32),
+                        projector=None)  # learned P rides in the state file
+                    for cid, p in factored_plans.items()})
                 (saved_scores, saved_re, fe_models,
                  resumed_history) = _mp_ckpt_load(
                     checkpoint_dir, agreed, fingerprint, task,
@@ -846,10 +957,17 @@ def train_game_multiprocess(
     total = game_primary.offsets.astype(np.float32) + sum(
         scores[cid] for cid in update_sequence)
 
+    # memo for the assembly: the final model after the last sweep is the
+    # same object the last validation step assembled — don't repeat the
+    # RE-table allgathers. Cleared whenever any coordinate trains.
+    assembled_memo: list = []
+
     def _assemble_global_model() -> GameModel:
         """Allgather the per-process RE tables into the (identical on every
         process) global model — at sweep boundaries when validation tracks
         per-sweep metrics, and once at the end."""
+        if assembled_memo:
+            return assembled_memo[0]
         out = dict(models)
         for cid, local_model in re_local_models.items():
             if local_model is initial_models.get(cid):
@@ -870,9 +988,11 @@ def train_game_multiprocess(
                 # identical on every process) projector so scoring still
                 # maps shard features into the projected key space
                 projector=local_model.projector)
-        return GameModel(
+        gm = GameModel(
             coordinates={cid: out[cid] for cid in update_sequence},
             task=task)
+        assembled_memo.append(gm)
+        return gm
 
     validation_history: list[dict] = list(resumed_history)
     for sweep in range(start_sweep, n_cd_iterations):
@@ -909,11 +1029,7 @@ def train_game_multiprocess(
                         task=task),
                     feature_shard_id=ds.feature_shard_id)
             else:
-                plan = re_plans[cid]
-                coord = RandomEffectCoordinate(
-                    coordinate_id=cid, dataset=plan.dataset, data=plan.game,
-                    task=task, config=plan.optimization,
-                    lam=lam.get(cid, 0.0), mesh=re_mesh)
+                plan = re_plans.get(cid) or factored_plans[cid]
                 if plan.primary:
                     res_c = residual
                 else:
@@ -923,8 +1039,31 @@ def train_game_multiprocess(
                     g_res = _allgather_rowvec(primary_rows, residual,
                                               n_global)
                     res_c = g_res[plan.global_rows]
-                model_c, scores_c = coord.train(
-                    res_c, re_local_models.get(cid), sweep=sweep)
+                if cid in re_plans:
+                    coord = RandomEffectCoordinate(
+                        coordinate_id=cid, dataset=plan.dataset,
+                        data=plan.game, task=task, config=plan.optimization,
+                        lam=lam.get(cid, 0.0), mesh=re_mesh)
+                    model_c, scores_c = coord.train(
+                        res_c, re_local_models.get(cid), sweep=sweep)
+                else:
+                    from photon_ml_tpu.game.factored import (
+                        FactoredRandomEffectCoordinate,
+                    )
+
+                    fcfg = plan.cfg
+                    fcoord = FactoredRandomEffectCoordinate(
+                        coordinate_id=cid, data=plan.game,
+                        dataset_config=fcfg.dataset, task=task,
+                        config=fcfg.optimization,
+                        projection_config=fcfg.projection_optimization,
+                        lam=lam.get(cid, 0.0),
+                        lam_projection=fcfg.lam_projection,
+                        n_factored_iterations=fcfg.n_factored_iterations,
+                        mesh=re_mesh)
+                    model_c, scores_c = _train_factored_mp(
+                        fcoord, plan.global_rows, res_c,
+                        re_local_models.get(cid), fe_mesh)
                 re_local_models[cid] = model_c
                 sc = np.asarray(scores_c, np.float32)
                 if plan.primary:
@@ -932,6 +1071,7 @@ def train_game_multiprocess(
                 else:
                     g_sc = _allgather_rowvec(plan.global_rows, sc, n_global)
                     new_scores = g_sc[primary_rows]
+            assembled_memo.clear()  # model state changed
             total = residual + new_scores
             scores[cid] = new_scores
             logger.info("mp sweep %d coordinate %s done", sweep, cid)
@@ -957,7 +1097,8 @@ def train_game_multiprocess(
                            if m is not initial_models.get(cid)},
                           {cid: m for cid, m in models.items()
                            if cid in fe_datasets},
-                          validation_history=validation_history)
+                          validation_history=validation_history,
+                          trained_projection_cids=frozenset(factored_plans))
 
     # --- model assembly: allgather RE tables ------------------------------
     model = _assemble_global_model()

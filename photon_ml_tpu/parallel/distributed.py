@@ -122,11 +122,27 @@ def shard_glm_data(data: GLMData, n_shards: int, *, device_put_mesh: Optional[Me
     weights[:n] = np.asarray(data.weights)
 
     design = data.design
+    from photon_ml_tpu.game.factored import FactoredDesign
+
     if isinstance(design, DenseDesign):
         x = np.asarray(design.x)
         xp = np.zeros((n_pad, x.shape[1]), x.dtype)
         xp[:n] = x
         sharded_design = DenseDesign(x=_j(xp.reshape(n_shards, per, x.shape[1])))
+    elif isinstance(design, FactoredDesign):
+        # the factored projection solve's implicit Khatri-Rao design: both
+        # row arrays (raw features x, per-sample latents v) stack like a
+        # dense design; matvec/rmatvec work per block unchanged
+        x = np.asarray(design.x)
+        v = np.asarray(design.v)
+        xp = np.zeros((n_pad, x.shape[1]), x.dtype)
+        xp[:n] = x
+        vp = np.zeros((n_pad, v.shape[1]), v.dtype)
+        vp[:n] = v
+        sharded_design = FactoredDesign(
+            x=_j(xp.reshape(n_shards, per, x.shape[1])),
+            v=_j(vp.reshape(n_shards, per, v.shape[1])),
+            latent_dim=design.latent_dim)
     elif isinstance(design, (CsrDesign, ChunkedSparseDesign)):
         if isinstance(design, ChunkedSparseDesign):
             raise TypeError(

@@ -341,8 +341,13 @@ def global_glm_data_from_local(local: GLMData, mesh: Mesh,
         return jax.make_array_from_process_local_data(sharding, x, global_shape)
 
     design = local.design
+    from photon_ml_tpu.game.factored import FactoredDesign
+
     if isinstance(design, DenseDesign):
         fed = DenseDesign(x=feed(design.x))
+    elif isinstance(design, FactoredDesign):
+        fed = FactoredDesign(x=feed(design.x), v=feed(design.v),
+                             latent_dim=design.latent_dim)
     elif isinstance(design, ChunkedSparseDesign):
         fed = ChunkedSparseDesign(
             rvals=feed(design.rvals), rcols=feed(design.rcols),

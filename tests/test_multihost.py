@@ -469,6 +469,81 @@ def test_two_process_train_game_driver(tmp_path):
         os.path.join(tmp_path, "out-mp", "workers", "proc-1"))
 
 
+_FACTORED_WORKER = r"""
+import sys
+port, pid = sys.argv[1], int(sys.argv[2])
+from photon_ml_tpu.testing import virtual_devices
+virtual_devices(2, force_cpu=True)
+from photon_ml_tpu.parallel import multihost
+multihost.initialize(f"localhost:{port}", 2, pid)
+import numpy as np
+from photon_ml_tpu.testing import make_mixed_effect
+from photon_ml_tpu.game.data import RandomEffectDatasetConfig
+from photon_ml_tpu.game.estimator import (
+    FactoredRandomEffectCoordinateConfig, FixedEffectCoordinateConfig,
+    GameEstimator, GameOptimizationConfiguration)
+from photon_ml_tpu.game.multiprocess import (
+    train_game_multiprocess, _take_rows)
+from photon_ml_tpu.game.projector import ProjectorType
+from photon_ml_tpu.glm.problem import GLMOptimizationConfiguration
+from photon_ml_tpu.optimize import OptimizerConfig
+from photon_ml_tpu.ops.regularization import L2Regularization
+from photon_ml_tpu.parallel.multihost import allgather_concat
+from photon_ml_tpu.types import TaskType
+
+game, _ = make_mixed_effect(n=240, d_fixed=5, d_re=4, n_entities=13, seed=5)
+n = game.n_samples
+lo, hi = (0, n // 2) if pid == 0 else (n // 2, n)
+local = _take_rows(game, np.arange(lo, hi))
+opt = GLMOptimizationConfiguration(
+    regularization=L2Regularization,
+    optimizer_config=OptimizerConfig(max_iterations=30))
+configs = {
+    "global": FixedEffectCoordinateConfig("fixed", opt),
+    "perEntity": FactoredRandomEffectCoordinateConfig(
+        RandomEffectDatasetConfig(
+            "entityId", "re", projector_type=ProjectorType.RANDOM,
+            projected_dim=2),
+        optimization=opt, n_factored_iterations=2),
+}
+seq = ["global", "perEntity"]
+lam = {"global": 1e-3, "perEntity": 0.5}
+mp = train_game_multiprocess(
+    local, TaskType.LOGISTIC_REGRESSION, configs, seq, lam,
+    n_cd_iterations=1)
+re_model = mp.model.coordinates["perEntity"]
+assert re_model.projector is not None
+# identical assembled model (incl. the LEARNED projection) on both procs
+both_p = allgather_concat(
+    np.asarray(re_model.projector.matrix).reshape(-1)).reshape(2, -1)
+assert np.array_equal(both_p[0], both_p[1]), "learned projection differs"
+both_c = allgather_concat(re_model.coeffs).reshape(2, -1)
+assert np.array_equal(both_c[0], both_c[1]), "latent tables differ"
+if pid == 0:
+    est = GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION, coordinate_configs=configs,
+        update_sequence=seq, n_cd_iterations=1)
+    ref = est.fit(game, [GameOptimizationConfiguration(lam)])[0]
+    re_ref = ref.model.coordinates["perEntity"]
+    np.testing.assert_allclose(
+        np.asarray(re_model.projector.matrix), re_ref.projector.matrix,
+        atol=2e-3, rtol=2e-2)
+    np.testing.assert_allclose(
+        mp.model.score(game), ref.model.score(game), atol=1e-2)
+print(f"MULTIPROC_FACTORED_OK {pid}", flush=True)
+"""
+
+
+@pytest.mark.slow
+def test_two_process_factored_coordinate(tmp_path):
+    """Factored random effect across two real processes (round-3 verdict
+    item 6): process-local latent solves over the entity partition, one
+    psum'd global projection solve — model (including the learned P)
+    identical on both processes and equal to the single-process run."""
+    _run_two_workers(tmp_path, _FACTORED_WORKER, "MULTIPROC_FACTORED_OK",
+                     timeout=420)
+
+
 @pytest.mark.slow
 def test_two_process_train_game_driver_tuning(tmp_path):
     """--tuning at 2 processes (round-3 verdict: the cluster regime must

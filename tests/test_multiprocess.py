@@ -235,6 +235,44 @@ class TestTrainMultiprocessSingleProcess:
         np.testing.assert_allclose(re_mp.coeffs, re_ref.coeffs,
                                    atol=1e-4, rtol=1e-4)
 
+    def test_factored_matches_estimator(self, problem):
+        """Factored coordinates in multi-process training (round-3 verdict
+        item 6): the latent solves partition like any random effect and
+        the shared projection is a psum'd global solve — the result must
+        match the single-process estimator run."""
+        from photon_ml_tpu.game.estimator import (
+            FactoredRandomEffectCoordinateConfig,
+        )
+        from photon_ml_tpu.game.projector import ProjectorType
+
+        game, configs, lam = problem
+        fconfigs = dict(configs)
+        fconfigs["perEntity"] = FactoredRandomEffectCoordinateConfig(
+            RandomEffectDatasetConfig(
+                "entityId", "re", projector_type=ProjectorType.RANDOM,
+                projected_dim=2),
+            optimization=configs["perEntity"].optimization,
+            n_factored_iterations=2)
+        seq = ["global", "perEntity"]
+        mp = train_game_multiprocess(
+            game, TaskType.LOGISTIC_REGRESSION, fconfigs, seq, lam,
+            n_cd_iterations=1)
+        est = GameEstimator(
+            task=TaskType.LOGISTIC_REGRESSION, coordinate_configs=fconfigs,
+            update_sequence=seq, n_cd_iterations=1)
+        ref = est.fit(game, [GameOptimizationConfiguration(lam)])[0]
+        re_mp = mp.model.coordinates["perEntity"]
+        re_ref = ref.model.coordinates["perEntity"]
+        assert re_mp.projector is not None
+        np.testing.assert_allclose(re_mp.projector.matrix,
+                                   re_ref.projector.matrix,
+                                   atol=1e-3, rtol=1e-2)
+        np.testing.assert_array_equal(re_mp.keys, re_ref.keys)
+        np.testing.assert_allclose(re_mp.coeffs, re_ref.coeffs,
+                                   atol=2e-3, rtol=2e-2)
+        np.testing.assert_allclose(
+            mp.model.score(game), ref.model.score(game), atol=5e-3)
+
     def test_per_sweep_validation_history_matches_estimator(self, problem):
         """validation_history must have single-process semantics: one entry
         per sweep, matching CoordinateDescent's per-sweep evaluation."""
