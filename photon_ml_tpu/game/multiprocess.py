@@ -635,6 +635,7 @@ def _train_factored_mp(coord, global_rows: np.ndarray, offsets,
         coord.task, coord.projection_config, fe_mesh)
     offsets_np = np.asarray(offsets, np.float32)
     latent = warm
+    fed = None
     for _ in range(max(1, coord.n_factored_iterations)):
         projector = RandomProjector(matrix=p)
         dataset = RandomEffectDataset.build(
@@ -643,12 +644,23 @@ def _train_factored_mp(coord, global_rows: np.ndarray, offsets,
         latent, _ = solver.train(dataset, offsets_np, coord.lam,
                                  warm_start=latent)
         v = coord._latent_table(latent, entities).astype(np.float32)
-        local = GLMData(
-            design=FactoredDesign(x=x_host, v=v,
-                                  latent_dim=coord.latent_dim),
-            labels=coord.data.labels, offsets=offsets_np,
-            weights=coord.data.weights)
-        fed = global_glm_data_multihost(local, fe_mesh)
+        if fed is None:
+            # first alternation pays the full budget-reconciled feed; the
+            # design's x / labels / weights / offsets are loop-invariant
+            # (the single-chip counterpart builds x_dev once the same way),
+            # so later alternations re-feed ONLY v
+            local = GLMData(
+                design=FactoredDesign(x=x_host, v=v,
+                                      latent_dim=coord.latent_dim),
+                labels=coord.data.labels, offsets=offsets_np,
+                weights=coord.data.weights)
+            fed = global_glm_data_multihost(local, fe_mesh)
+        else:
+            fed = dataclasses.replace(
+                fed, design=FactoredDesign(
+                    x=fed.design.x, v=_feed_stacked(v, fe_mesh,
+                                                    fed.labels.shape[1]),
+                    latent_dim=coord.latent_dim))
         result = run_fn(fed, jnp.asarray(p.reshape(-1)),
                         jnp.asarray(coord.lam_projection, jnp.float32))
         p = np.asarray(result.w, np.float32).reshape(
@@ -661,6 +673,26 @@ def _train_factored_mp(coord, global_rows: np.ndarray, offsets,
     latent, _ = solver.train(dataset, offsets_np, coord.lam,
                              warm_start=latent)
     return latent, np.asarray(latent.score(coord.data), np.float32)
+
+
+def _feed_stacked(a: np.ndarray, mesh, per: int):
+    """Place one per-local-row array (trailing dims preserved) into the
+    mesh's global data-axis layout at an already-agreed ``per`` — the
+    cheap re-feed for loop-varying leaves (the factored solve's v)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from photon_ml_tpu.parallel.mesh import DATA_AXIS
+    from photon_ml_tpu.parallel.multihost import local_axis_blocks
+
+    a = np.asarray(a, np.float32)
+    n_local = local_axis_blocks(mesh, DATA_AXIS)
+    buf = np.zeros((n_local * per,) + a.shape[1:], np.float32)
+    buf[:a.shape[0]] = a
+    return jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P(DATA_AXIS)),
+        buf.reshape((n_local, per) + a.shape[1:]),
+        (int(mesh.shape[DATA_AXIS]), per) + a.shape[1:])
 
 
 def _allgather_rowvec(global_rows: np.ndarray, values: np.ndarray,

@@ -146,8 +146,12 @@ def train_glm_sweep_batched(
 
     - dense 200k x 1024, 50 iters: sequential 0.75 s, batched 1.27 s —
       **0.59x, a loss**. The dense sequential path runs the fused Pallas
-      kernel at the HBM wall and warm starts slash late-lane iterations;
-      the vmapped solve takes the unfused path and repays those savings.
+      kernel at the HBM wall and warm starts slash late-lane iterations.
+      Round 4: the multi-row-margin kernel (``ops/pallas_glm.py::
+      fused_value_and_grad_multi``, dispatched automatically through a
+      custom-vmap rule when the solve vmaps over lambda) cuts the batched
+      dense time to 0.95 s — still 0.78x sequential: lockstep lanes
+      cannot beat warm starts on dense, with or without idle-MXU-row use.
     - chunked-sparse 3.2M nnz, d=20k, 30 iters: sequential 4.34 s,
       batched 2.49 s — **1.74x**. Here the per-iteration cost is XLA's
       random gather (~16-20 ns/nnz, tools/layout_crossover.py) whose

@@ -174,12 +174,15 @@ class GLMObjective:
 
     def value_and_grad(self, w: Array, data: GLMData, l2=0.0) -> tuple[Array, Array]:
         if self._fused_eligible(data):
-            from photon_ml_tpu.ops.pallas_glm import fused_value_and_grad
+            from photon_ml_tpu.ops.pallas_glm import vmappable_value_and_grad
 
-            value, grad = fused_value_and_grad(
-                self.loss, data.design.x, w, data.labels, data.offsets,
-                data.weights,
-                interpret=jax.default_backend() != "tpu")
+            # custom-vmap wrapper: a vmap over w alone (the batched lambda
+            # sweep) runs the multi-row kernel — one pass over X for all
+            # lanes; unbatched calls behave exactly like the plain kernel
+            vag = vmappable_value_and_grad(
+                self.loss, jax.default_backend() != "tpu")
+            value, grad = vag(data.design.x, w, data.labels, data.offsets,
+                              data.weights)
             l2 = jnp.asarray(l2, value.dtype)
             return (value + self._l2_term(w, l2),
                     grad + l2 * self._reg_w(w))

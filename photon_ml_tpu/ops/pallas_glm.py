@@ -52,6 +52,27 @@ bf16-design solve still measures ~1.4–1.5x over the f32 fused solve
 the same kernel. Auto block sizes (f32 400, bf16 800) are within 2% of
 the best measured; no retune needed.
 
+Round-4 multi-row-margin variant (``fused_value_and_grad_multi`` + the
+``vmappable_value_and_grad`` custom-vmap wrapper — the batched
+lambda-sweep consumer VERDICT r3 item 5 asked about): M coefficient rows
+share one pass over X; margins are M rows of one MXU matmul. Measured on
+the axon TPU v5e, dense 200k x 1024, 5 lambdas, 50-iteration solves,
+D2H-sync, min of 3:
+
+    batched sweep, unfused under vmap (round 3)   1.27 s
+    batched sweep + multi-row kernel              0.95 s   (1.33x better)
+    sequential sweep (M=1 kernel + warm starts)   0.74 s   (still the
+                                                  dense winner)
+
+Verdict: the idle MXU rows are real and the multi-row kernel recovers a
+1.33x on the batched path, but warm starts (late lanes converge in a few
+iterations) still beat lockstep lanes on dense problems — the sweep
+default (sequential for dense, batched for chunked-sparse at its 1.74x)
+stands. The kernel pays off when lanes genuinely must run without warm
+starts (the vmapped batched mode users opt into). Standalone per-call
+timings through the axon tunnel are floored at ~80 ms by the D2H round
+trip — only chained/in-solve measurements are meaningful here.
+
 In auto mode the block size prefers the largest ≤-cap divisor of n (see
 ``_dividing_block_rows``; at n=200k f32 that's B=400) so X streams in
 place — padding the row dim means `jnp.pad` copying the FULL design inside
@@ -273,6 +294,144 @@ def fused_value_and_grad(loss: PointwiseLoss, x, w, labels, offsets, weights,
     )
     value, grad = out
     return value[0, 0], grad[0, :]
+
+
+def _kernel_multi(loss: PointwiseLoss, x_ref, y_ref, off_ref, wt_ref, w_ref,
+                  loss_ref, grad_ref):
+    """Multi-row-margin variant: M coefficient rows share ONE pass over the
+    design block. The M=1 kernel leaves 127/128 MXU rows idle (the issue
+    wall the measurement table documents); here margins are the (M, B) rows
+    of a single matmul and the gradient a real (M, B)x(B, D) matmul — the
+    batched lambda-sweep's lanes ride the idle rows for free."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        loss_ref[:] = jnp.zeros_like(loss_ref)
+        grad_ref[:] = jnp.zeros_like(grad_ref)
+
+    x = x_ref[:]  # (B, D) — read once, shared by every lane
+    w = w_ref[:]  # (M, D) f32
+    y = y_ref[0]  # (1, B)
+    off = off_ref[0]
+    wt = wt_ref[0]
+    precision = (jax.lax.Precision.HIGHEST if x.dtype == jnp.float32
+                 else jax.lax.Precision.DEFAULT)
+    m = jax.lax.dot_general(
+        w.astype(x.dtype), x,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=precision) + off  # (M, B); off broadcasts over lanes
+    live = wt > 0  # (1, B) — broadcasts
+    m_safe = jnp.where(live, m, 0.0)
+    lvec = loss.loss(m_safe, y)
+    dvec = jnp.where(live, loss.d1(m_safe, y) * wt, 0.0)
+    loss_ref[:] += jnp.sum(jnp.where(live, wt * lvec, 0.0),
+                           axis=1).reshape(1, -1)  # (1, M)
+    grad_ref[:] += jax.lax.dot_general(
+        dvec.astype(x.dtype), x,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=precision)  # (M, D)
+
+
+@functools.partial(jax.jit, static_argnames=("loss", "block_rows", "interpret"))
+def fused_value_and_grad_multi(loss: PointwiseLoss, x, ws, labels, offsets,
+                               weights, *, block_rows: int | None = None,
+                               interpret: bool = False):
+    """(values (M,), grads (M, D)) for M coefficient vectors over ONE pass
+    of the design — the batched lambda-sweep consumer (every lane shares
+    the same data; only w differs per lane). Block selection and padding
+    semantics are identical to :func:`fused_value_and_grad`."""
+    n, d = x.shape
+    n_lanes = ws.shape[0]
+    tile = _sublane_tile(x.dtype)
+    if block_rows is None:
+        b = auto_block_rows(n, x.dtype)
+        if b is None:
+            b = _rounded_block(n, _default_block_rows(x.dtype), tile)
+    else:
+        b = _rounded_block(n, block_rows, tile)
+    n_blocks = pl.cdiv(n, b)
+    n_pad = n_blocks * b
+    if n_pad != n:
+        pad = n_pad - n
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+        labels = jnp.pad(labels, (0, pad))
+        offsets = jnp.pad(offsets, (0, pad))
+        weights = jnp.pad(weights, (0, pad))
+
+    f32 = jnp.float32
+    itemsize = jnp.dtype(x.dtype).itemsize
+    out = pl.pallas_call(
+        functools.partial(_kernel_multi, loss),
+        grid=(n_blocks,),
+        in_specs=[
+            pl.BlockSpec((b, d), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, b), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, b), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, b), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((n_lanes, d), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, n_lanes), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((n_lanes, d), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            _out_struct(x, (1, n_lanes), f32),
+            _out_struct(x, (n_lanes, d), f32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=4 * n_pad * d * n_lanes,
+            transcendentals=2 * n_pad * n_lanes,
+            bytes_accessed=n_pad * d * itemsize,
+        ),
+        interpret=interpret,
+    )(
+        x,
+        labels.astype(f32).reshape(n_blocks, 1, b),
+        offsets.astype(f32).reshape(n_blocks, 1, b),
+        weights.astype(f32).reshape(n_blocks, 1, b),
+        ws.astype(f32),
+    )
+    value, grad = out
+    return value[0, :], grad
+
+
+@functools.lru_cache(maxsize=None)
+def vmappable_value_and_grad(loss: PointwiseLoss, interpret: bool = False):
+    """The fused (value, grad) with a custom vmap rule: a vmap over the
+    coefficient vector alone (the batched lambda sweep) dispatches to the
+    multi-row kernel — one pass over X shared by all lanes, M margins as M
+    rows of one MXU matmul — instead of M independent kernel passes. Any
+    other batching combination falls back to a sequential lane map."""
+
+    @jax.custom_batching.custom_vmap
+    def vag(x, w, labels, offsets, weights):
+        return fused_value_and_grad(loss, x, w, labels, offsets, weights,
+                                    interpret=interpret)
+
+    @vag.def_vmap
+    def _rule(axis_size, in_batched, x, w, labels, offsets, weights):
+        xb, wb, lb, ob, wtb = in_batched
+        if wb and not (xb or lb or ob or wtb):
+            values, grads = fused_value_and_grad_multi(
+                loss, x, w, labels, offsets, weights, interpret=interpret)
+            return (values, grads), (True, True)
+
+        def body(i):
+            return fused_value_and_grad(
+                loss, x[i] if xb else x, w[i] if wb else w,
+                labels[i] if lb else labels, offsets[i] if ob else offsets,
+                weights[i] if wtb else weights, interpret=interpret)
+
+        values, grads = jax.lax.map(body, jnp.arange(axis_size))
+        return (values, grads), (True, True)
+
+    return vag
 
 
 def _hvp_kernel(x_ref, d2_ref, v_ref, out_ref):

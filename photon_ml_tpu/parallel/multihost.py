@@ -357,7 +357,8 @@ def global_glm_data_from_local(local: GLMData, mesh: Mesh,
     else:
         raise TypeError(
             f"multi-host feed takes the stacked per-block layout from "
-            f"shard_glm_data (DenseDesign or ChunkedSparseDesign); got "
+            f"shard_glm_data (DenseDesign, FactoredDesign, or "
+            f"ChunkedSparseDesign); got "
             f"{type(design).__name__} — run shard_glm_data("
             f"local, local_axis_blocks(mesh)) first, or use "
             f"global_glm_data_multihost for the whole dance")

@@ -261,6 +261,44 @@ class TestFusedPallasKernel:
         np.testing.assert_allclose(np.asarray(g1), np.asarray(g0),
                                    rtol=1e-4, atol=1e-4)
 
+    @pytest.mark.parametrize("loss", [LogisticLoss, SquaredLoss, PoissonLoss])
+    def test_multi_row_kernel_matches_per_lane(self, loss):
+        """The multi-row-margin variant (the batched lambda-sweep consumer)
+        must equal M independent single-row kernel calls — including
+        weight-0 padding rows and offsets — and the custom-vmap wrapper
+        must dispatch a w-only vmap to it."""
+        import jax
+
+        from photon_ml_tpu.ops.pallas_glm import (
+            fused_value_and_grad,
+            fused_value_and_grad_multi,
+            vmappable_value_and_grad,
+        )
+
+        data, _ = _make_data(loss)
+        rng = np.random.default_rng(5)
+        m = 5
+        weights = rng.uniform(0.5, 2.0, size=N)
+        weights[-5:] = 0.0
+        x = jnp.asarray(np.asarray(data.design.x), jnp.float32)
+        labels = jnp.asarray(np.asarray(data.labels), jnp.float32)
+        off = jnp.asarray(rng.normal(size=N), jnp.float32)
+        wt = jnp.asarray(weights, jnp.float32)
+        ws = jnp.asarray(rng.normal(size=(m, D)).astype(np.float32) * 0.3)
+        refs = [fused_value_and_grad(loss, x, ws[k], labels, off, wt,
+                                     interpret=True) for k in range(m)]
+        v_ref = np.asarray([float(v) for v, _ in refs])
+        g_ref = np.stack([np.asarray(g) for _, g in refs])
+        v, g = fused_value_and_grad_multi(loss, x, ws, labels, off, wt,
+                                          interpret=True)
+        np.testing.assert_allclose(np.asarray(v), v_ref, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(g), g_ref, rtol=1e-4, atol=1e-4)
+        vag = vmappable_value_and_grad(loss, True)
+        v2, g2 = jax.vmap(vag, in_axes=(None, 0, None, None, None))(
+            x, ws, labels, off, wt)
+        np.testing.assert_allclose(np.asarray(v2), v_ref, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(g2), g_ref, rtol=1e-4, atol=1e-4)
+
     def test_block_rows_smaller_than_n(self):
         from photon_ml_tpu.ops.pallas_glm import fused_value_and_grad
 
