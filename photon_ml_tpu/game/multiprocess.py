@@ -678,7 +678,14 @@ def _train_factored_mp(coord, global_rows: np.ndarray, offsets,
 def _feed_stacked(a: np.ndarray, mesh, per: int):
     """Place one per-local-row array (trailing dims preserved) into the
     mesh's global data-axis layout at an already-agreed ``per`` — the
-    cheap re-feed for loop-varying leaves (the factored solve's v)."""
+    cheap re-feed for loop-varying leaves (the factored solve's v).
+
+    LAYOUT CONTRACT with ``parallel.distributed.shard_glm_data``: local
+    rows fill CONTIGUOUSLY with zero padding at the tail, then reshape to
+    ``(n_local_blocks, per, ...)`` row-major. The re-fed leaf must align
+    row-for-row with the labels/weights blocks the first full feed built;
+    if shard_glm_data's stacking ever changes, this helper must change
+    with it (a mismatch would silently scramble rows)."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 

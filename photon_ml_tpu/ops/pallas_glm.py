@@ -342,7 +342,15 @@ def fused_value_and_grad_multi(loss: PointwiseLoss, x, ws, labels, offsets,
     """(values (M,), grads (M, D)) for M coefficient vectors over ONE pass
     of the design — the batched lambda-sweep consumer (every lane shares
     the same data; only w differs per lane). Block selection and padding
-    semantics are identical to :func:`fused_value_and_grad`."""
+    semantics are identical to :func:`fused_value_and_grad`.
+
+    KEPT SEPARATE from the M=1 kernel deliberately: the single-row kernel's
+    (1, B)/(1, D) lane-major layouts are the measured-fastest formulation
+    for the headline solve (see the module table — round 1's alternative
+    layouts lost 1.0-2.6x), and routing M=1 through this kernel's (M, ·)
+    shapes was not measured equal. Any change to the block-selection /
+    padding / BlockSpec plumbing here must be mirrored in
+    :func:`fused_value_and_grad` (and vice versa)."""
     n, d = x.shape
     n_lanes = ws.shape[0]
     tile = _sublane_tile(x.dtype)
