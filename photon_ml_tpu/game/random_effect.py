@@ -157,9 +157,26 @@ class RandomEffectSolver:
         (dead rows → ``n``, dropped by the ``mode="drop"`` scatter;
         deliberately NOT entity-padded, since zero-padding a scatter index
         would alias sample 0). With ``config.cache_device_buckets`` off,
-        reverts to upload-and-drop (peak HBM = one bucket instead of all)."""
+        reverts to upload-and-drop (peak HBM = one bucket instead of all).
+
+        When the dataset carries source data and the shard densifies
+        (:meth:`_compact_shared`), the fat tensors are materialized ON
+        DEVICE by one gather through the compact index maps instead of
+        being filled on host and shipped over the wire — the wire is
+        ~35 MB/s here and the padded tensors are 3-4x the compact form.
+        The gather runs ONCE per dataset (cached), so repeated sweeps pay
+        nothing: leaving the gathers INSIDE the sweep program instead
+        measured 3x on the 10M-row RE bench (re-gathering per solve)."""
 
         def build():
+            shared = self._compact_shared(dataset)
+            if shared is not None:
+                idx_d, fi_d = self._compact_arrays(dataset, i, bucket)
+                fi = bucket.feature_index
+                identity = (fi.shape[1] == shared[0].shape[1]
+                            and bool((fi == np.arange(fi.shape[1])).all()))
+                return _materialize_fat(*shared, idx_d, fi_d, n=n,
+                                        identity_cols=identity)
             return (self._put(bucket.x), self._put(bucket.labels),
                     self._put(bucket.weights),
                     self._put(np.maximum(bucket.sample_idx, 0)),
@@ -192,6 +209,12 @@ class RandomEffectSolver:
         data = dataset.source_data
         if data is None or dataset.projector is not None:
             return None
+        if not dataset.config.cache_device_buckets:
+            # upload-and-drop mode exists to BOUND peak HBM at ~one bucket;
+            # the materialize path would pin the dense shard image (+ index
+            # maps) on device for the dataset's lifetime — keep streaming
+            # on the host-upload path
+            return None
         if self.mesh is not None:
             # entity-mesh runs keep the fat path: its per-bucket tensors
             # shard 1/n_dev per device, whereas the shared dense image would
@@ -204,17 +227,15 @@ class RandomEffectSolver:
         return shard_x, data.device_labels(), data.device_weights()
 
     def _sweep_statics(self, dataset: RandomEffectDataset, n: int):
-        """(shared, statics) for the fused sweep — compact when eligible,
-        fat otherwise. Single home of the selection so train() and
-        _warm_compile() can never pre-compile different layouts."""
-        shared = self._compact_shared(dataset)
-        if shared is not None:
-            statics = tuple(self._compact_arrays(dataset, i, b)
-                            for i, b in enumerate(dataset.buckets))
-        else:
-            statics = tuple(self._static_arrays(dataset, i, b, n)
-                            for i, b in enumerate(dataset.buckets))
-        return shared, statics
+        """Fat statics for the fused sweep (single home, shared by train()
+        and _warm_compile() so they can never pre-compile different
+        layouts). :meth:`_static_arrays` materializes them ON DEVICE from
+        the compact uploads when the dataset allows — the sweep program
+        itself always consumes the fat layout (gathering inside the
+        program instead re-paid the gather every solve: 3x on the 10M-row
+        RE bench)."""
+        return tuple(self._static_arrays(dataset, i, b, n)
+                     for i, b in enumerate(dataset.buckets))
 
     def _compact_arrays(self, dataset: RandomEffectDataset, i: int,
                         bucket: REBucket):
@@ -240,7 +261,7 @@ class RandomEffectSolver:
 
     @partial(jax.jit, static_argnames=("self", "e_reals", "out_sharding"))
     def _sweep_fused(self, offsets_dev, lam, statics, warm_ctxs, coeffs_warm,
-                     cidxs, e_reals, out_sharding=None, shared=None):
+                     cidxs, e_reals, out_sharding=None):
         """One program for the WHOLE coordinate sweep: per bucket, gather
         residual offsets, gather warm starts from the previous sweep's
         coefficient table, solve, compute margins, scatter into the score
@@ -256,47 +277,22 @@ class RandomEffectSolver:
         sweep 0 (zeros — every ``found`` is False), so a single compilation
         serves the cold sweep and every warm sweep.
 
-        Two statics layouts per bucket:
-
-        - compact (2-tuple, with ``shared``): ``(sample_idx, feature_index)``
-          int32 index maps (-1 = padding); the program gathers the bucket's
-          x/labels/weights out of the ``shared`` (dense shard image, labels,
-          weights) arrays — the only per-bucket H2D is the index maps.
-        - fat (5-tuple): pre-filled ``(x, labels, weights, gather_idx,
-          scatter_idx)`` host tensors, for datasets without source data or
-          whose shard is too wide to densify.
+        Statics are the fat 5-tuple per bucket — ``(x, labels, weights,
+        gather_idx, scatter_idx)`` — either uploaded from host fills or
+        materialized on device from the compact index maps
+        (:func:`_materialize_fat`); the sweep program is identical either
+        way, and gathering inside the program instead re-paid the gather
+        every solve (measured 3x on the 10M-row RE bench).
         """
         scores = jnp.zeros_like(offsets_dev)
-        n = offsets_dev.shape[0]
         flat_w: list[jnp.ndarray] = []
         flat_v: list[jnp.ndarray] = []
         coef_parts: list[jnp.ndarray] = []
         for statics_k, (pos_d, found_d), cidx, \
                 e_real in zip(statics, warm_ctxs, cidxs, e_reals):
-            if len(statics_k) == 2:
-                idx_d, fi_d = statics_k
-                shard_x, labels_g, weights_g = shared
-                clip = jnp.maximum(idx_d, 0)
-                rmask = idx_d >= 0
-                fclip = jnp.maximum(fi_d, 0)
-                cmask = fi_d >= 0
-                x_d = (shard_x[clip[:, :, None], fclip[:, None, :]]
-                       * rmask[:, :, None] * cmask[:, None, :])
-                lab_d = labels_g[clip]
-                wt_d = weights_g[clip] * rmask
-                boff = offsets_dev[clip] * rmask
-                # materialize the gathered tensors ONCE: without the
-                # barrier XLA is free to fuse the gathers into the solver's
-                # while_loop body and re-gather every optimizer iteration
-                x_d, lab_d, wt_d, boff = jax.lax.optimization_barrier(
-                    (x_d, lab_d, wt_d, boff))
-                store_d = jnp.where(rmask, idx_d, n)
-                full_scatter = True  # padded lanes carry index n -> dropped
-            else:
-                x_d, lab_d, wt_d, idx_d, store_d = statics_k
-                boff = jnp.take(offsets_dev, idx_d.reshape(-1),
-                                mode="clip").reshape(idx_d.shape) * (wt_d > 0)
-                full_scatter = False  # store_d is (e_real, S)
+            x_d, lab_d, wt_d, idx_d, store_d = statics_k
+            boff = jnp.take(offsets_dev, idx_d.reshape(-1),
+                            mode="clip").reshape(idx_d.shape) * (wt_d > 0)
             w0 = jnp.where(
                 found_d,
                 jnp.take(coeffs_warm, pos_d.reshape(-1),
@@ -304,9 +300,7 @@ class RandomEffectSolver:
                 0.0).astype(jnp.float32)
             w_dev, variances, _conv = self._solve_bucket(
                 x_d, lab_d, boff, wt_d, w0, lam)
-            margins = self._margins_bucket(x_d, w_dev)
-            if not full_scatter:
-                margins = margins[:e_real]
+            margins = self._margins_bucket(x_d, w_dev)[:e_real]
             scores = scores.at[store_d].set(margins, mode="drop")
             flat_w.append(w_dev[:e_real].reshape(-1))
             flat_v.append(jnp.asarray(variances)[:e_real].reshape(-1))
@@ -459,12 +453,12 @@ class RandomEffectSolver:
             # always worth doing here (overlapped with the fixed-effect
             # stage); only the zero-data execution is skippable when this
             # process already compiled the program
-            shared, statics = self._sweep_statics(dataset, n)
+            statics = self._sweep_statics(dataset, n)
             warm_ctxs = tuple(self._warm_ctx(dataset, i, b, None, 0)
                               for i, b in enumerate(buckets))
             cidxs = tuple(self._coef_idx(dataset, i, b)
                           for i, b in enumerate(buckets))
-            sig = hash((self, n, shared is not None,
+            sig = hash((self, n,
                         tuple((b.tensor_shape, b.n_entities)
                               for b in buckets),
                         self._key_table_len(dataset)))
@@ -472,7 +466,7 @@ class RandomEffectSolver:
                 out = self._sweep_fused(
                     jnp.zeros((n,), jnp.float32), jnp.zeros((), jnp.float32),
                     statics, warm_ctxs, self._zero_coeffs(dataset), cidxs,
-                    tuple(b.n_entities for b in buckets), shared=shared)
+                    tuple(b.n_entities for b in buckets))
                 np.asarray(out[1][:1])  # D2H: the only reliable barrier on axon
                 _PRECOMPILED.add(sig)
             object.__setattr__(dataset, "_warm_compiled", (self.mesh,))
@@ -583,7 +577,7 @@ class RandomEffectSolver:
             # (see _sweep_fused). The per-bucket path below survives for the
             # streaming (upload-and-drop) and projected modes.
             buckets = dataset.buckets
-            shared, statics = self._sweep_statics(dataset, n)
+            statics = self._sweep_statics(dataset, n)
             warm_ctxs = tuple(
                 self._warm_ctx(dataset, i, b, warm_start, shard_dim)
                 for i, b in enumerate(buckets))
@@ -609,7 +603,7 @@ class RandomEffectSolver:
                             and tuple(off_sharding.spec) else None)
             scores, batched_dev, coeffs_unsorted = self._sweep_fused(
                 offsets_dev, lam_dev, statics, warm_ctxs, coeffs_warm,
-                cidxs, e_reals, out_sharding=out_sharding, shared=shared)
+                cidxs, e_reals, out_sharding=out_sharding)
             d_of = [b.tensor_shape[2] for b in buckets]
             w_sizes = [b.n_entities * d for b, d in zip(buckets, d_of)]
             v_sizes = [b.n_entities * (d if want_var else 0)
@@ -761,6 +755,33 @@ class RandomEffectSolver:
             projector=dataset.projector,
             coeffs_device=coeffs_device)
         return model, scores
+
+
+@partial(jax.jit, static_argnames=("n", "identity_cols"))
+def _materialize_fat(shard_x, labels_g, weights_g, idx_d, fi_d, *, n: int,
+                     identity_cols: bool = False):
+    """One device-side gather turning compact index maps into the fat
+    bucket tensors ``(x, labels, weights, gather_idx, scatter_idx)`` — the
+    exact 5-tuple the host-fill path uploads, built from the shared dense
+    shard image instead of shipped over the wire. Runs once per bucket per
+    dataset (the caller caches the result). ``identity_cols`` marks a
+    bucket whose local feature map is exactly ``arange(shard_dim)`` for
+    every entity (the common small-dim case: every feature observed) — the
+    (E, S, D) element gather then collapses to a plain ROW gather, which
+    the TPU executes several times faster."""
+    clip = jnp.maximum(idx_d, 0)
+    rmask = idx_d >= 0
+    if identity_cols:
+        x = shard_x[clip] * rmask[:, :, None]
+    else:
+        fclip = jnp.maximum(fi_d, 0)
+        cmask = fi_d >= 0
+        x = (shard_x[clip[:, :, None], fclip[:, None, :]]
+             * rmask[:, :, None] * cmask[:, None, :])
+    labels = labels_g[clip] * rmask
+    weights = weights_g[clip] * rmask
+    store = jnp.where(rmask, idx_d, n)
+    return x, labels, weights, clip, store
 
 
 @jax.jit
