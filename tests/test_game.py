@@ -746,6 +746,87 @@ class TestDownSampling:
         wb = bc.downsample(labels, weights, 0)
         np.testing.assert_array_equal(wb[:100], 1.0)  # positives kept
 
+    def test_keyed_draw_identical_across_single_chip_and_dp_mesh(self):
+        """The keyed per-global-row-id draw makes a down-sampled fixed
+        effect train identically on one device and on a dp mesh (the
+        stacked layout is contiguous rows, so the arange uid map agrees) —
+        the invariance the multi-process equality also rests on."""
+        import dataclasses as dc
+
+        from photon_ml_tpu.game.data import FixedEffectDataset, GameData
+        from photon_ml_tpu.game.coordinate import FixedEffectCoordinate
+        from photon_ml_tpu.glm.problem import GLMOptimizationConfiguration
+        from photon_ml_tpu.ops.regularization import L2Regularization
+        from photon_ml_tpu.optimize import OptimizerConfig
+        from photon_ml_tpu.parallel.mesh import DATA_AXIS, make_mesh
+        from photon_ml_tpu.sampling import BinaryClassificationDownSampler
+        from photon_ml_tpu.testing import dense_shard
+        from photon_ml_tpu.types import TaskType
+
+        rng = np.random.default_rng(3)
+        n, d = 400, 6
+        x = rng.normal(size=(n, d)).astype(np.float32)
+        y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+        game = GameData.build(labels=y, shards={"f": dense_shard(x)})
+        cfg = GLMOptimizationConfiguration(
+            regularization=L2Regularization,
+            optimizer_config=OptimizerConfig(max_iterations=30))
+        sampler = BinaryClassificationDownSampler(rate=0.6, seed=17)
+
+        def fit(mesh):
+            ds = FixedEffectDataset.build("c", game, "f", mesh=mesh)
+            coord = FixedEffectCoordinate(
+                coordinate_id="c", dataset=ds,
+                task=TaskType.LOGISTIC_REGRESSION, config=cfg, lam=0.1,
+                downsampler=sampler)
+            model, _ = coord.train(np.zeros(n, np.float32), sweep=1)
+            return np.asarray(model.model.coefficients.means)
+
+        w1 = fit(None)
+        w8 = fit(make_mesh({DATA_AXIS: 8}))
+        # f32 psum reduction order differs across the mesh — ~1e-4-level
+        # numerics; a kept-set mismatch would diverge at the 1e-1 level
+        np.testing.assert_allclose(w1, w8, atol=2e-3, rtol=2e-3)
+
+    def test_compact_path_disabled_in_streaming_mode(self):
+        """upload-and-drop (cache_device_buckets=False) bounds peak HBM at
+        ~one bucket; the compact-materialize path would pin the dense shard
+        image for the dataset's lifetime, so it must stay off there."""
+        from photon_ml_tpu.game.data import (
+            GameData,
+            RandomEffectDataset,
+            RandomEffectDatasetConfig,
+        )
+        from photon_ml_tpu.game.random_effect import RandomEffectSolver
+        from photon_ml_tpu.glm.problem import GLMOptimizationConfiguration
+        from photon_ml_tpu.ops.regularization import L2Regularization
+        from photon_ml_tpu.optimize import OptimizerConfig
+        from photon_ml_tpu.testing import dense_shard
+        from photon_ml_tpu.types import TaskType
+
+        rng = np.random.default_rng(0)
+        n = 64
+        x = rng.normal(size=(n, 3)).astype(np.float32)
+        y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+        game = GameData.build(labels=y, shards={"re": dense_shard(x)},
+                              id_columns={"e": rng.integers(0, 5, size=n)})
+        solver = RandomEffectSolver(
+            task=TaskType.LOGISTIC_REGRESSION,
+            config=GLMOptimizationConfiguration(
+                regularization=L2Regularization,
+                optimizer_config=OptimizerConfig(max_iterations=5)))
+        cached = RandomEffectDataset.build(
+            "c", game, RandomEffectDatasetConfig("e", "re"))
+        assert solver._compact_shared(cached) is not None
+        streaming = RandomEffectDataset.build(
+            "c", game, RandomEffectDatasetConfig(
+                "e", "re", cache_device_buckets=False))
+        assert solver._compact_shared(streaming) is None
+        # and the streaming solve still runs end to end on the host path
+        model, scores = solver.train(streaming, np.zeros(n, np.float32), 1.0)
+        assert np.isfinite(np.asarray(scores)).all()
+        assert np.isfinite(model.coeffs).all()
+
 
 class TestEvaluatorEdgeCases:
     def test_missing_id_rows_excluded_from_grouped_metric(self):
