@@ -297,10 +297,14 @@ class AvroDataReader:
         file_key_remap = []
         for d in decoded:
             remap = np.empty(len(d.feature_keys), np.int64)
+            identity = True
             for i, k in enumerate(d.feature_keys):
                 j = all_keys.setdefault(k, len(all_keys))
                 remap[i] = j
-            file_key_remap.append(remap)
+                identity = identity and j == i
+            # None marks the identity remap (always true for the first /
+            # only file): the per-nnz gather below is then skipped
+            file_key_remap.append(None if identity else remap)
         global_keys = [None] * len(all_keys)
         for k, j in all_keys.items():
             global_keys[j] = k
@@ -329,7 +333,8 @@ class AvroDataReader:
             rows_parts.append(
                 np.repeat(np.arange(d.n_records, dtype=np.int64) + row0,
                           counts))
-            keys_parts.append(remap[d.feat_key_id])
+            keys_parts.append(d.feat_key_id if remap is None
+                              else remap[d.feat_key_id])
             vals_parts.append(d.feat_val)
             row0 += d.n_records
         all_rows = np.concatenate(rows_parts) if rows_parts else \

@@ -440,7 +440,10 @@ def test_two_process_train_game_driver(tmp_path):
         "--training-data", str(train_dir),
         "--validation-data", val,
         "--feature-shards", "global=fixed|intercept,user=user|noIntercept",
-        "--coordinates", "global=fixed,shard=global,reg=L2",
+        # downsample on the fixed effect: the keyed per-global-row-id draw
+        # must sample the SAME rows through the per-process file shares
+        # (contiguous size-balanced runs) as the single-process read
+        "--coordinates", "global=fixed,shard=global,reg=L2,downsample=0.85",
         "perUser=random,entity=userId,shard=user,reg=L2",
         "--update-sequence", "global,perUser",
         "--grid", "global=0.01", "perUser=1",
