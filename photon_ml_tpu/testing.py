@@ -82,7 +82,9 @@ def dense_shard(x: np.ndarray):
     return FeatureShard.from_coo(
         np.repeat(np.arange(nn), dd),
         np.tile(np.arange(dd, dtype=np.int32), nn),
-        np.asarray(x, np.float32).ravel(), nn, dd)
+        # explicit copy: from_coo's sorted fast path would otherwise keep
+        # a VIEW of the caller's matrix inside the frozen shard
+        np.array(x, np.float32).ravel(), nn, dd)
 
 
 def make_mixed_effect(n: int = 2000, d_fixed: int = 8, d_re: int = 4,

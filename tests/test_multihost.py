@@ -421,7 +421,14 @@ print(f"MULTIPROC_DRIVER_OK {pid}", flush=True)
 
 
 @pytest.mark.slow
-def test_two_process_train_game_driver(tmp_path):
+@pytest.mark.parametrize("global_spec", [
+    "global=fixed,shard=global,reg=L2",
+    # downsample on the fixed effect: the keyed per-global-row-id draw
+    # must sample the SAME rows through the per-process file shares
+    # (contiguous size-balanced runs) as the single-process read
+    "global=fixed,shard=global,reg=L2,downsample=0.85",
+], ids=["plain", "downsampled"])
+def test_two_process_train_game_driver(tmp_path, global_spec):
     """The FULL train_game driver across two real processes: per-process
     file reads, global feature-index/vocabulary agreement, entity-
     partitioned training, chief-gated model write — and the validation AUC
@@ -440,10 +447,7 @@ def test_two_process_train_game_driver(tmp_path):
         "--training-data", str(train_dir),
         "--validation-data", val,
         "--feature-shards", "global=fixed|intercept,user=user|noIntercept",
-        # downsample on the fixed effect: the keyed per-global-row-id draw
-        # must sample the SAME rows through the per-process file shares
-        # (contiguous size-balanced runs) as the single-process read
-        "--coordinates", "global=fixed,shard=global,reg=L2,downsample=0.85",
+        "--coordinates", global_spec,
         "perUser=random,entity=userId,shard=user,reg=L2",
         "--update-sequence", "global,perUser",
         "--grid", "global=0.01", "perUser=1",

@@ -468,6 +468,56 @@ class TestMultiprocessCheckpoint:
         np.testing.assert_allclose(re_b.coeffs, re_a.coeffs,
                                    atol=1e-5, rtol=1e-4)
 
+    def test_factored_resume_restores_learned_projection(self, tmp_path):
+        """A factored coordinate's projection is TRAINED state: resume must
+        restore the saved P (not re-derive the seed-initial one), so the
+        resumed run must equal a straight run — and a resumed run with
+        per-sweep validation must return the FULL history."""
+        from photon_ml_tpu.evaluation import parse_evaluator
+        from photon_ml_tpu.game.estimator import (
+            FactoredRandomEffectCoordinateConfig,
+        )
+        from photon_ml_tpu.game.projector import ProjectorType
+
+        game, configs, seq, lam = self._setup()
+        fconfigs = dict(configs)
+        fconfigs["perEntity"] = FactoredRandomEffectCoordinateConfig(
+            RandomEffectDatasetConfig(
+                "entityId", "re", projector_type=ProjectorType.RANDOM,
+                projected_dim=2),
+            optimization=configs["perEntity"].optimization,
+            n_factored_iterations=1)
+        evaluators = [parse_evaluator("AUC")]
+        straight = train_game_multiprocess(
+            game, TaskType.LOGISTIC_REGRESSION, fconfigs, seq, lam,
+            n_cd_iterations=2, validation=(game, evaluators))
+
+        ck = str(tmp_path / "ck")
+        train_game_multiprocess(
+            game, TaskType.LOGISTIC_REGRESSION, fconfigs, seq, lam,
+            n_cd_iterations=1, checkpoint_dir=ck,
+            validation=(game, evaluators))
+        resumed = train_game_multiprocess(
+            game, TaskType.LOGISTIC_REGRESSION, fconfigs, seq, lam,
+            n_cd_iterations=2, checkpoint_dir=ck, resume=True,
+            validation=(game, evaluators))
+
+        re_a = straight.model.coordinates["perEntity"]
+        re_b = resumed.model.coordinates["perEntity"]
+        assert re_b.projector is not None
+        np.testing.assert_allclose(re_b.projector.matrix,
+                                   re_a.projector.matrix,
+                                   atol=1e-5, rtol=1e-4)
+        np.testing.assert_array_equal(re_b.keys, re_a.keys)
+        np.testing.assert_allclose(re_b.coeffs, re_a.coeffs,
+                                   atol=1e-4, rtol=1e-3)
+        # full per-sweep history, not just the post-resume tail
+        assert len(resumed.validation_history) == 2
+        for h_r, h_s in zip(resumed.validation_history,
+                            straight.validation_history):
+            for k in h_s:
+                np.testing.assert_allclose(h_r[k], h_s[k], atol=1e-4)
+
     def test_fingerprint_mismatch_rejected(self, tmp_path):
         game, configs, seq, lam = self._setup()
         ck = str(tmp_path / "ck")
