@@ -23,6 +23,7 @@ from photon_ml_tpu.data_validation import validate_game_data
 from photon_ml_tpu.evaluation import parse_evaluators
 from photon_ml_tpu.game.estimator import (
     FactoredRandomEffectCoordinateConfig,
+    FixedEffectCoordinateConfig,
     GameEstimator,
     GameOptimizationConfiguration,
     RandomEffectCoordinateConfig,
@@ -79,6 +80,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--debug-nans", action="store_true",
                    help="enable jax_debug_nans (fail fast on NaN; §5.2 "
                         "sanitizer equivalent)")
+    p.add_argument("--design-dtype", default="float32",
+                   choices=["float32", "bfloat16"],
+                   help="device storage dtype for the FIXED-EFFECT dense "
+                        "designs: bfloat16 halves the HBM traffic of the "
+                        "dominant payload (~1.4-1.5x solve) for ~3-digit "
+                        "design rounding; random-effect buckets stay f32")
     p.add_argument("--model-sparsity-threshold", type=float, default=0.0,
                    help="drop |coefficient| <= threshold from written "
                         "models (reference model-sparsity threshold)")
@@ -206,6 +213,19 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                               for s in args.feature_shards.split(","))
         coordinate_configs = dict(parse_coordinate_config(s)
                                   for s in args.coordinates)
+        if args.design_dtype != "float32":
+            if multiproc or (mesh is not None and args.mesh):
+                # the sharded fixed-effect feeds are f32 end to end
+                # (budget-reconciled global layout); mirror train_glm's gate
+                raise SystemExit("--design-dtype bfloat16 is not supported "
+                                 "with --mesh or multi-process --multihost "
+                                 "training (the sharded feed is float32)")
+            import dataclasses as _dc
+
+            coordinate_configs = {
+                cid: (_dc.replace(c, design_dtype=args.design_dtype)
+                      if isinstance(c, FixedEffectCoordinateConfig) else c)
+                for cid, c in coordinate_configs.items()}
         update_sequence = [c for c in args.update_sequence.split(",") if c]
         locked = [c for c in args.locked_coordinates.split(",") if c]
         if locked and not args.model_input_dir:

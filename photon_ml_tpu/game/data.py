@@ -369,6 +369,13 @@ class FixedEffectDataset:
         n_shards = 1
         if mesh is not None and DATA_AXIS in getattr(mesh, "shape", {}):
             n_shards = int(mesh.shape[DATA_AXIS])
+        if n_shards > 1 and dtype != jnp.float32:
+            # the data-sharded feed is f32 end to end; silently building
+            # f32 under a bf16 request would fake the promised speedup
+            raise ValueError(
+                "design dtype overrides are not supported on the "
+                "data-sharded mesh path (the stacked feed is float32); "
+                "drop --design-dtype or the data-axis mesh")
         if (n_shards == 1
                 and choose_dense_design(shard, n_shards=1,
                                         dense_max_dim=dense_max_dim)):

@@ -17,6 +17,8 @@ import json
 import logging
 from typing import Mapping, Optional, Sequence
 
+import jax.numpy as jnp
+
 from photon_ml_tpu.evaluation import EvaluationResults, Evaluator
 from photon_ml_tpu.game.coordinate import (
     FixedEffectCoordinate,
@@ -46,6 +48,11 @@ class FixedEffectCoordinateConfig:
     feature_shard_id: str
     optimization: GLMOptimizationConfiguration = GLMOptimizationConfiguration()
     downsampler: Optional[DownSampler] = None
+    #: "float32" (default) or "bfloat16" — the dtype the dense design is
+    #: stored in on device. bfloat16 halves the HBM traffic of the
+    #: dominant payload (the same trade the GLM driver's --design-dtype
+    #: offers: ~1.4-1.5x solve speed for ~1e-3-digit design rounding).
+    design_dtype: str = "float32"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -149,7 +156,9 @@ class GameEstimator:
             cfg = self.coordinate_configs[cid]
             if isinstance(cfg, FixedEffectCoordinateConfig):
                 datasets[cid] = FixedEffectDataset.build(
-                    cid, data, cfg.feature_shard_id, mesh=self.mesh)
+                    cid, data, cfg.feature_shard_id, mesh=self.mesh,
+                    dtype=(jnp.bfloat16 if cfg.design_dtype == "bfloat16"
+                           else jnp.float32))
             elif isinstance(cfg, FactoredRandomEffectCoordinateConfig):
                 # rebuilt each alternation around the learned projection
                 datasets[cid] = None
@@ -257,6 +266,12 @@ class GameEstimator:
                 "n_cd_iterations": self.n_cd_iterations,
                 "locked": sorted(locked),
                 "n_samples": data.n_samples,
+                # every coordinate's full configuration (optimizer, bounds,
+                # regularization, design dtype) — resuming under a changed
+                # config must fail loudly, not blend incompatible state
+                # (the multi-process fingerprint has always done this)
+                "configs": {c: repr(self.coordinate_configs.get(c))
+                            for c in self.update_sequence},
             }, sort_keys=True)
             cd_result = cd.run(coordinates, data, self.task,
                                validation=validation,

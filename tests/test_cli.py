@@ -266,6 +266,31 @@ class TestTrainGameDriver:
         ])
         assert sresult2["n_scored"] == 450
 
+    def test_design_dtype_bfloat16(self, tmp_path):
+        """--design-dtype bfloat16 on the GAME driver stores the fixed
+        design half-width; the model must stay close to the f32 run (the
+        design itself is rounded ~3 decimal digits)."""
+        train = make_avro_dataset(tmp_path / "train.avro", n=600, seed=1)
+        val = make_avro_dataset(tmp_path / "val.avro", n=300, seed=3)
+        argv = [
+            "--training-data", train, "--validation-data", val,
+            "--feature-shards", SHARDS,
+            "--coordinates", *COORDS,
+            "--update-sequence", "global,perUser",
+            "--grid", "global=0.1", "perUser=1",
+            "--evaluators", "AUC",
+        ]
+        r32 = train_game_cli.run(
+            argv + ["--output-dir", str(tmp_path / "o32")])
+        r16 = train_game_cli.run(
+            argv + ["--output-dir", str(tmp_path / "o16"),
+                    "--design-dtype", "bfloat16"])
+        assert abs(r16["best_evaluation"]["AUC"]
+                   - r32["best_evaluation"]["AUC"]) < 0.02
+        assert os.path.exists(
+            os.path.join(str(tmp_path / "o16"), "best",
+                         "model-metadata.json"))
+
     def test_partial_retrain_with_locked_coordinate(self, tmp_path):
         """Reference --model-input-dir path: warm-start from a saved model,
         freeze the fixed effect, retrain only the random effect."""
