@@ -456,10 +456,13 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
 
                 def release_datasets():
                     # tuning holds the datasets across fits; drop the cached
-                    # device placements (HBM) once the search is done
+                    # device placements (HBM) once the search is done —
+                    # including GameData's (dense shard image, labels/weights
+                    # uploaded by device_dense_shard)
                     for ds in datasets.values():
                         if hasattr(ds, "clear_device_cache"):
                             ds.clear_device_cache()
+                    data.clear_device_cache()
 
             maximize = evaluators[0].maximize
             search_cls = (GaussianProcessSearch if args.tuning == "BAYESIAN"

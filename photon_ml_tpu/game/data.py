@@ -28,6 +28,7 @@ too few rows from training entirely; all rows excluded from training remain
 from __future__ import annotations
 
 import dataclasses
+import threading
 from functools import partial
 from typing import Optional
 
@@ -40,6 +41,11 @@ from photon_ml_tpu.ops.design import CsrDesign, DenseDesign
 from photon_ml_tpu.ops.objective import GLMData
 from photon_ml_tpu.util import group_starts as _group_starts
 from photon_ml_tpu.util import hash_uniform as _hash_uniform
+from photon_ml_tpu.util import materialize_thunk
+
+#: guards lazy-thunk materialization (REBucket deferred native fills) —
+#: see util.materialize_thunk. Materialization is rare — one lock is enough.
+_THUNK_LOCK = threading.Lock()
 
 #: Fixed-effect designs at or below this width always densify (MXU path)
 #: when they fit the byte cap; above it the measured crossover rule decides.
@@ -121,6 +127,11 @@ class FeatureShard:
             # monotonicity check is ~10x cheaper than the argsort+gathers
             cols = np.ascontiguousarray(cols, np.int32)
             vals = np.ascontiguousarray(vals, np.float32)
+            # freeze the aliased buffers: a caller mutating them later would
+            # silently corrupt this frozen shard and any device image derived
+            # from it — make the write raise instead
+            cols.flags.writeable = False
+            vals.flags.writeable = False
         indptr = np.zeros(n_samples + 1, np.int64)
         np.cumsum(np.bincount(rows, minlength=n_samples), out=indptr[1:])
         return FeatureShard(indptr=indptr, cols=cols, vals=vals, dim=dim)
@@ -624,10 +635,8 @@ class REBucket:
         if name in ("x", "labels", "weights"):
             val = object.__getattribute__(self, name)
             if callable(val):
-                x, labels, weights = val()
-                object.__setattr__(self, "x", x)
-                object.__setattr__(self, "labels", labels)
-                object.__setattr__(self, "weights", weights)
+                materialize_thunk(self, ("x", "labels", "weights"),
+                                  _THUNK_LOCK)
                 return object.__getattribute__(self, name)
             return val
         return object.__getattribute__(self, name)

@@ -19,6 +19,7 @@ score-time RDD join (``model/RandomEffectModel.scala``).
 from __future__ import annotations
 
 import dataclasses
+import threading
 from typing import Mapping, Optional
 
 import numpy as np
@@ -27,6 +28,12 @@ from photon_ml_tpu.game.data import FeatureShard, GameData
 from photon_ml_tpu.game.projector import RandomProjector
 from photon_ml_tpu.models.glm import GeneralizedLinearModel
 from photon_ml_tpu.types import TaskType
+from photon_ml_tpu.util import materialize_thunk
+
+#: guards lazy-thunk materialization (RandomEffectModel coeffs/variances,
+#: GameModel.materialize's batched pull) — see util.materialize_thunk.
+#: Materialization is rare — one global lock is enough.
+_THUNK_LOCK = threading.Lock()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -110,9 +117,7 @@ class RandomEffectModel:
         if name in ("coeffs", "variances"):
             val = object.__getattribute__(self, name)
             if callable(val):
-                c, v = val()
-                object.__setattr__(self, "coeffs", c)
-                object.__setattr__(self, "variances", v)
+                materialize_thunk(self, ("coeffs", "variances"), _THUNK_LOCK)
                 return object.__getattribute__(self, name)
             return val
         return object.__getattribute__(self, name)
@@ -225,6 +230,12 @@ class GameModel:
 
         import jax.numpy as jnp
 
+        # same lock as __getattribute__: a thread touching m.coeffs while
+        # the driver materializes must not run a thunk twice
+        with _THUNK_LOCK:
+            self._materialize_locked(jax, jnp)
+
+    def _materialize_locked(self, jax, jnp) -> None:
         jobs = []  # (install_fn, flat_device_array)
         for m in self.coordinates.values():
             if isinstance(m, RandomEffectModel):
@@ -248,8 +259,13 @@ class GameModel:
 
                         def install_fe(flat, coeffs=coeffs, field=field,
                                        shape=arr.shape):
+                            # copy out of the shared transfer buffer: a
+                            # reshape view would let in-place mutation of
+                            # one coordinate's array silently alter
+                            # another's (RE installs already build fresh
+                            # arrays via mask-indexing — no copy needed)
                             object.__setattr__(coeffs, field,
-                                               flat.reshape(shape))
+                                               flat.reshape(shape).copy())
 
                         jobs.append((install_fe, arr.reshape(-1)))
         if not jobs:

@@ -199,13 +199,53 @@ def process_file_share(reader, input_path) -> list[str]:
 
     all_files = reader.paths(input_path)
     n_proc = jax.process_count()
+    if n_proc > 1:
+        # agree on the LISTING itself before ANY unilateral exit or further
+        # collective: a file landing mid-listing (or a too-few-files exit
+        # taken by one process only) must fail cleanly on every process,
+        # not crash some and hang the rest at the next collective
+        import hashlib
+
+        from photon_ml_tpu.parallel.multihost import allgather_concat
+        digest = hashlib.sha256("\0".join(all_files).encode()).digest()[:8]
+        h = np.frombuffer(digest, np.uint32).astype(np.float64)
+        sig = allgather_concat(
+            np.array([float(len(all_files)), h[0], h[1]])).reshape(n_proc, 3)
+        if not (sig == sig[:1]).all():
+            raise SystemExit(
+                "--multihost: the input file listing diverges across "
+                "processes (different lengths or names) — every process "
+                "must see the same files; re-run once the input directory "
+                "is stable")
+    # symmetric from here on: every process sees the same listing, so this
+    # exit (and every later decision) fires on all processes or none
     if len(all_files) < n_proc:
         raise SystemExit(
             f"--multihost with {n_proc} processes needs at "
             f"least that many input files (got {len(all_files)}; split "
             f"the data)")
-    sizes = np.array([max(os.path.getsize(f), 1) for f in all_files],
-                     np.float64)
+    try:
+        sizes = np.array([max(os.path.getsize(f), 1) for f in all_files],
+                         np.float64)
+    except OSError:
+        # non-stat-able paths (e.g. remote URIs a reader may accept)
+        sizes = None
+    if n_proc > 1:
+        # stat results can still diverge across hosts (a file renamed
+        # between the two passes, host-local disks): keep byte-size
+        # balancing only when every process saw the same sizes, else
+        # equal-count shares — the cuts below must be IDENTICAL everywhere
+        from photon_ml_tpu.parallel.multihost import allgather_concat
+        ok = sizes is not None
+        local = np.concatenate(
+            [[float(ok)], sizes if ok else np.zeros(len(all_files))])
+        rows = allgather_concat(local).reshape(n_proc, len(all_files) + 1)
+        if (rows[:, 0] == 1.0).all() and (rows == rows[:1]).all():
+            sizes = rows[0, 1:]
+        else:
+            sizes = np.ones(len(all_files), np.float64)
+    elif sizes is None:
+        sizes = np.ones(len(all_files), np.float64)
     # cut the cumulative-size curve into n_proc near-equal spans, keeping
     # every span non-empty (each process must read at least one file)
     cum = np.cumsum(sizes)

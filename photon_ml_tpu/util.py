@@ -31,3 +31,18 @@ def hash_uniform(ids: np.ndarray, seed: int) -> np.ndarray:
     z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
     z = z ^ (z >> np.uint64(31))
     return z.astype(np.float64) / float(2**64)
+
+
+def materialize_thunk(obj, fields: tuple, lock) -> None:
+    """Run a lazy materialization thunk at most once, double-checked under
+    ``lock``: ``fields[0]`` holds either the materialized array or a zero-arg
+    thunk returning one value per field; install the results with
+    ``object.__setattr__`` (the holders are frozen dataclasses). Thunks share
+    mutable solver/native scratch, so a racing double-run would corrupt the
+    tensors — the shared invariant behind REBucket's deferred native fills
+    and RandomEffectModel's deferred device pulls."""
+    with lock:
+        val = object.__getattribute__(obj, fields[0])
+        if callable(val):
+            for f, v in zip(fields, val()):
+                object.__setattr__(obj, f, v)
