@@ -67,17 +67,6 @@ struct Reader {
     return v;
   }
 
-  bool read_string(std::string* out) {
-    int64_t n = read_long();
-    if (n < 0 || !need(static_cast<size_t>(n))) {
-      ok = false;
-      return false;
-    }
-    out->assign(reinterpret_cast<const char*>(p), static_cast<size_t>(n));
-    p += n;
-    return true;
-  }
-
   // Zero-copy variant: the returned span aliases the block buffer, which
   // outlives the record decode — callers must consume it before the next
   // block. Saves one heap string per call in the per-feature hot loop.
@@ -105,18 +94,87 @@ struct Reader {
 };
 
 // String interner: key -> dense id, plus the flat byte table for export.
+// Open-addressing (linear probe, power-of-two capacity) keyed by an FNV-1a
+// hash computed straight off the block-buffer string views: the
+// unordered_map<string> version paid a heap std::string assembly plus a
+// chained-bucket walk per feature (~12 probes/record) and was the decode
+// hot spot once zlib was out of the way.
 struct Interner {
-  std::unordered_map<std::string, int32_t> ids;
-  std::string bytes;               // concatenated keys
+  struct Slot {
+    uint64_t h;
+    int32_t id;  // -1 = empty
+  };
+  std::string bytes;                // concatenated keys
   std::vector<int64_t> offsets{0};  // len+1 prefix offsets into bytes
+  std::vector<Slot> slots = std::vector<Slot>(1024, Slot{0, -1});
+  size_t count = 0;
 
-  int32_t intern(const std::string& s) {
-    auto it = ids.find(s);
-    if (it != ids.end()) return it->second;
-    int32_t id = static_cast<int32_t>(ids.size());
-    ids.emplace(s, id);
-    bytes.append(s);
+  // FNV-1a over a, then (when b is non-null) a 0x01 separator byte and b —
+  // byte-identical to hashing the stored key `a + '\x01' + b`.
+  static uint64_t hash_parts(const char* a, size_t la, const char* b,
+                             size_t lb) {
+    uint64_t h = 1469598103934665603ULL;
+    for (size_t i = 0; i < la; ++i) {
+      h ^= static_cast<uint8_t>(a[i]);
+      h *= 1099511628211ULL;
+    }
+    if (b) {
+      h ^= 1u;
+      h *= 1099511628211ULL;
+      for (size_t i = 0; i < lb; ++i) {
+        h ^= static_cast<uint8_t>(b[i]);
+        h *= 1099511628211ULL;
+      }
+    }
+    return h;
+  }
+
+  bool equals(int32_t id, const char* a, size_t la, const char* b,
+              size_t lb) const {
+    const int64_t off = offsets[id];
+    const int64_t len = offsets[id + 1] - off;
+    const int64_t want = static_cast<int64_t>(la + (b ? lb + 1 : 0));
+    if (len != want) return false;
+    const char* p = bytes.data() + off;
+    if (std::memcmp(p, a, la) != 0) return false;
+    if (b) {
+      if (p[la] != '\x01') return false;
+      if (std::memcmp(p + la + 1, b, lb) != 0) return false;
+    }
+    return true;
+  }
+
+  void grow() {
+    std::vector<Slot> ns(slots.size() * 2, Slot{0, -1});
+    const size_t mask = ns.size() - 1;
+    for (const Slot& s : slots) {
+      if (s.id < 0) continue;
+      size_t i = s.h & mask;
+      while (ns[i].id >= 0) i = (i + 1) & mask;
+      ns[i] = s;
+    }
+    slots.swap(ns);
+  }
+
+  // Intern `a + '\x01' + b` (b non-null) or just `a` (b null).
+  int32_t intern_parts(const char* a, size_t la, const char* b, size_t lb) {
+    const uint64_t h = hash_parts(a, la, b, lb);
+    const size_t mask = slots.size() - 1;
+    size_t i = h & mask;
+    while (slots[i].id >= 0) {
+      if (slots[i].h == h && equals(slots[i].id, a, la, b, lb))
+        return slots[i].id;
+      i = (i + 1) & mask;
+    }
+    const int32_t id = static_cast<int32_t>(count++);
+    slots[i] = Slot{h, id};
+    bytes.append(a, la);
+    if (b) {
+      bytes.push_back('\x01');
+      bytes.append(b, lb);
+    }
     offsets.push_back(static_cast<int64_t>(bytes.size()));
+    if (count * 10 >= slots.size() * 7) grow();
     return id;
   }
 };
@@ -141,9 +199,11 @@ constexpr double kNaN = __builtin_nan("");
 // null first (branch 0 = null).
 bool decode_record(Reader& r, const int* field_order, const uint8_t* null_first,
                    const std::vector<std::string>& id_keys, Result* out,
-                   std::string* scratch, std::string* keybuf) {
+                   std::vector<int32_t>* ids_scratch) {
   double response = kNaN, offs = kNaN, weight = kNaN;
-  std::vector<int32_t> ids(id_keys.size(), -1);
+  // caller-owned scratch: a per-record heap vector was 1 allocation/record
+  std::vector<int32_t>& ids = *ids_scratch;
+  ids.assign(id_keys.size(), -1);
   for (int f = 0; f < 6; ++f) {
     switch (field_order[f]) {
       case 0: {  // uid: [null, string]
@@ -176,19 +236,18 @@ bool decode_record(Reader& r, const int* field_order, const uint8_t* null_first,
             r.read_long();  // byte size, unused
           }
           for (int64_t i = 0; i < count; ++i) {
-            // name + '\x01' + term assembled in a REUSED buffer: the
-            // per-feature `std::string key = ...` copy was ~2M small
-            // allocations per 200k-record file
+            // name + term interned straight from the block-buffer views —
+            // no per-feature key assembly at all
             const char* s1;
             size_t l1;
+            const char* s2;
+            size_t l2;
             if (!r.read_string_view(&s1, &l1)) return false;
-            keybuf->assign(s1, l1);
-            keybuf->push_back('\x01');
-            if (!r.read_string_view(&s1, &l1)) return false;
-            keybuf->append(s1, l1);
+            if (!r.read_string_view(&s2, &l2)) return false;
             double v = r.read_double();
             if (!r.ok) return false;
-            out->feat_key.push_back(out->feat_keys.intern(*keybuf));
+            out->feat_key.push_back(
+                out->feat_keys.intern_parts(s1, l1, s2, l2));
             out->feat_val.push_back(v);
           }
         }
@@ -210,12 +269,14 @@ bool decode_record(Reader& r, const int* field_order, const uint8_t* null_first,
           for (int64_t i = 0; i < count; ++i) {
             const char* ks;
             size_t kl;
+            const char* vs;
+            size_t vl;
             if (!r.read_string_view(&ks, &kl)) return false;
-            if (!r.read_string(scratch)) return false;
+            if (!r.read_string_view(&vs, &vl)) return false;
             for (size_t c = 0; c < id_keys.size(); ++c) {
               if (id_keys[c].size() == kl
                   && std::memcmp(id_keys[c].data(), ks, kl) == 0) {
-                ids[c] = out->id_vocabs[c].intern(*scratch);
+                ids[c] = out->id_vocabs[c].intern_parts(vs, vl, nullptr, 0);
               }
             }
           }
@@ -298,8 +359,7 @@ void* photon_decode_blocks(const uint8_t* blocks, int64_t blocks_len,
 
   Reader file{blocks, blocks + blocks_len};
   std::vector<uint8_t> scratch_block;
-  std::string scratch;
-  std::string keybuf;
+  std::vector<int32_t> ids_scratch;
   while (file.p < file.end) {
     int64_t n_records = file.read_long();
     int64_t size = file.read_long();
@@ -327,7 +387,7 @@ void* photon_decode_blocks(const uint8_t* blocks, int64_t blocks_len,
     }
     for (int64_t i = 0; i < n_records; ++i) {
       if (!decode_record(rec, field_order, null_first, id_keys, out,
-                         &scratch, &keybuf)) {
+                         &ids_scratch)) {
         out->error = "record decode error";
         return out;
       }
@@ -350,7 +410,7 @@ int64_t photon_result_nnz(void* rp) {
 }
 
 int32_t photon_result_n_feature_keys(void* rp) {
-  return static_cast<int32_t>(static_cast<Result*>(rp)->feat_keys.ids.size());
+  return static_cast<int32_t>(static_cast<Result*>(rp)->feat_keys.count);
 }
 
 int64_t photon_result_feature_bytes_len(void* rp) {
@@ -380,7 +440,7 @@ void photon_result_copy_feature_keys(void* rp, char* bytes,
 
 int32_t photon_result_id_vocab_size(void* rp, int32_t col) {
   auto* r = static_cast<Result*>(rp);
-  return static_cast<int32_t>(r->id_vocabs[col].ids.size());
+  return static_cast<int32_t>(r->id_vocabs[col].count);
 }
 
 int64_t photon_result_id_vocab_bytes_len(void* rp, int32_t col) {

@@ -82,10 +82,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "sanitizer equivalent)")
     p.add_argument("--design-dtype", default="float32",
                    choices=["float32", "bfloat16"],
-                   help="device storage dtype for the FIXED-EFFECT dense "
-                        "designs: bfloat16 halves the HBM traffic of the "
-                        "dominant payload (~1.4-1.5x solve) for ~3-digit "
-                        "design rounding; random-effect buckets stay f32")
+                   help="storage dtype for the dense designs (fixed-effect "
+                        "AND random-effect bucket tensors), on device and "
+                        "on the host-device wire: bfloat16 halves the "
+                        "dominant payload (~1.4-1.5x solve, ~2x feed) for "
+                        "~3-digit design rounding; labels, weights and "
+                        "coefficients stay float32 and margins accumulate "
+                        "in float32")
     p.add_argument("--model-sparsity-threshold", type=float, default=0.0,
                    help="drop |coefficient| <= threshold from written "
                         "models (reference model-sparsity threshold)")
@@ -224,7 +227,9 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
 
             coordinate_configs = {
                 cid: (_dc.replace(c, design_dtype=args.design_dtype)
-                      if isinstance(c, FixedEffectCoordinateConfig) else c)
+                      if isinstance(c, (FixedEffectCoordinateConfig,
+                                        RandomEffectCoordinateConfig))
+                      else c)
                 for cid, c in coordinate_configs.items()}
         update_sequence = [c for c in args.update_sequence.split(",") if c]
         locked = [c for c in args.locked_coordinates.split(",") if c]
@@ -412,6 +417,12 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                         data, configurations, validation=validation,
                         initial_models=initial_models, locked=locked,
                         checkpoint=checkpoint, resume=args.resume)
+                    # drain the async solve queue inside the timed block:
+                    # without this the final sweep's device programs finish
+                    # during "Save models", which then reports compute as
+                    # IO (stages get reference Timed semantics; the wall is
+                    # unchanged — save's materialize would wait anyway)
+                    results[-1].model.device_wait()
         else:
             if validation is None:
                 raise SystemExit("--tuning needs --validation-data")

@@ -191,6 +191,8 @@ class RandomEffectCoordinate:
     #: optional mesh with an ``"entity"`` axis → entity-parallel solves
     #: (reference ``RandomEffectDatasetPartitioner`` sharding).
     mesh: Optional[object] = None
+    #: "float32" or "bfloat16" — see RandomEffectCoordinateConfig
+    design_dtype: str = "float32"
 
     def __post_init__(self):
         self.config.regularization.check_weight(self.lam)
@@ -198,7 +200,8 @@ class RandomEffectCoordinate:
     @property
     def solver(self) -> RandomEffectSolver:
         return RandomEffectSolver(task=self.task, config=self.config,
-                                  mesh=self.mesh)
+                                  mesh=self.mesh,
+                                  design_dtype=self.design_dtype)
 
     def train(self, offsets,
               warm_start: Optional[RandomEffectModel] = None,

@@ -201,24 +201,28 @@ class GameData:
         return out
 
     def device_dense_shard(self, shard_id: str,
-                           max_bytes: Optional[int] = None):
-        """Dense ``(n, dim)`` float32 device image of a feature shard,
-        materialized ON DEVICE from a compact CSR upload (per-row counts +
-        narrow column ids + values ≈ nnz*5–9 bytes instead of n*dim*4):
-        through a ~35 MB/s host↔device link the dense upload of a
-        200k×33 design costs ~0.7 s where the CSR upload costs ~0.2 s.
-        Cached per shard; ``None`` when the dense image would exceed
-        ``max_bytes`` (default :data:`DENSE_DESIGN_MAX_BYTES`, the same cap
-        the fixed-effect layout rule uses) — the budget is applied on cache
-        HITS too, so a caller with a tighter budget never receives an image
-        a looser caller materialized first."""
+                           max_bytes: Optional[int] = None,
+                           dtype=jnp.float32):
+        """Dense ``(n, dim)`` device image of a feature shard, materialized
+        ON DEVICE from a compact CSR upload (per-row counts + narrow column
+        ids + values ≈ nnz*5–9 bytes instead of n*dim*4): through a
+        ~35 MB/s host↔device link the dense upload of a 200k×33 design
+        costs ~0.7 s where the CSR upload costs ~0.2 s.  With
+        ``dtype=bfloat16`` the VALUES ride the wire at 2 bytes too (cast on
+        host) — the design-dtype trade end to end, not just in HBM.
+        Cached per (shard, dtype); ``None`` when the dense image would
+        exceed ``max_bytes`` (default :data:`DENSE_DESIGN_MAX_BYTES`, the
+        same cap the fixed-effect layout rule uses) — the budget is applied
+        on cache HITS too, so a caller with a tighter budget never receives
+        an image a looser caller materialized first."""
         shard = self.shards[shard_id]
         n, d = shard.n_samples, shard.dim
+        dtype = jnp.dtype(dtype)
         if max_bytes is None:
             max_bytes = DENSE_DESIGN_MAX_BYTES
-        if n * d * 4 > max_bytes:
+        if n * d * dtype.itemsize > max_bytes:
             return None
-        key = ("dense_shard", shard_id)
+        key = ("dense_shard", shard_id, dtype.name)
         out = self._device_cache.get(key)
         if out is None:
             counts = shard.row_counts()
@@ -229,7 +233,8 @@ class GameData:
             out = _densify_csr(
                 jnp.asarray(counts.astype(cdt)),
                 jnp.asarray(shard.cols.astype(coldt)),
-                jnp.asarray(shard.vals), n=n, d=d, nnz=shard.nnz)
+                jnp.asarray(shard.vals.astype(dtype)), n=n, d=d,
+                nnz=shard.nnz)
             self._device_cache[key] = out
         return out
 
@@ -255,11 +260,13 @@ class GameData:
 @partial(jax.jit, static_argnames=("n", "d", "nnz"))
 def _densify_csr(counts, cols, vals, *, n: int, d: int, nnz: int):
     """CSR → dense ``(n, d)`` on device. Duplicate (row, col) entries
-    accumulate, matching :meth:`FeatureShard.to_dense`'s ``np.add.at``."""
+    accumulate, matching :meth:`FeatureShard.to_dense`'s ``np.add.at``
+    (accumulation always in f32; the image lands in ``vals.dtype``)."""
     rows = jnp.repeat(jnp.arange(n, dtype=jnp.int32),
                       counts.astype(jnp.int32), total_repeat_length=nnz)
-    return jnp.zeros((n, d), jnp.float32).at[
-        rows, cols.astype(jnp.int32)].add(vals)
+    out = jnp.zeros((n, d), jnp.float32).at[
+        rows, cols.astype(jnp.int32)].add(vals.astype(jnp.float32))
+    return out.astype(vals.dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -392,12 +399,13 @@ class FixedEffectDataset:
                                         dense_max_dim=dense_max_dim)):
             # single-chip dense: materialize the design ON DEVICE from the
             # compact CSR upload — skips both the host densify and the
-            # (n, d, 4)-byte wire transfer (the wire is ~35 MB/s here)
+            # (n, d, 4)-byte wire transfer (the wire is ~35 MB/s here);
+            # a bfloat16 request ships the values at 2 bytes as well
             x_dev = data.device_dense_shard(
-                feature_shard_id, max_bytes=DENSE_DESIGN_MAX_BYTES)
+                feature_shard_id, max_bytes=DENSE_DESIGN_MAX_BYTES,
+                dtype=dtype)
             if x_dev is not None:
-                design = DenseDesign(
-                    x=x_dev if dtype == jnp.float32 else x_dev.astype(dtype))
+                design = DenseDesign(x=x_dev)
                 return FixedEffectDataset(
                     coordinate_id=coordinate_id,
                     feature_shard_id=feature_shard_id,

@@ -63,6 +63,11 @@ class RandomEffectCoordinateConfig:
 
     dataset: RandomEffectDatasetConfig
     optimization: GLMOptimizationConfiguration = GLMOptimizationConfiguration()
+    #: "float32" (default) or "bfloat16" — dtype of the per-entity designs
+    #: on device AND on the host↔device wire (the shared dense shard image
+    #: ships its values at 2 bytes under bfloat16); labels/weights/
+    #: coefficients stay float32, margins accumulate in float32.
+    design_dtype: str = "float32"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -146,9 +151,48 @@ class GameEstimator:
                 f"update sequence to stay part of the model")
 
     # --- dataset construction (once) --------------------------------------
+    def _prefetch_device_feed(self, data: GameData,
+                              locked: Sequence[str]) -> None:
+        """Dispatch the async host→device uploads the coordinates will need
+        BEFORE the host-side bucket builds start: jax transfers are
+        asynchronous, so the ~35 MB/s wire streams the dense shard images /
+        labels / weights while the host packs buckets. Without this the
+        wire only starts when the first solve asks for the image — fully
+        serialized after the builds."""
+        from photon_ml_tpu.game.data import choose_dense_design
+        from photon_ml_tpu.game.projector import ProjectorType
+
+        if self.mesh is not None:
+            return  # sharded paths build their own per-device feeds
+        seen: set = set()
+        for cid in self.update_sequence:
+            if cid in locked:
+                continue
+            cfg = self.coordinate_configs.get(cid)
+            if isinstance(cfg, FixedEffectCoordinateConfig):
+                sid, dt = cfg.feature_shard_id, cfg.design_dtype
+            elif isinstance(cfg, RandomEffectCoordinateConfig):
+                if (not cfg.dataset.cache_device_buckets
+                        or cfg.dataset.projector_type
+                        is ProjectorType.RANDOM):
+                    continue  # solver won't use the shared image
+                sid, dt = cfg.dataset.feature_shard_id, cfg.design_dtype
+            else:
+                continue
+            if (sid, dt) in seen:
+                continue
+            seen.add((sid, dt))
+            if choose_dense_design(data.shards[sid], n_shards=1):
+                data.device_dense_shard(
+                    sid, dtype=(jnp.bfloat16 if dt == "bfloat16"
+                                else jnp.float32))
+            data.device_labels()
+            data.device_weights()
+
     def prepare(self, data: GameData,
                 locked: Sequence[str] = ()) -> dict[str, object]:
         self._check_sequence(locked)
+        self._prefetch_device_feed(data, locked)
         datasets: dict[str, object] = {}
         for cid in self.update_sequence:
             if cid in locked:
@@ -185,7 +229,8 @@ class GameEstimator:
         from photon_ml_tpu.game.random_effect import RandomEffectSolver
 
         solver = RandomEffectSolver(task=self.task, config=cfg.optimization,
-                                    mesh=self.mesh)
+                                    mesh=self.mesh,
+                                    design_dtype=cfg.design_dtype)
         th = threading.Thread(target=solver._warm_compile, args=(dataset, n),
                               daemon=True)
         object.__setattr__(dataset, "_warm_thread", th)
@@ -222,7 +267,8 @@ class GameEstimator:
                 out[cid] = RandomEffectCoordinate(
                     coordinate_id=cid, dataset=datasets[cid], data=data,
                     task=self.task, config=ccfg.optimization,
-                    lam=config.lam(cid), mesh=self.mesh)
+                    lam=config.lam(cid), mesh=self.mesh,
+                    design_dtype=ccfg.design_dtype)
         return out
 
     # --- fit ---------------------------------------------------------------

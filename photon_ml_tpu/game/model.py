@@ -219,6 +219,35 @@ class GameModel:
     coordinates: Mapping[str, FixedEffectModel | RandomEffectModel]
     task: TaskType
 
+    def device_wait(self) -> None:
+        """Block until every pending device program behind this model's
+        tables has finished, WITHOUT pulling the tables host-side: one
+        1-element transfer from the last coordinate's device payload.  The
+        per-coordinate solve programs are chained by data dependencies
+        (each consumes the previous sweep's score state), so that single
+        pull transitively drains them all.  Gives stage walls the
+        reference's synchronous-stage semantics (GameTrainingDriver's
+        ``Timed`` blocks): train = compute, save = IO plus one batched
+        transfer.  ``jax.block_until_ready`` is not a reliable barrier on
+        tunneled PJRT platforms — a device→host pull is (bench.py's timing
+        discipline)."""
+        import jax
+
+        last = None
+        for m in self.coordinates.values():
+            if isinstance(m, RandomEffectModel):
+                thunk = object.__getattribute__(m, "coeffs")
+                dev = getattr(thunk, "device_payload", None) \
+                    if callable(thunk) else None
+                if dev is not None:
+                    last = dev
+            elif isinstance(m, FixedEffectModel):
+                arr = m.model.coefficients.means
+                if isinstance(arr, jax.Array):
+                    last = arr
+        if last is not None:
+            np.asarray(last.reshape(-1)[:1])
+
     def materialize(self) -> None:
         """Pull every coordinate's device-resident table host-side in ONE
         concatenated transfer (each individual pull pays a full host↔device

@@ -394,9 +394,11 @@ class AvroDataReader:
         return data, index_maps, vocabs
 
 
-def write_training_examples(path: str, data_records: Iterable[dict]) -> int:
+def write_training_examples(path: str, data_records: Iterable[dict], *,
+                            codec: str = "deflate") -> int:
     """Convenience writer for tests/examples (TrainingExampleAvro rows)."""
     from photon_ml_tpu.io.avro import write_avro_file
     from photon_ml_tpu.io.schemas import TRAINING_EXAMPLE_AVRO
 
-    return write_avro_file(path, data_records, TRAINING_EXAMPLE_AVRO)
+    return write_avro_file(path, data_records, TRAINING_EXAMPLE_AVRO,
+                           codec=codec)

@@ -313,6 +313,53 @@ class TestGameEstimator:
         vals = [r.evaluation.primary[1] for r in results]
         assert best.evaluation.primary[1] == max(vals)
 
+    def test_bf16_designs_match_f32_fit(self):
+        """bfloat16 designs (fixed-effect AND random-effect buckets, wire
+        included — cli --design-dtype) must track the f32 fit: same AUC to
+        ~1e-3 and close coefficients. Locks the end-to-end bf16 path the
+        e2e bench runs."""
+        import dataclasses as dc
+
+        data, _ = make_mixed_data(n=1500, n_entities=19)
+        vdata, _ = make_mixed_data(n=800, n_entities=19, seed=7)
+        cfg = GLMOptimizationConfiguration(regularization=L2Regularization)
+        coords = {
+            "global": FixedEffectCoordinateConfig(
+                feature_shard_id="fixed", optimization=cfg),
+            "perEntity": RandomEffectCoordinateConfig(
+                dataset=RandomEffectDatasetConfig("entityId", "re"),
+                optimization=cfg),
+        }
+        grid = [GameOptimizationConfiguration(
+            {"global": 0.01, "perEntity": 1.0})]
+        evaluators = parse_evaluators(["AUC"])
+
+        def fit(dtype):
+            est = GameEstimator(
+                task=TaskType.LOGISTIC_REGRESSION,
+                coordinate_configs={
+                    cid: dc.replace(c, design_dtype=dtype)
+                    for cid, c in coords.items()},
+                update_sequence=["global", "perEntity"], n_cd_iterations=2)
+            return est.fit(data, grid, validation=(vdata, evaluators))[0]
+
+        r32, r16 = fit("float32"), fit("bfloat16")
+        auc32 = r32.validation_history[-1]["AUC"]
+        auc16 = r16.validation_history[-1]["AUC"]
+        assert abs(auc32 - auc16) < 2e-3, (auc32, auc16)
+        fe32 = np.asarray(
+            r32.model.coordinates["global"].model.coefficients.means)
+        fe16 = np.asarray(
+            r16.model.coordinates["global"].model.coefficients.means)
+        np.testing.assert_allclose(fe16, fe32, atol=5e-2)
+        re32 = r32.model.coordinates["perEntity"]
+        re16 = r16.model.coordinates["perEntity"]
+        np.testing.assert_array_equal(re16.keys, re32.keys)
+        # per-entity solves on few samples amplify design rounding; bound
+        # the typical error, not the worst lane
+        err = np.abs(np.asarray(re16.coeffs) - np.asarray(re32.coeffs))
+        assert np.median(err) < 5e-2, float(np.median(err))
+
     def test_fit_with_entity_mesh_matches_unsharded(self):
         """End-to-end estimator path with a 2D dp x ep mesh: the fixed
         effect shards samples over 'data' (psum'd compiled L-BFGS) and the
