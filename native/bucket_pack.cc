@@ -220,3 +220,18 @@ void photon_re_bucket_indices(const int64_t* indptr, const int32_t* cols,
 }
 
 }  // extern "C"
+
+extern "C" {
+
+// Stable counting sort of DENSE non-negative ids (entity columns are
+// pre-indexed into [0, n_entities) by ingest): order receives row indices
+// grouped by id, original order preserved within an id. cursors holds the
+// exclusive prefix sum of the id histogram on entry and is consumed.
+// Replaces the O(n log n) numpy stable argsort in the random-effect
+// dataset build (~0.25 s per coordinate at 1M rows -> ~10 ms).
+void photon_counting_sort(const int64_t* ids, int64_t n, int64_t* cursors,
+                          int64_t* order) {
+  for (int64_t i = 0; i < n; ++i) order[cursors[ids[i]]++] = i;
+}
+
+}  // extern "C"

@@ -191,7 +191,14 @@ class CoordinateDescent:
             start_sweep, start_coord = state.sweep, state.coordinate_index
             logger.info("resumed from checkpoint: sweep %d coordinate %d",
                         start_sweep, start_coord)
-        total = jnp.asarray(data.offsets, jnp.float32) + sum(scores.values())
+        # all-zero offsets (no base margin — the common case) skip their
+        # 4 B/row upload; the host scan costs ~0.5 ms/1M rows
+        if data.offsets.size and not data.offsets.any():
+            total = sum(scores.values()) + jnp.zeros(
+                data.n_samples, jnp.float32)
+        else:
+            total = jnp.asarray(data.offsets, jnp.float32) \
+                + sum(scores.values())
 
         history: list[dict[str, float]] = []
         final_evaluation = None

@@ -196,7 +196,14 @@ class GameData:
     def device_weights(self):
         out = self._device_cache.get("weights")
         if out is None:
-            out = jnp.asarray(self.weights)
+            w = self.weights
+            # unweighted data (the common case: weight column absent) needs
+            # no 4 B/row transfer — build the ones on device. The host scan
+            # is ~0.5 ms/1M rows vs ~0.1 s of wire.
+            if w.size and w[0] == 1.0 and np.all(w == 1.0):
+                out = jnp.ones(w.shape[0], jnp.float32)
+            else:
+                out = jnp.asarray(w)
             self._device_cache["weights"] = out
         return out
 
@@ -734,7 +741,7 @@ class RandomEffectDataset:
             sample_uids = np.arange(n, dtype=np.int64)
 
         present = entities >= 0
-        order = np.argsort(entities[present], kind="stable")
+        order = _stable_group_order(entities[present])
         sample_rows = np.flatnonzero(present)[order]  # samples grouped by entity
         ent_sorted = entities[sample_rows]
         # segment boundaries by linear scan — ent_sorted is already sorted,
@@ -813,6 +820,20 @@ class RandomEffectDataset:
             passive_sample_idx=passive,
             passive_entity_ids=entities[passive],
             n_entities_total=n_entities_total, source_data=data)
+
+
+def _stable_group_order(ids: np.ndarray) -> np.ndarray:
+    """Stable argsort of a dense non-negative id column (entity ids are
+    pre-indexed into ``[0, n_entities)`` by ingest) — native O(n) counting
+    sort when available (the numpy stable argsort was ~0.25 s per
+    coordinate build at 1M rows), numpy fallback."""
+    from photon_ml_tpu import native
+
+    if native.available():
+        out = native.counting_sort(ids)
+        if out is not None:
+            return out
+    return np.argsort(ids, kind="stable")
 
 
 def _padded_shapes(n_samp_per_entity: np.ndarray, n_feat_per_entity: np.ndarray,

@@ -325,46 +325,44 @@ class AvroDataReader:
                 index_maps[cfg.shard_id] = build_index_map(
                     keep, add_intercept=cfg.has_intercept)
 
-        # flat nnz across files with global key ids and row offsets
-        rows_parts, keys_parts, vals_parts = [], [], []
-        row0 = 0
-        for d, remap in zip(decoded, file_key_remap):
-            counts = np.diff(d.feat_indptr)
-            rows_parts.append(
-                np.repeat(np.arange(d.n_records, dtype=np.int64) + row0,
-                          counts))
-            keys_parts.append(d.feat_key_id if remap is None
-                              else remap[d.feat_key_id])
-            vals_parts.append(d.feat_val)
-            row0 += d.n_records
-        all_rows = np.concatenate(rows_parts) if rows_parts else \
-            np.zeros(0, np.int64)
-        all_keys_id = np.concatenate(keys_parts) if keys_parts else \
-            np.zeros(0, np.int64)
-        all_vals = np.concatenate(vals_parts) if vals_parts else \
-            np.zeros(0, np.float64)
-
+        # per-shard CSR assembly: one native count+fill pass per (shard,
+        # file) replaces the flat remap/mask/gather numpy pipeline (~1 s at
+        # 1M records); record order is preserved by construction so no sort
+        # or from_coo monotonicity pass is needed
         shards = {}
         for cfg in self.shard_configs:
             imap = index_maps[cfg.shard_id]
-            key_to_col = np.full(len(global_keys), -1, np.int64)
+            key_to_col = np.full(len(global_keys), -1, np.int32)
             for j, k in enumerate(global_keys):
                 col = imap.key_to_index.get(k)
                 if col is not None:
                     key_to_col[j] = col
-            cols = key_to_col[all_keys_id]
-            sel = cols >= 0
-            rows = all_rows[sel]
-            scols = cols[sel]
-            svals = all_vals[sel]
-            if cfg.has_intercept:
-                icol = imap.key_to_index[INTERCEPT_KEY]
-                rows = np.concatenate([rows, np.arange(n, dtype=np.int64)])
-                scols = np.concatenate([scols, np.full(n, icol, np.int64)])
-                svals = np.concatenate([svals, np.ones(n)])
-            shards[cfg.shard_id] = FeatureShard.from_coo(
-                rows, scols.astype(np.int32), svals.astype(np.float32),
-                n, len(imap))
+            icol = (imap.key_to_index[INTERCEPT_KEY] if cfg.has_intercept
+                    else -1)
+            indptr_parts, cols_parts, vals_parts = [], [], []
+            for d, remap in zip(decoded, file_key_remap):
+                k2c = (key_to_col if remap is None
+                       else np.ascontiguousarray(key_to_col[remap]))
+                split = native.shard_split(
+                    d.feat_indptr, d.feat_key_id, d.feat_val, k2c, icol)
+                if split is None:  # library vanished mid-run
+                    return None
+                indptr_parts.append(split[0])
+                cols_parts.append(split[1])
+                vals_parts.append(split[2])
+            if len(indptr_parts) == 1:
+                indptr, cols, vals = indptr_parts[0], cols_parts[0], \
+                    vals_parts[0]
+            else:
+                nnz0 = np.cumsum([0] + [int(p[-1]) for p in indptr_parts])
+                indptr = np.concatenate(
+                    [indptr_parts[0]]
+                    + [p[1:] + off for p, off
+                       in zip(indptr_parts[1:], nnz0[1:-1])])
+                cols = np.concatenate(cols_parts)
+                vals = np.concatenate(vals_parts)
+            shards[cfg.shard_id] = FeatureShard(
+                indptr=indptr, cols=cols, vals=vals, dim=len(imap))
 
         # merge id columns across files through the (possibly frozen) vocab
         vocabs: dict[str, dict[str, int]] = {

@@ -85,75 +85,93 @@ def _load() -> Optional[ctypes.CDLL]:
         except OSError:
             _load_failed = True
             return None
-        lib.photon_decode_blocks.restype = ctypes.c_void_p
-        lib.photon_decode_blocks.argtypes = [
-            ctypes.c_char_p, ctypes.c_int64, ctypes.c_char_p, ctypes.c_int,
-            ctypes.POINTER(ctypes.c_int), ctypes.c_char_p, ctypes.c_char_p]
-        lib.photon_result_error.restype = ctypes.c_char_p
-        lib.photon_result_error.argtypes = [ctypes.c_void_p]
-        for name, res in (("n_records", ctypes.c_int64),
-                          ("nnz", ctypes.c_int64),
-                          ("n_feature_keys", ctypes.c_int32),
-                          ("feature_bytes_len", ctypes.c_int64)):
-            fn = getattr(lib, f"photon_result_{name}")
-            fn.restype = res
-            fn.argtypes = [ctypes.c_void_p]
-        lib.photon_result_copy_core.argtypes = [ctypes.c_void_p] + \
-            [np.ctypeslib.ndpointer(dtype=d, flags="C_CONTIGUOUS")
-             for d in (np.float64, np.float64, np.float64, np.int64,
-                       np.int32, np.float64)]
-        lib.photon_result_copy_feature_keys.argtypes = [
-            ctypes.c_void_p, ctypes.c_char_p,
-            np.ctypeslib.ndpointer(dtype=np.int64, flags="C_CONTIGUOUS")]
-        lib.photon_result_id_vocab_size.restype = ctypes.c_int32
-        lib.photon_result_id_vocab_size.argtypes = [ctypes.c_void_p,
-                                                    ctypes.c_int32]
-        lib.photon_result_id_vocab_bytes_len.restype = ctypes.c_int64
-        lib.photon_result_id_vocab_bytes_len.argtypes = [ctypes.c_void_p,
-                                                         ctypes.c_int32]
-        lib.photon_result_copy_id_col.argtypes = [
-            ctypes.c_void_p, ctypes.c_int32,
-            np.ctypeslib.ndpointer(dtype=np.int32, flags="C_CONTIGUOUS"),
-            ctypes.c_char_p,
-            np.ctypeslib.ndpointer(dtype=np.int64, flags="C_CONTIGUOUS")]
-        lib.photon_result_free.argtypes = [ctypes.c_void_p]
-        _i64p = np.ctypeslib.ndpointer(dtype=np.int64, flags="C_CONTIGUOUS")
-        _i32p = np.ctypeslib.ndpointer(dtype=np.int32, flags="C_CONTIGUOUS")
-        _f32p = np.ctypeslib.ndpointer(dtype=np.float32, flags="C_CONTIGUOUS")
-        lib.photon_re_feature_counts.restype = None
-        lib.photon_re_feature_counts.argtypes = [
-            _i64p, _i32p, _i64p, _i64p,
-            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
-            _i64p, _i64p, _i64p]
-        lib.photon_re_bucket_fill.restype = None
-        lib.photon_re_bucket_fill.argtypes = [
-            _i64p, _i32p, _f32p, _i64p, _i64p, _f32p, _f32p, _i64p,
-            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
-            ctypes.c_int64, _i64p, _i64p, _i64p, _i64p,
-            _f32p, _f32p, _f32p, _i64p, _i64p]
-        lib.photon_re_bucket_indices.restype = None
-        lib.photon_re_bucket_indices.argtypes = [
-            _i64p, _i32p, _i64p, _i64p, _i64p,
-            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
-            _i64p, _i64p, _i64p, _i64p]
-        lib.photon_write_scoring_results.restype = ctypes.c_int64
-        lib.photon_write_scoring_results.argtypes = [
-            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64,
-            np.ctypeslib.ndpointer(dtype=np.float64, flags="C_CONTIGUOUS"),
-            ctypes.c_void_p,  # labels (f64*) or NULL
-            ctypes.c_char_p,  # uid bytes or NULL
-            ctypes.c_void_p,  # uid offsets (i64*) or NULL
-            ctypes.c_int64, ctypes.c_int64]
-        _f64p = np.ctypeslib.ndpointer(dtype=np.float64, flags="C_CONTIGUOUS")
-        lib.photon_write_re_models.restype = ctypes.c_int64
-        lib.photon_write_re_models.argtypes = [
-            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64,
-            ctypes.c_int64, ctypes.c_char_p, _i64p,
-            ctypes.c_char_p, ctypes.c_int64,
-            _i64p, _i32p, _f64p,
-            ctypes.c_void_p,  # variances (f64*) or NULL
-            ctypes.c_char_p, _i64p, ctypes.c_char_p, _i64p,
-            ctypes.c_int64]
+        try:
+            lib.photon_decode_blocks.restype = ctypes.c_void_p
+            lib.photon_decode_blocks.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64, ctypes.c_char_p, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_int), ctypes.c_char_p, ctypes.c_char_p]
+            lib.photon_result_error.restype = ctypes.c_char_p
+            lib.photon_result_error.argtypes = [ctypes.c_void_p]
+            for name, res in (("n_records", ctypes.c_int64),
+                              ("nnz", ctypes.c_int64),
+                              ("n_feature_keys", ctypes.c_int32),
+                              ("feature_bytes_len", ctypes.c_int64)):
+                fn = getattr(lib, f"photon_result_{name}")
+                fn.restype = res
+                fn.argtypes = [ctypes.c_void_p]
+            lib.photon_result_copy_core.argtypes = [ctypes.c_void_p] + \
+                [np.ctypeslib.ndpointer(dtype=d, flags="C_CONTIGUOUS")
+                 for d in (np.float64, np.float64, np.float64, np.int64,
+                           np.int32, np.float64)]
+            lib.photon_result_copy_feature_keys.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p,
+                np.ctypeslib.ndpointer(dtype=np.int64, flags="C_CONTIGUOUS")]
+            lib.photon_result_id_vocab_size.restype = ctypes.c_int32
+            lib.photon_result_id_vocab_size.argtypes = [ctypes.c_void_p,
+                                                        ctypes.c_int32]
+            lib.photon_result_id_vocab_bytes_len.restype = ctypes.c_int64
+            lib.photon_result_id_vocab_bytes_len.argtypes = [ctypes.c_void_p,
+                                                             ctypes.c_int32]
+            lib.photon_result_copy_id_col.argtypes = [
+                ctypes.c_void_p, ctypes.c_int32,
+                np.ctypeslib.ndpointer(dtype=np.int32, flags="C_CONTIGUOUS"),
+                ctypes.c_char_p,
+                np.ctypeslib.ndpointer(dtype=np.int64, flags="C_CONTIGUOUS")]
+            lib.photon_result_free.argtypes = [ctypes.c_void_p]
+            _i64p = np.ctypeslib.ndpointer(dtype=np.int64, flags="C_CONTIGUOUS")
+            _i32p = np.ctypeslib.ndpointer(dtype=np.int32, flags="C_CONTIGUOUS")
+            _f32p = np.ctypeslib.ndpointer(dtype=np.float32, flags="C_CONTIGUOUS")
+            _f64p = np.ctypeslib.ndpointer(dtype=np.float64, flags="C_CONTIGUOUS")
+            lib.photon_shard_split_count.restype = None
+            lib.photon_shard_split_count.argtypes = [
+                _i64p, _i32p, ctypes.c_int64, _i32p, ctypes.c_int32, _i64p]
+            lib.photon_shard_split_fill.restype = None
+            lib.photon_shard_split_fill.argtypes = [
+                _i64p, _i32p, _f64p, ctypes.c_int64, _i32p, ctypes.c_int32,
+                _i64p, _i32p, _f32p]
+            lib.photon_counting_sort.restype = None
+            lib.photon_counting_sort.argtypes = [
+                _i64p, ctypes.c_int64, _i64p, _i64p]
+            lib.photon_re_feature_counts.restype = None
+            lib.photon_re_feature_counts.argtypes = [
+                _i64p, _i32p, _i64p, _i64p,
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                _i64p, _i64p, _i64p]
+            lib.photon_re_bucket_fill.restype = None
+            lib.photon_re_bucket_fill.argtypes = [
+                _i64p, _i32p, _f32p, _i64p, _i64p, _f32p, _f32p, _i64p,
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_int64, _i64p, _i64p, _i64p, _i64p,
+                _f32p, _f32p, _f32p, _i64p, _i64p]
+            lib.photon_re_bucket_indices.restype = None
+            lib.photon_re_bucket_indices.argtypes = [
+                _i64p, _i32p, _i64p, _i64p, _i64p,
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                _i64p, _i64p, _i64p, _i64p]
+            lib.photon_write_scoring_results.restype = ctypes.c_int64
+            lib.photon_write_scoring_results.argtypes = [
+                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64,
+                np.ctypeslib.ndpointer(dtype=np.float64, flags="C_CONTIGUOUS"),
+                ctypes.c_void_p,  # labels (f64*) or NULL
+                ctypes.c_char_p,  # uid bytes or NULL
+                ctypes.c_void_p,  # uid offsets (i64*) or NULL
+                ctypes.c_int64, ctypes.c_int64]
+            _f64p = np.ctypeslib.ndpointer(dtype=np.float64, flags="C_CONTIGUOUS")
+            lib.photon_write_re_models.restype = ctypes.c_int64
+            lib.photon_write_re_models.argtypes = [
+                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64,
+                ctypes.c_int64, ctypes.c_char_p, _i64p,
+                ctypes.c_char_p, ctypes.c_int64,
+                _i64p, _i32p, _f64p,
+                ctypes.c_void_p,  # variances (f64*) or NULL
+                ctypes.c_char_p, _i64p, ctypes.c_char_p, _i64p,
+                ctypes.c_int64]
+        except AttributeError:
+            # a stale prebuilt library (sources absent, no
+            # rebuild possible) missing newer symbols must
+            # degrade to the pure-Python fallback, never raise
+            _load_failed = True
+            return None
         _lib = lib
         return _lib
 
@@ -531,3 +549,48 @@ def re_bucket_indices(indptr, cols, all_active, ent_starts, sel,
         -1 if max_active_features is None else int(max_active_features),
         scratch.stamp_b, scratch.support, sample_idx, feature_index)
     return sample_idx, feature_index
+
+
+def shard_split(feat_indptr, feat_key_id, feat_val, key_to_col,
+                intercept_col: int):
+    """CSR split of one decoded file's flat feature stream into one shard
+    (``avro_reader.cc::photon_shard_split_{count,fill}``): record order
+    preserved, values cast to f32 in-pass, optional per-record intercept
+    entry appended. Replaces the numpy remap/mask/gather assembly (~1 s on
+    a 1M-record file). Returns ``(indptr, cols, vals)`` or None when the
+    library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(feat_indptr) - 1
+    counts = np.empty(n, np.int64)
+    lib.photon_shard_split_count(feat_indptr, feat_key_id, n, key_to_col,
+                                 intercept_col, counts)
+    indptr = np.zeros(n + 1, np.int64)
+    np.cumsum(counts, out=indptr[1:])
+    nnz = int(indptr[-1]) if n else 0
+    cols = np.empty(nnz, np.int32)
+    vals = np.empty(nnz, np.float32)
+    lib.photon_shard_split_fill(feat_indptr, feat_key_id, feat_val, n,
+                                key_to_col, intercept_col, indptr, cols,
+                                vals)
+    return indptr, cols, vals
+
+
+def counting_sort(ids: np.ndarray) -> Optional[np.ndarray]:
+    """Stable group-order of dense non-negative int ids — the native O(n)
+    counting sort (``bucket_pack.cc::photon_counting_sort``). Returns the
+    same permutation as ``np.argsort(ids, kind="stable")``; None when the
+    library is unavailable (caller falls back)."""
+    lib = _load()
+    if lib is None:
+        return None
+    ids = np.ascontiguousarray(ids, np.int64)
+    if ids.size == 0:
+        return np.zeros(0, np.int64)
+    cnt = np.bincount(ids)
+    cursors = np.zeros(len(cnt), np.int64)
+    np.cumsum(cnt[:-1], out=cursors[1:])
+    order = np.empty(ids.size, np.int64)
+    lib.photon_counting_sort(ids, ids.size, cursors, order)
+    return order
