@@ -217,12 +217,6 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
         coordinate_configs = dict(parse_coordinate_config(s)
                                   for s in args.coordinates)
         if args.design_dtype != "float32":
-            if multiproc or (mesh is not None and args.mesh):
-                # the sharded fixed-effect feeds are f32 end to end
-                # (budget-reconciled global layout); mirror train_glm's gate
-                raise SystemExit("--design-dtype bfloat16 is not supported "
-                                 "with --mesh or multi-process --multihost "
-                                 "training (the sharded feed is float32)")
             import dataclasses as _dc
 
             coordinate_configs = {

@@ -229,7 +229,6 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
     if multiproc:
         bad = [msg for flag, msg in (
             (args.training_diagnostics, "--training-diagnostics"),
-            (args.design_dtype == "bfloat16", "--design-dtype bfloat16"),
             (args.sweep_mode == "batched", "--sweep-mode batched (vmap "
              "over the lambda axis does not compose with the multi-process "
              "mesh yet)"),
@@ -328,9 +327,16 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
             )
 
             fe_mesh = make_multihost_mesh()
+            from photon_ml_tpu.game.data import cast_dense_design
+
+            # the budget-reconciled feed preserves leaf dtypes, so the
+            # bf16 cast here rides the wire at 2 bytes on every process
+            # (same flag everywhere -> symmetric layout)
             host = GLMData(
-                design=host_design_for_shard(shard,
-                                             dense_max_dim=DENSE_MAX_DIM),
+                design=cast_dense_design(
+                    host_design_for_shard(shard,
+                                          dense_max_dim=DENSE_MAX_DIM),
+                    design_dtype),
                 labels=data.labels,
                 offsets=data.offsets,
                 weights=data.weights)

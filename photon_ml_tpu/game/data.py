@@ -282,7 +282,8 @@ def _densify_csr(counts, cols, vals, *, n: int, d: int, nnz: int):
 
 
 def choose_dense_design(shard: FeatureShard, *, n_shards: int = 1,
-                        dense_max_dim: Optional[int] = None) -> bool:
+                        dense_max_dim: Optional[int] = None,
+                        itemsize: int = 4) -> bool:
     """Dense vs chunked-sparse layout pick for a fixed-effect design —
     the measured crossover rule (VERDICT r2 item 4, SURVEY.md §7
     hard-part #2). With ``dense_max_dim`` given, the old hard threshold
@@ -315,25 +316,31 @@ def choose_dense_design(shard: FeatureShard, *, n_shards: int = 1,
     """
     return choose_dense_design_stats(shard.n_samples, shard.dim, shard.nnz,
                                      n_shards=n_shards,
-                                     dense_max_dim=dense_max_dim)
+                                     dense_max_dim=dense_max_dim,
+                                     itemsize=itemsize)
 
 
 def choose_dense_design_stats(n_samples: int, dim: int, nnz: int, *,
                               n_shards: int = 1,
                               dense_max_dim: Optional[int] = None,
-                              n_local_samples: Optional[int] = None) -> bool:
+                              n_local_samples: Optional[int] = None,
+                              itemsize: int = 4) -> bool:
     """The rule of :func:`choose_dense_design` on explicit statistics —
     multi-process training calls this with GLOBALLY allreduced (n, nnz) so
     every process picks the same layout (an SPMD program must agree).
     ``n_local_samples`` bounds the HOST materialization (the build holds
     the full local (n, d) float32 array before the device split); defaults
-    to ``n_samples`` (single-process: local = global)."""
+    to ``n_samples`` (single-process: local = global). ``itemsize`` is the
+    DEVICE storage width (2 under --design-dtype bfloat16, letting designs
+    that fit dense only at 2 bytes still take the dense path); the host
+    cap stays at 4 bytes — the build materializes f32 before the cast."""
     if dense_max_dim is not None:
         return dim <= dense_max_dim
     n_local = n_samples if n_local_samples is None else n_local_samples
     if n_local * dim * 4 > DENSE_DESIGN_MAX_HOST_BYTES:
         return False
-    if n_samples * dim * 4 // max(n_shards, 1) > DENSE_DESIGN_MAX_BYTES:
+    if n_samples * dim * itemsize // max(n_shards, 1) \
+            > DENSE_DESIGN_MAX_BYTES:
         return False
     if dim <= DENSE_DESIGN_MAX_DIM:
         return True
@@ -343,14 +350,16 @@ def choose_dense_design_stats(n_samples: int, dim: int, nnz: int, *,
 def host_design_for_shard(shard: FeatureShard, *,
                           dense_max_dim: Optional[int] = None,
                           n_shards: int = 1,
-                          force_dense: Optional[bool] = None):
+                          force_dense: Optional[bool] = None,
+                          itemsize: int = 4):
     """Host-resident design for a fixed-effect shard, laid out per
     :func:`choose_dense_design`. The single home of the dense/sparse
     cutover — the single- and multi-process feeds must agree
     (``force_dense`` carries a decision already agreed across processes)."""
     dense = (force_dense if force_dense is not None
              else choose_dense_design(shard, n_shards=n_shards,
-                                      dense_max_dim=dense_max_dim))
+                                      dense_max_dim=dense_max_dim,
+                                      itemsize=itemsize))
     if dense:
         return DenseDesign(x=shard.to_dense())
     return CsrDesign(
@@ -358,6 +367,28 @@ def host_design_for_shard(shard: FeatureShard, *,
         cols=shard.cols.astype(np.int32),
         values=shard.vals,
         n_rows=shard.n_samples, n_cols=shard.dim)
+
+
+def design_dtype_of(dtype) -> "jnp.dtype":
+    """Normalize a design-dtype spec — the CLI strings ("float32" /
+    "bfloat16") or any dtype-like — to a jnp dtype. The single home of
+    the string→dtype mapping."""
+    if isinstance(dtype, str):
+        dtype = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+    return jnp.dtype(dtype)
+
+
+def cast_dense_design(host_design, dtype):
+    """Host-side dtype cast of a DENSE host design: the sharded feeds
+    (:func:`~photon_ml_tpu.parallel.distributed.shard_glm_data`, the
+    multihost global feed) preserve leaf dtypes, so casting here puts the
+    design on the wire and in HBM at 2 bytes under bfloat16. Sparse
+    layouts keep f32 values — bf16 is the dense-path trade (same policy
+    as train_glm's ``_to_glm_data``). ``dtype`` may be the CLI string."""
+    dtype = design_dtype_of(dtype)
+    if dtype != jnp.float32 and isinstance(host_design, DenseDesign):
+        return DenseDesign(x=np.asarray(host_design.x).astype(dtype))
+    return host_design
 
 
 @dataclasses.dataclass(frozen=True)
@@ -394,16 +425,11 @@ class FixedEffectDataset:
         n_shards = 1
         if mesh is not None and DATA_AXIS in getattr(mesh, "shape", {}):
             n_shards = int(mesh.shape[DATA_AXIS])
-        if n_shards > 1 and dtype != jnp.float32:
-            # the data-sharded feed is f32 end to end; silently building
-            # f32 under a bf16 request would fake the promised speedup
-            raise ValueError(
-                "design dtype overrides are not supported on the "
-                "data-sharded mesh path (the stacked feed is float32); "
-                "drop --design-dtype or the data-axis mesh")
+        itemsize = design_dtype_of(dtype).itemsize
         if (n_shards == 1
                 and choose_dense_design(shard, n_shards=1,
-                                        dense_max_dim=dense_max_dim)):
+                                        dense_max_dim=dense_max_dim,
+                                        itemsize=itemsize)):
             # single-chip dense: materialize the design ON DEVICE from the
             # compact CSR upload — skips both the host densify and the
             # (n, d, 4)-byte wire transfer (the wire is ~35 MB/s here);
@@ -423,7 +449,9 @@ class FixedEffectDataset:
         # and device_puts per-shard blocks directly — never materializing
         # the full design in one device's HBM (the whole point of dp)
         host_design = host_design_for_shard(
-            shard, dense_max_dim=dense_max_dim, n_shards=n_shards)
+            shard, dense_max_dim=dense_max_dim, n_shards=n_shards,
+            itemsize=itemsize)
+        host_design = cast_dense_design(host_design, dtype)
         if n_shards > 1:
             from photon_ml_tpu.parallel.distributed import shard_glm_data
 

@@ -370,8 +370,13 @@ class MultiProcessFixedEffectDataset:
     def build(coordinate_id: str, game_owned: GameData,
               feature_shard_id: str, mesh,
               *, dense_max_dim: Optional[int] = None,
+              design_dtype: str = "float32",
               ) -> "MultiProcessFixedEffectDataset":
-        from photon_ml_tpu.game.data import choose_dense_design_stats
+        from photon_ml_tpu.game.data import (
+            cast_dense_design,
+            choose_dense_design_stats,
+            design_dtype_of,
+        )
         from photon_ml_tpu.parallel.mesh import DATA_AXIS
         from photon_ml_tpu.parallel.multihost import (
             allreduce_max,
@@ -390,8 +395,12 @@ class MultiProcessFixedEffectDataset:
         dense = choose_dense_design_stats(
             int(g[0]), shard.dim, int(g[1]),
             n_shards=int(mesh.shape[DATA_AXIS]), dense_max_dim=dense_max_dim,
-            n_local_samples=n_loc)
+            n_local_samples=n_loc,
+            itemsize=design_dtype_of(design_dtype).itemsize)
         host_design = host_design_for_shard(shard, force_dense=dense)
+        # every process runs the same CLI flags, so the dtype decision is
+        # symmetric; the budget reconciliation below is dtype-independent
+        host_design = cast_dense_design(host_design, design_dtype)
         local = GLMData(design=host_design, labels=game_owned.labels,
                         offsets=np.zeros(shard.n_samples, np.float32),
                         weights=game_owned.weights)
@@ -899,7 +908,8 @@ def train_game_multiprocess(
             # (downsamplers are supported: the per-sweep draw is the keyed
             # per-global-row-id hash, identical under any row partition)
             fe_datasets[cid] = MultiProcessFixedEffectDataset.build(
-                cid, game_primary, cfg.feature_shard_id, fe_mesh)
+                cid, game_primary, cfg.feature_shard_id, fe_mesh,
+                design_dtype=cfg.design_dtype)
         elif isinstance(cfg, (RandomEffectCoordinateConfig,
                               FactoredRandomEffectCoordinateConfig)):
             t = cfg.dataset.random_effect_type
@@ -1122,7 +1132,9 @@ def train_game_multiprocess(
                     coord = RandomEffectCoordinate(
                         coordinate_id=cid, dataset=plan.dataset,
                         data=plan.game, task=task, config=plan.optimization,
-                        lam=lam.get(cid, 0.0), mesh=re_mesh)
+                        lam=lam.get(cid, 0.0), mesh=re_mesh,
+                        design_dtype=getattr(coordinate_configs[cid],
+                                             "design_dtype", "float32"))
                     model_c, scores_c = coord.train(
                         res_c, re_local_models.get(cid), sweep=sweep)
                 else:

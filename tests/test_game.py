@@ -400,6 +400,63 @@ class TestGameEstimator:
             r1.model.coordinates["global"].model.coefficients.means)
         np.testing.assert_allclose(fe1, fe0, atol=2e-3)
 
+    def test_bf16_designs_on_mesh_match_unsharded_bf16(self):
+        """bfloat16 designs through the DATA-SHARDED feed (shard_glm_data
+        preserves the bf16 leaves; the psum'd compiled solver consumes
+        them) must match the single-device bf16 fit — the sharded half of
+        the --design-dtype story."""
+        import dataclasses as dc
+
+        import jax
+
+        from photon_ml_tpu.parallel.mesh import (
+            DATA_AXIS,
+            ENTITY_AXIS,
+            make_mesh,
+        )
+
+        data, _ = make_mixed_data(n=800, n_entities=11)
+        cfg = GLMOptimizationConfiguration(regularization=L2Regularization)
+        coords = {
+            "global": FixedEffectCoordinateConfig(
+                feature_shard_id="fixed", optimization=cfg,
+                design_dtype="bfloat16"),
+            "perEntity": RandomEffectCoordinateConfig(
+                dataset=RandomEffectDatasetConfig("entityId", "re"),
+                optimization=cfg, design_dtype="bfloat16"),
+        }
+        grid = [GameOptimizationConfiguration(
+            {"global": 0.01, "perEntity": 1.0})]
+
+        def fit(mesh):
+            return GameEstimator(
+                task=TaskType.LOGISTIC_REGRESSION,
+                coordinate_configs=coords,
+                update_sequence=["global", "perEntity"],
+                n_cd_iterations=1, mesh=mesh).fit(data, grid)[0]
+
+        r0 = fit(None)
+        mesh = make_mesh({DATA_AXIS: 4, ENTITY_AXIS: 2},
+                         devices=jax.devices())
+        r1 = fit(mesh)
+        # identical arithmetic up to psum reassociation (bf16 designs both
+        # sides; accumulation is f32)
+        np.testing.assert_allclose(r1.model.score(data),
+                                   r0.model.score(data), atol=5e-3)
+        fe0 = np.asarray(
+            r0.model.coordinates["global"].model.coefficients.means)
+        fe1 = np.asarray(
+            r1.model.coordinates["global"].model.coefficients.means)
+        np.testing.assert_allclose(fe1, fe0, atol=5e-3)
+        # the sharded design blocks must actually BE bf16 (no silent f32)
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.game.data import FixedEffectDataset
+
+        fe = FixedEffectDataset.build("global", data, "fixed", mesh=mesh,
+                                      dtype=jnp.bfloat16)
+        assert fe.design.x.dtype == jnp.bfloat16
+
 
 def make_music_data(n=4000, d_global=6, d_item=3, n_users=25, n_songs=15,
                     n_artists=8, seed=0, param_seed=424242):

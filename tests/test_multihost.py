@@ -421,14 +421,19 @@ print(f"MULTIPROC_DRIVER_OK {pid}", flush=True)
 
 
 @pytest.mark.slow
-@pytest.mark.parametrize("global_spec", [
-    "global=fixed,shard=global,reg=L2",
+@pytest.mark.parametrize("global_spec,extra_argv", [
+    ("global=fixed,shard=global,reg=L2", []),
     # downsample on the fixed effect: the keyed per-global-row-id draw
     # must sample the SAME rows through the per-process file shares
     # (contiguous size-balanced runs) as the single-process read
-    "global=fixed,shard=global,reg=L2,downsample=0.85",
-], ids=["plain", "downsampled"])
-def test_two_process_train_game_driver(tmp_path, global_spec):
+    ("global=fixed,shard=global,reg=L2,downsample=0.85", []),
+    # bf16 designs through the multi-process budget-reconciled feed (and
+    # the process-local RE solves) — compared against a single-process
+    # bf16 run of the same driver
+    ("global=fixed,shard=global,reg=L2",
+     ["--design-dtype", "bfloat16"]),
+], ids=["plain", "downsampled", "bf16"])
+def test_two_process_train_game_driver(tmp_path, global_spec, extra_argv):
     """The FULL train_game driver across two real processes: per-process
     file reads, global feature-index/vocabulary agreement, entity-
     partitioned training, chief-gated model write — and the validation AUC
@@ -452,7 +457,7 @@ def test_two_process_train_game_driver(tmp_path, global_spec):
         "--update-sequence", "global,perUser",
         "--grid", "global=0.01", "perUser=1",
         "--evaluators", "AUC",
-    ]
+    ] + extra_argv
     base = train_game_cli.run(
         argv_common + ["--output-dir", str(tmp_path / "out-sp")])
     base_auc = base["best_evaluation"]["AUC"]
@@ -614,12 +619,14 @@ print(f"MULTIPROC_GLM_OK {pid}", flush=True)
 
 
 @pytest.mark.slow
-def test_two_process_train_glm_driver(tmp_path):
+@pytest.mark.parametrize("design_dtype", ["float32", "bfloat16"])
+def test_two_process_train_glm_driver(tmp_path, design_dtype):
     """The legacy GLM driver across two real processes: per-process file
     reads, global feature-index and summary-statistics agreement (the
     normalization context is part of the objective, so it must be identical
     everywhere), one psum'd warm-started lambda sweep — equal to the
-    single-process run."""
+    single-process run. The bf16 case drives the bf16-design leaves
+    through the budget-reconciled global feed."""
     import json
 
     from photon_ml_tpu.cli import train_glm as train_glm_cli
@@ -641,6 +648,7 @@ def test_two_process_train_glm_driver(tmp_path):
         # TIE across lambdas — L2 shrinkage roughly preserves rankings —
         # and a tie's winner would flip on psum summation order)
         "--evaluators", "LOGISTIC_LOSS,AUC",
+        "--design-dtype", design_dtype,
     ]
     base = train_glm_cli.run(
         argv_common + ["--output-dir", str(tmp_path / "glm-sp")])
