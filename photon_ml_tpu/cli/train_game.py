@@ -219,6 +219,16 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
         if args.design_dtype != "float32":
             import dataclasses as _dc
 
+            if any(isinstance(c, FactoredRandomEffectCoordinateConfig)
+                   for c in coordinate_configs.values()):
+                # factored coordinates solve in the RANDOM-projected space
+                # and keep f32 designs; silently training them f32 under a
+                # bf16 request would fake the promised speedup
+                raise SystemExit(
+                    "--design-dtype bfloat16 does not apply to factored "
+                    "random-effect coordinates (their projected designs "
+                    "are float32); drop the flag or the factored "
+                    "coordinate")
             coordinate_configs = {
                 cid: (_dc.replace(c, design_dtype=args.design_dtype)
                       if isinstance(c, (FixedEffectCoordinateConfig,

@@ -159,7 +159,10 @@ class GameEstimator:
         labels / weights while the host packs buckets. Without this the
         wire only starts when the first solve asks for the image — fully
         serialized after the builds."""
-        from photon_ml_tpu.game.data import choose_dense_design
+        from photon_ml_tpu.game.data import (
+            choose_dense_design,
+            design_dtype_of,
+        )
         from photon_ml_tpu.game.projector import ProjectorType
 
         if self.mesh is not None:
@@ -182,10 +185,12 @@ class GameEstimator:
             if (sid, dt) in seen:
                 continue
             seen.add((sid, dt))
-            if choose_dense_design(data.shards[sid], n_shards=1):
-                data.device_dense_shard(
-                    sid, dtype=(jnp.bfloat16 if dt == "bfloat16"
-                                else jnp.float32))
+            dtype = design_dtype_of(dt)
+            # same itemsize-aware rule as FixedEffectDataset.build — a
+            # mismatch would skip the prefetch exactly when it matters
+            if choose_dense_design(data.shards[sid], n_shards=1,
+                                   itemsize=dtype.itemsize):
+                data.device_dense_shard(sid, dtype=dtype)
             data.device_labels()
             data.device_weights()
 

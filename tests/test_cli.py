@@ -460,6 +460,24 @@ class TestTrainGameDriver:
         ])
         assert r["best_evaluation"]["AUC"] > 0.6
 
+    def test_factored_refuses_bf16_designs(self, tmp_path):
+        """--design-dtype bfloat16 with a factored coordinate fails loudly
+        (its projected designs are f32 — silent f32 would fake the
+        speedup)."""
+        train = make_avro_dataset(tmp_path / "train.avro", n=200, seed=0)
+        with pytest.raises(SystemExit, match="factored"):
+            train_game_cli.run([
+                "--training-data", train,
+                "--output-dir", str(tmp_path / "o"),
+                "--feature-shards", SHARDS,
+                "--coordinates", COORDS[0],
+                "perUser=factored,entity=userId,shard=user,projectedDim=2,"
+                "factoredIterations=1,reg=L2",
+                "--update-sequence", "global,perUser",
+                "--grid", "global=0.1", "perUser=1",
+                "--design-dtype", "bfloat16",
+            ])
+
     def test_mesh_flag_trains_sharded(self, tmp_path):
         """--mesh data=4,entity=2 runs the dp x ep estimator path."""
         from photon_ml_tpu.cli.train_game import parse_mesh
