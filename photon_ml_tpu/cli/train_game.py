@@ -196,8 +196,11 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
     multiproc = args.multihost and jax.process_count() > 1
     if multiproc and args.mesh:
         raise SystemExit(
-            "multi-process --multihost training does not support --mesh "
-            "(the multi-process path builds its own global data mesh)")
+            "multi-process --multihost training does not take --mesh: the "
+            "global data mesh is built automatically, the entity axis is "
+            "subsumed by the entity->process partition, and TP-across-"
+            "processes has no photon-scale workload — see PARALLELISM.md "
+            "\"Why --mesh is refused at >1 process\" for the full rationale")
     # fail fast on a bad mesh spec / device-count mismatch, BEFORE the
     # (potentially long) Avro reads
     mesh = parse_mesh(args.mesh)
