@@ -61,6 +61,17 @@ DENSE_DESIGN_MAX_BYTES = 4 << 30
 #: (n, d) float32 array in host RAM before any device split, so the
 #: per-device cap alone would let an 8-shard build allocate 8x it on host.
 DENSE_DESIGN_MAX_HOST_BYTES = 8 << 30
+#: cap on a random-effect coordinate's device-RESIDENT fat bucket tensors
+#: (f32 estimate: x (E,S,D) + labels/weights/gather/scatter (E,S) each);
+#: past it the build degrades to upload-and-drop streaming instead of
+#: OOMing. 6 GiB of a v5e's 16 GiB HBM: the sweep also holds the shared
+#: dense shard image (≤4 GiB by its own cap), score vectors and solver
+#: temporaries. Measured (tools/re_scaling_probe.py, power-law entities,
+#: dim 8, 5 histogram buckets): 10M rows ≈ 1.9 GiB fat, 30M rows ≈ 8.3 GiB
+#: — so the cap admits ~20M resident rows per chip at dim 8 and trips
+#: beyond, where entity sharding (--multihost / --mesh entity=K) is the
+#: intended scale-out.
+RE_FAT_CACHE_MAX_BYTES = 6 << 30
 
 
 @dataclasses.dataclass(frozen=True)
@@ -750,6 +761,7 @@ class RandomEffectDataset:
               projector: Optional[RandomProjector] = None,
               use_native: Optional[bool] = None,
               sample_uids: Optional[np.ndarray] = None,
+              n_entity_shards: int = 1,
               ) -> "RandomEffectDataset":
         """``projector`` overrides the seeded Gaussian matrix for the RANDOM
         path — the factored coordinate passes its LEARNED projection here
@@ -834,6 +846,8 @@ class RandomEffectDataset:
             buckets = _random_projection_buckets(
                 data, shard, all_active, ent_of_active, act_entity,
                 projector, config)
+            config = _guard_fat_cache(coordinate_id, config, buckets,
+                                      n_entity_shards)
             return RandomEffectDataset(
                 coordinate_id=coordinate_id, config=config, buckets=buckets,
                 passive_sample_idx=passive,
@@ -843,11 +857,51 @@ class RandomEffectDataset:
         # --- bucket pack: native single-pass packer when available --------
         buckets = _index_map_buckets(data, shard, all_active, ent_of_active,
                                      act_entity, config, use_native)
+        config = _guard_fat_cache(coordinate_id, config, buckets,
+                                  n_entity_shards)
         return RandomEffectDataset(
             coordinate_id=coordinate_id, config=config, buckets=buckets,
             passive_sample_idx=passive,
             passive_entity_ids=entities[passive],
             n_entities_total=n_entities_total, source_data=data)
+
+
+def resident_fat_bytes(buckets) -> int:
+    """f32 HBM estimate of a coordinate's device-RESIDENT bucket tensors —
+    the :func:`~photon_ml_tpu.game.random_effect._materialize_fat` product:
+    x (E,S,D) + labels/weights/gather-idx/scatter-idx (E,S) each. The
+    single home of the formula (build guard, estimator budget, probe)."""
+    return sum(
+        e * s * d * 4 + 4 * e * s * 4
+        for (e, s, d) in (b.tensor_shape for b in buckets))
+
+
+def _guard_fat_cache(coordinate_id: str, config: "RandomEffectDatasetConfig",
+                     buckets, n_entity_shards: int
+                     ) -> "RandomEffectDatasetConfig":
+    """Memory-cliff guard: device-resident buckets (the fast path) pin
+    EVERY bucket's fat tensors in HBM for the dataset's lifetime. Past the
+    per-DEVICE cap — total fat divided by the entity-mesh width, since an
+    entity axis shards the lanes 1/K per chip — degrade to upload-and-drop
+    streaming (peak HBM = one bucket) instead of OOMing. Measured scaling
+    table in tools/re_scaling_probe.py justifies the threshold.
+    Cross-coordinate accounting lives in GameEstimator.prepare, which sees
+    every coordinate."""
+    if not config.cache_device_buckets:
+        return config
+    fat = resident_fat_bytes(buckets) // max(int(n_entity_shards), 1)
+    if fat <= RE_FAT_CACHE_MAX_BYTES:
+        return config
+    import logging
+
+    logging.getLogger(__name__).warning(
+        "random-effect coordinate %s: device-resident buckets would hold "
+        "%.1f GiB of fat tensors per device (> %.1f GiB cap) — reverting "
+        "to upload-and-drop streaming (peak HBM = one bucket; slower "
+        "sweeps). Shard entities across more processes (--multihost) or "
+        "chips (--mesh entity=K) to regain the resident path.",
+        coordinate_id, fat / 2**30, RE_FAT_CACHE_MAX_BYTES / 2**30)
+    return dataclasses.replace(config, cache_device_buckets=False)
 
 
 def _stable_group_order(ids: np.ndarray) -> np.ndarray:

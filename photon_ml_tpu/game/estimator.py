@@ -194,11 +194,19 @@ class GameEstimator:
             data.device_labels()
             data.device_weights()
 
+    def _entity_shards(self) -> int:
+        if self.mesh is None:
+            return 1
+        from photon_ml_tpu.parallel.mesh import ENTITY_AXIS
+
+        return int(getattr(self.mesh, "shape", {}).get(ENTITY_AXIS, 1))
+
     def prepare(self, data: GameData,
                 locked: Sequence[str] = ()) -> dict[str, object]:
         self._check_sequence(locked)
         self._prefetch_device_feed(data, locked)
         datasets: dict[str, object] = {}
+        ep = self._entity_shards()
         for cid in self.update_sequence:
             if cid in locked:
                 continue  # frozen coordinate: no dataset, no training
@@ -212,14 +220,69 @@ class GameEstimator:
                 # rebuilt each alternation around the learned projection
                 datasets[cid] = None
             else:
-                datasets[cid] = RandomEffectDataset.build(cid, data, cfg.dataset)
+                datasets[cid] = RandomEffectDataset.build(
+                    cid, data, cfg.dataset, n_entity_shards=ep)
                 logger.info(
                     "coordinate %s: %d active entities in %d buckets, "
                     "%d passive rows", cid, datasets[cid].n_active_entities,
                     len(datasets[cid].buckets),
                     len(datasets[cid].passive_sample_idx))
-                self._start_warm_compile(datasets[cid], cfg, data.n_samples)
+        # cross-coordinate residency budget BEFORE warm compiles: the warm
+        # threads must compile the signatures the final (possibly flipped-
+        # to-streaming) datasets will actually solve with
+        self._apply_fat_budget(data, datasets)
+        for cid, ds in datasets.items():
+            if isinstance(ds, RandomEffectDataset):
+                self._start_warm_compile(ds, self.coordinate_configs[cid],
+                                         data.n_samples)
         return datasets
+
+    def _apply_fat_budget(self, data: GameData, datasets) -> None:
+        """Cross-coordinate HBM accounting (the per-build guard can't see
+        it): several coordinates can each pass the per-device fat cap while
+        their SUM exceeds it. Flip the largest offenders to streaming until
+        the total fits, then drop any prefetched dense shard images that no
+        remaining resident consumer will read — a dead multi-GiB pin in the
+        memory-tight regime would defeat the guard's purpose."""
+        from photon_ml_tpu.game.data import (
+            RE_FAT_CACHE_MAX_BYTES,
+            resident_fat_bytes,
+        )
+
+        ep = self._entity_shards()
+        resident = [
+            (cid, ds, resident_fat_bytes(ds.buckets) // ep)
+            for cid, ds in datasets.items()
+            if isinstance(ds, RandomEffectDataset)
+            and ds.config.cache_device_buckets]
+        total = sum(f for _, _, f in resident)
+        for cid, ds, f in sorted(resident, key=lambda t: -t[2]):
+            if total <= RE_FAT_CACHE_MAX_BYTES:
+                break
+            logger.warning(
+                "coordinate %s: flipping to upload-and-drop streaming — "
+                "the coordinates' combined resident fat tensors "
+                "(%.1f GiB/device) exceed the %.1f GiB cap",
+                cid, total / 2**30, RE_FAT_CACHE_MAX_BYTES / 2**30)
+            datasets[cid] = dataclasses.replace(
+                ds, config=dataclasses.replace(
+                    ds.config, cache_device_buckets=False))
+            total -= f
+        # evict dense images with no resident consumer (streaming solvers
+        # never touch the shared image; fixed effects keep theirs)
+        keep = set()
+        for cid, cfg in self.coordinate_configs.items():
+            if isinstance(cfg, FixedEffectCoordinateConfig):
+                keep.add(cfg.feature_shard_id)
+            elif isinstance(cfg, RandomEffectCoordinateConfig):
+                ds = datasets.get(cid)
+                if (isinstance(ds, RandomEffectDataset)
+                        and ds.config.cache_device_buckets):
+                    keep.add(cfg.dataset.feature_shard_id)
+        for key in list(data._device_cache):
+            if (isinstance(key, tuple) and key
+                    and key[0] == "dense_shard" and key[1] not in keep):
+                del data._device_cache[key]
 
     def _start_warm_compile(self, dataset, cfg, n: int) -> None:
         """Kick off the coordinate's bucket-shape compiles in the background

@@ -115,6 +115,29 @@ class TestRandomEffectDataset:
         for b in ds.buckets:
             assert ((b.feature_index >= 0).sum(axis=1) <= 2).all()
 
+    def test_fat_cache_guard_degrades_to_streaming(self, monkeypatch,
+                                                   caplog):
+        """Past RE_FAT_CACHE_MAX_BYTES the build flips to upload-and-drop
+        streaming (peak HBM = one bucket) with a warning, instead of
+        pinning every fat tensor in HBM — the measured memory cliff
+        (tools/re_scaling_probe.py). Training still works."""
+        import logging
+
+        import photon_ml_tpu.game.data as gdata
+
+        data, _ = make_mixed_data(n=500, n_entities=11)
+        monkeypatch.setattr(gdata, "RE_FAT_CACHE_MAX_BYTES", 1024)
+        with caplog.at_level(logging.WARNING):
+            ds = RandomEffectDataset.build(
+                "re", data, RandomEffectDatasetConfig("entityId", "re"))
+        assert not ds.config.cache_device_buckets
+        assert any("upload-and-drop" in r.message for r in caplog.records)
+        # under the cap the resident path stays on
+        monkeypatch.setattr(gdata, "RE_FAT_CACHE_MAX_BYTES", 6 << 30)
+        ds2 = RandomEffectDataset.build(
+            "re", data, RandomEffectDatasetConfig("entityId", "re"))
+        assert ds2.config.cache_device_buckets
+
 
 class TestRandomEffectDatasetScale:
     def test_build_scales_to_many_entities(self):
