@@ -1,0 +1,160 @@
+"""The bench harness's artifact-completeness machinery.
+
+The official scoreboard is the terminal ``suite_summary`` JSON line that
+``bench.py`` prints; two harness runs (rounds 2-3) lost metrics to
+truncation, and a hard-down device tunnel would have lost everything —
+a hung first device call blocks the main thread in native code where the
+SIGTERM handler can never run. These tests lock the rescue paths: the
+startup probe's fail-fast labeling, the mid-suite stall watchdog's
+partial-summary emit, and the single-terminal-line guarantee.
+
+No reference analog (the reference's drivers log via Timed.scala but have
+no artifact contract); this protects OUR measurement pipeline.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+import bench  # noqa: E402
+
+
+@pytest.fixture
+def fresh_bench(monkeypatch):
+    """bench with its module-level emit state isolated per test."""
+    monkeypatch.setattr(bench, "_RESULTS", [])
+    monkeypatch.setattr(bench, "_SUMMARY_DONE", [False])
+    monkeypatch.setattr(bench, "_LAST_PROGRESS", [0.0])
+    return bench
+
+
+def _summary_lines(captured: str):
+    return [json.loads(line) for line in captured.splitlines()
+            if '"suite_summary"' in line]
+
+
+class TestTerminalSummary:
+    def test_summary_prints_once_even_if_called_twice(self, fresh_bench,
+                                                      capsys):
+        fresh_bench._emit("m", 1.0, "x", 1.0)
+        fresh_bench._emit_summary()
+        fresh_bench._emit_summary()
+        assert len(_summary_lines(capsys.readouterr().out)) == 1
+
+    def test_empty_results_and_no_error_prints_nothing(self, fresh_bench,
+                                                       capsys):
+        fresh_bench._emit_summary()
+        assert _summary_lines(capsys.readouterr().out) == []
+
+    def test_error_summary_prints_even_with_zero_results(self, fresh_bench,
+                                                         capsys):
+        fresh_bench._emit_summary(error="device unreachable: probe hung")
+        (summary,) = _summary_lines(capsys.readouterr().out)
+        assert summary["n_metrics"] == 0
+        assert "device unreachable" in summary["error"]
+        assert summary["metrics"] == {}
+
+    def test_error_summary_carries_partial_results(self, fresh_bench,
+                                                   capsys):
+        fresh_bench._emit("done_metric", 42.0, "x", 2.0)
+        fresh_bench._emit_summary(error="suite stalled after done_metric")
+        (summary,) = _summary_lines(capsys.readouterr().out)
+        assert summary["n_metrics"] == 1
+        assert summary["metrics"]["done_metric"]["value"] == 42.0
+        assert "stalled" in summary["error"]
+
+
+class TestDeviceProbe:
+    def test_fast_fail_emits_labeled_summary_and_reraises(self, fresh_bench,
+                                                          capsys,
+                                                          monkeypatch):
+        def boom():
+            raise RuntimeError("connection refused")
+
+        monkeypatch.setattr(fresh_bench, "_probe_op", boom)
+        with pytest.raises(RuntimeError, match="connection refused"):
+            fresh_bench._probe_device(deadline_s=30.0)
+        (summary,) = _summary_lines(capsys.readouterr().out)
+        assert "device probe failed: RuntimeError" in summary["error"]
+
+    def test_interruption_labeled_as_interruption_not_device_failure(
+            self, fresh_bench, capsys, monkeypatch):
+        """A harness SIGTERM mid-probe arrives as SystemExit(124); the
+        artifact must blame the timeout, not the accelerator."""
+        def killed():
+            raise SystemExit(124)
+
+        monkeypatch.setattr(fresh_bench, "_probe_op", killed)
+        with pytest.raises(SystemExit):
+            fresh_bench._probe_device(deadline_s=30.0)
+        (summary,) = _summary_lines(capsys.readouterr().out)
+        assert "interrupted during device probe" in summary["error"]
+        assert "device probe failed" not in summary["error"]
+
+    def test_healthy_probe_passes_silently(self, fresh_bench, capsys):
+        # CPU backend (conftest): the round-trip completes in milliseconds
+        fresh_bench._probe_device(deadline_s=60.0)
+        assert _summary_lines(capsys.readouterr().out) == []
+
+
+class TestStallWatchdog:
+    def test_stall_fires_exit4_with_partial_summary(self, tmp_path):
+        """A device call hanging mid-suite (simulated by a sleep after one
+        emitted metric) must produce exit code 4 and a terminal summary
+        carrying the already-measured metric. Subprocess: the watchdog
+        ends the interpreter with os._exit."""
+        code = textwrap.dedent("""
+            import sys, time
+            sys.path.insert(0, {repo!r})
+            import jax; jax.config.update("jax_platforms", "cpu")
+            import bench
+            bench._emit("survivor_metric", 7.0, "x", 1.0)
+            bench._start_stall_watchdog(stall_s=1.5)
+            time.sleep(60)   # the simulated hang; watchdog fires first
+            print("UNREACHED")
+        """).format(repo=REPO)
+        result = subprocess.run([sys.executable, "-c", code],
+                                capture_output=True, text=True, timeout=120)
+        assert result.returncode == 4, result.stderr[-500:]
+        assert "UNREACHED" not in result.stdout
+        last = json.loads(result.stdout.strip().splitlines()[-1])
+        assert last["metric"] == "suite_summary"
+        assert "stalled" in last["error"]
+        assert "survivor_metric" in last["error"]  # names the last metric
+        assert last["metrics"]["survivor_metric"]["value"] == 7.0
+
+    def test_heartbeat_defers_the_watchdog(self, fresh_bench):
+        import time
+        fresh_bench._heartbeat()
+        before = fresh_bench._LAST_PROGRESS[0]
+        time.sleep(0.01)
+        fresh_bench._heartbeat()
+        assert fresh_bench._LAST_PROGRESS[0] > before
+
+
+class TestSharedBaselineRates:
+    def test_cached_by_default_fresh_remeasures(self, fresh_bench,
+                                                monkeypatch):
+        """Default calls reuse the cached measurement (the e2e composite);
+        fresh=True re-measures so a bench's comparator shares ITS process
+        state (see the _SHARED_RATES note in bench.py)."""
+        calls = []
+        monkeypatch.setattr(fresh_bench, "_make_cd_problem",
+                            lambda *a, **k: (None, (1, 2, 3, 4, 5)))
+        monkeypatch.setattr(fresh_bench, "_host_cd_sweep",
+                            lambda *a, **k: calls.append(1))
+        monkeypatch.setattr(fresh_bench, "_SHARED_RATES", {})
+        r1 = fresh_bench._host_cd_rate()
+        assert calls == [1] and r1 > 0
+        assert fresh_bench._host_cd_rate() == r1   # cache hit: no re-run
+        assert calls == [1]
+        fresh_bench._host_cd_rate(fresh=True)      # bypasses the cache
+        assert calls == [1, 1]
