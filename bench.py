@@ -162,7 +162,12 @@ def _probe_device(deadline_s: float = 300.0):
         _emit_summary(error=(
             f"interrupted during device probe: {type(e).__name__}: {e}"))
         raise
-    done.set()
+    finally:
+        # cancel the watchdog on EVERY outcome: it exists to catch the
+        # probe never returning. Leaving it armed after a fail-fast
+        # exception would have it os._exit(3) in whatever the process
+        # does next (observed: it hard-killed a pytest run 30 s later)
+        done.set()
 
 
 def _generator_tag(fn, args) -> str:

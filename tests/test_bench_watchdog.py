@@ -29,11 +29,20 @@ import bench  # noqa: E402
 
 @pytest.fixture
 def fresh_bench(monkeypatch):
-    """bench with its module-level emit state isolated per test."""
+    """bench with its module-level emit state isolated per test.
+
+    Also restores the SIGTERM disposition: `_emit_summary` sets it to
+    SIG_IGN before the final print (so a retry-TERM can't truncate the
+    line), and that must not leak into the rest of the pytest run —
+    monkeypatch cannot undo a ``signal.signal`` call on its own."""
+    import signal
+
+    prev = signal.getsignal(signal.SIGTERM)
     monkeypatch.setattr(bench, "_RESULTS", [])
     monkeypatch.setattr(bench, "_SUMMARY_DONE", [False])
     monkeypatch.setattr(bench, "_LAST_PROGRESS", [0.0])
-    return bench
+    yield bench
+    signal.signal(signal.SIGTERM, prev)
 
 
 def _summary_lines(captured: str):
@@ -98,6 +107,22 @@ class TestDeviceProbe:
         (summary,) = _summary_lines(capsys.readouterr().out)
         assert "interrupted during device probe" in summary["error"]
         assert "device probe failed" not in summary["error"]
+
+    def test_failed_probe_cancels_the_watchdog(self, fresh_bench, capsys,
+                                               monkeypatch):
+        """After a fail-fast probe the watchdog must be disarmed: a
+        lingering thread would os._exit(3) the host process at deadline
+        (observed hard-killing a pytest run before the finally fix)."""
+        import time
+
+        def boom():
+            raise RuntimeError("fail fast")
+
+        monkeypatch.setattr(fresh_bench, "_probe_op", boom)
+        with pytest.raises(RuntimeError):
+            fresh_bench._probe_device(deadline_s=0.3)
+        time.sleep(0.8)  # past the deadline; survival IS the assertion
+        assert len(_summary_lines(capsys.readouterr().out)) == 1
 
     def test_healthy_probe_passes_silently(self, fresh_bench, capsys):
         # CPU backend (conftest): the round-trip completes in milliseconds
