@@ -171,14 +171,56 @@ def _probe_device(deadline_s: float = 300.0):
 
 
 def _generator_tag(fn, args) -> str:
-    """Cache key for a generator function: args + bytecode + CONSTANTS.
-    ``co_code`` alone stores only indices into ``co_consts`` — editing a
-    literal (a seed, a scale) would otherwise silently reuse stale data."""
+    """Cache key for a generator function, in two parts: an args hash
+    (identifies the fixture VARIANT — several can be live at once, e.g.
+    the big and small ingest files) then a code hash over bytecode +
+    CONSTANTS (identifies the GENERATION — ``co_code`` alone stores only
+    indices into ``co_consts``, so editing a literal like a seed or a
+    scale would otherwise silently reuse stale data). The split lets the
+    fixture cache GC dead generations of one variant without touching
+    its siblings."""
     import hashlib
 
-    return hashlib.sha1(
-        repr(args).encode() + b"|" + fn.__code__.co_code + b"|"
-        + repr(fn.__code__.co_consts).encode()).hexdigest()[:10]
+    ahash = hashlib.sha1(repr(args).encode()).hexdigest()[:8]
+    chash = hashlib.sha1(
+        fn.__code__.co_code + b"|"
+        + repr(fn.__code__.co_consts).encode()).hexdigest()[:8]
+    return f"{ahash}-{chash}"
+
+
+def _fixture_path(name: str, fn, args, ext: str) -> "tuple[str, bool]":
+    """Resolve the cache path for (name, fn, args) and return
+    ``(path, exists)``; on a cache miss, first GC stale files so dead
+    generations don't accumulate (20-500 MB each — dozens were found
+    hoarding ~5 GB of /tmp). Collected: other GENERATIONS of this
+    variant (same args hash, different code hash) and legacy pre-split
+    names (no dash in the tag — all dead by construction under the
+    current naming). Sibling variants sharing a name — the big and small
+    ingest files — survive.
+
+    NOTE single-writer assumption: the GC unlinks files another bench
+    process could in principle still be reading, if a run of an OLDER
+    bench.py overlaps a run of an edited one. Benches run one at a time
+    on these boxes (1 CPU; the suite cannot share it), so the trade is
+    taken for the disk space; per-uid naming still isolates users, and
+    the unique staging file keeps same-version runs race-free."""
+    import glob
+
+    tag = _generator_tag(fn, args)
+    ahash, _chash = tag.split("-")
+    prefix = f"photon_bench_{os.getuid()}_{name}_"
+    path = os.path.join(tempfile.gettempdir(), f"{prefix}{tag}{ext}")
+    if os.path.exists(path):
+        return path, True
+    for old in glob.glob(os.path.join(tempfile.gettempdir(),
+                                      f"{prefix}*{ext}")):
+        base_tag = os.path.basename(old)[len(prefix):-len(ext)]
+        if base_tag.startswith(f"{ahash}-") or "-" not in base_tag:
+            try:
+                os.unlink(old)
+            except OSError:
+                pass  # another process may have raced the same cleanup
+    return path, False
 
 
 def _cached_fixture(name: str, fn, *args) -> str:
@@ -188,13 +230,9 @@ def _cached_fixture(name: str, fn, *args) -> str:
     ``fn(path, *args)`` generates the file. The cache key folds in ``args``
     and ``fn``'s own bytecode, so editing the generator or its parameters
     invalidates the cached file instead of silently benchmarking stale
-    data. Per-user temp name + unique staging file avoid cross-user
-    collisions and concurrent-run races in the shared temp dir."""
-    tag = _generator_tag(fn, args)
-    path = os.path.join(
-        tempfile.gettempdir(),
-        f"photon_bench_{os.getuid()}_{name}_{tag}.avro")
-    if not os.path.exists(path):
+    data (see :func:`_fixture_path` for the naming and GC rules)."""
+    path, exists = _fixture_path(name, fn, args, ".avro")
+    if not exists:
         fd, tmp = tempfile.mkstemp(dir=tempfile.gettempdir(),
                                    suffix=".avro.tmp")
         os.close(fd)
@@ -213,13 +251,8 @@ def _cached_npz(name: str, fn, *args) -> dict:
     the 10M-row random-effect problem costs ~40 s of rng/alias-sampling —
     prep, not measurement). Same keying discipline as
     :func:`_cached_fixture`: args + the generator's bytecode."""
-    import hashlib
-
-    tag = _generator_tag(fn, args)
-    path = os.path.join(
-        tempfile.gettempdir(),
-        f"photon_bench_{os.getuid()}_{name}_{tag}.npz")
-    if not os.path.exists(path):
+    path, exists = _fixture_path(name, fn, args, ".npz")
+    if not exists:
         arrays = fn(*args)
         fd, tmp = tempfile.mkstemp(dir=tempfile.gettempdir(),
                                    suffix=".npz.tmp")
