@@ -165,6 +165,44 @@ class TestStallWatchdog:
         assert fresh_bench._LAST_PROGRESS[0] > before
 
 
+class TestFixtureCacheGC:
+    def test_generation_gc_spares_sibling_variants_and_cache_hits(
+            self, tmp_path, monkeypatch):
+        """A cache miss collects dead GENERATIONS of the same variant and
+        legacy pre-split names, but never sibling variants (the big and
+        small ingest files share a fixture name)."""
+        import tempfile as _tempfile
+
+        monkeypatch.setattr(_tempfile, "gettempdir",
+                            lambda: str(tmp_path))
+        calls = []
+
+        def gen(path, n):
+            calls.append(n)
+            with open(path, "w") as f:
+                f.write("x" * n)
+
+        legacy = tmp_path / (f"photon_bench_{os.getuid()}"
+                             "_gct_0123456789.avro")
+        legacy.write_text("legacy")
+        p_small = bench._cached_fixture("gct", gen, 10)
+        assert not legacy.exists()          # legacy orphan collected
+        p_big = bench._cached_fixture("gct", gen, 20)
+        assert p_small != p_big and os.path.exists(p_small)
+        assert bench._cached_fixture("gct", gen, 10) == p_small
+        assert calls == [10, 20]            # cache hit: no regeneration
+
+        def gen(path, n):                   # edited generator: new chash
+            calls.append(n)
+            with open(path, "w") as f:
+                f.write("y" * (n + 1))
+
+        p_small2 = bench._cached_fixture("gct", gen, 10)
+        assert p_small2 != p_small
+        assert not os.path.exists(p_small)  # dead generation collected
+        assert os.path.exists(p_big)        # sibling variant survives
+
+
 class TestSharedBaselineRates:
     def test_cached_by_default_fresh_remeasures(self, fresh_bench,
                                                 monkeypatch):
