@@ -201,6 +201,8 @@ class TestFixtureCacheGC:
         assert p_small2 != p_small
         assert not os.path.exists(p_small)  # dead generation collected
         assert os.path.exists(p_big)        # sibling variant survives
+        assert os.path.exists(p_small2)     # ... and the new one was built
+        assert calls == [10, 20, 10]        # by actually re-running gen
 
 
 class TestSharedBaselineRates:
