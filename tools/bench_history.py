@@ -1,0 +1,88 @@
+"""Round-over-round scoreboard from the official BENCH_r*.json artifacts.
+
+Each artifact stores the bench run's `rc` and the last parsed JSON line of
+its stdout tail. Rounds 1-3 predate the terminal `suite_summary` line, so
+their `parsed` is whatever single metric happened to print last; for those
+the metric lines are recovered from the raw `tail` text instead. Prints a
+metric x round table of official values (the judge-recorded numbers — no
+local re-runs), plus each round's rc and any recorded environment error.
+
+Usage: python tools/bench_history.py [repo_root]
+"""
+
+import glob
+import json
+import os
+import re
+import sys
+
+
+def _metrics_of(artifact: dict) -> dict:
+    """metric name -> line dict, from the summary when present, else by
+    scanning the stored stdout tail for metric JSON lines."""
+    parsed = artifact.get("parsed") or {}
+    if parsed.get("metric") == "suite_summary":
+        return {name: dict(vals, metric=name)
+                for name, vals in parsed.get("metrics", {}).items()}
+    out = {}
+    for line in artifact.get("tail", "").splitlines():
+        line = line.strip()
+        if not (line.startswith("{") and '"metric"' in line):
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue  # truncated tail edge
+        if "metric" in rec and "value" in rec:
+            out[rec["metric"]] = rec
+    if parsed.get("metric") and parsed["metric"] not in out:
+        out[parsed["metric"]] = parsed
+    return out
+
+
+def main(root: str = ".") -> None:
+    rounds = {}
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if not m:
+            continue
+        art = json.load(open(path))
+        rounds[int(m.group(1))] = {
+            "rc": art.get("rc"),
+            "metrics": _metrics_of(art),
+            "error": (art.get("parsed") or {}).get("error"),
+        }
+    if not rounds:
+        print("no BENCH_r*.json artifacts found under", root)
+        return
+
+    names = []
+    for r in sorted(rounds):
+        for name in rounds[r]["metrics"]:
+            if name not in names and name != "suite_summary":
+                names.append(name)
+
+    cols = sorted(rounds)
+    width = max(len(n) for n in names) if names else 10
+    header = "metric".ljust(width) + "".join(f"  r{c:02d}".rjust(14)
+                                             for c in cols)
+    print(header)
+    print("-" * len(header))
+    for name in names:
+        row = name.ljust(width)
+        for c in cols:
+            rec = rounds[c]["metrics"].get(name)
+            row += (f"{rec['value']:14,.0f}" if rec else " " * 14)
+        print(row)
+    print()
+    for c in cols:
+        note = f"r{c:02d}: rc={rounds[c]['rc']}"
+        if rounds[c]["error"]:
+            note += f"  error: {rounds[c]['error']}"
+        if rounds[c]["rc"] == 124:
+            note += "  (harness timeout; partial)"
+        print(note)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else ".")
