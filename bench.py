@@ -1039,7 +1039,11 @@ def main(argv=None):
         raise SystemExit(124)
 
     signal.signal(signal.SIGTERM, _sigterm)
-    _probe_device()
+    if args.only != "ingest":
+        # the ingest/write bench is host-only (native Avro codecs, no
+        # device leg) — it stays runnable, and useful, with the
+        # accelerator tunnel down
+        _probe_device()
     _start_stall_watchdog()
     if args.only:
         try:

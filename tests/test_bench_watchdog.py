@@ -203,6 +203,20 @@ class TestSuiteOrchestration:
         fresh_bench.main(["--only", "cd"])
         assert order == ["bench_cd_sweep"]
 
+    def test_probe_skipped_for_host_only_ingest(self, fresh_bench,
+                                                monkeypatch):
+        """--only ingest has no device leg and must stay runnable with
+        the tunnel down (driven for real: rc=0 during an actual outage);
+        every other mode probes the device first."""
+        order, probed = [], []
+        self._neuter(monkeypatch, order)
+        monkeypatch.setattr(bench, "_probe_device",
+                            lambda deadline_s=300.0: probed.append(1))
+        fresh_bench.main(["--only", "ingest"])
+        assert probed == [] and order == ["bench_ingest"]
+        fresh_bench.main(["--only", "glm"])
+        assert probed == [1] and order[-1] == "bench_glm"
+
 
 class TestFixtureCacheGC:
     def test_generation_gc_spares_sibling_variants_and_cache_hits(
