@@ -27,8 +27,9 @@ semantic fields with an explicit, documented syntax:
 
 from __future__ import annotations
 
+import dataclasses
 import itertools
-from typing import Mapping, Sequence
+from typing import Mapping, Optional, Sequence
 
 from photon_ml_tpu.game.data import RandomEffectDatasetConfig
 from photon_ml_tpu.game.projector import ProjectorType
@@ -168,6 +169,112 @@ def parse_coordinate_config(spec: str):
     if kv:
         raise ValueError(f"unknown coordinate options {sorted(kv)} in {spec!r}")
     return cid, cfg
+
+
+# ---------------------------------------------------------------------------
+# Resilience configuration (shared by train_game and train_glm)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ResilienceConfig:
+    """The drivers' retry/divergence knobs, round-trippable through a JSON
+    config file (:meth:`as_dict` / :meth:`from_dict`) so a chaos sweep or a
+    production deployment can pin them alongside the rest of the run
+    configuration.
+
+    ``max_retries`` is RETRIES, not attempts (0 = try once); it budgets
+    both the IO retry policy and the divergence guard's rollback-retries.
+    ``on_divergence``: ``fail`` (raise with an actionable message — the
+    default), ``rollback`` (roll back + regularization backoff, freeze
+    after the budget), ``freeze`` (freeze immediately).
+    """
+
+    max_retries: int = 2
+    retry_deadline_s: Optional[float] = None
+    on_divergence: str = "fail"
+    reg_backoff: float = 10.0
+
+    def __post_init__(self):
+        if self.max_retries < 0:
+            raise ValueError(
+                f"max_retries must be >= 0, got {self.max_retries}")
+        if self.on_divergence not in ("fail", "rollback", "freeze"):
+            raise ValueError(
+                f"on_divergence must be fail|rollback|freeze, "
+                f"got {self.on_divergence!r}")
+
+    # --- config-file round-trip ------------------------------------------
+    def as_dict(self) -> dict:
+        return {
+            "maxRetries": self.max_retries,
+            "retryDeadlineS": self.retry_deadline_s,
+            "onDivergence": self.on_divergence,
+            "regBackoff": self.reg_backoff,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "ResilienceConfig":
+        return cls(
+            max_retries=int(d.get("maxRetries", 2)),
+            retry_deadline_s=(None if d.get("retryDeadlineS") is None
+                              else float(d["retryDeadlineS"])),
+            on_divergence=str(d.get("onDivergence", "fail")),
+            reg_backoff=float(d.get("regBackoff", 10.0)),
+        )
+
+    # --- materialization --------------------------------------------------
+    def retry_policy(self):
+        from photon_ml_tpu.resilience import RetryPolicy
+
+        return RetryPolicy(max_attempts=self.max_retries + 1,
+                           deadline_s=self.retry_deadline_s)
+
+    def guard(self, bus=None):
+        from photon_ml_tpu.resilience import DivergenceGuard, DivergencePolicy
+
+        return DivergenceGuard(
+            DivergencePolicy(mode=self.on_divergence,
+                             max_retries=self.max_retries,
+                             reg_backoff=self.reg_backoff),
+            bus=bus)
+
+
+def add_resilience_flags(parser) -> None:
+    """The shared driver flags (train_game + train_glm)."""
+    parser.add_argument(
+        "--max-retries", type=int, default=2,
+        help="retries (not attempts) for transient faults: Avro reads, "
+             "checkpoint save/load, multihost initialization — and the "
+             "divergence guard's per-coordinate rollback budget")
+    parser.add_argument(
+        "--retry-deadline-s", type=float, default=None,
+        help="hard wall-clock deadline across one operation's retries "
+             "(the retry never sleeps into a deadline it would blow)")
+    parser.add_argument(
+        "--on-divergence", choices=["fail", "rollback", "freeze"],
+        default="fail",
+        help="when a coordinate step produces NaN/Inf: fail = raise with "
+             "an actionable error (default); rollback = roll back to the "
+             "last good state, bump the coordinate's regularization and "
+             "retry (freeze after --max-retries failures); freeze = lock "
+             "the coordinate at its last good model immediately and "
+             "continue degraded")
+
+
+def resilience_from_args(args) -> ResilienceConfig:
+    return ResilienceConfig(max_retries=args.max_retries,
+                            retry_deadline_s=args.retry_deadline_s,
+                            on_divergence=args.on_divergence)
+
+
+def install_resilience(config: ResilienceConfig):
+    """Install the process-wide retry policy and build the run's guard —
+    the one call both drivers make after parsing flags."""
+    from photon_ml_tpu.resilience import set_default_policy
+
+    set_default_policy(config.retry_policy())
+    return config.guard()
 
 
 def parse_grid(specs: Sequence[str]) -> list[Mapping[str, float]]:

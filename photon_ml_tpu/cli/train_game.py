@@ -15,9 +15,12 @@ import os
 from typing import Optional, Sequence
 
 from photon_ml_tpu.cli.config import (
+    add_resilience_flags,
+    install_resilience,
     parse_coordinate_config,
     parse_feature_shard_config,
     parse_grid,
+    resilience_from_args,
 )
 from photon_ml_tpu.data_validation import validate_game_data
 from photon_ml_tpu.evaluation import parse_evaluators
@@ -121,6 +124,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "fixed-effect samples over 'data' (psum'd compiled "
                         "optimizer) and random-effect entity lanes over "
                         "'entity'. Default: single device")
+    add_resilience_flags(p)
     return p
 
 
@@ -178,6 +182,9 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
 
     args = build_parser().parse_args(argv)
     task = TaskType(args.task)
+    # install the retry policy BEFORE anything that might retry (multihost
+    # initialization is the first candidate)
+    guard = install_resilience(resilience_from_args(args))
     if args.multihost:
         # must precede parse_mesh: forming the job is only possible before
         # the first backend-touching call
@@ -349,7 +356,7 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                 n_cd_iterations=args.cd_iterations,
                 checkpoint_dir=mp_ckpt, resume=args.resume,
                 initial_models=initial_models, locked=locked,
-                validation=validation)
+                validation=validation, guard=guard)
             evaluation = None
             if validation is not None:
                 vdata, evs = validation
@@ -423,7 +430,8 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                     results = est.fit(
                         data, configurations, validation=validation,
                         initial_models=initial_models, locked=locked,
-                        checkpoint=checkpoint, resume=args.resume)
+                        checkpoint=checkpoint, resume=args.resume,
+                        guard=guard)
                     # drain the async solve queue inside the timed block:
                     # without this the final sweep's device programs finish
                     # during "Save models", which then reports compute as
@@ -468,7 +476,8 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                 def evaluate(config: dict) -> float:
                     r = est.fit(data, [GameOptimizationConfiguration(config)],
                                 validation=validation, datasets=datasets,
-                                initial_models=initial_models, locked=locked)[0]
+                                initial_models=initial_models, locked=locked,
+                                guard=guard)[0]
                     results.append(r)
                     return r.evaluation.primary[1]
 

@@ -18,6 +18,11 @@ from typing import Optional, Sequence
 import jax.numpy as jnp
 import numpy as np
 
+from photon_ml_tpu.cli.config import (
+    add_resilience_flags,
+    install_resilience,
+    resilience_from_args,
+)
 from photon_ml_tpu.data_validation import validate_game_data
 from photon_ml_tpu.evaluation import parse_evaluators
 from photon_ml_tpu.glm.problem import GLMOptimizationConfiguration
@@ -126,6 +131,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "writes outputs. Not combinable with "
                         "--training-diagnostics or --design-dtype bfloat16 "
                         "yet")
+    add_resilience_flags(p)
     return p
 
 
@@ -218,6 +224,9 @@ def _run_diagnostics(args, task, best, glm_train, glm_val, shard, stats, imap,
 def run(argv: Optional[Sequence[str]] = None) -> dict:
     args = build_parser().parse_args(argv)
     task = TaskType(args.task)
+    # install the retry policy BEFORE anything that might retry (multihost
+    # initialization is the first candidate)
+    install_resilience(resilience_from_args(args))
     if args.multihost:
         from photon_ml_tpu.parallel import multihost
 
@@ -375,6 +384,36 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
             # the reference's OptimizationStatesTracker iteration table
             log_optimizer_trace(
                 tm.result, f"lambda={tm.regularization_weight:g}", run_logger)
+
+        # divergence guard over the sweep (pure reads: finiteness of the
+        # trained coefficients). The GLM sweep has no rollback target —
+        # each lambda is an independent solve — so non-"fail" modes drop
+        # the diverged lambdas from model selection and continue degraded.
+        diverged = [tm for tm in trained
+                    if not np.isfinite(
+                        np.asarray(tm.model.coefficients.means)).all()]
+        if diverged:
+            from photon_ml_tpu.events import GLOBAL_BUS
+            from photon_ml_tpu.resilience import DivergenceError
+
+            bad = [tm.regularization_weight for tm in diverged]
+            for w in bad:
+                GLOBAL_BUS.post("divergence_detected", driver="train_glm",
+                                regularization_weight=w)
+            if args.on_divergence == "fail":
+                raise DivergenceError(
+                    f"GLM sweep diverged at lambda(s) {bad} (non-finite "
+                    f"coefficients); re-run with --on-divergence=rollback "
+                    f"to drop them from selection, or raise the "
+                    f"regularization / lower the normalization scale")
+            if len(diverged) == len(trained):
+                raise DivergenceError(
+                    f"every lambda in the sweep diverged ({bad}); nothing "
+                    f"to select — fix the optimization configuration")
+            for w in bad:
+                GLOBAL_BUS.post("coordinate_frozen", driver="train_glm",
+                                regularization_weight=w)
+            trained = [tm for tm in trained if tm not in diverged]
 
         best_idx = 0
         glm_val = None

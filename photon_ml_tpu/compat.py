@@ -1,0 +1,34 @@
+"""Version-compat shims over the moving jax API surface.
+
+The framework targets current jax, but the resilience story includes not
+falling over on the trailing versions real clusters run. Everything
+version-dependent is funneled through here so call sites stay on ONE
+spelling:
+
+- ``shard_map``: top-level export (jax >= 0.6) vs
+  ``jax.experimental.shard_map`` (older), and the replication-check kwarg
+  rename ``check_rep`` -> ``check_vma``.
+- ``jax.sharding.AxisType`` is handled in :mod:`photon_ml_tpu.parallel.mesh`
+  (mesh construction is the only consumer).
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:  # jax >= 0.6 exports shard_map at top level
+    from jax import shard_map as _shard_map
+except ImportError:  # older jax: the experimental home
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_REP_KWARG = ("check_vma"
+              if "check_vma" in inspect.signature(_shard_map).parameters
+              else "check_rep")
+
+
+def shard_map(f, *, check_vma=None, **kwargs):
+    """``jax.shard_map`` with the modern ``check_vma`` spelling on every
+    jax version this package supports."""
+    if check_vma is not None:
+        kwargs[_REP_KWARG] = check_vma
+    return _shard_map(f, **kwargs)

@@ -24,6 +24,7 @@ from photon_ml_tpu.evaluation import Evaluator, evaluate_all
 from photon_ml_tpu.game.coordinate import Coordinate, CoordinateModel
 from photon_ml_tpu.game.data import GameData
 from photon_ml_tpu.game.model import GameModel
+from photon_ml_tpu.resilience import fault_point, fault_value
 from photon_ml_tpu.types import TaskType
 
 logger = logging.getLogger(__name__)
@@ -125,13 +126,26 @@ class CoordinateDescent:
         resume: bool = False,
         locked: Sequence[str] = (),
         config_fingerprint: Optional[str] = None,
+        guard=None,  # Optional[photon_ml_tpu.resilience.DivergenceGuard]
     ) -> CoordinateDescentResult:
         """``locked`` coordinates (reference partial retrain via
         ``--model-input-dir``: freeze some coordinates, retrain others) keep
         their ``initial_models`` entry; their scores participate in the
         residual accounting but they are never retrained — so they need no
-        entry in ``coordinates`` (and no dataset build)."""
+        entry in ``coordinates`` (and no dataset build).
+
+        ``guard`` (a :class:`~photon_ml_tpu.resilience.DivergenceGuard`)
+        checks each coordinate step's outputs for NaN/Inf: on divergence
+        the step is rolled back to the last good state (re-read from
+        ``checkpoint`` when one is present — the same path a crash-restart
+        takes), the coordinate's regularization is bumped, and the step
+        retries; past the policy's retry budget the coordinate is frozen
+        at its last good model (the ``locked`` mechanism) and the run
+        continues degraded. ``guard=None`` (default) is the exact
+        pre-guard code path; a healthy guarded run is bit-identical since
+        the checks are pure reads."""
         locked = set(locked)
+        coordinates = dict(coordinates)  # guard retries may bump a lam
         for cid in locked:
             if not initial_models or cid not in initial_models:
                 raise KeyError(
@@ -203,15 +217,66 @@ class CoordinateDescent:
         history: list[dict[str, float]] = []
         final_evaluation = None
         for sweep in range(start_sweep, self.n_iterations):
+            fault_point("worker.stall", sweep=sweep)
             for ci, cid in enumerate(self.update_sequence):
                 if sweep == start_sweep and ci < start_coord:
                     continue
                 if cid in locked:
                     continue  # frozen: scores stay as seeded
+                if (guard is not None and cid in guard.frozen
+                        and cid in models):
+                    # diverged earlier THIS fit: locked at last good model.
+                    # A fresh configuration (no model yet — e.g. the next
+                    # grid point sharing the guard) retrains: its new
+                    # regularization may well not diverge.
+                    continue
                 t0 = time.perf_counter()
-                residual = total - scores[cid]
-                model, new_scores = coordinates[cid].train(
-                    residual, models.get(cid), sweep=sweep)
+                while True:
+                    residual = total - scores[cid]
+                    try:
+                        model, new_scores = coordinates[cid].train(
+                            residual, models.get(cid), sweep=sweep)
+                        new_scores = fault_value(
+                            "optimizer.step", new_scores,
+                            coordinate=cid, sweep=sweep)
+                        step_error = None
+                    except Exception as e:
+                        if guard is None:
+                            raise
+                        model, new_scores, step_error = None, None, e
+                    if guard is None or (step_error is None
+                                         and guard.healthy(model,
+                                                           new_scores)):
+                        break  # healthy: commit below
+                    action = guard.on_divergence(
+                        cid, sweep=sweep, has_good_model=cid in models,
+                        error=step_error)
+                    if action == "freeze":
+                        new_scores = None  # keep last good model + scores
+                        break
+                    # roll back to the last durable state: nothing was
+                    # committed in-process, and when a checkpoint manager
+                    # is present the state is re-read from disk so
+                    # recovery exercises the exact crash-restart path
+                    if (checkpoint is not None
+                            and checkpoint.latest_step() is not None):
+                        state = checkpoint.restore(
+                            expected_fingerprint=config_fingerprint)
+                        models = dict(state.model.coordinates)
+                        for k, v in state.scores.items():
+                            if k in scores:
+                                host_scores[k] = np.asarray(v, np.float32)
+                                scores[k] = jnp.asarray(host_scores[k])
+                        total = jnp.asarray(data.offsets, jnp.float32) \
+                            + sum(scores.values())
+                    # regularization backoff: stronger curvature is the
+                    # standard fix for a diverged GLM solve
+                    coord = coordinates[cid]
+                    if hasattr(coord, "lam"):
+                        coordinates[cid] = dataclasses.replace(
+                            coord, lam=guard.next_lam(coord.lam))
+                if new_scores is None:
+                    continue  # frozen mid-sweep: nothing to commit
                 models[cid] = model
                 total = residual + new_scores
                 scores[cid] = new_scores

@@ -350,6 +350,7 @@ class GameEstimator:
         locked: Sequence[str] = (),
         checkpoint=None,
         resume: bool = False,
+        guard=None,  # Optional[photon_ml_tpu.resilience.DivergenceGuard]
     ) -> list[GameResult]:
         """``datasets`` (from :meth:`prepare`) lets callers that fit many
         times over the same data — e.g. a tuning loop — build the coordinate
@@ -358,7 +359,11 @@ class GameEstimator:
         coordinates keep their model and skip training);
         ``checkpoint``/``resume`` persist/restore coordinate-boundary state
         (single-configuration fits only — a resumed grid would mis-attribute
-        the restored state to every configuration)."""
+        the restored state to every configuration). ``guard`` is the
+        resilience subsystem's divergence guard (rollback / regularization
+        backoff / freeze at coordinate boundaries; see RESILIENCE.md) —
+        shared across configurations so a tuning loop's failure budget is
+        per-run, not per-point."""
         self._check_sequence(locked)
         if checkpoint is not None and len(configurations) != 1:
             raise ValueError("checkpointing supports exactly one configuration")
@@ -392,7 +397,8 @@ class GameEstimator:
                                initial_models=initial_models,
                                checkpoint=checkpoint, resume=resume,
                                locked=locked,
-                               config_fingerprint=fingerprint)
+                               config_fingerprint=fingerprint,
+                               guard=guard)
             # the final CD sweep already evaluated this exact model
             evaluation = cd_result.final_evaluation
             results.append(GameResult(

@@ -68,6 +68,7 @@ from photon_ml_tpu.glm.problem import GLMOptimizationConfiguration
 from photon_ml_tpu.models.coefficients import Coefficients
 from photon_ml_tpu.models.glm import GeneralizedLinearModel
 from photon_ml_tpu.ops.objective import GLMData
+from photon_ml_tpu.resilience import fault_point, fault_value
 from photon_ml_tpu.types import TaskType
 
 logger = logging.getLogger(__name__)
@@ -530,10 +531,19 @@ def _mp_ckpt_save(root: str, sweep: int, fingerprint: str,
             payload[f"fev::{cid}"] = np.asarray(v, np.float32)
     payload["fingerprint"] = np.frombuffer(
         fingerprint.encode("utf-8"), np.uint8)
-    tmp = os.path.join(d, f".sweep-{sweep}.npz.tmp")
-    with open(tmp, "wb") as f:
-        np.savez(f, **payload)
-    os.replace(tmp, os.path.join(d, f"sweep-{sweep}.npz"))
+
+    from photon_ml_tpu.resilience import fault_point, retry
+
+    def attempt() -> None:
+        tmp = os.path.join(d, f".sweep-{sweep}.npz.tmp")
+        with open(tmp, "wb") as f:
+            np.savez(f, **payload)
+        # crash-mid-write window: payload fully written, rename pending —
+        # a kill here must leave the previous sweep as the loadable latest
+        fault_point("ckpt.save", step=sweep, path=d, scope="mp")
+        os.replace(tmp, os.path.join(d, f"sweep-{sweep}.npz"))
+
+    retry(attempt, name=f"ckpt.save:mp-sweep-{sweep}")
     # prune like the single-process manager (io/checkpoint.py keep=3): a
     # 10M-row score decomposition is ~10s of MB per sweep per process
     kept = sorted(
@@ -777,6 +787,7 @@ def train_game_multiprocess(
     initial_models: Optional[Mapping[str, object]] = None,
     locked: Sequence[str] = (),
     validation: Optional[tuple] = None,
+    guard=None,  # Optional[photon_ml_tpu.resilience.DivergenceGuard]
 ) -> MultiProcessGameResult:
     """Run GAME coordinate descent across all processes.
 
@@ -801,6 +812,14 @@ def train_game_multiprocess(
     on EVERY process) enables per-sweep validation tracking: the global
     model is assembled at each sweep boundary and evaluated — identical on
     every process since model and data are. History is in the result.
+
+    ``guard`` (a :class:`~photon_ml_tpu.resilience.DivergenceGuard`)
+    enables divergence rollback: each coordinate step's NaN/Inf verdict is
+    allreduce-maxed so every process rolls back (bumping the coordinate's
+    regularization) or freezes the coordinate in lockstep. Fault plans in
+    multi-process runs must be seeded identically on every process —
+    injected faults then fire symmetrically, which is what keeps the
+    collective schedule aligned through a recovery.
     """
     import jax
     import jax.numpy as jnp
@@ -1031,10 +1050,14 @@ def train_game_multiprocess(
                         coeffs=np.zeros(0, np.float32),
                         projector=None)  # learned P rides in the state file
                     for cid, p in factored_plans.items()})
+                from photon_ml_tpu.resilience import retry as _retry
+
                 (saved_scores, saved_re, fe_models,
-                 resumed_history) = _mp_ckpt_load(
-                    checkpoint_dir, agreed, fingerprint, task,
-                    re_templates, fe_datasets)
+                 resumed_history) = _retry(
+                    lambda: _mp_ckpt_load(
+                        checkpoint_dir, agreed, fingerprint, task,
+                        re_templates, fe_datasets),
+                    name=f"ckpt.restore:mp-sweep-{agreed}")
                 re_local_models.update(saved_re)
                 scores.update(saved_scores)
                 models.update(fe_models)
@@ -1084,84 +1107,149 @@ def train_game_multiprocess(
         return gm
 
     validation_history: list[dict] = list(resumed_history)
+    lam = dict(lam)  # guard retries bump a coordinate's weight in place
     for sweep in range(start_sweep, n_cd_iterations):
+        fault_point("worker.stall", sweep=sweep)
         for cid in update_sequence:
             if cid in locked:
                 continue  # frozen: scores stay as seeded
+            if (guard is not None and cid in guard.frozen
+                    and (cid in models or cid in re_local_models)):
+                # diverged earlier THIS run: locked at last good model (a
+                # fresh run sharing the guard — the next grid point —
+                # retrains under its new regularization)
+                continue
             cfg = coordinate_configs[cid]
-            residual = total - scores[cid]
-            if cid in fe_datasets:
-                ds = fe_datasets[cid]
-                w_sweep = None
-                if cfg.downsampler is not None:
-                    # keyed per-global-row-id draw: the kept set is a pure
-                    # per-row function, so every partition of the rows —
-                    # including the single-process run — samples identically
-                    w_sweep = cfg.downsampler.downsample(
-                        game_primary.labels, game_primary.weights,
-                        sweep=sweep, uids=primary_rows)
-                data = ds.glm_data(residual, local_weights=w_sweep)
-                w0 = (jnp.zeros((ds.dim,), jnp.float32)
-                      if cid not in models else
-                      jnp.asarray(models[cid].model.coefficients.means))
-                train_fn = _fixed_train_fn_dist(
-                    task, cfg.optimization, fe_mesh)
-                result, variances, g_scores = train_fn(
-                    data, w0, jnp.asarray(lam.get(cid, 0.0), jnp.float32))
-                new_scores = ds.local_scores(g_scores)
-                models[cid] = FixedEffectModel(
-                    model=GeneralizedLinearModel(
-                        coefficients=Coefficients(
-                            means=np.asarray(result.w),
-                            variances=(None if variances is None
-                                       else np.asarray(variances))),
-                        task=task),
-                    feature_shard_id=ds.feature_shard_id)
-            else:
-                plan = re_plans.get(cid) or factored_plans[cid]
-                if plan.primary:
-                    res_c = residual
-                else:
-                    # residuals live on primary owners; this coordinate's
-                    # rows live on ITS entity owners — exchange via the
-                    # replicated global vector (the reference's score join)
-                    g_res = _allgather_rowvec(primary_rows, residual,
-                                              n_global)
-                    res_c = g_res[plan.global_rows]
-                if cid in re_plans:
-                    coord = RandomEffectCoordinate(
-                        coordinate_id=cid, dataset=plan.dataset,
-                        data=plan.game, task=task, config=plan.optimization,
-                        lam=lam.get(cid, 0.0), mesh=re_mesh,
-                        design_dtype=getattr(coordinate_configs[cid],
-                                             "design_dtype", "float32"))
-                    model_c, scores_c = coord.train(
-                        res_c, re_local_models.get(cid), sweep=sweep)
-                else:
-                    from photon_ml_tpu.game.factored import (
-                        FactoredRandomEffectCoordinate,
-                    )
+            while True:
+                residual = total - scores[cid]
+                prev_fe = models.get(cid)
+                prev_re = re_local_models.get(cid)
+                step_error = None
+                new_model = None
+                new_scores = None
+                try:
+                    if cid in fe_datasets:
+                        ds = fe_datasets[cid]
+                        w_sweep = None
+                        if cfg.downsampler is not None:
+                            # keyed per-global-row-id draw: the kept set is
+                            # a pure per-row function, so every partition of
+                            # the rows — including the single-process run —
+                            # samples identically
+                            w_sweep = cfg.downsampler.downsample(
+                                game_primary.labels, game_primary.weights,
+                                sweep=sweep, uids=primary_rows)
+                        data = ds.glm_data(residual, local_weights=w_sweep)
+                        w0 = (jnp.zeros((ds.dim,), jnp.float32)
+                              if cid not in models else
+                              jnp.asarray(models[cid].model.coefficients.means))
+                        train_fn = _fixed_train_fn_dist(
+                            task, cfg.optimization, fe_mesh)
+                        result, variances, g_scores = train_fn(
+                            data, w0,
+                            jnp.asarray(lam.get(cid, 0.0), jnp.float32))
+                        new_scores = ds.local_scores(g_scores)
+                        models[cid] = new_model = FixedEffectModel(
+                            model=GeneralizedLinearModel(
+                                coefficients=Coefficients(
+                                    means=np.asarray(result.w),
+                                    variances=(None if variances is None
+                                               else np.asarray(variances))),
+                                task=task),
+                            feature_shard_id=ds.feature_shard_id)
+                    else:
+                        plan = re_plans.get(cid) or factored_plans[cid]
+                        if plan.primary:
+                            res_c = residual
+                        else:
+                            # residuals live on primary owners; this
+                            # coordinate's rows live on ITS entity owners —
+                            # exchange via the replicated global vector (the
+                            # reference's score join)
+                            g_res = _allgather_rowvec(primary_rows, residual,
+                                                      n_global)
+                            res_c = g_res[plan.global_rows]
+                        if cid in re_plans:
+                            coord = RandomEffectCoordinate(
+                                coordinate_id=cid, dataset=plan.dataset,
+                                data=plan.game, task=task,
+                                config=plan.optimization,
+                                lam=lam.get(cid, 0.0), mesh=re_mesh,
+                                design_dtype=getattr(coordinate_configs[cid],
+                                                     "design_dtype",
+                                                     "float32"))
+                            model_c, scores_c = coord.train(
+                                res_c, re_local_models.get(cid), sweep=sweep)
+                        else:
+                            from photon_ml_tpu.game.factored import (
+                                FactoredRandomEffectCoordinate,
+                            )
 
-                    fcfg = plan.cfg
-                    fcoord = FactoredRandomEffectCoordinate(
-                        coordinate_id=cid, data=plan.game,
-                        dataset_config=fcfg.dataset, task=task,
-                        config=fcfg.optimization,
-                        projection_config=fcfg.projection_optimization,
-                        lam=lam.get(cid, 0.0),
-                        lam_projection=fcfg.lam_projection,
-                        n_factored_iterations=fcfg.n_factored_iterations,
-                        mesh=re_mesh)
-                    model_c, scores_c = _train_factored_mp(
-                        fcoord, plan.global_rows, res_c,
-                        re_local_models.get(cid), fe_mesh)
-                re_local_models[cid] = model_c
-                sc = np.asarray(scores_c, np.float32)
-                if plan.primary:
-                    new_scores = sc
+                            fcfg = plan.cfg
+                            fcoord = FactoredRandomEffectCoordinate(
+                                coordinate_id=cid, data=plan.game,
+                                dataset_config=fcfg.dataset, task=task,
+                                config=fcfg.optimization,
+                                projection_config=fcfg.projection_optimization,
+                                lam=lam.get(cid, 0.0),
+                                lam_projection=fcfg.lam_projection,
+                                n_factored_iterations=fcfg.n_factored_iterations,
+                                mesh=re_mesh)
+                            model_c, scores_c = _train_factored_mp(
+                                fcoord, plan.global_rows, res_c,
+                                re_local_models.get(cid), fe_mesh)
+                        re_local_models[cid] = new_model = model_c
+                        sc = np.asarray(scores_c, np.float32)
+                        if plan.primary:
+                            new_scores = sc
+                        else:
+                            g_sc = _allgather_rowvec(plan.global_rows, sc,
+                                                     n_global)
+                            new_scores = g_sc[primary_rows]
+                    new_scores = fault_value("optimizer.step", new_scores,
+                                             coordinate=cid, sweep=sweep)
+                except Exception as e:
+                    if guard is None:
+                        raise
+                    # deterministic faults raise SYMMETRICALLY (the plan's
+                    # decisions are a pure function of seeded counters), so
+                    # every process lands here together and the verdict
+                    # collective below stays aligned
+                    step_error = e
+                if guard is None:
+                    break
+                # the guard verdict is COLLECTIVE: a local isfinite could
+                # differ across row shards, and a split verdict would
+                # desync every later collective — allreduce_max so all
+                # processes roll back (or not) in lockstep
+                local_ok = (step_error is None
+                            and guard.healthy(new_model, new_scores))
+                bad = int(allreduce_max(
+                    np.array([0 if local_ok else 1], np.int64))[0]) > 0
+                if not bad:
+                    break
+                # roll back this coordinate's in-process state to the last
+                # good model (identical on every process, like the verdict)
+                if prev_fe is None:
+                    models.pop(cid, None)
                 else:
-                    g_sc = _allgather_rowvec(plan.global_rows, sc, n_global)
-                    new_scores = g_sc[primary_rows]
+                    models[cid] = prev_fe
+                if prev_re is None:
+                    re_local_models.pop(cid, None)
+                else:
+                    re_local_models[cid] = prev_re
+                action = guard.on_divergence(
+                    cid, sweep=sweep,
+                    has_good_model=(prev_fe is not None
+                                    or prev_re is not None
+                                    or cid in initial_models),
+                    error=step_error)
+                if action == "freeze":
+                    new_scores = None
+                    break
+                lam[cid] = guard.next_lam(lam.get(cid, 0.0))
+            if new_scores is None:
+                continue  # frozen mid-sweep: nothing to commit
             assembled_memo.clear()  # model state changed
             total = residual + new_scores
             scores[cid] = new_scores

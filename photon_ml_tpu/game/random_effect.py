@@ -32,7 +32,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from photon_ml_tpu.compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from photon_ml_tpu.game.data import RandomEffectDataset, REBucket

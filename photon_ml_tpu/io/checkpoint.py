@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import logging
 import os
 import shutil
 import tempfile
@@ -28,6 +29,8 @@ from photon_ml_tpu.game.projector import RandomProjector
 from photon_ml_tpu.models.coefficients import Coefficients
 from photon_ml_tpu.models.glm import GeneralizedLinearModel
 from photon_ml_tpu.types import TaskType
+
+logger = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass
@@ -93,6 +96,12 @@ class CheckpointManager:
         for step in self.steps()[:-self.keep]:
             shutil.rmtree(os.path.join(self.root, f"step-{step}"),
                           ignore_errors=True)
+        # stale tmp dirs from a crashed/injected-fault save attempt (the
+        # atomic-rename protocol means they are never the live checkpoint)
+        for name in os.listdir(self.root):
+            if name.endswith(".tmp"):
+                shutil.rmtree(os.path.join(self.root, name),
+                              ignore_errors=True)
 
     # --- save/restore -----------------------------------------------------
     def save(self, step: int, state: CoordinateDescentState,
@@ -103,9 +112,6 @@ class CheckpointManager:
         lambda=10 run would silently mis-attribute the model."""
         if self.read_only:
             return os.path.join(self.root, f"step-{step}")
-        final = os.path.join(self.root, f"step-{step}")
-        tmp = tempfile.mkdtemp(prefix=f"step-{step}-", suffix=".tmp",
-                               dir=self.root)
         manifest = {
             "step": step,
             "sweep": state.sweep,
@@ -140,24 +146,76 @@ class CheckpointManager:
         for cid, sc in state.scores.items():
             arrays[f"scores:{cid}"] = sc
 
-        np.savez(os.path.join(tmp, "arrays.npz"), **arrays)
-        with open(os.path.join(tmp, "manifest.json"), "w") as f:
-            json.dump(manifest, f, indent=2)
-        if os.path.exists(final):
-            shutil.rmtree(final)
-        os.rename(tmp, final)
+        from photon_ml_tpu.resilience import fault_point, retry
+
+        final = os.path.join(self.root, f"step-{step}")
+
+        def attempt() -> None:
+            tmp = tempfile.mkdtemp(prefix=f"step-{step}-", suffix=".tmp",
+                                   dir=self.root)
+            np.savez(os.path.join(tmp, "arrays.npz"), **arrays)
+            with open(os.path.join(tmp, "manifest.json"), "w") as f:
+                json.dump(manifest, f, indent=2)
+            # the crash-mid-write window: tmp is fully written, the atomic
+            # rename has not happened — a kill here must leave the previous
+            # step as the loadable latest
+            fault_point("ckpt.save", step=step, path=final)
+            if os.path.exists(final):
+                # retire the old copy aside FIRST (".tmp" suffix keeps it
+                # out of steps()): rmtree-before-rename would open a window
+                # where a crash loses BOTH copies of this step
+                retired = tempfile.mkdtemp(prefix=f"step-{step}-retired-",
+                                           suffix=".tmp", dir=self.root)
+                os.rmdir(retired)
+                os.rename(final, retired)
+                os.rename(tmp, final)
+                shutil.rmtree(retired, ignore_errors=True)
+            else:
+                os.rename(tmp, final)
+
+        retry(attempt, name=f"ckpt.save:step-{step}")
         self._gc()
         return final
 
     def restore(self, step: Optional[int] = None,
                 expected_fingerprint: Optional[str] = None,
                 ) -> CoordinateDescentState:
+        from photon_ml_tpu.resilience import retry
+
+        if step is not None or self._pinned:
+            if step is None:
+                step = self.latest_step()
+                if step is None:
+                    raise FileNotFoundError(
+                        f"no checkpoints under {self.root}")
+            return retry(
+                lambda: self._restore_step(step, expected_fingerprint),
+                name=f"ckpt.restore:step-{step}")
+        steps = self.steps()
+        if not steps:
+            raise FileNotFoundError(f"no checkpoints under {self.root}")
+        # auto-select: walk back from the newest step past corrupt ones (a
+        # crashed writer can't corrupt a renamed step, but disks can) —
+        # resuming one boundary earlier beats dying. Fingerprint mismatches
+        # still propagate: older steps share the configuration.
+        last_error: Optional[BaseException] = None
+        for s in reversed(steps):
+            try:
+                return retry(
+                    lambda s=s: self._restore_step(s, expected_fingerprint),
+                    name=f"ckpt.restore:step-{s}")
+            except ValueError:
+                raise
+            except Exception as e:
+                logger.warning("checkpoint step-%d unreadable (%r); "
+                               "falling back to the previous step", s, e)
+                last_error = e
+        raise last_error
+
+    def _restore_step(self, step: int, expected_fingerprint: Optional[str],
+                      ) -> CoordinateDescentState:
         import jax.numpy as jnp
 
-        if step is None:
-            step = self.latest_step()
-            if step is None:
-                raise FileNotFoundError(f"no checkpoints under {self.root}")
         path = os.path.join(self.root, f"step-{step}")
         with open(os.path.join(path, "manifest.json")) as f:
             manifest = json.load(f)

@@ -85,6 +85,19 @@ def parse_input_columns(spec: str) -> InputColumnsNames:
     return InputColumnsNames(**overrides)
 
 
+def _read_records_with_retry(path: str) -> list:
+    """One file's records, under the resilience retry policy (transient
+    read errors — flaky network filesystems, injected ``io.read`` faults —
+    are retried with backoff; persistent ones re-raise unchanged)."""
+    from photon_ml_tpu.resilience import fault_point, retry
+
+    def attempt() -> list:
+        fault_point("io.read", path=path)
+        return list(iter_avro_file(path))
+
+    return retry(attempt, name=f"io.read:{os.path.basename(path)}")
+
+
 def _record_features(record: dict, bags: Optional[Sequence[str]],
                      features_field: str = "features"):
     """Yield (key, value) for the record's features, filtered by bag.
@@ -168,7 +181,7 @@ class AvroDataReader:
             native_out = self._read_native(files, id_columns, entity_vocabs)
             if native_out is not None:
                 return native_out
-        records = [r for p in files for r in iter_avro_file(p)]
+        records = [r for p in files for r in _read_records_with_retry(p)]
 
         index_maps = self.index_maps or self.build_index_maps(records)
         vocabs: dict[str, dict[str, int]] = {
@@ -246,8 +259,15 @@ class AvroDataReader:
         if not native.available():
             return None
 
+        from photon_ml_tpu.resilience import fault_point, retry
+
         def decode(p):
-            return native.decode_training_file(p, id_keys=tuple(id_columns))
+            def attempt():
+                fault_point("io.read", path=p)
+                return native.decode_training_file(p,
+                                                   id_keys=tuple(id_columns))
+
+            return retry(attempt, name=f"io.read:{os.path.basename(p)}")
 
         if len(files) > 1:
             from concurrent.futures import ThreadPoolExecutor

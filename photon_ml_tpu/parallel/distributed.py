@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from photon_ml_tpu.compat import shard_map
 
 from photon_ml_tpu.ops.design import ChunkedSparseDesign, CsrDesign, DenseDesign
 from photon_ml_tpu.ops.objective import GLMData, GLMObjective

@@ -44,7 +44,7 @@ _initialized = False
 def initialize(coordinator_address: Optional[str] = None,
                num_processes: Optional[int] = None,
                process_id: Optional[int] = None,
-               *, auto: bool = False) -> None:
+               *, auto: bool = False, retry_policy=None) -> None:
     """Form the multi-controller job (idempotent). On single-host runs this
     is a no-op; on TPU pods the args come from the environment.
 
@@ -53,6 +53,13 @@ def initialize(coordinator_address: Optional[str] = None,
     drivers' ``--multihost`` flag is fed on CPU/GPU clusters) → with
     ``auto=True``, bare ``jax.distributed.initialize()`` (JAX's own cluster
     auto-detection: TPU pod metadata, Slurm, etc.).
+
+    Connection attempts run under ``retry_policy`` (default: the
+    process-wide resilience policy — the drivers' ``--max-retries`` /
+    ``--retry-deadline-s`` flags), and a coordinator that stays
+    unreachable raises a :class:`RuntimeError` naming the address, this
+    process's index, and the attempt budget — not a raw backend hang or
+    traceback.
 
     Must run before ANY backend-touching JAX call — even
     ``jax.process_count()`` initializes the XLA backend, after which
@@ -88,9 +95,77 @@ def initialize(coordinator_address: Optional[str] = None,
                 jax.distributed.initialize()
                 _initialized = True
             return  # single-host
-    jax.distributed.initialize(coordinator_address=coordinator_address,
-                               num_processes=num_processes,
-                               process_id=process_id)
+    from photon_ml_tpu.resilience import fault_point, get_default_policy, \
+        retry
+
+    policy = retry_policy if retry_policy is not None \
+        else get_default_policy()
+    # the deadline must be HARD: jax.distributed.initialize BLOCKS
+    # internally (~300s default) waiting for the coordinator, so without
+    # capping its own timeout the retry deadline would never get a chance
+    # to fire. Budget each attempt an equal share of the deadline.
+    init_kwargs = {}
+    if policy.deadline_s is not None:
+        import inspect as _inspect
+
+        if ("initialization_timeout"
+                in _inspect.signature(jax.distributed.initialize).parameters):
+            init_kwargs["initialization_timeout"] = max(
+                1, int(np.ceil(policy.deadline_s / policy.max_attempts)))
+    attempts = [0]
+
+    def attempt() -> None:
+        attempts[0] += 1
+        fault_point("collective", op="initialize",
+                    coordinator=coordinator_address)
+        if (process_id not in (None, 0) and coordinator_address
+                and ":" in coordinator_address):
+            # reachability preflight (non-chief only — process 0 hosts the
+            # coordinator itself): some jax versions answer an unreachable
+            # coordinator with a C++ LOG(FATAL) process abort, which no
+            # Python handler can turn into the actionable error below;
+            # probing the socket first keeps the failure catchable. A
+            # worker legitimately starting BEFORE the coordinator must
+            # wait, not die — poll within this attempt's budget (jax's own
+            # default wait is 300s), through the retry module's sanctioned
+            # sleep so the wait is visible to the hygiene accounting.
+            import socket
+
+            from photon_ml_tpu.resilience.retry import _sleep
+
+            host, port = coordinator_address.rsplit(":", 1)
+            budget = init_kwargs.get("initialization_timeout", 300)
+            t_start = _time.monotonic()
+            while True:
+                try:
+                    socket.create_connection((host, int(port)),
+                                             timeout=min(budget, 10)).close()
+                    break
+                except OSError:
+                    if _time.monotonic() - t_start >= budget:
+                        raise
+                    _sleep(0.2)
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id, **init_kwargs)
+
+    import time as _time
+
+    t0 = _time.monotonic()
+    try:
+        retry(attempt, policy, name="multihost.initialize")
+    except Exception as e:
+        raise RuntimeError(
+            f"could not join the multi-controller job: coordinator "
+            f"{coordinator_address!r} unreachable from process "
+            f"{process_id if process_id is not None else '?'} of "
+            f"{num_processes} after {attempts[0]} attempt(s) over "
+            f"{_time.monotonic() - t0:.1f}s "
+            f"(deadline {policy.deadline_s}s, max attempts "
+            f"{policy.max_attempts}). Check that the coordinator process "
+            f"is up, PHOTON_COORDINATOR_ADDRESS is its reachable "
+            f"host:port, and every process agrees on "
+            f"PHOTON_NUM_PROCESSES; last error: {e!r}") from e
     _initialized = True
 
 
@@ -147,6 +222,12 @@ def _gather_stack(x: np.ndarray) -> np.ndarray:
     divergence). 8-byte dtypes ride through as uint32 word pairs."""
     from jax.experimental import multihost_utils
 
+    from photon_ml_tpu.resilience import fault_point
+
+    # injection-only, never retried: a unilateral second attempt at a
+    # collective would desync every other process — fault recovery for
+    # collectives is the caller's (symmetric) job
+    fault_point("collective", op="allgather", shape=tuple(x.shape))
     x = np.ascontiguousarray(x)
     if x.dtype.itemsize == 8 and not jax.config.jax_enable_x64:
         dtype = x.dtype

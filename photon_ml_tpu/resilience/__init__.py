@@ -1,0 +1,59 @@
+"""Resilience subsystem: deterministic fault injection, retry/backoff, and
+divergence guard/rollback for GAME training.
+
+The reference leans on Spark lineage + driver restarts for fault tolerance
+(SURVEY.md §5.4); the TPU-native port replaces that with first-class
+checkpointing plus this subsystem, which makes the training stack *use* the
+checkpoints to recover:
+
+- :mod:`photon_ml_tpu.resilience.faults` — a seedable, deterministic
+  :class:`FaultPlan` with named injection sites (``io.read``, ``ckpt.save``,
+  ``collective``, ``optimizer.step``, ``worker.stall``) threaded as no-op
+  hooks through the io/parallel/game layers. Inactive (the production
+  default) the hooks cost one module-global ``is None`` check.
+- :mod:`photon_ml_tpu.resilience.retry` — one ``retry(fn, policy)``
+  primitive (exponential backoff, deterministic jitter, deadline,
+  per-attempt :class:`~photon_ml_tpu.events.EventBus` emission) wrapped
+  around Avro reads, checkpoint save/restore, and multihost initialization.
+- :mod:`photon_ml_tpu.resilience.guard` — NaN/Inf divergence detection at
+  coordinate boundaries with rollback / regularization-backoff / freeze
+  semantics (see RESILIENCE.md).
+"""
+
+from photon_ml_tpu.resilience.faults import (
+    FaultPlan,
+    FaultSpec,
+    InjectedFault,
+    active_plan,
+    fault_point,
+    fault_value,
+    injected,
+)
+from photon_ml_tpu.resilience.guard import (
+    DivergenceError,
+    DivergenceGuard,
+    DivergencePolicy,
+)
+from photon_ml_tpu.resilience.retry import (
+    RetryPolicy,
+    get_default_policy,
+    retry,
+    set_default_policy,
+)
+
+__all__ = [
+    "FaultPlan",
+    "FaultSpec",
+    "InjectedFault",
+    "active_plan",
+    "fault_point",
+    "fault_value",
+    "injected",
+    "DivergenceError",
+    "DivergenceGuard",
+    "DivergencePolicy",
+    "RetryPolicy",
+    "get_default_policy",
+    "retry",
+    "set_default_policy",
+]

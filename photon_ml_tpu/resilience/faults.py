@@ -1,0 +1,271 @@
+"""Deterministic, seedable fault injection.
+
+A :class:`FaultPlan` names *injection sites* — fixed strings the framework
+threads through its hot paths as :func:`fault_point` / :func:`fault_value`
+calls — and decides, deterministically, which invocations of each site
+misbehave. The registered sites:
+
+========================  ====================================================
+``io.read``               one visit per (file, attempt) in the Avro readers
+``ckpt.save``             one visit per save attempt, *between* the tmp write
+                          and the atomic rename — the crash-mid-write window
+``collective``            host-side collectives (allgather/allreduce) and
+                          ``jax.distributed.initialize``
+``optimizer.step``        one visit per coordinate-descent coordinate step
+                          (value hook: ``mode="nan"`` corrupts the scores)
+``worker.stall``          one visit per sweep (``mode="stall"`` sleeps)
+========================  ====================================================
+
+Activation is explicit only: :func:`activate` / the :func:`injected` context
+manager, or the ``PHOTON_FAULT_PLAN`` environment variable (a JSON object or
+an ``@/path/to/plan.json`` reference) read once at import. With no active
+plan every hook returns after a single module-global ``is None`` check, so
+production paths pay nothing.
+
+Determinism: explicit ``at`` invocation indices always fire; ``rate`` draws
+ride a per-site ``numpy`` generator seeded from ``(plan.seed, crc32(site))``,
+so two plans built from the same spec fire identically — what makes a chaos
+sweep reproducible and a bisection meaningful.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import os
+import zlib
+from typing import Any, Mapping, Optional, Sequence
+
+import numpy as np
+
+#: canonical site names (free-form strings are accepted; these are the ones
+#: the framework threads)
+SITES = ("io.read", "ckpt.save", "collective", "optimizer.step",
+         "worker.stall")
+
+_MODES = ("raise", "nan", "stall")
+
+
+class InjectedFault(RuntimeError):
+    """The exception raised by ``mode="raise"`` specs (retryable)."""
+
+    def __init__(self, site: str, index: int, message: str = ""):
+        self.site = site
+        self.index = index
+        super().__init__(
+            message or f"injected fault at site {site!r} (invocation "
+                       f"#{index})")
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One rule: which invocations of ``site`` misbehave, and how.
+
+    ``at`` lists explicit 0-based invocation indices; ``rate`` adds a
+    seeded per-invocation probability on top. ``max_fires`` caps total
+    firings (None = unlimited). ``mode``: ``"raise"`` raises
+    :class:`InjectedFault`; ``"nan"`` corrupts the value passing through a
+    :func:`fault_value` hook; ``"stall"`` sleeps ``stall_seconds`` (through
+    the retry module's sanctioned sleep).
+    """
+
+    site: str
+    at: tuple[int, ...] = ()
+    rate: float = 0.0
+    max_fires: Optional[int] = None
+    mode: str = "raise"
+    stall_seconds: float = 0.0
+    message: str = ""
+
+    def __post_init__(self):
+        if self.mode not in _MODES:
+            raise ValueError(f"fault mode must be one of {_MODES}, "
+                             f"got {self.mode!r}")
+        if not (0.0 <= self.rate <= 1.0):
+            raise ValueError(f"rate must be in [0, 1], got {self.rate}")
+
+
+@dataclasses.dataclass
+class FaultRecord:
+    """Audit entry for one firing (mirrored as a ``fault_injected`` event)."""
+
+    site: str
+    index: int
+    mode: str
+    context: dict
+
+
+class FaultPlan:
+    """Deterministic registry of :class:`FaultSpec` rules.
+
+    Thread-compatibility note: visits mutate per-site counters; the
+    training drivers visit sites from the main thread only (the reader's
+    decode pool calls :func:`fault_point` from workers, where the GIL makes
+    the counter increment atomic — ordering across files is then
+    nondeterministic, so specs targeting ``io.read`` in multi-file runs
+    should prefer ``rate`` over ``at``).
+    """
+
+    def __init__(self, specs: Sequence[FaultSpec] = (), seed: int = 0,
+                 bus=None):
+        self.specs = tuple(specs)
+        self.seed = int(seed)
+        self.bus = bus
+        self.records: list[FaultRecord] = []
+        self._counts: dict[str, int] = {}
+        self._fires: dict[int, int] = {i: 0 for i in range(len(self.specs))}
+        self._rngs: dict[str, np.random.Generator] = {}
+
+    # --- bookkeeping ------------------------------------------------------
+    def visits(self, site: str) -> int:
+        """How many times ``site`` has been visited so far."""
+        return self._counts.get(site, 0)
+
+    def fired(self, site: Optional[str] = None) -> list[FaultRecord]:
+        if site is None:
+            return list(self.records)
+        return [r for r in self.records if r.site == site]
+
+    def _rng(self, site: str) -> np.random.Generator:
+        rng = self._rngs.get(site)
+        if rng is None:
+            rng = np.random.default_rng(
+                (self.seed, zlib.crc32(site.encode("utf-8"))))
+            self._rngs[site] = rng
+        return rng
+
+    # --- the decision -----------------------------------------------------
+    def visit(self, site: str, context: Mapping[str, Any]) -> Optional[str]:
+        """Advance ``site``'s invocation counter and apply the first firing
+        spec. Returns the fired mode (``"nan"``/``"stall"``) for value
+        hooks, raises for ``"raise"`` specs, None when nothing fires."""
+        index = self._counts.get(site, 0)
+        self._counts[site] = index + 1
+        for i, spec in enumerate(self.specs):
+            if spec.site != site:
+                continue
+            if spec.max_fires is not None and self._fires[i] >= spec.max_fires:
+                continue
+            fire = index in spec.at
+            if not fire and spec.rate > 0.0:
+                fire = float(self._rng(site).random()) < spec.rate
+            if not fire:
+                continue
+            self._fires[i] += 1
+            record = FaultRecord(site=site, index=index, mode=spec.mode,
+                                 context=dict(context))
+            self.records.append(record)
+            self._post(record)
+            if spec.mode == "raise":
+                raise InjectedFault(site, index, spec.message)
+            if spec.mode == "stall":
+                from photon_ml_tpu.resilience.retry import _sleep
+
+                _sleep(spec.stall_seconds)
+                return "stall"
+            return spec.mode
+        return None
+
+    def _post(self, record: FaultRecord) -> None:
+        bus = self.bus
+        if bus is None:
+            from photon_ml_tpu.events import GLOBAL_BUS as bus
+        bus.post("fault_injected", site=record.site, index=record.index,
+                 mode=record.mode, **record.context)
+
+    # --- (de)serialization ------------------------------------------------
+    @classmethod
+    def from_json(cls, obj: "str | Mapping") -> "FaultPlan":
+        """Build from a JSON object/string:
+        ``{"seed": 0, "specs": [{"site": "io.read", "at": [0]}, ...]}``."""
+        if isinstance(obj, str):
+            obj = json.loads(obj)
+        specs = [FaultSpec(site=s["site"],
+                           at=tuple(int(x) for x in s.get("at", ())),
+                           rate=float(s.get("rate", 0.0)),
+                           max_fires=(None if s.get("max_fires") is None
+                                      else int(s["max_fires"])),
+                           mode=s.get("mode", "raise"),
+                           stall_seconds=float(s.get("stall_seconds", 0.0)),
+                           message=s.get("message", ""))
+                 for s in obj.get("specs", ())]
+        return cls(specs, seed=int(obj.get("seed", 0)))
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "seed": self.seed,
+            "specs": [{
+                "site": s.site, "at": list(s.at), "rate": s.rate,
+                "max_fires": s.max_fires, "mode": s.mode,
+                "stall_seconds": s.stall_seconds, "message": s.message,
+            } for s in self.specs],
+        }, sort_keys=True)
+
+
+# ---------------------------------------------------------------------------
+# Global activation + the hooks
+# ---------------------------------------------------------------------------
+
+_ACTIVE: Optional[FaultPlan] = None
+
+
+def active_plan() -> Optional[FaultPlan]:
+    return _ACTIVE
+
+
+def activate(plan: FaultPlan) -> FaultPlan:
+    global _ACTIVE
+    _ACTIVE = plan
+    return plan
+
+
+def deactivate() -> None:
+    global _ACTIVE
+    _ACTIVE = None
+
+
+@contextlib.contextmanager
+def injected(plan: FaultPlan):
+    """Scope a plan's activation (test/chaos-sweep entry point)."""
+    global _ACTIVE
+    prev = _ACTIVE
+    _ACTIVE = plan
+    try:
+        yield plan
+    finally:
+        _ACTIVE = prev
+
+
+def fault_point(site: str, **context: Any) -> None:
+    """Injection hook for control-flow sites. No active plan (the
+    production default): returns after one global ``is None`` check."""
+    plan = _ACTIVE
+    if plan is None:
+        return
+    plan.visit(site, context)
+
+
+def fault_value(site: str, value, **context: Any):
+    """Injection hook threaded through a data value (e.g. the coordinate
+    step's new scores). ``mode="nan"`` corrupts the value; ``"raise"``
+    raises; inactive plans pass the value through untouched."""
+    plan = _ACTIVE
+    if plan is None:
+        return value
+    if plan.visit(site, context) == "nan":
+        return value * float("nan")
+    return value
+
+
+def _activate_from_env() -> None:
+    spec = os.environ.get("PHOTON_FAULT_PLAN")
+    if not spec:
+        return
+    if spec.startswith("@"):
+        with open(spec[1:]) as f:
+            spec = f.read()
+    activate(FaultPlan.from_json(spec))
+
+
+_activate_from_env()

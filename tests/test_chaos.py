@@ -1,0 +1,202 @@
+"""Chaos integration test (tier-1): one fault of each class — a failed
+Avro read, a failed checkpoint rename, a diverging coordinate, plus a
+worker stall — injected into one small single-process GAME training run.
+
+Asserts the run COMPLETES, with: the correct final model shape, the
+expected fault/retry/rollback/freeze events in order, and a loadable
+latest checkpoint. This is the end-to-end contract of the resilience
+subsystem (RESILIENCE.md); the per-primitive tests live in
+``tests/test_resilience.py``.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.cli import train_game as train_game_cli
+from photon_ml_tpu.events import GLOBAL_BUS
+from photon_ml_tpu.io.checkpoint import CheckpointManager
+from photon_ml_tpu.io.data_reader import write_training_examples
+from photon_ml_tpu.resilience import (
+    FaultPlan,
+    FaultSpec,
+    get_default_policy,
+    injected,
+    set_default_policy,
+)
+
+
+@pytest.fixture(autouse=True)
+def _restore_default_retry_policy():
+    """The CLI installs a process-wide retry policy from its flags; don't
+    leak it into later tests."""
+    prev = get_default_policy()
+    yield
+    set_default_policy(prev)
+
+SHARDS = "global=fixed|intercept,user=user|noIntercept"
+COORDS = [
+    "global=fixed,shard=global,reg=L2",
+    "perUser=random,entity=userId,shard=user,reg=L2",
+]
+
+
+def make_avro_dataset(path, n=400, d_fixed=3, d_user=2, n_users=5, seed=0):
+    prng = np.random.default_rng(777)
+    w = prng.normal(size=d_fixed)
+    u = 1.5 * prng.normal(size=(n_users, d_user))
+    rng = np.random.default_rng(seed)
+    xf = rng.normal(size=(n, d_fixed))
+    xu = rng.normal(size=(n, d_user))
+    users = rng.integers(0, n_users, size=n)
+    margin = xf @ w + np.einsum("nd,nd->n", xu, u[users])
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-margin))).astype(float)
+    records = []
+    for i in range(n):
+        feats = [{"name": f"fixed.x{j}", "term": "", "value": float(xf[i, j])}
+                 for j in range(d_fixed)]
+        feats += [{"name": f"user.z{j}", "term": "", "value": float(xu[i, j])}
+                  for j in range(d_user)]
+        records.append({
+            "uid": str(i), "response": float(y[i]), "offset": None,
+            "weight": None, "features": feats,
+            "metadataMap": {"userId": f"u{users[i]}"},
+        })
+    write_training_examples(str(path), records)
+    return str(path)
+
+
+def first_index(events, name, **match):
+    for i, e in enumerate(events):
+        if e.name == name and all(e.payload.get(k) == v
+                                  for k, v in match.items()):
+            return i
+    raise AssertionError(
+        f"no {name!r} event matching {match} in "
+        f"{[(e.name, dict(e.payload)) for e in events]}")
+
+
+def test_chaos_game_run_survives_one_fault_of_each_class(tmp_path):
+    train = make_avro_dataset(tmp_path / "train.avro", n=400, seed=0)
+    val = make_avro_dataset(tmp_path / "val.avro", n=200, seed=1)
+    out = str(tmp_path / "out")
+
+    # optimizer.step visit order with update_sequence [global, perUser] and
+    # 2 sweeps: 0=global/s0, 1=perUser/s0, 2=global/s1, 3=perUser/s1,
+    # 4=perUser/s1-retry. Corrupting 3 AND 4 exhausts --max-retries=1:
+    # one rollback-retry, then freeze.
+    plan = FaultPlan([
+        FaultSpec("io.read", at=(0,)),            # first read attempt dies
+        FaultSpec("ckpt.save", at=(0,)),          # first commit dies
+        FaultSpec("optimizer.step", at=(3, 4), mode="nan"),
+        FaultSpec("worker.stall", at=(1,), mode="stall",
+                  stall_seconds=0.01),            # breathes through retry's
+                                                  # sanctioned sleep
+    ], seed=0)
+
+    events = []
+    unsub = GLOBAL_BUS.subscribe(lambda e: events.append(e))
+    try:
+        with injected(plan):
+            result = train_game_cli.run([
+                "--training-data", train, "--validation-data", val,
+                "--output-dir", out,
+                "--feature-shards", SHARDS,
+                "--coordinates", *COORDS,
+                "--update-sequence", "global,perUser",
+                "--cd-iterations", "2",
+                "--grid", "global=0.1", "perUser=1",
+                "--evaluators", "AUC",
+                "--checkpoint",
+                "--max-retries", "1",
+                "--on-divergence", "rollback",
+            ])
+    finally:
+        unsub()
+
+    # --- training completed, model written, evaluation finite -------------
+    assert result["n_configurations"] == 1
+    assert os.path.exists(os.path.join(out, "best", "model-metadata.json"))
+    assert np.isfinite(result["best_evaluation"]["AUC"])
+    assert result["best_evaluation"]["AUC"] > 0.5  # degraded, not garbage
+
+    # every fault class actually fired
+    assert {r.site for r in plan.records} == {
+        "io.read", "ckpt.save", "optimizer.step", "worker.stall"}
+
+    # --- expected events, in order ----------------------------------------
+    # failed read -> retried -> succeeded
+    i_read = first_index(events, "fault_injected", site="io.read")
+    i_read_retry = first_index(events, "retry_attempt")
+    i_read_ok = first_index(events, "retry_succeeded")
+    assert i_read < i_read_retry < i_read_ok
+    assert events[i_read_retry].payload["op"].startswith("io.read")
+
+    # failed checkpoint commit -> retried -> succeeded
+    i_ck = first_index(events, "fault_injected", site="ckpt.save")
+    assert i_ck > i_read_ok
+    i_ck_ok = next(i for i, e in enumerate(events)
+                   if e.name == "retry_succeeded"
+                   and e.payload["op"].startswith("ckpt.save"))
+    assert i_ck < i_ck_ok
+
+    # diverging coordinate -> detected -> rolled back -> detected -> frozen
+    i_nan = first_index(events, "fault_injected", site="optimizer.step")
+    i_det = first_index(events, "divergence_detected", coordinate="perUser")
+    i_rb = first_index(events, "coordinate_rollback", coordinate="perUser")
+    i_fr = first_index(events, "coordinate_frozen", coordinate="perUser")
+    assert i_ck_ok < i_nan < i_det < i_rb < i_fr
+    assert events[i_rb].payload["attempt"] == 1
+    assert events[i_fr].payload["failures"] == 2
+
+    # --- the latest checkpoint is complete and loadable -------------------
+    mgr = CheckpointManager(os.path.join(out, "checkpoints"))
+    state = mgr.restore()
+    assert set(state.model.coordinates) == {"global", "perUser"}
+    for cid, cm in state.model.coordinates.items():
+        arrays = ([cm.model.coefficients.means] if cid == "global"
+                  else [cm.coeffs])
+        for a in arrays:
+            assert np.isfinite(np.asarray(a)).all(), cid
+    # the frozen coordinate's scores in the checkpoint are finite too (the
+    # NaN attempt was rolled back, never committed)
+    for cid, sc in state.scores.items():
+        assert np.isfinite(sc).all(), cid
+
+
+def test_no_fault_plan_is_bit_identical(tmp_path):
+    """Acceptance: with no FaultPlan active and default policies, the
+    training entry point produces bit-identical models — the guard's
+    checks are pure reads and retries only trigger on exceptions."""
+    train = make_avro_dataset(tmp_path / "train.avro", n=300, seed=2)
+    argv = [
+        "--training-data", train,
+        "--feature-shards", SHARDS,
+        "--coordinates", *COORDS,
+        "--update-sequence", "global,perUser",
+        "--grid", "global=0.1", "perUser=1",
+    ]
+    train_game_cli.run(argv + ["--output-dir", str(tmp_path / "o1")])
+    # second run opts into every guard mode knob the CLI exposes
+    train_game_cli.run(argv + ["--output-dir", str(tmp_path / "o2"),
+                               "--on-divergence", "rollback",
+                               "--max-retries", "3"])
+
+    def coeffs(out):
+        import json
+
+        path = os.path.join(out, "best")
+        with open(os.path.join(path, "model-metadata.json")) as f:
+            meta = json.load(f)
+        out_arrays = {}
+        for cid, info in meta["coordinates"].items():
+            from photon_ml_tpu.io.avro import iter_avro_file
+
+            part = os.path.join(path, info["type"], cid, "coefficients",
+                                "part-00000.avro")
+            out_arrays[cid] = [r for r in iter_avro_file(part)]
+        return out_arrays
+
+    a, b = coeffs(str(tmp_path / "o1")), coeffs(str(tmp_path / "o2"))
+    assert a == b

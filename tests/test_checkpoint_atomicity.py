@@ -1,0 +1,141 @@
+"""Crash-mid-write atomicity: a kill between the checkpoint tmp-write and
+the atomic rename (injected through the ``ckpt.save`` fault site, which sits
+exactly in that window) must leave the latest COMPLETE step loadable — for
+both the single-process :class:`CheckpointManager` and the multi-process
+per-sweep state files."""
+
+import os
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.events import GLOBAL_BUS
+from photon_ml_tpu.game.model import FixedEffectModel, GameModel
+from photon_ml_tpu.io.checkpoint import (
+    CheckpointManager,
+    CoordinateDescentState,
+)
+from photon_ml_tpu.models.coefficients import Coefficients
+from photon_ml_tpu.models.glm import GeneralizedLinearModel
+from photon_ml_tpu.resilience import (
+    FaultPlan,
+    FaultSpec,
+    InjectedFault,
+    injected,
+)
+from photon_ml_tpu.types import TaskType
+
+TASK = TaskType.LOGISTIC_REGRESSION
+
+
+def make_state(value: float, sweep: int = 0) -> CoordinateDescentState:
+    model = GameModel(coordinates={
+        "g": FixedEffectModel(
+            model=GeneralizedLinearModel(
+                coefficients=Coefficients(
+                    means=np.full(3, value, np.float32)),
+                task=TASK),
+            feature_shard_id="g"),
+    }, task=TASK)
+    return CoordinateDescentState(
+        sweep=sweep, coordinate_index=0, model=model,
+        scores={"g": np.full(5, value, np.float32)})
+
+
+def saved_means(state: CoordinateDescentState) -> np.ndarray:
+    return np.asarray(state.model.coordinates["g"].model.coefficients.means)
+
+
+def crash_plan():
+    """Fires on EVERY ckpt.save attempt — defeats the retry so the save
+    fails outright, simulating a hard kill in the commit window."""
+    from photon_ml_tpu.events import EventBus
+
+    return FaultPlan([FaultSpec("ckpt.save", rate=1.0)], bus=EventBus())
+
+
+class TestCheckpointManagerAtomicity:
+    def test_crash_mid_write_keeps_previous_step(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), keep=3)
+        mgr.save(1, make_state(1.0), fingerprint="fp")
+        with injected(crash_plan()):
+            with pytest.raises(InjectedFault):
+                mgr.save(2, make_state(2.0), fingerprint="fp")
+        # the interrupted step never appears; the previous one loads
+        assert mgr.steps() == [1]
+        assert mgr.latest_step() == 1
+        restored = mgr.restore(expected_fingerprint="fp")
+        np.testing.assert_array_equal(saved_means(restored),
+                                      np.full(3, 1.0, np.float32))
+        # a later clean save commits AND clears the stale tmp debris
+        mgr.save(2, make_state(2.0), fingerprint="fp")
+        assert mgr.latest_step() == 2
+        assert [n for n in os.listdir(tmp_path) if n.endswith(".tmp")] == []
+
+    def test_crash_during_overwrite_keeps_old_copy(self, tmp_path):
+        """Re-saving an existing step must never pass through a state where
+        NO copy of that step exists (the old rmtree-then-rename did)."""
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(5, make_state(1.0), fingerprint="fp")
+        with injected(crash_plan()):
+            with pytest.raises(InjectedFault):
+                mgr.save(5, make_state(99.0), fingerprint="fp")
+        restored = mgr.restore(5, expected_fingerprint="fp")
+        np.testing.assert_array_equal(saved_means(restored),
+                                      np.full(3, 1.0, np.float32))
+
+    def test_single_transient_fault_is_retried_through(self, tmp_path):
+        names = []
+        unsub = GLOBAL_BUS.subscribe(lambda e: names.append(e.name))
+        try:
+            mgr = CheckpointManager(str(tmp_path))
+            plan = FaultPlan([FaultSpec("ckpt.save", at=(0,))])
+            with injected(plan):
+                mgr.save(1, make_state(3.0), fingerprint="fp")
+        finally:
+            unsub()
+        assert mgr.latest_step() == 1
+        np.testing.assert_array_equal(
+            saved_means(mgr.restore(expected_fingerprint="fp")),
+            np.full(3, 3.0, np.float32))
+        assert names[:3] == ["fault_injected", "retry_attempt",
+                             "retry_succeeded"]
+
+    def test_restore_walks_past_corrupt_latest(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(1, make_state(1.0), fingerprint="fp")
+        mgr.save(2, make_state(2.0), fingerprint="fp")
+        os.unlink(tmp_path / "step-2" / "manifest.json")
+        restored = mgr.restore(expected_fingerprint="fp")
+        np.testing.assert_array_equal(saved_means(restored),
+                                      np.full(3, 1.0, np.float32))
+        # explicit step selection still fails loudly
+        with pytest.raises(Exception):
+            mgr.restore(2, expected_fingerprint="fp")
+
+
+class TestMultiProcessCheckpointAtomicity:
+    def test_crash_mid_write_keeps_previous_sweep(self, tmp_path):
+        from photon_ml_tpu.game.multiprocess import (
+            _mp_ckpt_latest,
+            _mp_ckpt_load,
+            _mp_ckpt_save,
+        )
+
+        root = str(tmp_path)
+        _mp_ckpt_save(root, 0, "fp", {"g": np.ones(4, np.float32)}, {}, {})
+        assert _mp_ckpt_latest(root) == 0
+        with injected(crash_plan()):
+            with pytest.raises(InjectedFault):
+                _mp_ckpt_save(root, 1, "fp",
+                              {"g": np.full(4, 9.0, np.float32)}, {}, {})
+        # the interrupted sweep is invisible; sweep 0 still loads
+        assert _mp_ckpt_latest(root) == 0
+        scores, re_models, fe_models, history = _mp_ckpt_load(
+            root, 0, "fp", TASK, {}, {})
+        np.testing.assert_array_equal(scores["g"], np.ones(4, np.float32))
+        assert re_models == {} and fe_models == {} and history == []
+        # recovery: the next clean save commits sweep 1
+        _mp_ckpt_save(root, 1, "fp", {"g": np.full(4, 2.0, np.float32)},
+                      {}, {})
+        assert _mp_ckpt_latest(root) == 1

@@ -1,0 +1,359 @@
+"""Resilience subsystem tests: fault plans, retry/backoff, divergence guard,
+CLI config round-trip, and multihost-initialize error surfacing.
+
+The chaos integration test (one fault of each class through a full GAME
+run) lives in ``tests/test_chaos.py``; checkpoint crash-mid-write tests in
+``tests/test_checkpoint_atomicity.py``.
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.events import EventBus
+from photon_ml_tpu.resilience import (
+    DivergenceError,
+    DivergenceGuard,
+    DivergencePolicy,
+    FaultPlan,
+    FaultSpec,
+    InjectedFault,
+    RetryPolicy,
+    fault_point,
+    fault_value,
+    injected,
+    retry,
+)
+from photon_ml_tpu.resilience import faults as faults_mod
+
+
+class TestFaultPlan:
+    def test_inactive_is_a_noop(self):
+        """Zero dispatch with no active plan: the hook touches no plan
+        state and posts no events — the production-path contract."""
+        assert faults_mod.active_plan() is None
+        plan = FaultPlan([FaultSpec("io.read", at=(0,))])
+        bus_events = []
+        from photon_ml_tpu.events import GLOBAL_BUS
+
+        unsub = GLOBAL_BUS.subscribe(lambda e: bus_events.append(e))
+        try:
+            fault_point("io.read", path="x")
+            out = fault_value("optimizer.step", 123, coordinate="c")
+        finally:
+            unsub()
+        assert out == 123
+        assert plan.visits("io.read") == 0
+        assert plan.records == []
+        assert bus_events == []
+
+    def test_at_index_fires_deterministically(self):
+        plan = FaultPlan([FaultSpec("io.read", at=(1,))], bus=EventBus())
+        with injected(plan):
+            fault_point("io.read")  # invocation 0: clean
+            with pytest.raises(InjectedFault):
+                fault_point("io.read")  # invocation 1: fires
+            fault_point("io.read")  # invocation 2: clean
+        assert [r.index for r in plan.fired("io.read")] == [1]
+
+    def test_rate_is_seed_deterministic(self):
+        def firing_indices(seed):
+            plan = FaultPlan([FaultSpec("io.read", rate=0.3,
+                                        mode="nan")],
+                             seed=seed, bus=EventBus())
+            with injected(plan):
+                for _ in range(50):
+                    fault_value("io.read", 1.0)
+            return [r.index for r in plan.fired()]
+
+        a, b = firing_indices(7), firing_indices(7)
+        assert a == b and a  # deterministic and non-empty
+        assert firing_indices(8) != a
+
+    def test_max_fires_caps(self):
+        plan = FaultPlan([FaultSpec("io.read", rate=1.0, max_fires=2,
+                                    mode="nan")], bus=EventBus())
+        with injected(plan):
+            for _ in range(5):
+                fault_value("io.read", 1.0)
+        assert len(plan.fired()) == 2
+
+    def test_nan_mode_corrupts_value(self):
+        plan = FaultPlan([FaultSpec("optimizer.step", at=(0,), mode="nan")],
+                         bus=EventBus())
+        with injected(plan):
+            bad = fault_value("optimizer.step", np.ones(3, np.float32))
+            good = fault_value("optimizer.step", np.ones(3, np.float32))
+        assert np.isnan(bad).all()
+        assert (good == 1.0).all()
+
+    def test_stall_mode_routes_through_retry_sleep(self, monkeypatch):
+        import sys
+
+        # the package re-exports the retry FUNCTION under the same name as
+        # the module, so go through sys.modules for the module object
+        retry_mod = sys.modules["photon_ml_tpu.resilience.retry"]
+        slept = []
+        monkeypatch.setattr(retry_mod, "_sleep", lambda s: slept.append(s))
+        plan = FaultPlan([FaultSpec("worker.stall", at=(0,), mode="stall",
+                                    stall_seconds=3.5)], bus=EventBus())
+        with injected(plan):
+            fault_point("worker.stall", sweep=0)
+        assert slept == [3.5]
+
+    def test_json_round_trip(self):
+        plan = FaultPlan([
+            FaultSpec("io.read", at=(0, 2), mode="raise", message="boom"),
+            FaultSpec("optimizer.step", rate=0.5, max_fires=3, mode="nan"),
+        ], seed=42)
+        clone = FaultPlan.from_json(plan.to_json())
+        assert clone.to_json() == plan.to_json()
+        assert clone.seed == 42
+        assert clone.specs == plan.specs
+
+    def test_fired_posts_event(self):
+        bus = EventBus()
+        seen = []
+        bus.subscribe(lambda e: seen.append(e))
+        plan = FaultPlan([FaultSpec("ckpt.save", at=(0,), mode="nan")],
+                         bus=bus)
+        with injected(plan):
+            fault_value("ckpt.save", 1.0, step=3)
+        assert [e.name for e in seen] == ["fault_injected"]
+        assert seen[0].payload["site"] == "ckpt.save"
+        assert seen[0].payload["step"] == 3
+
+
+class _FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+    def sleep(self, s):
+        self.now += s
+
+
+class TestRetry:
+    def test_backoff_sequence_is_seed_deterministic(self):
+        p = RetryPolicy(base_delay_s=0.1, multiplier=2.0, max_delay_s=1.0,
+                        jitter=0.2, seed=5)
+        a = list(itertools.islice(p.delays(), 6))
+        b = list(itertools.islice(p.delays(), 6))
+        assert a == b
+        # exponential envelope with bounded jitter, capped at max_delay
+        for k, d in enumerate(a):
+            base = min(0.1 * 2.0 ** k, 1.0)
+            assert 0.8 * base <= d <= 1.2 * base
+        assert a != list(itertools.islice(
+            RetryPolicy(base_delay_s=0.1, jitter=0.2, seed=6).delays(), 6))
+
+    def test_succeeds_after_transient_failures(self):
+        bus = EventBus()
+        names = []
+        bus.subscribe(lambda e: names.append(e.name))
+        clock = _FakeClock()
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise IOError("transient")
+            return "ok"
+
+        out = retry(flaky, RetryPolicy(max_attempts=3), bus=bus,
+                    sleep=clock.sleep, clock=clock)
+        assert out == "ok"
+        assert names == ["retry_attempt", "retry_attempt", "retry_succeeded"]
+
+    def test_exhaustion_reraises_original(self):
+        bus = EventBus()
+        names = []
+        bus.subscribe(lambda e: names.append(e.name))
+        clock = _FakeClock()
+
+        def broken():
+            raise ValueError("permanent")
+
+        with pytest.raises(ValueError, match="permanent"):
+            retry(broken, RetryPolicy(max_attempts=3), bus=bus,
+                  sleep=clock.sleep, clock=clock)
+        assert names == ["retry_attempt", "retry_attempt", "retry_exhausted"]
+
+    def test_deadline_never_sleeps_past_it(self):
+        """The retry gives up rather than sleep into a deadline it would
+        blow — total elapsed stays under deadline_s."""
+        bus = EventBus()
+        events = []
+        bus.subscribe(lambda e: events.append(e))
+        clock = _FakeClock()
+        policy = RetryPolicy(max_attempts=100, base_delay_s=0.4,
+                             multiplier=1.0, jitter=0.0, deadline_s=1.0)
+
+        def broken():
+            clock.now += 0.1  # each attempt costs 0.1s of work
+            raise IOError("down")
+
+        with pytest.raises(IOError):
+            retry(broken, policy, bus=bus, sleep=clock.sleep, clock=clock)
+        assert clock.now <= 1.0
+        assert events[-1].name == "retry_exhausted"
+        assert events[-1].payload["deadline_hit"] is True
+        assert events[-1].payload["attempts"] < 100
+
+    def test_non_retryable_propagates_immediately(self):
+        calls = []
+
+        def broken():
+            calls.append(1)
+            raise KeyError("nope")
+
+        with pytest.raises(KeyError):
+            retry(broken, RetryPolicy(max_attempts=5, retry_on=(OSError,)),
+                  bus=EventBus(), sleep=lambda s: None)
+        assert len(calls) == 1
+
+    def test_first_try_success_posts_nothing(self):
+        bus = EventBus()
+        seen = []
+        bus.subscribe(lambda e: seen.append(e))
+        assert retry(lambda: 42, RetryPolicy(), bus=bus) == 42
+        assert seen == []
+
+
+class TestDivergenceGuard:
+    def test_healthy_is_a_pure_read(self):
+        g = DivergenceGuard(DivergencePolicy(mode="rollback"),
+                            bus=EventBus())
+        scores = np.ones(4, np.float32)
+        assert g.healthy(None, scores)
+        assert not g.healthy(None, np.array([1.0, np.inf]))
+        assert g.failures == {} and g.frozen == set()
+
+    def test_fail_mode_raises(self):
+        g = DivergenceGuard(DivergencePolicy(mode="fail"), bus=EventBus())
+        with pytest.raises(DivergenceError, match="diverged at sweep 1"):
+            g.on_divergence("re", sweep=1, has_good_model=True)
+
+    def test_rollback_then_freeze_event_order(self):
+        bus = EventBus()
+        names = []
+        bus.subscribe(lambda e: names.append(e.name))
+        g = DivergenceGuard(DivergencePolicy(mode="rollback", max_retries=2),
+                            bus=bus)
+        assert g.on_divergence("re", sweep=0, has_good_model=True) == "retry"
+        assert g.on_divergence("re", sweep=0, has_good_model=True) == "retry"
+        assert g.on_divergence("re", sweep=0, has_good_model=True) == "freeze"
+        assert "re" in g.frozen
+        assert names == [
+            "divergence_detected", "coordinate_rollback",
+            "divergence_detected", "coordinate_rollback",
+            "divergence_detected", "coordinate_frozen",
+        ]
+
+    def test_freeze_mode_freezes_immediately(self):
+        g = DivergenceGuard(DivergencePolicy(mode="freeze"), bus=EventBus())
+        assert g.on_divergence("g", sweep=0, has_good_model=True) == "freeze"
+
+    def test_freeze_without_model_raises(self):
+        g = DivergenceGuard(DivergencePolicy(mode="freeze"), bus=EventBus())
+        with pytest.raises(DivergenceError, match="nothing to freeze"):
+            g.on_divergence("g", sweep=0, has_good_model=False)
+
+    def test_next_lam_backoff(self):
+        g = DivergenceGuard(
+            DivergencePolicy(mode="rollback", reg_backoff=10.0),
+            bus=EventBus())
+        assert g.next_lam(0.5) == 5.0
+        assert g.next_lam(0.0) == 10.0  # 0 would retry the same solve
+
+
+class TestResilienceConfig:
+    def test_dict_round_trip(self):
+        import json
+
+        from photon_ml_tpu.cli.config import ResilienceConfig
+
+        cfg = ResilienceConfig(max_retries=5, retry_deadline_s=30.0,
+                               on_divergence="rollback", reg_backoff=3.0)
+        wire = json.dumps(cfg.as_dict())
+        assert ResilienceConfig.from_dict(json.loads(wire)) == cfg
+        # defaults round-trip too (None deadline survives JSON)
+        dflt = ResilienceConfig()
+        assert ResilienceConfig.from_dict(
+            json.loads(json.dumps(dflt.as_dict()))) == dflt
+
+    def test_flags_reach_the_config(self):
+        import argparse
+
+        from photon_ml_tpu.cli.config import (
+            add_resilience_flags,
+            resilience_from_args,
+        )
+
+        p = argparse.ArgumentParser()
+        add_resilience_flags(p)
+        cfg = resilience_from_args(p.parse_args(
+            ["--max-retries", "4", "--retry-deadline-s", "12",
+             "--on-divergence", "freeze"]))
+        assert cfg.max_retries == 4
+        assert cfg.retry_deadline_s == 12.0
+        assert cfg.on_divergence == "freeze"
+        policy = cfg.retry_policy()
+        assert policy.max_attempts == 5  # retries, not attempts
+        assert policy.deadline_s == 12.0
+        guard = cfg.guard()
+        assert guard.policy.mode == "freeze"
+
+    def test_both_drivers_expose_the_flags(self):
+        from photon_ml_tpu.cli import train_game, train_glm
+
+        for build in (train_game.build_parser, train_glm.build_parser):
+            args = build().parse_args(
+                ["--training-data", "x", "--output-dir", "y"]
+                + (["--feature-shards", "g=*", "--coordinates",
+                    "g=fixed,shard=g", "--update-sequence", "g"]
+                   if build is train_game.build_parser else []))
+            assert args.max_retries == 2
+            assert args.retry_deadline_s is None
+            assert args.on_divergence == "fail"
+
+
+class TestMultihostInitialize:
+    def test_unreachable_coordinator_error_is_actionable(self, monkeypatch):
+        from photon_ml_tpu.parallel import multihost
+
+        monkeypatch.setattr(multihost, "_initialized", False)
+        attempts = []
+
+        def refuse(**kwargs):
+            attempts.append(kwargs)
+            raise ConnectionError("connection refused")
+
+        monkeypatch.setattr(multihost.jax.distributed, "initialize", refuse)
+        policy = RetryPolicy(max_attempts=3, base_delay_s=0.0, jitter=0.0)
+        with pytest.raises(RuntimeError) as exc_info:
+            multihost.initialize("10.0.0.9:1234", 4, 2, retry_policy=policy)
+        msg = str(exc_info.value)
+        assert "10.0.0.9:1234" in msg  # coordinator address
+        assert "process 2 of 4" in msg  # who I am
+        assert "3 attempt(s)" in msg  # the budget that was spent
+        assert "PHOTON_COORDINATOR_ADDRESS" in msg  # what to check
+        assert len(attempts) == 3
+        assert not multihost._initialized
+
+    def test_injected_collective_fault_surfaces(self, monkeypatch):
+        from photon_ml_tpu.parallel import multihost
+
+        monkeypatch.setattr(multihost, "_initialized", False)
+        monkeypatch.setattr(multihost.jax.distributed, "initialize",
+                            lambda **kw: None)
+        plan = FaultPlan([FaultSpec("collective", at=(0, 1, 2))],
+                         bus=EventBus())
+        policy = RetryPolicy(max_attempts=3, base_delay_s=0.0, jitter=0.0)
+        with injected(plan):
+            with pytest.raises(RuntimeError, match="unreachable"):
+                multihost.initialize("h:1", 2, 0, retry_policy=policy)
+        assert len(plan.fired("collective")) == 3

@@ -1,0 +1,37 @@
+"""Tier-1 wrapper for ``tools/check_resilience_hygiene.py`` (no bare
+``except:``; no ``time.sleep`` outside ``resilience/retry.py``)."""
+
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import check_resilience_hygiene as hygiene  # noqa: E402
+
+
+def test_package_is_clean():
+    assert hygiene.main(REPO) == 0
+
+
+@pytest.mark.parametrize("snippet, n", [
+    ("try:\n    pass\nexcept:\n    pass\n", 1),
+    ("try:\n    pass\nexcept Exception:\n    pass\n", 0),
+    ("import time\ntime.sleep(1)\n", 1),
+    ("import time as t\nt.sleep(1)\n", 1),
+    ("from time import sleep\nsleep(1)\n", 1),
+    ("from time import sleep as zzz\nzzz(1)\n", 1),
+    # unrelated .sleep attributes / names must not trip the check
+    ("class X:\n    def sleep(self):\n        pass\nX().sleep()\n", 0),
+    ("import os\nos.path.join('a', 'b')\n", 0),
+])
+def test_detector(snippet, n):
+    assert len(hygiene.check_source(snippet, "photon_ml_tpu/x.py")) == n
+
+
+def test_retry_module_is_exempt():
+    src = "import time\ntime.sleep(1)\n"
+    assert hygiene.check_source(
+        src, os.path.join("photon_ml_tpu", "resilience", "retry.py")) == []

@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Static resilience-hygiene check over ``photon_ml_tpu/``.
+
+Two rules, both load-bearing for the resilience subsystem:
+
+1. **No bare ``except:``** — a bare handler swallows ``KeyboardInterrupt``
+   and ``SystemExit``, which is exactly how a "resilient" run turns into an
+   unkillable one. Catch a type (``except Exception:`` at minimum).
+2. **No ``time.sleep`` outside ``resilience/retry.py``** — every wait must
+   route through the retry module's sanctioned sleep so backoff, deadlines,
+   and injected stalls share one accounting chokepoint; an ad-hoc sleep is
+   invisible to ``--retry-deadline-s`` and to the bench watchdog.
+
+Run directly (``python tools/check_resilience_hygiene.py [root]``, exit 1 on
+violations) or through the tier-1 test ``tests/test_resilience_hygiene.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+#: the one module allowed to sleep (it owns backoff + injected stalls)
+SLEEP_ALLOWED = {os.path.join("photon_ml_tpu", "resilience", "retry.py")}
+
+
+def _is_time_sleep(node: ast.AST, time_aliases: set[str],
+                   sleep_names: set[str]) -> bool:
+    if isinstance(node, ast.Attribute) and node.attr == "sleep":
+        return isinstance(node.value, ast.Name) and node.value.id in time_aliases
+    if isinstance(node, ast.Name):
+        return node.id in sleep_names
+    return False
+
+
+def check_source(source: str, rel_path: str) -> list[str]:
+    """Violations in one file, as ``path:line: message`` strings."""
+    tree = ast.parse(source, filename=rel_path)
+    sleep_ok = rel_path in {os.path.normpath(p) for p in SLEEP_ALLOWED}
+
+    # resolve what `time` / `sleep` are bound to in this module
+    time_aliases: set[str] = set()
+    sleep_names: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "time":
+                    time_aliases.add(a.asname or "time")
+        elif isinstance(node, ast.ImportFrom) and node.module == "time":
+            for a in node.names:
+                if a.name == "sleep":
+                    sleep_names.add(a.asname or "sleep")
+
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            out.append(f"{rel_path}:{node.lineno}: bare `except:` — catch a "
+                       f"type (it swallows KeyboardInterrupt/SystemExit)")
+        elif (not sleep_ok
+              and _is_time_sleep(node, time_aliases, sleep_names)):
+            out.append(f"{rel_path}:{node.lineno}: time.sleep outside "
+                       f"resilience/retry.py — route waits through the "
+                       f"retry module so deadlines and the watchdog see "
+                       f"them")
+    return out
+
+
+def main(root: str = ".") -> int:
+    pkg = os.path.join(root, "photon_ml_tpu")
+    violations: list[str] = []
+    for dirpath, _, filenames in os.walk(pkg):
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            rel = os.path.normpath(os.path.relpath(path, root))
+            with open(path, encoding="utf-8") as f:
+                violations.extend(check_source(f.read(), rel))
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"{len(violations)} resilience-hygiene violation(s)")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else "."))
