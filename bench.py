@@ -1015,8 +1015,12 @@ def bench_end_to_end():
                 wall, stages = w, _stages_of(out)
     e2e_rate = E2E_ROWS / wall
     base_rate = 1.0 / (1.0 / py_ingest_rate + 1.0 / host_cd_rate)
+    # self-describing metric line: the run configuration rides as extras so
+    # round-over-round artifacts are comparable without reading this source
     _emit("game_end_to_end_rows_per_sec", e2e_rate, "rows/s",
           e2e_rate / base_rate, n_rows=int(E2E_ROWS),
+          n_users=int(E2E_USERS), n_songs=int(E2E_SONGS),
+          design_dtype="bfloat16", codec="null", best_of=2,
           wall_s=round(wall, 2), stage_s=stages)
 
 

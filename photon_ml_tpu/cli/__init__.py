@@ -1,12 +1,14 @@
 """Command-line drivers.
 
 Re-design of the reference's client layer (``photon-client/.../cli/...`` and
-the legacy ``Driver.scala``): four entry points with the reference's
-vocabulary —
+the legacy ``Driver.scala``): the reference's entry points with its
+vocabulary, plus the online-serving driver —
 
 - ``python -m photon_ml_tpu train_glm``  (legacy GLM ``Driver``)
 - ``python -m photon_ml_tpu train_game`` (``GameTrainingDriver``)
 - ``python -m photon_ml_tpu score_game`` (``GameScoringDriver``)
+- ``python -m photon_ml_tpu serve_game`` (online HTTP scoring — no
+  reference counterpart; see :mod:`photon_ml_tpu.serving`)
 - ``python -m photon_ml_tpu build_index`` (``FeatureIndexingDriver``)
 
 Spark-submit/scopt is replaced by argparse; the rich inline DSLs (feature

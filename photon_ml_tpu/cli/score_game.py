@@ -71,28 +71,13 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
         args.output_dir, "workers", f"proc-{jax.process_index()}")
     run_logger = RunLogger(log_dir)
     try:
-        model_dir = os.path.normpath(args.model_dir)
-        if not os.path.exists(os.path.join(model_dir, "model-metadata.json")):
-            nested = os.path.join(model_dir, "best")
-            if os.path.exists(os.path.join(nested, "model-metadata.json")):
-                model_dir = nested
-            else:
-                raise FileNotFoundError(
-                    f"no model-metadata.json under {args.model_dir!r}")
+        from photon_ml_tpu.io import (
+            find_feature_index_dir,
+            resolve_game_model_dir,
+        )
 
-        # feature-indexes lives at the train_game run root; the model may be
-        # at <run>/best or <run>/all/config-N — walk up to find it
-        index_dir = None
-        probe = model_dir
-        for _ in range(3):
-            candidate = os.path.join(probe, "feature-indexes")
-            if os.path.isdir(candidate):
-                index_dir = candidate
-                break
-            probe = os.path.dirname(probe)
-        if index_dir is None:
-            raise FileNotFoundError(
-                f"no feature-indexes directory at or above {model_dir!r}")
+        model_dir = resolve_game_model_dir(args.model_dir)
+        index_dir = find_feature_index_dir(model_dir)
         shard_configs = tuple(parse_feature_shard_config(s)
                               for s in args.feature_shards.split(","))
         index_maps = {

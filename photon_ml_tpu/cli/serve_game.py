@@ -1,0 +1,98 @@
+"""GAME online-serving driver: ``python -m photon_ml_tpu serve_game``.
+
+The online counterpart of ``score_game``: load a trained GAME model once,
+answer ``/score`` requests at low latency, hot-swap new versions via
+``/reload`` without dropping traffic. The subsystem lives in
+:mod:`photon_ml_tpu.serving`; this driver is flag parsing + process setup.
+
+Numerics: on CPU backends the driver enables ``jax_enable_x64`` BEFORE any
+scoring trace so the engine accumulates margins in float64 — the batch-path
+bit-parity contract (see serving/engine.py). TPU backends have no f64 path;
+serving there runs f32 accumulation (approximate parity) and this flag is
+left alone.
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional, Sequence
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="photon_ml_tpu serve_game",
+        description="Serve a saved GAME model over HTTP")
+    p.add_argument("--model-dir", required=True,
+                   help="a train_game output dir (containing best/ or a "
+                        "model-metadata.json directly); also the default "
+                        "for /reload")
+    p.add_argument("--feature-shards", required=True,
+                   help="same shard specs used at training time")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8080,
+                   help="0 = ephemeral (the test/bench mode)")
+    p.add_argument("--max-batch", type=int, default=1024,
+                   help="largest padded batch bucket; bigger requests are "
+                        "chunked")
+    p.add_argument("--microbatch", type=int, default=64,
+                   help="microbatcher max coalesced batch; 0 disables the "
+                        "batcher (single requests hit the engine directly)")
+    p.add_argument("--max-wait-ms", type=float, default=2.0,
+                   help="microbatcher linger after the first queued request")
+    p.add_argument("--no-warmup", action="store_true",
+                   help="skip pre-compiling the bucket executables at "
+                        "startup (first requests then pay the compiles)")
+    return p
+
+
+def build_server(argv: Optional[Sequence[str]] = None):
+    """Parse flags → started-but-not-serving :class:`GameServer` (the
+    programmatic/test entry; :func:`run` wraps it in serve-forever)."""
+    from photon_ml_tpu.cli.config import parse_feature_shard_config
+
+    args = build_parser().parse_args(argv)
+    import jax
+
+    if jax.default_backend() == "cpu" and not jax.config.jax_enable_x64:
+        # float64 margin accumulation = bit-parity with the batch scorer;
+        # must be set before the first trace (serving owns this process)
+        jax.config.update("jax_enable_x64", True)
+
+    from photon_ml_tpu.serving import (
+        GameServer,
+        MicroBatcher,
+        ModelRegistry,
+        ServingService,
+    )
+
+    shard_configs = tuple(parse_feature_shard_config(s)
+                          for s in args.feature_shards.split(","))
+    registry = ModelRegistry(shard_configs, max_batch=args.max_batch,
+                             warmup=not args.no_warmup)
+    registry.load(args.model_dir)
+    batcher = None
+    if args.microbatch > 0:
+        batcher = MicroBatcher(
+            lambda records: registry.active().score(records),
+            max_batch=args.microbatch, max_wait_ms=args.max_wait_ms)
+    service = ServingService(registry, default_model_dir=args.model_dir,
+                             batcher=batcher)
+    return GameServer(service, host=args.host, port=args.port)
+
+
+def run(argv: Optional[Sequence[str]] = None) -> dict:
+    server = build_server(argv)
+    version = server.service.registry.active_version
+    print(f"serving GAME model version {version} on {server.url} "
+          f"(/score /healthz /reload)", flush=True)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+    return {"url": server.url, "version": version}
+
+
+if __name__ == "__main__":
+    run()

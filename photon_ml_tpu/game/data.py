@@ -124,8 +124,12 @@ class FeatureShard:
     def from_coo(rows, cols, vals, n_samples: int, dim: int) -> "FeatureShard":
         """OWNERSHIP: when the inputs are already row-sorted AND in the
         target dtypes, the returned shard ALIASES them (the sorted fast
-        path deliberately avoids the copy) — callers must not mutate the
-        arrays afterwards. Unsorted inputs are copied by the sort."""
+        path deliberately avoids the copy) — and FREEZES the aliased
+        ``cols``/``vals`` buffers via ``writeable=False``, so a caller's
+        later in-place write raises ``ValueError`` instead of silently
+        corrupting the shard (and any device image derived from it).
+        Callers that need to keep mutating their arrays must pass a copy.
+        Unsorted inputs are copied by the sort and stay writable."""
         rows = np.asarray(rows, np.int64)
         if rows.size and (np.diff(rows) < 0).any():
             order = np.argsort(rows, kind="stable")

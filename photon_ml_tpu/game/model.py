@@ -54,6 +54,25 @@ class FixedEffectModel:
         return out.astype(np.float32)
 
 
+def sum_coordinate_margins(offsets, margins, xp=np):
+    """THE GAME score-summation contract: ``f32(f64(offset) + Σ f64(mᵢ))``
+    accumulated in coordinate order.
+
+    Single home of the total-score arithmetic, shared by the batch path
+    (:meth:`GameModel.score`, ``GameTransformer``'s per-coordinate
+    breakdown total) and the online serving engine
+    (:mod:`photon_ml_tpu.serving.engine`) — the online/batch bit-parity
+    guarantee rests on both paths running THIS reduction. ``xp`` is numpy
+    for the host batch path or ``jax.numpy`` inside the jitted online path
+    (where float64 requires ``jax_enable_x64``; without it the engine
+    degrades to f32 accumulation and parity is approximate).
+    """
+    total = xp.asarray(offsets).astype(xp.float64)
+    for m in margins:
+        total = total + xp.asarray(m).astype(xp.float64)
+    return total.astype(xp.float32)
+
+
 def key_join(keys: np.ndarray, dim: int, entity_ids: np.ndarray,
              feature_ids: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     """Sorted-table join for (entity, feature) pairs: ``(pos, found)``.
@@ -308,10 +327,9 @@ class GameModel:
 
     def score(self, data: GameData) -> np.ndarray:
         """Total margin per sample: offsets + sum of coordinate scores."""
-        total = data.offsets.astype(np.float64)
-        for model in self.coordinates.values():
-            total = total + model.score(data)
-        return total.astype(np.float32)
+        return sum_coordinate_margins(
+            data.offsets,
+            (m.score(data) for m in self.coordinates.values()))
 
     def score_by_coordinate(self, data: GameData) -> dict[str, np.ndarray]:
         return {cid: m.score(data) for cid, m in self.coordinates.items()}

@@ -24,7 +24,7 @@ import numpy as np
 
 from photon_ml_tpu.evaluation import EvaluationResults, Evaluator, evaluate_all
 from photon_ml_tpu.game.data import GameData
-from photon_ml_tpu.game.model import GameModel
+from photon_ml_tpu.game.model import GameModel, sum_coordinate_margins
 from photon_ml_tpu.ops.losses import loss_for_task
 
 
@@ -60,10 +60,10 @@ class GameTransformer:
         by_coordinate = None
         if self.score_breakdown:
             by_coordinate = self.model.score_by_coordinate(data)
-            total = data.offsets.astype(np.float64)
-            for s in by_coordinate.values():
-                total = total + s
-            scores = total.astype(np.float32)
+            # same reduction as GameModel.score (and the online serving
+            # engine): breakdown totals are bit-identical to plain scores
+            scores = sum_coordinate_margins(data.offsets,
+                                            by_coordinate.values())
         else:
             scores = self.model.score(data)
 

@@ -19,8 +19,11 @@ from photon_ml_tpu.io.index import (  # noqa: F401
 )
 from photon_ml_tpu.io.data_reader import AvroDataReader, FeatureShardConfig  # noqa: F401
 from photon_ml_tpu.io.model_io import (  # noqa: F401
+    find_feature_index_dir,
+    game_model_entity_vocabs,
     load_game_model,
     load_glm_model,
+    resolve_game_model_dir,
     save_game_model,
     save_glm_model,
     save_glm_model_text,

@@ -370,7 +370,13 @@ class AvroDataReader:
                 indptr_parts.append(split[0])
                 cols_parts.append(split[1])
                 vals_parts.append(split[2])
-            if len(indptr_parts) == 1:
+            if not indptr_parts:
+                # zero decoded parts: an empty CSR, not an IndexError on
+                # indptr_parts[0] below (n is 0 here, so indptr is [0])
+                indptr = np.zeros(n + 1, np.int64)
+                cols = np.zeros(0, np.int32)
+                vals = np.zeros(0, np.float32)
+            elif len(indptr_parts) == 1:
                 indptr, cols, vals = indptr_parts[0], cols_parts[0], \
                     vals_parts[0]
             else:

@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import json
 import os
-from typing import Iterator
+from typing import Iterator, Optional
 
 import numpy as np
 
@@ -261,6 +261,62 @@ def _re_records(model: RandomEffectModel, index_map: IndexMap,
             "means": means,
             "variances": variances,
         }
+
+
+def resolve_game_model_dir(path: str) -> str:
+    """Accept a ``train_game`` run dir (containing ``best/``) or a model dir
+    holding ``model-metadata.json`` directly — the lookup every consumer of
+    a saved GAME model (batch scorer, serving registry) shares."""
+    path = os.path.normpath(path)
+    if os.path.exists(os.path.join(path, "model-metadata.json")):
+        return path
+    nested = os.path.join(path, "best")
+    if os.path.exists(os.path.join(nested, "model-metadata.json")):
+        return nested
+    raise FileNotFoundError(f"no model-metadata.json under {path!r}")
+
+
+def find_feature_index_dir(model_dir: str, *, max_up: int = 3) -> str:
+    """Locate the run's ``feature-indexes`` directory: it lives at the
+    train_game run root, while the model may sit at ``<run>/best`` or
+    ``<run>/all/config-N`` — walk up to find it."""
+    probe = os.path.normpath(model_dir)
+    for _ in range(max_up):
+        candidate = os.path.join(probe, "feature-indexes")
+        if os.path.isdir(candidate):
+            return candidate
+        probe = os.path.dirname(probe)
+    raise FileNotFoundError(
+        f"no feature-indexes directory at or above {model_dir!r}")
+
+
+def game_model_entity_vocabs(model_dir: str,
+                             metadata: Optional[dict] = None,
+                             ) -> dict[str, dict[str, int]]:
+    """Entity vocabularies derived from the MODEL's own coefficient files
+    (raw ``modelId`` strings → dense ids, in record order per part file).
+
+    The batch scorer keys entity lookups off the *dataset*'s vocabulary;
+    online serving has no dataset — requests arrive one at a time — so the
+    model's saved per-entity records are the authoritative id universe.
+    Coordinates sharing a random-effect type merge into one vocabulary
+    (ids from the first coordinate's record order, extended by later ones).
+    """
+    if metadata is None:
+        with open(os.path.join(model_dir, "model-metadata.json")) as f:
+            metadata = json.load(f)
+    vocabs: dict[str, dict[str, int]] = {}
+    for cid, info in metadata["coordinates"].items():
+        if info["type"] != "random-effect":
+            continue
+        vocab = vocabs.setdefault(info["randomEffectType"], {})
+        part = os.path.join(model_dir, info["type"], cid, "coefficients",
+                            "part-00000.avro")
+        for rec in iter_avro_file(part):
+            raw = rec["modelId"]
+            if raw not in vocab:
+                vocab[raw] = len(vocab)
+    return vocabs
 
 
 def load_game_model(

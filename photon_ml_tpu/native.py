@@ -581,13 +581,20 @@ def counting_sort(ids: np.ndarray) -> Optional[np.ndarray]:
     """Stable group-order of dense non-negative int ids — the native O(n)
     counting sort (``bucket_pack.cc::photon_counting_sort``). Returns the
     same permutation as ``np.argsort(ids, kind="stable")``; None when the
-    library is unavailable (caller falls back)."""
-    lib = _load()
-    if lib is None:
-        return None
+    library is unavailable (caller falls back).
+
+    Counting sort allocates O(max(ids)) counter arrays — correct only for
+    PRE-INDEXED dense ids. A sparse column (raw 64-bit hashes, say) would
+    silently allocate gigabytes, so large-and-sparse inputs take the
+    comparison-sort fallback here instead of gambling on the caller."""
     ids = np.ascontiguousarray(ids, np.int64)
     if ids.size == 0:
         return np.zeros(0, np.int64)
+    if int(ids.max()) > 4 * ids.size:
+        return np.argsort(ids, kind="stable")
+    lib = _load()
+    if lib is None:
+        return None
     cnt = np.bincount(ids)
     cursors = np.zeros(len(cnt), np.int64)
     np.cumsum(cnt[:-1], out=cursors[1:])

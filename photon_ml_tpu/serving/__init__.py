@@ -1,0 +1,32 @@
+"""Online serving: low-latency GAME scoring with hot-swappable versions.
+
+The piece the reference never shipped in photon-ml itself — the paper's
+per-entity models exist to be APPLIED at request time (SURVEY §0, §5.5) —
+built here as four layers (see SERVING.md for the architecture doc):
+
+- :mod:`~photon_ml_tpu.serving.registry` — versioned model registry:
+  validate-then-activate loading of ``train_game`` output dirs, atomic
+  hot-swap, instant rollback.
+- :mod:`~photon_ml_tpu.serving.store` — per-entity coefficients packed
+  dense on device with O(1) raw-id lookup and a zeros fallback row (the
+  GLMix cold-start contract).
+- :mod:`~photon_ml_tpu.serving.engine` — jitted scoring with power-of-two
+  batch buckets: zero steady-state recompiles, batch-path bit-parity.
+- :mod:`~photon_ml_tpu.serving.batcher` / :mod:`~photon_ml_tpu.serving.http`
+  — microbatching queue and the stdlib JSON endpoint
+  (``/score`` / ``/healthz`` / ``/reload``) behind
+  ``python -m photon_ml_tpu serve_game``.
+"""
+
+from photon_ml_tpu.serving.batcher import MicroBatcher  # noqa: F401
+from photon_ml_tpu.serving.engine import (  # noqa: F401
+    RequestBatch,
+    ScoringEngine,
+    next_bucket,
+)
+from photon_ml_tpu.serving.http import GameServer, ServingService  # noqa: F401
+from photon_ml_tpu.serving.registry import (  # noqa: F401
+    ModelRegistry,
+    ServingModel,
+)
+from photon_ml_tpu.serving.store import EntityCoefficientStore  # noqa: F401

@@ -1,0 +1,113 @@
+"""Microbatching queue: coalesce single requests into engine-sized batches.
+
+Online GLMix traffic is dominated by batch-size-1 requests, but the engine's
+per-call overhead (pack, pad, dispatch) amortizes across a batch — and the
+power-of-two buckets mean a batch of 8 costs barely more than a batch of 1.
+The batcher trades a bounded wait (``max_wait_ms``, default 2 ms) for that
+amortization: submitters enqueue and get a Future; a single worker thread
+drains up to ``max_batch`` requests per scoring call, waiting at most
+``max_wait_ms`` after the first request of a batch arrives before firing.
+
+Swap interaction: the score function is resolved PER BATCH (the registry's
+active engine), so a hot-swap takes effect at the next batch boundary and a
+batch never mixes versions.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from concurrent.futures import Future
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+
+class MicroBatcher:
+    """Single-worker request coalescer in front of a scoring callable.
+
+    ``score_fn(records) -> np.ndarray`` scores one homogeneous batch (the
+    registry's active version). Thread-safe; :meth:`submit` never blocks
+    beyond the queue lock.
+    """
+
+    def __init__(self, score_fn: Callable[[Sequence[dict]], np.ndarray], *,
+                 max_batch: int = 64, max_wait_ms: float = 2.0):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self._score_fn = score_fn
+        self.max_batch = max_batch
+        self.max_wait_s = max_wait_ms / 1000.0
+        self._cond = threading.Condition()
+        self._queue: collections.deque = collections.deque()
+        self._closed = False
+        self.n_batches = 0
+        self.n_coalesced = 0  # requests that shared a batch with others
+        self._worker = threading.Thread(target=self._run, daemon=True,
+                                        name="photon-serving-batcher")
+        self._worker.start()
+
+    def submit(self, record: dict) -> "Future[float]":
+        """Enqueue one record; the Future resolves to its float score."""
+        fut: Future = Future()
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("batcher is closed")
+            self._queue.append((record, fut))
+            self._cond.notify()
+        return fut
+
+    def score(self, record: dict,
+              timeout: Optional[float] = None) -> float:
+        """Blocking convenience wrapper around :meth:`submit`."""
+        return self.submit(record).result(timeout=timeout)
+
+    def close(self) -> None:
+        """Drain outstanding work, then stop the worker."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify()
+        self._worker.join()
+
+    # --- worker -----------------------------------------------------------
+    def _run(self) -> None:
+        while True:
+            batch = self._next_batch()
+            if batch is None:
+                return
+            records = [r for r, _ in batch]
+            try:
+                scores = self._score_fn(records)
+            except Exception as e:  # score failure fails THIS batch only
+                for _, fut in batch:
+                    fut.set_exception(e)
+                continue
+            self.n_batches += 1
+            if len(batch) > 1:
+                self.n_coalesced += len(batch)
+            for (_, fut), s in zip(batch, np.asarray(scores)):
+                fut.set_result(float(s))
+
+    def _next_batch(self):
+        """Block for the first request, then linger ``max_wait_s`` for
+        followers (or until ``max_batch`` is reached). None = closed and
+        drained."""
+        import time
+
+        with self._cond:
+            while not self._queue:
+                if self._closed:
+                    return None
+                self._cond.wait()
+            if self.max_wait_s > 0:
+                deadline = time.monotonic() + self.max_wait_s
+                while (len(self._queue) < self.max_batch
+                       and not self._closed):
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(timeout=remaining)
+            out = []
+            while self._queue and len(out) < self.max_batch:
+                out.append(self._queue.popleft())
+            return out

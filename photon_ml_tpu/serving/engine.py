@@ -1,0 +1,227 @@
+"""Jitted online scoring engine: shape-bucketed, zero steady-state recompiles.
+
+Requests arrive at arbitrary batch sizes; XLA compiles one executable per
+input SHAPE. Left alone, that means a recompile (10s+ through a remote-
+compile tunnel) the first time any new size shows up — a latency cliff in
+the middle of serving traffic. The engine therefore pads every batch up to
+a power-of-two bucket (1, 2, 4, … ``max_batch``): the executable set is
+fixed and small (log₂ max_batch + 1 shapes), :meth:`ScoringEngine.warmup`
+pre-traces all of them, and steady-state serving performs **zero**
+recompiles no matter how request sizes vary. ``compile_count`` exposes the
+trace counter the serving bench asserts on.
+
+Numeric contract: per-coordinate margins are accumulated in float64 (when
+``jax_enable_x64`` is on — the serve CLI enables it on CPU backends) and the
+total runs :func:`photon_ml_tpu.game.model.sum_coordinate_margins` — the
+same reduction, same coordinate order, as the batch scorer. Online scores
+are bit-identical to ``score_game`` output (tests/test_serving.py locks
+this). Without x64 (TPU serving) accumulation degrades to f32 and parity is
+approximate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Mapping, Optional, Sequence
+
+import numpy as np
+
+from photon_ml_tpu.game.model import (
+    FixedEffectModel,
+    GameModel,
+    sum_coordinate_margins,
+)
+from photon_ml_tpu.io.data_reader import FeatureShardConfig, _record_features
+from photon_ml_tpu.io.index import IndexMap
+from photon_ml_tpu.types import INTERCEPT_KEY
+from photon_ml_tpu.serving.store import EntityCoefficientStore
+
+
+def next_bucket(n: int) -> int:
+    """Smallest power of two ≥ max(n, 1)."""
+    return 1 << max(int(n) - 1, 0).bit_length()
+
+
+@dataclasses.dataclass(frozen=True)
+class RequestBatch:
+    """Host arrays for one batch of scoring requests: per-shard dense
+    designs, per-random-effect-coordinate store rows, offsets."""
+
+    n: int
+    offsets: np.ndarray  # (n,) float32
+    xs: tuple  # per shard config: (n, dim) float32
+    rows: tuple  # per RE coordinate: (n,) int32 store rows
+
+
+class ScoringEngine:
+    """Scores request records against one loaded GAME model version.
+
+    One engine per :class:`~photon_ml_tpu.serving.registry.ServingModel`
+    version — hot-swapping installs a fresh engine, so an engine's jit
+    cache always matches its coefficients. Thread-safe: concurrent
+    :meth:`score` calls share the compiled executables.
+    """
+
+    def __init__(self, model: GameModel,
+                 shard_configs: Sequence[FeatureShardConfig],
+                 index_maps: Mapping[str, IndexMap],
+                 stores: Mapping[str, EntityCoefficientStore],
+                 *, max_batch: int = 1024):
+        import jax
+        import jax.numpy as jnp
+
+        self.model = model
+        self.shard_configs = tuple(shard_configs)
+        self.index_maps = dict(index_maps)
+        self.stores = dict(stores)
+        self.max_batch = next_bucket(max_batch)
+        self._shard_order = [c.shard_id for c in self.shard_configs]
+        # coordinate walk order is the model's — the summation contract is
+        # order-sensitive and the batch path iterates the same dict
+        self._coords = list(model.coordinates.items())
+        self._re_order = [cid for cid, cm in self._coords
+                          if not isinstance(cm, FixedEffectModel)]
+        for cid in self._re_order:
+            if cid not in self.stores:
+                raise ValueError(f"no EntityCoefficientStore for "
+                                 f"random-effect coordinate {cid!r}")
+        # model parameters ride as jit ARGUMENTS, not closure constants:
+        # constants get baked into every bucket's executable (compile-time
+        # and image bloat proportional to table size × bucket count)
+        self._params = {
+            "fe": {cid: jnp.asarray(
+                np.asarray(cm.model.coefficients.means, np.float32))
+                for cid, cm in self._coords
+                if isinstance(cm, FixedEffectModel)},
+            "re": {cid: self.stores[cid].table for cid in self._re_order},
+        }
+        self._lock = threading.Lock()
+        self._compile_count = 0
+        self._n_calls = 0
+        self._n_scored = 0
+        accum = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+
+        def _score_padded(params, offsets, xs, rows):
+            # body runs at TRACE time only — one increment per compiled
+            # bucket shape, the recompile counter the serving bench asserts
+            self._compile_count += 1
+            margins = []
+            i_x = {sid: i for i, sid in enumerate(self._shard_order)}
+            i_r = {cid: i for i, cid in enumerate(self._re_order)}
+            for cid, cm in self._coords:
+                x = xs[i_x[cm.feature_shard_id]].astype(accum)
+                if isinstance(cm, FixedEffectModel):
+                    m = x @ params["fe"][cid].astype(accum)
+                else:
+                    tab = params["re"][cid][rows[i_r[cid]]].astype(accum)
+                    m = jnp.sum(x * tab, axis=1)
+                margins.append(m.astype(jnp.float32))
+            return sum_coordinate_margins(offsets, margins, xp=jnp)
+
+        self._score_jit = jax.jit(_score_padded)
+
+    # --- stats ------------------------------------------------------------
+    @property
+    def compile_count(self) -> int:
+        """Distinct jitted traces so far (== XLA compiles of the scoring
+        program). Constant after :meth:`warmup` — the zero-recompile
+        contract."""
+        return self._compile_count
+
+    @property
+    def n_scored(self) -> int:
+        return self._n_scored
+
+    # --- request packing --------------------------------------------------
+    def pack(self, records: Sequence[dict]) -> RequestBatch:
+        """Records (TrainingExampleAvro-shaped dicts: ``features`` list,
+        ``metadataMap``, optional ``offset``) → host arrays.
+
+        Feature handling mirrors the batch reader exactly — bag filtering,
+        index-map lookup (unknown keys dropped), intercept column, duplicate
+        (row, col) entries accumulating in f32 — so packing introduces no
+        online/batch skew.
+        """
+        n = len(records)
+        offsets = np.zeros(n, np.float32)
+        for i, rec in enumerate(records):
+            off = rec.get("offset")
+            if off is not None:
+                offsets[i] = off
+        xs = []
+        for cfg in self.shard_configs:
+            imap = self.index_maps[cfg.shard_id]
+            x = np.zeros((n, len(imap)), np.float32)
+            get = imap.key_to_index.get
+            for i, rec in enumerate(records):
+                for key, value in _record_features(rec, cfg.feature_bags):
+                    j = get(key)
+                    if j is not None:
+                        x[i, j] += np.float32(value)
+                if cfg.has_intercept:
+                    x[i, imap.key_to_index[INTERCEPT_KEY]] += np.float32(1.0)
+            xs.append(x)
+        rows = []
+        for cid in self._re_order:
+            store = self.stores[cid]
+            raw = [
+                (rec.get("metadataMap") or {}).get(store.random_effect_type)
+                for rec in records]
+            rows.append(store.rows_for(raw))
+        return RequestBatch(n=n, offsets=offsets, xs=tuple(xs),
+                            rows=tuple(rows))
+
+    # --- scoring ----------------------------------------------------------
+    def score(self, records: Sequence[dict]) -> np.ndarray:
+        """Total GAME score per record (float32, batch-path parity)."""
+        return self.score_batch(self.pack(records))
+
+    def score_batch(self, batch: RequestBatch) -> np.ndarray:
+        out = np.empty(batch.n, np.float32)
+        # batches past the largest bucket chunk — per-sample independence
+        # makes the split score-invariant
+        for lo in range(0, batch.n, self.max_batch):
+            hi = min(lo + self.max_batch, batch.n)
+            out[lo:hi] = self._score_chunk(batch, lo, hi)
+        with self._lock:
+            self._n_calls += 1
+            self._n_scored += batch.n
+        return out
+
+    def _score_chunk(self, batch: RequestBatch, lo: int, hi: int) -> np.ndarray:
+        n = hi - lo
+        b = next_bucket(n)
+        offsets = np.zeros(b, np.float32)
+        offsets[:n] = batch.offsets[lo:hi]
+        xs = []
+        for x in batch.xs:
+            xp = np.zeros((b, x.shape[1]), np.float32)
+            xp[:n] = x[lo:hi]
+            xs.append(xp)
+        rows = []
+        for cid, r in zip(self._re_order, batch.rows):
+            rp = np.full(b, self.stores[cid].fallback_row, np.int32)
+            rp[:n] = r[lo:hi]
+            rows.append(rp)
+        scores = self._score_jit(self._params, offsets, tuple(xs),
+                                 tuple(rows))
+        return np.asarray(scores)[:n]
+
+    def warmup(self, max_bucket: Optional[int] = None) -> int:
+        """Pre-trace every bucket executable (1, 2, 4, … ``max_batch``) so
+        live traffic never waits on a compile. Returns the number of
+        compiles performed."""
+        top = self.max_batch if max_bucket is None else next_bucket(max_bucket)
+        before = self._compile_count
+        b = 1
+        while b <= top:
+            empty = RequestBatch(
+                n=b, offsets=np.zeros(b, np.float32),
+                xs=tuple(np.zeros((b, len(self.index_maps[c.shard_id])),
+                                  np.float32) for c in self.shard_configs),
+                rows=tuple(np.full(b, self.stores[cid].fallback_row,
+                                   np.int32) for cid in self._re_order))
+            self._score_chunk(empty, 0, b)
+            b <<= 1
+        return self._compile_count - before

@@ -1,0 +1,190 @@
+"""Stdlib-HTTP front end for online GAME scoring.
+
+Three JSON endpoints over ``http.server`` (no web framework in the image,
+and none needed — handlers are thin marshaling around the registry/batcher):
+
+- ``POST /score``  — ``{"records": [...]}`` (or ``{"record": {...}}``) →
+  ``{"scores": [...], "version": v, "latency_ms": ...}``. Records are
+  TrainingExampleAvro-shaped dicts (``features`` list, ``metadataMap``,
+  optional ``offset``). Single records route through the microbatcher when
+  enabled; explicit batches go straight to the engine.
+- ``GET /healthz`` — liveness + the serving counters the bench asserts on
+  (active version, engine compile count, requests/scores served).
+- ``POST /reload`` — ``{"model_dir": "..."} `` (optional; defaults to the
+  dir served at startup) → validate + hot-swap. A corrupt candidate
+  returns 409 and the active version keeps serving.
+
+Every scored request posts a ``serving_request`` event on the registry's
+:class:`~photon_ml_tpu.events.EventBus` (latency, batch size, version) —
+the same bus training lifecycle events ride, so one metrics exporter
+observes both halves of the system.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from photon_ml_tpu.serving.batcher import MicroBatcher
+from photon_ml_tpu.serving.registry import ModelRegistry
+
+
+class ServingService:
+    """Endpoint logic, HTTP-free (testable directly; the handler is thin)."""
+
+    def __init__(self, registry: ModelRegistry, *,
+                 default_model_dir: Optional[str] = None,
+                 batcher: Optional[MicroBatcher] = None):
+        self.registry = registry
+        self.default_model_dir = default_model_dir
+        self.batcher = batcher
+        self._lock = threading.Lock()
+        self.n_requests = 0
+        self.n_scored = 0
+        self.started_at = time.time()
+
+    # --- endpoints --------------------------------------------------------
+    def score(self, payload: dict) -> dict:
+        if "record" in payload:
+            records = [payload["record"]]
+        else:
+            records = payload.get("records")
+        if not isinstance(records, list) or not records:
+            raise ValueError("payload needs 'records': [non-empty list] "
+                             "or 'record': {...}")
+        t0 = time.perf_counter()
+        version = self.registry.active_version
+        if self.batcher is not None and len(records) == 1:
+            scores = [self.batcher.score(records[0])]
+        else:
+            scores = [float(s)
+                      for s in self.registry.active().score(records)]
+        latency_ms = (time.perf_counter() - t0) * 1e3
+        with self._lock:
+            self.n_requests += 1
+            self.n_scored += len(records)
+        self.registry.bus.post("serving_request", batch=len(records),
+                               latency_ms=latency_ms, version=version)
+        return {"scores": scores, "version": version,
+                "latency_ms": round(latency_ms, 3)}
+
+    def healthz(self) -> dict:
+        active = self.registry.active_or_none()
+        return {
+            "status": "ok" if active is not None else "no_model",
+            "version": self.registry.active_version,
+            "versions": self.registry.versions(),
+            "compiles": (0 if active is None
+                         else active.engine.compile_count),
+            "requests": self.n_requests,
+            "scored": self.n_scored,
+            "uptime_s": round(time.time() - self.started_at, 1),
+        }
+
+    def reload(self, payload: dict) -> dict:
+        model_dir = payload.get("model_dir") or self.default_model_dir
+        if not model_dir:
+            raise ValueError("payload needs 'model_dir' (no default "
+                             "configured)")
+        previous = self.registry.active_version
+        sm = self.registry.reload(model_dir)
+        return {"version": sm.version, "previous": previous,
+                "model_dir": sm.model_dir}
+
+    def close(self) -> None:
+        if self.batcher is not None:
+            self.batcher.close()
+
+
+def _make_handler(service: ServingService):
+    class Handler(BaseHTTPRequestHandler):
+        # per-request log lines go nowhere useful under test/bench load
+        def log_message(self, fmt, *args):  # noqa: D102
+            pass
+
+        def _reply(self, status: int, body: dict) -> None:
+            data = json.dumps(body).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _payload(self) -> dict:
+            length = int(self.headers.get("Content-Length") or 0)
+            if not length:
+                return {}
+            return json.loads(self.rfile.read(length) or b"{}")
+
+        def do_GET(self):  # noqa: N802
+            if self.path == "/healthz":
+                self._reply(200, service.healthz())
+            else:
+                self._reply(404, {"error": f"unknown path {self.path}"})
+
+        def do_POST(self):  # noqa: N802
+            try:
+                payload = self._payload()
+            except (ValueError, json.JSONDecodeError) as e:
+                self._reply(400, {"error": f"bad JSON: {e}"})
+                return
+            if self.path == "/score":
+                try:
+                    self._reply(200, service.score(payload))
+                except ValueError as e:
+                    self._reply(400, {"error": str(e)})
+                except Exception as e:
+                    self._reply(500, {"error": repr(e)})
+            elif self.path == "/reload":
+                try:
+                    self._reply(200, service.reload(payload))
+                except Exception as e:
+                    # swap REJECTED: the active version is untouched, so
+                    # this is a conflict, not a server death
+                    self._reply(409, {
+                        "error": repr(e),
+                        "version": service.registry.active_version})
+            else:
+                self._reply(404, {"error": f"unknown path {self.path}"})
+
+    return Handler
+
+
+class GameServer:
+    """Threaded HTTP server wrapper with a test-friendly lifecycle."""
+
+    def __init__(self, service: ServingService, *, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.service = service
+        self._httpd = ThreadingHTTPServer((host, port),
+                                          _make_handler(service))
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "GameServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True,
+                                        name="photon-serving-http")
+        self._thread.start()
+        return self
+
+    def serve_forever(self) -> None:
+        self._httpd.serve_forever()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join()
+        self.service.close()

@@ -1,0 +1,94 @@
+"""Entity coefficient store: per-entity models packed for O(1) online lookup.
+
+The batch path joins random-effect coefficients against a dataset with one
+``searchsorted`` over the whole score set
+(:meth:`photon_ml_tpu.game.model.RandomEffectModel.lookup`); a serving
+request has no dataset — it names one entity by its RAW id and needs that
+entity's coefficient row *now*. So each random-effect coordinate's sparse
+``(entity·dim + feature) → coeff`` table is repacked once at model-load time
+into a dense ``(n_entities + 1, dim)`` device array plus a host
+``raw id → row`` dict: request-time lookup is one dict probe and one device
+gather. The extra last row is all-zero — the landing slot for entities the
+model has never seen, which therefore score exactly 0 from this coordinate
+(the GLMix cold-start contract: unseen entities fall back to the fixed
+effect alone, same as the batch path's not-found join).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping, Optional, Sequence
+
+import numpy as np
+
+from photon_ml_tpu.game.model import RandomEffectModel
+
+
+@dataclasses.dataclass(frozen=True)
+class EntityCoefficientStore:
+    """Dense per-entity coefficient table for one random-effect coordinate.
+
+    ``table`` is ``(n_entities + 1, dim)`` float32 on device; row
+    ``n_entities`` is the all-zero fallback row. ``row_of_id`` maps the raw
+    entity id string to its table row.
+    """
+
+    random_effect_type: str
+    feature_shard_id: str
+    dim: int
+    table: object  # jax.Array (n_entities + 1, dim) float32
+    row_of_id: Mapping[str, int]
+
+    @property
+    def n_entities(self) -> int:
+        return len(self.row_of_id)
+
+    @property
+    def fallback_row(self) -> int:
+        return int(self.table.shape[0]) - 1
+
+    def rows_for(self, raw_ids: Sequence[Optional[str]]) -> np.ndarray:
+        """Table row per raw entity id; unseen/missing ids land on the
+        zero fallback row."""
+        fb = self.fallback_row
+        get = self.row_of_id.get
+        return np.fromiter(
+            (fb if r is None else get(r, fb) for r in raw_ids),
+            np.int32, count=len(raw_ids))
+
+    @staticmethod
+    def build(model: RandomEffectModel,
+              entity_vocab: Mapping[str, int]) -> "EntityCoefficientStore":
+        """Pack a loaded :class:`RandomEffectModel`'s sparse table densely.
+
+        ``entity_vocab`` is the model-derived raw→dense id map
+        (:func:`photon_ml_tpu.io.model_io.game_model_entity_vocabs`). Models
+        fresh off disk are always in shard space (export back-projects), so
+        a projector here is a usage error, not a supported layout.
+        """
+        import jax.numpy as jnp
+
+        if model.projector is not None:
+            raise ValueError(
+                "serving expects shard-space models (call to_shard_space() "
+                "before building a store); saved models are already "
+                "back-projected by export")
+        keys = np.asarray(model.keys, np.int64)
+        ent = keys // model.dim
+        feat = keys % model.dim
+        uniq = np.unique(ent)
+        dense = np.zeros((len(uniq) + 1, model.dim), np.float32)
+        if len(keys):
+            pos = np.searchsorted(uniq, ent)
+            dense[pos, feat] = model.coeffs
+        # dense entity id -> packed row, then raw id -> packed row; vocab
+        # entries without coefficients (possible when coordinates sharing a
+        # re_type merged vocabs) deliberately map to the fallback zeros row
+        row_of_dense = {int(e): i for i, e in enumerate(uniq)}
+        fallback = len(uniq)
+        row_of_id = {raw: row_of_dense.get(d, fallback)
+                     for raw, d in entity_vocab.items()}
+        return EntityCoefficientStore(
+            random_effect_type=model.random_effect_type,
+            feature_shard_id=model.feature_shard_id,
+            dim=model.dim, table=jnp.asarray(dense), row_of_id=row_of_id)

@@ -309,3 +309,22 @@ class TestNativeREModelWriter:
         np.testing.assert_array_equal(re2.keys, model.keys)
         np.testing.assert_allclose(re2.coeffs, model.coeffs, rtol=1e-6)
         np.testing.assert_allclose(re2.variances, model.variances, rtol=1e-6)
+
+
+class TestCountingSort:
+    def test_dense_ids_match_stable_argsort(self):
+        rng = np.random.default_rng(3)
+        ids = rng.integers(0, 40, size=500).astype(np.int64)
+        out = native.counting_sort(ids)
+        if out is None:
+            pytest.skip("native library unavailable")
+        np.testing.assert_array_equal(out,
+                                      np.argsort(ids, kind="stable"))
+
+    def test_sparse_large_ids_fall_back_without_allocating(self):
+        """ids.max() >> ids.size must NOT allocate O(max) counters — the
+        guard routes to the stable comparison sort (library or not)."""
+        ids = np.array([0, 10**12, 7, 10**12, 3], np.int64)
+        out = native.counting_sort(ids)
+        assert out is not None  # guard answers even without the library
+        np.testing.assert_array_equal(out, np.argsort(ids, kind="stable"))

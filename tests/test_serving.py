@@ -1,0 +1,359 @@
+"""Online serving subsystem tests (photon_ml_tpu/serving/ + serve_game).
+
+The load-bearing contracts, each locked by a test here:
+
+- **online/batch bit-parity**: serving scores are bit-identical to
+  ``score_game`` output on the same model + records, INCLUDING records
+  naming entities the model never saw (cold-start fallback to the fixed
+  effect);
+- **zero steady-state recompiles**: after warmup, varying request sizes
+  never trigger a new XLA trace (the power-of-two bucket contract);
+- **hot-swap safety**: ``/reload`` under concurrent scoring fails no
+  in-flight or subsequent request; a corrupt candidate is rejected and the
+  active version keeps serving;
+- the end-to-end driver smoke: train tiny → serve over HTTP → score →
+  reload → score again.
+"""
+
+import json
+import os
+import shutil
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.cli import score_game as score_game_cli
+from photon_ml_tpu.cli import serve_game as serve_game_cli
+from photon_ml_tpu.cli import train_game as train_game_cli
+from photon_ml_tpu.cli.config import parse_feature_shard_config
+from photon_ml_tpu.io.avro import iter_avro_file
+from photon_ml_tpu.io.data_reader import write_training_examples
+from photon_ml_tpu.serving import MicroBatcher, ModelRegistry, next_bucket
+
+SHARDS = "global=fixed|intercept,user=user|noIntercept"
+SHARD_CONFIGS = tuple(parse_feature_shard_config(s)
+                      for s in SHARDS.split(","))
+COORDS = [
+    "global=fixed,shard=global,reg=L2",
+    "perUser=random,entity=userId,shard=user,reg=L2",
+]
+D_FIXED, D_USER, N_USERS = 6, 3, 9
+
+
+def _records(n, seed, *, cold_users=0, param_seed=777):
+    """Mixed-effect logistic records; the last ``cold_users`` user ids are
+    OUTSIDE the training universe (``uCOLD*``) — the fallback path."""
+    prng = np.random.default_rng(param_seed)
+    w = prng.normal(size=D_FIXED)
+    u = 1.5 * prng.normal(size=(N_USERS, D_USER))
+    rng = np.random.default_rng(seed)
+    xf = rng.normal(size=(n, D_FIXED))
+    xu = rng.normal(size=(n, D_USER))
+    users = rng.integers(0, N_USERS, size=n)
+    margin = xf @ w + np.einsum("nd,nd->n", xu, u[users])
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-margin))).astype(float)
+    out = []
+    for i in range(n):
+        feats = [{"name": f"fixed.x{j}", "term": "", "value": float(xf[i, j])}
+                 for j in range(D_FIXED)]
+        feats += [{"name": f"user.z{j}", "term": "", "value": float(xu[i, j])}
+                  for j in range(D_USER)]
+        uid = (f"uCOLD{i}" if i >= n - cold_users else f"u{users[i]}")
+        out.append({
+            "uid": str(i), "response": float(y[i]), "offset": None,
+            "weight": None, "features": feats,
+            "metadataMap": {"userId": uid},
+        })
+    return out
+
+
+def _train(tmp, tag, seed):
+    train_path = os.path.join(tmp, f"train-{tag}.avro")
+    write_training_examples(train_path, _records(500, seed))
+    out = os.path.join(tmp, f"run-{tag}")
+    train_game_cli.run([
+        "--training-data", train_path,
+        "--output-dir", out,
+        "--feature-shards", SHARDS,
+        "--coordinates", *COORDS,
+        "--update-sequence", "global,perUser",
+        "--grid", "global=0.1", "perUser=1",
+        "--evaluators", "",
+    ])
+    return out
+
+
+@pytest.fixture(scope="module")
+def trained(tmp_path_factory):
+    """Two tiny trained model versions + a request set with cold users."""
+    tmp = str(tmp_path_factory.mktemp("serving"))
+    v1 = _train(tmp, "v1", seed=0)
+    v2 = _train(tmp, "v2", seed=5)
+    # 60 requests, last 4 naming users no model has seen
+    requests = _records(60, seed=11, cold_users=4)
+    val_path = os.path.join(tmp, "requests.avro")
+    write_training_examples(val_path, requests)
+    return {"tmp": tmp, "v1": v1, "v2": v2,
+            "requests": requests, "requests_avro": val_path}
+
+
+class TestEngine:
+    def test_next_bucket(self):
+        assert [next_bucket(n) for n in (0, 1, 2, 3, 5, 8, 9, 1000)] == \
+            [1, 1, 2, 4, 8, 8, 16, 1024]
+
+    def test_online_scores_bit_identical_to_batch(self, trained):
+        """The headline parity contract: engine output == score_game
+        output, bit for bit, cold-start users included."""
+        score_out = os.path.join(trained["tmp"], "batch-scores")
+        score_game_cli.run([
+            "--data", trained["requests_avro"],
+            "--model-dir", trained["v1"],
+            "--output-dir", score_out,
+            "--feature-shards", SHARDS,
+        ])
+        batch = np.array([r["predictionScore"] for r in iter_avro_file(
+            os.path.join(score_out, "scores.avro"))], np.float64)
+
+        registry = ModelRegistry(SHARD_CONFIGS)
+        sm = registry.load(trained["v1"])
+        online = sm.score(trained["requests"])
+        assert online.dtype == np.float32
+        # scores.avro stores the f32 batch score widened to f64 — exact
+        assert np.array_equal(online.astype(np.float64), batch)
+
+    def test_cold_user_fallback_is_fixed_effect_only(self, trained):
+        """An unseen entity's score must equal the same features scored
+        with NO entity id at all (pure fixed effect + offset)."""
+        registry = ModelRegistry(SHARD_CONFIGS)
+        sm = registry.load(trained["v1"])
+        cold = [r for r in trained["requests"]
+                if r["metadataMap"]["userId"].startswith("uCOLD")]
+        assert len(cold) == 4
+        anonymized = [{**r, "metadataMap": {}} for r in cold]
+        assert np.array_equal(sm.score(cold), sm.score(anonymized))
+
+    def test_bucket_padding_is_score_invariant(self, trained):
+        """Any batch split — singles, odd sizes, chunked past max_batch —
+        lands on identical scores (padding rows are inert)."""
+        registry = ModelRegistry(SHARD_CONFIGS, max_batch=16)
+        sm = registry.load(trained["v1"])
+        recs = trained["requests"][:23]
+        whole = sm.score(recs)  # 23 → chunks of 16 + 7 (pad to 8)
+        singles = np.concatenate([sm.score([r]) for r in recs])
+        assert np.array_equal(whole, singles)
+        pairs = np.concatenate([sm.score(recs[i:i + 2])
+                                for i in range(0, 22, 2)]
+                               + [sm.score(recs[22:])])
+        assert np.array_equal(whole, pairs)
+
+    def test_zero_recompiles_after_warmup(self, trained):
+        registry = ModelRegistry(SHARD_CONFIGS, max_batch=32)
+        sm = registry.load(trained["v1"])
+        n = sm.engine.warmup()
+        assert n == 6  # buckets 1, 2, 4, 8, 16, 32
+        frozen = sm.engine.compile_count
+        for size in (1, 2, 3, 5, 7, 8, 11, 16, 23, 32, 40, 60):
+            sm.score(trained["requests"][:size])
+        # the steady-state contract: request-size variety → no new traces
+        assert sm.engine.compile_count == frozen
+        assert sm.engine.n_scored >= sum(
+            (1, 2, 3, 5, 7, 8, 11, 16, 23, 32, 40, 60))
+
+
+class TestRegistry:
+    def test_hot_swap_under_concurrent_scoring(self, trained):
+        registry = ModelRegistry(SHARD_CONFIGS, max_batch=16)
+        registry.load(trained["v1"])
+        recs = trained["requests"][:8]
+        v1_scores = registry.active().score(recs)
+
+        stop = threading.Event()
+        failures: list = []
+        n_ok = [0]
+
+        def loop():
+            try:
+                while not stop.is_set():
+                    scores = registry.active().score(recs)
+                    assert scores.shape == (8,)
+                    assert np.all(np.isfinite(scores))
+                    n_ok[0] += 1
+            except Exception as e:  # pragma: no cover - failure path
+                failures.append(e)
+
+        threads = [threading.Thread(target=loop) for _ in range(4)]
+        for t in threads:
+            t.start()
+        # swap mid-flight; scorers keep their grabbed version references
+        registry.reload(trained["v2"])
+        registry.active().score(recs)  # post-swap request succeeds too
+        stop.set()
+        for t in threads:
+            t.join()
+        assert not failures
+        assert n_ok[0] > 0
+        assert registry.active_version == 2
+        v2_scores = registry.active().score(recs)
+        # the swap was real: different coefficients, different scores
+        assert not np.array_equal(v1_scores, v2_scores)
+        # rollback stays instant: v1 is still registered and warm
+        registry.activate(1)
+        assert np.array_equal(registry.active().score(recs), v1_scores)
+
+    def test_corrupt_candidate_rejected_active_keeps_serving(
+            self, trained, tmp_path):
+        registry = ModelRegistry(SHARD_CONFIGS)
+        registry.load(trained["v1"])
+        recs = trained["requests"][:5]
+        before = registry.active().score(recs)
+
+        garbage = str(tmp_path / "garbage")
+        shutil.copytree(trained["v1"], garbage)
+        with open(os.path.join(garbage, "best",
+                               "model-metadata.json"), "w") as f:
+            f.write("{ this is not json")
+        with pytest.raises(Exception):
+            registry.reload(garbage)
+
+        missing = str(tmp_path / "missing-part")
+        shutil.copytree(trained["v1"], missing)
+        os.remove(os.path.join(missing, "best", "random-effect", "perUser",
+                               "coefficients", "part-00000.avro"))
+        with pytest.raises(FileNotFoundError):
+            registry.reload(missing)
+
+        # both rejections left version 1 active and serving identically
+        assert registry.active_version == 1
+        assert np.array_equal(registry.active().score(recs), before)
+
+    def test_retire_rules(self, trained):
+        registry = ModelRegistry(SHARD_CONFIGS)
+        registry.load(trained["v1"])
+        registry.load(trained["v2"])
+        with pytest.raises(ValueError):
+            registry.retire(2)  # active
+        registry.retire(1)
+        assert registry.versions() == [2]
+
+
+class TestBatcher:
+    def test_coalesces_and_matches_engine(self, trained):
+        registry = ModelRegistry(SHARD_CONFIGS, max_batch=16)
+        sm = registry.load(trained["v1"])
+        recs = trained["requests"][:10]
+        direct = sm.score(recs)
+        batcher = MicroBatcher(
+            lambda rs: registry.active().score(rs),
+            max_batch=16, max_wait_ms=100.0)
+        try:
+            futures = [batcher.submit(r) for r in recs]
+            got = np.array([f.result(timeout=60) for f in futures],
+                           np.float32)
+        finally:
+            batcher.close()
+        assert np.array_equal(got, direct)
+        # submits landed inside one linger window → coalesced batches
+        assert batcher.n_batches <= 2
+        assert batcher.n_coalesced >= 9
+
+    def test_batch_failure_fails_only_that_batch(self):
+        calls = [0]
+
+        def flaky(rs):
+            calls[0] += 1
+            if calls[0] == 1:
+                raise RuntimeError("boom")
+            return np.zeros(len(rs), np.float32)
+
+        batcher = MicroBatcher(flaky, max_batch=4, max_wait_ms=1.0)
+        try:
+            f1 = batcher.submit({"features": []})
+            with pytest.raises(RuntimeError):
+                f1.result(timeout=30)
+            f2 = batcher.submit({"features": []})
+            assert f2.result(timeout=30) == 0.0
+        finally:
+            batcher.close()
+
+
+class TestHttpEndToEnd:
+    def _post(self, url, payload):
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return json.loads(resp.read())
+
+    def _get(self, url):
+        with urllib.request.urlopen(url, timeout=60) as resp:
+            return json.loads(resp.read())
+
+    def test_serve_reload_serve(self, trained):
+        """Train tiny → serve → score via HTTP → hot-reload → score again."""
+        server = serve_game_cli.build_server([
+            "--model-dir", trained["v1"],
+            "--feature-shards", SHARDS,
+            "--port", "0", "--max-batch", "8", "--max-wait-ms", "1",
+        ]).start()
+        try:
+            base = server.url
+            health = self._get(base + "/healthz")
+            assert health["status"] == "ok"
+            assert health["version"] == 1
+            assert health["compiles"] >= 4  # warmed buckets 1..8
+
+            recs = trained["requests"][:3]
+            out1 = self._post(base + "/score", {"records": recs})
+            assert out1["version"] == 1 and len(out1["scores"]) == 3
+
+            # single-record route (through the microbatcher) agrees
+            single = self._post(base + "/score", {"record": recs[0]})
+            assert single["scores"][0] == out1["scores"][0]
+
+            out_reload = self._post(base + "/reload",
+                                    {"model_dir": trained["v2"]})
+            assert out_reload == {"version": 2, "previous": 1,
+                                  "model_dir": os.path.join(
+                                      trained["v2"], "best")}
+            out2 = self._post(base + "/score", {"records": recs})
+            assert out2["version"] == 2
+            assert out2["scores"] != out1["scores"]
+
+            # corrupt reload → 409, still serving version 2
+            with pytest.raises(urllib.error.HTTPError) as err:
+                self._post(base + "/reload",
+                           {"model_dir": os.path.join(trained["tmp"],
+                                                      "nonexistent")})
+            assert err.value.code == 409
+            assert self._get(base + "/healthz")["version"] == 2
+
+            with pytest.raises(urllib.error.HTTPError) as err:
+                self._post(base + "/score", {"records": []})
+            assert err.value.code == 400
+        finally:
+            server.stop()
+
+    def test_serving_request_events_on_bus(self, trained):
+        from photon_ml_tpu.events import EventBus
+
+        bus = EventBus()
+        seen = []
+        bus.subscribe(lambda e: seen.append(e))
+        registry = ModelRegistry(SHARD_CONFIGS, bus=bus)
+        registry.load(trained["v1"])
+        from photon_ml_tpu.serving import ServingService
+
+        service = ServingService(registry)
+        out = service.score({"records": trained["requests"][:2]})
+        assert len(out["scores"]) == 2
+        reqs = [e for e in seen if e.name == "serving_request"]
+        assert len(reqs) == 1
+        assert reqs[0].payload["batch"] == 2
+        assert reqs[0].payload["version"] == 1
+        assert reqs[0].payload["latency_ms"] >= 0
+        names = [e.name for e in seen]
+        assert "model_loaded" in names and "model_activated" in names

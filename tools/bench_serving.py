@@ -1,0 +1,219 @@
+"""Load generator / latency bench for the online serving subsystem.
+
+Spins up an in-process :class:`GameServer` over a trained GAME model (or
+targets an already-running server via ``--url``), replays request traffic at
+mixed batch sizes from worker threads, and reports:
+
+- ``serving_score_latency_ms`` — p50/p99 end-to-end HTTP latency plus
+  throughput (requests/s, rows/s),
+- the engine recompile count across the loaded phase (the zero-recompile
+  contract: after warmup it must not move — asserted by
+  tests/test_serving.py, *reported* here),
+- per-request metrics stream: the service posts one ``serving_request``
+  event per scored request on the EventBus; the bench subscribes a listener
+  and folds them into the summary (server-side latency vs. the
+  client-observed one).
+
+Output: one JSON line per metric + a terminal ``suite_summary`` line, the
+same artifact shape as bench.py.
+
+Usage::
+
+    python tools/bench_serving.py --model-dir out/ \
+        --feature-shards 'global=fixed|intercept,user=user|noIntercept' \
+        --data val.avro --requests 500 --concurrency 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import time
+import urllib.request
+
+
+def _percentile(xs, q):
+    import numpy as np
+
+    return float(np.percentile(np.asarray(xs), q)) if xs else 0.0
+
+
+def _http_json(url: str, payload=None, timeout=60.0):
+    if payload is None:
+        req = urllib.request.Request(url)
+    else:
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _request_pool(args, server):
+    """Records to replay: --data avro file when given, else synthetic
+    records drawn from the model's own feature/entity universe (plus a
+    slice of unseen entities — the cold-start path serves too)."""
+    if args.data:
+        from photon_ml_tpu.io.avro import iter_avro_file
+
+        records = list(iter_avro_file(args.data))
+        if not records:
+            raise SystemExit(f"--data {args.data!r} holds no records")
+        return records
+    if server is None:
+        raise SystemExit("--data is required with --url (a remote bench "
+                         "can't introspect the model's feature space)")
+    import numpy as np
+
+    from photon_ml_tpu.types import NAME_TERM_DELIMITER
+
+    sm = server.service.registry.active()
+    rng = np.random.default_rng(7)
+    records = []
+    stores = list(sm.stores.values())
+    for i in range(args.pool):
+        feats = []
+        for cfg in sm.engine.shard_configs:
+            names = [k for k in sm.index_maps[cfg.shard_id].names()
+                     if not k.startswith("(INTERCEPT)")]
+            take = rng.choice(len(names), size=min(6, len(names)),
+                              replace=False)
+            for t in take:
+                name, _, term = names[int(t)].partition(NAME_TERM_DELIMITER)
+                feats.append({"name": name, "term": term,
+                              "value": float(rng.normal())})
+        meta = {}
+        for store in stores:
+            ids = list(store.row_of_id)
+            # ~10% unseen entities: the fallback path is part of traffic
+            if ids and rng.random() > 0.1:
+                meta[store.random_effect_type] = ids[int(rng.integers(len(ids)))]
+            else:
+                meta[store.random_effect_type] = f"__cold_{i}"
+        records.append({"features": feats, "metadataMap": meta,
+                        "offset": None})
+    return records
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    p.add_argument("--model-dir")
+    p.add_argument("--feature-shards")
+    p.add_argument("--url", help="bench an already-running server instead "
+                                 "of spawning one in-process")
+    p.add_argument("--data", help="avro file of records to replay "
+                                  "(default: synthesize from the model)")
+    p.add_argument("--requests", type=int, default=200)
+    p.add_argument("--concurrency", type=int, default=4)
+    p.add_argument("--batch-sizes", default="1,1,1,2,4,8",
+                   help="cycled per request (skew toward singles, like "
+                        "real traffic)")
+    p.add_argument("--pool", type=int, default=256,
+                   help="synthetic request pool size")
+    p.add_argument("--max-wait-ms", type=float, default=2.0)
+    args = p.parse_args(argv)
+
+    server = None
+    server_events = []
+    if args.url:
+        base = args.url.rstrip("/")
+    else:
+        if not (args.model_dir and args.feature_shards):
+            raise SystemExit("--model-dir and --feature-shards are "
+                             "required without --url")
+        from photon_ml_tpu.cli.serve_game import build_server
+        from photon_ml_tpu.events import GLOBAL_BUS
+
+        GLOBAL_BUS.subscribe(
+            lambda e: server_events.append(e)
+            if e.name == "serving_request" else None)
+        server = build_server([
+            "--model-dir", args.model_dir,
+            "--feature-shards", args.feature_shards,
+            "--port", "0", "--max-wait-ms", str(args.max_wait_ms),
+        ]).start()
+        base = server.url
+
+    pool = _request_pool(args, server)
+    sizes = [int(s) for s in args.batch_sizes.split(",") if s]
+    compiles0 = _http_json(base + "/healthz")["compiles"]
+
+    latencies: list[float] = []
+    errors: list[str] = []
+    lock = threading.Lock()
+    counter = {"i": 0}
+
+    def worker():
+        while True:
+            with lock:
+                i = counter["i"]
+                if i >= args.requests:
+                    return
+                counter["i"] += 1
+            size = sizes[i % len(sizes)]
+            recs = [pool[(i + j) % len(pool)] for j in range(size)]
+            t0 = time.perf_counter()
+            try:
+                out = _http_json(base + "/score", {"records": recs})
+                assert len(out["scores"]) == size
+            except Exception as e:
+                with lock:
+                    errors.append(repr(e))
+                continue
+            with lock:
+                latencies.append((time.perf_counter() - t0) * 1e3)
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=worker)
+               for _ in range(args.concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    health = _http_json(base + "/healthz")
+
+    rows = sum(sizes[i % len(sizes)] for i in range(args.requests))
+    results = [{
+        "metric": "serving_score_latency_ms",
+        "value": round(_percentile(latencies, 50), 3),
+        "unit": "ms p50 (client-observed, HTTP included)",
+        "p99_ms": round(_percentile(latencies, 99), 3),
+        "requests_per_sec": round(len(latencies) / wall, 1),
+        "rows_per_sec": round(rows / wall, 1),
+        "n_requests": len(latencies),
+        "n_errors": len(errors),
+        "concurrency": args.concurrency,
+        "batch_sizes": sizes,
+        "recompiles_during_load": health["compiles"] - compiles0,
+        "version": health["version"],
+    }]
+    if server_events:
+        sl = [e.payload["latency_ms"] for e in server_events]
+        results.append({
+            "metric": "serving_server_latency_ms",
+            "value": round(_percentile(sl, 50), 3),
+            "unit": "ms p50 (server-side, via EventBus serving_request)",
+            "p99_ms": round(_percentile(sl, 99), 3),
+            "n_events": len(sl),
+        })
+    for r in results:
+        print(json.dumps(r), flush=True)
+    print(json.dumps({
+        "metric": "suite_summary",
+        "value": results[0]["value"],
+        "unit": results[0]["unit"],
+        "p99_ms": results[0]["p99_ms"],
+        "zero_recompiles": results[0]["recompiles_during_load"] == 0,
+        "n_errors": len(errors),
+        "wall_s": round(wall, 2),
+    }), flush=True)
+    if server is not None:
+        server.stop()
+    if errors:
+        raise SystemExit(f"{len(errors)} failed requests, first: {errors[0]}")
+
+
+if __name__ == "__main__":
+    main()
