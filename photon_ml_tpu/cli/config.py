@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
+import os
 from typing import Mapping, Optional, Sequence
 
 from photon_ml_tpu.game.data import RandomEffectDatasetConfig
@@ -275,6 +276,80 @@ def install_resilience(config: ResilienceConfig):
 
     set_default_policy(config.retry_policy())
     return config.guard()
+
+
+# ---------------------------------------------------------------------------
+# Telemetry configuration (shared by train_game, train_glm and serve_game)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class TelemetryConfig:
+    """The drivers' telemetry knobs, round-trippable through a JSON config
+    file like :class:`ResilienceConfig`.
+
+    ``telemetry_dir`` (None = disabled) receives ``trace.jsonl`` (the span
+    tree) while the run is live and ``metrics.prom`` (the final registry
+    snapshot) at close; ``poll_interval_s`` (0 = disabled) starts the
+    host-RSS/device-memory gauge sampler at that period.
+    """
+
+    telemetry_dir: Optional[str] = None
+    poll_interval_s: float = 0.0
+
+    def __post_init__(self):
+        if self.poll_interval_s < 0:
+            raise ValueError(f"poll_interval_s must be >= 0, "
+                             f"got {self.poll_interval_s}")
+
+    # --- config-file round-trip ------------------------------------------
+    def as_dict(self) -> dict:
+        return {"telemetryDir": self.telemetry_dir,
+                "pollIntervalS": self.poll_interval_s}
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "TelemetryConfig":
+        return cls(telemetry_dir=d.get("telemetryDir"),
+                   poll_interval_s=float(d.get("pollIntervalS", 0.0)))
+
+
+def add_telemetry_flags(parser) -> None:
+    """The shared driver flags (train_game, train_glm, serve_game)."""
+    parser.add_argument(
+        "--telemetry-dir", default=None,
+        help="enable span tracing + metric export into this directory: "
+             "trace.jsonl (nested spans: stages, coordinate-descent sweeps "
+             "and steps, optimizer traces) streamed during the run, "
+             "metrics.prom (Prometheus text snapshot of every counter/"
+             "gauge/histogram) written at exit. Default: telemetry off "
+             "(zero per-step device syncs)")
+    parser.add_argument(
+        "--telemetry-poll-s", type=float, default=0.0,
+        help="poll interval for the host-RSS / device-memory gauge "
+             "sampler (seconds; 0 disables — device memory_stats can "
+             "synchronize with the backend, so this is strictly opt-in)")
+
+
+def telemetry_from_args(args, *, subdir: Optional[str] = None,
+                        ) -> TelemetryConfig:
+    """``subdir`` relocates a non-chief process's telemetry under
+    ``workers/proc-N`` — N processes appending to one trace.jsonl would
+    interleave records from different runs of the id counter."""
+    tdir = args.telemetry_dir
+    if tdir and subdir:
+        tdir = os.path.join(tdir, subdir)
+    return TelemetryConfig(telemetry_dir=tdir,
+                           poll_interval_s=args.telemetry_poll_s)
+
+
+def install_telemetry(config: TelemetryConfig):
+    """Start the run's telemetry session (a no-op session when everything
+    is disabled) — the one call every driver makes after parsing flags.
+    Callers own ``session.close()``."""
+    from photon_ml_tpu.telemetry import start_telemetry
+
+    return start_telemetry(telemetry_dir=config.telemetry_dir,
+                           poll_interval_s=config.poll_interval_s)
 
 
 def parse_grid(specs: Sequence[str]) -> list[Mapping[str, float]]:

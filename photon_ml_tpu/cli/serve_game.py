@@ -42,6 +42,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-warmup", action="store_true",
                    help="skip pre-compiling the bucket executables at "
                         "startup (first requests then pay the compiles)")
+    from photon_ml_tpu.cli.config import add_telemetry_flags
+
+    add_telemetry_flags(p)
     return p
 
 
@@ -51,6 +54,14 @@ def build_server(argv: Optional[Sequence[str]] = None):
     from photon_ml_tpu.cli.config import parse_feature_shard_config
 
     args = build_parser().parse_args(argv)
+    from photon_ml_tpu.cli.config import (
+        install_telemetry,
+        telemetry_from_args,
+    )
+
+    # /metrics is always live (the registry is process-global); the session
+    # adds the trace file and device sampler when the flags ask for them
+    telemetry = install_telemetry(telemetry_from_args(args))
     import jax
 
     if jax.default_backend() == "cpu" and not jax.config.jax_enable_x64:
@@ -77,20 +88,23 @@ def build_server(argv: Optional[Sequence[str]] = None):
             max_batch=args.microbatch, max_wait_ms=args.max_wait_ms)
     service = ServingService(registry, default_model_dir=args.model_dir,
                              batcher=batcher)
-    return GameServer(service, host=args.host, port=args.port)
+    server = GameServer(service, host=args.host, port=args.port)
+    server.telemetry = telemetry  # closed by run()'s finally
+    return server
 
 
 def run(argv: Optional[Sequence[str]] = None) -> dict:
     server = build_server(argv)
     version = server.service.registry.active_version
     print(f"serving GAME model version {version} on {server.url} "
-          f"(/score /healthz /reload)", flush=True)
+          f"(/score /healthz /metrics /reload)", flush=True)
     try:
         server.serve_forever()
     except KeyboardInterrupt:
         pass
     finally:
         server.stop()
+        server.telemetry.close()
     return {"url": server.url, "version": version}
 
 

@@ -16,11 +16,14 @@ from typing import Optional, Sequence
 
 from photon_ml_tpu.cli.config import (
     add_resilience_flags,
+    add_telemetry_flags,
     install_resilience,
+    install_telemetry,
     parse_coordinate_config,
     parse_feature_shard_config,
     parse_grid,
     resilience_from_args,
+    telemetry_from_args,
 )
 from photon_ml_tpu.data_validation import validate_game_data
 from photon_ml_tpu.evaluation import parse_evaluators
@@ -125,6 +128,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "optimizer) and random-effect entity lanes over "
                         "'entity'. Default: single device")
     add_resilience_flags(p)
+    add_telemetry_flags(p)
     return p
 
 
@@ -219,6 +223,16 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
     log_dir = args.output_dir if chief else os.path.join(
         args.output_dir, "workers", f"proc-{_process_index()}")
     run_logger = RunLogger(log_dir)
+    # telemetry before the first event post, so the bridge sees the whole
+    # run; non-chief processes trace under their own workers/ subdir
+    telemetry = install_telemetry(telemetry_from_args(
+        args, subdir=None if chief
+        else os.path.join("workers", f"proc-{_process_index()}")))
+    from photon_ml_tpu.telemetry import tracing
+    import contextlib as _contextlib
+
+    _root_span = _contextlib.ExitStack()
+    _root_span.enter_context(tracing.span("train_game"))
     GLOBAL_BUS.post("training_started", driver="train_game",
                     task=task.value, output_dir=args.output_dir)
     try:
@@ -540,7 +554,9 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
             "output_dir": args.output_dir,
         }
     finally:
+        _root_span.close()
         GLOBAL_BUS.post("training_finished", driver="train_game")
+        telemetry.close()
         run_logger.close()
 
 

@@ -20,8 +20,11 @@ import numpy as np
 
 from photon_ml_tpu.cli.config import (
     add_resilience_flags,
+    add_telemetry_flags,
     install_resilience,
+    install_telemetry,
     resilience_from_args,
+    telemetry_from_args,
 )
 from photon_ml_tpu.data_validation import validate_game_data
 from photon_ml_tpu.evaluation import parse_evaluators
@@ -132,6 +135,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "--training-diagnostics or --design-dtype bfloat16 "
                         "yet")
     add_resilience_flags(p)
+    add_telemetry_flags(p)
     return p
 
 
@@ -250,6 +254,19 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
     run_logger = RunLogger(
         args.output_dir if chief else os.path.join(
             args.output_dir, "workers", f"proc-{jax.process_index()}"))
+    telemetry = install_telemetry(telemetry_from_args(
+        args, subdir=None if chief
+        else os.path.join("workers", f"proc-{jax.process_index()}")))
+    from photon_ml_tpu.telemetry import tracing
+
+    import contextlib as _contextlib
+
+    _root_span = _contextlib.ExitStack()
+    _root_span.enter_context(tracing.span("train_glm"))
+    from photon_ml_tpu.events import GLOBAL_BUS
+
+    GLOBAL_BUS.post("training_started", driver="train_glm",
+                    task=task.value, output_dir=args.output_dir)
     try:
         evaluators = parse_evaluators(
             [e for e in args.evaluators.split(",") if e])
@@ -473,6 +490,9 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
             "diagnostics_report": report_path,
         }
     finally:
+        _root_span.close()
+        GLOBAL_BUS.post("training_finished", driver="train_glm")
+        telemetry.close()
         run_logger.close()
 
 

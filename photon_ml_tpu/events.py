@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import threading
 import time
 from typing import Any, Callable, Mapping
 
@@ -43,26 +44,39 @@ Listener = Callable[[TrainingEvent], None]
 
 
 class EventBus:
-    """Synchronous in-process pub/sub (reference event bus equivalent)."""
+    """Synchronous in-process pub/sub (reference event bus equivalent).
+
+    Thread-safe: the serving front end posts from ``ThreadingHTTPServer``
+    worker threads while training code (or a test) may subscribe
+    concurrently, so list mutation happens under a lock and ``post``
+    iterates a snapshot. Listeners run on the POSTING thread, outside the
+    lock — a slow listener delays its poster, never other
+    subscribe/unsubscribe calls.
+    """
 
     def __init__(self) -> None:
+        self._lock = threading.Lock()
         self._listeners: list[Listener] = []
 
     def subscribe(self, listener: Listener) -> Callable[[], None]:
         """Register; returns an unsubscribe callable."""
-        self._listeners.append(listener)
+        with self._lock:
+            self._listeners.append(listener)
 
         def unsubscribe() -> None:
-            try:
-                self._listeners.remove(listener)
-            except ValueError:
-                pass
+            with self._lock:
+                try:
+                    self._listeners.remove(listener)
+                except ValueError:
+                    pass
 
         return unsubscribe
 
     def post(self, name: str, **payload: Any) -> TrainingEvent:
         event = TrainingEvent(name=name, payload=payload)
-        for listener in list(self._listeners):
+        with self._lock:
+            listeners = list(self._listeners)
+        for listener in listeners:
             try:
                 listener(event)
             except Exception:  # observers must never kill training
@@ -70,7 +84,8 @@ class EventBus:
         return event
 
     def __len__(self) -> int:
-        return len(self._listeners)
+        with self._lock:
+            return len(self._listeners)
 
 
 #: Default process-wide bus the CLI drivers post to; embedders may also pass

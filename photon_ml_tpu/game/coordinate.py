@@ -159,6 +159,16 @@ class FixedEffectCoordinate:
             train_fn = _fixed_train_fn(self.task, self.config)
         result, variances, scores = train_fn(
             data, w0, jnp.asarray(self.lam, jnp.float32))
+        from photon_ml_tpu.telemetry import tracing
+
+        if tracing.enabled():
+            # the reference's OptimizationStatesTracker table, folded into
+            # trace.jsonl + the metrics registry. Gated: reading the trace
+            # arrays syncs the device, which a bare run's async dispatch
+            # must not pay.
+            from photon_ml_tpu.telemetry import record_optimizer_trace
+
+            record_optimizer_trace(self.coordinate_id, result, sweep=sweep)
         scores = scores.reshape(-1)
         if self.dataset.n_shards > 1:
             scores = scores[:self.dataset.n_samples]  # drop tail padding

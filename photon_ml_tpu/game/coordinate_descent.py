@@ -214,102 +214,156 @@ class CoordinateDescent:
             total = jnp.asarray(data.offsets, jnp.float32) \
                 + sum(scores.values())
 
+        # --- telemetry (live only under --telemetry-dir: the loss/grad-norm
+        # reads force a device sync per step, which a bare run's async
+        # dispatch pipeline must not pay) ---------------------------------
+        from photon_ml_tpu.telemetry import tracing
+        telemetry_on = tracing.enabled()
+        if telemetry_on:
+            from photon_ml_tpu.ops.losses import loss_for_task
+            from photon_ml_tpu.telemetry import metrics as tmetrics
+
+            _loss = loss_for_task(task)
+            _labels_d = jnp.asarray(data.labels, jnp.float32)
+            _weights_d = jnp.asarray(data.weights, jnp.float32)
+            _loss_gauge = tmetrics.gauge(
+                "photon_game_coordinate_loss",
+                "Weighted data objective (no regularizer) after the "
+                "coordinate's step", labels=("coordinate",))
+            _gnorm_gauge = tmetrics.gauge(
+                "photon_game_coordinate_grad_norm",
+                "Norm of the weighted margin gradient after the "
+                "coordinate's step", labels=("coordinate",))
+            _steps_total = tmetrics.counter(
+                "photon_game_coordinate_steps_total",
+                "Committed coordinate-descent steps",
+                labels=("coordinate",))
+
         history: list[dict[str, float]] = []
         final_evaluation = None
         for sweep in range(start_sweep, self.n_iterations):
             fault_point("worker.stall", sweep=sweep)
-            for ci, cid in enumerate(self.update_sequence):
-                if sweep == start_sweep and ci < start_coord:
-                    continue
-                if cid in locked:
-                    continue  # frozen: scores stay as seeded
-                if (guard is not None and cid in guard.frozen
-                        and cid in models):
-                    # diverged earlier THIS fit: locked at last good model.
-                    # A fresh configuration (no model yet — e.g. the next
-                    # grid point sharing the guard) retrains: its new
-                    # regularization may well not diverge.
-                    continue
-                t0 = time.perf_counter()
-                while True:
-                    residual = total - scores[cid]
-                    try:
-                        model, new_scores = coordinates[cid].train(
-                            residual, models.get(cid), sweep=sweep)
-                        new_scores = fault_value(
-                            "optimizer.step", new_scores,
-                            coordinate=cid, sweep=sweep)
-                        step_error = None
-                    except Exception as e:
-                        if guard is None:
-                            raise
-                        model, new_scores, step_error = None, None, e
-                    if guard is None or (step_error is None
-                                         and guard.healthy(model,
-                                                           new_scores)):
-                        break  # healthy: commit below
-                    action = guard.on_divergence(
-                        cid, sweep=sweep, has_good_model=cid in models,
-                        error=step_error)
-                    if action == "freeze":
-                        new_scores = None  # keep last good model + scores
-                        break
-                    # roll back to the last durable state: nothing was
-                    # committed in-process, and when a checkpoint manager
-                    # is present the state is re-read from disk so
-                    # recovery exercises the exact crash-restart path
-                    if (checkpoint is not None
-                            and checkpoint.latest_step() is not None):
-                        state = checkpoint.restore(
-                            expected_fingerprint=config_fingerprint)
-                        models = dict(state.model.coordinates)
-                        for k, v in state.scores.items():
-                            if k in scores:
-                                host_scores[k] = np.asarray(v, np.float32)
-                                scores[k] = jnp.asarray(host_scores[k])
-                        total = jnp.asarray(data.offsets, jnp.float32) \
-                            + sum(scores.values())
-                    # regularization backoff: stronger curvature is the
-                    # standard fix for a diverged GLM solve
-                    coord = coordinates[cid]
-                    if hasattr(coord, "lam"):
-                        coordinates[cid] = dataclasses.replace(
-                            coord, lam=guard.next_lam(coord.lam))
-                if new_scores is None:
-                    continue  # frozen mid-sweep: nothing to commit
-                models[cid] = model
-                total = residual + new_scores
-                scores[cid] = new_scores
-                # dispatch time: device work may still be in flight (async
-                # dispatch is what lets the next coordinate's host prep
-                # overlap); the sweep wall-clock is the honest total
-                logger.info("sweep %d coordinate %s dispatched in %.2fs",
+            with tracing.span("cd.sweep", sweep=sweep):
+                for ci, cid in enumerate(self.update_sequence):
+                    if sweep == start_sweep and ci < start_coord:
+                        continue
+                    if cid in locked:
+                        continue  # frozen: scores stay as seeded
+                    if (guard is not None and cid in guard.frozen
+                            and cid in models):
+                        # diverged earlier THIS fit: locked at last good
+                        # model. A fresh configuration (no model yet — e.g.
+                        # the next grid point sharing the guard) retrains:
+                        # its new regularization may well not diverge.
+                        continue
+                    with tracing.span("cd.step", coordinate=cid,
+                                      sweep=sweep) as step_span:
+                        t0 = time.perf_counter()
+                        while True:
+                            residual = total - scores[cid]
+                            try:
+                                model, new_scores = coordinates[cid].train(
+                                    residual, models.get(cid), sweep=sweep)
+                                new_scores = fault_value(
+                                    "optimizer.step", new_scores,
+                                    coordinate=cid, sweep=sweep)
+                                step_error = None
+                            except Exception as e:
+                                if guard is None:
+                                    raise
+                                model, new_scores, step_error = None, None, e
+                            if guard is None or (step_error is None
+                                                 and guard.healthy(
+                                                     model, new_scores)):
+                                break  # healthy: commit below
+                            action = guard.on_divergence(
+                                cid, sweep=sweep,
+                                has_good_model=cid in models,
+                                error=step_error)
+                            if action == "freeze":
+                                new_scores = None  # keep last good state
+                                break
+                            # roll back to the last durable state: nothing
+                            # was committed in-process, and when a
+                            # checkpoint manager is present the state is
+                            # re-read from disk so recovery exercises the
+                            # exact crash-restart path
+                            if (checkpoint is not None
+                                    and checkpoint.latest_step() is not None):
+                                state = checkpoint.restore(
+                                    expected_fingerprint=config_fingerprint)
+                                models = dict(state.model.coordinates)
+                                for k, v in state.scores.items():
+                                    if k in scores:
+                                        host_scores[k] = np.asarray(
+                                            v, np.float32)
+                                        scores[k] = jnp.asarray(
+                                            host_scores[k])
+                                total = jnp.asarray(data.offsets,
+                                                    jnp.float32) \
+                                    + sum(scores.values())
+                            # regularization backoff: stronger curvature is
+                            # the standard fix for a diverged GLM solve
+                            coord = coordinates[cid]
+                            if hasattr(coord, "lam"):
+                                coordinates[cid] = dataclasses.replace(
+                                    coord, lam=guard.next_lam(coord.lam))
+                        if new_scores is None:
+                            continue  # frozen mid-sweep: nothing to commit
+                        models[cid] = model
+                        total = residual + new_scores
+                        scores[cid] = new_scores
+                        if telemetry_on:
+                            # progress of the BLOCK objective CD minimizes:
+                            # loss of the committed total margin, and the
+                            # norm of its margin gradient (≈ how much signal
+                            # is left for later coordinates to absorb)
+                            margins = total.astype(jnp.float32)
+                            obj = float(jnp.sum(
+                                _weights_d * _loss.loss(margins, _labels_d)))
+                            gnorm = float(jnp.linalg.norm(
+                                _weights_d * _loss.d1(margins, _labels_d)))
+                            step_span.set(loss=obj, grad_norm=gnorm)
+                            _loss_gauge.labels(coordinate=cid).set(obj)
+                            _gnorm_gauge.labels(coordinate=cid).set(gnorm)
+                            _steps_total.labels(coordinate=cid).inc()
+                        # dispatch time: device work may still be in flight
+                        # (async dispatch is what lets the next coordinate's
+                        # host prep overlap); the sweep wall is the honest
+                        # total
+                        logger.info(
+                            "sweep %d coordinate %s dispatched in %.2fs",
                             sweep, cid, time.perf_counter() - t0)
-                if checkpoint is not None:
-                    from photon_ml_tpu.io.checkpoint import CoordinateDescentState
+                        if checkpoint is not None:
+                            from photon_ml_tpu.io.checkpoint import (
+                                CoordinateDescentState,
+                            )
 
-                    # sync ONLY the trained coordinate to the host mirror
-                    host_scores[cid] = np.asarray(new_scores, np.float32)
-                    next_ci = (ci + 1) % len(self.update_sequence)
-                    checkpoint.save(
-                        sweep * len(self.update_sequence) + ci + 1,
-                        CoordinateDescentState(
-                            sweep=sweep + (next_ci == 0),
-                            coordinate_index=next_ci,
-                            model=GameModel(coordinates=dict(models), task=task),
-                            scores=dict(host_scores)),
-                        fingerprint=config_fingerprint)
+                            # sync ONLY the trained coordinate to the mirror
+                            host_scores[cid] = np.asarray(new_scores,
+                                                          np.float32)
+                            next_ci = (ci + 1) % len(self.update_sequence)
+                            checkpoint.save(
+                                sweep * len(self.update_sequence) + ci + 1,
+                                CoordinateDescentState(
+                                    sweep=sweep + (next_ci == 0),
+                                    coordinate_index=next_ci,
+                                    model=GameModel(
+                                        coordinates=dict(models), task=task),
+                                    scores=dict(host_scores)),
+                                fingerprint=config_fingerprint)
 
-            if validation is not None:
-                vdata, evaluators = validation
-                gm = GameModel(coordinates=dict(models), task=task)
-                vscores = gm.score(vdata)
-                results = evaluate_all(
-                    evaluators, vscores, vdata.labels, weights=vdata.weights,
-                    id_tags=vdata.id_columns)
-                history.append(results.as_dict())
-                final_evaluation = results
-                logger.info("sweep %d validation: %s", sweep, results)
+                if validation is not None:
+                    vdata, evaluators = validation
+                    with tracing.span("cd.validate", sweep=sweep):
+                        gm = GameModel(coordinates=dict(models), task=task)
+                        vscores = gm.score(vdata)
+                        results = evaluate_all(
+                            evaluators, vscores, vdata.labels,
+                            weights=vdata.weights, id_tags=vdata.id_columns)
+                    history.append(results.as_dict())
+                    final_evaluation = results
+                    logger.info("sweep %d validation: %s", sweep, results)
 
         model = GameModel(
             coordinates={cid: models[cid] for cid in self.update_sequence},

@@ -13,6 +13,7 @@ import contextlib
 import json
 import logging
 import os
+import threading
 import time
 from typing import Iterator, Optional
 
@@ -42,15 +43,28 @@ class RunLogger:
             self._handlers.append(fh)
         self._metrics_path = (os.path.join(run_dir, "metrics.jsonl")
                               if run_dir else None)
+        # ONE append handle for the logger's lifetime: reopening per metric
+        # costs an open/close syscall pair per line, and interleaved opens
+        # from serving threads can shear lines — the lock serializes writers
+        # and the flush keeps the file durable line-by-line
+        self._metrics_lock = threading.Lock()
+        self._metrics_fh = (open(self._metrics_path, "a", encoding="utf-8")
+                            if self._metrics_path else None)
 
     def metric(self, **kwargs) -> None:
         kwargs.setdefault("ts", time.time())
-        if self._metrics_path:
-            with open(self._metrics_path, "a") as f:
-                f.write(json.dumps(kwargs) + "\n")
+        line = json.dumps(kwargs) + "\n"
+        with self._metrics_lock:
+            if self._metrics_fh is not None:
+                self._metrics_fh.write(line)
+                self._metrics_fh.flush()
         logger.info("metric %s", kwargs)
 
     def close(self) -> None:
+        with self._metrics_lock:
+            if self._metrics_fh is not None:
+                self._metrics_fh.close()
+                self._metrics_fh = None
         root = logging.getLogger("photon_ml_tpu")
         for h in self._handlers:
             root.removeHandler(h)
@@ -112,25 +126,35 @@ def profiled(output_dir: Optional[str]) -> Iterator[None]:
     import jax
 
     os.makedirs(output_dir, exist_ok=True)
-    with jax.profiler.trace(output_dir):
-        yield
-    logger.info("profiler trace written to %s", output_dir)
+    try:
+        with jax.profiler.trace(output_dir):
+            yield
+    finally:
+        # the trace file exists even when the body raised (the profiler's
+        # own exit wrote it) — confirm in a finally so a failing run still
+        # tells the user where its trace landed
+        logger.info("profiler trace written to %s", output_dir)
 
 
 @contextlib.contextmanager
 def timed(stage: str, run_logger: Optional[RunLogger] = None) -> Iterator[None]:
-    """``with timed("Read data"): ...`` — the reference's ``Timed`` wrapper.
+    """``with timed("Read data"): ...`` — the reference's ``Timed`` wrapper,
+    now a thin layer over a telemetry span: the stage appears in the run's
+    ``trace.jsonl`` tree (when ``--telemetry-dir`` is configured) with the
+    same name.
 
     Also posts ``stage_started``/``stage_finished`` lifecycle events to the
     global :mod:`photon_ml_tpu.events` bus so observers see stage boundaries.
     """
     from photon_ml_tpu.events import GLOBAL_BUS
+    from photon_ml_tpu.telemetry.tracing import span
 
     logger.info("%s: start", stage)
     GLOBAL_BUS.post("stage_started", stage=stage)
     t0 = time.perf_counter()
     try:
-        yield
+        with span(stage, kind="stage"):
+            yield
     finally:
         dt = time.perf_counter() - t0
         logger.info("%s: done in %.2fs", stage, dt)

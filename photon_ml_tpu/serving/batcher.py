@@ -22,6 +22,18 @@ from typing import Callable, Optional, Sequence
 
 import numpy as np
 
+from photon_ml_tpu.telemetry import metrics as _metrics
+
+#: how well the linger window coalesces traffic — the distribution should
+#: shift right as load rises (that's the amortization working)
+_BATCH_SIZE = _metrics.histogram(
+    "photon_serving_batch_size",
+    "Coalesced records per microbatcher scoring call",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256))
+#: requests parked in the queue right now (sampled at enqueue/drain)
+_QUEUE_DEPTH = _metrics.gauge(
+    "photon_serving_queue_depth", "Microbatcher queue depth")
+
 
 class MicroBatcher:
     """Single-worker request coalescer in front of a scoring callable.
@@ -54,6 +66,7 @@ class MicroBatcher:
             if self._closed:
                 raise RuntimeError("batcher is closed")
             self._queue.append((record, fut))
+            _QUEUE_DEPTH.set(len(self._queue))
             self._cond.notify()
         return fut
 
@@ -76,6 +89,7 @@ class MicroBatcher:
             if batch is None:
                 return
             records = [r for r, _ in batch]
+            _BATCH_SIZE.observe(len(records))
             try:
                 scores = self._score_fn(records)
             except Exception as e:  # score failure fails THIS batch only
@@ -110,4 +124,5 @@ class MicroBatcher:
             out = []
             while self._queue and len(out) < self.max_batch:
                 out.append(self._queue.popleft())
+            _QUEUE_DEPTH.set(len(self._queue))
             return out

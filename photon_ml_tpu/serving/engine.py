@@ -36,6 +36,17 @@ from photon_ml_tpu.io.data_reader import FeatureShardConfig, _record_features
 from photon_ml_tpu.io.index import IndexMap
 from photon_ml_tpu.types import INTERCEPT_KEY
 from photon_ml_tpu.serving.store import EntityCoefficientStore
+from photon_ml_tpu.telemetry import metrics as _metrics
+
+#: one XLA trace of the scoring program — constant after warmup (the
+#: zero-recompile contract the bench and the /metrics scrape both watch)
+_RECOMPILES = _metrics.counter(
+    "photon_serving_recompiles_total",
+    "XLA traces of the scoring program (constant after warmup)")
+#: engine-side scoring latency per padded bucket shape (dispatch + D2H)
+_SCORE_LATENCY = _metrics.histogram(
+    "photon_serving_score_latency_seconds",
+    "Engine scoring time per padded batch bucket", labels=("bucket",))
 
 
 def next_bucket(n: int) -> int:
@@ -106,6 +117,7 @@ class ScoringEngine:
             # body runs at TRACE time only — one increment per compiled
             # bucket shape, the recompile counter the serving bench asserts
             self._compile_count += 1
+            _RECOMPILES.inc()
             margins = []
             i_x = {sid: i for i, sid in enumerate(self._shard_order)}
             i_r = {cid: i for i, cid in enumerate(self._re_order)}
@@ -204,9 +216,14 @@ class ScoringEngine:
             rp = np.full(b, self.stores[cid].fallback_row, np.int32)
             rp[:n] = r[lo:hi]
             rows.append(rp)
-        scores = self._score_jit(self._params, offsets, tuple(xs),
-                                 tuple(rows))
-        return np.asarray(scores)[:n]
+        # the np.asarray D2H pull belongs inside the timed region: jax
+        # dispatch is async, so the jit call alone returns before the
+        # device finishes
+        with _SCORE_LATENCY.labels(bucket=str(b)).time():
+            scores = self._score_jit(self._params, offsets, tuple(xs),
+                                     tuple(rows))
+            out = np.asarray(scores)[:n]
+        return out
 
     def warmup(self, max_bucket: Optional[int] = None) -> int:
         """Pre-trace every bucket executable (1, 2, 4, … ``max_batch``) so

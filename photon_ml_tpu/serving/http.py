@@ -10,6 +10,9 @@ and none needed — handlers are thin marshaling around the registry/batcher):
   enabled; explicit batches go straight to the engine.
 - ``GET /healthz`` — liveness + the serving counters the bench asserts on
   (active version, engine compile count, requests/scores served).
+- ``GET /metrics`` — Prometheus text exposition of the process-global
+  telemetry registry (request latency histogram, per-bucket score
+  latency, recompile counter, active version gauge, ...).
 - ``POST /reload`` — ``{"model_dir": "..."} `` (optional; defaults to the
   dir served at startup) → validate + hot-swap. A corrupt candidate
   returns 409 and the active version keeps serving.
@@ -17,7 +20,10 @@ and none needed — handlers are thin marshaling around the registry/batcher):
 Every scored request posts a ``serving_request`` event on the registry's
 :class:`~photon_ml_tpu.events.EventBus` (latency, batch size, version) —
 the same bus training lifecycle events ride, so one metrics exporter
-observes both halves of the system.
+observes both halves of the system. Request latency itself is measured by
+the telemetry registry's histogram timer (the hygiene rule: serving code
+never calls ``time.perf_counter`` directly — see
+``tools/check_telemetry_hygiene.py``).
 """
 
 from __future__ import annotations
@@ -30,6 +36,13 @@ from typing import Optional
 
 from photon_ml_tpu.serving.batcher import MicroBatcher
 from photon_ml_tpu.serving.registry import ModelRegistry
+from photon_ml_tpu.telemetry import metrics as _metrics
+
+#: end-to-end /score handling time (pack + engine + marshaling), the
+#: server-side complement of the bench's client-observed latency
+_REQUEST_LATENCY = _metrics.histogram(
+    "photon_serving_request_latency_seconds",
+    "End-to-end /score request handling time")
 
 
 class ServingService:
@@ -55,14 +68,14 @@ class ServingService:
         if not isinstance(records, list) or not records:
             raise ValueError("payload needs 'records': [non-empty list] "
                              "or 'record': {...}")
-        t0 = time.perf_counter()
-        version = self.registry.active_version
-        if self.batcher is not None and len(records) == 1:
-            scores = [self.batcher.score(records[0])]
-        else:
-            scores = [float(s)
-                      for s in self.registry.active().score(records)]
-        latency_ms = (time.perf_counter() - t0) * 1e3
+        with _REQUEST_LATENCY.time() as timer:
+            version = self.registry.active_version
+            if self.batcher is not None and len(records) == 1:
+                scores = [self.batcher.score(records[0])]
+            else:
+                scores = [float(s)
+                          for s in self.registry.active().score(records)]
+        latency_ms = timer.seconds * 1e3
         with self._lock:
             self.n_requests += 1
             self.n_scored += len(records)
@@ -106,9 +119,13 @@ def _make_handler(service: ServingService):
             pass
 
         def _reply(self, status: int, body: dict) -> None:
-            data = json.dumps(body).encode()
+            self._reply_raw(status, json.dumps(body).encode(),
+                            "application/json")
+
+        def _reply_raw(self, status: int, data: bytes,
+                       content_type: str) -> None:
             self.send_response(status)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(data)))
             self.end_headers()
             self.wfile.write(data)
@@ -122,6 +139,13 @@ def _make_handler(service: ServingService):
         def do_GET(self):  # noqa: N802
             if self.path == "/healthz":
                 self._reply(200, service.healthz())
+            elif self.path == "/metrics":
+                from photon_ml_tpu.telemetry.prometheus import (
+                    CONTENT_TYPE,
+                    render,
+                )
+
+                self._reply_raw(200, render().encode(), CONTENT_TYPE)
             else:
                 self._reply(404, {"error": f"unknown path {self.path}"})
 

@@ -72,6 +72,13 @@ class ModelRegistry:
         self.max_batch = max_batch
         self.warmup = warmup
         self.bus = bus if bus is not None else GLOBAL_BUS
+        # lifecycle events (model_loaded/activated/rejected) become metrics
+        # (reload counters, active-version gauge) via the telemetry bridge;
+        # binding here — idempotently — means every registry's bus feeds
+        # /metrics without the embedder wiring anything
+        from photon_ml_tpu.telemetry import bridge
+
+        bridge.bind(bus=self.bus)
         self._lock = threading.Lock()
         self._versions: dict[int, ServingModel] = {}
         self._active: Optional[ServingModel] = None
@@ -108,7 +115,16 @@ class ModelRegistry:
         from photon_ml_tpu.resilience import retry
 
         name = f"serving.load:{os.path.basename(os.path.normpath(model_dir))}"
-        loaded = retry(lambda: self._load_validated(model_dir), name=name)
+        try:
+            loaded = retry(lambda: self._load_validated(model_dir), name=name)
+        except Exception as e:
+            # the reject is part of the observable lifecycle: the bridge
+            # counts it (photon_model_reload_rejects_total) and operators
+            # alert on it — a fleet silently failing to pick up new models
+            # is the exact failure /reload was built to surface
+            self.bus.post("model_reload_rejected", path=model_dir,
+                          error=repr(e))
+            raise
         with self._lock:
             version = self._next_version
             self._next_version += 1

@@ -1,0 +1,167 @@
+"""Unified telemetry: metrics registry, Prometheus exposition, span tracing.
+
+The observability layer training, resilience, and serving all feed
+(OBSERVABILITY.md is the operator-facing doc; SURVEY.md §5.1 maps the
+reference's ``PhotonLogger``/``Timed``/``OptimizationStatesTracker`` story
+this supersedes):
+
+- :mod:`~photon_ml_tpu.telemetry.metrics` — thread-safe labeled
+  Counter/Gauge/Histogram families in a process-global registry
+  (stdlib-only, nanosecond-scale updates);
+- :mod:`~photon_ml_tpu.telemetry.prometheus` — ``/metrics`` text
+  exposition + the matching parser;
+- :mod:`~photon_ml_tpu.telemetry.tracing` — nested spans →
+  ``trace.jsonl`` (``timed()`` stages ride it automatically);
+- :mod:`~photon_ml_tpu.telemetry.bridge` — the EventBus→registry
+  translator (existing ``serving_request``/``retry_*``/``stage_finished``
+  events become metrics with zero call-site changes);
+- :mod:`~photon_ml_tpu.telemetry.device` — optional host-RSS/device-memory
+  gauge sampler.
+
+:class:`TelemetrySession` is the drivers' one-call lifecycle: configure the
+global tracer into ``--telemetry-dir``, bind the bridge, start the sampler,
+and on close dump a final ``metrics.prom`` snapshot next to the trace.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from photon_ml_tpu.telemetry import bridge, metrics, tracing  # noqa: F401
+from photon_ml_tpu.telemetry.metrics import (  # noqa: F401
+    DEFAULT_LATENCY_BUCKETS,
+    MetricsRegistry,
+    default_registry,
+    quantile_from_buckets,
+)
+from photon_ml_tpu.telemetry.tracing import (  # noqa: F401
+    GLOBAL_TRACER,
+    Tracer,
+    annotate,
+    span,
+)
+
+
+def record_optimizer_trace(coordinate_id: str, result, *, sweep: int = 0,
+                           ) -> None:
+    """Fold one coordinate solve's optimizer trace into telemetry: the
+    per-iteration (loss, |grad|) table goes into ``trace.jsonl`` as an
+    ``optimizer_trace`` annotation under the current span, and the
+    iteration/convergence summary lands in the registry — the reference's
+    ``OptimizationStatesTracker`` dump, queryable instead of grepped.
+
+    Call sites gate on :func:`tracing.enabled` — reading ``result`` arrays
+    forces a device sync, which a non-telemetry run must not pay.
+    """
+    import numpy as np
+
+    iterations = int(result.iterations)
+    converged = bool(result.converged)
+    metrics.counter(
+        "photon_optimizer_iterations_total",
+        "Optimizer iterations spent, per coordinate",
+        labels=("coordinate",)).labels(coordinate=coordinate_id).inc(
+            max(iterations, 0))
+    metrics.gauge(
+        "photon_optimizer_converged",
+        "1 when the coordinate's last solve converged",
+        labels=("coordinate",)).labels(coordinate=coordinate_id).set(
+            1.0 if converged else 0.0)
+    values = np.asarray(result.values, np.float64)
+    gnorms = np.asarray(result.grad_norms, np.float64)
+    if values.size == 0:
+        return  # per-iteration tracking off (e.g. vmapped solves)
+    n = min(iterations + 1, len(values))
+    finite = np.isfinite(values[:n])
+    if finite.any():
+        last = int(np.nonzero(finite)[0][-1])
+        metrics.gauge(
+            "photon_optimizer_final_loss",
+            "Objective value at the coordinate's last recorded iteration",
+            labels=("coordinate",)).labels(coordinate=coordinate_id).set(
+                float(values[last]))
+        metrics.gauge(
+            "photon_optimizer_final_grad_norm",
+            "Gradient norm at the coordinate's last recorded iteration",
+            labels=("coordinate",)).labels(coordinate=coordinate_id).set(
+                float(gnorms[last]))
+    tracing.annotate(
+        "optimizer_trace", coordinate=coordinate_id, sweep=sweep,
+        iterations=iterations, converged=converged,
+        values=[float(v) for v in values[:n]],
+        grad_norms=[float(g) for g in gnorms[:n]])
+
+
+class _NullSession:
+    """Telemetry disabled: every lifecycle call is a no-op."""
+
+    enabled = False
+
+    def close(self) -> None:
+        pass
+
+
+class TelemetrySession:
+    """One run's telemetry lifecycle (built by the drivers from
+    ``--telemetry-dir`` / ``--telemetry-poll-s``)."""
+
+    enabled = True
+
+    def __init__(self, telemetry_dir: Optional[str] = None,
+                 poll_interval_s: float = 0.0, bus=None,
+                 registry: Optional[MetricsRegistry] = None):
+        if bus is None:
+            from photon_ml_tpu.events import GLOBAL_BUS as bus
+        self.telemetry_dir = telemetry_dir
+        self.registry = registry if registry is not None \
+            else default_registry()
+        self._unbind = bridge.bind(bus=bus, registry=self.registry)
+        self._sampler = None
+        self._owns_tracer = False
+        if telemetry_dir:
+            os.makedirs(telemetry_dir, exist_ok=True)
+            tracing.configure(os.path.join(telemetry_dir, "trace.jsonl"),
+                              bus=bus)
+            self._owns_tracer = True
+        if poll_interval_s > 0:
+            from photon_ml_tpu.telemetry.device import DeviceStatsSampler
+
+            self._sampler = DeviceStatsSampler(
+                poll_interval_s, registry=self.registry).start()
+
+    def dump_metrics(self) -> Optional[str]:
+        """Write the registry snapshot as ``<dir>/metrics.prom``; returns
+        the path (None when no telemetry dir)."""
+        if not self.telemetry_dir:
+            return None
+        from photon_ml_tpu.telemetry.prometheus import render
+
+        path = os.path.join(self.telemetry_dir, "metrics.prom")
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(render(self.registry))
+        os.replace(tmp, path)
+        return path
+
+    def close(self) -> None:
+        if self._sampler is not None:
+            self._sampler.close()
+            self._sampler = None
+        self.dump_metrics()
+        if self._owns_tracer:
+            tracing.close()
+            self._owns_tracer = False
+        self._unbind()
+        self._unbind = lambda: None
+
+
+def start_telemetry(telemetry_dir: Optional[str] = None,
+                    poll_interval_s: float = 0.0, bus=None):
+    """Driver entry: a live :class:`TelemetrySession` when anything is
+    enabled, else an inert null session (so callers always hold something
+    with ``close()``)."""
+    if not telemetry_dir and poll_interval_s <= 0:
+        return _NullSession()
+    return TelemetrySession(telemetry_dir=telemetry_dir,
+                            poll_interval_s=poll_interval_s, bus=bus)

@@ -1,0 +1,112 @@
+"""Periodic host/device resource sampler → gauges.
+
+"What is this run doing right now" includes "what is it holding": host RSS
+(the Avro read + host-mirror footprint) and per-device accelerator memory
+(the HBM the design tensors and score decomposition pin — the memory cliff
+``CoordinateDescent`` guards against). The sampler polls both on a
+background thread at a configurable interval and publishes gauges; it is
+OFF by default and gated behind the drivers' ``--telemetry-poll-s`` flag
+(0 disables) because ``device.memory_stats()`` can synchronize with the
+backend — never put it on a request path.
+
+The wait uses ``threading.Event.wait`` (not ``time.sleep``) so shutdown is
+immediate and the resilience hygiene rule (all sleeps live in
+``resilience/retry.py``) holds. A failed sample logs once at debug level
+and keeps polling — a flaky backend stat must never kill telemetry, let
+alone the run (same contract as event listeners).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Optional
+
+from photon_ml_tpu.telemetry.metrics import MetricsRegistry, default_registry
+
+logger = logging.getLogger(__name__)
+
+
+def host_rss_bytes() -> Optional[int]:
+    """Resident set size of this process, or None when unreadable."""
+    try:
+        with open("/proc/self/status", encoding="ascii") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    try:
+        import resource
+
+        # ru_maxrss is KiB on Linux, bytes on macOS; Linux is the target
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+    except Exception:
+        return None
+
+
+class DeviceStatsSampler:
+    """Background gauge poller; ``start()``/``close()`` lifecycle."""
+
+    def __init__(self, interval_s: float,
+                 registry: Optional[MetricsRegistry] = None):
+        if interval_s <= 0:
+            raise ValueError(f"interval_s must be > 0, got {interval_s}")
+        self.interval_s = float(interval_s)
+        reg = registry if registry is not None else default_registry()
+        self._rss = reg.gauge("photon_host_rss_bytes",
+                              "Process resident set size")
+        self._in_use = reg.gauge("photon_device_bytes_in_use",
+                                 "Accelerator memory in use, per device",
+                                 labels=("device",))
+        self._limit = reg.gauge("photon_device_bytes_limit",
+                                "Accelerator memory limit, per device",
+                                labels=("device",))
+        self._samples = reg.counter("photon_device_samples_total",
+                                    "Completed sampler polls")
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def sample_once(self) -> None:
+        """One poll (also callable synchronously from tests)."""
+        rss = host_rss_bytes()
+        if rss is not None:
+            self._rss.set(rss)
+        try:
+            import jax
+
+            for d in jax.devices():
+                stats = d.memory_stats()
+                if not stats:
+                    continue  # backend doesn't report (e.g. plain CPU)
+                if "bytes_in_use" in stats:
+                    self._in_use.labels(device=str(d.id)).set(
+                        stats["bytes_in_use"])
+                if "bytes_limit" in stats:
+                    self._limit.labels(device=str(d.id)).set(
+                        stats["bytes_limit"])
+        except Exception:
+            logger.debug("device memory stats unavailable", exc_info=True)
+        self._samples.inc()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.sample_once()
+            except Exception:  # sampler must never die mid-run
+                logger.debug("telemetry sample failed", exc_info=True)
+
+    def start(self) -> "DeviceStatsSampler":
+        self.sample_once()  # one immediate sample: gauges exist right away
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name="photon-telemetry-sampler")
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None

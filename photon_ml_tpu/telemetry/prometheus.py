@@ -1,0 +1,161 @@
+"""Prometheus text exposition (format 0.0.4) for a MetricsRegistry.
+
+One pure function, :func:`render`, turns a registry snapshot into the text
+format every Prometheus-compatible scraper ingests — served by
+``GET /metrics`` on the serving front end and dumped as ``metrics.prom``
+into a training run's ``--telemetry-dir``. :func:`parse_text` is the
+inverse (subset: the families we emit), shared by
+``tools/bench_serving.py``'s end-of-run scrape and the round-trip tests so
+the writer and the one in-repo reader can never drift apart.
+
+Layout per family::
+
+    # HELP name help text
+    # TYPE name counter|gauge|histogram
+    name{label="value"} 1
+    ...
+
+Histograms expand to cumulative ``name_bucket{le="..."}`` series (including
+``le="+Inf"``) plus ``name_sum`` and ``name_count``, exactly the layout
+``histogram_quantile()`` expects.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Mapping, Optional
+
+from photon_ml_tpu.telemetry.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    default_registry,
+)
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def _escape_help(s: str) -> str:
+    return s.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _escape_label(s: str) -> str:
+    return (s.replace("\\", "\\\\").replace("\"", "\\\"")
+            .replace("\n", "\\n"))
+
+
+def format_value(v: float) -> str:
+    """Prometheus float formatting: integers without a trailing ``.0``,
+    infinities as ``+Inf``/``-Inf``."""
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if math.isnan(v):
+        return "NaN"
+    if float(v) == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def _labels_text(names, values, extra: Optional[tuple[str, str]] = None) -> str:
+    pairs = [f'{n}="{_escape_label(v)}"' for n, v in zip(names, values)]
+    if extra is not None:
+        pairs.append(f'{extra[0]}="{extra[1]}"')
+    return "{" + ",".join(pairs) + "}" if pairs else ""
+
+
+def render(registry: Optional[MetricsRegistry] = None) -> str:
+    """The registry's current state as exposition text (ends with ``\\n``)."""
+    registry = registry if registry is not None else default_registry()
+    lines: list[str] = []
+    for fam in registry.collect():
+        if fam.help:
+            lines.append(f"# HELP {fam.name} {_escape_help(fam.help)}")
+        lines.append(f"# TYPE {fam.name} {fam.type}")
+        for values, child in fam.children():
+            if isinstance(child, (Counter, Gauge)):
+                lines.append(
+                    f"{fam.name}{_labels_text(fam.label_names, values)} "
+                    f"{format_value(child.value)}")
+            elif isinstance(child, Histogram):
+                cum, total, count = child.snapshot()
+                bounds = [format_value(b) for b in child.uppers] + ["+Inf"]
+                for bound, c in zip(bounds, cum):
+                    lines.append(
+                        f"{fam.name}_bucket"
+                        f"{_labels_text(fam.label_names, values, ('le', bound))}"
+                        f" {c}")
+                lines.append(
+                    f"{fam.name}_sum{_labels_text(fam.label_names, values)} "
+                    f"{format_value(total)}")
+                lines.append(
+                    f"{fam.name}_count{_labels_text(fam.label_names, values)} "
+                    f"{count}")
+    return "\n".join(lines) + "\n" if lines else ""
+
+
+def _parse_label_block(block: str) -> dict[str, str]:
+    out: dict[str, str] = {}
+    i = 0
+    while i < len(block):
+        eq = block.index("=", i)
+        name = block[i:eq].strip().lstrip(",").strip()
+        assert block[eq + 1] == '"', f"unquoted label value in {block!r}"
+        j = eq + 2
+        val = []
+        while block[j] != '"':
+            if block[j] == "\\":
+                nxt = block[j + 1]
+                val.append({"n": "\n", "\\": "\\", '"': '"'}.get(nxt, nxt))
+                j += 2
+            else:
+                val.append(block[j])
+                j += 1
+        out[name] = "".join(val)
+        i = j + 1
+    return out
+
+
+def parse_value(s: str) -> float:
+    if s == "+Inf":
+        return math.inf
+    if s == "-Inf":
+        return -math.inf
+    return float(s)
+
+
+def parse_text(text: str) -> dict[str, list[tuple[dict[str, str], float]]]:
+    """Exposition text → ``{series_name: [(labels, value), ...]}``.
+
+    Histogram series come back under their expanded names
+    (``x_bucket``/``x_sum``/``x_count``) — the shape scrapers see. Helper
+    for the bench and tests, not a general-purpose Prometheus parser (no
+    exemplars, no timestamps — we emit neither).
+    """
+    out: dict[str, list[tuple[dict[str, str], float]]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        if "{" in line:
+            name, rest = line.split("{", 1)
+            block, value_s = rest.rsplit("}", 1)
+            labels = _parse_label_block(block)
+        else:
+            name, value_s = line.rsplit(" ", 1)
+            labels = {}
+        out.setdefault(name.strip(), []).append(
+            (labels, parse_value(value_s.strip())))
+    return out
+
+
+def series_value(parsed: Mapping, name: str,
+                 labels: Optional[Mapping[str, str]] = None,
+                 default: float = 0.0) -> float:
+    """First series under ``name`` whose labels contain ``labels`` (subset
+    match); ``default`` when absent — scrape-delta helpers shouldn't crash
+    on a counter that hasn't been created yet."""
+    for got, value in parsed.get(name, ()):
+        if labels is None or all(got.get(k) == v for k, v in labels.items()):
+            return value
+    return default

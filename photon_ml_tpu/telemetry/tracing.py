@@ -1,0 +1,181 @@
+"""Span tracing: nested ``span(name)`` contexts → ``trace.jsonl``.
+
+``util/Timed.scala`` gave the reference *flat* stage timings in a log file;
+a run that interleaves coordinate descent, retries, checkpointing and
+validation needs the *tree*: which stage contained which step, and where
+the wall-clock actually went. A span is one timed region with an id, its
+enclosing span's id (tracked per-thread via ``contextvars``, so concurrent
+serving requests each get their own stack), and arbitrary JSON attributes.
+
+- unconfigured (the default), spans cost two contextvar operations and a
+  ``perf_counter`` pair — cheap enough to leave permanently in hot-ish
+  paths like the coordinate-descent step loop;
+- ``GLOBAL_TRACER.configure(path, bus=...)`` (done by the drivers'
+  ``--telemetry-dir`` flag) appends one JSON line per completed span to
+  ``<run_dir>/trace.jsonl`` and, when a bus is given, posts a
+  ``span_finished`` event so the EventBus→metrics bridge folds span
+  durations into the registry;
+- ``timed()`` (:mod:`photon_ml_tpu.logging_util`) is now a thin wrapper
+  over a span — stage sections appear in the trace tree for free.
+
+Record layout (one JSON object per line)::
+
+    {"name": ..., "span_id": 3, "parent_id": 2, "ts": <wall clock>,
+     "t0": ..., "t1": ..., "seconds": ..., <attribute>: ...}
+
+``t0``/``t1`` are ``perf_counter`` readings — monotonic and mutually
+comparable within the process, so a child's interval provably nests inside
+its parent's (the property the telemetry tests assert); ``ts`` is the wall
+clock for humans correlating with ``photon.log``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import itertools
+import json
+import os
+import threading
+import time
+from typing import Iterator, Optional
+
+#: the enclosing span's id on THIS thread/context (None = root)
+_CURRENT: contextvars.ContextVar[Optional[int]] = contextvars.ContextVar(
+    "photon_current_span", default=None)
+
+#: reserved record keys — span attributes may not shadow them
+_RESERVED = frozenset(
+    {"name", "span_id", "parent_id", "ts", "t0", "t1", "seconds"})
+
+
+class Span:
+    """One live timed region; ``set(**attrs)`` attaches attributes any time
+    before exit (e.g. a loss computed after the work the span times)."""
+
+    __slots__ = ("name", "span_id", "parent_id", "attrs", "ts", "t0", "t1",
+                 "seconds")
+
+    def __init__(self, name: str, span_id: int, parent_id: Optional[int],
+                 attrs: dict):
+        self.name = name
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.attrs = attrs
+        self.seconds = 0.0
+
+    def set(self, **attrs) -> "Span":
+        self.attrs.update(attrs)
+        return self
+
+    def record(self) -> dict:
+        bad = _RESERVED & self.attrs.keys()
+        if bad:
+            raise ValueError(f"span attributes shadow reserved keys {bad}")
+        return {"name": self.name, "span_id": self.span_id,
+                "parent_id": self.parent_id, "ts": self.ts,
+                "t0": self.t0, "t1": self.t1,
+                "seconds": self.seconds, **self.attrs}
+
+
+class Tracer:
+    """Span factory + (optional) JSONL sink + (optional) EventBus bridge."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ids = itertools.count(1)
+        self._fh = None
+        self._path: Optional[str] = None
+        self._bus = None
+
+    @property
+    def enabled(self) -> bool:
+        """True when spans are being exported (a sink is configured)."""
+        return self._fh is not None
+
+    @property
+    def path(self) -> Optional[str]:
+        return self._path
+
+    def configure(self, path: str, bus=None) -> "Tracer":
+        """Start appending completed spans to ``path`` (parent dirs
+        created). Reconfiguring closes the previous sink first."""
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+            self._fh = open(path, "a", encoding="utf-8")
+            self._path = path
+            self._bus = bus
+        return self
+
+    def close(self) -> None:
+        """Stop exporting; spans keep working (and keep their parentage)
+        as no-ops."""
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+            self._fh = None
+            self._path = None
+            self._bus = None
+
+    def _write(self, record: dict) -> None:
+        line = json.dumps(record) + "\n"
+        with self._lock:
+            if self._fh is not None:
+                self._fh.write(line)
+                self._fh.flush()
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs) -> Iterator[Span]:
+        sp = Span(name, next(self._ids), _CURRENT.get(), attrs)
+        token = _CURRENT.set(sp.span_id)
+        sp.ts = time.time()
+        sp.t0 = time.perf_counter()
+        try:
+            yield sp
+        finally:
+            sp.t1 = time.perf_counter()
+            sp.seconds = sp.t1 - sp.t0
+            _CURRENT.reset(token)
+            if self._fh is not None:
+                self._write(sp.record())
+            bus = self._bus
+            if bus is not None:
+                bus.post("span_finished", span=name, span_id=sp.span_id,
+                         parent_id=sp.parent_id, seconds=sp.seconds)
+
+    def annotate(self, name: str, **payload) -> None:
+        """Write a non-span record (e.g. an optimizer iteration table) into
+        the trace file, tagged with the current span as its parent. No-op
+        when unconfigured."""
+        if self._fh is None:
+            return
+        self._write({"name": name, "span_id": None,
+                     "parent_id": _CURRENT.get(), "ts": time.time(),
+                     **payload})
+
+
+#: process-global tracer the drivers configure; instrumented modules call
+#: the module-level :func:`span` so embedders can swap sinks in one place
+GLOBAL_TRACER = Tracer()
+
+
+def span(name: str, **attrs):
+    return GLOBAL_TRACER.span(name, **attrs)
+
+
+def annotate(name: str, **payload) -> None:
+    GLOBAL_TRACER.annotate(name, **payload)
+
+
+def enabled() -> bool:
+    return GLOBAL_TRACER.enabled
+
+
+def configure(path: str, bus=None) -> Tracer:
+    return GLOBAL_TRACER.configure(path, bus=bus)
+
+
+def close() -> None:
+    GLOBAL_TRACER.close()

@@ -1,0 +1,56 @@
+"""Tier-1 wrapper for ``tools/check_telemetry_hygiene.py`` (no ``print(``
+outside CLI entry points; no ``time.perf_counter`` in serving/ — latency
+measurement must go through the metrics registry or a span)."""
+
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import check_telemetry_hygiene as hygiene  # noqa: E402
+
+
+def test_package_is_clean():
+    assert hygiene.main(REPO) == 0
+
+
+@pytest.mark.parametrize("snippet, n", [
+    ("print('x')\n", 1),
+    ("import logging\nlogging.getLogger(__name__).info('x')\n", 0),
+    # a method NAMED print on an object must not trip the check
+    ("class X:\n    def print(self):\n        pass\nX().print()\n", 0),
+])
+def test_print_detector(snippet, n):
+    assert len(hygiene.check_source(snippet, "photon_ml_tpu/x.py")) == n
+
+
+@pytest.mark.parametrize("rel", [
+    os.path.join("photon_ml_tpu", "cli", "serve_game.py"),
+    os.path.join("photon_ml_tpu", "__main__.py"),
+])
+def test_cli_entry_points_may_print(rel):
+    assert hygiene.check_source("print('usage')\n", rel) == []
+
+
+@pytest.mark.parametrize("snippet, n", [
+    ("import time\ntime.perf_counter()\n", 1),
+    ("import time as t\nt.perf_counter()\n", 1),
+    ("from time import perf_counter\nperf_counter()\n", 1),
+    ("from time import perf_counter as pc\npc()\n", 1),
+    # scheduling clocks stay legal in serving/: deadlines and timestamps
+    # are not latency measurements
+    ("import time\ntime.monotonic()\n", 0),
+    ("import time\ntime.time()\n", 0),
+])
+def test_perf_counter_detector_in_serving(snippet, n):
+    rel = os.path.join("photon_ml_tpu", "serving", "x.py")
+    assert len(hygiene.check_source(snippet, rel)) == n
+
+
+def test_perf_counter_legal_outside_serving():
+    src = "import time\ntime.perf_counter()\n"
+    assert hygiene.check_source(
+        src, os.path.join("photon_ml_tpu", "game", "x.py")) == []

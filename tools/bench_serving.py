@@ -12,7 +12,14 @@ mixed batch sizes from worker threads, and reports:
 - per-request metrics stream: the service posts one ``serving_request``
   event per scored request on the EventBus; the bench subscribes a listener
   and folds them into the summary (server-side latency vs. the
-  client-observed one).
+  client-observed one),
+- a ``/metrics`` scrape (before and after the load) folding the SERVER'S
+  own Prometheus histogram into the report: request-latency quantiles
+  estimated from the bucket deltas, the recompile counter delta, and —
+  for in-process runs, where the bench is the only traffic — parity
+  assertions between the scraped counters and the client-side tallies
+  (requests counted == requests sent, recompiles metric == healthz
+  compiles delta, histogram count == scored requests).
 
 Output: one JSON line per metric + a terminal ``suite_summary`` line, the
 same artifact shape as bench.py.
@@ -48,6 +55,40 @@ def _http_json(url: str, payload=None, timeout=60.0):
             headers={"Content-Type": "application/json"})
     with urllib.request.urlopen(req, timeout=timeout) as resp:
         return json.loads(resp.read())
+
+
+def _scrape_metrics(base: str):
+    """Parsed /metrics snapshot, or None against a server without the
+    endpoint (pre-telemetry builds)."""
+    from photon_ml_tpu.telemetry.prometheus import parse_text
+
+    try:
+        with urllib.request.urlopen(base + "/metrics", timeout=60) as resp:
+            return parse_text(resp.read().decode())
+    except Exception:
+        return None
+
+
+def _histogram_delta(m0, m1, name: str):
+    """(uppers, cumulative-count deltas, count delta) for one label-free
+    histogram between two scrapes — the load window's own distribution."""
+    import math
+
+    from photon_ml_tpu.telemetry.prometheus import series_value
+
+    buckets1 = m1.get(name + "_bucket", [])
+    uppers, deltas = [], []
+    for labels, v1 in buckets1:
+        le = labels.get("le")
+        v0 = series_value(m0 or {}, name + "_bucket", {"le": le})
+        uppers.append(math.inf if le == "+Inf" else float(le))
+        deltas.append(int(v1 - v0))
+    order = sorted(range(len(uppers)), key=lambda i: uppers[i])
+    uppers = [uppers[i] for i in order]
+    deltas = [deltas[i] for i in order]
+    count = (series_value(m1, name + "_count")
+             - series_value(m0 or {}, name + "_count"))
+    return uppers[:-1], deltas, int(count)
 
 
 def _request_pool(args, server):
@@ -138,6 +179,7 @@ def main(argv=None):
     pool = _request_pool(args, server)
     sizes = [int(s) for s in args.batch_sizes.split(",") if s]
     compiles0 = _http_json(base + "/healthz")["compiles"]
+    metrics0 = _scrape_metrics(base)
 
     latencies: list[float] = []
     errors: list[str] = []
@@ -173,6 +215,7 @@ def main(argv=None):
         t.join()
     wall = time.perf_counter() - t0
     health = _http_json(base + "/healthz")
+    metrics1 = _scrape_metrics(base)
 
     rows = sum(sizes[i % len(sizes)] for i in range(args.requests))
     results = [{
@@ -198,6 +241,49 @@ def main(argv=None):
             "p99_ms": round(_percentile(sl, 99), 3),
             "n_events": len(sl),
         })
+    parity_failures: list[str] = []
+    if metrics1 is not None:
+        from photon_ml_tpu.telemetry.metrics import quantile_from_buckets
+        from photon_ml_tpu.telemetry.prometheus import series_value
+
+        def delta(name):
+            return (series_value(metrics1, name)
+                    - series_value(metrics0 or {}, name))
+
+        # bucket series are CUMULATIVE, so their per-scrape deltas are too
+        uppers, cum, hist_count = _histogram_delta(
+            metrics0, metrics1, "photon_serving_request_latency_seconds")
+        q = (lambda p: round(
+            quantile_from_buckets(uppers, cum, p) * 1e3, 3)) \
+            if cum and cum[-1] else (lambda p: 0.0)
+        recompiles_metric = int(delta("photon_serving_recompiles_total"))
+        requests_metric = int(delta("photon_serving_requests_total"))
+        results.append({
+            "metric": "serving_metrics_scrape",
+            "value": q(0.50),
+            "unit": "ms p50 (server histogram, bucket-interpolated)",
+            "p99_ms": q(0.99),
+            "histogram_count": hist_count,
+            "requests_total": requests_metric,
+            "recompiles_total": recompiles_metric,
+            "active_version": series_value(
+                metrics1, "photon_model_active_version"),
+        })
+        if server is not None:
+            # in-process run = the bench is the only traffic, so the
+            # server's own books must match the client's exactly
+            if requests_metric != len(latencies):
+                parity_failures.append(
+                    f"requests_total moved {requests_metric}, client "
+                    f"completed {len(latencies)}")
+            if hist_count != len(latencies):
+                parity_failures.append(
+                    f"latency histogram counted {hist_count} requests, "
+                    f"client completed {len(latencies)}")
+            if recompiles_metric != health["compiles"] - compiles0:
+                parity_failures.append(
+                    f"recompiles_total moved {recompiles_metric}, healthz "
+                    f"compile counter moved {health['compiles'] - compiles0}")
     for r in results:
         print(json.dumps(r), flush=True)
     print(json.dumps({
@@ -206,6 +292,8 @@ def main(argv=None):
         "unit": results[0]["unit"],
         "p99_ms": results[0]["p99_ms"],
         "zero_recompiles": results[0]["recompiles_during_load"] == 0,
+        "metrics_parity": not parity_failures if metrics1 is not None
+        else None,
         "n_errors": len(errors),
         "wall_s": round(wall, 2),
     }), flush=True)
@@ -213,6 +301,9 @@ def main(argv=None):
         server.stop()
     if errors:
         raise SystemExit(f"{len(errors)} failed requests, first: {errors[0]}")
+    if parity_failures:
+        raise SystemExit("server-side /metrics disagree with the client's "
+                         "measurements: " + "; ".join(parity_failures))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Static telemetry-hygiene check over ``photon_ml_tpu/``.
+
+Two rules, both load-bearing for the telemetry subsystem (the sibling of
+``check_resilience_hygiene.py``, same contract: run directly or through the
+tier-1 test):
+
+1. **No ``print(`` outside CLI entry points** — anything printed from
+   library code bypasses the run log, the metrics registry, AND the trace
+   file: it is observability that evaporates when stdout does. Library code
+   logs (``logging``), counts (``telemetry.metrics``), or spans
+   (``telemetry.tracing``). Only the CLI drivers (``photon_ml_tpu/cli/``)
+   and the module runner (``__main__.py``) own stdout.
+2. **No ``time.perf_counter`` in ``photon_ml_tpu/serving/``** — the
+   serving hot path measures latency exclusively through the registry's
+   histogram timer (``Histogram.time()``) or a tracing span, so every
+   latency number lands in ``/metrics`` with consistent clocking; an ad-hoc
+   ``perf_counter`` pair is a measurement the scrape can never see.
+   ``time.monotonic`` (deadlines) and ``time.time`` (timestamps) stay
+   legal — they are scheduling clocks, not latency measurements.
+
+Run directly (``python tools/check_telemetry_hygiene.py [root]``, exit 1 on
+violations) or through the tier-1 test ``tests/test_telemetry_hygiene.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+#: stdout owners: the CLI drivers and the module runner
+PRINT_ALLOWED_PREFIXES = (
+    os.path.join("photon_ml_tpu", "cli") + os.sep,
+)
+PRINT_ALLOWED_FILES = {os.path.join("photon_ml_tpu", "__main__.py")}
+
+#: the subtree where latency measurement must route through telemetry
+PERF_COUNTER_BANNED_PREFIX = os.path.join("photon_ml_tpu", "serving") + os.sep
+
+
+def _is_perf_counter(node: ast.AST, time_aliases: set[str],
+                     pc_names: set[str]) -> bool:
+    if isinstance(node, ast.Attribute) and node.attr == "perf_counter":
+        return (isinstance(node.value, ast.Name)
+                and node.value.id in time_aliases)
+    if isinstance(node, ast.Name):
+        return node.id in pc_names
+    return False
+
+
+def check_source(source: str, rel_path: str) -> list[str]:
+    """Violations in one file, as ``path:line: message`` strings."""
+    tree = ast.parse(source, filename=rel_path)
+    rel_path = os.path.normpath(rel_path)
+    print_ok = (rel_path in PRINT_ALLOWED_FILES
+                or any(rel_path.startswith(p)
+                       for p in PRINT_ALLOWED_PREFIXES))
+    pc_banned = rel_path.startswith(PERF_COUNTER_BANNED_PREFIX)
+
+    # resolve what `time` / `perf_counter` are bound to in this module
+    time_aliases: set[str] = set()
+    pc_names: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "time":
+                    time_aliases.add(a.asname or "time")
+        elif isinstance(node, ast.ImportFrom) and node.module == "time":
+            for a in node.names:
+                if a.name == "perf_counter":
+                    pc_names.add(a.asname or "perf_counter")
+
+    out = []
+    for node in ast.walk(tree):
+        if (not print_ok and isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "print"):
+            out.append(f"{rel_path}:{node.lineno}: print() outside a CLI "
+                       f"entry point — library code logs, counts "
+                       f"(telemetry.metrics) or spans (telemetry.tracing); "
+                       f"stdout belongs to the drivers")
+        elif (pc_banned
+              and _is_perf_counter(node, time_aliases, pc_names)):
+            out.append(f"{rel_path}:{node.lineno}: time.perf_counter in "
+                       f"serving/ — measure latency through the metrics "
+                       f"registry's Histogram.time() or a tracing span so "
+                       f"/metrics sees it")
+    return out
+
+
+def main(root: str = ".") -> int:
+    pkg = os.path.join(root, "photon_ml_tpu")
+    violations: list[str] = []
+    for dirpath, _, filenames in os.walk(pkg):
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            rel = os.path.normpath(os.path.relpath(path, root))
+            with open(path, encoding="utf-8") as f:
+                violations.extend(check_source(f.read(), rel))
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"{len(violations)} telemetry-hygiene violation(s)")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else "."))
