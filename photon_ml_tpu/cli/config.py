@@ -289,28 +289,39 @@ class TelemetryConfig:
     file like :class:`ResilienceConfig`.
 
     ``telemetry_dir`` (None = disabled) receives ``trace.jsonl`` (the span
-    tree) while the run is live and ``metrics.prom`` (the final registry
+    tree) while the run is live and ``metrics.prom`` (the registry
     snapshot) at close; ``poll_interval_s`` (0 = disabled) starts the
-    host-RSS/device-memory gauge sampler at that period.
+    host-RSS/device-memory gauge sampler at that period AND, when a
+    telemetry dir is set, re-snapshots ``metrics.prom`` on the same cadence
+    (push-gateway-style, so batch runs are observable mid-flight);
+    ``metrics_port`` (0 = disabled) serves the live fleet-wide aggregate
+    from ``GET /metrics`` on the chief and, at >1 process, enables the
+    collective registry fold at sweep boundaries.
     """
 
     telemetry_dir: Optional[str] = None
     poll_interval_s: float = 0.0
+    metrics_port: int = 0
 
     def __post_init__(self):
         if self.poll_interval_s < 0:
             raise ValueError(f"poll_interval_s must be >= 0, "
                              f"got {self.poll_interval_s}")
+        if not 0 <= self.metrics_port < 65536:
+            raise ValueError(f"metrics_port must be in [0, 65535], "
+                             f"got {self.metrics_port}")
 
     # --- config-file round-trip ------------------------------------------
     def as_dict(self) -> dict:
         return {"telemetryDir": self.telemetry_dir,
-                "pollIntervalS": self.poll_interval_s}
+                "pollIntervalS": self.poll_interval_s,
+                "metricsPort": self.metrics_port}
 
     @classmethod
     def from_dict(cls, d: Mapping) -> "TelemetryConfig":
         return cls(telemetry_dir=d.get("telemetryDir"),
-                   poll_interval_s=float(d.get("pollIntervalS", 0.0)))
+                   poll_interval_s=float(d.get("pollIntervalS", 0.0)),
+                   metrics_port=int(d.get("metricsPort", 0)))
 
 
 def add_telemetry_flags(parser) -> None:
@@ -321,13 +332,25 @@ def add_telemetry_flags(parser) -> None:
              "trace.jsonl (nested spans: stages, coordinate-descent sweeps "
              "and steps, optimizer traces) streamed during the run, "
              "metrics.prom (Prometheus text snapshot of every counter/"
-             "gauge/histogram) written at exit. Default: telemetry off "
-             "(zero per-step device syncs)")
+             "gauge/histogram) written at exit — plus, on the chief of a "
+             "--metrics-port run, metrics.aggregate.prom (the fleet fold; "
+             "tools/metrics_fold.py reproduces it offline). Default: "
+             "telemetry off (zero per-step device syncs)")
     parser.add_argument(
         "--telemetry-poll-s", type=float, default=0.0,
         help="poll interval for the host-RSS / device-memory gauge "
              "sampler (seconds; 0 disables — device memory_stats can "
-             "synchronize with the backend, so this is strictly opt-in)")
+             "synchronize with the backend, so this is strictly opt-in). "
+             "With --telemetry-dir, also re-snapshots metrics.prom at the "
+             "same period so batch runs are scrapeable mid-flight")
+    parser.add_argument(
+        "--metrics-port", type=int, default=0,
+        help="serve GET /metrics on this port (chief process only; 0 "
+             "disables). In a --multihost run the endpoint returns the "
+             "FLEET aggregate — counters and histogram buckets summed "
+             "across every process, per-host gauges fanned out under a "
+             "process label — refreshed by a collective registry fold at "
+             "each coordinate-descent sweep / GLM lambda boundary")
 
 
 def telemetry_from_args(args, *, subdir: Optional[str] = None,
@@ -339,7 +362,8 @@ def telemetry_from_args(args, *, subdir: Optional[str] = None,
     if tdir and subdir:
         tdir = os.path.join(tdir, subdir)
     return TelemetryConfig(telemetry_dir=tdir,
-                           poll_interval_s=args.telemetry_poll_s)
+                           poll_interval_s=args.telemetry_poll_s,
+                           metrics_port=args.metrics_port)
 
 
 def install_telemetry(config: TelemetryConfig):
@@ -349,7 +373,8 @@ def install_telemetry(config: TelemetryConfig):
     from photon_ml_tpu.telemetry import start_telemetry
 
     return start_telemetry(telemetry_dir=config.telemetry_dir,
-                           poll_interval_s=config.poll_interval_s)
+                           poll_interval_s=config.poll_interval_s,
+                           metrics_port=config.metrics_port)
 
 
 def parse_grid(specs: Sequence[str]) -> list[Mapping[str, float]]:

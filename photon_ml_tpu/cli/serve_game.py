@@ -64,6 +64,10 @@ def build_server(argv: Optional[Sequence[str]] = None):
     telemetry = install_telemetry(telemetry_from_args(args))
     import jax
 
+    from photon_ml_tpu.telemetry import emit_build_info
+
+    emit_build_info()
+
     if jax.default_backend() == "cpu" and not jax.config.jax_enable_x64:
         # float64 margin accumulation = bit-parity with the batch scorer;
         # must be set before the first trace (serving owns this process)

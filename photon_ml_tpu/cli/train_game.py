@@ -228,7 +228,11 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
     telemetry = install_telemetry(telemetry_from_args(
         args, subdir=None if chief
         else os.path.join("workers", f"proc-{_process_index()}")))
-    from photon_ml_tpu.telemetry import tracing
+    from photon_ml_tpu.telemetry import emit_build_info, tracing
+
+    # photon_build_info{version, process, jax_version}: every process
+    # stamps itself so a fleet scrape exposes mixed-version fleets
+    emit_build_info()
     import contextlib as _contextlib
 
     _root_span = _contextlib.ExitStack()

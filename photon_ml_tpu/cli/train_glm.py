@@ -257,7 +257,9 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
     telemetry = install_telemetry(telemetry_from_args(
         args, subdir=None if chief
         else os.path.join("workers", f"proc-{jax.process_index()}")))
-    from photon_ml_tpu.telemetry import tracing
+    from photon_ml_tpu.telemetry import emit_build_info, tracing
+
+    emit_build_info()
 
     import contextlib as _contextlib
 

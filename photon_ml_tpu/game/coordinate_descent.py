@@ -217,6 +217,7 @@ class CoordinateDescent:
         # --- telemetry (live only under --telemetry-dir: the loss/grad-norm
         # reads force a device sync per step, which a bare run's async
         # dispatch pipeline must not pay) ---------------------------------
+        from photon_ml_tpu.telemetry import aggregate as fleet
         from photon_ml_tpu.telemetry import tracing
         telemetry_on = tracing.enabled()
         if telemetry_on:
@@ -364,6 +365,10 @@ class CoordinateDescent:
                     history.append(results.as_dict())
                     final_evaluation = results
                     logger.info("sweep %d validation: %s", sweep, results)
+            # fleet-metrics fold point (no-op unless --metrics-port
+            # installed a hook; placed outside the cd.sweep span so the
+            # fold's own wall time never pollutes the sweep timing)
+            fleet.sweep_boundary(sweep=sweep)
 
         model = GameModel(
             coordinates={cid: models[cid] for cid in self.update_sequence},

@@ -1278,6 +1278,13 @@ def train_game_multiprocess(
                            if cid in fe_datasets},
                           validation_history=validation_history,
                           trained_projection_cids=frozenset(factored_plans))
+        # fleet-metrics fold point. COLLECTIVE when --metrics-port installed
+        # the fold hook: every process reaches this line once per sweep (the
+        # loop above is already collective-symmetric), so the allgather
+        # inside the hook stays aligned. No hook (the default) is a no-op.
+        from photon_ml_tpu.telemetry.aggregate import sweep_boundary
+
+        sweep_boundary(sweep=sweep)
 
     # --- model assembly: allgather RE tables ------------------------------
     model = _assemble_global_model()

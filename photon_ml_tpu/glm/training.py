@@ -110,6 +110,12 @@ def train_glm_sweep(
     d = data.dim if dim is None else dim
     w = jnp.zeros((d,)) if initial is None else jnp.asarray(initial)
 
+    # fleet-metrics fold point (no-op unless --metrics-port installed a
+    # hook). The lambda loop is the GLM driver's sweep boundary and is
+    # collective-symmetric under --multihost: every process runs the
+    # identical sorted sweep over the psum'd objective.
+    from photon_ml_tpu.telemetry.aggregate import sweep_boundary
+
     out: list[TrainedModel] = []
     for lam in sorted(regularization_weights, reverse=True):
         result = run(data, w, jnp.asarray(lam, w.dtype))
@@ -120,6 +126,7 @@ def train_glm_sweep(
         out.append(TrainedModel(float(lam), model, result))
         if warm_start:
             w = result.w
+        sweep_boundary(regularization_weight=float(lam))
     return out
 
 

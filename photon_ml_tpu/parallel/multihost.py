@@ -289,6 +289,16 @@ def allgather_concat_strings(strings) -> list[str]:
     return out
 
 
+def allgather_text(text: str) -> list[str]:
+    """Every process's ``text`` in process order (identity single-process)
+    — the transport behind the fleet metrics fold
+    (:mod:`photon_ml_tpu.telemetry.aggregate`): each process contributes
+    one rendered registry snapshot per sweep boundary and process 0 merges
+    the gathered list. One string per process keeps the collective at a
+    single lengths-gather plus one flat byte gather."""
+    return allgather_concat_strings([text])
+
+
 def allreduce_max(x: np.ndarray) -> np.ndarray:
     """Element-wise max across processes (identity single-process)."""
     x = np.asarray(x)

@@ -30,9 +30,12 @@ _BATCH_SIZE = _metrics.histogram(
     "photon_serving_batch_size",
     "Coalesced records per microbatcher scoring call",
     buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256))
-#: requests parked in the queue right now (sampled at enqueue/drain)
+#: requests parked in the queue right now (sampled at enqueue/drain).
+#: Host-owned: in a serving fleet each process has its own queue, so a
+#: fleet aggregate fans this out under a ``process`` label.
 _QUEUE_DEPTH = _metrics.gauge(
     "photon_serving_queue_depth", "Microbatcher queue depth")
+_metrics.mark_host_owned("photon_serving_queue_depth")
 
 
 class MicroBatcher:

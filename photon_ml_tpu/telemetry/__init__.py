@@ -18,14 +18,25 @@ this supersedes):
 - :mod:`~photon_ml_tpu.telemetry.device` — optional host-RSS/device-memory
   gauge sampler.
 
+- :mod:`~photon_ml_tpu.telemetry.aggregate` — the fleet fold: merge N
+  process registries into one scrapeable aggregate (collective at sweep
+  boundaries, offline via ``tools/metrics_fold.py``), plus the chief's
+  ``--metrics-port`` listener and the trace-merge helper.
+
 :class:`TelemetrySession` is the drivers' one-call lifecycle: configure the
-global tracer into ``--telemetry-dir``, bind the bridge, start the sampler,
-and on close dump a final ``metrics.prom`` snapshot next to the trace.
+global tracer into ``--telemetry-dir``, bind the bridge, start the sampler
+and (``--telemetry-poll-s``) the periodic ``metrics.prom`` snapshot writer,
+stand up the fleet aggregator under ``--metrics-port``, and on close dump a
+final ``metrics.prom`` snapshot next to the trace — with, on the chief of a
+folding run, the matching ``metrics.aggregate.prom``.
 """
 
 from __future__ import annotations
 
+import logging
 import os
+import sys
+import threading
 from typing import Optional
 
 from photon_ml_tpu.telemetry import bridge, metrics, tracing  # noqa: F401
@@ -41,6 +52,32 @@ from photon_ml_tpu.telemetry.tracing import (  # noqa: F401
     annotate,
     span,
 )
+
+logger = logging.getLogger(__name__)
+
+
+def emit_build_info(registry: Optional[MetricsRegistry] = None) -> None:
+    """Register the ``photon_build_info{version, process, jax_version}``
+    info-style gauge (constant 1; the payload rides the labels). Every
+    driver emits it at startup, so one fleet scrape shows a mixed-version
+    fleet — the failure mode the aggregator's type-conflict error points
+    at — at a glance. Idempotent per (version, process, jax_version)."""
+    import jax
+
+    from photon_ml_tpu import __version__
+
+    reg = registry if registry is not None else default_registry()
+    try:
+        process = str(jax.process_index())
+    except Exception:
+        process = "0"
+    reg.gauge(
+        "photon_build_info",
+        "Constant 1; build/version info rides the labels (a fleet scrape "
+        "shows mixed-version fleets at a glance)",
+        labels=("version", "process", "jax_version")).labels(
+            version=__version__, process=process,
+            jax_version=jax.__version__).set(1.0)
 
 
 def record_optimizer_trace(coordinate_id: str, result, *, sweep: int = 0,
@@ -104,13 +141,23 @@ class _NullSession:
 
 class TelemetrySession:
     """One run's telemetry lifecycle (built by the drivers from
-    ``--telemetry-dir`` / ``--telemetry-poll-s``)."""
+    ``--telemetry-dir`` / ``--telemetry-poll-s`` / ``--metrics-port``).
+
+    With ``metrics_port``, every process of the job installs the fleet
+    fold hook (the fold is a collective, so the flag — shared by the whole
+    job's command line — must act symmetrically) and the chief additionally
+    serves ``GET /metrics`` with the latest aggregate. With a telemetry dir
+    AND a positive poll interval, ``metrics.prom`` is re-snapshotted
+    push-gateway-style every interval, so batch runs are observable
+    mid-flight rather than only at exit.
+    """
 
     enabled = True
 
     def __init__(self, telemetry_dir: Optional[str] = None,
                  poll_interval_s: float = 0.0, bus=None,
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 metrics_port: int = 0):
         if bus is None:
             from photon_ml_tpu.events import GLOBAL_BUS as bus
         self.telemetry_dir = telemetry_dir
@@ -119,6 +166,11 @@ class TelemetrySession:
         self._unbind = bridge.bind(bus=bus, registry=self.registry)
         self._sampler = None
         self._owns_tracer = False
+        self._aggregator = None
+        self._server = None
+        self._unhook = lambda: None
+        self._snap_stop: Optional[threading.Event] = None
+        self._snap_thread: Optional[threading.Thread] = None
         if telemetry_dir:
             os.makedirs(telemetry_dir, exist_ok=True)
             tracing.configure(os.path.join(telemetry_dir, "trace.jsonl"),
@@ -129,26 +181,98 @@ class TelemetrySession:
 
             self._sampler = DeviceStatsSampler(
                 poll_interval_s, registry=self.registry).start()
+            if telemetry_dir:
+                # push-gateway-style periodic snapshot on the same cadence
+                # (Event.wait, not sleep — shutdown is immediate and the
+                # resilience sleep-hygiene rule holds)
+                self._snap_stop = threading.Event()
+                self._snap_thread = threading.Thread(
+                    target=self._snapshot_loop, args=(poll_interval_s,),
+                    daemon=True, name="photon-telemetry-snapshot")
+                self._snap_thread.start()
+        if metrics_port:
+            from photon_ml_tpu.telemetry.aggregate import (
+                FleetMetricsAggregator,
+                MetricsHTTPServer,
+                install_sweep_hook,
+                is_chief,
+            )
 
-    def dump_metrics(self) -> Optional[str]:
-        """Write the registry snapshot as ``<dir>/metrics.prom``; returns
-        the path (None when no telemetry dir)."""
-        if not self.telemetry_dir:
-            return None
+            self._aggregator = FleetMetricsAggregator(registry=self.registry)
+            self._unhook = install_sweep_hook(
+                lambda **info: self._aggregator.fold())
+            if is_chief():
+                self._server = MetricsHTTPServer(
+                    self._aggregator.latest, port=metrics_port).start()
+
+    @property
+    def metrics_url(self) -> Optional[str]:
+        """The chief's live scrape URL (None off-chief / without
+        ``--metrics-port``)."""
+        return None if self._server is None else self._server.url
+
+    def _snapshot_loop(self, interval_s: float) -> None:
+        while not self._snap_stop.wait(interval_s):
+            try:
+                self.dump_metrics()
+            except Exception:  # the writer must never kill the run
+                logger.debug("periodic metrics snapshot failed",
+                             exc_info=True)
+
+    def _local_text(self) -> str:
+        """This process's snapshot, host-tagged on multi-process jobs —
+        the one renderer behind dumps, the periodic writer and the fold,
+        so offline folds of the dumps reproduce the live fold exactly."""
+        from photon_ml_tpu.telemetry.aggregate import process_tag
         from photon_ml_tpu.telemetry.prometheus import render
 
-        path = os.path.join(self.telemetry_dir, "metrics.prom")
-        tmp = path + ".tmp"
-        with open(tmp, "w", encoding="utf-8") as f:
-            f.write(render(self.registry))
-        os.replace(tmp, path)
-        return path
+        tag = process_tag()
+        return render(self.registry,
+                      host_tag=None if tag is None else ("process", tag))
+
+    def dump_metrics(self, text: Optional[str] = None) -> Optional[str]:
+        """Write the registry snapshot as ``<dir>/metrics.prom`` (atomic
+        tmp+rename — a scraper never reads a torn file); returns the path
+        (None when no telemetry dir)."""
+        if not self.telemetry_dir:
+            return None
+        return _write_atomic(
+            os.path.join(self.telemetry_dir, "metrics.prom"),
+            text if text is not None else self._local_text())
 
     def close(self) -> None:
+        if self._snap_stop is not None:
+            self._snap_stop.set()
+            self._snap_thread.join()
+            self._snap_stop = self._snap_thread = None
         if self._sampler is not None:
             self._sampler.close()
             self._sampler = None
-        self.dump_metrics()
+        text = self._local_text()
+        self.dump_metrics(text=text)
+        if self._aggregator is not None:
+            # final collective fold over the EXACT texts just dumped, so
+            # tools/metrics_fold.py over the metrics.prom files reproduces
+            # metrics.aggregate.prom byte-for-byte. Skipped when close()
+            # runs on an exception path: the job is dying and a collective
+            # here would hang against processes that never reach it.
+            if sys.exc_info()[0] is None:
+                try:
+                    agg = self._aggregator.fold(local_text=text)
+                except Exception:
+                    logger.warning("final fleet metrics fold failed",
+                                   exc_info=True)
+                    agg = None
+                if agg is not None and self.telemetry_dir:
+                    _write_atomic(os.path.join(self.telemetry_dir,
+                                               "metrics.aggregate.prom"),
+                                  agg)
+            if self._server is not None:
+                self._server.stop()
+                self._server = None
+            self._unhook()
+            self._unhook = lambda: None
+            self._aggregator = None
         if self._owns_tracer:
             tracing.close()
             self._owns_tracer = False
@@ -156,12 +280,22 @@ class TelemetrySession:
         self._unbind = lambda: None
 
 
+def _write_atomic(path: str, text: str) -> str:
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.write(text)
+    os.replace(tmp, path)
+    return path
+
+
 def start_telemetry(telemetry_dir: Optional[str] = None,
-                    poll_interval_s: float = 0.0, bus=None):
+                    poll_interval_s: float = 0.0, bus=None,
+                    metrics_port: int = 0):
     """Driver entry: a live :class:`TelemetrySession` when anything is
     enabled, else an inert null session (so callers always hold something
     with ``close()``)."""
-    if not telemetry_dir and poll_interval_s <= 0:
+    if not telemetry_dir and poll_interval_s <= 0 and not metrics_port:
         return _NullSession()
     return TelemetrySession(telemetry_dir=telemetry_dir,
-                            poll_interval_s=poll_interval_s, bus=bus)
+                            poll_interval_s=poll_interval_s, bus=bus,
+                            metrics_port=metrics_port)

@@ -23,7 +23,11 @@ from __future__ import annotations
 
 from typing import Callable, Optional
 
-from photon_ml_tpu.telemetry.metrics import MetricsRegistry, default_registry
+from photon_ml_tpu.telemetry.metrics import (
+    MetricsRegistry,
+    default_registry,
+    mark_host_owned,
+)
 
 #: attribute stashed on the bus holding the registries already bridged to it
 #: (strong refs on purpose: identity checks must not race id() reuse)
@@ -82,6 +86,10 @@ def _make_listener(reg: MetricsRegistry) -> Callable:
     active_version = reg.gauge(
         "photon_model_active_version",
         "Currently active serving model version (0 = none)")
+    # host-owned: a serving fleet mid-rollout legitimately has processes
+    # on different versions — the aggregate must show every one, not
+    # whichever host's gauge merged last
+    mark_host_owned("photon_model_active_version")
     training_runs = reg.counter(
         "photon_training_runs_total",
         "Training driver invocations", labels=("driver",))

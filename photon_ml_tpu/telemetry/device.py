@@ -23,9 +23,20 @@ import os
 import threading
 from typing import Optional
 
-from photon_ml_tpu.telemetry.metrics import MetricsRegistry, default_registry
+from photon_ml_tpu.telemetry.metrics import (
+    MetricsRegistry,
+    default_registry,
+    mark_host_owned,
+)
 
 logger = logging.getLogger(__name__)
+
+# per-host-owned gauges: a fleet aggregate must keep one series per
+# process (tagged at render time), not let the chief's RSS overwrite a
+# worker's
+mark_host_owned("photon_host_rss_bytes")
+mark_host_owned("photon_device_bytes_in_use")
+mark_host_owned("photon_device_bytes_limit")
 
 
 def host_rss_bytes() -> Optional[int]:

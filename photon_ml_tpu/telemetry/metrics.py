@@ -332,6 +332,26 @@ class MetricsRegistry:
             return list(self._families.values())
 
 
+#: gauge families whose value is meaningful PER HOST (queue depth, RSS,
+#: device memory): a fleet aggregate must fan them out under a ``process``
+#: label instead of letting one host's value overwrite another's. The set
+#: holds NAMES (not family objects) so marking works at declaration time
+#: and the exposition layer can consult it without import cycles.
+_HOST_OWNED_GAUGES: set[str] = set()
+
+
+def mark_host_owned(name: str) -> None:
+    """Declare gauge family ``name`` per-host-owned: multi-process renders
+    tag its series with a ``process`` label (see ``prometheus.render``) so
+    the fleet aggregate keeps one series per host. Counters and histograms
+    never need this — they sum."""
+    _HOST_OWNED_GAUGES.add(name)
+
+
+def host_owned_gauges() -> frozenset:
+    return frozenset(_HOST_OWNED_GAUGES)
+
+
 #: the process-global registry — instrumented modules and the ``/metrics``
 #: exposition meet here
 _DEFAULT_REGISTRY = MetricsRegistry()

@@ -8,6 +8,14 @@ inverse (subset: the families we emit), shared by
 ``tools/bench_serving.py``'s end-of-run scrape and the round-trip tests so
 the writer and the one in-repo reader can never drift apart.
 
+The parse→render round-trip is BYTE-IDENTICAL: :func:`parse_text` returns a
+:class:`ParsedSnapshot` that keeps the ``# HELP``/``# TYPE`` headers and
+document order alongside the samples, and :func:`render` accepts either a
+registry or a parsed snapshot. The fleet aggregator
+(:mod:`photon_ml_tpu.telemetry.aggregate`) leans on this invariant so the
+in-training collective fold and the offline ``tools/metrics_fold.py`` fold
+of the same snapshots produce the same bytes.
+
 Layout per family::
 
     # HELP name help text
@@ -31,6 +39,7 @@ from photon_ml_tpu.telemetry.metrics import (
     Histogram,
     MetricsRegistry,
     default_registry,
+    host_owned_gauges,
 )
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
@@ -38,6 +47,19 @@ CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 def _escape_help(s: str) -> str:
     return s.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _unescape(s: str) -> str:
+    out, i = [], 0
+    while i < len(s):
+        c = s[i]
+        if c == "\\" and i + 1 < len(s):
+            out.append({"n": "\n", "\\": "\\"}.get(s[i + 1], s[i + 1]))
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
 
 
 def _escape_label(s: str) -> str:
@@ -64,18 +86,32 @@ def _labels_text(names, values, extra: Optional[tuple[str, str]] = None) -> str:
     return "{" + ",".join(pairs) + "}" if pairs else ""
 
 
-def render(registry: Optional[MetricsRegistry] = None) -> str:
-    """The registry's current state as exposition text (ends with ``\\n``)."""
+def render(registry=None,
+           host_tag: Optional[tuple[str, str]] = None) -> str:
+    """The registry's current state as exposition text (ends with ``\\n``).
+
+    Also accepts a :class:`ParsedSnapshot` (what :func:`parse_text`
+    returns), re-emitting it byte-identically — the aggregator's merge
+    path. ``host_tag`` (e.g. ``("process", "1")``) is appended to every
+    series of a host-owned gauge family (see
+    :func:`~photon_ml_tpu.telemetry.metrics.mark_host_owned`) so a
+    multi-process fold never collapses one host's gauge into another's.
+    """
+    if isinstance(registry, ParsedSnapshot):
+        return render_parsed(registry)
     registry = registry if registry is not None else default_registry()
+    host_owned = host_owned_gauges() if host_tag is not None else ()
     lines: list[str] = []
     for fam in registry.collect():
         if fam.help:
             lines.append(f"# HELP {fam.name} {_escape_help(fam.help)}")
         lines.append(f"# TYPE {fam.name} {fam.type}")
+        tag = (host_tag if fam.type == "gauge" and fam.name in host_owned
+               else None)
         for values, child in fam.children():
             if isinstance(child, (Counter, Gauge)):
                 lines.append(
-                    f"{fam.name}{_labels_text(fam.label_names, values)} "
+                    f"{fam.name}{_labels_text(fam.label_names, values, tag)} "
                     f"{format_value(child.value)}")
             elif isinstance(child, Histogram):
                 cum, total, count = child.snapshot()
@@ -124,18 +160,44 @@ def parse_value(s: str) -> float:
     return float(s)
 
 
-def parse_text(text: str) -> dict[str, list[tuple[dict[str, str], float]]]:
-    """Exposition text → ``{series_name: [(labels, value), ...]}``.
+class ParsedSnapshot(dict):
+    """:func:`parse_text` result: ``{series_name: [(labels, value), ...]}``
+    (a plain dict, so pre-existing consumers keep working) plus
+    ``families`` — ``{family_name: {"type": ..., "help": ...}}`` in
+    document order, carrying the ``# HELP``/``# TYPE`` headers needed to
+    re-render the text byte-identically and to merge snapshots
+    type-correctly."""
+
+    def __init__(self):
+        super().__init__()
+        self.families: dict[str, dict] = {}
+
+
+def parse_text(text: str) -> ParsedSnapshot:
+    """Exposition text → :class:`ParsedSnapshot`.
 
     Histogram series come back under their expanded names
-    (``x_bucket``/``x_sum``/``x_count``) — the shape scrapers see. Helper
-    for the bench and tests, not a general-purpose Prometheus parser (no
-    exemplars, no timestamps — we emit neither).
+    (``x_bucket``/``x_sum``/``x_count``) — the shape scrapers see. Not a
+    general-purpose Prometheus parser (no exemplars, no timestamps — we
+    emit neither), but ``render(parse_text(render(reg)))`` is
+    byte-identical to ``render(reg)`` — the invariant the fleet
+    aggregator depends on.
     """
-    out: dict[str, list[tuple[dict[str, str], float]]] = {}
+    out = ParsedSnapshot()
     for line in text.splitlines():
         line = line.strip()
-        if not line or line.startswith("#"):
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(" ", 3)
+            if len(parts) >= 3 and parts[1] in ("HELP", "TYPE"):
+                fam = out.families.setdefault(
+                    parts[2], {"type": "untyped", "help": None})
+                body = parts[3] if len(parts) > 3 else ""
+                if parts[1] == "HELP":
+                    fam["help"] = _unescape(body)
+                else:
+                    fam["type"] = body.strip() or "untyped"
             continue
         if "{" in line:
             name, rest = line.split("{", 1)
@@ -147,6 +209,80 @@ def parse_text(text: str) -> dict[str, list[tuple[dict[str, str], float]]]:
         out.setdefault(name.strip(), []).append(
             (labels, parse_value(value_s.strip())))
     return out
+
+
+def _sample_line(name: str, labels: Mapping[str, str], value: float) -> str:
+    if labels:
+        block = ",".join(f'{k}="{_escape_label(v)}"'
+                         for k, v in labels.items())
+        return f"{name}{{{block}}} {format_value(value)}"
+    return f"{name} {format_value(value)}"
+
+
+def _label_key(labels: Mapping[str, str]) -> tuple:
+    return tuple(sorted(labels.items()))
+
+
+def histogram_series_names(family: str) -> tuple[str, str, str]:
+    """The expanded series names a histogram family ``family`` emits."""
+    return family + "_bucket", family + "_sum", family + "_count"
+
+
+def _emit_histogram(lines: list, parsed: ParsedSnapshot, name: str) -> None:
+    """Re-emit a histogram family per-child (all of one label set's buckets,
+    then its ``_sum`` and ``_count``) — the layout :func:`render` writes, so
+    the round-trip stays byte-identical."""
+    bucket_name, sum_name, count_name = histogram_series_names(name)
+    sums = list(parsed.get(sum_name, ()))
+    counts = list(parsed.get(count_name, ()))
+    groups: dict[tuple, list] = {}
+    for labels, value in parsed.get(bucket_name, ()):
+        base = {k: v for k, v in labels.items() if k != "le"}
+        groups.setdefault(_label_key(base), []).append((labels, value))
+
+    def pop_matching(samples: list, key: tuple):
+        for i, (labels, value) in enumerate(samples):
+            if _label_key(labels) == key:
+                return samples.pop(i)
+        return None
+
+    for key, buckets in groups.items():
+        for labels, value in buckets:
+            lines.append(_sample_line(bucket_name, labels, value))
+        for series, samples in ((sum_name, sums), (count_name, counts)):
+            got = pop_matching(samples, key)
+            if got is not None:
+                lines.append(_sample_line(series, got[0], got[1]))
+    # stray _sum/_count with no bucket series (not produced by our
+    # renderer, but tolerated rather than dropped)
+    for series, samples in ((sum_name, sums), (count_name, counts)):
+        for labels, value in samples:
+            lines.append(_sample_line(series, labels, value))
+
+
+def render_parsed(parsed: ParsedSnapshot) -> str:
+    """A :class:`ParsedSnapshot` back as exposition text — the exact bytes
+    :func:`render` would have produced for the snapshot it was parsed from
+    (headers, family order and sample order preserved)."""
+    lines: list[str] = []
+    claimed: set[str] = set()
+    for name, fam in parsed.families.items():
+        if fam.get("help"):
+            lines.append(f"# HELP {name} {_escape_help(fam['help'])}")
+        lines.append(f"# TYPE {name} {fam['type']}")
+        if fam["type"] == "histogram":
+            claimed.update(histogram_series_names(name))
+            _emit_histogram(lines, parsed, name)
+        else:
+            claimed.add(name)
+            for labels, value in parsed.get(name, ()):
+                lines.append(_sample_line(name, labels, value))
+    for name, samples in parsed.items():  # headerless series, document order
+        if name in claimed:
+            continue
+        for labels, value in samples:
+            lines.append(_sample_line(name, labels, value))
+    return "\n".join(lines) + "\n" if lines else ""
 
 
 def series_value(parsed: Mapping, name: str,

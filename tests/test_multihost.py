@@ -751,6 +751,168 @@ def test_two_process_score_game_driver(tmp_path):
     assert rows == n_total
 
 
+_TELEMETRY_WORKER = r"""
+import sys, json
+port, pid = sys.argv[1], int(sys.argv[2])
+from photon_ml_tpu.testing import virtual_devices
+virtual_devices(2, force_cpu=True)
+from photon_ml_tpu.parallel import multihost
+multihost.initialize(f"localhost:{port}", 2, pid)
+from photon_ml_tpu.cli import train_game
+train_game.run(json.loads('@ARGS@'))
+print(f"MULTIPROC_TELEMETRY_OK {pid}", flush=True)
+"""
+
+
+def _exact_series(parsed, series, labels):
+    for got, value in parsed.get(series, ()):
+        if got == labels:
+            return value
+    return 0.0
+
+
+@pytest.mark.slow
+def test_two_process_fleet_telemetry(tmp_path):
+    """Fleet-wide telemetry across two real processes: train_game
+    --multihost --telemetry-dir --metrics-port. The chief's live /metrics
+    must serve ONE aggregate in which counters and histogram
+    bucket/sum/count series are the element-wise sum of the two
+    per-process registries and per-host gauges fan out under a process
+    label; at close the chief writes metrics.aggregate.prom as the fold of
+    the exact per-process metrics.prom dumps, and tools/metrics_fold.py
+    reproduces it byte-identically offline (plus the merged trace
+    timeline)."""
+    import json
+    import threading
+    import time
+    import urllib.request
+
+    from photon_ml_tpu.telemetry import prometheus as tprom
+    from photon_ml_tpu.telemetry.aggregate import aggregate_text
+
+    train_dir = tmp_path / "train"
+    train_dir.mkdir()
+    for i in range(4):
+        _write_game_avro(train_dir / f"part-{i}.avro", n=120, seed=i)
+
+    tdir = str(tmp_path / "telemetry")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        metrics_port = s.getsockname()[1]
+    argv = [
+        "--training-data", str(train_dir),
+        "--output-dir", str(tmp_path / "out"),
+        "--feature-shards", "global=fixed|intercept,user=user|noIntercept",
+        "--coordinates", "global=fixed,shard=global,reg=L2",
+        "perUser=random,entity=userId,shard=user,reg=L2",
+        "--update-sequence", "global,perUser",
+        "--cd-iterations", "2",
+        "--grid", "global=0.01", "perUser=1",
+        "--evaluators", "",
+        "--telemetry-dir", tdir,
+        "--telemetry-poll-s", "0.5",
+        "--metrics-port", str(metrics_port),
+        "--multihost",
+    ]
+    script = _TELEMETRY_WORKER.replace("@ARGS@", json.dumps(argv))
+
+    # scrape the chief's endpoint WHILE training runs; keep the first
+    # response that reflects a genuine 2-process fold (both processes'
+    # training_started events summed)
+    scraped = {}
+    stop = threading.Event()
+
+    def scraper():
+        url = f"http://127.0.0.1:{metrics_port}/metrics"
+        while not stop.is_set() and "agg" not in scraped:
+            try:
+                with urllib.request.urlopen(url, timeout=5) as resp:
+                    body = resp.read().decode()
+                p = tprom.parse_text(body)
+                if tprom.series_value(p, "photon_training_runs_total",
+                                      {"driver": "train_game"}) >= 2:
+                    scraped["agg"] = body
+            except OSError:
+                pass
+            time.sleep(0.05)
+
+    scraper_thread = threading.Thread(target=scraper, daemon=True)
+    scraper_thread.start()
+    try:
+        _run_two_workers(tmp_path, script, "MULTIPROC_TELEMETRY_OK",
+                         timeout=420)
+    finally:
+        stop.set()
+        scraper_thread.join()
+
+    # --- the live scrape saw one fleet-wide aggregate -------------------
+    assert "agg" in scraped, \
+        "GET /metrics never served a 2-process aggregate"
+    live = tprom.parse_text(scraped["agg"])
+    assert {l.get("process")
+            for l, _ in live["photon_host_rss_bytes"]} == {"0", "1"}
+    assert {l["process"] for l, _ in live["photon_build_info"]} == \
+        {"0", "1"}
+
+    # --- close-time artifacts -------------------------------------------
+    chief_text = open(os.path.join(tdir, "metrics.prom")).read()
+    worker_text = open(os.path.join(
+        tdir, "workers", "proc-1", "metrics.prom")).read()
+    agg_text = open(os.path.join(tdir, "metrics.aggregate.prom")).read()
+    # the dumped aggregate IS the fold of the dumped snapshots, byte for
+    # byte (close renders once and feeds the same text to both)
+    assert agg_text == aggregate_text([chief_text, worker_text])
+
+    # the offline tool reproduces it byte-identically, and merges traces
+    refold = str(tmp_path / "refold.prom")
+    rc = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.dirname(
+             os.path.abspath(__file__))), "tools", "metrics_fold.py"),
+         tdir, "--output", refold],
+        capture_output=True, text=True)
+    assert rc.returncode == 0, rc.stdout + rc.stderr
+    assert open(refold).read() == agg_text
+
+    # every counter / histogram series in the aggregate equals the
+    # element-wise sum of the two per-process snapshots
+    p0, p1 = tprom.parse_text(chief_text), tprom.parse_text(worker_text)
+    pa = tprom.parse_text(agg_text)
+    checked = 0
+    for name, fam in pa.families.items():
+        if fam["type"] == "counter":
+            series_names = [name]
+        elif fam["type"] == "histogram":
+            series_names = [name + "_bucket", name + "_sum",
+                            name + "_count"]
+        else:
+            continue
+        for series in series_names:
+            for labels, value in pa.get(series, ()):
+                want = (_exact_series(p0, series, labels)
+                        + _exact_series(p1, series, labels))
+                assert value == pytest.approx(want), (series, labels)
+                checked += 1
+    assert checked > 10  # the sum check actually covered the registry
+    # per-host gauges appear once per process label in the aggregate too
+    assert {l.get("process")
+            for l, _ in pa["photon_host_rss_bytes"]} == {"0", "1"}
+    # replicated (non-host-owned) gauges resolve to the chief's value
+    for labels, value in pa.get("photon_optimizer_converged", ()):
+        assert value == _exact_series(p0, "photon_optimizer_converged",
+                                      labels)
+
+    # merged trace: one wall-clock timeline, every record process-tagged
+    merged_trace = os.path.join(tdir, "trace.merged.jsonl")
+    assert os.path.exists(merged_trace)
+    records = [json.loads(line) for line in open(merged_trace)]
+    assert {r["process"] for r in records} == {0, 1}
+    ts = [r.get("ts", 0.0) for r in records]
+    assert ts == sorted(ts)
+    assert any(r["name"] == "train_game" and r["process"] == 1
+               for r in records)
+
+
 @pytest.mark.slow
 def test_two_process_game_cd(tmp_path):
     """Full GAME coordinate descent across two real processes: dp fixed

@@ -1,0 +1,463 @@
+"""Fleet-metrics aggregation tests (telemetry/aggregate.py + wiring).
+
+The load-bearing contracts:
+
+- **byte-identical round trip**: ``render(parse_text(render(reg)))`` equals
+  ``render(reg)`` exactly, HELP/TYPE headers included — the invariant that
+  makes the live collective fold and the offline ``tools/metrics_fold.py``
+  fold of the same snapshots produce the same bytes;
+- **merge semantics**: counters and histogram ``_bucket``/``_sum``/
+  ``_count`` series sum per label set; gauges resolve chief-wins; per-host
+  gauges (render-time ``process`` tag) fan out one series per process;
+  conflicting family types across snapshots fail loudly;
+- **zero cost when off**: ``sweep_boundary`` with no hooks installed is a
+  no-op, and a session without ``--metrics-port`` installs none;
+- **end-to-end** (single-process degenerate of the 2-process test in
+  ``tests/test_multihost.py``): ``train_game --metrics-port`` serves a live
+  scrape during the run, writes ``metrics.aggregate.prom`` at close
+  byte-identical to its own ``metrics.prom`` (the 1-process fold is the
+  identity), and ``tools/metrics_fold.py`` reproduces it offline.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import photon_ml_tpu.telemetry.device  # noqa: F401  (marks rss host-owned)
+from photon_ml_tpu.telemetry import aggregate as tagg
+from photon_ml_tpu.telemetry import prometheus as tprom
+from photon_ml_tpu.telemetry.metrics import MetricsRegistry, mark_host_owned
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import metrics_fold  # noqa: E402
+
+
+def _registry(rss=100.0, reads=3, hist=(0.05, 0.5)):
+    reg = MetricsRegistry()
+    reg.counter("photon_reads_total", "reads", labels=("op",)).labels(
+        op="avro").inc(reads)
+    reg.gauge("photon_host_rss_bytes", "Process resident set size").set(rss)
+    reg.gauge("photon_sweep", "replicated sweep index").set(rss / 100)
+    h = reg.histogram("photon_lat_seconds", "latency", buckets=(0.1, 1.0))
+    for v in hist:
+        h.observe(v)
+    return reg
+
+
+class TestRoundTrip:
+    def test_byte_identical_with_headers(self):
+        reg = _registry()
+        text = tprom.render(reg)
+        parsed = tprom.parse_text(text)
+        assert parsed.families["photon_reads_total"] == {
+            "type": "counter", "help": "reads"}
+        assert parsed.families["photon_lat_seconds"]["type"] == "histogram"
+        assert tprom.render(parsed) == text
+
+    def test_byte_identical_with_nasty_escapes(self):
+        reg = MetricsRegistry()
+        reg.counter("photon_e_total", 'help with "quotes"\nand\\slashes',
+                    labels=("p",)).labels(p='a"b\\c\nd').inc()
+        text = tprom.render(reg)
+        assert tprom.render(tprom.parse_text(text)) == text
+
+    def test_byte_identical_labeled_histogram_multiple_children(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("photon_h_seconds", "h", labels=("k",),
+                          buckets=(0.1, 1.0))
+        h.labels(k="a").observe(0.05)
+        h.labels(k="b").observe(5.0)
+        h.labels(k="a").observe(0.5)
+        text = tprom.render(reg)
+        assert tprom.render(tprom.parse_text(text)) == text
+
+    def test_headerless_family_with_no_samples_preserved(self):
+        reg = MetricsRegistry()
+        reg.counter("photon_zero_total", "declared, labeled, never used",
+                    labels=("op",))
+        text = tprom.render(reg)  # headers only, no samples
+        assert "photon_zero_total" in text
+        assert tprom.render(tprom.parse_text(text)) == text
+
+
+class TestMerge:
+    def _texts(self):
+        a = tprom.render(_registry(rss=100, reads=3, hist=(0.05, 0.5)),
+                         host_tag=("process", "0"))
+        b = tprom.render(_registry(rss=200, reads=4, hist=(5.0,)),
+                         host_tag=("process", "1"))
+        return a, b
+
+    def test_counters_and_histograms_sum(self):
+        a, b = self._texts()
+        p = tprom.parse_text(tagg.aggregate_text([a, b]))
+        assert tprom.series_value(p, "photon_reads_total",
+                                  {"op": "avro"}) == 7
+        assert tprom.series_value(p, "photon_lat_seconds_count") == 3
+        assert tprom.series_value(p, "photon_lat_seconds_sum") \
+            == pytest.approx(5.55)
+        assert tprom.series_value(p, "photon_lat_seconds_bucket",
+                                  {"le": "1"}) == 2
+        assert tprom.series_value(p, "photon_lat_seconds_bucket",
+                                  {"le": "+Inf"}) == 3
+
+    def test_host_owned_gauges_fan_out_plain_gauges_chief_win(self):
+        a, b = self._texts()
+        p = tprom.parse_text(tagg.aggregate_text([a, b]))
+        # photon_host_rss_bytes is host-owned (marked by device.py): one
+        # series per process, neither overwritten
+        assert tprom.series_value(p, "photon_host_rss_bytes",
+                                  {"process": "0"}) == 100
+        assert tprom.series_value(p, "photon_host_rss_bytes",
+                                  {"process": "1"}) == 200
+        assert len(p["photon_host_rss_bytes"]) == 2
+        # the replicated gauge resolves to the chief's value, one series
+        assert p["photon_sweep"] == [({}, 1.0)]
+
+    def test_series_missing_from_one_snapshot_still_merge(self):
+        reg_a, reg_b = MetricsRegistry(), MetricsRegistry()
+        reg_a.counter("photon_a_total", "only on a").inc(2)
+        reg_b.counter("photon_b_total", "only on b").inc(5)
+        p = tprom.parse_text(tagg.aggregate_text(
+            [tprom.render(reg_a), tprom.render(reg_b)]))
+        assert tprom.series_value(p, "photon_a_total") == 2
+        assert tprom.series_value(p, "photon_b_total") == 5
+
+    def test_single_snapshot_merge_is_identity(self):
+        a, _ = self._texts()
+        assert tagg.aggregate_text([a]) == a
+
+    def test_conflicting_family_types_raise(self):
+        reg_a, reg_b = MetricsRegistry(), MetricsRegistry()
+        reg_a.counter("photon_clash", "as counter").inc()
+        reg_b.gauge("photon_clash", "as gauge").set(1)
+        with pytest.raises(ValueError, match="conflicting types"):
+            tagg.aggregate_text([tprom.render(reg_a), tprom.render(reg_b)])
+
+    def test_family_order_follows_chief(self):
+        reg_a, reg_b = MetricsRegistry(), MetricsRegistry()
+        reg_a.counter("photon_first_total", "x").inc()
+        reg_b.counter("photon_extra_total", "worker-only family").inc()
+        reg_b.counter("photon_first_total", "x").inc()
+        merged = tagg.aggregate_text([tprom.render(reg_a),
+                                      tprom.render(reg_b)])
+        assert merged.index("photon_first_total") \
+            < merged.index("photon_extra_total")
+
+
+class TestHostTagRender:
+    def test_tag_applies_only_to_host_owned_gauges(self):
+        reg = _registry()
+        p = tprom.parse_text(tprom.render(reg, host_tag=("process", "7")))
+        (labels, _), = p["photon_host_rss_bytes"]
+        assert labels == {"process": "7"}
+        # counters/histograms and non-host-owned gauges stay untagged
+        (labels, _), = p["photon_reads_total"]
+        assert labels == {"op": "avro"}
+        (labels, _), = p["photon_sweep"]
+        assert labels == {}
+
+    def test_no_tag_is_the_golden_layout(self):
+        reg = _registry()
+        assert tprom.render(reg) == tprom.render(reg, host_tag=None)
+
+    def test_marked_name_is_respected(self):
+        reg = MetricsRegistry()
+        reg.gauge("photon_custom_depth", "per-host depth").set(3)
+        mark_host_owned("photon_custom_depth")
+        p = tprom.parse_text(tprom.render(reg, host_tag=("process", "2")))
+        (labels, value), = p["photon_custom_depth"]
+        assert labels == {"process": "2"} and value == 3
+
+
+class TestSweepHooks:
+    def test_install_fire_uninstall(self):
+        seen = []
+        un = tagg.install_sweep_hook(lambda **info: seen.append(info))
+        try:
+            tagg.sweep_boundary(sweep=1)
+            tagg.sweep_boundary(sweep=2)
+        finally:
+            un()
+        tagg.sweep_boundary(sweep=3)  # after uninstall: not delivered
+        assert seen == [{"sweep": 1}, {"sweep": 2}]
+        un()  # double-uninstall is a no-op
+
+    def test_hook_failure_is_contained(self):
+        calls = []
+        un_bad = tagg.install_sweep_hook(
+            lambda **info: (_ for _ in ()).throw(RuntimeError("boom")))
+        un_ok = tagg.install_sweep_hook(lambda **info: calls.append(info))
+        try:
+            tagg.sweep_boundary(sweep=0)  # must not raise
+        finally:
+            un_bad()
+            un_ok()
+        assert calls == [{"sweep": 0}]
+
+
+class TestFleetAggregatorSingleProcess:
+    def test_fold_is_identity_and_latest_is_live(self):
+        reg = _registry(rss=42)
+        agg = tagg.FleetMetricsAggregator(registry=reg)
+        folded = agg.fold()
+        assert folded == tprom.render(reg)  # 1 process: no host tag
+        # latest() renders LIVE at 1 process (fresher than the last fold)
+        reg.counter("photon_reads_total", "reads", labels=("op",)).labels(
+            op="avro").inc()
+        assert "photon_reads_total{op=\"avro\"} 4" in agg.latest()
+
+
+class TestMetricsHTTPServer:
+    def _get(self, url):
+        with urllib.request.urlopen(url, timeout=30) as resp:
+            return resp.status, resp.headers.get("Content-Type"), \
+                resp.read().decode()
+
+    def test_serves_provider_text(self):
+        server = tagg.MetricsHTTPServer(lambda: "photon_up 1\n").start()
+        try:
+            status, ctype, body = self._get(server.url + "/metrics")
+            assert status == 200
+            assert ctype == tprom.CONTENT_TYPE
+            assert body == "photon_up 1\n"
+            status, _, body = self._get(server.url + "/healthz")
+            assert status == 200 and json.loads(body)["status"] == "ok"
+            with pytest.raises(urllib.error.HTTPError) as e:
+                self._get(server.url + "/nope")
+            assert e.value.code == 404
+        finally:
+            server.stop()
+
+    def test_provider_failure_is_a_500_not_a_crash(self):
+        def bad():
+            raise RuntimeError("registry exploded")
+
+        server = tagg.MetricsHTTPServer(bad).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                self._get(server.url + "/metrics")
+            assert e.value.code == 500
+            # the server survives and keeps answering
+            status, _, _ = self._get(server.url + "/healthz")
+            assert status == 200
+        finally:
+            server.stop()
+
+
+class TestTraceMerge:
+    def _trace(self, path, records):
+        with open(path, "w") as f:
+            for r in records:
+                f.write(json.dumps(r) + "\n")
+        return str(path)
+
+    def test_merge_tags_process_and_orders_by_wall_clock(self, tmp_path):
+        chief = self._trace(tmp_path / "a.jsonl", [
+            {"name": "cd.sweep", "span_id": 1, "parent_id": None,
+             "ts": 10.0, "t0": 0.0, "t1": 1.0, "seconds": 1.0, "sweep": 0},
+            {"name": "cd.sweep", "span_id": 2, "parent_id": None,
+             "ts": 30.0, "t0": 2.0, "t1": 3.0, "seconds": 1.0, "sweep": 1},
+        ])
+        worker = self._trace(tmp_path / "b.jsonl", [
+            {"name": "cd.sweep", "span_id": 1, "parent_id": None,
+             "ts": 20.0, "t0": 0.5, "t1": 1.5, "seconds": 1.0, "sweep": 0},
+        ])
+        merged = tagg.merge_trace_files([(0, chief), (1, worker)])
+        assert [(r["process"], r["ts"]) for r in merged] == [
+            (0, 10.0), (1, 20.0), (0, 30.0)]
+        # span ids stay per-process scoped; (process, span_id) is unique
+        keys = {(r["process"], r["span_id"]) for r in merged}
+        assert len(keys) == 3
+
+    def test_fold_traces_tool(self, tmp_path):
+        run = tmp_path / "run"
+        wdir = run / "workers" / "proc-1"
+        wdir.mkdir(parents=True)
+        self._trace(run / "trace.jsonl",
+                    [{"name": "a", "span_id": 1, "parent_id": None,
+                      "ts": 2.0}])
+        self._trace(wdir / "trace.jsonl",
+                    [{"name": "b", "span_id": 1, "parent_id": None,
+                      "ts": 1.0}])
+        out = metrics_fold.fold_traces(str(run))
+        recs = [json.loads(line) for line in open(out)]
+        assert [(r["name"], r["process"]) for r in recs] == [("b", 1),
+                                                             ("a", 0)]
+
+
+class TestMetricsFoldTool:
+    def test_offline_fold_matches_live_merge(self, tmp_path):
+        run = tmp_path / "run"
+        wdir = run / "workers" / "proc-1"
+        wdir.mkdir(parents=True)
+        t0 = tprom.render(_registry(rss=100, reads=3),
+                          host_tag=("process", "0"))
+        t1 = tprom.render(_registry(rss=200, reads=4),
+                          host_tag=("process", "1"))
+        (run / "metrics.prom").write_text(t0)
+        (wdir / "metrics.prom").write_text(t1)
+        out = metrics_fold.fold_metrics(str(run))
+        assert out == str(run / "metrics.aggregate.prom")
+        assert open(out).read() == tagg.aggregate_text([t0, t1])
+        p = tprom.parse_text(open(out).read())
+        assert tprom.series_value(p, "photon_reads_total",
+                                  {"op": "avro"}) == 7
+        assert len(p["photon_host_rss_bytes"]) == 2
+
+    def test_missing_worker_snapshot_is_actionable(self, tmp_path):
+        run = tmp_path / "run"
+        (run / "workers" / "proc-1").mkdir(parents=True)
+        (run / "metrics.prom").write_text("photon_up 1\n")
+        with pytest.raises(FileNotFoundError, match="process 1"):
+            metrics_fold.fold_metrics(str(run))
+
+    def test_cli_main(self, tmp_path, capsys):
+        run = tmp_path / "run"
+        run.mkdir()
+        (run / "metrics.prom").write_text(
+            tprom.render(_registry()))
+        assert metrics_fold.main([str(run), "--no-traces"]) == 0
+        assert "metrics.aggregate.prom" in capsys.readouterr().out
+        assert (run / "metrics.aggregate.prom").exists()
+
+
+class TestPeriodicSnapshotWriter:
+    def test_metrics_prom_written_mid_flight(self, tmp_path):
+        from photon_ml_tpu.events import EventBus
+        from photon_ml_tpu.telemetry import TelemetrySession
+
+        reg = _registry()
+        session = TelemetrySession(telemetry_dir=str(tmp_path),
+                                   poll_interval_s=0.05, bus=EventBus(),
+                                   registry=reg)
+        try:
+            path = tmp_path / "metrics.prom"
+            deadline = time.monotonic() + 10
+            while not path.exists() and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert path.exists(), "no mid-flight metrics.prom snapshot"
+            # the snapshot keeps refreshing: bump a counter, watch it land
+            reg.counter("photon_reads_total", "reads",
+                        labels=("op",)).labels(op="avro").inc(10)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                p = tprom.parse_text(path.read_text())
+                if tprom.series_value(p, "photon_reads_total",
+                                      {"op": "avro"}) == 13:
+                    break
+                time.sleep(0.02)
+            else:
+                pytest.fail("periodic writer never refreshed the snapshot")
+        finally:
+            session.close()
+
+    def test_no_writer_without_telemetry_dir(self):
+        from photon_ml_tpu.events import EventBus
+        from photon_ml_tpu.telemetry import TelemetrySession
+
+        session = TelemetrySession(poll_interval_s=0.05, bus=EventBus(),
+                                   registry=MetricsRegistry())
+        try:
+            assert session._snap_thread is None
+        finally:
+            session.close()
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: train_game --metrics-port (single-process degenerate; the
+# genuine 2-process fold is tests/test_multihost.py::
+# test_two_process_fleet_telemetry)
+# ---------------------------------------------------------------------------
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class TestTrainGameMetricsPortE2E:
+    def test_live_scrape_and_close_time_aggregate(self, tmp_path):
+        from photon_ml_tpu.cli import train_game as train_game_cli
+        from photon_ml_tpu.io.data_reader import write_training_examples
+        from test_telemetry import _records
+
+        train_path = str(tmp_path / "train.avro")
+        write_training_examples(train_path, _records(120))
+        tdir = str(tmp_path / "telemetry")
+        port = _free_port()
+
+        scraped = []
+        stop = threading.Event()
+
+        def scraper():
+            url = f"http://127.0.0.1:{port}/metrics"
+            while not stop.is_set():
+                try:
+                    with urllib.request.urlopen(url, timeout=5) as resp:
+                        scraped.append(resp.read().decode())
+                except OSError:
+                    pass
+                time.sleep(0.05)
+
+        t = threading.Thread(target=scraper, daemon=True)
+        t.start()
+        try:
+            train_game_cli.run([
+                "--training-data", train_path,
+                "--output-dir", str(tmp_path / "run"),
+                "--feature-shards",
+                "global=fixed|intercept,user=user|noIntercept",
+                "--coordinates", "global=fixed,shard=global,reg=L2",
+                "perUser=random,entity=userId,shard=user,reg=L2",
+                "--update-sequence", "global,perUser",
+                "--cd-iterations", "2",
+                "--grid", "global=0.1", "perUser=1",
+                "--evaluators", "",
+                "--telemetry-dir", tdir,
+                "--metrics-port", str(port),
+            ])
+        finally:
+            stop.set()
+            t.join()
+        assert scraped, "the live /metrics endpoint was never reachable"
+        p = tprom.parse_text(scraped[-1])
+        assert tprom.series_value(
+            p, "photon_build_info",
+            {"process": "0"}, default=0.0) == 1.0
+        assert tprom.series_value(p, "photon_training_runs_total",
+                                  {"driver": "train_game"}) >= 1
+
+        # zero-new-hot-path contract holds the other way around too: the
+        # listener is DOWN once the session closed
+        with pytest.raises(OSError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=2)
+
+        # close-time artifacts: at 1 process the fold is the identity, so
+        # the aggregate is byte-identical to the dump — and the offline
+        # tool reproduces it byte-identically again
+        dump = open(os.path.join(tdir, "metrics.prom")).read()
+        agg = open(os.path.join(tdir, "metrics.aggregate.prom")).read()
+        assert agg == dump
+        out = metrics_fold.fold_metrics(tdir, output=str(
+            tmp_path / "refold.prom"))
+        assert open(out).read() == agg
+        # build info made it to the durable snapshot with real labels
+        p = tprom.parse_text(dump)
+        (labels, value), = p["photon_build_info"]
+        assert value == 1.0
+        assert set(labels) == {"version", "process", "jax_version"}
+        assert labels["process"] == "0"
+        np.testing.assert_array_less([0], [len(labels["version"])])

@@ -1,6 +1,8 @@
 """Tier-1 wrapper for ``tools/check_telemetry_hygiene.py`` (no ``print(``
 outside CLI entry points; no ``time.perf_counter`` in serving/ — latency
-measurement must go through the metrics registry or a span)."""
+measurement must go through the metrics registry or a span; metric names
+match ``photon_[a-z0-9_]+`` with non-empty help; no ``MetricsRegistry``
+constructed outside ``photon_ml_tpu/telemetry/``)."""
 
 import os
 import sys
@@ -54,3 +56,51 @@ def test_perf_counter_legal_outside_serving():
     src = "import time\ntime.perf_counter()\n"
     assert hygiene.check_source(
         src, os.path.join("photon_ml_tpu", "game", "x.py")) == []
+
+
+@pytest.mark.parametrize("snippet, n", [
+    # attribute-style registration (registry or module alias)
+    ('from photon_ml_tpu.telemetry import metrics as m\n'
+     'm.counter("photon_good_total", "well documented")\n', 0),
+    ('from photon_ml_tpu.telemetry import metrics as m\n'
+     'm.counter("bad_name_total", "help")\n', 1),          # missing prefix
+    ('from photon_ml_tpu.telemetry import metrics as m\n'
+     'm.gauge("photon_CamelCase", "help")\n', 1),          # bad charset
+    ('from photon_ml_tpu.telemetry import metrics as m\n'
+     'm.histogram("photon_ok_seconds")\n', 1),             # no help at all
+    ('from photon_ml_tpu.telemetry import metrics as m\n'
+     'm.counter("photon_ok_total", "  ")\n', 1),           # blank help
+    ('from photon_ml_tpu.telemetry import metrics as m\n'
+     'm.counter("photon_ok_total", help_="via keyword")\n', 0),
+    # from-imported factory names are tracked too
+    ('from photon_ml_tpu.telemetry.metrics import counter\n'
+     'counter("nope_total", "help")\n', 1),
+    # dynamic names are out of the lint's reach (registry plumbing)
+    ('from photon_ml_tpu.telemetry import metrics as m\n'
+     'name = f()\nm.counter(name, "help")\n', 0),
+    # unrelated .histogram calls with non-literal args don't trip it
+    ('import numpy as np\nnp.histogram(data, bins=10)\n', 0),
+])
+def test_metric_naming_lint(snippet, n):
+    rel = os.path.join("photon_ml_tpu", "game", "x.py")
+    assert len(hygiene.check_source(snippet, rel)) == n, \
+        hygiene.check_source(snippet, rel)
+
+
+@pytest.mark.parametrize("rel, n", [
+    (os.path.join("photon_ml_tpu", "game", "x.py"), 1),
+    (os.path.join("photon_ml_tpu", "serving", "x.py"), 1),
+    (os.path.join("photon_ml_tpu", "telemetry", "x.py"), 0),
+])
+def test_private_registry_construction_banned_outside_telemetry(rel, n):
+    src = ("from photon_ml_tpu.telemetry.metrics import MetricsRegistry\n"
+           "reg = MetricsRegistry()\n")
+    assert len(hygiene.check_source(src, rel)) == n
+
+
+def test_private_registry_via_module_attribute_banned():
+    src = ("from photon_ml_tpu.telemetry import metrics\n"
+           "reg = metrics.MetricsRegistry()\n")
+    rel = os.path.join("photon_ml_tpu", "io", "x.py")
+    out = hygiene.check_source(src, rel)
+    assert len(out) == 1 and "default_registry" in out[0]

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Static telemetry-hygiene check over ``photon_ml_tpu/``.
 
-Two rules, both load-bearing for the telemetry subsystem (the sibling of
+Four rules, all load-bearing for the telemetry subsystem (the sibling of
 ``check_resilience_hygiene.py``, same contract: run directly or through the
 tier-1 test):
 
@@ -18,6 +18,16 @@ tier-1 test):
    ``perf_counter`` pair is a measurement the scrape can never see.
    ``time.monotonic`` (deadlines) and ``time.time`` (timestamps) stay
    legal — they are scheduling clocks, not latency measurements.
+3. **Metric naming** — every ``counter(``/``gauge(``/``histogram(``
+   registration with a literal name must match ``photon_[a-z0-9_]+`` and
+   carry non-empty help text. The fleet aggregator merges snapshots by
+   family name across processes and versions; an off-prefix or
+   helpless metric is a scrape nobody can interpret.
+4. **One registry** — no module outside ``photon_ml_tpu/telemetry/``
+   constructs a ``MetricsRegistry``: the process-global default is the
+   only sanctioned registry outside tests. A second registry silently
+   forks the metric namespace and its series never reach ``/metrics`` or
+   the fleet fold.
 
 Run directly (``python tools/check_telemetry_hygiene.py [root]``, exit 1 on
 violations) or through the tier-1 test ``tests/test_telemetry_hygiene.py``.
@@ -27,6 +37,7 @@ from __future__ import annotations
 
 import ast
 import os
+import re
 import sys
 
 #: stdout owners: the CLI drivers and the module runner
@@ -37,6 +48,14 @@ PRINT_ALLOWED_FILES = {os.path.join("photon_ml_tpu", "__main__.py")}
 
 #: the subtree where latency measurement must route through telemetry
 PERF_COUNTER_BANNED_PREFIX = os.path.join("photon_ml_tpu", "serving") + os.sep
+
+#: the one place allowed to construct MetricsRegistry instances
+REGISTRY_ALLOWED_PREFIX = os.path.join("photon_ml_tpu", "telemetry") + os.sep
+
+#: metric-family registration methods/functions
+METRIC_FACTORIES = frozenset({"counter", "gauge", "histogram"})
+
+METRIC_NAME_RE = re.compile(r"photon_[a-z0-9_]+\Z")
 
 
 def _is_perf_counter(node: ast.AST, time_aliases: set[str],
@@ -49,6 +68,26 @@ def _is_perf_counter(node: ast.AST, time_aliases: set[str],
     return False
 
 
+def _metric_call_args(node: ast.Call):
+    """(name, help) literals of a metric-factory call; non-literal fields
+    come back as None (dynamic names/helps are out of the lint's reach —
+    the registry's internal plumbing passes them through variables)."""
+    name = help_ = None
+    if node.args and isinstance(node.args[0], ast.Constant) \
+            and isinstance(node.args[0].value, str):
+        name = node.args[0].value
+    if len(node.args) > 1 and isinstance(node.args[1], ast.Constant) \
+            and isinstance(node.args[1].value, str):
+        help_ = node.args[1].value
+    for kw in node.keywords:
+        if kw.arg == "help_" and isinstance(kw.value, ast.Constant) \
+                and isinstance(kw.value.value, str):
+            help_ = kw.value.value
+    has_help_arg = len(node.args) > 1 or any(kw.arg == "help_"
+                                             for kw in node.keywords)
+    return name, help_, has_help_arg
+
+
 def check_source(source: str, rel_path: str) -> list[str]:
     """Violations in one file, as ``path:line: message`` strings."""
     tree = ast.parse(source, filename=rel_path)
@@ -57,19 +96,26 @@ def check_source(source: str, rel_path: str) -> list[str]:
                 or any(rel_path.startswith(p)
                        for p in PRINT_ALLOWED_PREFIXES))
     pc_banned = rel_path.startswith(PERF_COUNTER_BANNED_PREFIX)
+    registry_ok = rel_path.startswith(REGISTRY_ALLOWED_PREFIX)
 
     # resolve what `time` / `perf_counter` are bound to in this module
     time_aliases: set[str] = set()
     pc_names: set[str] = set()
+    metric_fn_names: set[str] = set()  # from-imports of counter/gauge/...
     for node in ast.walk(tree):
         if isinstance(node, ast.Import):
             for a in node.names:
                 if a.name == "time":
                     time_aliases.add(a.asname or "time")
-        elif isinstance(node, ast.ImportFrom) and node.module == "time":
-            for a in node.names:
-                if a.name == "perf_counter":
-                    pc_names.add(a.asname or "perf_counter")
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "time":
+                for a in node.names:
+                    if a.name == "perf_counter":
+                        pc_names.add(a.asname or "perf_counter")
+            elif node.module == "photon_ml_tpu.telemetry.metrics":
+                for a in node.names:
+                    if a.name in METRIC_FACTORIES:
+                        metric_fn_names.add(a.asname or a.name)
 
     out = []
     for node in ast.walk(tree):
@@ -86,6 +132,40 @@ def check_source(source: str, rel_path: str) -> list[str]:
                        f"serving/ — measure latency through the metrics "
                        f"registry's Histogram.time() or a tracing span so "
                        f"/metrics sees it")
+        elif isinstance(node, ast.Call):
+            func = node.func
+            is_factory = (
+                (isinstance(func, ast.Attribute)
+                 and func.attr in METRIC_FACTORIES)
+                or (isinstance(func, ast.Name)
+                    and func.id in metric_fn_names))
+            if is_factory:
+                name, help_, has_help = _metric_call_args(node)
+                if name is not None:
+                    if not METRIC_NAME_RE.fullmatch(name):
+                        out.append(
+                            f"{rel_path}:{node.lineno}: metric name "
+                            f"{name!r} must match photon_[a-z0-9_]+ — the "
+                            f"fleet aggregate merges by family name, so "
+                            f"every family carries the photon_ prefix")
+                    if not has_help or (help_ is not None
+                                        and not help_.strip()):
+                        out.append(
+                            f"{rel_path}:{node.lineno}: metric {name!r} "
+                            f"registered without help text — a scrape "
+                            f"nobody can interpret; say what the number "
+                            f"means")
+            if (not registry_ok
+                    and ((isinstance(func, ast.Name)
+                          and func.id == "MetricsRegistry")
+                         or (isinstance(func, ast.Attribute)
+                             and func.attr == "MetricsRegistry"))):
+                out.append(
+                    f"{rel_path}:{node.lineno}: MetricsRegistry() outside "
+                    f"photon_ml_tpu/telemetry/ — the process-global "
+                    f"default_registry() is the only sanctioned registry "
+                    f"outside tests; a private one forks the namespace "
+                    f"away from /metrics and the fleet fold")
     return out
 
 

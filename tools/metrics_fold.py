@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Offline fleet-metrics fold for a completed ``--telemetry-dir`` run.
+
+A ``--multihost`` run dumps one ``metrics.prom`` per process (the chief's
+under ``DIR/``, workers under ``DIR/workers/proc-N/``) plus per-process
+``trace.jsonl`` span files. This tool folds them after the fact:
+
+- ``DIR/metrics.aggregate.prom`` — counters and histogram
+  ``_bucket``/``_sum``/``_count`` series summed across processes, gauges by
+  owner semantics (chief wins; per-host gauges carry a ``process`` label
+  and fan out). The fold is the SAME code path the in-training collective
+  uses (``photon_ml_tpu/telemetry/aggregate.py``), fed the same snapshot
+  texts in the same process order — so re-folding the dumps of a
+  ``--metrics-port`` run reproduces its ``metrics.aggregate.prom``
+  byte-for-byte.
+- ``DIR/trace.merged.jsonl`` — every process's spans on one wall-clock
+  timeline, each record tagged ``"process": N`` (span ids stay
+  per-process; the merged key is ``(process, span_id)``), so cross-host
+  sweep skew is visible in a single file.
+
+Usage::
+
+    python tools/metrics_fold.py DIR [--output AGG.prom] [--no-traces]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from photon_ml_tpu.telemetry.aggregate import (  # noqa: E402
+    aggregate_text,
+    merge_trace_files,
+)
+
+
+def worker_dirs(run_dir: str) -> list[tuple[int, str]]:
+    """``(process_index, dir)`` for every ``workers/proc-N`` subdir, in
+    process order (the order the live fold gathers in)."""
+    out = []
+    root = os.path.join(run_dir, "workers")
+    if os.path.isdir(root):
+        for name in os.listdir(root):
+            if not name.startswith("proc-"):
+                continue
+            try:
+                pid = int(name[len("proc-"):])
+            except ValueError:
+                continue
+            out.append((pid, os.path.join(root, name)))
+    return sorted(out)
+
+
+def _snapshot_paths(run_dir: str, filename: str) -> list[tuple[int, str]]:
+    return [(0, os.path.join(run_dir, filename))] + [
+        (pid, os.path.join(d, filename)) for pid, d in worker_dirs(run_dir)]
+
+
+def _write_atomic(path: str, text: str) -> str:
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.write(text)
+    os.replace(tmp, path)
+    return path
+
+
+def fold_metrics(run_dir: str, output: Optional[str] = None) -> str:
+    """Merge ``metrics.prom`` + ``workers/proc-N/metrics.prom`` into
+    ``metrics.aggregate.prom`` (or ``output``); returns the written path."""
+    texts = []
+    for pid, path in _snapshot_paths(run_dir, "metrics.prom"):
+        if not os.path.exists(path):
+            raise FileNotFoundError(
+                f"no metrics.prom for process {pid} at {path!r} — was the "
+                f"run started with --telemetry-dir on every process?")
+        with open(path, encoding="utf-8") as f:
+            texts.append(f.read())
+    return _write_atomic(
+        output or os.path.join(run_dir, "metrics.aggregate.prom"),
+        aggregate_text(texts))
+
+
+def fold_traces(run_dir: str, output: Optional[str] = None) -> Optional[str]:
+    """Merge per-process ``trace.jsonl`` files into ``trace.merged.jsonl``;
+    returns the written path, or None when the run produced no traces."""
+    import json
+
+    paths = [(pid, p) for pid, p in _snapshot_paths(run_dir, "trace.jsonl")
+             if os.path.exists(p)]
+    if not paths:
+        return None
+    records = merge_trace_files(paths)
+    out = output or os.path.join(run_dir, "trace.merged.jsonl")
+    tmp = out + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+    os.replace(tmp, out)
+    return out
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Fold a multi-host run's per-process metrics.prom "
+                    "dumps (and trace.jsonl files) into one aggregate")
+    parser.add_argument("run_dir", help="the run's --telemetry-dir")
+    parser.add_argument("--output", default=None,
+                        help="aggregate output path (default: "
+                             "RUN_DIR/metrics.aggregate.prom)")
+    parser.add_argument("--no-traces", action="store_true",
+                        help="skip the trace.jsonl merge")
+    args = parser.parse_args(argv)
+    n_workers = len(worker_dirs(args.run_dir))
+    agg = fold_metrics(args.run_dir, args.output)
+    print(f"folded {1 + n_workers} process snapshot(s) -> {agg}")
+    if not args.no_traces:
+        merged = fold_traces(args.run_dir)
+        if merged:
+            print(f"merged traces -> {merged}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
