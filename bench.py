@@ -119,7 +119,7 @@ def _probe_op():
     return float(jax.jit(lambda a: a * 2.0)(jnp.float32(1.0)))
 
 
-def _probe_device(deadline_s: float = 300.0):
+def _probe_device(deadline_s: "float | None" = None):
     """Fail LOUDLY if the accelerator is unreachable instead of hanging.
 
     The device tunnel occasionally goes hard-down: the first device call
@@ -129,9 +129,18 @@ def _probe_device(deadline_s: float = 300.0):
     This runs a trivial round-trip on the main thread under a watchdog
     thread; a healthy device finishes it in seconds (~20-40 s on a cold
     compile cache). On deadline the watchdog prints a terminal
-    suite_summary line that NAMES the environment failure, so the
-    recorded artifact distinguishes "device unreachable" from "code
-    broken", then exits 3."""
+    suite_summary line that NAMES the environment failure — the
+    structured `error` + rc=3 shape `tools/bench_gate.py` classifies as
+    `infra-failure` — then exits 3.
+
+    The deadline defaults to 90 s (`PHOTON_BENCH_PROBE_TIMEOUT_S` to
+    override): comfortably above the ~20-40 s healthy cold-cache probe,
+    and well under the 300 s a dead tunnel used to burn before r05's
+    artifact said anything (BENCH_r05.json: 300 s of silence for a
+    tunnel that was down from second one)."""
+    if deadline_s is None:
+        deadline_s = float(os.environ.get(
+            "PHOTON_BENCH_PROBE_TIMEOUT_S", 90.0))
     done = threading.Event()
 
     def _watch():

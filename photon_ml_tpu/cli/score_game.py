@@ -51,6 +51,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "is computed on the globally gathered scores — the "
                         "reference's per-partition scoring map + shuffle-"
                         "side evaluation (GameScoringDriver.scala)")
+    from photon_ml_tpu.cli.config import add_telemetry_flags
+
+    # --telemetry-dir / --telemetry-poll-s / --metrics-port: batch scoring
+    # gets the same spans, metrics.prom and compile accounting as the
+    # training and serving drivers
+    add_telemetry_flags(p)
     return p
 
 
@@ -65,11 +71,29 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
         multihost.initialize(auto=True)
     import jax
 
+    from photon_ml_tpu.cli.config import (
+        install_telemetry,
+        telemetry_from_args,
+    )
+
     multiproc = args.multihost and jax.process_count() > 1
     chief = jax.process_index() == 0
     log_dir = args.output_dir if chief else os.path.join(
         args.output_dir, "workers", f"proc-{jax.process_index()}")
     run_logger = RunLogger(log_dir)
+    # telemetry before the first stage, so every timed() section lands in
+    # the span tree; non-chief processes trace under workers/proc-N (same
+    # rule as photon.log)
+    telemetry = install_telemetry(telemetry_from_args(
+        args, subdir=None if chief
+        else os.path.join("workers", f"proc-{jax.process_index()}")))
+    from photon_ml_tpu.telemetry import emit_build_info, tracing
+
+    emit_build_info()
+    import contextlib as _contextlib
+
+    _root_span = _contextlib.ExitStack()
+    _root_span.enter_context(tracing.span("score_game"))
     try:
         from photon_ml_tpu.io import (
             find_feature_index_dir,
@@ -203,6 +227,8 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
         return {"n_scored": n_scored, "evaluation": evaluation,
                 "output_dir": args.output_dir}
     finally:
+        _root_span.close()
+        telemetry.close()
         run_logger.close()
 
 

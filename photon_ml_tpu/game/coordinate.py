@@ -33,6 +33,7 @@ from photon_ml_tpu.models.glm import GeneralizedLinearModel
 from photon_ml_tpu.ops.losses import loss_for_task
 from photon_ml_tpu.ops.objective import GLMObjective
 from photon_ml_tpu.sampling import DownSampler
+from photon_ml_tpu.telemetry import profiling
 from photon_ml_tpu.types import TaskType
 
 CoordinateModel = Union[FixedEffectModel, RandomEffectModel]
@@ -45,18 +46,21 @@ def _fixed_train_fn(task: TaskType, config: GLMOptimizationConfiguration):
     ``fused=True`` engages the one-pass Pallas value+grad (and Hvp) kernels
     on TPU for dense designs (transparent fallback otherwise —
     ops/pallas_glm.py). The mesh-sharded variant below enables them inside
-    its shard_map bodies too, both validated on-chip through a mesh."""
+    its shard_map bodies too, both validated on-chip through a mesh.
+    ``profile_jit`` (vs a bare ``jax.jit``) adds the compile/execute
+    accounting the flat-recompile contract asserts on — the solve program
+    must compile once per (task, config, shapes) and never again across
+    sweeps or grid points."""
     problem = OptimizationProblem(
         GLMObjective(loss=loss_for_task(task), fused=True), config)
 
-    @jax.jit
     def train(data, w0, lam):
         result = problem.run(data, w0, lam)
         variances = problem.compute_variances(result.w, data, lam)
         scores = data.design.matvec(result.w)
         return result, variances, scores
 
-    return train
+    return profiling.profile_jit(train, "game.fixed_effect")
 
 
 @lru_cache(maxsize=None)
@@ -76,7 +80,6 @@ def _fixed_train_fn_dist(task: TaskType, config: GLMOptimizationConfiguration,
         mesh=mesh)
     problem = OptimizationProblem(dist, config)
 
-    @jax.jit
     def train(data, w0, lam):
         result = problem.run(data, w0, lam)
         variances = problem.compute_variances(result.w, data, lam)
@@ -86,7 +89,7 @@ def _fixed_train_fn_dist(task: TaskType, config: GLMOptimizationConfiguration,
         scores = dist.margins(result.w, no_off)  # (n_shards, per)
         return result, variances, scores
 
-    return train
+    return profiling.profile_jit(train, "game.fixed_effect.dist")
 
 
 @lru_cache(maxsize=None)
@@ -102,11 +105,10 @@ def _factored_projection_cache(task: TaskType,
         objective=GLMObjective(loss=loss_for_task(task)), mesh=mesh)
     problem = OptimizationProblem(dist, config)
 
-    @jax.jit
     def run(data, w0, lam):
         return problem.run(data, w0, lam)
 
-    return run
+    return profiling.profile_jit(run, "game.factored_projection")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -15,7 +15,6 @@ from __future__ import annotations
 
 import dataclasses
 import logging
-import time
 from typing import Mapping, Optional, Sequence
 
 import numpy as np
@@ -25,9 +24,19 @@ from photon_ml_tpu.game.coordinate import Coordinate, CoordinateModel
 from photon_ml_tpu.game.data import GameData
 from photon_ml_tpu.game.model import GameModel
 from photon_ml_tpu.resilience import fault_point, fault_value
+from photon_ml_tpu.telemetry import metrics as _tmetrics
 from photon_ml_tpu.types import TaskType
 
 logger = logging.getLogger(__name__)
+
+#: host-side dispatch wall per coordinate step (device work may still be in
+#: flight — async dispatch is what lets the next coordinate's host prep
+#: overlap; the sweep span is the honest total). A registry timer, not a
+#: raw perf_counter pair, so the number lands in /metrics (hygiene rule 5).
+_STEP_DISPATCH = _tmetrics.histogram(
+    "photon_game_step_dispatch_seconds",
+    "Host-side dispatch wall per committed coordinate-descent step "
+    "(async: device work may continue past it)", labels=("coordinate",))
 
 
 from collections.abc import Mapping as _Mapping
@@ -244,7 +253,15 @@ class CoordinateDescent:
         final_evaluation = None
         for sweep in range(start_sweep, self.n_iterations):
             fault_point("worker.stall", sweep=sweep)
-            with tracing.span("cd.sweep", sweep=sweep):
+            with tracing.span("cd.sweep", sweep=sweep) as sweep_span:
+                if telemetry_on:
+                    # the training flat-recompile contract, trace-visible:
+                    # every cd.sweep span carries the number of profiled-jit
+                    # compiles it triggered — 0 for every sweep after the
+                    # first (tests/test_telemetry.py hard-asserts this)
+                    from photon_ml_tpu.telemetry import profiling
+
+                    _compiles_at_sweep_start = profiling.total_compiles()
                 for ci, cid in enumerate(self.update_sequence):
                     if sweep == start_sweep and ci < start_coord:
                         continue
@@ -258,8 +275,9 @@ class CoordinateDescent:
                         # its new regularization may well not diverge.
                         continue
                     with tracing.span("cd.step", coordinate=cid,
-                                      sweep=sweep) as step_span:
-                        t0 = time.perf_counter()
+                                      sweep=sweep) as step_span, \
+                            _STEP_DISPATCH.labels(
+                                coordinate=cid).time() as dispatch_timer:
                         while True:
                             residual = total - scores[cid]
                             try:
@@ -331,10 +349,11 @@ class CoordinateDescent:
                         # dispatch time: device work may still be in flight
                         # (async dispatch is what lets the next coordinate's
                         # host prep overlap); the sweep wall is the honest
-                        # total
+                        # total. The timer's running read keeps the log line
+                        # inside the step without a second clock.
                         logger.info(
                             "sweep %d coordinate %s dispatched in %.2fs",
-                            sweep, cid, time.perf_counter() - t0)
+                            sweep, cid, dispatch_timer.elapsed())
                         if checkpoint is not None:
                             from photon_ml_tpu.io.checkpoint import (
                                 CoordinateDescentState,
@@ -365,6 +384,9 @@ class CoordinateDescent:
                     history.append(results.as_dict())
                     final_evaluation = results
                     logger.info("sweep %d validation: %s", sweep, results)
+                if telemetry_on:
+                    sweep_span.set(compiles=profiling.total_compiles()
+                                   - _compiles_at_sweep_start)
             # fleet-metrics fold point (no-op unless --metrics-port
             # installed a hook; placed outside the cd.sweep span so the
             # fold's own wall time never pollutes the sweep timing)

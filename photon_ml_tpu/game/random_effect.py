@@ -42,6 +42,7 @@ from photon_ml_tpu.ops.design import DenseDesign
 from photon_ml_tpu.ops.losses import loss_for_task
 from photon_ml_tpu.ops.objective import GLMData, GLMObjective
 from photon_ml_tpu.parallel.mesh import ENTITY_AXIS
+from photon_ml_tpu.telemetry import profiling
 from photon_ml_tpu.types import TaskType, VarianceComputationType
 
 
@@ -107,34 +108,13 @@ class RandomEffectSolver:
         objective = GLMObjective(loss=loss_for_task(self.task))
         return OptimizationProblem(objective, self.config)
 
-    @partial(jax.jit, static_argnames=("self",))
     def _solve_bucket(self, x, labels, offsets, weights, w0, lam):
-        """Batched solve: x (E,S,D), labels/offsets/weights (E,S), w0 (E,D)."""
-        problem = self._problem()
+        """Batched solve: x (E,S,D), labels/offsets/weights (E,S), w0 (E,D).
 
-        def solve_one(xe, ye, oe, we, w0e, lam_):
-            data = GLMData(design=DenseDesign(x=xe), labels=ye,
-                           offsets=oe, weights=we)
-            result = problem.run(data, w0e, lam_)
-            variances = problem.compute_variances(result.w, data, lam_)
-            if variances is None:
-                variances = jnp.zeros((0,), xe.dtype)
-            return result.w, variances, result.converged
-
-        batch = jax.vmap(solve_one, in_axes=(0, 0, 0, 0, 0, None))
-        if self.mesh is None:
-            return batch(x, labels, offsets, weights, w0, lam)
-        # Entity-parallel: each device solves its contiguous slice of lanes.
-        # No collectives in the body — independence is the whole point.
-        s = P(self.entity_axis)
-        # check_vma off: the body is collective-free by construction, and the
-        # optimizers' constant-initialized while_loop carries would otherwise
-        # trip the varying-axis check against lane-varying outputs.
-        return shard_map(
-            batch, mesh=self.mesh,
-            in_specs=(s, s, s, s, s, P()),
-            out_specs=(s, s, s), check_vma=False,
-        )(x, labels, offsets, weights, w0, lam)
+        Dispatches the module-level profiled jit (compile/execute
+        accounting under ``fn="game.re.solve_bucket"``); inside the fused
+        sweep trace it inlines instead (tracer passthrough)."""
+        return _solve_bucket_jit(self, x, labels, offsets, weights, w0, lam)
 
     def _put(self, a, pad_value=0):
         """Pad the entity dim to the mesh axis size and shard lanes over it.
@@ -288,10 +268,12 @@ class RandomEffectSolver:
         return jnp.einsum("esd,ed->es", x, w,
                           preferred_element_type=jnp.float32)
 
-    @partial(jax.jit, static_argnames=("self", "e_reals", "out_sharding"))
     def _sweep_fused(self, offsets_dev, lam, statics, warm_ctxs, coeffs_warm,
                      cidxs, e_reals, out_sharding=None):
-        """One program for the WHOLE coordinate sweep: per bucket, gather
+        """One program for the WHOLE coordinate sweep (dispatched through
+        the module-level profiled jit, ``fn="game.re.sweep_fused"`` — the
+        per-coordinate compile counter the flat-recompile contract watches):
+        per bucket, gather
         residual offsets, gather warm starts from the previous sweep's
         coefficient table, solve, compute margins, scatter into the score
         vector; plus the flat coefficient/variance table for the single
@@ -313,36 +295,9 @@ class RandomEffectSolver:
         way, and gathering inside the program instead re-paid the gather
         every solve (measured 3x on the 10M-row RE bench).
         """
-        scores = jnp.zeros_like(offsets_dev)
-        flat_w: list[jnp.ndarray] = []
-        flat_v: list[jnp.ndarray] = []
-        coef_parts: list[jnp.ndarray] = []
-        for statics_k, (pos_d, found_d), cidx, \
-                e_real in zip(statics, warm_ctxs, cidxs, e_reals):
-            x_d, lab_d, wt_d, idx_d, store_d = statics_k
-            boff = jnp.take(offsets_dev, idx_d.reshape(-1),
-                            mode="clip").reshape(idx_d.shape) * (wt_d > 0)
-            w0 = jnp.where(
-                found_d,
-                jnp.take(coeffs_warm, pos_d.reshape(-1),
-                         mode="clip").reshape(pos_d.shape),
-                0.0).astype(jnp.float32)
-            w_dev, variances, _conv = self._solve_bucket(
-                x_d, lab_d, boff, wt_d, w0, lam)
-            margins = self._margins_bucket(x_d, w_dev)[:e_real]
-            scores = scores.at[store_d].set(margins, mode="drop")
-            flat_w.append(w_dev[:e_real].reshape(-1))
-            flat_v.append(jnp.asarray(variances)[:e_real].reshape(-1))
-            coef_parts.append(
-                w_dev[:e_real].reshape(-1)[cidx].astype(jnp.float32))
-        if out_sharding is not None:
-            # keep the score vector in the caller's (e.g. data-axis) layout:
-            # without the constraint GSPMD replicates the scatter output,
-            # silently un-sharding the CD score decomposition
-            # (tests/test_sharded_scores.py — ROADMAP item 5 prototype)
-            scores = jax.lax.with_sharding_constraint(scores, out_sharding)
-        batched = jnp.concatenate(flat_w + flat_v)
-        return scores, batched, jnp.concatenate(coef_parts)
+        return _sweep_fused_jit(self, offsets_dev, lam, statics, warm_ctxs,
+                                coeffs_warm, cidxs, e_reals,
+                                out_sharding=out_sharding)
 
     def _warm_ctx(self, dataset: RandomEffectDataset, i: int,
                   bucket: REBucket, warm: Optional[RandomEffectModel],
@@ -785,6 +740,84 @@ class RandomEffectSolver:
             projector=dataset.projector,
             coeffs_device=coeffs_device)
         return model, scores
+
+
+def _solve_bucket_impl(solver, x, labels, offsets, weights, w0, lam):
+    """Batched bucket solve body (the traced program behind
+    :meth:`RandomEffectSolver._solve_bucket`)."""
+    problem = solver._problem()
+
+    def solve_one(xe, ye, oe, we, w0e, lam_):
+        data = GLMData(design=DenseDesign(x=xe), labels=ye,
+                       offsets=oe, weights=we)
+        result = problem.run(data, w0e, lam_)
+        variances = problem.compute_variances(result.w, data, lam_)
+        if variances is None:
+            variances = jnp.zeros((0,), xe.dtype)
+        return result.w, variances, result.converged
+
+    batch = jax.vmap(solve_one, in_axes=(0, 0, 0, 0, 0, None))
+    if solver.mesh is None:
+        return batch(x, labels, offsets, weights, w0, lam)
+    # Entity-parallel: each device solves its contiguous slice of lanes.
+    # No collectives in the body — independence is the whole point.
+    s = P(solver.entity_axis)
+    # check_vma off: the body is collective-free by construction, and the
+    # optimizers' constant-initialized while_loop carries would otherwise
+    # trip the varying-axis check against lane-varying outputs.
+    return shard_map(
+        batch, mesh=solver.mesh,
+        in_specs=(s, s, s, s, s, P()),
+        out_specs=(s, s, s), check_vma=False,
+    )(x, labels, offsets, weights, w0, lam)
+
+
+def _sweep_fused_impl(solver, offsets_dev, lam, statics, warm_ctxs,
+                      coeffs_warm, cidxs, e_reals, out_sharding=None):
+    """Fused whole-coordinate sweep body (the traced program behind
+    :meth:`RandomEffectSolver._sweep_fused`; semantics documented there)."""
+    scores = jnp.zeros_like(offsets_dev)
+    flat_w: list[jnp.ndarray] = []
+    flat_v: list[jnp.ndarray] = []
+    coef_parts: list[jnp.ndarray] = []
+    for statics_k, (pos_d, found_d), cidx, \
+            e_real in zip(statics, warm_ctxs, cidxs, e_reals):
+        x_d, lab_d, wt_d, idx_d, store_d = statics_k
+        boff = jnp.take(offsets_dev, idx_d.reshape(-1),
+                        mode="clip").reshape(idx_d.shape) * (wt_d > 0)
+        w0 = jnp.where(
+            found_d,
+            jnp.take(coeffs_warm, pos_d.reshape(-1),
+                     mode="clip").reshape(pos_d.shape),
+            0.0).astype(jnp.float32)
+        w_dev, variances, _conv = solver._solve_bucket(
+            x_d, lab_d, boff, wt_d, w0, lam)
+        margins = solver._margins_bucket(x_d, w_dev)[:e_real]
+        scores = scores.at[store_d].set(margins, mode="drop")
+        flat_w.append(w_dev[:e_real].reshape(-1))
+        flat_v.append(jnp.asarray(variances)[:e_real].reshape(-1))
+        coef_parts.append(
+            w_dev[:e_real].reshape(-1)[cidx].astype(jnp.float32))
+    if out_sharding is not None:
+        # keep the score vector in the caller's (e.g. data-axis) layout:
+        # without the constraint GSPMD replicates the scatter output,
+        # silently un-sharding the CD score decomposition
+        # (tests/test_sharded_scores.py — ROADMAP item 5 prototype)
+        scores = jax.lax.with_sharding_constraint(scores, out_sharding)
+    batched = jnp.concatenate(flat_w + flat_v)
+    return scores, batched, jnp.concatenate(coef_parts)
+
+
+#: the profiled executables behind the solver methods: module-level so the
+#: per-signature compiled cache (and its compile accounting) is shared by
+#: every solver instance of a process — RandomEffectSolver is a frozen
+#: value-equal dataclass, so the ``solver`` static keys by configuration,
+#: exactly like the old per-method jit cache
+_solve_bucket_jit = profiling.profile_jit(
+    _solve_bucket_impl, "game.re.solve_bucket", static_argnames=("solver",))
+_sweep_fused_jit = profiling.profile_jit(
+    _sweep_fused_impl, "game.re.sweep_fused",
+    static_argnames=("solver", "e_reals", "out_sharding"))
 
 
 @partial(jax.jit, static_argnames=("n", "S", "identity_cols"))

@@ -106,7 +106,12 @@ def train_glm_sweep(
         config.regularization.check_weight(lam)
     problem = build_problem(task, config, normalization, reg_mask, mesh=mesh)
 
-    run = jax.jit(problem.run)
+    from photon_ml_tpu.telemetry import profiling
+
+    # one compile serves the whole lambda sweep (lambda is a traced
+    # scalar); profile_jit makes that visible — photon_compiles_total
+    # {fn="glm.sweep_solve"} must move once per sweep, not per lambda
+    run = profiling.profile_jit(problem.run, "glm.sweep_solve")
     d = data.dim if dim is None else dim
     w = jnp.zeros((d,)) if initial is None else jnp.asarray(initial)
 
@@ -173,10 +178,14 @@ def train_glm_sweep_batched(
     problem = build_problem(task, config, normalization, reg_mask)
     lams = sorted((float(l) for l in regularization_weights), reverse=True)
 
+    from photon_ml_tpu.telemetry import profiling
+
     # data/w0 as explicit unbatched args (in_axes=None), NOT a closure: a
     # closed-over device array becomes an HLO constant — a GB-scale design
     # baked into the program (and rejected by remote-compile size limits)
-    run = jax.jit(jax.vmap(problem.run, in_axes=(None, None, 0)))
+    run = profiling.profile_jit(
+        jax.vmap(problem.run, in_axes=(None, None, 0)),
+        "glm.sweep_solve_batched")
     batched = run(data, jnp.zeros((data.dim,)),
                   jnp.asarray(lams, jnp.float32))
 

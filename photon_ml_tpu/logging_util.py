@@ -151,12 +151,15 @@ def timed(stage: str, run_logger: Optional[RunLogger] = None) -> Iterator[None]:
 
     logger.info("%s: start", stage)
     GLOBAL_BUS.post("stage_started", stage=stage)
-    t0 = time.perf_counter()
+    sp = None
     try:
-        with span(stage, kind="stage"):
+        with span(stage, kind="stage") as sp:
             yield
     finally:
-        dt = time.perf_counter() - t0
+        # the span IS the stage clock (telemetry hygiene rule 5: one
+        # timing source, visible in trace.jsonl) — read its seconds
+        # instead of running a second perf_counter pair
+        dt = sp.seconds if sp is not None else 0.0
         logger.info("%s: done in %.2fs", stage, dt)
         GLOBAL_BUS.post("stage_finished", stage=stage, seconds=dt)
         if run_logger is not None:

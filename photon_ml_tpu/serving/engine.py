@@ -37,16 +37,20 @@ from photon_ml_tpu.io.index import IndexMap
 from photon_ml_tpu.types import INTERCEPT_KEY
 from photon_ml_tpu.serving.store import EntityCoefficientStore
 from photon_ml_tpu.telemetry import metrics as _metrics
+from photon_ml_tpu.telemetry import profiling as _profiling
 
-#: one XLA trace of the scoring program — constant after warmup (the
-#: zero-recompile contract the bench and the /metrics scrape both watch)
-_RECOMPILES = _metrics.counter(
-    "photon_serving_recompiles_total",
-    "XLA traces of the scoring program (constant after warmup)")
 #: engine-side scoring latency per padded bucket shape (dispatch + D2H)
 _SCORE_LATENCY = _metrics.histogram(
     "photon_serving_score_latency_seconds",
     "Engine scoring time per padded batch bucket", labels=("bucket",))
+
+#: the fn label serving's traces count under — the SAME
+#: ``photon_compiles_total{fn}`` family the training paths use
+#: (telemetry/profiling.py), so one scrape expression covers every
+#: recompile contract in the system. The engine keeps its own jit (the
+#: power-of-two bucket machinery IS the zero-recompile design) and counts
+#: traces from inside the traced body via ``profiling.record_compile``.
+SCORING_FN_LABEL = "serving.score"
 
 
 def next_bucket(n: int) -> int:
@@ -117,7 +121,7 @@ class ScoringEngine:
             # body runs at TRACE time only — one increment per compiled
             # bucket shape, the recompile counter the serving bench asserts
             self._compile_count += 1
-            _RECOMPILES.inc()
+            _profiling.record_compile(SCORING_FN_LABEL)
             margins = []
             i_x = {sid: i for i, sid in enumerate(self._shard_order)}
             i_r = {cid: i for i, cid in enumerate(self._re_order)}
@@ -136,9 +140,12 @@ class ScoringEngine:
     # --- stats ------------------------------------------------------------
     @property
     def compile_count(self) -> int:
-        """Distinct jitted traces so far (== XLA compiles of the scoring
-        program). Constant after :meth:`warmup` — the zero-recompile
-        contract."""
+        """Distinct jitted traces of THIS engine so far (== XLA compiles of
+        its scoring program). Constant after :meth:`warmup` — the
+        zero-recompile contract. The process-wide scrape equivalent is
+        ``photon_compiles_total{fn="serving.score"}`` (which sums across
+        hot-swapped engines; this per-engine attribute backs the
+        bench_serving parity assert)."""
         return self._compile_count
 
     @property

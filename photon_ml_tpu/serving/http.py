@@ -57,7 +57,9 @@ class ServingService:
         self._lock = threading.Lock()
         self.n_requests = 0
         self.n_scored = 0
-        self.started_at = time.time()
+        # monotonic: uptime is a DURATION (immune to wall-clock jumps, and
+        # telemetry hygiene rule 5 bans wall-clock arithmetic for durations)
+        self._started_monotonic = time.monotonic()
 
     # --- endpoints --------------------------------------------------------
     def score(self, payload: dict) -> dict:
@@ -94,7 +96,7 @@ class ServingService:
                          else active.engine.compile_count),
             "requests": self.n_requests,
             "scored": self.n_scored,
-            "uptime_s": round(time.time() - self.started_at, 1),
+            "uptime_s": round(time.monotonic() - self._started_monotonic, 1),
         }
 
     def reload(self, payload: dict) -> dict:

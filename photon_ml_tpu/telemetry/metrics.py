@@ -62,6 +62,11 @@ class _Timer:
         self._t0 = time.perf_counter()
         return self
 
+    def elapsed(self) -> float:
+        """Running read of the open timer (for a mid-region log line) —
+        the observation itself still happens once, at exit."""
+        return time.perf_counter() - self._t0
+
     def __exit__(self, *exc) -> None:
         self.seconds = time.perf_counter() - self._t0
         self._hist.observe(self.seconds)

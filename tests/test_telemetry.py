@@ -555,6 +555,70 @@ class TestTrainGameTelemetry:
         assert not tracing.enabled()  # session closed its sink
 
 
+class TestTrainGameProfiling:
+    """The PR-5 acceptance contract: a --telemetry-dir train_game run
+    exposes the compile/cost accounting, the compile counter goes flat
+    after sweep 1, and perf_report renders the run's artifacts."""
+
+    def _parsed(self, telemetry_run):
+        path = os.path.join(telemetry_run["telemetry_dir"], "metrics.prom")
+        return tprom.parse_text(open(path).read())
+
+    def test_compile_and_cost_families_exposed(self, telemetry_run):
+        parsed = self._parsed(telemetry_run)
+        for fn in ("game.fixed_effect", "game.re.sweep_fused"):
+            assert tprom.series_value(
+                parsed, "photon_compiles_total", {"fn": fn}) >= 1, fn
+            assert tprom.series_value(
+                parsed, "photon_compile_seconds_total", {"fn": fn}) > 0, fn
+            # XLA's CPU cost model prices both solve programs
+            assert tprom.series_value(
+                parsed, "photon_flops_total", {"fn": fn}) > 0, fn
+            assert tprom.series_value(
+                parsed, "photon_bytes_accessed_total", {"fn": fn}) > 0, fn
+        # the process-wide XLA pipeline listener saw the backend compiles
+        assert tprom.series_value(
+            parsed, "photon_xla_compile_seconds_total",
+            {"phase": "backend"}) > 0
+        # dispatch timing flows through the registry histogram (rule 5)
+        assert tprom.series_value(
+            parsed, "photon_game_step_dispatch_seconds_count",
+            {"coordinate": "global"}) >= N_SWEEPS
+
+    def test_compile_counter_flat_after_first_sweep(self, telemetry_run):
+        """The training flat-recompile contract, trace-visible: every
+        cd.sweep span past the first carries compiles == 0."""
+        sweeps = sorted((s for s in telemetry_run["spans"]
+                         if s["name"] == "cd.sweep"),
+                        key=lambda s: s["sweep"])
+        assert len(sweeps) == N_SWEEPS
+        assert all("compiles" in s for s in sweeps)
+        assert sweeps[0]["compiles"] >= 1  # the cold sweep pays them all
+        for s in sweeps[1:]:
+            assert s["compiles"] == 0, \
+                f"sweep {s['sweep']} recompiled {s['compiles']} programs"
+
+    def test_perf_report_renders_run_artifacts(self, telemetry_run):
+        import sys as _sys
+
+        _sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools"))
+        import perf_report
+
+        trace_path, prom_path = perf_report.resolve_inputs(
+            telemetry_run["telemetry_dir"])
+        spans = perf_report.load_spans(trace_path)
+        report = perf_report.build_report(spans, open(prom_path).read())
+        assert "critical path" in report
+        assert "cd.step{coordinate=global}" in report
+        assert "game.fixed_effect" in report
+        assert "per-coordinate" in report
+        # the report is a pure function of the artifacts
+        assert report == perf_report.build_report(
+            spans, open(prom_path).read())
+
+
 class TestServeGameMetricsEndpoint:
     def _get(self, url):
         with urllib.request.urlopen(url, timeout=60) as resp:
@@ -583,7 +647,9 @@ class TestServeGameMetricsEndpoint:
             m0 = tprom.parse_text(self._get(base + "/metrics"))
             assert tprom.series_value(
                 m0, "photon_model_active_version") >= 1
-            assert "photon_serving_recompiles_total" in m0
+            # serving traces count under the system-wide compile family
+            assert tprom.series_value(
+                m0, "photon_compiles_total", {"fn": "serving.score"}) >= 1
             assert "photon_serving_request_latency_seconds_bucket" in m0
 
             recs = _records(8, seed=11)
@@ -598,7 +664,8 @@ class TestServeGameMetricsEndpoint:
 
             # zero-recompile contract, scrape-visible: warmup pre-traced
             # every bucket, so varied request sizes move nothing
-            assert delta("photon_serving_recompiles_total") == 0
+            assert delta("photon_compiles_total",
+                         {"fn": "serving.score"}) == 0
             assert delta("photon_serving_requests_total") == 5
             assert delta("photon_serving_scored_rows_total") == 1 + 2 + 3 + 5 + 8
             assert delta(

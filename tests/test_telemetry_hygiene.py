@@ -1,8 +1,9 @@
 """Tier-1 wrapper for ``tools/check_telemetry_hygiene.py`` (no ``print(``
-outside CLI entry points; no ``time.perf_counter`` in serving/ — latency
-measurement must go through the metrics registry or a span; metric names
-match ``photon_[a-z0-9_]+`` with non-empty help; no ``MetricsRegistry``
-constructed outside ``photon_ml_tpu/telemetry/``)."""
+outside CLI entry points; no ``time.perf_counter`` outside telemetry/ and
+no wall-clock duration arithmetic — duration measurement must go through
+the metrics registry or a span; metric names match ``photon_[a-z0-9_]+``
+with non-empty help; no ``MetricsRegistry`` constructed outside
+``photon_ml_tpu/telemetry/``)."""
 
 import os
 import sys
@@ -42,20 +43,46 @@ def test_cli_entry_points_may_print(rel):
     ("import time as t\nt.perf_counter()\n", 1),
     ("from time import perf_counter\nperf_counter()\n", 1),
     ("from time import perf_counter as pc\npc()\n", 1),
-    # scheduling clocks stay legal in serving/: deadlines and timestamps
-    # are not latency measurements
+    # scheduling clocks stay legal: deadlines and timestamps are not
+    # duration measurements
     ("import time\ntime.monotonic()\n", 0),
     ("import time\ntime.time()\n", 0),
 ])
-def test_perf_counter_detector_in_serving(snippet, n):
-    rel = os.path.join("photon_ml_tpu", "serving", "x.py")
+@pytest.mark.parametrize("subdir", ["serving", "game", "glm", "io"])
+def test_perf_counter_detector_package_wide(snippet, n, subdir):
+    # rule 5 extended the original serving-only ban package-wide: the
+    # sanctioned timers (Histogram.time(), spans) live in telemetry/
+    rel = os.path.join("photon_ml_tpu", subdir, "x.py")
     assert len(hygiene.check_source(snippet, rel)) == n
 
 
-def test_perf_counter_legal_outside_serving():
+def test_perf_counter_legal_inside_telemetry():
     src = "import time\ntime.perf_counter()\n"
     assert hygiene.check_source(
-        src, os.path.join("photon_ml_tpu", "game", "x.py")) == []
+        src, os.path.join("photon_ml_tpu", "telemetry", "x.py")) == []
+
+
+@pytest.mark.parametrize("snippet, n", [
+    # a duration from the wall clock, either operand order
+    ("import time\nt0 = time.time()\nd = time.time() - t0\n", 1),
+    ("import time\nd = 5.0 - time.time()\n", 1),
+    ("import time as t\nd = t.time() - 1.0\n", 1),
+    ("from time import time as now\nd = now() - 1.0\n", 1),
+    # timestamps alone are fine; monotonic arithmetic is fine
+    ("import time\nts = time.time()\n", 0),
+    ("import time\nd = time.monotonic() - 1.0\n", 0),
+    # a method NAMED time on another object must not trip the check
+    ("h.time() - 1.0\n", 0),
+])
+def test_wall_clock_duration_detector(snippet, n):
+    rel = os.path.join("photon_ml_tpu", "game", "x.py")
+    assert len(hygiene.check_source(snippet, rel)) == n
+
+
+def test_wall_clock_duration_legal_inside_telemetry():
+    src = "import time\nd = time.time() - 1.0\n"
+    assert hygiene.check_source(
+        src, os.path.join("photon_ml_tpu", "telemetry", "x.py")) == []
 
 
 @pytest.mark.parametrize("snippet, n", [

@@ -246,9 +246,9 @@ def main(argv=None):
         from photon_ml_tpu.telemetry.metrics import quantile_from_buckets
         from photon_ml_tpu.telemetry.prometheus import series_value
 
-        def delta(name):
-            return (series_value(metrics1, name)
-                    - series_value(metrics0 or {}, name))
+        def delta(name, labels=None):
+            return (series_value(metrics1, name, labels)
+                    - series_value(metrics0 or {}, name, labels))
 
         # bucket series are CUMULATIVE, so their per-scrape deltas are too
         uppers, cum, hist_count = _histogram_delta(
@@ -256,7 +256,10 @@ def main(argv=None):
         q = (lambda p: round(
             quantile_from_buckets(uppers, cum, p) * 1e3, 3)) \
             if cum and cum[-1] else (lambda p: 0.0)
-        recompiles_metric = int(delta("photon_serving_recompiles_total"))
+        # the serving traces count under the system-wide compile family
+        # (telemetry/profiling.py) since the profiling layer landed
+        recompiles_metric = int(delta("photon_compiles_total",
+                                      {"fn": "serving.score"}))
         requests_metric = int(delta("photon_serving_requests_total"))
         results.append({
             "metric": "serving_metrics_scrape",

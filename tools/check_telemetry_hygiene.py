@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Static telemetry-hygiene check over ``photon_ml_tpu/``.
 
-Four rules, all load-bearing for the telemetry subsystem (the sibling of
+Five rules, all load-bearing for the telemetry subsystem (the sibling of
 ``check_resilience_hygiene.py``, same contract: run directly or through the
 tier-1 test):
 
@@ -11,13 +11,15 @@ tier-1 test):
    logs (``logging``), counts (``telemetry.metrics``), or spans
    (``telemetry.tracing``). Only the CLI drivers (``photon_ml_tpu/cli/``)
    and the module runner (``__main__.py``) own stdout.
-2. **No ``time.perf_counter`` in ``photon_ml_tpu/serving/``** — the
-   serving hot path measures latency exclusively through the registry's
-   histogram timer (``Histogram.time()``) or a tracing span, so every
-   latency number lands in ``/metrics`` with consistent clocking; an ad-hoc
-   ``perf_counter`` pair is a measurement the scrape can never see.
-   ``time.monotonic`` (deadlines) and ``time.time`` (timestamps) stay
-   legal — they are scheduling clocks, not latency measurements.
+2. **No ``time.perf_counter`` outside ``photon_ml_tpu/telemetry/``** —
+   every duration measurement routes through the registry's histogram
+   timer (``Histogram.time()``) or a tracing span, so every latency
+   number lands in ``/metrics``/``trace.jsonl`` with consistent clocking;
+   an ad-hoc ``perf_counter`` pair is a measurement the scrape can never
+   see. (Originally serving-only; the profiling layer extended it
+   package-wide — rule 5.) ``time.monotonic`` (deadlines) and
+   ``time.time`` (timestamps) stay legal — they are scheduling clocks,
+   not duration measurements.
 3. **Metric naming** — every ``counter(``/``gauge(``/``histogram(``
    registration with a literal name must match ``photon_[a-z0-9_]+`` and
    carry non-empty help text. The fleet aggregator merges snapshots by
@@ -28,6 +30,11 @@ tier-1 test):
    only sanctioned registry outside tests. A second registry silently
    forks the metric namespace and its series never reach ``/metrics`` or
    the fleet fold.
+5. **No wall-clock duration arithmetic** — a subtraction with a
+   ``time.time()`` call on either side computes a duration from the wall
+   clock: wrong under clock jumps AND invisible to telemetry. Durations
+   come from registry timers or spans; ``time.time()`` alone (a
+   timestamp) stays legal.
 
 Run directly (``python tools/check_telemetry_hygiene.py [root]``, exit 1 on
 violations) or through the tier-1 test ``tests/test_telemetry_hygiene.py``.
@@ -46,8 +53,8 @@ PRINT_ALLOWED_PREFIXES = (
 )
 PRINT_ALLOWED_FILES = {os.path.join("photon_ml_tpu", "__main__.py")}
 
-#: the subtree where latency measurement must route through telemetry
-PERF_COUNTER_BANNED_PREFIX = os.path.join("photon_ml_tpu", "serving") + os.sep
+#: the one subtree whose job IS timing: the sanctioned timers live here
+TIMING_ALLOWED_PREFIX = os.path.join("photon_ml_tpu", "telemetry") + os.sep
 
 #: the one place allowed to construct MetricsRegistry instances
 REGISTRY_ALLOWED_PREFIX = os.path.join("photon_ml_tpu", "telemetry") + os.sep
@@ -95,12 +102,13 @@ def check_source(source: str, rel_path: str) -> list[str]:
     print_ok = (rel_path in PRINT_ALLOWED_FILES
                 or any(rel_path.startswith(p)
                        for p in PRINT_ALLOWED_PREFIXES))
-    pc_banned = rel_path.startswith(PERF_COUNTER_BANNED_PREFIX)
+    pc_banned = not rel_path.startswith(TIMING_ALLOWED_PREFIX)
     registry_ok = rel_path.startswith(REGISTRY_ALLOWED_PREFIX)
 
-    # resolve what `time` / `perf_counter` are bound to in this module
+    # resolve what `time` / `perf_counter` / `time.time` are bound to
     time_aliases: set[str] = set()
     pc_names: set[str] = set()
+    tt_names: set[str] = set()  # from-imports of time.time
     metric_fn_names: set[str] = set()  # from-imports of counter/gauge/...
     for node in ast.walk(tree):
         if isinstance(node, ast.Import):
@@ -112,10 +120,21 @@ def check_source(source: str, rel_path: str) -> list[str]:
                 for a in node.names:
                     if a.name == "perf_counter":
                         pc_names.add(a.asname or "perf_counter")
+                    elif a.name == "time":
+                        tt_names.add(a.asname or "time")
             elif node.module == "photon_ml_tpu.telemetry.metrics":
                 for a in node.names:
                     if a.name in METRIC_FACTORIES:
                         metric_fn_names.add(a.asname or a.name)
+
+    def _is_wall_clock_call(node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "time":
+            return (isinstance(f.value, ast.Name)
+                    and f.value.id in time_aliases)
+        return isinstance(f, ast.Name) and f.id in tt_names
 
     out = []
     for node in ast.walk(tree):
@@ -128,10 +147,18 @@ def check_source(source: str, rel_path: str) -> list[str]:
                        f"stdout belongs to the drivers")
         elif (pc_banned
               and _is_perf_counter(node, time_aliases, pc_names)):
-            out.append(f"{rel_path}:{node.lineno}: time.perf_counter in "
-                       f"serving/ — measure latency through the metrics "
-                       f"registry's Histogram.time() or a tracing span so "
-                       f"/metrics sees it")
+            out.append(f"{rel_path}:{node.lineno}: time.perf_counter "
+                       f"outside telemetry/ — measure durations through "
+                       f"the metrics registry's Histogram.time() or a "
+                       f"tracing span so /metrics and trace.jsonl see them")
+        elif (pc_banned and isinstance(node, ast.BinOp)
+              and isinstance(node.op, ast.Sub)
+              and (_is_wall_clock_call(node.left)
+                   or _is_wall_clock_call(node.right))):
+            out.append(f"{rel_path}:{node.lineno}: duration computed from "
+                       f"time.time() — the wall clock is for timestamps "
+                       f"(it jumps); measure durations with a registry "
+                       f"timer or a tracing span")
         elif isinstance(node, ast.Call):
             func = node.func
             is_factory = (

@@ -1,0 +1,246 @@
+#!/usr/bin/env python
+"""Critical-path performance report for a ``--telemetry-dir`` run.
+
+The run's artifacts already hold everything needed to answer "where did
+the wall-clock go": ``trace.jsonl`` (the span tree — or
+``trace.merged.jsonl`` for a multi-process run) and ``metrics.prom`` (the
+registry snapshot — or ``metrics.aggregate.prom`` for the fleet fold).
+This tool renders them into one deterministic text report:
+
+- **critical path** — top-k span groups by EXCLUSIVE seconds (a span's
+  own wall minus its direct children's), so a fat parent that merely
+  contains the work doesn't mask the stage that performs it;
+- **compile vs execute** — the profiled-jit accounting
+  (``photon_compiles_total{fn}`` / ``photon_compile_seconds_total{fn}`` /
+  ``photon_execute_latency_seconds{fn}`` — telemetry/profiling.py), per
+  function and total, plus the process-wide XLA pipeline counters that
+  catch un-wrapped jits;
+- **per-coordinate table** — ``cd.step`` spans folded per coordinate with
+  the optimizer-iteration counters;
+- **FLOPs/s estimate** — ``photon_flops_total{fn}`` over the execute-sum
+  seconds (dispatch-side; a lower bound on device throughput).
+
+Usage::
+
+    python tools/perf_report.py DIR [--top K]
+
+where DIR is the run's ``--telemetry-dir``. Merged/aggregate artifacts are
+preferred automatically when present.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Mapping, Optional, Sequence
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from photon_ml_tpu.telemetry import prometheus as tprom  # noqa: E402
+
+#: span attributes that are record plumbing, not user attributes
+_RESERVED = ("name", "span_id", "parent_id", "ts", "t0", "t1", "seconds",
+             "process")
+
+
+def load_spans(path: str) -> list[dict]:
+    """Span records (``span_id`` non-null) from a trace file; annotations
+    are dropped. Each record gets a ``process`` key (0 when absent)."""
+    spans = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            if rec.get("span_id") is None:
+                continue
+            rec.setdefault("process", 0)
+            spans.append(rec)
+    return spans
+
+
+def _group_label(span: Mapping) -> str:
+    """Aggregation key for the critical path: the span name, plus the
+    coordinate attribute when present (cd.step{coordinate=global} is a
+    different line of work than cd.step{coordinate=perUser})."""
+    if "coordinate" in span:
+        return f'{span["name"]}{{coordinate={span["coordinate"]}}}'
+    return str(span["name"])
+
+
+def exclusive_seconds(spans: Sequence[Mapping]) -> dict[tuple, dict]:
+    """Per span-group: total, exclusive (total minus direct children) and
+    call count. Spans key by (process, span_id) so merged multi-process
+    traces fold correctly."""
+    child_sum: dict[tuple, float] = {}
+    for s in spans:
+        if s.get("parent_id") is not None:
+            pkey = (s["process"], s["parent_id"])
+            child_sum[pkey] = child_sum.get(pkey, 0.0) + float(s["seconds"])
+    groups: dict[tuple, dict] = {}
+    for s in spans:
+        key = (s["process"], _group_label(s))
+        g = groups.setdefault(key, {"total": 0.0, "exclusive": 0.0,
+                                    "calls": 0})
+        own = float(s["seconds"])
+        g["total"] += own
+        g["exclusive"] += max(
+            own - child_sum.get((s["process"], s["span_id"]), 0.0), 0.0)
+        g["calls"] += 1
+    return groups
+
+
+def _labeled(parsed: Mapping, series: str, label: str) -> dict[str, float]:
+    """{label value: sample value} over one series' samples."""
+    out: dict[str, float] = {}
+    for labels, value in parsed.get(series, ()):
+        if label in labels:
+            out[labels[label]] = out.get(labels[label], 0.0) + value
+    return out
+
+
+def _fmt_count(v: float) -> str:
+    """Human scale for FLOP/byte totals (deterministic, 3 significant-ish
+    digits)."""
+    for unit, div in (("T", 1e12), ("G", 1e9), ("M", 1e6), ("k", 1e3)):
+        if abs(v) >= div:
+            return f"{v / div:.2f}{unit}"
+    return f"{v:.0f}"
+
+
+def build_report(spans: Sequence[Mapping], prom_text: str,
+                 top: int = 10) -> str:
+    """The report text (the CLI prints it; tests golden-compare it)."""
+    parsed = tprom.parse_text(prom_text)
+    multi = len({s["process"] for s in spans}) > 1 if spans else False
+    lines: list[str] = ["== photon performance report =="]
+
+    roots = [s for s in spans if s.get("parent_id") is None]
+    wall = sum(float(s["seconds"]) for s in roots)
+    root_names = sorted({_group_label(s) for s in roots})
+    lines.append(f"wall {wall:.3f} s across {len(roots)} root span(s)"
+                 + (f" [{', '.join(root_names)}]" if root_names else ""))
+
+    # --- critical path ----------------------------------------------------
+    lines.append("")
+    lines.append(f"-- critical path: top {top} span groups by exclusive "
+                 f"seconds --")
+    groups = exclusive_seconds(spans)
+    header = f"{'exclusive_s':>12} {'total_s':>10} {'calls':>6}  span"
+    lines.append(header)
+    ranked = sorted(groups.items(),
+                    key=lambda kv: (-kv[1]["exclusive"], kv[0]))
+    for (process, label), g in ranked[:top]:
+        tag = f" [proc {process}]" if multi else ""
+        lines.append(f"{g['exclusive']:>12.3f} {g['total']:>10.3f} "
+                     f"{g['calls']:>6d}  {label}{tag}")
+    if not groups:
+        lines.append("  (no spans)")
+
+    # --- compile vs execute ----------------------------------------------
+    lines.append("")
+    lines.append("-- compile vs execute (profiled jits) --")
+    compiles = _labeled(parsed, "photon_compiles_total", "fn")
+    compile_s = _labeled(parsed, "photon_compile_seconds_total", "fn")
+    exec_s = _labeled(parsed, "photon_execute_latency_seconds_sum", "fn")
+    exec_n = _labeled(parsed, "photon_execute_latency_seconds_count", "fn")
+    flops = _labeled(parsed, "photon_flops_total", "fn")
+    bytes_ = _labeled(parsed, "photon_bytes_accessed_total", "fn")
+    fns = sorted(set(compiles) | set(exec_n))
+    if fns:
+        lines.append(f"{'fn':<28} {'compiles':>8} {'compile_s':>10} "
+                     f"{'execs':>7} {'execute_s':>10} {'flops':>9} "
+                     f"{'GFLOP/s':>8}")
+        for fn in fns:
+            es = exec_s.get(fn, 0.0)
+            fl = flops.get(fn, 0.0)
+            rate = (fl / es / 1e9) if es > 0 else 0.0
+            lines.append(
+                f"{fn:<28} {int(compiles.get(fn, 0)):>8d} "
+                f"{compile_s.get(fn, 0.0):>10.3f} "
+                f"{int(exec_n.get(fn, 0)):>7d} {es:>10.3f} "
+                f"{_fmt_count(fl):>9} {rate:>8.2f}")
+        tot_c, tot_e = sum(compile_s.values()), sum(exec_s.values())
+        tot_f = sum(flops.values())
+        rate = (tot_f / tot_e / 1e9) if tot_e > 0 else 0.0
+        lines.append(
+            f"{'TOTAL':<28} {int(sum(compiles.values())):>8d} "
+            f"{tot_c:>10.3f} {int(sum(exec_n.values())):>7d} "
+            f"{tot_e:>10.3f} {_fmt_count(tot_f):>9} {rate:>8.2f}")
+        if tot_c + tot_e > 0:
+            share = 100.0 * tot_c / (tot_c + tot_e)
+            lines.append(f"compile share of (compile+execute): {share:.1f}%"
+                         f"  [bytes accessed: "
+                         f"{_fmt_count(sum(bytes_.values()))}B]")
+    else:
+        lines.append("  (no profiled-jit series in snapshot)")
+    xla_n = _labeled(parsed, "photon_xla_compiles_total", "phase")
+    xla_s = _labeled(parsed, "photon_xla_compile_seconds_total", "phase")
+    if xla_s:
+        parts = ", ".join(f"{ph} {xla_s.get(ph, 0.0):.3f}s"
+                          f"/{int(xla_n.get(ph, 0))}"
+                          for ph in ("trace", "lower", "backend")
+                          if ph in xla_s or ph in xla_n)
+        lines.append(f"process-wide XLA pipeline (any jit): {parts}")
+
+    # --- per-coordinate table --------------------------------------------
+    steps = [s for s in spans if s["name"] == "cd.step"]
+    if steps:
+        lines.append("")
+        lines.append("-- coordinate descent: per-coordinate --")
+        iters = _labeled(parsed, "photon_optimizer_iterations_total",
+                         "coordinate")
+        by_cid: dict[str, list] = {}
+        for s in steps:
+            by_cid.setdefault(str(s.get("coordinate", "?")), []).append(
+                float(s["seconds"]))
+        lines.append(f"{'coordinate':<16} {'steps':>6} {'total_s':>10} "
+                     f"{'mean_s':>9} {'opt_iters':>10}")
+        for cid in sorted(by_cid):
+            ss = by_cid[cid]
+            lines.append(f"{cid:<16} {len(ss):>6d} {sum(ss):>10.3f} "
+                         f"{sum(ss) / len(ss):>9.3f} "
+                         f"{int(iters.get(cid, 0)):>10d}")
+    return "\n".join(lines) + "\n"
+
+
+def resolve_inputs(run_dir: str) -> tuple[str, str]:
+    """(trace path, metrics path), preferring the merged/aggregate
+    artifacts of a multi-process run when present."""
+    trace = os.path.join(run_dir, "trace.merged.jsonl")
+    if not os.path.exists(trace):
+        trace = os.path.join(run_dir, "trace.jsonl")
+    prom = os.path.join(run_dir, "metrics.aggregate.prom")
+    if not os.path.exists(prom):
+        prom = os.path.join(run_dir, "metrics.prom")
+    return trace, prom
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        description="Render a critical-path report from a --telemetry-dir "
+                    "run (trace.jsonl + metrics.prom)")
+    p.add_argument("run_dir", help="the run's --telemetry-dir")
+    p.add_argument("--top", type=int, default=10,
+                   help="span groups to show in the critical path")
+    args = p.parse_args(argv)
+    trace_path, prom_path = resolve_inputs(args.run_dir)
+    if not os.path.exists(trace_path):
+        print(f"no trace file under {args.run_dir} "
+              f"(expected trace.jsonl — was the run started with "
+              f"--telemetry-dir?)", file=sys.stderr)
+        return 1
+    spans = load_spans(trace_path)
+    prom_text = ""
+    if os.path.exists(prom_path):
+        with open(prom_path, encoding="utf-8") as f:
+            prom_text = f.read()
+    sys.stdout.write(build_report(spans, prom_text, top=args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
