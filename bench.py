@@ -280,6 +280,10 @@ _T0 = time.perf_counter()
 
 # every _emit line, in order — the terminal summary line replays them all
 _RESULTS: list[dict] = []
+# the winning e2e run's perf report + overlap numbers (filled by
+# bench_end_to_end via _stash_perf_report; the gate attaches the report
+# to a regression verdict so the slowdown arrives with its critical path)
+_E2E_PERF_REPORT: list[str] = []
 # perf_counter of the latest emit — the stall watchdog's heartbeat
 _LAST_PROGRESS: list[float] = [0.0]
 # set once the terminal summary has printed; keeps the main thread's
@@ -341,6 +345,92 @@ def _start_stall_watchdog(stall_s: float | None = None):
     threading.Thread(target=_watch, daemon=True).start()
 
 
+def _tools_module(name: str):
+    """Import a module from tools/ (bench.py sits at the repo root)."""
+    import importlib
+    import sys
+
+    tools = os.path.join(os.path.dirname(os.path.abspath(__file__)), "tools")
+    if tools not in sys.path:
+        sys.path.insert(0, tools)
+    return importlib.import_module(name)
+
+
+def _stash_perf_report(telemetry_dir: "str | None") -> "dict | None":
+    """Render the e2e winner's perf report (before its tempdir vanishes),
+    stash the text for the gate, and return the async-I/O overlap numbers
+    for the metric line. Never fails the bench — telemetry is evidence,
+    not a dependency."""
+    if not telemetry_dir:
+        return None
+    try:
+        perf_report = _tools_module("perf_report")
+        trace_path, prom_path = perf_report.resolve_inputs(telemetry_dir)
+        spans = perf_report.load_spans(trace_path)
+        prom_text = ""
+        if os.path.exists(prom_path):
+            with open(prom_path, encoding="utf-8") as f:
+                prom_text = f.read()
+        _E2E_PERF_REPORT[:] = [perf_report.build_report(spans, prom_text)]
+        return perf_report.io_overlap(spans)
+    except Exception:
+        return None
+
+
+# gate the FULL suite by default; main() flips this off for --only subset
+# runs (every unrun metric would read as "vanished" = regression).
+# PHOTON_BENCH_GATE=0/1 overrides either way.
+_GATE_DEFAULT = [True]
+
+
+def _find_baseline() -> "tuple[str, dict] | None":
+    """The last SOUND bench artifact next to this file (BENCH_rNN.json,
+    newest round first; infra-failed rounds — like r05's device outage —
+    are skipped). ``PHOTON_BENCH_BASELINE`` overrides the search."""
+    import glob
+
+    bench_gate = _tools_module("bench_gate")
+    override = os.environ.get("PHOTON_BENCH_BASELINE")
+    here = os.path.dirname(os.path.abspath(__file__))
+    candidates = ([override] if override else
+                  sorted(glob.glob(os.path.join(here, "BENCH_r*.json")),
+                         reverse=True))
+    for path in candidates:
+        art = bench_gate.load_artifact(path)
+        if art is not None and bench_gate.infra_failure(art) is None:
+            return path, art
+    return None
+
+
+def _gate_line(summary: dict) -> "dict | None":
+    """The auto-gate: this suite's summary vs the last sound artifact,
+    as one JSON-able line (``tools/bench_gate.py`` semantics). On a
+    ``regression`` verdict the e2e run's perf report rides along, so the
+    slowdown arrives with its critical path attached. Returns None (and
+    gates nothing) when no sound baseline exists or the gate itself
+    errors — the gate must never break the terminal summary.
+    ``PHOTON_BENCH_GATE=0`` disables it."""
+    flag = os.environ.get("PHOTON_BENCH_GATE")
+    enabled = (flag != "0") if flag is not None else _GATE_DEFAULT[0]
+    if not enabled:
+        return None
+    try:
+        bench_gate = _tools_module("bench_gate")
+        found = _find_baseline()
+        current = bench_gate.normalize_artifact({"parsed": summary})
+        verdict = bench_gate.gate(current,
+                                  found[1] if found else None)
+        line = {"metric": "bench_gate",
+                "baseline": os.path.basename(found[0]) if found else None}
+        line.update(verdict)
+        if (verdict.get("verdict") == bench_gate.VERDICT_REGRESSION
+                and _E2E_PERF_REPORT):
+            line["perf_report"] = _E2E_PERF_REPORT[0][:8000]
+        return line
+    except Exception:
+        return None
+
+
 def _emit_summary(error: str | None = None):
     """The LAST stdout line: one JSON object holding EVERY metric.
 
@@ -389,6 +479,17 @@ def _emit_summary(error: str | None = None):
     }
     if error is not None:
         summary["error"] = error
+    else:
+        # auto-gate against the last sound artifact: the verdict prints as
+        # its own JSON line AND rides the summary under "gate" (the
+        # summary must stay the FINAL line — the harness parses the last
+        # line of the tail as the artifact, and future gates read that
+        # artifact's metric set)
+        gate_line = _gate_line(summary)
+        if gate_line is not None:
+            summary["gate"] = {k: v for k, v in gate_line.items()
+                               if k not in ("metric", "perf_report")}
+            print(json.dumps(gate_line), flush=True)
     print(json.dumps(summary), flush=True)
 
 
@@ -1009,28 +1110,42 @@ def bench_end_to_end():
         # measure TWICE (warm jit both times, fresh data path each) and
         # keep the better run: single-run walls on this box swing 1.5-3x
         # with transient host residue/contention, and the cleaner of two
-        # is the reproducible property of the code
-        wall, stages = None, {}
+        # is the reproducible property of the code. Each measured run
+        # carries --telemetry-dir so the winner ships a span trace: the
+        # perf_report async-I/O-overlap section (and a regression gate
+        # verdict, see _gate_line) can then PROVE how much of the
+        # save/read wall was hidden under train, from artifacts alone.
+        wall, stages, best_td = None, {}, None
         for i in range(2):
             _residue_drain()
             out = os.path.join(tmp, f"out{i}")
+            td = os.path.join(out, "telemetry")
             t0 = time.perf_counter()
-            train_game_cli.run(args + ["--output-dir", out])
+            train_game_cli.run(args + ["--output-dir", out,
+                                       "--telemetry-dir", td])
             w = time.perf_counter() - t0
             _heartbeat()
             assert os.path.exists(
                 os.path.join(out, "best", "model-metadata.json"))
             if wall is None or w < wall:
-                wall, stages = w, _stages_of(out)
+                wall, stages, best_td = w, _stages_of(out), td
+        overlap = _stash_perf_report(best_td)
     e2e_rate = E2E_ROWS / wall
     base_rate = 1.0 / (1.0 / py_ingest_rate + 1.0 / host_cd_rate)
+    extra = {}
+    if overlap:
+        for cls in ("save", "read"):
+            if cls in overlap:
+                extra[f"{cls}_io_s"] = round(overlap[cls]["seconds"], 3)
+                extra[f"{cls}_hidden_pct"] = round(
+                    overlap[cls]["hidden_pct"], 1)
     # self-describing metric line: the run configuration rides as extras so
     # round-over-round artifacts are comparable without reading this source
     _emit("game_end_to_end_rows_per_sec", e2e_rate, "rows/s",
           e2e_rate / base_rate, n_rows=int(E2E_ROWS),
           n_users=int(E2E_USERS), n_songs=int(E2E_SONGS),
           design_dtype="bfloat16", codec="null", best_of=2,
-          wall_s=round(wall, 2), stage_s=stages)
+          wall_s=round(wall, 2), stage_s=stages, **extra)
 
 
 def main(argv=None):
@@ -1058,6 +1173,7 @@ def main(argv=None):
         # accelerator tunnel down
         _probe_device()
     _start_stall_watchdog()
+    _GATE_DEFAULT[0] = not args.only
     if args.only:
         try:
             {"glm": bench_glm, "re": bench_random_effect,

@@ -34,7 +34,7 @@ from photon_ml_tpu.game.estimator import (
     GameOptimizationConfiguration,
     RandomEffectCoordinateConfig,
 )
-from photon_ml_tpu.io import AvroDataReader, save_game_model
+from photon_ml_tpu.io import AvroDataReader
 from photon_ml_tpu.logging_util import RunLogger, timed
 from photon_ml_tpu.types import DataValidationType, TaskType
 
@@ -228,6 +228,15 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
     telemetry = install_telemetry(telemetry_from_args(
         args, subdir=None if chief
         else os.path.join("workers", f"proc-{_process_index()}")))
+    # the async I/O pipeline's writer service: feature indexes and model
+    # part-files are written on background threads and joined before exit,
+    # so "Save models" shrinks to the join wall (chief-only — only the
+    # chief writes outputs)
+    saver = None
+    if chief:
+        from photon_ml_tpu.io.pipeline import BackgroundSaver
+
+        saver = BackgroundSaver()
     from photon_ml_tpu.telemetry import emit_build_info, tracing
 
     # photon_build_info{version, process, jax_version}: every process
@@ -326,6 +335,16 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
             else:
                 data, index_maps, vocabs = reader.read(
                     args.training_data, id_columns=id_columns)
+        if saver is not None:
+            # the index maps are final from here on: their JSON files write
+            # on the background pool, fully hidden under the stages below
+            os.makedirs(args.output_dir, exist_ok=True)
+            for shard_id, imap in index_maps.items():
+                saver.submit_file_write(
+                    imap.save,
+                    os.path.join(args.output_dir, "feature-indexes",
+                                 f"{shard_id}.json"),
+                    label="io.save.index", shard=shard_id)
 
         initial_models = None
         if args.model_input_dir:
@@ -348,15 +367,64 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
             reader_v = AvroDataReader(shard_configs=shard_configs,
                                       index_maps=index_maps,
                                       input_columns=reader.input_columns)
-            with timed("Read validation data", run_logger):
-                vdata, _, _ = reader_v.read(
-                    args.validation_data, id_columns=id_columns,
-                    entity_vocabs=vocabs)
-            validation = (vdata, evaluators)
+            if multiproc:
+                # collective path: every process must hold the data before
+                # the symmetric training starts — read it here
+                with timed("Read validation data", run_logger):
+                    vdata, _, _ = reader_v.read(
+                        args.validation_data, id_columns=id_columns,
+                        entity_vocabs=vocabs)
+                validation = (vdata, evaluators)
+            else:
+                # async ingest: the read runs in the background while the
+                # training data uploads and the first sweep trains; the
+                # callable joins it at first use (sweep 1's evaluation),
+                # and the "Read validation data" stage records the JOIN
+                # wall — the visible (unhidden) part of the read
+                from photon_ml_tpu.io.pipeline import read_in_background
+
+                _v_future = read_in_background(
+                    reader_v.read, args.validation_data,
+                    id_columns=id_columns, entity_vocabs=vocabs,
+                    label="io.read.validation")
+                _v_cell: list = []
+
+                def validation():
+                    if not _v_cell:
+                        with timed("Read validation data", run_logger):
+                            vdata, _, _ = _v_future.result()
+                        _v_cell.append((vdata, evaluators))
+                    return _v_cell[0]
 
         est = GameEstimator(task=task, coordinate_configs=coordinate_configs,
                             update_sequence=update_sequence,
                             n_cd_iterations=args.cd_iterations, mesh=mesh)
+
+        # async model publication: each configuration's model save is
+        # submitted the moment that configuration finishes, overlapping
+        # the remaining grid points and best-selection. With
+        # --output-all-models every config lands under all/config-i (and
+        # best/ is published later as a hardlink alias of the winner —
+        # the model is serialized ONCE); a single-config grid's only
+        # result IS best, so it saves straight to best/ while the driver
+        # finishes bookkeeping.
+        _single_config = [False]
+        _best_pre_submitted = [False]
+
+        def _note_result(i, r):
+            if saver is None:
+                return
+            if args.output_all_models:
+                saver.submit_game_save(
+                    os.path.join(args.output_dir, "all", f"config-{i}"),
+                    r.model, index_maps, vocabs,
+                    sparsity_threshold=args.model_sparsity_threshold)
+            elif _single_config[0] and i == 0:
+                saver.submit_game_save(
+                    os.path.join(args.output_dir, "best"),
+                    r.model, index_maps, vocabs,
+                    sparsity_threshold=args.model_sparsity_threshold)
+                _best_pre_submitted[0] = True
 
         def _mp_fit(config, mp_ckpt=None):
             """One collective-symmetric multi-process fit, evaluated and
@@ -443,13 +511,15 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                     # collective-symmetric training all processes join
                     for config in configurations:
                         results.append(_mp_fit(config, mp_ckpt))
+                        _note_result(len(results) - 1, results[-1])
             else:
+                _single_config[0] = len(configurations) == 1
                 with timed("Train (grid)", run_logger), profiled(profile_dir):
                     results = est.fit(
                         data, configurations, validation=validation,
                         initial_models=initial_models, locked=locked,
                         checkpoint=checkpoint, resume=args.resume,
-                        guard=guard)
+                        guard=guard, on_result=_note_result)
                     # drain the async solve queue inside the timed block:
                     # without this the final sweep's device programs finish
                     # during "Save models", which then reports compute as
@@ -484,6 +554,7 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                 def evaluate(config: dict) -> float:
                     r = _mp_fit(GameOptimizationConfiguration(config))
                     results.append(r)
+                    _note_result(len(results) - 1, r)
                     return r.evaluation.primary[1]
 
                 def release_datasets():
@@ -497,6 +568,7 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                                 initial_models=initial_models, locked=locked,
                                 guard=guard)[0]
                     results.append(r)
+                    _note_result(len(results) - 1, r)
                     return r.evaluation.primary[1]
 
                 def release_datasets():
@@ -534,22 +606,27 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                               config=dict(best.configuration.regularization_weights))
 
         if chief:
+            best_dir = os.path.join(args.output_dir, "best")
+            if not args.output_all_models and not _best_pre_submitted[0]:
+                # multi-config grid / tuning without --output-all-models:
+                # the winner is only known now — submit its (sole) save
+                saver.submit_game_save(
+                    best_dir, best.model, index_maps, vocabs,
+                    sparsity_threshold=args.model_sparsity_threshold)
+            # the stage is now the JOIN wall: whatever the background
+            # writers didn't finish under train/selection (plus, under
+            # --output-all-models, the hardlink alias publish)
             with timed("Save models", run_logger):
-                os.makedirs(args.output_dir, exist_ok=True)
-                for shard_id, imap in index_maps.items():
-                    imap.save(os.path.join(args.output_dir, "feature-indexes",
-                                           f"{shard_id}.json"))
-                save_game_model(os.path.join(args.output_dir, "best"),
-                                best.model, index_maps, vocabs,
-                                sparsity_threshold=args.model_sparsity_threshold)
+                saver.join()
                 if args.output_all_models:
-                    for i, r in enumerate(results):
-                        save_game_model(
-                            os.path.join(args.output_dir, "all", f"config-{i}"),
-                            r.model, index_maps, vocabs,
-                            sparsity_threshold=args.model_sparsity_threshold)
-            GLOBAL_BUS.post("model_saved",
-                            path=os.path.join(args.output_dir, "best"))
+                    from photon_ml_tpu.io.pipeline import publish_model_alias
+
+                    best_i = next(i for i, r in enumerate(results)
+                                  if r is best)
+                    publish_model_alias(
+                        os.path.join(args.output_dir, "all",
+                                     f"config-{best_i}"), best_dir)
+            GLOBAL_BUS.post("model_saved", path=best_dir)
         return {
             "best_config": dict(best.configuration.regularization_weights),
             "best_evaluation": (best.evaluation.as_dict()
@@ -558,6 +635,11 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
             "output_dir": args.output_dir,
         }
     finally:
+        if saver is not None:
+            # happy path already join()ed (errors propagated there); this
+            # waits out any writer a failing run left in flight so no
+            # thread outlives the driver into a dir being torn down
+            saver.close()
         _root_span.close()
         GLOBAL_BUS.post("training_finished", driver="train_game")
         telemetry.close()

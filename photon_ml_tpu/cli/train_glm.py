@@ -257,6 +257,13 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
     telemetry = install_telemetry(telemetry_from_args(
         args, subdir=None if chief
         else os.path.join("workers", f"proc-{jax.process_index()}")))
+    # async I/O pipeline: model/index writes run on background threads and
+    # are joined before exit — "Save models" is the join wall (chief-only)
+    saver = None
+    if chief:
+        from photon_ml_tpu.io.pipeline import BackgroundSaver
+
+        saver = BackgroundSaver()
     from photon_ml_tpu.telemetry import emit_build_info, tracing
 
     emit_build_info()
@@ -434,6 +441,30 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                                 regularization_weight=w)
             trained = [tm for tm in trained if tm not in diverged]
 
+        # async model publication: every lambda's model is final here —
+        # submit the all/ writes NOW so they overlap the validation read,
+        # scoring and selection below (evaluation is not part of the
+        # written artifact, so writing before selection is byte-equivalent)
+        def _save_glm(model, out_dir, model_id):
+            save_glm_model(os.path.join(out_dir, "model.avro"),
+                           model, imap, model_id=model_id)
+            # the reference driver writes text AND Avro models
+            save_glm_model_text(os.path.join(out_dir, "model.txt"),
+                                model, imap)
+
+        if chief:
+            saver.submit_file_write(
+                imap.save,
+                os.path.join(args.output_dir, "feature-index.json"),
+                label="io.save.index")
+            for tm in trained:
+                model_id = f"lambda-{tm.regularization_weight:g}"
+                out_dir = os.path.join(args.output_dir, "all", model_id)
+                saver.submit(
+                    lambda tm=tm, out_dir=out_dir, model_id=model_id:
+                        _save_glm(tm.model, out_dir, model_id),
+                    label="io.save.model", path=out_dir)
+
         best_idx = 0
         glm_val = None
         # diagnostics need validation data too (fitting curve, out-of-sample
@@ -458,24 +489,17 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
 
         best = trained[best_idx]
         if chief:
+            # the winner is known only now; everything else has been
+            # writing in the background since the sweep ended — the stage
+            # is the join wall
+            saver.submit(
+                lambda: _save_glm(best.model,
+                                  os.path.join(args.output_dir, "best"),
+                                  "best"),
+                label="io.save.model", path=os.path.join(args.output_dir,
+                                                         "best"))
             with timed("Save models", run_logger):
-                imap.save(os.path.join(args.output_dir, "feature-index.json"))
-                save_glm_model(
-                    os.path.join(args.output_dir, "best", "model.avro"),
-                    best.model, imap, model_id="best")
-                # the reference driver writes text AND Avro models
-                save_glm_model_text(
-                    os.path.join(args.output_dir, "best", "model.txt"),
-                    best.model, imap)
-                for tm in trained:
-                    out_dir = os.path.join(
-                        args.output_dir, "all",
-                        f"lambda-{tm.regularization_weight:g}")
-                    save_glm_model(
-                        os.path.join(out_dir, "model.avro"), tm.model, imap,
-                        model_id=f"lambda-{tm.regularization_weight:g}")
-                    save_glm_model_text(
-                        os.path.join(out_dir, "model.txt"), tm.model, imap)
+                saver.join()
         report_path = None
         if args.training_diagnostics:
             # the DIAGNOSED stage of the reference driver's state machine
@@ -492,6 +516,10 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
             "diagnostics_report": report_path,
         }
     finally:
+        if saver is not None:
+            # happy path already join()ed; this waits out writers a
+            # failing run left in flight
+            saver.close()
         _root_span.close()
         GLOBAL_BUS.post("training_finished", driver="train_glm")
         telemetry.close()

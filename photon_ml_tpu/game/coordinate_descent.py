@@ -19,7 +19,7 @@ from typing import Mapping, Optional, Sequence
 
 import numpy as np
 
-from photon_ml_tpu.evaluation import Evaluator, evaluate_all
+from photon_ml_tpu.evaluation import evaluate_all
 from photon_ml_tpu.game.coordinate import Coordinate, CoordinateModel
 from photon_ml_tpu.game.data import GameData
 from photon_ml_tpu.game.model import GameModel
@@ -129,7 +129,7 @@ class CoordinateDescent:
         coordinates: Mapping[str, Coordinate],
         data: GameData,
         task: TaskType,
-        validation: Optional[tuple[GameData, Sequence[Evaluator]]] = None,
+        validation=None,  # (GameData, evaluators) | zero-arg callable -> same
         initial_models: Optional[Mapping[str, CoordinateModel]] = None,
         checkpoint=None,  # Optional[photon_ml_tpu.io.checkpoint.CheckpointManager]
         resume: bool = False,
@@ -374,6 +374,11 @@ class CoordinateDescent:
                                 fingerprint=config_fingerprint)
 
                 if validation is not None:
+                    if callable(validation):
+                        # async-ingest join point: the driver kicked the
+                        # validation read off in the background; the first
+                        # sweep's evaluation is its first (and only) wait
+                        validation = validation()
                     vdata, evaluators = validation
                     with tracing.span("cd.validate", sweep=sweep):
                         gm = GameModel(coordinates=dict(models), task=task)
@@ -399,6 +404,8 @@ class CoordinateDescent:
             # sweep loop fully skipped (resume from a completed checkpoint):
             # the model is final but unevaluated — evaluate it now so the
             # caller still gets metrics
+            if callable(validation):
+                validation = validation()
             vdata, evaluators = validation
             vscores = model.score(vdata)
             final_evaluation = evaluate_all(

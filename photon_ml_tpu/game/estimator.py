@@ -351,6 +351,7 @@ class GameEstimator:
         checkpoint=None,
         resume: bool = False,
         guard=None,  # Optional[photon_ml_tpu.resilience.DivergenceGuard]
+        on_result=None,  # Optional[Callable[[int, GameResult], None]]
     ) -> list[GameResult]:
         """``datasets`` (from :meth:`prepare`) lets callers that fit many
         times over the same data — e.g. a tuning loop — build the coordinate
@@ -363,7 +364,13 @@ class GameEstimator:
         resilience subsystem's divergence guard (rollback / regularization
         backoff / freeze at coordinate boundaries; see RESILIENCE.md) —
         shared across configurations so a tuning loop's failure budget is
-        per-run, not per-point."""
+        per-run, not per-point. ``validation`` may be a zero-arg callable
+        returning the ``(GameData, evaluators)`` tuple — resolved at first
+        use, so a driver can keep the validation read in flight while
+        early sweeps run. ``on_result(index, result)`` fires the moment
+        each configuration finishes — the async I/O pipeline's hook for
+        submitting that model's background save while the remaining grid
+        points still train."""
         self._check_sequence(locked)
         if checkpoint is not None and len(configurations) != 1:
             raise ValueError("checkpointing supports exactly one configuration")
@@ -407,6 +414,8 @@ class GameEstimator:
                 validation_history=cd_result.validation_history))
             logger.info("configuration %s -> %s",
                         dict(config.regularization_weights), evaluation)
+            if on_result is not None:
+                on_result(len(results) - 1, results[-1])
         return results
 
     @staticmethod

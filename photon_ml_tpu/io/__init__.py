@@ -29,3 +29,10 @@ from photon_ml_tpu.io.model_io import (  # noqa: F401
     save_glm_model_text,
 )
 from photon_ml_tpu.io.checkpoint import CheckpointManager  # noqa: F401
+from photon_ml_tpu.io.pipeline import (  # noqa: F401
+    BackgroundSaver,
+    DecodePrefetcher,
+    publish_model_alias,
+    read_in_background,
+    save_game_model_atomic,
+)

@@ -249,17 +249,30 @@ class AvroDataReader:
     def _read_native(self, files, id_columns, entity_vocabs):
         """All-numpy assembly from the C++ decoder; None -> fall back.
 
-        Files decode in parallel: the decoder is stateless per call and the
-        ctypes FFI releases the GIL, so a thread pool gets real concurrency
-        (the reference gets the same from executor-parallel HDFS reads —
-        SURVEY.md §7 hard-parts #7 ingest throughput).
+        The decode is a bounded double-buffered PIPELINE
+        (:class:`photon_ml_tpu.io.pipeline.DecodePrefetcher`): up to the
+        worker window of files decode concurrently — the decoder is
+        stateless per call and the ctypes FFI releases the GIL, the
+        reference gets the same from executor-parallel HDFS reads
+        (SURVEY.md §7 hard-parts #7 ingest throughput) — while this
+        consumer does each already-decoded file's key-table merge, id
+        remap and (with preset index maps) CSR shard split. The old
+        decode-ALL-then-concatenate barrier paid the whole assembly after
+        the last decode; here assembly of file *i* overlaps the decode of
+        file *i+1*.
         """
         from photon_ml_tpu import native
 
         if not native.available():
             return None
 
+        from photon_ml_tpu.io.pipeline import (
+            DecodePrefetcher,
+            _ingest_decode_seconds,
+            _ingest_files,
+        )
         from photon_ml_tpu.resilience import fault_point, retry
+        from photon_ml_tpu.telemetry import tracing
 
         def decode(p):
             def attempt():
@@ -267,70 +280,102 @@ class AvroDataReader:
                 return native.decode_training_file(p,
                                                    id_keys=tuple(id_columns))
 
-            return retry(attempt, name=f"io.read:{os.path.basename(p)}")
+            with tracing.span("io.read.file", path=p) as sp:
+                d = retry(attempt, name=f"io.read:{os.path.basename(p)}")
+            _ingest_decode_seconds().inc(sp.seconds)
+            _ingest_files().inc()
+            return d
 
-        if len(files) > 1:
-            from concurrent.futures import ThreadPoolExecutor
+        # cap workers: each in-flight decode holds the whole file blob,
+        # so peak RSS ≈ window × file size
+        workers = min(len(files), os.cpu_count() or 4, 8)
+        preset_maps = self.index_maps
 
-            # cap workers: each in-flight decode holds the whole file blob,
-            # so peak RSS ≈ workers × file size
-            workers = min(len(files), os.cpu_count() or 4, 8)
+        # streamed accumulators (per file, in file order — identical
+        # ordering semantics to the old all-at-once assembly)
+        labels_p, offsets_p, weights_p = [], [], []
+        all_keys: dict[str, int] = {}
+        pending_splits: list = []  # (decoded, remap) until maps exist
+        split_parts: dict[str, list] = {c.shard_id: []
+                                        for c in self.shard_configs}
+        vocabs: dict[str, dict[str, int]] = {
+            c: dict(v) for c, v in (entity_vocabs or {}).items()}
+        frozen = entity_vocabs is not None
+        ids_p: dict[str, list] = {c: [] for c in id_columns}
 
-            class _Incompatible(Exception):
-                pass
+        def split_file(d):
+            """CSR-split one decoded file into every shard (native
+            count+fill pass per (shard, file) — record order preserved by
+            construction, so no sort or from_coo monotonicity pass).
+            ``k2c`` maps the file's LOCAL key ids straight to shard
+            columns, so no per-nnz global-key gather is needed."""
+            for cfg in self.shard_configs:
+                imap = index_maps[cfg.shard_id]
+                k2c = np.empty(len(d.feature_keys), np.int32)
+                for i, k in enumerate(d.feature_keys):
+                    k2c[i] = imap.key_to_index.get(k, -1)
+                icol = (imap.key_to_index[INTERCEPT_KEY]
+                        if cfg.has_intercept else -1)
+                split = native.shard_split(
+                    d.feat_indptr, d.feat_key_id, d.feat_val,
+                    np.ascontiguousarray(k2c), icol)
+                if split is None:  # library vanished mid-run
+                    return False
+                split_parts[cfg.shard_id].append(split)
+            return True
 
-            def decode_or_raise(p):
-                d = decode(p)
-                if d is None:  # short-circuit: cancel the remaining files
-                    raise _Incompatible
-                return d
+        index_maps = preset_maps
+        for d in DecodePrefetcher(decode, files, workers=workers):
+            if d is None:  # incompatible schema: fall back (prefetcher
+                return None  # cancels the files still queued)
+            with tracing.span("io.read.assemble",
+                              n_records=int(d.n_records)):
+                labels_p.append(d.response)
+                offsets_p.append(d.offset)
+                weights_p.append(d.weight)
+                if preset_maps is None:
+                    # merge this file's feature-key table into the global
+                    # universe the index maps are built from after the
+                    # stream (preset maps skip the merge entirely)
+                    for k in d.feature_keys:
+                        all_keys.setdefault(k, len(all_keys))
+                # id columns through the (possibly frozen) vocab
+                for c in id_columns:
+                    local = d.id_cols[c]
+                    local_vocab = d.id_vocabs[c]
+                    vocab = vocabs.setdefault(c, {})
+                    id_remap = np.full(len(local_vocab) + 1, -1, np.int64)
+                    for i, raw in enumerate(local_vocab):
+                        if raw not in vocab:
+                            if frozen:
+                                continue
+                            vocab[raw] = len(vocab)
+                        id_remap[i] = vocab[raw]
+                    ids_p[c].append(id_remap[local])
+                if preset_maps is not None:
+                    # maps are known up front: this file's CSR split runs
+                    # NOW, overlapped with the next file's decode
+                    if not split_file(d):
+                        return None
+                else:
+                    # training read: column ids depend on the FULL key
+                    # universe — buffer the decode, split after the stream
+                    pending_splits.append(d)
 
-            with ThreadPoolExecutor(max_workers=workers) as pool:
-                futures = [pool.submit(decode_or_raise, p) for p in files]
-                try:
-                    decoded = [f.result() for f in futures]
-                except _Incompatible:
-                    for f in futures:
-                        f.cancel()
-                    return None
-                except BaseException:
-                    # decode error (corrupt file, etc.): don't burn time
-                    # decoding the rest before propagating
-                    for f in futures:
-                        f.cancel()
-                    raise
-        else:
-            decoded = [decode(files[0])]
-            if decoded[0] is None:
-                return None
-
-        n = sum(d.n_records for d in decoded)
-        labels = np.concatenate([d.response for d in decoded]).astype(np.float32)
+        n = int(sum(len(p) for p in labels_p))
+        labels = (np.concatenate(labels_p) if labels_p
+                  else np.zeros(0)).astype(np.float32)
         offsets = np.nan_to_num(
-            np.concatenate([d.offset for d in decoded]), nan=0.0
-        ).astype(np.float32)
-        weights = np.concatenate([d.weight for d in decoded])
+            np.concatenate(offsets_p) if offsets_p else np.zeros(0),
+            nan=0.0).astype(np.float32)
+        weights = (np.concatenate(weights_p) if weights_p
+                   else np.zeros(0))
         weights = np.where(np.isnan(weights), 1.0, weights).astype(np.float32)
 
-        # merge per-file feature-key tables into one global key list
-        all_keys: dict[str, int] = {}
-        file_key_remap = []
-        for d in decoded:
-            remap = np.empty(len(d.feature_keys), np.int64)
-            identity = True
-            for i, k in enumerate(d.feature_keys):
-                j = all_keys.setdefault(k, len(all_keys))
-                remap[i] = j
-                identity = identity and j == i
-            # None marks the identity remap (always true for the first /
-            # only file): the per-nnz gather below is then skipped
-            file_key_remap.append(None if identity else remap)
-        global_keys = [None] * len(all_keys)
-        for k, j in all_keys.items():
-            global_keys[j] = k
-
-        index_maps = self.index_maps
         if index_maps is None:
+            global_keys = [None] * len(all_keys)
+            for k, j in all_keys.items():
+                global_keys[j] = k
             index_maps = {}
             # bag of a key = name prefix before the first '.' (see
             # _record_features); key layout is "name\x01term"
@@ -344,74 +389,37 @@ class AvroDataReader:
                          if b in cfg.feature_bags])
                 index_maps[cfg.shard_id] = build_index_map(
                     keep, add_intercept=cfg.has_intercept)
+            for d in pending_splits:
+                if not split_file(d):
+                    return None
 
-        # per-shard CSR assembly: one native count+fill pass per (shard,
-        # file) replaces the flat remap/mask/gather numpy pipeline (~1 s at
-        # 1M records); record order is preserved by construction so no sort
-        # or from_coo monotonicity pass is needed
         shards = {}
         for cfg in self.shard_configs:
+            parts = split_parts[cfg.shard_id]
             imap = index_maps[cfg.shard_id]
-            key_to_col = np.full(len(global_keys), -1, np.int32)
-            for j, k in enumerate(global_keys):
-                col = imap.key_to_index.get(k)
-                if col is not None:
-                    key_to_col[j] = col
-            icol = (imap.key_to_index[INTERCEPT_KEY] if cfg.has_intercept
-                    else -1)
-            indptr_parts, cols_parts, vals_parts = [], [], []
-            for d, remap in zip(decoded, file_key_remap):
-                k2c = (key_to_col if remap is None
-                       else np.ascontiguousarray(key_to_col[remap]))
-                split = native.shard_split(
-                    d.feat_indptr, d.feat_key_id, d.feat_val, k2c, icol)
-                if split is None:  # library vanished mid-run
-                    return None
-                indptr_parts.append(split[0])
-                cols_parts.append(split[1])
-                vals_parts.append(split[2])
-            if not indptr_parts:
+            if not parts:
                 # zero decoded parts: an empty CSR, not an IndexError on
-                # indptr_parts[0] below (n is 0 here, so indptr is [0])
+                # parts[0] below (n is 0 here, so indptr is [0])
                 indptr = np.zeros(n + 1, np.int64)
                 cols = np.zeros(0, np.int32)
                 vals = np.zeros(0, np.float32)
-            elif len(indptr_parts) == 1:
-                indptr, cols, vals = indptr_parts[0], cols_parts[0], \
-                    vals_parts[0]
+            elif len(parts) == 1:
+                indptr, cols, vals = parts[0]
             else:
+                indptr_parts = [p[0] for p in parts]
                 nnz0 = np.cumsum([0] + [int(p[-1]) for p in indptr_parts])
                 indptr = np.concatenate(
                     [indptr_parts[0]]
                     + [p[1:] + off for p, off
                        in zip(indptr_parts[1:], nnz0[1:-1])])
-                cols = np.concatenate(cols_parts)
-                vals = np.concatenate(vals_parts)
+                cols = np.concatenate([p[1] for p in parts])
+                vals = np.concatenate([p[2] for p in parts])
             shards[cfg.shard_id] = FeatureShard(
                 indptr=indptr, cols=cols, vals=vals, dim=len(imap))
 
-        # merge id columns across files through the (possibly frozen) vocab
-        vocabs: dict[str, dict[str, int]] = {
-            c: dict(v) for c, v in (entity_vocabs or {}).items()}
-        frozen = entity_vocabs is not None
-        ids = {}
-        for c in id_columns:
-            out = np.full(n, -1, np.int64)
-            row0 = 0
-            vocab = vocabs.setdefault(c, {})
-            for d in decoded:
-                local = d.id_cols[c]
-                local_vocab = d.id_vocabs[c]
-                remap = np.full(len(local_vocab) + 1, -1, np.int64)
-                for i, raw in enumerate(local_vocab):
-                    if raw not in vocab:
-                        if frozen:
-                            continue
-                        vocab[raw] = len(vocab)
-                    remap[i] = vocab[raw]
-                out[row0:row0 + d.n_records] = remap[local]
-                row0 += d.n_records
-            ids[c] = out
+        ids = {c: (np.concatenate(ids_p[c]) if ids_p[c]
+                   else np.full(0, -1, np.int64))
+               for c in id_columns}
 
         data = GameData(labels=labels, offsets=offsets, weights=weights,
                         shards=shards, id_columns=ids)

@@ -1,0 +1,392 @@
+"""Async I/O pipeline: background model publication + overlapped ingest.
+
+The e2e GAME driver wall (BENCH_r04) was ~59% "Save models" and ~25%
+"Read" — training itself was a quarter of the run. The reference hides
+exactly this class of latency behind executor-parallel HDFS writers and
+readers (SURVEY.md §7 "ingest throughput"); this module is the TPU-native
+port's equivalent, built from three pieces:
+
+- :class:`BackgroundSaver` — a small two-pool writer service the drivers
+  own. Whole-model saves run on *orchestrator* threads and fan their
+  per-coordinate part-file writes out on a shared *part-writer* pool (the
+  native RE writer releases the GIL, so coordinate part files encode
+  concurrently even on one core). Every model directory is staged in a
+  hidden temp sibling and published with the crash-safe retire-then-rename
+  protocol of :mod:`photon_ml_tpu.io.checkpoint`, under the resilience
+  retry policy with the ``io.model_save`` fault site in the crash window —
+  a kill or injected fault mid-save never exposes a partial model to the
+  serving registry. The driver submits saves the moment each result
+  exists, keeps training, and :meth:`BackgroundSaver.join`\\ s before exit
+  (first writer error propagates).
+- :class:`DecodePrefetcher` — a bounded, double-buffered file pipeline:
+  up to ``window`` Avro decodes stay in flight while the consumer does
+  key-remap/CSR assembly on already-decoded files, replacing the
+  decode-ALL-then-concatenate barrier in the reader.
+- :func:`read_in_background` — one background read (the drivers kick the
+  validation-data read off here so it overlaps training-data upload and
+  sweep 1; the result is joined at first use).
+
+All background work runs under a *copy of the submitter's context*, so
+spans opened in worker threads parent correctly under the driver's stage
+spans: ``io.save.model`` / ``io.save.part`` / ``io.save.index`` /
+``io.read.file`` / ``io.read.validation`` land on the run's one timeline
+and ``tools/perf_report.py`` can show how much of the I/O wall was hidden
+under train (the ``-- async I/O overlap --`` section).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import os
+import shutil
+import tempfile
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Callable, Iterator, Optional, Sequence
+
+from photon_ml_tpu.telemetry import metrics as tmetrics
+from photon_ml_tpu.telemetry import tracing
+
+
+def _save_seconds():
+    return tmetrics.counter(
+        "photon_save_seconds_total",
+        "Wall seconds spent writing model part-files, per coordinate "
+        "(background writers included — compare with the driver's "
+        "'Save models' join wall to see the hidden fraction)",
+        labels=("coordinate",))
+
+
+def _save_bytes():
+    return tmetrics.counter(
+        "photon_save_bytes_total",
+        "Bytes of model/index artifacts written (part-files, metadata, "
+        "feature indexes)")
+
+
+def _ingest_decode_seconds():
+    return tmetrics.counter(
+        "photon_ingest_decode_seconds_total",
+        "Wall seconds spent decoding input Avro files (prefetcher worker "
+        "side; overlaps assembly on the consumer side)")
+
+
+def _ingest_files():
+    return tmetrics.counter(
+        "photon_ingest_files_total",
+        "Input Avro files decoded through the ingest prefetcher")
+
+
+# ---------------------------------------------------------------------------
+# atomic directory publication (the checkpoint protocol, generalized)
+# ---------------------------------------------------------------------------
+
+
+def publish_dir(staging: str, final: str) -> None:
+    """Atomically publish a fully-written ``staging`` directory at
+    ``final`` using the retire-then-rename protocol from
+    :mod:`photon_ml_tpu.io.checkpoint`: an existing ``final`` is first
+    renamed aside (a ``.tmp`` suffix keeps it invisible to directory
+    probes), the staging dir takes its place, then the retired copy is
+    deleted — at no instant is ``final`` absent or partially written."""
+    final = os.path.normpath(final)
+    parent = os.path.dirname(os.path.abspath(final))
+    if os.path.exists(final):
+        retired = tempfile.mkdtemp(
+            prefix=f".{os.path.basename(final)}-retired-", suffix=".tmp",
+            dir=parent)
+        os.rmdir(retired)
+        os.rename(final, retired)
+        os.rename(staging, final)
+        shutil.rmtree(retired, ignore_errors=True)
+    else:
+        os.rename(staging, final)
+
+
+def _gc_stale_staging(parent: str, base: str) -> None:
+    """Drop staging/retired leftovers of a crashed or fault-injected
+    earlier attempt at publishing ``base`` (the atomic protocol means they
+    are never the live copy). Only this target's prefix is touched, so
+    concurrent saves of sibling model dirs are never collected."""
+    for name in os.listdir(parent):
+        if name.endswith(".tmp") and (
+                name.startswith(f".{base}-stage-")
+                or name.startswith(f".{base}-retired-")):
+            shutil.rmtree(os.path.join(parent, name), ignore_errors=True)
+
+
+def save_game_model_atomic(output_dir: str, model, index_maps, entity_vocabs,
+                           *, sparsity_threshold: float = 0.0,
+                           executor: Optional[ThreadPoolExecutor] = None,
+                           ) -> None:
+    """:func:`photon_ml_tpu.io.model_io.save_game_model` with crash-safe
+    publication: the model tree is written into a hidden staging sibling
+    and atomically renamed into place (retire-then-rename), under the
+    resilience retry policy. The ``io.model_save`` fault site sits in the
+    crash window — staging fully written, rename not yet done — so an
+    injected fault or a kill there leaves the previous model (or nothing)
+    visible, never a partial tree."""
+    from photon_ml_tpu.io.model_io import save_game_model
+    from photon_ml_tpu.resilience import fault_point, retry
+
+    output_dir = os.path.normpath(output_dir)
+    parent = os.path.dirname(os.path.abspath(output_dir))
+    os.makedirs(parent, exist_ok=True)
+    base = os.path.basename(output_dir)
+
+    def attempt() -> None:
+        _gc_stale_staging(parent, base)
+        staging = tempfile.mkdtemp(prefix=f".{base}-stage-", suffix=".tmp",
+                                   dir=parent)
+        try:
+            save_game_model(staging, model, index_maps, entity_vocabs,
+                            sparsity_threshold=sparsity_threshold,
+                            executor=executor)
+            fault_point("io.model_save", path=output_dir)
+            publish_dir(staging, output_dir)
+        except BaseException:
+            shutil.rmtree(staging, ignore_errors=True)
+            raise
+
+    retry(attempt, name=f"io.model_save:{base}")
+
+
+def publish_model_alias(src_dir: str, dst_dir: str) -> None:
+    """Publish ``dst_dir`` as an alias of the finished model at
+    ``src_dir`` WITHOUT re-serializing it: part-files (and any other
+    payload files) are hardlinked — copied when the filesystem refuses
+    links — into a staging tree, ``model-metadata.json`` is rewritten with
+    an ``aliasOf`` key naming the source, and the tree is published
+    atomically. This is how ``--output-all-models`` gets its ``best/``
+    directory for free instead of serializing the winning model twice."""
+    from photon_ml_tpu.resilience import fault_point, retry
+
+    src_dir = os.path.normpath(src_dir)
+    dst_dir = os.path.normpath(dst_dir)
+    parent = os.path.dirname(os.path.abspath(dst_dir))
+    os.makedirs(parent, exist_ok=True)
+    base = os.path.basename(dst_dir)
+
+    def attempt() -> None:
+        _gc_stale_staging(parent, base)
+        staging = tempfile.mkdtemp(prefix=f".{base}-stage-", suffix=".tmp",
+                                   dir=parent)
+        try:
+            with tracing.span("io.save.alias", src=src_dir, dst=dst_dir):
+                for dirpath, _dirnames, filenames in os.walk(src_dir):
+                    rel = os.path.relpath(dirpath, src_dir)
+                    out = (staging if rel == "." else
+                           os.path.join(staging, rel))
+                    os.makedirs(out, exist_ok=True)
+                    for name in filenames:
+                        s = os.path.join(dirpath, name)
+                        d = os.path.join(out, name)
+                        if name == "model-metadata.json":
+                            with open(s) as f:
+                                metadata = json.load(f)
+                            metadata["aliasOf"] = os.path.relpath(
+                                src_dir, parent)
+                            with open(d, "w") as f:
+                                json.dump(metadata, f, indent=2)
+                            continue
+                        try:
+                            os.link(s, d)
+                        except OSError:
+                            shutil.copy2(s, d)
+            fault_point("io.model_save", path=dst_dir)
+            publish_dir(staging, dst_dir)
+        except BaseException:
+            shutil.rmtree(staging, ignore_errors=True)
+            raise
+
+    retry(attempt, name=f"io.model_save:{base}")
+
+
+# ---------------------------------------------------------------------------
+# the background writer service
+# ---------------------------------------------------------------------------
+
+
+class BackgroundSaver:
+    """Driver-owned background writer: saves run off the critical path and
+    are joined (with error propagation) before the driver returns.
+
+    Two pools, so a whole-model save blocking on its own part-file writes
+    can never deadlock: orchestrators (one per in-flight model save) on
+    ``_saves``, leaf part-file/index writers on the shared ``_parts``
+    pool. Submission copies the caller's context, so worker-side spans
+    parent under whatever stage the driver was in when it submitted."""
+
+    def __init__(self, part_workers: int = 4, save_workers: int = 2):
+        self._parts = ThreadPoolExecutor(
+            max_workers=part_workers, thread_name_prefix="photon-save-part")
+        self._saves = ThreadPoolExecutor(
+            max_workers=save_workers, thread_name_prefix="photon-save")
+        self._lock = threading.Lock()
+        self._pending: list[tuple[str, Future]] = []
+
+    # --- submission -------------------------------------------------------
+    def _track(self, label: str, fut: Future) -> Future:
+        with self._lock:
+            self._pending.append((label, fut))
+        return fut
+
+    def submit_game_save(self, output_dir: str, model, index_maps,
+                         entity_vocabs, *, sparsity_threshold: float = 0.0,
+                         ) -> Future:
+        """Stage + atomically publish a GAME model at ``output_dir`` in the
+        background, fanning its per-coordinate part-files out on the
+        writer pool. Returns the save's future; :meth:`join` collects it."""
+        ctx = contextvars.copy_context()
+
+        def job() -> None:
+            with tracing.span("io.save.model", path=output_dir):
+                save_game_model_atomic(
+                    output_dir, model, index_maps, entity_vocabs,
+                    sparsity_threshold=sparsity_threshold,
+                    executor=self._parts)
+
+        return self._track(f"model:{output_dir}",
+                           self._saves.submit(ctx.run, job))
+
+    def submit_file_write(self, fn: Callable[[str], Any], path: str, *,
+                          label: str = "io.save.file", **attrs) -> Future:
+        """Run ``fn(path)`` (e.g. ``IndexMap.save``) on the writer pool
+        under an I/O span; the written file's size feeds
+        ``photon_save_bytes_total``."""
+        ctx = contextvars.copy_context()
+
+        def job() -> None:
+            with tracing.span(label, path=path, **attrs):
+                fn(path)
+            if os.path.exists(path):
+                _save_bytes().inc(os.path.getsize(path))
+
+        return self._track(f"{label}:{path}",
+                           self._parts.submit(ctx.run, job))
+
+    def submit(self, fn: Callable[[], Any], *, label: str = "io.save.task",
+               **attrs) -> Future:
+        """Run an arbitrary write task on the writer pool under a span."""
+        ctx = contextvars.copy_context()
+
+        def job():
+            with tracing.span(label, **attrs):
+                return fn()
+
+        return self._track(f"{label}",
+                           self._parts.submit(ctx.run, job))
+
+    # --- completion -------------------------------------------------------
+    def join(self) -> None:
+        """Wait for every submitted write; the first error (in submission
+        order) propagates — a failed background save must fail the run,
+        not be discovered by the next reader of a missing model."""
+        import logging
+
+        with self._lock:
+            pending, self._pending = self._pending, []
+        first_error: Optional[BaseException] = None
+        for label, fut in pending:
+            try:
+                fut.result()
+            except BaseException as e:
+                logging.getLogger(__name__).error(
+                    "background write %s failed: %r", label, e)
+                if first_error is None:
+                    first_error = e
+        if first_error is not None:
+            raise first_error
+
+    def close(self) -> None:
+        """Shut both pools down, waiting for in-flight writes (a writer
+        must never outlive the driver into a directory the harness is
+        about to delete). Errors of never-joined futures are dropped here
+        — the happy path joins first; close() runs on the failure path
+        where a second raise would mask the original error."""
+        self._saves.shutdown(wait=True)
+        self._parts.shutdown(wait=True)
+        with self._lock:
+            self._pending.clear()
+
+
+# ---------------------------------------------------------------------------
+# overlapped ingest
+# ---------------------------------------------------------------------------
+
+
+class DecodePrefetcher:
+    """Bounded double-buffered pipeline over ``fn(item)`` calls.
+
+    Up to ``window`` calls run on a worker pool while the consumer
+    iterates results strictly in submission order — the overlap that
+    replaces the reader's decode-all-then-concatenate barrier. An error
+    in any call cancels everything still queued and re-raises on the
+    consumer side; breaking out of the iteration (e.g. a fall-back
+    signal) likewise cancels the remainder."""
+
+    def __init__(self, fn: Callable[[Any], Any], items: Sequence[Any], *,
+                 workers: int = 2, window: Optional[int] = None):
+        self._fn = fn
+        self._items = list(items)
+        self._workers = max(1, workers)
+        # one extra in-flight slot beyond the workers keeps the pool fed
+        # while the consumer holds the head result (double buffering)
+        self._window = window if window is not None else self._workers + 1
+
+    def __iter__(self) -> Iterator[Any]:
+        from collections import deque
+
+        pool = ThreadPoolExecutor(max_workers=self._workers,
+                                  thread_name_prefix="photon-ingest")
+        queue: deque[Future] = deque()
+        it = iter(self._items)
+        try:
+            for item in it:
+                ctx = contextvars.copy_context()
+                queue.append(pool.submit(ctx.run, self._fn, item))
+                if len(queue) >= self._window:
+                    break
+            while queue:
+                head = queue.popleft()
+                try:
+                    result = head.result()
+                except BaseException:
+                    for f in queue:
+                        f.cancel()
+                    raise
+                for item in it:
+                    ctx = contextvars.copy_context()
+                    queue.append(pool.submit(ctx.run, self._fn, item))
+                    break
+                yield result
+        finally:
+            for f in queue:
+                f.cancel()
+            pool.shutdown(wait=True)
+
+
+def read_in_background(fn: Callable[..., Any], *args,
+                       label: str = "io.read.validation",
+                       **kwargs) -> Future:
+    """Run one read on a background thread under an I/O span (in the
+    caller's context, so the span parents under the current stage) and
+    return its :class:`~concurrent.futures.Future`. The drivers use this
+    to kick the validation-data read off while training data uploads and
+    the first sweep runs; ``future.result()`` at first use is the join."""
+    ctx = contextvars.copy_context()
+    fut: Future = Future()
+
+    def run() -> None:
+        try:
+            with tracing.span(label):
+                result = fn(*args, **kwargs)
+        except BaseException as e:  # delivered at the join, not lost
+            fut.set_exception(e)
+        else:
+            fut.set_result(result)
+
+    threading.Thread(target=lambda: ctx.run(run), daemon=True,
+                     name="photon-read-bg").start()
+    return fut

@@ -9,6 +9,10 @@ misbehave. The registered sites:
 ``io.read``               one visit per (file, attempt) in the Avro readers
 ``ckpt.save``             one visit per save attempt, *between* the tmp write
                           and the atomic rename — the crash-mid-write window
+``io.model_save``         one visit per model-publish attempt, between the
+                          fully-written staging tree and the atomic
+                          retire-then-rename (``io/pipeline.py``) — the
+                          background saver's crash window
 ``collective``            host-side collectives (allgather/allreduce) and
                           ``jax.distributed.initialize``
 ``optimizer.step``        one visit per coordinate-descent coordinate step
@@ -41,8 +45,8 @@ import numpy as np
 
 #: canonical site names (free-form strings are accepted; these are the ones
 #: the framework threads)
-SITES = ("io.read", "ckpt.save", "collective", "optimizer.step",
-         "worker.stall")
+SITES = ("io.read", "ckpt.save", "io.model_save", "collective",
+         "optimizer.step", "worker.stall")
 
 _MODES = ("raise", "nan", "stall")
 

@@ -41,6 +41,8 @@ def fresh_bench(monkeypatch):
     monkeypatch.setattr(bench, "_RESULTS", [])
     monkeypatch.setattr(bench, "_SUMMARY_DONE", [False])
     monkeypatch.setattr(bench, "_LAST_PROGRESS", [0.0])
+    monkeypatch.setattr(bench, "_GATE_DEFAULT", [True])
+    monkeypatch.setattr(bench, "_E2E_PERF_REPORT", [])
     yield bench
     signal.signal(signal.SIGTERM, prev)
 
@@ -276,3 +278,105 @@ class TestSharedBaselineRates:
         assert calls == [1]
         fresh_bench._host_cd_rate(fresh=True)      # bypasses the cache
         assert calls == [1, 1]
+
+
+class TestBenchGate:
+    """The suite's auto-gate: verdict vs the last sound artifact, emitted
+    as its own JSON line and embedded in the terminal summary (which must
+    stay the FINAL line — the harness parses the tail's last line)."""
+
+    def _baseline(self, tmp_path, metrics, rc=0):
+        doc = {"rc": rc, "parsed": {
+            "metric": "suite_summary", "value": 1.0, "unit": "x",
+            "vs_baseline": 1.0, "n_metrics": len(metrics),
+            "metrics": {k: {"value": v, "unit": "x"}
+                        for k, v in metrics.items()}}}
+        p = tmp_path / "BENCH_r91.json"
+        p.write_text(json.dumps(doc))
+        return str(p)
+
+    def test_ok_verdict_embedded_and_printed(self, fresh_bench, capsys,
+                                             monkeypatch, tmp_path):
+        monkeypatch.setenv("PHOTON_BENCH_BASELINE",
+                           self._baseline(tmp_path, {"m": 100.0}))
+        fresh_bench._emit("m", 101.0, "x", 1.0)
+        fresh_bench._emit_summary()
+        lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()
+                 if l.startswith("{")]
+        gate_lines = [l for l in lines if l.get("metric") == "bench_gate"]
+        assert len(gate_lines) == 1
+        assert gate_lines[0]["verdict"] == "ok"
+        assert gate_lines[0]["baseline"] == "BENCH_r91.json"
+        # the summary is the FINAL line and carries the verdict
+        assert lines[-1]["metric"] == "suite_summary"
+        assert lines[-1]["gate"]["verdict"] == "ok"
+
+    def test_regression_attaches_perf_report(self, fresh_bench, capsys,
+                                             monkeypatch, tmp_path):
+        monkeypatch.setenv("PHOTON_BENCH_BASELINE",
+                           self._baseline(tmp_path, {"m": 100.0}))
+        monkeypatch.setattr(fresh_bench, "_E2E_PERF_REPORT",
+                            ["== photon performance report ==\n..."])
+        fresh_bench._emit("m", 10.0, "x", 1.0)  # 10x drop
+        fresh_bench._emit_summary()
+        lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()
+                 if l.startswith("{")]
+        gate = next(l for l in lines if l.get("metric") == "bench_gate")
+        assert gate["verdict"] == "regression"
+        assert gate["perf_report"].startswith("== photon performance")
+        # the critical path rides the printed line, not the artifact's
+        # summary (which future gates read for metrics only)
+        assert "perf_report" not in lines[-1]["gate"]
+
+    def test_infra_failed_baseline_is_skipped(self, fresh_bench, capsys,
+                                              monkeypatch, tmp_path):
+        monkeypatch.setenv("PHOTON_BENCH_BASELINE",
+                           self._baseline(tmp_path, {"m": 100.0}, rc=3))
+        fresh_bench._emit("m", 10.0, "x", 1.0)
+        fresh_bench._emit_summary()
+        lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()
+                 if l.startswith("{")]
+        gate = next(l for l in lines if l.get("metric") == "bench_gate")
+        # rc!=0 baseline is not sound -> current becomes the baseline
+        assert gate["verdict"] == "missing-baseline"
+
+    def test_error_summary_skips_the_gate(self, fresh_bench, capsys,
+                                          monkeypatch, tmp_path):
+        monkeypatch.setenv("PHOTON_BENCH_BASELINE",
+                           self._baseline(tmp_path, {"m": 100.0}))
+        fresh_bench._emit("m", 10.0, "x", 1.0)
+        fresh_bench._emit_summary(error="device unreachable")
+        lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()
+                 if l.startswith("{")]
+        assert not any(l.get("metric") == "bench_gate" for l in lines)
+        assert "gate" not in lines[-1]
+
+    def test_gate_disabled_by_env(self, fresh_bench, capsys, monkeypatch,
+                                  tmp_path):
+        monkeypatch.setenv("PHOTON_BENCH_BASELINE",
+                           self._baseline(tmp_path, {"m": 100.0}))
+        monkeypatch.setenv("PHOTON_BENCH_GATE", "0")
+        fresh_bench._emit("m", 10.0, "x", 1.0)
+        fresh_bench._emit_summary()
+        lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()
+                 if l.startswith("{")]
+        assert not any(l.get("metric") == "bench_gate" for l in lines)
+
+    def test_find_baseline_prefers_newest_sound_round(self, fresh_bench,
+                                                      monkeypatch,
+                                                      tmp_path):
+        """BENCH_r*.json scan: newest first, infra-failed rounds (like
+        r05's device outage) skipped."""
+        sound = {"rc": 0, "parsed": {
+            "metric": "suite_summary", "value": 1.0, "unit": "x",
+            "vs_baseline": 1.0, "n_metrics": 1,
+            "metrics": {"m": {"value": 5.0, "unit": "x"}}}}
+        dead = {"rc": 3, "parsed": {"metric": "suite_summary",
+                                    "error": "device unreachable"}}
+        (tmp_path / "BENCH_r01.json").write_text(json.dumps(sound))
+        (tmp_path / "BENCH_r02.json").write_text(json.dumps(dead))
+        monkeypatch.delenv("PHOTON_BENCH_BASELINE", raising=False)
+        monkeypatch.setattr(fresh_bench.os.path, "dirname",
+                            lambda p: str(tmp_path))
+        path, art = fresh_bench._find_baseline()
+        assert os.path.basename(path) == "BENCH_r01.json"

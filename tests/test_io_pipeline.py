@@ -1,0 +1,457 @@
+"""Async I/O pipeline (io/pipeline.py): background save parity +
+atomicity, alias publication, writer-error propagation, the decode
+prefetcher, and the background validation read.
+
+The load-bearing acceptance properties:
+
+- **Byte parity** — a background (staged + fan-out) save must produce the
+  same bytes as the old synchronous in-place save. The only
+  nondeterminism in the writers is the Avro container's spec-mandated
+  random 16-byte sync marker, so the byte-for-byte comparison pins the
+  entropy source (and uses the Python writer — the native writer draws
+  its marker from C++ ``std::random_device``, which a test can't seed);
+  the native path is covered by record-level + container-metadata parity.
+- **Crash-safe publication** — an ``io.model_save`` fault injected
+  mid-background-save (the ``PHOTON_FAULT_PLAN`` site; activated here via
+  the same :func:`~photon_ml_tpu.resilience.injected` hook the env var
+  routes to) must never expose a partial model: the save retries and
+  republishes, or fails leaving the previous model untouched — the
+  serving registry's validate finds nothing to reject because nothing
+  partial ever exists at the final path.
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu import native
+from photon_ml_tpu.game.model import (
+    FixedEffectModel,
+    GameModel,
+    RandomEffectModel,
+)
+from photon_ml_tpu.io.avro import iter_avro_file
+from photon_ml_tpu.io.index import build_index_map
+from photon_ml_tpu.io.model_io import load_game_model, save_game_model
+from photon_ml_tpu.io.pipeline import (
+    BackgroundSaver,
+    DecodePrefetcher,
+    publish_model_alias,
+    read_in_background,
+    save_game_model_atomic,
+)
+from photon_ml_tpu.models.coefficients import Coefficients
+from photon_ml_tpu.models.glm import GeneralizedLinearModel
+from photon_ml_tpu.resilience import FaultPlan, FaultSpec, injected
+from photon_ml_tpu.types import TaskType, feature_key
+
+
+def make_game_model(seed: int = 0, n_entities: int = 6, dim: int = 4,
+                    d_fixed: int = 5) -> tuple[GameModel, dict, dict]:
+    """A small host-resident GAME model + matching index maps/vocabs."""
+    rng = np.random.default_rng(seed)
+    import jax.numpy as jnp
+
+    fixed = FixedEffectModel(
+        model=GeneralizedLinearModel(
+            coefficients=Coefficients(
+                means=jnp.asarray(rng.normal(size=d_fixed).astype(np.float32))),
+            task=TaskType.LOGISTIC_REGRESSION),
+        feature_shard_id="fixed")
+    # 2 coefficients per entity, sorted keys (entity * dim + feature)
+    keys = np.sort(np.concatenate([
+        e * dim + rng.choice(dim, size=2, replace=False)
+        for e in range(n_entities)]).astype(np.int64))
+    re = RandomEffectModel(
+        random_effect_type="userId", feature_shard_id="re",
+        task=TaskType.LOGISTIC_REGRESSION, dim=dim, keys=keys,
+        coeffs=rng.normal(size=len(keys)).astype(np.float32))
+    model = GameModel(coordinates={"global": fixed, "perUser": re},
+                      task=TaskType.LOGISTIC_REGRESSION)
+    index_maps = {
+        "fixed": build_index_map([feature_key(f"x{i}")
+                                  for i in range(d_fixed)],
+                                 add_intercept=False),
+        "re": build_index_map([feature_key(f"r{i}") for i in range(dim)],
+                              add_intercept=False),
+    }
+    vocabs = {"userId": {f"u{i}": i for i in range(n_entities)}}
+    return model, index_maps, vocabs
+
+
+def tree_bytes(root: str) -> dict[str, bytes]:
+    out = {}
+    for dirpath, _dirs, files in os.walk(root):
+        for name in files:
+            p = os.path.join(dirpath, name)
+            with open(p, "rb") as f:
+                out[os.path.relpath(p, root)] = f.read()
+    return out
+
+
+def tree_records(root: str) -> dict[str, object]:
+    """Decoded view of a model dir: Avro files as record lists, JSON as
+    parsed objects — the writer-agnostic content identity."""
+    out = {}
+    for rel, raw in tree_bytes(root).items():
+        p = os.path.join(root, rel)
+        if rel.endswith(".avro"):
+            out[rel] = list(iter_avro_file(p))
+        elif rel.endswith(".json"):
+            out[rel] = json.loads(raw)
+        else:
+            out[rel] = raw
+    return out
+
+
+class TestBackgroundSaveParity:
+    def test_byte_identical_to_synchronous_save(self, tmp_path,
+                                                monkeypatch):
+        """With the container sync marker pinned (and the native writer —
+        whose marker a test can't seed — disabled), the background save's
+        tree is byte-for-byte the synchronous save's tree."""
+        monkeypatch.setattr(os, "urandom", lambda n: b"\x07" * n)
+        monkeypatch.setattr(native, "available", lambda: False)
+        model, index_maps, vocabs = make_game_model()
+        sync_dir = str(tmp_path / "sync")
+        bg_dir = str(tmp_path / "bg")
+        save_game_model(sync_dir, model, index_maps, vocabs)
+        saver = BackgroundSaver()
+        try:
+            saver.submit_game_save(bg_dir, model, index_maps, vocabs)
+            saver.join()
+        finally:
+            saver.close()
+        a, b = tree_bytes(sync_dir), tree_bytes(bg_dir)
+        assert sorted(a) == sorted(b)
+        for rel in a:
+            assert a[rel] == b[rel], f"{rel} differs"
+
+    @pytest.mark.skipif(not native.available(),
+                        reason="native writer unavailable")
+    def test_native_path_record_identical(self, tmp_path):
+        """Native RE writer path: same records, same file set, same
+        metadata (bytes differ only in the random sync markers)."""
+        model, index_maps, vocabs = make_game_model(seed=3)
+        sync_dir = str(tmp_path / "sync")
+        bg_dir = str(tmp_path / "bg")
+        save_game_model(sync_dir, model, index_maps, vocabs)
+        saver = BackgroundSaver()
+        try:
+            saver.submit_game_save(bg_dir, model, index_maps, vocabs)
+            saver.join()
+        finally:
+            saver.close()
+        a, b = tree_records(sync_dir), tree_records(bg_dir)
+        assert sorted(a) == sorted(b)
+        for rel in a:
+            assert a[rel] == b[rel], f"{rel} differs"
+        # same loaded scores through the real loader
+        la = load_game_model(sync_dir, index_maps, vocabs)
+        lb = load_game_model(bg_dir, index_maps, vocabs)
+        for cid in la.coordinates:
+            ma, mb = la.coordinates[cid], lb.coordinates[cid]
+            if isinstance(ma, RandomEffectModel):
+                np.testing.assert_array_equal(ma.keys, mb.keys)
+                np.testing.assert_allclose(ma.coeffs, mb.coeffs)
+
+
+class TestAtomicPublication:
+    def test_injected_fault_mid_save_never_exposes_partial(self, tmp_path):
+        """The io.model_save site fires between the fully-written staging
+        tree and the rename: under the default retry policy the save
+        retries and publishes; the final dir is only ever the old model or
+        the complete new one, and no staging leftovers survive."""
+        model_a, index_maps, vocabs = make_game_model(seed=1)
+        model_b, _, _ = make_game_model(seed=2)
+        out = str(tmp_path / "model")
+        save_game_model_atomic(out, model_a, index_maps, vocabs)
+        before = tree_records(out)
+
+        plan = FaultPlan([FaultSpec(site="io.model_save", at=(0,))])
+        saver = BackgroundSaver()
+        try:
+            with injected(plan):
+                saver.submit_game_save(out, model_b, index_maps, vocabs)
+                saver.join()
+        finally:
+            saver.close()
+        assert plan.fired("io.model_save"), "the fault never fired"
+        after = tree_records(out)
+        # the new model is fully published (≠ old), atomically
+        assert after != before
+        loaded = load_game_model(out, index_maps, vocabs)
+        np.testing.assert_allclose(
+            np.asarray(loaded.coordinates["perUser"].coeffs),
+            np.asarray(model_b.coordinates["perUser"].coeffs), atol=1e-6)
+        stray = [n for n in os.listdir(tmp_path) if n.endswith(".tmp")]
+        assert stray == []
+
+    def test_unrecoverable_fault_keeps_previous_model(self, tmp_path):
+        """Every retry faulting: the join raises, and the previously
+        published model is still byte-for-byte intact — the registry's
+        validate would find nothing partial to reject."""
+        from photon_ml_tpu.resilience import InjectedFault
+
+        model_a, index_maps, vocabs = make_game_model(seed=1)
+        model_b, _, _ = make_game_model(seed=2)
+        out = str(tmp_path / "model")
+        save_game_model_atomic(out, model_a, index_maps, vocabs)
+        before = tree_bytes(out)
+
+        plan = FaultPlan([FaultSpec(site="io.model_save", rate=1.0)])
+        saver = BackgroundSaver()
+        try:
+            with injected(plan):
+                saver.submit_game_save(out, model_b, index_maps, vocabs)
+                with pytest.raises(InjectedFault):
+                    saver.join()
+        finally:
+            saver.close()
+        assert tree_bytes(out) == before
+        stray = [n for n in os.listdir(tmp_path) if n.endswith(".tmp")]
+        assert stray == []
+
+    def test_driver_survives_io_model_save_fault(self, tmp_path):
+        """e2e: a train_game run with an injected io.model_save fault (the
+        PHOTON_FAULT_PLAN site) completes under retry and leaves a fully
+        loadable best/ model."""
+        import sys
+
+        sys.path.insert(0, os.path.dirname(__file__))
+        from test_cli import COORDS, SHARDS, make_avro_dataset
+
+        from photon_ml_tpu.cli import train_game as train_game_cli
+
+        train = make_avro_dataset(tmp_path / "train.avro", n=300, seed=0)
+        out = str(tmp_path / "out")
+        plan = FaultPlan([FaultSpec(site="io.model_save", at=(0,))])
+        with injected(plan):
+            result = train_game_cli.run([
+                "--training-data", train,
+                "--output-dir", out,
+                "--feature-shards", SHARDS,
+                "--coordinates", *COORDS,
+                "--update-sequence", "global,perUser",
+                "--grid", "global=0.1", "perUser=1",
+            ])
+        assert result["n_configurations"] == 1
+        assert plan.fired("io.model_save"), "the fault never fired"
+        assert os.path.exists(
+            os.path.join(out, "best", "model-metadata.json"))
+        stray = [n for n in os.listdir(out) if n.endswith(".tmp")]
+        assert stray == []
+
+    def test_fault_plan_env_spec_parses_site(self):
+        """The exact JSON a PHOTON_FAULT_PLAN env value would carry for
+        this site round-trips through the plan parser (the env path calls
+        FaultPlan.from_json verbatim)."""
+        plan = FaultPlan.from_json(
+            '{"seed": 3, "specs": [{"site": "io.model_save", "at": [0]}]}')
+        assert plan.specs[0].site == "io.model_save"
+        assert plan.to_json() == FaultPlan.from_json(plan.to_json()).to_json()
+
+
+class TestAliasPublication:
+    def test_alias_hardlinks_and_annotates(self, tmp_path):
+        model, index_maps, vocabs = make_game_model()
+        src = str(tmp_path / "all" / "config-1")
+        dst = str(tmp_path / "best")
+        save_game_model_atomic(src, model, index_maps, vocabs)
+        publish_model_alias(src, dst)
+        meta = json.load(open(os.path.join(dst, "model-metadata.json")))
+        assert meta["aliasOf"] == os.path.join("all", "config-1")
+        part = os.path.join("random-effect", "perUser", "coefficients",
+                            "part-00000.avro")
+        # part-files shared, not re-serialized (hardlink on this fs)
+        assert (os.stat(os.path.join(src, part)).st_ino
+                == os.stat(os.path.join(dst, part)).st_ino)
+        # the alias loads like any model dir
+        loaded = load_game_model(dst, index_maps, vocabs)
+        assert set(loaded.coordinates) == {"global", "perUser"}
+
+    def test_alias_republish_over_existing(self, tmp_path):
+        model_a, index_maps, vocabs = make_game_model(seed=1)
+        model_b, _, _ = make_game_model(seed=2)
+        src_a = str(tmp_path / "all" / "config-0")
+        src_b = str(tmp_path / "all" / "config-1")
+        dst = str(tmp_path / "best")
+        save_game_model_atomic(src_a, model_a, index_maps, vocabs)
+        save_game_model_atomic(src_b, model_b, index_maps, vocabs)
+        publish_model_alias(src_a, dst)
+        publish_model_alias(src_b, dst)  # retire-then-rename over old alias
+        meta = json.load(open(os.path.join(dst, "model-metadata.json")))
+        assert meta["aliasOf"] == os.path.join("all", "config-1")
+        loaded = load_game_model(dst, index_maps, vocabs)
+        np.testing.assert_allclose(
+            np.asarray(loaded.coordinates["perUser"].coeffs),
+            np.asarray(model_b.coordinates["perUser"].coeffs), atol=1e-6)
+
+
+class TestBackgroundSaver:
+    def test_join_propagates_first_error(self):
+        saver = BackgroundSaver()
+        try:
+            saver.submit(lambda: None, label="io.save.task")
+            saver.submit(lambda: (_ for _ in ()).throw(
+                RuntimeError("disk full")), label="io.save.task")
+            with pytest.raises(RuntimeError, match="disk full"):
+                saver.join()
+            # the failed batch is drained: a fresh join is clean
+            saver.join()
+        finally:
+            saver.close()
+
+    def test_submitted_spans_parent_under_callers_span(self, tmp_path):
+        from photon_ml_tpu.telemetry import tracing
+
+        trace = str(tmp_path / "trace.jsonl")
+        tracing.configure(trace)
+        try:
+            saver = BackgroundSaver()
+            with tracing.span("stage"):
+                saver.submit(lambda: None, label="io.save.task")
+                saver.join()
+            saver.close()
+        finally:
+            tracing.close()
+        records = [json.loads(l) for l in open(trace)]
+        by_name = {r["name"]: r for r in records}
+        assert by_name["io.save.task"]["parent_id"] \
+            == by_name["stage"]["span_id"]
+
+
+class TestDecodePrefetcher:
+    def test_yields_in_submission_order(self):
+        started = []
+
+        def work(i):
+            started.append(i)
+            return i * i
+
+        out = list(DecodePrefetcher(work, range(10), workers=3))
+        assert out == [i * i for i in range(10)]
+        assert sorted(started) == list(range(10))
+
+    def test_error_cancels_and_propagates(self):
+        def work(i):
+            if i == 3:
+                raise ValueError("corrupt file")
+            return i
+
+        with pytest.raises(ValueError, match="corrupt file"):
+            list(DecodePrefetcher(work, range(100), workers=2))
+
+    def test_consumer_break_cancels_remaining(self):
+        ran = []
+        gate = threading.Event()
+
+        def work(i):
+            gate.wait(5.0)
+            ran.append(i)
+            return i
+
+        pf = iter(DecodePrefetcher(work, range(50), workers=1, window=2))
+        gate.set()
+        assert next(pf) == 0
+        pf.close()  # consumer walks away: queued items are cancelled
+        assert len(ran) <= 3  # in-flight window only, never all 50
+
+    def test_bounded_window(self):
+        in_flight = []
+        peak = []
+        lock = threading.Lock()
+
+        def work(i):
+            with lock:
+                in_flight.append(i)
+                peak.append(len(in_flight))
+            result = i
+            with lock:
+                in_flight.remove(i)
+            return result
+
+        list(DecodePrefetcher(work, range(30), workers=2, window=3))
+        assert max(peak) <= 3
+
+
+class TestBackgroundRead:
+    def test_result_matches_direct_call(self):
+        fut = read_in_background(lambda a, b: a + b, 2, b=3,
+                                 label="io.read.validation")
+        assert fut.result(timeout=10) == 5
+
+    def test_exception_delivered_at_join(self):
+        def boom():
+            raise OSError("no such file")
+
+        fut = read_in_background(boom)
+        with pytest.raises(OSError, match="no such file"):
+            fut.result(timeout=10)
+
+
+@pytest.mark.skipif(not native.available(),
+                    reason="native decoder unavailable")
+class TestStreamedIngestParity:
+    def test_multi_file_native_matches_python_codec(self, tmp_path):
+        """The prefetching (streamed-assembly) native read is
+        element-identical to the pure-Python codec on a multi-file input,
+        in both training (maps built) and frozen-vocab (preset maps)
+        modes — the barrier removal must not change a single id."""
+        from photon_ml_tpu.cli.config import parse_feature_shard_config
+        from photon_ml_tpu.io.data_reader import (
+            AvroDataReader,
+            write_training_examples,
+        )
+
+        rng = np.random.default_rng(0)
+        files = []
+        for k in range(4):
+            r = np.random.default_rng(k)
+            recs = []
+            for i in range(40):
+                feats = [{"name": f"f.x{j}", "term": "", "value": float(v)}
+                         for j, v in zip(r.choice(20, 5, replace=False),
+                                         r.normal(size=5))]
+                recs.append({"uid": str(i),
+                             "response": float(r.integers(0, 2)),
+                             "offset": None, "weight": None,
+                             "features": feats,
+                             "metadataMap": {
+                                 "userId": f"u{r.integers(0, 23)}"}})
+            p = str(tmp_path / f"part-{k}.avro")
+            write_training_examples(p, recs)
+            files.append(p)
+
+        cfg = (parse_feature_shard_config("f=f|intercept"),)
+        dn, imn, vn = AvroDataReader(shard_configs=cfg).read(
+            files, id_columns=["userId"])
+        dp, imp, vp = AvroDataReader(shard_configs=cfg,
+                                     use_native=False).read(
+            files, id_columns=["userId"])
+        assert dn.n_samples == dp.n_samples == 160
+        assert list(imn["f"].names()) == list(imp["f"].names())
+        assert vn == vp
+        np.testing.assert_array_equal(dn.labels, dp.labels)
+        np.testing.assert_array_equal(dn.id_columns["userId"],
+                                      dp.id_columns["userId"])
+        sn, sp = dn.shards["f"], dp.shards["f"]
+        np.testing.assert_array_equal(sn.indptr, sp.indptr)
+        np.testing.assert_array_equal(sn.cols, sp.cols)
+        np.testing.assert_allclose(sn.vals, sp.vals)
+
+        # frozen-vocab preset-map mode (the per-file streamed CSR split)
+        dv, _, _ = AvroDataReader(shard_configs=cfg, index_maps=imn).read(
+            files, id_columns=["userId"], entity_vocabs=vn)
+        dv2, _, _ = AvroDataReader(shard_configs=cfg, index_maps=imn,
+                                   use_native=False).read(
+            files, id_columns=["userId"], entity_vocabs=vn)
+        np.testing.assert_array_equal(dv.id_columns["userId"],
+                                      dv2.id_columns["userId"])
+        np.testing.assert_array_equal(dv.shards["f"].indptr,
+                                      dv2.shards["f"].indptr)
+        np.testing.assert_allclose(dv.shards["f"].vals,
+                                   dv2.shards["f"].vals)

@@ -299,6 +299,54 @@ class TestPerfReport:
         assert m.endswith("metrics.aggregate.prom")
 
 
+# async-I/O overlap fixture: one sweep [2, 8]; a background save spans
+# [6, 10] (2 s of its 4 s hidden under the sweep), its child part span
+# must NOT double-count; a read spans [0, 4] (2 s hidden)
+OVERLAP_TRACE = [
+    {"name": "train_game", "span_id": 1, "parent_id": None, "ts": 100.0,
+     "t0": 0.0, "t1": 11.0, "seconds": 11.0},
+    {"name": "cd.sweep", "span_id": 2, "parent_id": 1, "ts": 102.0,
+     "t0": 2.0, "t1": 8.0, "seconds": 6.0, "sweep": 0},
+    {"name": "io.save.model", "span_id": 3, "parent_id": 1, "ts": 106.0,
+     "t0": 6.0, "t1": 10.0, "seconds": 4.0, "path": "out/best"},
+    {"name": "io.save.part", "span_id": 4, "parent_id": 3, "ts": 106.1,
+     "t0": 6.1, "t1": 9.9, "seconds": 3.8, "coordinate": "perUser"},
+    {"name": "io.read.validation", "span_id": 5, "parent_id": 1,
+     "ts": 100.0, "t0": 0.0, "t1": 4.0, "seconds": 4.0},
+]
+
+
+def _with_process(spans):
+    # load_spans stamps process=0; direct fixtures do the same here
+    return [dict(s, process=0) for s in spans]
+
+
+class TestIoOverlap:
+    def test_overlap_numbers(self):
+        ov = perf_report.io_overlap(_with_process(OVERLAP_TRACE))
+        assert ov["train_wall_s"] == pytest.approx(6.0)
+        # nested io.save.part is counted through its parent only
+        assert ov["save"]["spans"] == 1
+        assert ov["save"]["seconds"] == pytest.approx(4.0)
+        assert ov["save"]["hidden_seconds"] == pytest.approx(2.0)
+        assert ov["save"]["hidden_pct"] == pytest.approx(50.0)
+        assert ov["read"]["seconds"] == pytest.approx(4.0)
+        assert ov["read"]["hidden_seconds"] == pytest.approx(2.0)
+
+    def test_report_renders_overlap_section(self):
+        report = perf_report.build_report(_with_process(OVERLAP_TRACE),
+                                          "", top=5)
+        assert "-- async I/O overlap (hidden under train) --" in report
+        assert "save: 4.000 s across 1 span(s), 50.0% hidden" in report
+        assert "read: 4.000 s across 1 span(s), 50.0% hidden" in report
+
+    def test_no_io_spans_no_section(self):
+        assert perf_report.io_overlap(
+            _with_process([s for s in TRACE_FIXTURE
+                           if s["span_id"] is not None])) is None
+        # the golden above already proves the section is absent there
+
+
 def _summary(metrics, error=None):
     doc = {"metric": "suite_summary", "value": 1.0, "unit": "x",
            "vs_baseline": 1.0, "n_metrics": len(metrics),

@@ -1,5 +1,7 @@
 """Tier-1 wrapper for ``tools/check_resilience_hygiene.py`` (no bare
-``except:``; no ``time.sleep`` outside ``resilience/retry.py``)."""
+``except:``; no ``time.sleep`` outside ``resilience/retry.py``; no model
+part-file writes outside ``io/`` — they must go through the atomic
+staged publish)."""
 
 import os
 import sys
@@ -26,6 +28,15 @@ def test_package_is_clean():
     # unrelated .sleep attributes / names must not trip the check
     ("class X:\n    def sleep(self):\n        pass\nX().sleep()\n", 0),
     ("import os\nos.path.join('a', 'b')\n", 0),
+    # rule 3: bare part-file writes outside io/
+    ('open("part-00000.avro", "w")\n', 1),
+    ('open(os.path.join(d, "coefficients", "part-00000.avro"), "wb")\n', 1),
+    ('open(path, mode="w")\n', 0),  # no part-file literal in the call
+    ('open("part-00000.avro")\n', 0),  # a read is fine
+    ('open("part-00000.avro", "rb")\n', 0),
+    ('write_avro_file(os.path.join(d, "part-00000.avro"), recs, SCHEMA)\n',
+     1),
+    ('write_avro_file(os.path.join(d, "scores.avro"), recs, SCHEMA)\n', 0),
 ])
 def test_detector(snippet, n):
     assert len(hygiene.check_source(snippet, "photon_ml_tpu/x.py")) == n
@@ -35,3 +46,12 @@ def test_retry_module_is_exempt():
     src = "import time\ntime.sleep(1)\n"
     assert hygiene.check_source(
         src, os.path.join("photon_ml_tpu", "resilience", "retry.py")) == []
+
+
+def test_io_package_may_write_part_files():
+    src = 'open("part-00000.avro", "w")\n'
+    assert hygiene.check_source(
+        src, os.path.join("photon_ml_tpu", "io", "model_io.py")) == []
+    # cli/ is NOT exempt — the rule exists for the drivers
+    assert len(hygiene.check_source(
+        src, os.path.join("photon_ml_tpu", "cli", "train_game.py"))) == 1

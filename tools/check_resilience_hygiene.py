@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Static resilience-hygiene check over ``photon_ml_tpu/``.
 
-Two rules, both load-bearing for the resilience subsystem:
+Three rules, all load-bearing for the resilience subsystem:
 
 1. **No bare ``except:``** — a bare handler swallows ``KeyboardInterrupt``
    and ``SystemExit``, which is exactly how a "resilient" run turns into an
@@ -10,6 +10,14 @@ Two rules, both load-bearing for the resilience subsystem:
    route through the retry module's sanctioned sleep so backoff, deadlines,
    and injected stalls share one accounting chokepoint; an ad-hoc sleep is
    invisible to ``--retry-deadline-s`` and to the bench watchdog.
+3. **No model/index part-file writes outside ``io/``** — a bare
+   ``open(...part-*.avro, "w")`` (or direct ``write_avro_file`` of a
+   part-file) in driver code bypasses the staged-directory
+   retire-then-rename publish in ``io/pipeline.py``: a crash mid-write
+   would expose a partial model to the serving registry. Part-files are
+   written by ``io/model_io.py`` and published atomically
+   (``save_game_model_atomic`` / ``BackgroundSaver``) — route through
+   them.
 
 Run directly (``python tools/check_resilience_hygiene.py [root]``, exit 1 on
 violations) or through the tier-1 test ``tests/test_resilience_hygiene.py``.
@@ -24,6 +32,10 @@ import sys
 #: the one module allowed to sleep (it owns backoff + injected stalls)
 SLEEP_ALLOWED = {os.path.join("photon_ml_tpu", "resilience", "retry.py")}
 
+#: the package prefix allowed to write model part-files (it owns the
+#: atomic staged publish)
+PART_WRITE_ALLOWED_PREFIX = os.path.join("photon_ml_tpu", "io") + os.sep
+
 
 def _is_time_sleep(node: ast.AST, time_aliases: set[str],
                    sleep_names: set[str]) -> bool:
@@ -34,10 +46,40 @@ def _is_time_sleep(node: ast.AST, time_aliases: set[str],
     return False
 
 
+def _is_part_file_write(node: ast.AST) -> bool:
+    """True for ``open(..)`` / ``write_avro_file(..)`` calls whose argument
+    tree contains a ``part-*.avro`` string literal (the model part-file
+    naming contract — ``os.path.join(..., "part-00000.avro")`` included)."""
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    name = fn.id if isinstance(fn, ast.Name) else (
+        fn.attr if isinstance(fn, ast.Attribute) else None)
+    if name not in ("open", "write_avro_file"):
+        return False
+    for sub in ast.walk(node):
+        if (isinstance(sub, ast.Constant) and isinstance(sub.value, str)
+                and "part-" in sub.value and sub.value.endswith(".avro")):
+            # reads are fine: only flag an explicit write mode / the writer
+            if name == "write_avro_file":
+                return True
+            mode = None
+            if len(node.args) > 1 and isinstance(node.args[1], ast.Constant):
+                mode = node.args[1].value
+            for kw in node.keywords:
+                if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                    mode = kw.value.value
+            return isinstance(mode, str) and ("w" in mode or "a" in mode
+                                              or "x" in mode)
+    return False
+
+
 def check_source(source: str, rel_path: str) -> list[str]:
     """Violations in one file, as ``path:line: message`` strings."""
     tree = ast.parse(source, filename=rel_path)
     sleep_ok = rel_path in {os.path.normpath(p) for p in SLEEP_ALLOWED}
+    part_ok = os.path.normpath(rel_path).startswith(
+        PART_WRITE_ALLOWED_PREFIX)
 
     # resolve what `time` / `sleep` are bound to in this module
     time_aliases: set[str] = set()
@@ -63,6 +105,12 @@ def check_source(source: str, rel_path: str) -> list[str]:
                        f"resilience/retry.py — route waits through the "
                        f"retry module so deadlines and the watchdog see "
                        f"them")
+        elif not part_ok and _is_part_file_write(node):
+            out.append(f"{rel_path}:{node.lineno}: model part-file write "
+                       f"outside io/ — a bare part-*.avro write bypasses "
+                       f"the atomic staged publish; route through "
+                       f"io.model_io.save_game_model / "
+                       f"io.pipeline.BackgroundSaver")
     return out
 
 

@@ -15,6 +15,11 @@ This tool renders them into one deterministic text report:
   ``photon_execute_latency_seconds{fn}`` — telemetry/profiling.py), per
   function and total, plus the process-wide XLA pipeline counters that
   catch un-wrapped jits;
+- **async I/O overlap** — how much of the ``io.save.*`` / ``io.read.*``
+  span time (the background writer/prefetcher pipeline,
+  ``io/pipeline.py``) lies hidden under training compute — the line that
+  makes the save/ingest overlap provable from artifacts (section present
+  only when the trace carries I/O spans);
 - **per-coordinate table** — ``cd.step`` spans folded per coordinate with
   the optimizer-iteration counters;
 - **FLOPs/s estimate** — ``photon_flops_total{fn}`` over the execute-sum
@@ -93,6 +98,66 @@ def exclusive_seconds(spans: Sequence[Mapping]) -> dict[tuple, dict]:
     return groups
 
 
+def _merge_intervals(intervals: list[tuple[float, float]],
+                     ) -> list[tuple[float, float]]:
+    out: list[tuple[float, float]] = []
+    for lo, hi in sorted(intervals):
+        if out and lo <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], hi))
+        else:
+            out.append((lo, hi))
+    return out
+
+
+def _overlap_seconds(lo: float, hi: float,
+                     merged: list[tuple[float, float]]) -> float:
+    return sum(max(0.0, min(hi, b) - max(lo, a)) for a, b in merged)
+
+
+def io_overlap(spans: Sequence[Mapping]) -> Optional[dict]:
+    """How much of the async I/O pipeline's wall was HIDDEN under
+    training compute: per class (``save`` = ``io.save.*`` spans, ``read``
+    = ``io.read.*`` spans), total span seconds and the fraction of them
+    that lies inside the union of train intervals (``cd.sweep`` spans plus
+    ``Train*`` stage spans), compared per process via the monotonic
+    ``t0``/``t1`` readings. Nested I/O spans (``io.save.part`` under
+    ``io.save.model``) count once — only spans whose direct parent is not
+    itself an I/O span are summed. None when the trace has no I/O spans."""
+    by_id = {(s["process"], s["span_id"]): s for s in spans}
+    train: dict[int, list[tuple[float, float]]] = {}
+    for s in spans:
+        if (s["name"] == "cd.sweep"
+                or (s.get("kind") == "stage"
+                    and str(s["name"]).startswith("Train"))):
+            train.setdefault(s["process"], []).append(
+                (float(s["t0"]), float(s["t1"])))
+    merged = {p: _merge_intervals(iv) for p, iv in train.items()}
+    out = {}
+    for cls in ("save", "read"):
+        total = hidden = 0.0
+        count = 0
+        for s in spans:
+            if not str(s["name"]).startswith(f"io.{cls}"):
+                continue
+            parent = by_id.get((s["process"], s.get("parent_id")))
+            if parent is not None and str(parent["name"]).startswith("io."):
+                continue  # nested I/O span: counted via its parent
+            total += float(s["seconds"])
+            hidden += _overlap_seconds(float(s["t0"]), float(s["t1"]),
+                                       merged.get(s["process"], []))
+            count += 1
+        if count:
+            out[cls] = {"seconds": total, "hidden_seconds": hidden,
+                        "spans": count,
+                        "hidden_pct": (100.0 * hidden / total
+                                       if total > 0 else 0.0)}
+    if not out:
+        return None
+    out["train_wall_s"] = sum(hi - lo for iv in merged.values()
+                              for lo, hi in iv)
+    return out
+
+
 def _labeled(parsed: Mapping, series: str, label: str) -> dict[str, float]:
     """{label value: sample value} over one series' samples."""
     out: dict[str, float] = {}
@@ -139,6 +204,19 @@ def build_report(spans: Sequence[Mapping], prom_text: str,
                      f"{g['calls']:>6d}  {label}{tag}")
     if not groups:
         lines.append("  (no spans)")
+
+    # --- async I/O overlap -----------------------------------------------
+    overlap = io_overlap(spans)
+    if overlap is not None:
+        lines.append("")
+        lines.append("-- async I/O overlap (hidden under train) --")
+        lines.append(f"train wall {overlap['train_wall_s']:.3f} s")
+        for cls in ("save", "read"):
+            if cls in overlap:
+                o = overlap[cls]
+                lines.append(
+                    f"{cls}: {o['seconds']:.3f} s across {o['spans']} "
+                    f"span(s), {o['hidden_pct']:.1f}% hidden")
 
     # --- compile vs execute ----------------------------------------------
     lines.append("")
