@@ -1070,6 +1070,13 @@ def bench_end_to_end():
         # locked by tests/test_game.py)
         "--design-dtype", "bfloat16",
     ]
+    # PHOTON_BENCH_SUPERVISE=N runs the measured e2e as an N-process
+    # supervised fleet (resilience/supervisor.py); the winner's restart
+    # count rides the metric line as an extra either way, so a future
+    # round can quantify recovery overhead against the unsupervised walls
+    supervise = int(os.environ.get("PHOTON_BENCH_SUPERVISE", "0") or 0)
+    if supervise:
+        args += ["--supervise", str(supervise)]
     def _residue_drain():
         # drop host/device residue before measuring: freed-but-resident
         # heap from a prior run inflates the next run's read stage 2-5x
@@ -1115,24 +1122,30 @@ def bench_end_to_end():
         # perf_report async-I/O-overlap section (and a regression gate
         # verdict, see _gate_line) can then PROVE how much of the
         # save/read wall was hidden under train, from artifacts alone.
-        wall, stages, best_td = None, {}, None
+        wall, stages, best_td, restarts = None, {}, None, None
         for i in range(2):
             _residue_drain()
             out = os.path.join(tmp, f"out{i}")
             td = os.path.join(out, "telemetry")
             t0 = time.perf_counter()
-            train_game_cli.run(args + ["--output-dir", out,
-                                       "--telemetry-dir", td])
+            res = train_game_cli.run(args + ["--output-dir", out,
+                                             "--telemetry-dir", td])
             w = time.perf_counter() - t0
             _heartbeat()
             assert os.path.exists(
                 os.path.join(out, "best", "model-metadata.json"))
             if wall is None or w < wall:
                 wall, stages, best_td = w, _stages_of(out), td
+                # supervised runs report their restart count; the extra
+                # makes recovery overhead visible round-over-round
+                restarts = res.get("restarts")
         overlap = _stash_perf_report(best_td)
     e2e_rate = E2E_ROWS / wall
     base_rate = 1.0 / (1.0 / py_ingest_rate + 1.0 / host_cd_rate)
     extra = {}
+    if restarts is not None:
+        extra["supervise"] = supervise
+        extra["restarts"] = int(restarts)
     if overlap:
         for cls in ("save", "read"):
             if cls in overlap:

@@ -263,6 +263,39 @@ def add_resilience_flags(parser) -> None:
              "continue degraded")
 
 
+def add_supervision_flags(parser) -> None:
+    """The supervised-recovery flags (train_game + train_glm): an external
+    :class:`~photon_ml_tpu.resilience.FleetSupervisor` owns the fleet's
+    process lifecycle and recovers the ASYMMETRIC fault class (one process
+    dead or stalled mid-collective) the in-process machinery cannot."""
+    parser.add_argument(
+        "--supervise", type=int, default=0, metavar="N",
+        help="launch the training as an N-process supervised fleet: this "
+             "command relaunches itself N times under a FleetSupervisor "
+             "that watches exit codes + per-process heartbeats and, on any "
+             "asymmetric failure (a process crash, or a heartbeat stale "
+             "past --heartbeat-timeout-s), kills the survivors and "
+             "restarts the WHOLE fleet from the latest agreed checkpoint. "
+             "0 (default) = train in this process, unsupervised")
+    parser.add_argument(
+        "--max-restarts", type=int, default=2, metavar="K",
+        help="supervised-fleet restart budget (restarts, not attempts; "
+             "exponential backoff between attempts). Past the budget the "
+             "supervisor raises with the failing processes' log tails")
+    parser.add_argument(
+        "--heartbeat-timeout-s", type=float, default=300.0,
+        help="declare a supervised process stalled when its heartbeat file "
+             "(touched at sweep/coordinate/collective boundaries) goes "
+             "this stale — size it above the longest healthy gap between "
+             "boundaries (a long healthy collective does not beat while "
+             "inside it). <= 0 disables stall detection (exit codes only)")
+    parser.add_argument(
+        "--restart-deadline-s", type=float, default=None,
+        help="hard wall-clock deadline across ALL supervised attempts "
+             "including backoff sleeps; like retries, the supervisor never "
+             "sleeps into a deadline it would then blow")
+
+
 def resilience_from_args(args) -> ResilienceConfig:
     return ResilienceConfig(max_retries=args.max_retries,
                             retry_deadline_s=args.retry_deadline_s,

@@ -16,6 +16,7 @@ from typing import Optional, Sequence
 
 from photon_ml_tpu.cli.config import (
     add_resilience_flags,
+    add_supervision_flags,
     add_telemetry_flags,
     install_resilience,
     install_telemetry,
@@ -128,6 +129,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "optimizer) and random-effect entity lanes over "
                         "'entity'. Default: single device")
     add_resilience_flags(p)
+    add_supervision_flags(p)
     add_telemetry_flags(p)
     return p
 
@@ -181,10 +183,54 @@ def _resolve_model_dir(path: str) -> str:
     raise FileNotFoundError(f"no model-metadata.json under {path!r}")
 
 
+def _run_supervised(raw_argv: Sequence[str], args) -> dict:
+    """The ``--supervise N`` branch: relaunch this command as an N-process
+    supervised fleet (workers get ``--checkpoint --resume`` so every
+    restart resumes from the latest agreed checkpoint, and ``--multihost``
+    at N > 1) and return the chief's result dict + the restart count.
+    Runs BEFORE any jax/backend touch — the supervisor process itself
+    never trains."""
+    from photon_ml_tpu.cli.config import (
+        install_telemetry,
+        parse_grid,
+        telemetry_from_args,
+    )
+    from photon_ml_tpu.resilience.supervisor import supervise_from_args
+
+    if args.tuning != "NONE" or len(parse_grid(args.grid)) != 1:
+        raise SystemExit(
+            "--supervise needs a single-config grid and no --tuning: "
+            "restart-from-checkpoint resumes ONE training (the same "
+            "constraint as --checkpoint/--resume)")
+    worker_flags = ["--checkpoint", "--resume"]
+    if args.supervise > 1:
+        worker_flags.append("--multihost")
+    # the supervisor's own telemetry (supervisor.run/attempt spans and the
+    # photon_supervisor_* bridge metrics) lands under supervisor/ — the
+    # worker processes own the run's telemetry dirs AND the metrics port
+    # (binding it here too would collide with the chief worker's server)
+    import dataclasses as _dc
+
+    telemetry = install_telemetry(_dc.replace(
+        telemetry_from_args(args,
+                            subdir=os.path.join("supervisor", "telemetry")),
+        metrics_port=0))
+    try:
+        return supervise_from_args("train_game", raw_argv, args,
+                                   worker_flags=worker_flags)
+    finally:
+        telemetry.close()
+
+
 def run(argv: Optional[Sequence[str]] = None) -> dict:
+    import sys
+
     from photon_ml_tpu.events import GLOBAL_BUS
 
-    args = build_parser().parse_args(argv)
+    raw_argv = list(sys.argv[1:] if argv is None else argv)
+    args = build_parser().parse_args(raw_argv)
+    if args.supervise:
+        return _run_supervised(raw_argv, args)
     task = TaskType(args.task)
     # install the retry policy BEFORE anything that might retry (multihost
     # initialization is the first candidate)
@@ -627,13 +673,20 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                         os.path.join(args.output_dir, "all",
                                      f"config-{best_i}"), best_dir)
             GLOBAL_BUS.post("model_saved", path=best_dir)
-        return {
+        result = {
             "best_config": dict(best.configuration.regularization_weights),
             "best_evaluation": (best.evaluation.as_dict()
                                 if best.evaluation else None),
             "n_configurations": len(results),
             "output_dir": args.output_dir,
         }
+        if chief:
+            # supervised runs: hand the result dict back to the supervisor
+            # (no-op unsupervised)
+            from photon_ml_tpu.resilience.supervisor import write_result_file
+
+            write_result_file(result)
+        return result
     finally:
         if saver is not None:
             # happy path already join()ed (errors propagated there); this

@@ -20,6 +20,7 @@ import numpy as np
 
 from photon_ml_tpu.cli.config import (
     add_resilience_flags,
+    add_supervision_flags,
     add_telemetry_flags,
     install_resilience,
     install_telemetry,
@@ -135,6 +136,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "--training-diagnostics or --design-dtype bfloat16 "
                         "yet")
     add_resilience_flags(p)
+    add_supervision_flags(p)
     add_telemetry_flags(p)
     return p
 
@@ -226,7 +228,30 @@ def _run_diagnostics(args, task, best, glm_train, glm_val, shard, stats, imap,
 
 
 def run(argv: Optional[Sequence[str]] = None) -> dict:
-    args = build_parser().parse_args(argv)
+    import sys
+
+    raw_argv = list(sys.argv[1:] if argv is None else argv)
+    args = build_parser().parse_args(raw_argv)
+    if args.supervise:
+        # supervised fleet: relaunch this command N times under the
+        # FleetSupervisor (before any jax/backend touch). The GLM sweep
+        # has no checkpoint — a restarted fleet re-solves from scratch,
+        # which the deterministic sweep makes exactly repeatable.
+        import dataclasses as _dc
+
+        from photon_ml_tpu.resilience.supervisor import supervise_from_args
+
+        telemetry = install_telemetry(_dc.replace(
+            telemetry_from_args(
+                args, subdir=os.path.join("supervisor", "telemetry")),
+            metrics_port=0))
+        try:
+            return supervise_from_args(
+                "train_glm", raw_argv, args,
+                worker_flags=(("--multihost",) if args.supervise > 1
+                              else ()))
+        finally:
+            telemetry.close()
     task = TaskType(args.task)
     # install the retry policy BEFORE anything that might retry (multihost
     # initialization is the first candidate)
@@ -508,13 +533,19 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                     args, task, best, glm_train, glm_val, shard, stats, imap,
                     config, normalization, reg_mask, run_logger)
 
-        return {
+        result = {
             "best_lambda": best.regularization_weight,
             "best_evaluation": (best.evaluation.as_dict()
                                 if best.evaluation else None),
             "output_dir": args.output_dir,
             "diagnostics_report": report_path,
         }
+        if chief:
+            # supervised runs: hand the result dict back to the supervisor
+            from photon_ml_tpu.resilience.supervisor import write_result_file
+
+            write_result_file(result)
+        return result
     finally:
         if saver is not None:
             # happy path already join()ed; this waits out writers a

@@ -8,6 +8,10 @@ spelling:
 - ``shard_map``: top-level export (jax >= 0.6) vs
   ``jax.experimental.shard_map`` (older), and the replication-check kwarg
   rename ``check_rep`` -> ``check_vma``.
+- ``typeof``: ``jax.typeof`` (jax >= 0.6's public aval accessor, whose
+  result carries the ``vma`` varying-manual-axes set inside ``shard_map``
+  bodies) vs ``jax.core.get_aval`` (older jax: same aval, no ``vma`` —
+  callers must treat the attribute as optional).
 - ``jax.sharding.AxisType`` is handled in :mod:`photon_ml_tpu.parallel.mesh`
   (mesh construction is the only consumer).
 """
@@ -32,3 +36,21 @@ def shard_map(f, *, check_vma=None, **kwargs):
     if check_vma is not None:
         kwargs[_REP_KWARG] = check_vma
     return _shard_map(f, **kwargs)
+
+
+def typeof(x):
+    """``jax.typeof`` on every supported jax version.
+
+    Returns the abstract value of ``x``. On jax versions that predate the
+    top-level export the result comes from ``jax.core.get_aval`` and does
+    NOT carry a ``vma`` attribute — read it with
+    ``getattr(typeof(x), "vma", ...)`` (exactly how ``ops/pallas_glm.py``
+    threads varying manual axes into its kernel out-structs)."""
+    import jax
+
+    fn = getattr(jax, "typeof", None)
+    if fn is not None:
+        return fn(x)
+    from jax.core import get_aval
+
+    return get_aval(x)

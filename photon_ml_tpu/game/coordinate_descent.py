@@ -23,7 +23,7 @@ from photon_ml_tpu.evaluation import evaluate_all
 from photon_ml_tpu.game.coordinate import Coordinate, CoordinateModel
 from photon_ml_tpu.game.data import GameData
 from photon_ml_tpu.game.model import GameModel
-from photon_ml_tpu.resilience import fault_point, fault_value
+from photon_ml_tpu.resilience import fault_point, fault_value, heartbeat
 from photon_ml_tpu.telemetry import metrics as _tmetrics
 from photon_ml_tpu.types import TaskType
 
@@ -252,6 +252,7 @@ class CoordinateDescent:
         history: list[dict[str, float]] = []
         final_evaluation = None
         for sweep in range(start_sweep, self.n_iterations):
+            heartbeat("cd.sweep")
             fault_point("worker.stall", sweep=sweep)
             with tracing.span("cd.sweep", sweep=sweep) as sweep_span:
                 if telemetry_on:
@@ -274,6 +275,7 @@ class CoordinateDescent:
                         # the next grid point sharing the guard) retrains:
                         # its new regularization may well not diverge.
                         continue
+                    heartbeat("cd.step")
                     with tracing.span("cd.step", coordinate=cid,
                                       sweep=sweep) as step_span, \
                             _STEP_DISPATCH.labels(

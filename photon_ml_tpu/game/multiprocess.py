@@ -68,7 +68,7 @@ from photon_ml_tpu.glm.problem import GLMOptimizationConfiguration
 from photon_ml_tpu.models.coefficients import Coefficients
 from photon_ml_tpu.models.glm import GeneralizedLinearModel
 from photon_ml_tpu.ops.objective import GLMData
-from photon_ml_tpu.resilience import fault_point, fault_value
+from photon_ml_tpu.resilience import fault_point, fault_value, heartbeat
 from photon_ml_tpu.types import TaskType
 
 logger = logging.getLogger(__name__)
@@ -1109,8 +1109,10 @@ def train_game_multiprocess(
     validation_history: list[dict] = list(resumed_history)
     lam = dict(lam)  # guard retries bump a coordinate's weight in place
     for sweep in range(start_sweep, n_cd_iterations):
+        heartbeat("mp.sweep")
         fault_point("worker.stall", sweep=sweep)
         for cid in update_sequence:
+            heartbeat("mp.step")
             if cid in locked:
                 continue  # frozen: scores stay as seeded
             if (guard is not None and cid in guard.frozen

@@ -108,6 +108,22 @@ class RandomEffectSolver:
         objective = GLMObjective(loss=loss_for_task(self.task))
         return OptimizationProblem(objective, self.config)
 
+    def _lane_axes(self) -> tuple[str, ...]:
+        """Every mesh axis name, entity last — bucket lanes shard over ALL
+        of them, for two reasons. Correctness: the lane shard_map runs with
+        ``check_vma=False`` (the while_loop carries defeat the checker), so
+        an out_spec that left a mesh axis unmentioned would make the
+        output's replication over that axis UNVERIFIED — and GSPMD
+        consumers then disagree about it (a gather takes one replica, a
+        reshape/concatenate sums them: the exact-``data``-width inflation
+        the 2D-mesh estimator tests pinned). Mentioning every axis leaves
+        nothing unverified. Parallelism: the per-entity solves have no
+        cross-lane communication at all, so a 2D ``(data, entity)`` mesh
+        solves ``data*entity`` lanes at once instead of idling the data
+        groups."""
+        names = [a for a in self.mesh.axis_names if a != self.entity_axis]
+        return tuple(names) + (self.entity_axis,)
+
     def _solve_bucket(self, x, labels, offsets, weights, w0, lam):
         """Batched solve: x (E,S,D), labels/offsets/weights (E,S), w0 (E,D).
 
@@ -128,13 +144,17 @@ class RandomEffectSolver:
         a = np.asarray(a)
         if self.mesh is None:
             return jnp.asarray(a)
-        n_dev = self.mesh.shape[self.entity_axis]
+        # lanes shard over EVERY mesh axis (see _lane_axes): pad to the full
+        # device count so each device owns a whole number of lanes
+        n_dev = int(np.prod([self.mesh.shape[ax]
+                             for ax in self._lane_axes()]))
         e = a.shape[0]
         e_pad = -(-e // n_dev) * n_dev
         if e_pad != e:
             a = np.concatenate(
                 [a, np.full((e_pad - e,) + a.shape[1:], pad_value, a.dtype)])
-        return jax.device_put(a, NamedSharding(self.mesh, P(self.entity_axis)))
+        return jax.device_put(a, NamedSharding(self.mesh,
+                                               P(self._lane_axes())))
 
     def _static_arrays(self, dataset: RandomEffectDataset, i: int,
                        bucket: REBucket, n: int):
@@ -760,8 +780,12 @@ def _solve_bucket_impl(solver, x, labels, offsets, weights, w0, lam):
     if solver.mesh is None:
         return batch(x, labels, offsets, weights, w0, lam)
     # Entity-parallel: each device solves its contiguous slice of lanes.
-    # No collectives in the body — independence is the whole point.
-    s = P(solver.entity_axis)
+    # No collectives in the body — independence is the whole point. The
+    # lane specs mention EVERY mesh axis (solver._lane_axes): with
+    # check_vma off, an unmentioned axis would leave the outputs'
+    # replication unverified and downstream GSPMD consumers disagree on it
+    # (gather takes one replica, concatenate sums them).
+    s = P(solver._lane_axes())
     # check_vma off: the body is collective-free by construction, and the
     # optimizers' constant-initialized while_loop carries would otherwise
     # trip the varying-axis check against lane-varying outputs.

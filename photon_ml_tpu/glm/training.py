@@ -121,16 +121,30 @@ def train_glm_sweep(
     # identical sorted sweep over the psum'd objective.
     from photon_ml_tpu.telemetry.aggregate import sweep_boundary
 
+    from photon_ml_tpu.resilience import fault_point, fault_value, heartbeat
+
     out: list[TrainedModel] = []
     for lam in sorted(regularization_weights, reverse=True):
+        # per-lambda liveness + injection: the lambda loop is the GLM
+        # driver's sweep boundary (what the GAME drivers' per-sweep
+        # worker.stall / optimizer.step sites are to coordinate descent)
+        heartbeat("glm.sweep")
+        fault_point("worker.stall", regularization_weight=float(lam))
         result = run(data, w, jnp.asarray(lam, w.dtype))
-        variances = problem.compute_variances(result.w, data, lam)
-        coeffs = Coefficients(means=result.w, variances=variances)
+        w_solved = fault_value("optimizer.step", result.w,
+                               regularization_weight=float(lam))
+        variances = problem.compute_variances(w_solved, data, lam)
+        coeffs = Coefficients(means=w_solved, variances=variances)
         model = GeneralizedLinearModel(
             coefficients=to_original_space(coeffs, normalization), task=task)
         out.append(TrainedModel(float(lam), model, result))
         if warm_start:
-            w = result.w
+            # an injected-NaN solve must not poison the NEXT lambda's warm
+            # start (nan init never recovers); the finiteness sync runs
+            # only when a fault actually corrupted the value, so the
+            # healthy path keeps its async dispatch untouched
+            if w_solved is result.w or bool(jnp.isfinite(w_solved).all()):
+                w = w_solved
         sweep_boundary(regularization_weight=float(lam))
     return out
 

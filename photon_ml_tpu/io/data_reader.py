@@ -89,9 +89,10 @@ def _read_records_with_retry(path: str) -> list:
     """One file's records, under the resilience retry policy (transient
     read errors — flaky network filesystems, injected ``io.read`` faults —
     are retried with backoff; persistent ones re-raise unchanged)."""
-    from photon_ml_tpu.resilience import fault_point, retry
+    from photon_ml_tpu.resilience import fault_point, heartbeat, retry
 
     def attempt() -> list:
+        heartbeat("io.read")
         fault_point("io.read", path=path)
         return list(iter_avro_file(path))
 
@@ -271,11 +272,12 @@ class AvroDataReader:
             _ingest_decode_seconds,
             _ingest_files,
         )
-        from photon_ml_tpu.resilience import fault_point, retry
+        from photon_ml_tpu.resilience import fault_point, heartbeat, retry
         from photon_ml_tpu.telemetry import tracing
 
         def decode(p):
             def attempt():
+                heartbeat("io.read")
                 fault_point("io.read", path=p)
                 return native.decode_training_file(p,
                                                    id_keys=tuple(id_columns))

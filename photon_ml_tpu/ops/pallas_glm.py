@@ -163,7 +163,9 @@ def _out_struct(x, shape, dtype):
     manual axes: under shard_map, outputs vary over the same mesh axes as
     the design block — without the vma the checker rejects the
     pallas_call. One home for both kernels so the plumbing cannot drift."""
-    vma = getattr(jax.typeof(x), "vma", frozenset()) or None
+    from photon_ml_tpu.compat import typeof
+
+    vma = getattr(typeof(x), "vma", frozenset()) or None
     return (jax.ShapeDtypeStruct(shape, dtype) if vma is None
             else jax.ShapeDtypeStruct(shape, dtype, vma=vma))
 

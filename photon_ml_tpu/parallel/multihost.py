@@ -41,6 +41,28 @@ from photon_ml_tpu.parallel.mesh import DATA_AXIS, ENTITY_AXIS
 _initialized = False
 
 
+def _enable_cpu_collectives() -> None:
+    """Multi-process jobs on the CPU backend (loopback test fleets, the
+    supervised 2-process chaos cells) need a cross-process collectives
+    implementation — the bare CPU client refuses multiprocess computations
+    outright ("Multiprocess computations aren't implemented"). jaxlib
+    ships gloo in the wheel but leaves it off by default, and the config
+    flag only takes effect BEFORE backend/client creation — which is why
+    this lives in :func:`initialize` (documented to run before any
+    backend-touching call) rather than at first collective. TPU/GPU
+    platforms keep their native ICI/NCCL paths untouched."""
+    import os
+
+    platforms = str(jax.config.jax_platforms
+                    or os.environ.get("JAX_PLATFORMS", ""))
+    if "cpu" not in platforms.split(","):
+        return
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except (AttributeError, ValueError):  # pre-0.4.35 jax: no such flag
+        pass
+
+
 def initialize(coordinator_address: Optional[str] = None,
                num_processes: Optional[int] = None,
                process_id: Optional[int] = None,
@@ -98,6 +120,7 @@ def initialize(coordinator_address: Optional[str] = None,
     from photon_ml_tpu.resilience import fault_point, get_default_policy, \
         retry
 
+    _enable_cpu_collectives()
     policy = retry_policy if retry_policy is not None \
         else get_default_policy()
     # the deadline must be HARD: jax.distributed.initialize BLOCKS
@@ -116,6 +139,9 @@ def initialize(coordinator_address: Optional[str] = None,
 
     def attempt() -> None:
         attempts[0] += 1
+        from photon_ml_tpu.resilience import heartbeat
+
+        heartbeat("initialize")
         fault_point("collective", op="initialize",
                     coordinator=coordinator_address)
         if (process_id not in (None, 0) and coordinator_address
@@ -222,11 +248,15 @@ def _gather_stack(x: np.ndarray) -> np.ndarray:
     divergence). 8-byte dtypes ride through as uint32 word pairs."""
     from jax.experimental import multihost_utils
 
-    from photon_ml_tpu.resilience import fault_point
+    from photon_ml_tpu.resilience import fault_point, heartbeat
 
     # injection-only, never retried: a unilateral second attempt at a
     # collective would desync every other process — fault recovery for
-    # collectives is the caller's (symmetric) job
+    # collectives is the caller's (symmetric) job. The heartbeat marks
+    # the collective BOUNDARY: a process whose peer died blocks inside
+    # the gather below with this beat as its last sign of life, which is
+    # exactly the staleness the fleet supervisor's stall detection reads.
+    heartbeat("collective")
     fault_point("collective", op="allgather", shape=tuple(x.shape))
     x = np.ascontiguousarray(x)
     if x.dtype.itemsize == 8 and not jax.config.jax_enable_x64:

@@ -18,6 +18,12 @@ checkpoints to recover:
 - :mod:`photon_ml_tpu.resilience.guard` — NaN/Inf divergence detection at
   coordinate boundaries with rollback / regularization-backoff / freeze
   semantics (see RESILIENCE.md).
+- :mod:`photon_ml_tpu.resilience.supervisor` — the ASYMMETRIC fault class
+  (one process of a multi-controller job dies or stalls mid-collective):
+  a :class:`FleetSupervisor` owns the fleet's process lifecycle, watches
+  exit codes + per-process :func:`heartbeat` files, and relaunches the
+  whole fleet from the latest agreed checkpoint under a bounded restart
+  budget (the drivers' ``--supervise N`` flag).
 """
 
 from photon_ml_tpu.resilience.faults import (
@@ -40,6 +46,12 @@ from photon_ml_tpu.resilience.retry import (
     retry,
     set_default_policy,
 )
+from photon_ml_tpu.resilience.supervisor import (
+    FleetExhaustedError,
+    FleetSupervisor,
+    SupervisorPolicy,
+    heartbeat,
+)
 
 __all__ = [
     "FaultPlan",
@@ -56,4 +68,8 @@ __all__ = [
     "get_default_policy",
     "retry",
     "set_default_policy",
+    "FleetExhaustedError",
+    "FleetSupervisor",
+    "SupervisorPolicy",
+    "heartbeat",
 ]

@@ -17,7 +17,9 @@ misbehave. The registered sites:
                           ``jax.distributed.initialize``
 ``optimizer.step``        one visit per coordinate-descent coordinate step
                           (value hook: ``mode="nan"`` corrupts the scores)
-``worker.stall``          one visit per sweep (``mode="stall"`` sleeps)
+``worker.stall``          one visit per sweep (``mode="stall"`` sleeps;
+                          ``mode="kill"`` dies abruptly — the supervised-
+                          recovery crash site)
 ========================  ====================================================
 
 Activation is explicit only: :func:`activate` / the :func:`injected` context
@@ -48,7 +50,27 @@ import numpy as np
 SITES = ("io.read", "ckpt.save", "io.model_save", "collective",
          "optimizer.step", "worker.stall")
 
-_MODES = ("raise", "nan", "stall")
+_MODES = ("raise", "nan", "stall", "kill")
+
+
+def _process_index() -> int:
+    """This process's fleet index, for ``FaultSpec.processes`` gating.
+    ``PHOTON_PROCESS_ID`` (set by the fleet supervisor and by manual
+    multi-controller launches) wins; 0 when unset — single-process runs
+    and in-process tests are process 0."""
+    try:
+        return int(os.environ.get("PHOTON_PROCESS_ID", "0"))
+    except ValueError:
+        return 0
+
+
+def _restart_count() -> int:
+    """Which supervisor attempt this process belongs to (0 = first
+    launch), for ``FaultSpec.attempts`` gating."""
+    try:
+        return int(os.environ.get("PHOTON_RESTART_COUNT", "0"))
+    except ValueError:
+        return 0
 
 
 class InjectedFault(RuntimeError):
@@ -71,7 +93,19 @@ class FaultSpec:
     firings (None = unlimited). ``mode``: ``"raise"`` raises
     :class:`InjectedFault`; ``"nan"`` corrupts the value passing through a
     :func:`fault_value` hook; ``"stall"`` sleeps ``stall_seconds`` (through
-    the retry module's sanctioned sleep).
+    the retry module's sanctioned sleep); ``"kill"`` terminates the process
+    abruptly with ``exit_code`` (``os._exit`` — no cleanup, no atexit: the
+    crash the fleet supervisor exists to recover from).
+
+    ``processes`` restricts the spec to specific process indices
+    (``PHOTON_PROCESS_ID``, 0 when unset) — the ASYMMETRIC fault class:
+    unlike the symmetric default, a process-restricted spec fires on some
+    processes only, so it must simulate faults the surviving processes
+    cannot recover from in-process (kill/stall), not divergences the
+    lockstep guard handles. ``attempts`` restricts to specific supervisor
+    restart attempts (``PHOTON_RESTART_COUNT``, 0 when unset) — a kill
+    gated ``attempts=(0,)`` fires on the first launch only, so the
+    restarted fleet completes instead of dying deterministically forever.
     """
 
     site: str
@@ -81,6 +115,9 @@ class FaultSpec:
     mode: str = "raise"
     stall_seconds: float = 0.0
     message: str = ""
+    exit_code: int = 113
+    processes: Optional[tuple[int, ...]] = None
+    attempts: Optional[tuple[int, ...]] = None
 
     def __post_init__(self):
         if self.mode not in _MODES:
@@ -143,7 +180,12 @@ class FaultPlan:
     def visit(self, site: str, context: Mapping[str, Any]) -> Optional[str]:
         """Advance ``site``'s invocation counter and apply the first firing
         spec. Returns the fired mode (``"nan"``/``"stall"``) for value
-        hooks, raises for ``"raise"`` specs, None when nothing fires."""
+        hooks, raises for ``"raise"`` specs, None when nothing fires.
+
+        ``processes``/``attempts``-restricted specs still consume their
+        seeded ``rate`` draw on every process and attempt — the draw
+        sequence stays aligned with the unrestricted plan, so restricting
+        a spec never shifts which invocations OTHER specs hit."""
         index = self._counts.get(site, 0)
         self._counts[site] = index + 1
         for i, spec in enumerate(self.specs):
@@ -154,6 +196,10 @@ class FaultPlan:
             fire = index in spec.at
             if not fire and spec.rate > 0.0:
                 fire = float(self._rng(site).random()) < spec.rate
+            if fire and spec.processes is not None:
+                fire = _process_index() in spec.processes
+            if fire and spec.attempts is not None:
+                fire = _restart_count() in spec.attempts
             if not fire:
                 continue
             self._fires[i] += 1
@@ -168,6 +214,12 @@ class FaultPlan:
 
                 _sleep(spec.stall_seconds)
                 return "stall"
+            if spec.mode == "kill":
+                # an abrupt death, not an exit: no finally blocks, no
+                # atexit, no flushing — the asymmetric crash class only a
+                # SUPERVISOR can recover (surviving processes are left
+                # stuck in their next collective)
+                os._exit(spec.exit_code)
             return spec.mode
         return None
 
@@ -192,7 +244,14 @@ class FaultPlan:
                                       else int(s["max_fires"])),
                            mode=s.get("mode", "raise"),
                            stall_seconds=float(s.get("stall_seconds", 0.0)),
-                           message=s.get("message", ""))
+                           message=s.get("message", ""),
+                           exit_code=int(s.get("exit_code", 113)),
+                           processes=(None if s.get("processes") is None
+                                      else tuple(int(x)
+                                                 for x in s["processes"])),
+                           attempts=(None if s.get("attempts") is None
+                                     else tuple(int(x)
+                                                for x in s["attempts"])))
                  for s in obj.get("specs", ())]
         return cls(specs, seed=int(obj.get("seed", 0)))
 
@@ -203,6 +262,11 @@ class FaultPlan:
                 "site": s.site, "at": list(s.at), "rate": s.rate,
                 "max_fires": s.max_fires, "mode": s.mode,
                 "stall_seconds": s.stall_seconds, "message": s.message,
+                "exit_code": s.exit_code,
+                "processes": (None if s.processes is None
+                              else list(s.processes)),
+                "attempts": (None if s.attempts is None
+                             else list(s.attempts)),
             } for s in self.specs],
         }, sort_keys=True)
 

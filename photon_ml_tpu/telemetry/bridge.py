@@ -93,6 +93,16 @@ def _make_listener(reg: MetricsRegistry) -> Callable:
     training_runs = reg.counter(
         "photon_training_runs_total",
         "Training driver invocations", labels=("driver",))
+    supervisor_faults = reg.counter(
+        "photon_supervisor_faults_total",
+        "Fleet liveness faults detected by the supervisor",
+        labels=("reason",))  # "exit" | "stall" — a closed vocabulary
+    supervisor_restarts = reg.counter(
+        "photon_supervisor_restarts_total",
+        "Whole-fleet restarts performed by the supervisor")
+    supervisor_exhausted = reg.counter(
+        "photon_supervisor_exhausted_total",
+        "Supervised runs abandoned past their restart budget or deadline")
 
     def listener(event) -> None:
         name, p = event.name, event.payload
@@ -126,6 +136,13 @@ def _make_listener(reg: MetricsRegistry) -> Callable:
             active_version.set(float(p.get("version") or 0))
         elif name == "training_started":
             training_runs.labels(driver=str(p.get("driver", ""))).inc()
+        elif name == "supervisor_fault_detected":
+            supervisor_faults.labels(
+                reason=str(p.get("reason", "unknown"))).inc()
+        elif name == "supervisor_restart":
+            supervisor_restarts.inc()
+        elif name == "supervisor_exhausted":
+            supervisor_exhausted.inc()
 
     return listener
 

@@ -44,6 +44,12 @@ from typing import Iterator, Optional
 _CURRENT: contextvars.ContextVar[Optional[int]] = contextvars.ContextVar(
     "photon_current_span", default=None)
 
+#: the full open-ancestor id stack on THIS thread/context — what lets a
+#: span that outlives its lexical parent (async background work submitted
+#: with a copied context) re-parent to the nearest ancestor still open
+_STACK: contextvars.ContextVar[tuple] = contextvars.ContextVar(
+    "photon_span_stack", default=())
+
 #: reserved record keys — span attributes may not shadow them
 _RESERVED = frozenset(
     {"name", "span_id", "parent_id", "ts", "t0", "t1", "seconds"})
@@ -87,6 +93,10 @@ class Tracer:
         self._fh = None
         self._path: Optional[str] = None
         self._bus = None
+        #: ids of spans currently open anywhere in the process — consulted
+        #: at span exit so an async span re-parents instead of recording an
+        #: interval that leaks outside its (already closed) parent
+        self._open: set[int] = set()
 
     @property
     def enabled(self) -> bool:
@@ -130,14 +140,37 @@ class Tracer:
     def span(self, name: str, **attrs) -> Iterator[Span]:
         sp = Span(name, next(self._ids), _CURRENT.get(), attrs)
         token = _CURRENT.set(sp.span_id)
+        ancestors = _STACK.get()
+        stack_token = _STACK.set(ancestors + (sp.span_id,))
+        with self._lock:
+            self._open.add(sp.span_id)
         sp.ts = time.time()
         sp.t0 = time.perf_counter()
         try:
             yield sp
         finally:
+            # leave the open set BEFORE stamping t1: a concurrent child
+            # that still observes this span open is then guaranteed to
+            # stamp its own t1 first, so the enclosure check below can
+            # never race a parent mid-close
+            with self._lock:
+                self._open.discard(sp.span_id)
             sp.t1 = time.perf_counter()
             sp.seconds = sp.t1 - sp.t0
             _CURRENT.reset(token)
+            _STACK.reset(stack_token)
+            with self._lock:
+                if (sp.parent_id is not None
+                        and sp.parent_id not in self._open):
+                    # async span outlived its lexical parent (background
+                    # writers inherit the submitting stage's context but
+                    # may finish after the stage closes): re-parent to the
+                    # nearest ancestor still open, so every recorded
+                    # interval provably nests inside its parent's — the
+                    # trace.jsonl enclosure contract
+                    sp.parent_id = next(
+                        (a for a in reversed(ancestors) if a in self._open),
+                        None)
             if self._fh is not None:
                 self._write(sp.record())
             bus = self._bus

@@ -200,3 +200,149 @@ def test_no_fault_plan_is_bit_identical(tmp_path):
 
     a, b = coeffs(str(tmp_path / "o1")), coeffs(str(tmp_path / "o2"))
     assert a == b
+
+
+# ---------------------------------------------------------------------------
+# Supervised recovery (resilience/supervisor.py), single-process tier-1
+# lane: the 2-process loopback e2es live in tests/test_multihost.py (slow).
+# ---------------------------------------------------------------------------
+
+
+def _best_coeffs(out_dir):
+    import json
+
+    from photon_ml_tpu.io.avro import iter_avro_file
+
+    path = os.path.join(str(out_dir), "best")
+    with open(os.path.join(path, "model-metadata.json")) as f:
+        meta = json.load(f)
+    return {cid: [r for r in iter_avro_file(os.path.join(
+        path, info["type"], cid, "coefficients", "part-00000.avro"))]
+        for cid, info in meta["coordinates"].items()}
+
+
+def _supervised_env(monkeypatch):
+    """A --supervise worker is a fresh ``python -m photon_ml_tpu`` process:
+    it needs the CPU pin — and the conftest's x64 mode, or the bit-identity
+    comparison against the in-process run would break on precision, not on
+    supervision — in its ENVIRONMENT (``jax.config.update`` only covers
+    this process)."""
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("JAX_ENABLE_X64", "1")
+    monkeypatch.delenv("PHOTON_FAULT_PLAN", raising=False)
+
+
+def test_supervised_no_fault_is_bit_identical_to_direct(
+        tmp_path, monkeypatch):
+    """Acceptance: with no fault plan, a supervised run's model is
+    bit-identical to an unsupervised one — supervision only adds the
+    external watcher (plus --checkpoint --resume, which a fault-free run
+    never reads back)."""
+    _supervised_env(monkeypatch)
+    train = make_avro_dataset(tmp_path / "train.avro", n=300, seed=2)
+    argv = [
+        "--training-data", train,
+        "--feature-shards", SHARDS,
+        "--coordinates", *COORDS,
+        "--update-sequence", "global,perUser",
+        "--cd-iterations", "2",
+        "--grid", "global=0.1", "perUser=1",
+    ]
+    direct = train_game_cli.run(
+        argv + ["--output-dir", str(tmp_path / "direct")])
+    supervised = train_game_cli.run(
+        argv + ["--output-dir", str(tmp_path / "supervised"),
+                "--supervise", "1", "--max-restarts", "2"])
+    assert supervised["restarts"] == 0
+    assert direct["n_configurations"] == 1
+    assert _best_coeffs(tmp_path / "supervised") == \
+        _best_coeffs(tmp_path / "direct")
+
+
+def test_supervised_kill_restart_recovers_run(tmp_path, monkeypatch):
+    """A worker killed abruptly mid-sweep (worker.stall mode="kill",
+    first launch only): the supervisor restarts it, the restarted process
+    resumes from the latest checkpoint (fingerprint-validated on load),
+    and the run completes with a healthy model and the full supervisor
+    event trail."""
+    import json
+
+    _supervised_env(monkeypatch)
+    monkeypatch.setenv("PHOTON_FAULT_PLAN", json.dumps(
+        {"seed": 0, "specs": [{"site": "worker.stall", "at": [1],
+                               "mode": "kill", "attempts": [0]}]}))
+    train = make_avro_dataset(tmp_path / "train.avro", n=300, seed=0)
+    val = make_avro_dataset(tmp_path / "val.avro", n=150, seed=1)
+    out = tmp_path / "out"
+
+    events = []
+    unsub = GLOBAL_BUS.subscribe(
+        lambda e: events.append(e) if e.name.startswith("supervisor_")
+        else None)
+    try:
+        result = train_game_cli.run([
+            "--training-data", train, "--validation-data", val,
+            "--output-dir", str(out),
+            "--feature-shards", SHARDS,
+            "--coordinates", *COORDS,
+            "--update-sequence", "global,perUser",
+            "--cd-iterations", "2",
+            "--grid", "global=0.1", "perUser=1",
+            "--evaluators", "AUC",
+            "--supervise", "1", "--max-restarts", "2",
+            "--heartbeat-timeout-s", "120",
+        ])
+    finally:
+        unsub()
+
+    assert result["restarts"] == 1
+    assert result["best_evaluation"]["AUC"] > 0.5
+    assert os.path.exists(os.path.join(out, "best", "model-metadata.json"))
+    names = [e.name for e in events]
+    assert names == ["supervisor_started", "supervisor_fault_detected",
+                     "supervisor_restart", "supervisor_completed"]
+    fault = events[1].payload
+    assert fault["reason"] == "exit" and fault["returncode"] == 113
+    # the supervisor's post-mortem surface exists: per-attempt worker logs
+    assert os.path.exists(os.path.join(out, "supervisor", "attempt-0",
+                                       "proc-0.log"))
+    assert os.path.exists(os.path.join(out, "supervisor", "attempt-1",
+                                       "proc-0.log"))
+
+
+def test_chaos_sweep_smoke_budget(monkeypatch):
+    """Tier-1 invocation of the randomized sweep harness: the smoke grid
+    (1 seed x 1 rate, both drivers, small data) must pass its quality
+    floors in-process. The full grid and the 2-process asymmetric cells
+    run in test_chaos_sweep_full (slow)."""
+    import sys
+
+    monkeypatch.delenv("PHOTON_FAULT_PLAN", raising=False)
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import chaos_sweep
+
+    assert chaos_sweep.main(["--budget", "smoke", "--rows", "240"]) == 0
+
+
+@pytest.mark.slow
+def test_chaos_sweep_full(monkeypatch):
+    """The nightly-scale randomized sweep: full seed x rate grid over both
+    drivers plus the 2-process --supervise 2 loopback cells under
+    asymmetric kill/stall plans (>= 1 automatic restart each, same
+    quality floors)."""
+    import sys
+
+    monkeypatch.delenv("PHOTON_FAULT_PLAN", raising=False)
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    # the supervised workers pin their own lean 2-device CPU backend
+    # (conftest's 8-device XLA_FLAGS would leak into all 2x their procs)
+    monkeypatch.setenv("XLA_FLAGS",
+                       "--xla_force_host_platform_device_count=2")
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import chaos_sweep
+
+    assert chaos_sweep.main(
+        ["--budget", "full", "--seeds", "0,1", "--rates", "0.05,0.15",
+         "--asymmetric"]) == 0

@@ -923,3 +923,155 @@ def test_two_process_game_cd(tmp_path):
     ``data/RandomEffectDatasetPartitioner.scala``)."""
     _run_two_workers(tmp_path, _GAME_WORKER, "MULTIPROC_GAME_OK",
                      timeout=420)
+
+
+# ---------------------------------------------------------------------------
+# Supervised fleet recovery (resilience/supervisor.py): the asymmetric
+# fault class — one process dead or stalled mid-collective — recovered by
+# killing the survivors and relaunching the fleet from the latest agreed
+# checkpoint. Unit tests for the supervisor itself live in
+# tests/test_resilience.py; 1-process supervised runs (incl. the
+# bit-identical no-fault contract) in tests/test_chaos.py.
+# ---------------------------------------------------------------------------
+
+
+def _supervised_game_argv(train_dir, val, out):
+    return [
+        "--training-data", str(train_dir),
+        "--validation-data", str(val),
+        "--output-dir", str(out),
+        "--feature-shards", "global=fixed|intercept,user=user|noIntercept",
+        "--coordinates", "global=fixed,shard=global,reg=L2",
+        "perUser=random,entity=userId,shard=user,reg=L2",
+        "--update-sequence", "global,perUser",
+        "--cd-iterations", "2",
+        "--grid", "global=0.01", "perUser=1",
+        "--evaluators", "AUC",
+    ]
+
+
+def _best_model_records(out_dir):
+    """Every coefficient record in out_dir/best, keyed by coordinate — the
+    model-content fingerprint two runs are compared on."""
+    import glob
+    import json
+
+    from photon_ml_tpu.io.avro import iter_avro_file
+
+    best = os.path.join(str(out_dir), "best")
+    with open(os.path.join(best, "model-metadata.json")) as f:
+        meta = json.load(f)
+    out = {}
+    for cid, info in meta["coordinates"].items():
+        parts = sorted(glob.glob(os.path.join(
+            best, info["type"], cid, "coefficients", "part-*.avro")))
+        assert parts, (cid, best)
+        out[cid] = [r for p in parts for r in iter_avro_file(p)]
+    return out
+
+
+def _supervised_fleet_env(monkeypatch, tmp_path, plan=None):
+    """Environment for a --supervise 2 loopback fleet launched from inside
+    pytest: worker processes pin their own 2-device CPU backend (the
+    conftest's 8-device XLA_FLAGS would leak in), and the fault plan rides
+    PHOTON_FAULT_PLAN (the workers activate it; the supervisor parent
+    never trains so it stays inert there)."""
+    import json
+
+    monkeypatch.setenv("XLA_FLAGS",
+                       "--xla_force_host_platform_device_count=2")
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    if plan is not None:
+        monkeypatch.setenv("PHOTON_FAULT_PLAN", json.dumps(plan))
+    else:
+        monkeypatch.delenv("PHOTON_FAULT_PLAN", raising=False)
+
+
+@pytest.mark.slow
+def test_supervised_two_process_kill_recovery_matches_uninterrupted(
+        tmp_path, monkeypatch):
+    """One process SIGKILLed mid-sweep (worker.stall mode="kill" on process
+    1, first launch only): the supervisor must detect the exit, kill the
+    survivor stuck in its next collective, relaunch the fleet, and the
+    resumed run must converge to the SAME model as an uninterrupted
+    supervised run — restart-from-agreed-checkpoint is exact, not merely
+    "close"."""
+    from photon_ml_tpu.cli import train_game as train_game_cli
+    from photon_ml_tpu.events import GLOBAL_BUS
+
+    train_dir = tmp_path / "train"
+    train_dir.mkdir()
+    for i in range(4):
+        _write_game_avro(train_dir / f"part-{i}.avro", n=120, seed=i)
+    val = _write_game_avro(tmp_path / "val.avro", n=240, seed=9)
+
+    # uninterrupted supervised baseline
+    _supervised_fleet_env(monkeypatch, tmp_path)
+    clean = train_game_cli.run(
+        _supervised_game_argv(train_dir, val, tmp_path / "out-clean")
+        + ["--supervise", "2", "--max-restarts", "2"])
+    assert clean["restarts"] == 0
+    base_auc = clean["best_evaluation"]["AUC"]
+    assert base_auc > 0.6
+
+    # same fleet under the asymmetric kill plan
+    _supervised_fleet_env(monkeypatch, tmp_path, plan={
+        "seed": 0, "specs": [{"site": "worker.stall", "at": [1],
+                              "mode": "kill", "processes": [1],
+                              "attempts": [0]}]})
+    restarts = []
+    unsub = GLOBAL_BUS.subscribe(
+        lambda e: restarts.append(e.payload)
+        if e.name == "supervisor_restart" else None)
+    try:
+        recovered = train_game_cli.run(
+            _supervised_game_argv(train_dir, val, tmp_path / "out-kill")
+            + ["--supervise", "2", "--max-restarts", "2"])
+    finally:
+        unsub()
+    assert recovered["restarts"] >= 1
+    assert len(restarts) == recovered["restarts"]
+
+    # chaos-floor on the metric, exactness on the model content
+    assert abs(recovered["best_evaluation"]["AUC"] - base_auc) < 0.05
+    assert _best_model_records(tmp_path / "out-kill") == \
+        _best_model_records(tmp_path / "out-clean")
+
+
+@pytest.mark.slow
+def test_supervised_two_process_stall_recovery(tmp_path, monkeypatch):
+    """Stall detection e2e through the worker.stall fault site: process 1
+    wedges for 600s mid-sweep, so it never exits — only the heartbeat
+    going stale can flag it. The supervisor must declare the stall within
+    the timeout, restart, and recover a passing run."""
+    from photon_ml_tpu.cli import train_game as train_game_cli
+    from photon_ml_tpu.events import GLOBAL_BUS
+
+    train_dir = tmp_path / "train"
+    train_dir.mkdir()
+    for i in range(4):
+        _write_game_avro(train_dir / f"part-{i}.avro", n=120, seed=i)
+    val = _write_game_avro(tmp_path / "val.avro", n=240, seed=9)
+
+    _supervised_fleet_env(monkeypatch, tmp_path, plan={
+        "seed": 0, "specs": [{"site": "worker.stall", "at": [1],
+                              "mode": "stall", "stall_seconds": 600.0,
+                              "processes": [1], "attempts": [0]}]})
+    faults = []
+    unsub = GLOBAL_BUS.subscribe(
+        lambda e: faults.append(e.payload)
+        if e.name == "supervisor_fault_detected" else None)
+    try:
+        recovered = train_game_cli.run(
+            _supervised_game_argv(train_dir, val, tmp_path / "out-stall")
+            + ["--supervise", "2", "--max-restarts", "2",
+               "--heartbeat-timeout-s", "25"])
+    finally:
+        unsub()
+    assert recovered["restarts"] >= 1
+    assert any(f["reason"] == "stall" for f in faults)
+    stall = next(f for f in faults if f["reason"] == "stall")
+    assert stall["heartbeat_age_s"] > 25.0
+    assert recovered["best_evaluation"]["AUC"] > 0.6
+    assert os.path.exists(os.path.join(
+        tmp_path, "out-stall", "best", "model-metadata.json"))

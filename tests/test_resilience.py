@@ -203,6 +203,60 @@ class TestRetry:
         assert events[-1].payload["deadline_hit"] is True
         assert events[-1].payload["attempts"] < 100
 
+    def test_deadline_boundary_smaller_budget_than_next_step(self):
+        """THE documented edge: the remaining budget is positive but
+        smaller than the next backoff step — retry must give up NOW
+        (before the deadline), not start a sleep it cannot afford and
+        resolve at deadline + delay."""
+        bus = EventBus()
+        events = []
+        bus.subscribe(lambda e: events.append(e))
+        clock = _FakeClock()
+        sleeps = []
+
+        def sleep(s):
+            sleeps.append(s)
+            clock.sleep(s)
+
+        policy = RetryPolicy(max_attempts=100, base_delay_s=0.5,
+                             multiplier=1.0, jitter=0.0, deadline_s=1.0)
+
+        def broken():
+            clock.now += 0.2  # each attempt costs 0.2s of work
+            raise IOError("down")
+
+        with pytest.raises(IOError):
+            retry(broken, policy, bus=bus, sleep=sleep, clock=clock)
+        # attempt 1 at elapsed 0.2: 0.2 + 0.5 < 1.0 -> sleeps; attempt 2
+        # at elapsed 0.9: the 0.1s remaining is SMALLER than the 0.5s
+        # step, so it gives up with the budget unspent
+        assert sleeps == [0.5]
+        assert clock.now == pytest.approx(0.9)  # resolved BEFORE 1.0,
+        assert clock.now < 1.0                  # not at 1.0 + 0.5
+        assert events[-1].name == "retry_exhausted"
+        assert events[-1].payload["deadline_hit"] is True
+        assert events[-1].payload["attempts"] == 2
+
+    def test_deadline_boundary_exact_equality_gives_up(self):
+        """elapsed + next_delay == deadline_s exactly is already a blown
+        deadline (the contract is strict: resolve IN deadline_s, never
+        AT deadline_s + epsilon) — no sleep may start."""
+        clock = _FakeClock()
+        sleeps = []
+        policy = RetryPolicy(max_attempts=100, base_delay_s=0.75,
+                             multiplier=1.0, jitter=0.0, deadline_s=1.0)
+
+        def broken():
+            clock.now += 0.25
+            raise IOError("down")
+
+        with pytest.raises(IOError):
+            retry(broken, policy, bus=EventBus(),
+                  sleep=lambda s: sleeps.append(s) or clock.sleep(s),
+                  clock=clock)
+        assert sleeps == []  # 0.25 + 0.75 == 1.0: not a single sleep
+        assert clock.now == pytest.approx(0.25)
+
     def test_non_retryable_propagates_immediately(self):
         calls = []
 
@@ -357,3 +411,208 @@ class TestMultihostInitialize:
             with pytest.raises(RuntimeError, match="unreachable"):
                 multihost.initialize("h:1", 2, 0, retry_policy=policy)
         assert len(plan.fired("collective")) == 3
+
+
+class TestFleetSupervisor:
+    """Process-level unit tests with trivial worker scripts (no jax): the
+    supervised-recovery E2Es (real training fleets, kill/stall plans) live
+    in ``tests/test_multihost.py``."""
+
+    def _command(self, tmp_path, body: str) -> list:
+        import sys
+
+        script = tmp_path / "worker.py"
+        script.write_text(body)
+        return [sys.executable, str(script)]
+
+    def test_policy_validation(self):
+        from photon_ml_tpu.resilience import SupervisorPolicy
+
+        with pytest.raises(ValueError, match="max_restarts"):
+            SupervisorPolicy(max_restarts=-1)
+        with pytest.raises(ValueError, match="heartbeat_timeout_s"):
+            SupervisorPolicy(heartbeat_timeout_s=0.0)
+        assert SupervisorPolicy(heartbeat_timeout_s=None).heartbeat_timeout_s \
+            is None
+
+    def test_restart_on_nonzero_exit_then_success(self, tmp_path):
+        from photon_ml_tpu.resilience import FleetSupervisor, SupervisorPolicy
+
+        bus = EventBus()
+        events = []
+        bus.subscribe(lambda e: events.append(e))
+        # dies on the first launch, succeeds on the restart — and hands its
+        # result payload back through PHOTON_RESULT_FILE
+        cmd = self._command(tmp_path, (
+            "import json, os, sys\n"
+            "if os.environ['PHOTON_RESTART_COUNT'] == '0':\n"
+            "    sys.exit(3)\n"
+            "with open(os.environ['PHOTON_RESULT_FILE'], 'w') as f:\n"
+            "    json.dump({'auc': 0.9}, f)\n"))
+        sup = FleetSupervisor(
+            cmd, 1, str(tmp_path / "run"),
+            SupervisorPolicy(max_restarts=2, base_backoff_s=0.01,
+                             heartbeat_timeout_s=None),
+            bus=bus)
+        fleet = sup.run()
+        assert fleet.restarts == 1
+        assert fleet.attempts == 2
+        assert fleet.result == {"auc": 0.9}
+        names = [e.name for e in events]
+        assert names == ["supervisor_started", "supervisor_fault_detected",
+                         "supervisor_restart", "supervisor_completed"]
+        fault = events[1].payload
+        assert fault["reason"] == "exit" and fault["returncode"] == 3
+        assert events[3].payload["restarts"] == 1
+
+    def test_stall_detection_via_stale_heartbeat(self, tmp_path):
+        from photon_ml_tpu.resilience import FleetSupervisor, SupervisorPolicy
+
+        bus = EventBus()
+        events = []
+        bus.subscribe(lambda e: events.append(e))
+        # first launch wedges without ever beating; the restart exits 0
+        cmd = self._command(tmp_path, (
+            "import os, time\n"
+            "if os.environ['PHOTON_RESTART_COUNT'] == '0':\n"
+            "    time.sleep(120)\n"))
+        sup = FleetSupervisor(
+            cmd, 1, str(tmp_path / "run"),
+            SupervisorPolicy(max_restarts=1, base_backoff_s=0.01,
+                             heartbeat_timeout_s=0.4, poll_interval_s=0.05,
+                             grace_s=0.2),
+            bus=bus)
+        fleet = sup.run()
+        assert fleet.restarts == 1
+        fault = next(e for e in events
+                     if e.name == "supervisor_fault_detected").payload
+        assert fault["reason"] == "stall"
+        assert fault["heartbeat_age_s"] > 0.4
+
+    def test_kills_survivors_on_asymmetric_exit(self, tmp_path):
+        import time
+
+        from photon_ml_tpu.resilience import FleetSupervisor, SupervisorPolicy
+
+        # process 0 dies at once on the first launch; process 1 wedges (the
+        # "stuck in a collective" survivor) — the supervisor must kill it
+        # within the grace budget, not wait out its 120s sleep
+        cmd = self._command(tmp_path, (
+            "import os, sys, time\n"
+            "pid = os.environ['PHOTON_PROCESS_ID']\n"
+            "if os.environ['PHOTON_RESTART_COUNT'] == '0':\n"
+            "    if pid == '0':\n"
+            "        sys.exit(5)\n"
+            "    time.sleep(120)\n"
+            "if pid == '0':\n"
+            "    import json\n"
+            "    with open(os.environ['PHOTON_RESULT_FILE'], 'w') as f:\n"
+            "        json.dump({'ok': True}, f)\n"))
+        sup = FleetSupervisor(
+            cmd, 2, str(tmp_path / "run"),
+            SupervisorPolicy(max_restarts=1, base_backoff_s=0.01,
+                             heartbeat_timeout_s=None, poll_interval_s=0.05,
+                             grace_s=0.5))
+        t0 = time.monotonic()
+        fleet = sup.run()
+        assert time.monotonic() - t0 < 60  # the survivor was killed, not
+        assert fleet.restarts == 1         # waited out
+        assert fleet.result == {"ok": True}
+        # both processes saw a coordinator address (n_processes > 1), and a
+        # fresh port per attempt
+        log0 = (tmp_path / "run" / "attempt-0" / "proc-0.log")
+        assert log0.exists()
+
+    def test_exhaustion_raises_with_log_tails(self, tmp_path):
+        from photon_ml_tpu.resilience import (
+            FleetExhaustedError,
+            FleetSupervisor,
+            SupervisorPolicy,
+        )
+
+        bus = EventBus()
+        events = []
+        bus.subscribe(lambda e: events.append(e))
+        cmd = self._command(tmp_path, (
+            "import sys\n"
+            "print('BOOM: cannot load data')\n"
+            "sys.exit(7)\n"))
+        sup = FleetSupervisor(
+            cmd, 1, str(tmp_path / "run"),
+            SupervisorPolicy(max_restarts=1, base_backoff_s=0.01,
+                             heartbeat_timeout_s=None),
+            bus=bus)
+        with pytest.raises(FleetExhaustedError) as exc_info:
+            sup.run()
+        msg = str(exc_info.value)
+        assert "rc=7" in msg
+        assert "BOOM: cannot load data" in msg  # the post-mortem surface
+        assert "restart budget 1 spent" in msg
+        assert [e.name for e in events][-1] == "supervisor_exhausted"
+        assert events[-1].payload["attempts"] == 2
+
+    def test_deadline_never_sleeps_into_it(self, tmp_path):
+        import time
+
+        from photon_ml_tpu.resilience import (
+            FleetExhaustedError,
+            FleetSupervisor,
+            SupervisorPolicy,
+        )
+
+        # the same boundary contract as retry(): the next backoff step
+        # (10s) would blow the 2s deadline, so the supervisor gives up
+        # after the FIRST failure instead of sleeping
+        cmd = self._command(tmp_path, "import sys; sys.exit(1)\n")
+        sup = FleetSupervisor(
+            cmd, 1, str(tmp_path / "run"),
+            SupervisorPolicy(max_restarts=50, base_backoff_s=10.0,
+                             deadline_s=2.0, heartbeat_timeout_s=None))
+        t0 = time.monotonic()
+        with pytest.raises(FleetExhaustedError, match="deadline"):
+            sup.run()
+        assert time.monotonic() - t0 < 2.0
+        assert sup.restarts == 0
+
+    def test_strip_supervision_flags(self):
+        from photon_ml_tpu.resilience.supervisor import \
+            strip_supervision_flags
+
+        argv = ["--training-data", "t", "--supervise", "2",
+                "--max-restarts", "3", "--heartbeat-timeout-s", "30",
+                "--restart-deadline-s", "600", "--evaluators", "AUC"]
+        assert strip_supervision_flags(argv) == [
+            "--training-data", "t", "--evaluators", "AUC"]
+        # --flag=value spelling too
+        assert strip_supervision_flags(
+            ["--supervise=2", "--cd-iterations", "2"]) == [
+            "--cd-iterations", "2"]
+
+    def test_heartbeat_and_result_file_hooks(self, tmp_path, monkeypatch):
+        import json
+        import os
+
+        from photon_ml_tpu.resilience import heartbeat
+        from photon_ml_tpu.resilience.supervisor import write_result_file
+
+        # unsupervised: both are no-ops
+        monkeypatch.delenv("PHOTON_HEARTBEAT_FILE", raising=False)
+        monkeypatch.delenv("PHOTON_RESULT_FILE", raising=False)
+        heartbeat("x")
+        write_result_file({"a": 1})
+
+        hb = tmp_path / "beat"
+        monkeypatch.setenv("PHOTON_HEARTBEAT_FILE", str(hb))
+        heartbeat("first")  # missing file: created, never raises
+        assert hb.exists()
+        old = os.stat(hb).st_mtime
+        os.utime(hb, (old - 100, old - 100))
+        heartbeat("again")  # existing file: mtime refreshed
+        assert os.stat(hb).st_mtime > old - 100
+
+        res = tmp_path / "result.json"
+        monkeypatch.setenv("PHOTON_RESULT_FILE", str(res))
+        write_result_file({"auc": 0.5})
+        with open(res) as f:
+            assert json.load(f) == {"auc": 0.5}
+        assert not os.path.exists(str(res) + ".tmp")  # atomic publish

@@ -1,7 +1,9 @@
 """Tier-1 wrapper for ``tools/check_resilience_hygiene.py`` (no bare
 ``except:``; no ``time.sleep`` outside ``resilience/retry.py``; no model
 part-file writes outside ``io/`` — they must go through the atomic
-staged publish)."""
+staged publish; no ``subprocess.Popen``/``os.kill`` outside
+``resilience/supervisor.py`` — process lifecycle stays visible to the
+fleet supervisor)."""
 
 import os
 import sys
@@ -37,6 +39,20 @@ def test_package_is_clean():
     ('write_avro_file(os.path.join(d, "part-00000.avro"), recs, SCHEMA)\n',
      1),
     ('write_avro_file(os.path.join(d, "scores.avro"), recs, SCHEMA)\n', 0),
+    # rule 4: process lifecycle outside resilience/supervisor.py
+    ("import subprocess\nsubprocess.Popen(['x'])\n", 1),
+    ("import subprocess as sp\nsp.Popen(['x'])\n", 1),
+    ("from subprocess import Popen\nPopen(['x'])\n", 1),
+    ("from subprocess import Popen as P\nP(['x'])\n", 1),
+    ("import os\nos.kill(1, 9)\n", 1),
+    ("import os\nos.killpg(1, 9)\n", 1),
+    ("from os import kill\nkill(1, 9)\n", 1),
+    # blocking one-shot helpers stay legal (they cannot outlive the
+    # caller), and unrelated .kill/.Popen attributes must not trip it
+    ("import subprocess\nsubprocess.run(['x'], check=True)\n", 0),
+    ("import subprocess\nsubprocess.check_output(['x'])\n", 0),
+    ("proc.kill()\n", 0),
+    ("class X:\n    def kill(self):\n        pass\nX().kill()\n", 0),
 ])
 def test_detector(snippet, n):
     assert len(hygiene.check_source(snippet, "photon_ml_tpu/x.py")) == n
@@ -55,3 +71,14 @@ def test_io_package_may_write_part_files():
     # cli/ is NOT exempt — the rule exists for the drivers
     assert len(hygiene.check_source(
         src, os.path.join("photon_ml_tpu", "cli", "train_game.py"))) == 1
+
+
+def test_supervisor_module_may_manage_processes():
+    src = "import subprocess, os\nsubprocess.Popen(['x'])\nos.kill(1, 9)\n"
+    assert hygiene.check_source(
+        src, os.path.join("photon_ml_tpu", "resilience",
+                          "supervisor.py")) == []
+    # game/ is NOT exempt — a driver-forked worker would be invisible to
+    # the supervisor's restart logic
+    assert len(hygiene.check_source(
+        src, os.path.join("photon_ml_tpu", "game", "multiprocess.py"))) == 2

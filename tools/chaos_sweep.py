@@ -1,0 +1,305 @@
+#!/usr/bin/env python
+"""Randomized chaos sweep: fault plans × seeds × rates × both drivers,
+asserting model-QUALITY floors, not mere completion.
+
+The tier-1 chaos test (``tests/test_chaos.py``) injects one fault of each
+class through one GAME run; this tool scales that into a grid: for every
+``(driver, seed, rate)`` cell it builds a randomized (but seeded, hence
+exactly reproducible) ``PHOTON_FAULT_PLAN`` over the registered injection
+sites, runs the full training driver under it, and asserts the run's
+validation metric lands within ``--floor`` of a clean reference run on the
+same data — a recovery that silently degrades the model fails the sweep
+even though the run "completed".
+
+``--asymmetric`` adds the supervised-recovery cells: 2-process loopback
+fleets (``--supervise 2``) under asymmetric kill/stall plans
+(``FaultSpec.processes`` restricts the fault to process 1;
+``attempts=[0]`` confines it to the first launch so the restarted fleet
+completes), asserting at least one automatic restart happened AND the same
+quality floor holds.
+
+Budgets::
+
+    --budget smoke   1 seed x 1 rate, small data   (the tier-1 invocation)
+    --budget full    the full --seeds x --rates grid (nightly; -m slow)
+
+A failing cell reproduces exactly: the printed plan JSON IS the repro
+(``PHOTON_FAULT_PLAN='<plan>' python -m photon_ml_tpu <driver> ...``).
+Exit code: 0 = every cell passed, 1 = failures (listed last).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+SHARDS = "global=fixed|intercept,user=user|noIntercept"
+COORDS = [
+    "global=fixed,shard=global,reg=L2",
+    "perUser=random,entity=userId,shard=user,reg=L2",
+]
+
+
+def write_dataset(path: str, n: int, seed: int, n_users: int = 5,
+                  d_fixed: int = 3, d_user: int = 2) -> str:
+    """Mixed-effect TrainingExampleAvro file (the same record shape the
+    tier-1 chaos test trains on; parameters fixed so every cell and the
+    clean reference see one learnable distribution)."""
+    from photon_ml_tpu.io.data_reader import write_training_examples
+
+    prng = np.random.default_rng(777)
+    w = prng.normal(size=d_fixed)
+    u = 1.5 * prng.normal(size=(n_users, d_user))
+    rng = np.random.default_rng(seed)
+    xf = rng.normal(size=(n, d_fixed))
+    xu = rng.normal(size=(n, d_user))
+    users = rng.integers(0, n_users, size=n)
+    margin = xf @ w + np.einsum("nd,nd->n", xu, u[users])
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-margin))).astype(float)
+    records = []
+    for i in range(n):
+        feats = [{"name": f"fixed.x{j}", "term": "", "value": float(xf[i, j])}
+                 for j in range(d_fixed)]
+        feats += [{"name": f"user.z{j}", "term": "", "value": float(xu[i, j])}
+                  for j in range(d_user)]
+        records.append({
+            "uid": str(i), "response": float(y[i]), "offset": None,
+            "weight": None, "features": feats,
+            "metadataMap": {"userId": f"u{users[i]}"},
+        })
+    write_training_examples(path, records)
+    return path
+
+
+def build_plan(driver: str, seed: int, rate: float) -> dict:
+    """One randomized-but-seeded symmetric plan: every registered site the
+    driver threads, firing at ``rate`` (plan determinism makes the cell
+    reproducible and bisectable — see RESILIENCE.md)."""
+    specs = [
+        {"site": "io.read", "rate": rate},
+        {"site": "worker.stall", "rate": rate, "mode": "stall",
+         "stall_seconds": 0.02},
+        # at most ONE nan corruption: the rollback budget is per
+        # coordinate, and the sweep asserts quality, not freeze-everything
+        {"site": "optimizer.step", "rate": rate, "mode": "nan",
+         "max_fires": 1},
+    ]
+    if driver == "game":
+        specs.append({"site": "ckpt.save", "rate": rate})
+    return {"seed": seed, "specs": specs}
+
+
+def asymmetric_plans() -> list[tuple[str, dict]]:
+    """The supervised-recovery cells: process 1 dies (or stalls) at sweep
+    1 of the FIRST launch only."""
+    return [
+        ("kill-p1", {"seed": 0, "specs": [
+            {"site": "worker.stall", "at": [1], "mode": "kill",
+             "processes": [1], "attempts": [0]}]}),
+        ("stall-p1", {"seed": 0, "specs": [
+            {"site": "worker.stall", "at": [1], "mode": "stall",
+             "stall_seconds": 600.0, "processes": [1], "attempts": [0]}]}),
+    ]
+
+
+def game_argv(train: str, val: str, out: str, *, sweeps: int = 2) -> list:
+    return [
+        "--training-data", train, "--validation-data", val,
+        "--output-dir", out,
+        "--feature-shards", SHARDS,
+        "--coordinates", *COORDS,
+        "--update-sequence", "global,perUser",
+        "--cd-iterations", str(sweeps),
+        "--grid", "global=0.1", "perUser=1",
+        "--evaluators", "AUC",
+        "--checkpoint",
+        "--max-retries", "2",
+        "--on-divergence", "rollback",
+    ]
+
+
+def glm_argv(train: str, val: str, out: str) -> list:
+    return [
+        "--training-data", train, "--validation-data", val,
+        "--output-dir", out,
+        "--regularization-type", "L2",
+        "--regularization-weights", "10;1;0.1",
+        "--evaluators", "AUC",
+        "--max-retries", "2",
+        "--on-divergence", "rollback",
+    ]
+
+
+def run_driver(driver: str, argv: list) -> float:
+    """One in-process driver run → its validation AUC."""
+    if driver == "game":
+        from photon_ml_tpu.cli import train_game as mod
+    else:
+        from photon_ml_tpu.cli import train_glm as mod
+    out = mod.run(argv)
+    return float(out["best_evaluation"]["AUC"])
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="randomized chaos sweep with model-quality floors")
+    p.add_argument("--seeds", default="0,1,2",
+                   help="comma-separated plan seeds")
+    p.add_argument("--rates", default="0.05,0.15",
+                   help="comma-separated per-site fault rates")
+    p.add_argument("--drivers", default="game,glm")
+    p.add_argument("--budget", choices=["smoke", "full"], default="full",
+                   help="smoke = 1 seed x 1 rate on small data (tier-1)")
+    p.add_argument("--asymmetric", action="store_true",
+                   help="add 2-process --supervise 2 cells under "
+                        "asymmetric kill/stall plans")
+    p.add_argument("--floor", type=float, default=0.05,
+                   help="max allowed AUC drop vs the clean reference")
+    p.add_argument("--rows", type=int, default=400)
+    p.add_argument("--output", default=None,
+                   help="where to write chaos_sweep.json (default: the "
+                        "sweep's temp dir, i.e. discarded)")
+    args = p.parse_args(argv)
+
+    seeds = [int(s) for s in args.seeds.split(",") if s]
+    rates = [float(r) for r in args.rates.split(",") if r]
+    drivers = [d for d in args.drivers.split(",") if d]
+    rows = args.rows
+    if args.budget == "smoke":
+        seeds, rates, rows = seeds[:1], rates[:1], min(rows, 300)
+
+    from photon_ml_tpu.resilience import FaultPlan, injected
+    from photon_ml_tpu.resilience.retry import (
+        get_default_policy,
+        set_default_policy,
+    )
+
+    cells: list[dict] = []
+    failures: list[str] = []
+    prev_policy = get_default_policy()
+    with tempfile.TemporaryDirectory() as tmp:
+        # a DIRECTORY of part files: the 2-process asymmetric cells assign
+        # whole files per process (process_file_share needs >= 1 per
+        # process); single-process cells read the same directory whole, so
+        # every cell and the clean reference train on identical rows
+        train = os.path.join(tmp, "train")
+        os.makedirs(train)
+        for i in range(4):
+            write_dataset(os.path.join(train, f"part-{i}.avro"),
+                          rows // 4, seed=2 + i)
+        val = write_dataset(os.path.join(tmp, "val.avro"), rows // 2, seed=9)
+
+        ref: dict[str, float] = {}
+        for d in drivers:
+            out = os.path.join(tmp, f"ref-{d}")
+            a = (game_argv(train, val, out) if d == "game"
+                 else glm_argv(train, val, out))
+            ref[d] = run_driver(d, a)
+            set_default_policy(prev_policy)  # drivers install their own
+            print(f"[chaos] clean reference {d}: AUC={ref[d]:.4f}",
+                  flush=True)
+
+        for d in drivers:
+            for seed in seeds:
+                for rate in rates:
+                    plan_obj = build_plan(d, seed, rate)
+                    out = os.path.join(tmp, f"{d}-s{seed}-r{rate}")
+                    a = (game_argv(train, val, out) if d == "game"
+                         else glm_argv(train, val, out))
+                    cell = {"driver": d, "seed": seed, "rate": rate,
+                            "plan": plan_obj, "ref_auc": ref[d]}
+                    try:
+                        with injected(FaultPlan.from_json(plan_obj)):
+                            auc = run_driver(d, a)
+                        cell["auc"] = auc
+                        cell["ok"] = auc >= ref[d] - args.floor
+                    except Exception as e:  # a crashed cell is a failure
+                        cell["error"] = repr(e)
+                        cell["ok"] = False
+                    finally:
+                        set_default_policy(prev_policy)
+                    cells.append(cell)
+                    status = "ok" if cell["ok"] else "FAIL"
+                    print(f"[chaos] {d} seed={seed} rate={rate}: "
+                          f"auc={cell.get('auc', float('nan')):.4f} "
+                          f"(ref {ref[d]:.4f}) {status}", flush=True)
+                    if not cell["ok"]:
+                        failures.append(
+                            f"{d} seed={seed} rate={rate}: repro with "
+                            f"PHOTON_FAULT_PLAN='{json.dumps(plan_obj)}'")
+
+        if args.asymmetric:
+            from photon_ml_tpu.events import GLOBAL_BUS
+
+            # pin a lean 2-virtual-device CPU backend in the workers'
+            # environment (same shape as the loopback test harness;
+            # cross-process collectives ride the gloo implementation
+            # multihost.initialize enables on CPU) unless the caller
+            # already pinned a count
+            if "xla_force_host_platform_device_count" not in \
+                    os.environ.get("XLA_FLAGS", ""):
+                os.environ["XLA_FLAGS"] = (
+                    os.environ.get("XLA_FLAGS", "")
+                    + " --xla_force_host_platform_device_count=2").strip()
+            for d in drivers:
+                for name, plan_obj in asymmetric_plans():
+                    out = os.path.join(tmp, f"asym-{d}-{name}")
+                    a = (game_argv(train, val, out) if d == "game"
+                         else glm_argv(train, val, out))
+                    a += ["--supervise", "2", "--max-restarts", "2",
+                          "--heartbeat-timeout-s", "45"]
+                    restarts: list[int] = []
+                    unsub = GLOBAL_BUS.subscribe(
+                        lambda e: restarts.append(1)
+                        if e.name == "supervisor_restart" else None)
+                    cell = {"driver": d, "cell": f"asym-{name}",
+                            "plan": plan_obj, "ref_auc": ref[d]}
+                    os.environ["PHOTON_FAULT_PLAN"] = json.dumps(plan_obj)
+                    try:
+                        result = run_driver(d, a)
+                        cell["auc"] = result
+                        cell["restarts"] = len(restarts)
+                        cell["ok"] = (result >= ref[d] - args.floor
+                                      and len(restarts) >= 1)
+                    except Exception as e:
+                        cell["error"] = repr(e)
+                        cell["ok"] = False
+                    finally:
+                        os.environ.pop("PHOTON_FAULT_PLAN", None)
+                        set_default_policy(prev_policy)
+                        unsub()
+                    cells.append(cell)
+                    print(f"[chaos] asym {d} {name}: "
+                          f"auc={cell.get('auc', float('nan')):.4f} "
+                          f"restarts={cell.get('restarts')} "
+                          f"{'ok' if cell['ok'] else 'FAIL'}", flush=True)
+                    if not cell["ok"]:
+                        failures.append(f"asym {d} {name}: "
+                                        f"{json.dumps(plan_obj)}")
+
+        artifact = {"floor": args.floor, "budget": args.budget,
+                    "reference": ref, "cells": cells,
+                    "failures": failures}
+        out_path = args.output or os.path.join(tmp, "chaos_sweep.json")
+        with open(out_path, "w") as f:
+            json.dump(artifact, f, indent=2)
+
+    n_ok = sum(1 for c in cells if c["ok"])
+    print(f"[chaos] {n_ok}/{len(cells)} cells passed "
+          f"(floor: AUC >= ref - {args.floor})")
+    for f_ in failures:
+        print(f"[chaos] FAILED: {f_}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Static resilience-hygiene check over ``photon_ml_tpu/``.
 
-Three rules, all load-bearing for the resilience subsystem:
+Four rules, all load-bearing for the resilience subsystem:
 
 1. **No bare ``except:``** — a bare handler swallows ``KeyboardInterrupt``
    and ``SystemExit``, which is exactly how a "resilient" run turns into an
@@ -18,6 +18,13 @@ Three rules, all load-bearing for the resilience subsystem:
    written by ``io/model_io.py`` and published atomically
    (``save_game_model_atomic`` / ``BackgroundSaver``) — route through
    them.
+4. **No ``subprocess.Popen`` / ``os.kill`` outside
+   ``resilience/supervisor.py``** — process lifecycle must stay visible to
+   the fleet supervisor: a driver-forked child is invisible to the restart
+   logic that claims to own recovery (it would survive ``_kill_fleet`` and
+   hold the coordinator port, or die unnoticed with no liveness signal).
+   Blocking one-shot helpers (``subprocess.run`` — e.g. the native
+   toolchain probe) stay legal: they cannot outlive their caller.
 
 Run directly (``python tools/check_resilience_hygiene.py [root]``, exit 1 on
 violations) or through the tier-1 test ``tests/test_resilience_hygiene.py``.
@@ -35,6 +42,11 @@ SLEEP_ALLOWED = {os.path.join("photon_ml_tpu", "resilience", "retry.py")}
 #: the package prefix allowed to write model part-files (it owns the
 #: atomic staged publish)
 PART_WRITE_ALLOWED_PREFIX = os.path.join("photon_ml_tpu", "io") + os.sep
+
+#: the one module allowed to spawn or signal processes (it owns the
+#: fleet's process lifecycle)
+PROCESS_ALLOWED = {os.path.join("photon_ml_tpu", "resilience",
+                                "supervisor.py")}
 
 
 def _is_time_sleep(node: ast.AST, time_aliases: set[str],
@@ -74,25 +86,61 @@ def _is_part_file_write(node: ast.AST) -> bool:
     return False
 
 
+def _is_process_call(node: ast.AST, subprocess_aliases: set[str],
+                     os_aliases: set[str], popen_names: set[str],
+                     kill_names: set[str]) -> bool:
+    """True for ``subprocess.Popen(..)`` / ``os.kill``/``os.killpg`` calls
+    (module- and from-import aliases included)."""
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+        if fn.attr == "Popen" and fn.value.id in subprocess_aliases:
+            return True
+        if fn.attr in ("kill", "killpg") and fn.value.id in os_aliases:
+            return True
+    if isinstance(fn, ast.Name):
+        return fn.id in popen_names or fn.id in kill_names
+    return False
+
+
 def check_source(source: str, rel_path: str) -> list[str]:
     """Violations in one file, as ``path:line: message`` strings."""
     tree = ast.parse(source, filename=rel_path)
     sleep_ok = rel_path in {os.path.normpath(p) for p in SLEEP_ALLOWED}
     part_ok = os.path.normpath(rel_path).startswith(
         PART_WRITE_ALLOWED_PREFIX)
+    process_ok = rel_path in {os.path.normpath(p) for p in PROCESS_ALLOWED}
 
-    # resolve what `time` / `sleep` are bound to in this module
+    # resolve what `time` / `sleep` / `subprocess` / `os` are bound to in
+    # this module
     time_aliases: set[str] = set()
     sleep_names: set[str] = set()
+    subprocess_aliases: set[str] = set()
+    os_aliases: set[str] = set()
+    popen_names: set[str] = set()
+    kill_names: set[str] = set()
     for node in ast.walk(tree):
         if isinstance(node, ast.Import):
             for a in node.names:
                 if a.name == "time":
                     time_aliases.add(a.asname or "time")
+                elif a.name == "subprocess":
+                    subprocess_aliases.add(a.asname or "subprocess")
+                elif a.name == "os":
+                    os_aliases.add(a.asname or "os")
         elif isinstance(node, ast.ImportFrom) and node.module == "time":
             for a in node.names:
                 if a.name == "sleep":
                     sleep_names.add(a.asname or "sleep")
+        elif isinstance(node, ast.ImportFrom) and node.module == "subprocess":
+            for a in node.names:
+                if a.name == "Popen":
+                    popen_names.add(a.asname or "Popen")
+        elif isinstance(node, ast.ImportFrom) and node.module == "os":
+            for a in node.names:
+                if a.name in ("kill", "killpg"):
+                    kill_names.add(a.asname or a.name)
 
     out = []
     for node in ast.walk(tree):
@@ -111,6 +159,15 @@ def check_source(source: str, rel_path: str) -> list[str]:
                        f"the atomic staged publish; route through "
                        f"io.model_io.save_game_model / "
                        f"io.pipeline.BackgroundSaver")
+        elif (not process_ok
+              and _is_process_call(node, subprocess_aliases, os_aliases,
+                                   popen_names, kill_names)):
+            out.append(f"{rel_path}:{node.lineno}: subprocess.Popen/os.kill "
+                       f"outside resilience/supervisor.py — process "
+                       f"lifecycle must stay visible to the fleet "
+                       f"supervisor (an untracked child survives "
+                       f"_kill_fleet or dies without a liveness signal); "
+                       f"route process management through FleetSupervisor")
     return out
 
 
