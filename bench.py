@@ -984,13 +984,19 @@ def bench_ingest():
 # 7. end-to-end GAME training driver (Avro in -> model written)
 # --------------------------------------------------------------------------
 
-def _write_e2e_file(path, n=E2E_ROWS, users=E2E_USERS, songs=E2E_SONGS):
+def _write_e2e_file(path, n=E2E_ROWS, users=E2E_USERS, songs=E2E_SONGS,
+                    touched_users=0):
     """Music-shaped TrainingExampleAvro: a global bag (6 of 32 features),
     an item bag (4 of 8), user+song ids, labels planted from user/song
     factors so the CD sweep has real structure to recover.  Sampling is
     vectorized per chunk (a per-record rng.choice made the 1M-row prep
     dominate cold bench runs) and the codec is null — the e2e metric
-    measures the pipeline, not zlib (the ingest bench keeps deflate)."""
+    measures the pipeline, not zlib (the ingest bench keeps deflate).
+
+    ``touched_users`` perturbs the item-bag values on rows of the FIRST k
+    user ids (all other rows byte-identical draws) — the refresh bench's
+    controlled entity-local change: exactly those users fingerprint as
+    touched, everyone else carries."""
     from photon_ml_tpu.io.data_reader import write_training_examples
 
     rng = np.random.default_rng(99)
@@ -1014,6 +1020,8 @@ def _write_e2e_file(path, n=E2E_ROWS, users=E2E_USERS, songs=E2E_SONGS):
             ii = rng.random((m, d_item)).argsort(axis=1)[:, :4]
             iv = rng.normal(size=(m, 4))
             u, s = user[lo:lo + m], song[lo:lo + m]
+            if touched_users:
+                iv = np.where((u < touched_users)[:, None], iv * 1.05, iv)
             margin = ((np.take_along_axis(
                 np.broadcast_to(w_fixed, (m, d_fixed)), fi, 1) * fv).sum(1)
                 / np.sqrt(6)
@@ -1161,12 +1169,80 @@ def bench_end_to_end():
           wall_s=round(wall, 2), stage_s=stages, **extra)
 
 
+REFRESH_ROWS = 200_000
+REFRESH_USERS = 4_000
+REFRESH_SONGS = 2_000
+
+
+def bench_refresh():
+    """Incremental continuous-training refresh (cli/refresh_game.py) at
+    1% / 10% / 100% touched-entity fractions: train a base model once,
+    then refresh it against datasets where exactly that fraction of users'
+    rows changed. The metric is re-solved entities per second of refresh
+    wall; ``vs_baseline`` is the speedup of the incremental run's
+    per-entity rate over the 100%-touched (full-refit-cost) run's — the
+    O(touched) vs O(all entities) claim, measured."""
+    from photon_ml_tpu.cli import refresh_game as refresh_game_cli
+    from photon_ml_tpu.cli import train_game as train_game_cli
+
+    base = _cached_fixture("refresh-base", _write_e2e_file, REFRESH_ROWS,
+                           REFRESH_USERS, REFRESH_SONGS)
+    shards = "global=g|intercept,item=it|noIntercept"
+    coords = [
+        "global=fixed,shard=global,reg=L2,maxIter=25",
+        ("perUser=random,entity=userId,shard=item,reg=L2,maxIter=25,"
+         "buckets=histogram,maxSampleBuckets=4"),
+    ]
+    common = [
+        "--feature-shards", shards,
+        "--coordinates", *coords,
+        "--update-sequence", "global,perUser",
+        "--grid", "global=0.001", "perUser=1",
+        "--data-validation", "VALIDATE_DISABLED",
+        "--evaluators", "",
+    ]
+    _heartbeat()
+    with tempfile.TemporaryDirectory() as tmp:
+        prior = os.path.join(tmp, "base")
+        train_game_cli.run(["--training-data", base,
+                            "--output-dir", prior] + common)
+        _heartbeat()
+        runs = []
+        for frac in (0.01, 0.10, 1.00):
+            touched = max(1, int(REFRESH_USERS * frac))
+            data = _cached_fixture(
+                f"refresh-t{int(frac * 100)}", _write_e2e_file,
+                REFRESH_ROWS, REFRESH_USERS, REFRESH_SONGS, touched)
+            out = os.path.join(tmp, f"refresh-{int(frac * 100)}")
+            t0 = time.perf_counter()
+            res = refresh_game_cli.run(
+                ["--prior-dir", prior, "--training-data", data,
+                 "--output-dir", out] + common)
+            wall = time.perf_counter() - t0
+            _heartbeat()
+            runs.append((frac, res, wall))
+        # baseline = the 100%-touched run's per-entity rate (full refit
+        # cost through the identical code path)
+        frac100, res100, wall100 = runs[-1]
+        base_rate = max(sum(res100["solved"].values()), 1) / wall100
+        for frac, res, wall in runs:
+            solved = sum(res["solved"].values())
+            rate = max(solved, 1) / wall
+            _emit(f"refresh_entities_per_sec_{int(frac * 100)}pct", rate,
+                  "entities/s", rate / base_rate,
+                  touched_fraction=frac,
+                  touched_entities=sum(res["touched"].values()),
+                  carried_entities=sum(res["carried"].values()),
+                  solved_entities=solved, wall_s=round(wall, 2),
+                  n_rows=int(REFRESH_ROWS), n_users=int(REFRESH_USERS))
+
+
 def main(argv=None):
     import argparse
 
     p = argparse.ArgumentParser()
     p.add_argument("--only",
-                   choices=["glm", "re", "cd", "ingest", "e2e"],
+                   choices=["glm", "re", "cd", "ingest", "e2e", "refresh"],
                    help="run a single benchmark instead of the full suite")
     args = p.parse_args(argv)
     _setup_compile_cache()
@@ -1191,7 +1267,7 @@ def main(argv=None):
         try:
             {"glm": bench_glm, "re": bench_random_effect,
              "cd": bench_cd_sweep, "ingest": bench_ingest,
-             "e2e": bench_end_to_end}[args.only]()
+             "e2e": bench_end_to_end, "refresh": bench_refresh}[args.only]()
         finally:
             _emit_summary()
         return
@@ -1225,6 +1301,8 @@ def main(argv=None):
         bench_glm()
         drain()
         bench_cd_sweep()
+        drain()
+        bench_refresh()
         drain()
         bench_ingest()
         drain()

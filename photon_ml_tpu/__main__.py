@@ -2,7 +2,8 @@
 
 The four reference entry points (SURVEY.md §2.5) under one module runner —
 ``train_glm``, ``train_game``, ``score_game``, ``build_index`` — plus
-``serve_game``, the online-serving driver the reference never shipped.
+``serve_game`` (online serving) and ``refresh_game`` (the continuous-
+training incremental refresh), neither of which the reference shipped.
 """
 
 from __future__ import annotations
@@ -12,6 +13,7 @@ import sys
 _DRIVERS = {
     "train_glm": "photon_ml_tpu.cli.train_glm",
     "train_game": "photon_ml_tpu.cli.train_game",
+    "refresh_game": "photon_ml_tpu.cli.refresh_game",
     "score_game": "photon_ml_tpu.cli.score_game",
     "serve_game": "photon_ml_tpu.cli.serve_game",
     "build_index": "photon_ml_tpu.cli.build_index",

@@ -1,0 +1,304 @@
+"""Continuous-training refresh driver: ``python -m photon_ml_tpu refresh_game``.
+
+The periodic retrain of a continuously refreshing GLMix deployment
+(PAPER.md §0): warm-start every optimizer from a previously published
+model, re-solve ONLY the random-effect entities whose training data
+changed since that model's run (the ``data-manifest.json`` diff), carry
+every untouched entity's coefficients forward bit-identically, and
+publish BOTH a full merged model directory (the next refresh's parent)
+and an entity-level coefficient patch serving can activate by overwriting
+only the touched rows of its device tables (``serve_game --watch-dir`` or
+``/reload``).
+
+Feature indexes are PRESET from the prior run — a refresh lives in its
+parent's feature space by contract (that is what makes warm starts,
+carried coefficients, and patch rows line up) — while entity vocabularies
+extend freely: new entities train and patch in as fresh rows.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+
+from photon_ml_tpu.cli.config import (
+    add_resilience_flags,
+    add_telemetry_flags,
+    install_resilience,
+    install_telemetry,
+    parse_coordinate_config,
+    parse_feature_shard_config,
+    parse_grid,
+    resilience_from_args,
+    telemetry_from_args,
+)
+from photon_ml_tpu.data_validation import validate_game_data
+from photon_ml_tpu.evaluation import parse_evaluators
+from photon_ml_tpu.game.estimator import (
+    GameOptimizationConfiguration,
+    RandomEffectCoordinateConfig,
+)
+from photon_ml_tpu.io import AvroDataReader
+from photon_ml_tpu.io.data_reader import parse_input_columns
+from photon_ml_tpu.logging_util import RunLogger, timed
+from photon_ml_tpu.types import DataValidationType, TaskType
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="photon_ml_tpu refresh_game",
+        description="Incrementally refresh a published GAME model "
+                    "(warm-start + touched-entity refit + delta publish)")
+    p.add_argument("--prior-dir", required=True,
+                   help="the previous run's output dir (train_game or "
+                        "refresh_game; contains best/ or a "
+                        "model-metadata.json directly) — the refresh "
+                        "warm-starts from it, reuses its feature indexes, "
+                        "and diffs against its data-manifest.json")
+    p.add_argument("--training-data", required=True)
+    p.add_argument("--validation-data")
+    p.add_argument("--output-dir", required=True)
+    p.add_argument("--task", default="LOGISTIC_REGRESSION",
+                   choices=[t.value for t in TaskType])
+    p.add_argument("--feature-shards", required=True,
+                   help="same shard specs used at training time")
+    p.add_argument("--coordinates", required=True, nargs="+",
+                   help="same coordinate specs used at training time")
+    p.add_argument("--update-sequence", required=True)
+    p.add_argument("--grid", nargs="*", default=[],
+                   help="ONE per-coordinate lambda config "
+                        "'coordId=lambda' (a refresh fits a single "
+                        "configuration — tuning belongs to full retrains)")
+    p.add_argument("--refresh-sweeps", type=int, default=1,
+                   help="refresh sweeps over the update sequence "
+                        "(1 = production refresh: one warm pass)")
+    p.add_argument("--evaluators", default="AUC")
+    p.add_argument("--data-validation", default="VALIDATE_FULL",
+                   choices=[v.value for v in DataValidationType])
+    p.add_argument("--model-sparsity-threshold", type=float, default=0.0)
+    p.add_argument("--input-columns", default="")
+    p.add_argument("--no-patch", action="store_true",
+                   help="skip the coefficient-patch artifact (full model "
+                        "dir only)")
+    add_resilience_flags(p)
+    add_telemetry_flags(p)
+    return p
+
+
+def run(argv: Optional[Sequence[str]] = None) -> dict:
+    import sys
+
+    from photon_ml_tpu.events import GLOBAL_BUS
+
+    args = build_parser().parse_args(
+        list(sys.argv[1:] if argv is None else argv))
+    task = TaskType(args.task)
+    install_resilience(resilience_from_args(args))
+    run_logger = RunLogger(args.output_dir)
+    telemetry = install_telemetry(telemetry_from_args(args))
+    from photon_ml_tpu.telemetry import emit_build_info, tracing
+
+    emit_build_info()
+    import contextlib as _contextlib
+
+    _root_span = _contextlib.ExitStack()
+    _root_span.enter_context(tracing.span("refresh_game"))
+    GLOBAL_BUS.post("training_started", driver="refresh_game",
+                    task=task.value, output_dir=args.output_dir)
+    saver = None
+    try:
+        from photon_ml_tpu.continuous import delta as delta_mod
+        from photon_ml_tpu.continuous.refresh import (
+            patch_bytes_counter,
+            refresh_game_model,
+        )
+        from photon_ml_tpu.io.index import IndexMap
+        from photon_ml_tpu.io.model_io import (
+            find_feature_index_dir,
+            game_model_entity_vocabs,
+            load_game_model,
+            model_lineage_id,
+            resolve_game_model_dir,
+        )
+        from photon_ml_tpu.io.pipeline import (
+            BackgroundSaver,
+            save_model_patch_atomic,
+        )
+
+        shard_configs = tuple(parse_feature_shard_config(s)
+                              for s in args.feature_shards.split(","))
+        coordinate_configs = dict(parse_coordinate_config(s)
+                                  for s in args.coordinates)
+        update_sequence = [c for c in args.update_sequence.split(",") if c]
+        grid = parse_grid(args.grid)
+        if len(grid) != 1:
+            raise SystemExit(
+                f"refresh_game fits exactly one configuration "
+                f"(got {len(grid)} --grid configs)")
+        configuration = GameOptimizationConfiguration(grid[0])
+        evaluators = parse_evaluators(
+            [e for e in args.evaluators.split(",") if e])
+
+        prior_model_dir = resolve_game_model_dir(args.prior_dir)
+        index_dir = find_feature_index_dir(prior_model_dir)
+        preset_maps = {
+            cfg.shard_id: IndexMap.load(
+                os.path.join(index_dir, f"{cfg.shard_id}.json"))
+            for cfg in shard_configs}
+
+        re_types = sorted({
+            c.dataset.random_effect_type
+            for c in coordinate_configs.values()
+            if isinstance(c, RandomEffectCoordinateConfig)})
+        id_columns = tuple(dict.fromkeys(
+            re_types + [e.id_tag for e in evaluators if e.id_tag]))
+
+        reader = AvroDataReader(
+            shard_configs=shard_configs, index_maps=preset_maps,
+            input_columns=parse_input_columns(args.input_columns))
+        with timed("Read training data", run_logger):
+            data, index_maps, vocabs = reader.read(args.training_data,
+                                                   id_columns=id_columns)
+        # union id universe: entities of the prior MODEL extend the data's
+        # vocabulary, so carried entities survive even with zero rows this
+        # run (the GLMix refresh premise: most entities see no new data)
+        prior_vocabs = game_model_entity_vocabs(prior_model_dir)
+        for re_type, pv in prior_vocabs.items():
+            tgt = vocabs.setdefault(re_type, {})
+            for raw in pv:
+                tgt.setdefault(raw, len(tgt))
+
+        with timed("Load prior model", run_logger):
+            initial_models = dict(load_game_model(
+                prior_model_dir, index_maps, vocabs).coordinates)
+            prior_lineage = model_lineage_id(prior_model_dir)
+
+        with timed("Validate data", run_logger):
+            validate_game_data(data, task,
+                               DataValidationType(args.data_validation))
+
+        # --- change detection ------------------------------------------
+        re_coords = {
+            cid: (c.dataset.random_effect_type, c.dataset.feature_shard_id)
+            for cid, c in coordinate_configs.items()
+            if isinstance(c, RandomEffectCoordinateConfig)}
+        with timed("Compute delta", run_logger), \
+                tracing.span("refresh.delta"):
+            manifest = delta_mod.build_manifest(data, re_coords, vocabs)
+            prior_manifest = delta_mod.load_manifest(
+                delta_mod.manifest_path_for(prior_model_dir))
+            deltas = delta_mod.coordinate_deltas(prior_manifest, manifest)
+        touched_entities = {
+            cid: np.asarray(
+                sorted(vocabs[re_coords[cid][0]][raw]
+                       for raw in d.touched), np.int64)
+            for cid, d in deltas.items()}
+        if prior_manifest is None:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "prior run has no data-manifest.json — treating every "
+                "entity as touched (cold-cost refresh; the output records "
+                "a manifest, so the NEXT refresh is incremental)")
+
+        validation = None
+        if args.validation_data:
+            reader_v = AvroDataReader(shard_configs=shard_configs,
+                                      index_maps=index_maps,
+                                      input_columns=reader.input_columns)
+            with timed("Read validation data", run_logger):
+                vdata, _, _ = reader_v.read(args.validation_data,
+                                            id_columns=id_columns,
+                                            entity_vocabs=vocabs)
+            validation = (vdata, evaluators)
+
+        with timed("Refresh", run_logger):
+            result = refresh_game_model(
+                task, coordinate_configs, update_sequence, data,
+                configuration, initial_models, touched_entities,
+                n_sweeps=args.refresh_sweeps, validation=validation)
+        for cid, st in result.stats.items():
+            run_logger.metric(stage="refresh", coordinate=cid,
+                              touched=st.touched, carried=st.carried,
+                              solved=st.solved)
+
+        # --- publish: full model (next parent) + manifest + indexes ------
+        import datetime as _dt
+
+        trained_at = _dt.datetime.now(_dt.timezone.utc).isoformat()
+        manifest_dig = delta_mod.manifest_digest(manifest)
+        lineage = {"parentModel": prior_lineage, "trainedAt": trained_at,
+                   "dataManifest": manifest_dig}
+        saver = BackgroundSaver()
+        best_dir = os.path.join(args.output_dir, "best")
+        saver.submit_game_save(
+            best_dir, result.model, index_maps, vocabs,
+            sparsity_threshold=args.model_sparsity_threshold,
+            lineage=lineage)
+        for shard_id, imap in index_maps.items():
+            saver.submit_file_write(
+                imap.save,
+                os.path.join(args.output_dir, "feature-indexes",
+                             f"{shard_id}.json"),
+                label="io.save.index", shard=shard_id)
+        saver.submit_file_write(
+            lambda path: delta_mod.save_manifest(path, manifest),
+            os.path.join(args.output_dir, delta_mod.MANIFEST_NAME),
+            label="io.save.manifest")
+        with timed("Save models", run_logger):
+            saver.join()
+        GLOBAL_BUS.post("model_saved", path=best_dir)
+
+        # --- publish: the entity-level coefficient patch ----------------
+        patch_dir = None
+        if not args.no_patch:
+            patch_dir = os.path.join(args.output_dir, "patch")
+            reverse = {t: {v: k for k, v in vocabs[t].items()}
+                       for t in vocabs}
+            removed_raw = {}
+            for cid, dense_ids in result.removed.items():
+                t = re_coords[cid][0]
+                removed_raw[cid] = [reverse[t][int(e)] for e in dense_ids]
+            with timed("Publish patch", run_logger):
+                patch_bytes = save_model_patch_atomic(
+                    patch_dir, result.patch, index_maps, vocabs,
+                    task=task, parent_model=prior_lineage,
+                    model_id=model_lineage_id(best_dir),
+                    removed=removed_raw,
+                    lineage={"trainedAt": trained_at,
+                             "dataManifest": manifest_dig},
+                    sparsity_threshold=args.model_sparsity_threshold)
+            patch_bytes_counter().inc(patch_bytes)
+            run_logger.metric(stage="patch", bytes=patch_bytes,
+                              coordinates=sorted(result.patch))
+
+        out = {
+            "output_dir": args.output_dir,
+            "patch_dir": patch_dir,
+            "parent_model": prior_lineage,
+            "touched": {cid: st.touched
+                        for cid, st in result.stats.items()},
+            "carried": {cid: st.carried
+                        for cid, st in result.stats.items()},
+            "solved": {cid: st.solved
+                       for cid, st in result.stats.items()},
+            "evaluation": (result.final_evaluation.as_dict()
+                           if result.final_evaluation is not None
+                           else None),
+        }
+        return out
+    finally:
+        if saver is not None:
+            saver.close()
+        _root_span.close()
+        GLOBAL_BUS.post("training_finished", driver="refresh_game")
+        telemetry.close()
+        run_logger.close()
+
+
+if __name__ == "__main__":
+    run()

@@ -42,6 +42,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-warmup", action="store_true",
                    help="skip pre-compiling the bucket executables at "
                         "startup (first requests then pay the compiles)")
+    p.add_argument("--watch-dir", metavar="DIR",
+                   help="poll DIR for new model versions — full "
+                        "train_game/refresh_game output dirs OR "
+                        "coefficient-patch dirs — and apply each through "
+                        "the validate-then-activate path (registry-driven "
+                        "discovery; no /reload call needed). Entries "
+                        "apply in sorted name order; rejected candidates "
+                        "never disturb the active version")
+    p.add_argument("--watch-poll-s", type=float, default=10.0,
+                   help="poll interval for --watch-dir (seconds)")
     from photon_ml_tpu.cli.config import add_telemetry_flags
 
     add_telemetry_flags(p)
@@ -94,6 +104,12 @@ def build_server(argv: Optional[Sequence[str]] = None):
                              batcher=batcher)
     server = GameServer(service, host=args.host, port=args.port)
     server.telemetry = telemetry  # closed by run()'s finally
+    server.watcher = None
+    if args.watch_dir:
+        from photon_ml_tpu.serving import ModelDirectoryWatcher
+
+        server.watcher = ModelDirectoryWatcher(
+            registry, args.watch_dir, poll_s=args.watch_poll_s).start()
     return server
 
 
@@ -107,6 +123,8 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
     except KeyboardInterrupt:
         pass
     finally:
+        if server.watcher is not None:
+            server.watcher.stop()
         server.stop()
         server.telemetry.close()
     return {"url": server.url, "version": version}

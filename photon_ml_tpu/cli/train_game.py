@@ -404,6 +404,44 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                 raise SystemExit(
                     f"locked coordinates {sorted(missing)} not present in "
                     f"the input model")
+
+        # --- continuous-training lineage + data manifest ----------------
+        # every published model records where it came from (parentModel /
+        # trainedAt) and a per-entity fingerprint manifest of its training
+        # data, so refresh_game can warm-start from it and re-solve only
+        # the entities whose data changed. Chief-only and single-process:
+        # a multi-process share sees a partial row set, so its manifest
+        # would mis-flag every remotely-read entity as changed.
+        lineage = None
+        if chief:
+            import datetime as _dt
+
+            manifest_digest = None
+            if not multiproc:
+                from photon_ml_tpu.continuous import delta as _delta
+
+                re_coords = {
+                    cid: (c.dataset.random_effect_type,
+                          c.dataset.feature_shard_id)
+                    for cid, c in coordinate_configs.items()
+                    if isinstance(c, RandomEffectCoordinateConfig)}
+                _manifest = _delta.build_manifest(data, re_coords, vocabs)
+                manifest_digest = _delta.manifest_digest(_manifest)
+                saver.submit_file_write(
+                    lambda path, m=_manifest: _delta.save_manifest(path, m),
+                    os.path.join(args.output_dir, _delta.MANIFEST_NAME),
+                    label="io.save.manifest")
+            parent_lineage = None
+            if args.model_input_dir:
+                from photon_ml_tpu.io.model_io import model_lineage_id
+
+                parent_lineage = model_lineage_id(model_dir)
+            lineage = {
+                "parentModel": parent_lineage,
+                "trainedAt": _dt.datetime.now(
+                    _dt.timezone.utc).isoformat(),
+                "dataManifest": manifest_digest,
+            }
         with timed("Validate data", run_logger):
             validate_game_data(data, task,
                                DataValidationType(args.data_validation))
@@ -464,12 +502,14 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                 saver.submit_game_save(
                     os.path.join(args.output_dir, "all", f"config-{i}"),
                     r.model, index_maps, vocabs,
-                    sparsity_threshold=args.model_sparsity_threshold)
+                    sparsity_threshold=args.model_sparsity_threshold,
+                    lineage=lineage)
             elif _single_config[0] and i == 0:
                 saver.submit_game_save(
                     os.path.join(args.output_dir, "best"),
                     r.model, index_maps, vocabs,
-                    sparsity_threshold=args.model_sparsity_threshold)
+                    sparsity_threshold=args.model_sparsity_threshold,
+                    lineage=lineage)
                 _best_pre_submitted[0] = True
 
         def _mp_fit(config, mp_ckpt=None):
@@ -658,7 +698,8 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                 # the winner is only known now — submit its (sole) save
                 saver.submit_game_save(
                     best_dir, best.model, index_maps, vocabs,
-                    sparsity_threshold=args.model_sparsity_threshold)
+                    sparsity_threshold=args.model_sparsity_threshold,
+                    lineage=lineage)
             # the stage is now the JOIN wall: whatever the background
             # writers didn't finish under train/selection (plus, under
             # --output-all-models, the hardlink alias publish)

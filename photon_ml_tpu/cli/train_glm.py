@@ -104,6 +104,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--input-columns", default="",
                    help="remap record fields, e.g. 'response=label' "
                         "(reference InputColumnsNames)")
+    p.add_argument("--warm-start", metavar="DIR",
+                   help="continuous-training warm start: seed the sweep's "
+                        "FIRST solve from a previous run's best model "
+                        "(DIR is a train_glm output dir containing "
+                        "best/model.avro, or a model.avro's directory). "
+                        "Coefficients join by feature NAME, so the prior "
+                        "model aligns even if this run's feature index "
+                        "orders differently; the warm-started solve "
+                        "converges in strictly fewer iterations on "
+                        "unchanged data. Sequential sweep mode only")
     p.add_argument("--design-dtype", default="float32",
                    choices=["float32", "bfloat16"],
                    help="storage dtype of a DENSE design matrix. bfloat16 "
@@ -253,6 +263,12 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
         finally:
             telemetry.close()
     task = TaskType(args.task)
+    if args.warm_start and args.sweep_mode == "batched":
+        # fail fast, before any read: batched lanes solve independently
+        # from zero by design — there is nothing to warm-start
+        raise SystemExit(
+            "--warm-start needs --sweep-mode sequential (batched lanes "
+            "solve independently from zero by design)")
     # install the retry policy BEFORE anything that might retry (multihost
     # initialization is the first candidate)
     install_resilience(resilience_from_args(args))
@@ -414,6 +430,21 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                     args.output_dir, "workers",
                     f"proc-{jax.process_index()}"),
                 "profile")
+        initial = None
+        if args.warm_start:
+            from photon_ml_tpu.io.model_io import load_glm_model
+
+            warm_path = os.path.join(args.warm_start, "best", "model.avro")
+            if not os.path.exists(warm_path):
+                warm_path = os.path.join(args.warm_start, "model.avro")
+            with timed("Load warm start", run_logger):
+                prior = load_glm_model(warm_path, imap)
+            # the sweep optimizes in TRANSFORMED space; a saved model's
+            # coefficients are original-space (export back-transforms)
+            w_orig = jnp.asarray(prior.coefficients.means)
+            initial = (w_orig if normalization.is_identity
+                       else normalization.original_to_model(w_orig))
+
         with timed("Train", run_logger), profiled(profile_dir):
             if args.sweep_mode == "batched":
                 # multiproc + batched already rejected up front
@@ -426,6 +457,7 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                 trained = train_glm_sweep(
                     task, glm_train, lambdas, config,
                     normalization=normalization, reg_mask=reg_mask,
+                    initial=initial,
                     mesh=fe_mesh, dim=len(imap) if multiproc else None)
         for tm in trained:
             run_logger.metric(stage="train", regularization_weight=tm.regularization_weight,

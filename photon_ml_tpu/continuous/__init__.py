@@ -1,0 +1,44 @@
+"""Continuous training: warm-start refresh, incremental refit, delta publish.
+
+Photon-ML's production story is a continuously refreshing GLMix deployment
+(PAPER.md §0, §GAME): periodic retrains warm-started from the previous
+model, where per-entity random effects are millions of tiny independent
+solves and MOST entities see no new data between refreshes. This package
+closes the train→serve loop the repo already has both ends of:
+
+- :mod:`~photon_ml_tpu.continuous.delta` — per-entity data fingerprints
+  and the ``data-manifest.json`` recorded with every published model, so a
+  refresh can tell exactly which entities' training data changed since the
+  model it warm-starts from.
+- :mod:`~photon_ml_tpu.continuous.refresh` — the refresh loop itself:
+  every optimizer seeded from the prior model (GLM solves start from the
+  prior coefficient vector; GAME coordinates through the estimator's
+  ``initial_models`` machinery), random-effect coordinates re-solve ONLY
+  the touched entities (bucketed exactly like the full path in
+  ``game/random_effect.py``) and every untouched entity's coefficients
+  carry forward — refresh cost O(touched entities), not O(all entities).
+
+The refresh output is both a full model directory (the next refresh's
+warm-start parent) and an *entity-level coefficient patch*
+(``io/model_io.py::save_game_model_patch``) that serving activates by
+overwriting only the touched rows of its dense device tables
+(``serving/store.py::EntityCoefficientStore.apply_patch`` via
+``serving/registry.py::ModelRegistry.load_patch``) instead of rebuilding
+them. See CONTINUOUS.md for the loop architecture, the patch format, and
+the failure semantics around the ``io.delta_publish`` fault site.
+"""
+
+from photon_ml_tpu.continuous.delta import (  # noqa: F401
+    MANIFEST_NAME,
+    EntityDelta,
+    build_manifest,
+    entity_delta,
+    entity_fingerprints,
+    load_manifest,
+    manifest_digest,
+    save_manifest,
+)
+from photon_ml_tpu.continuous.refresh import (  # noqa: F401
+    RefreshResult,
+    refresh_game_model,
+)

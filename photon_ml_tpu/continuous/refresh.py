@@ -1,0 +1,290 @@
+"""The incremental refresh loop: warm-start everything, re-solve only
+touched random-effect entities, carry the rest forward.
+
+Why this is not just ``GameEstimator.fit(initial_models=...)``: coordinate
+descent's residual accounting replaces a coordinate's WHOLE score vector
+when the coordinate trains, so a random-effect coordinate restricted to
+touched entities would lose the carried entities' score contribution.
+The refresh loop keeps CD's residual discipline — ``total = offsets +
+Σ scores[c]``, train against ``total - scores[c]`` — but merges per
+coordinate: touched entities' rows take the fresh solve's scores, carried
+entities' rows keep the prior model's (seeded once from
+``model.score(data)``, exactly how CD seeds ``initial_models``).
+
+The touched-only solve IS the full path: the touched entities' rows are
+re-bucketed by :meth:`photon_ml_tpu.game.data.RandomEffectDataset.build`
+(the untouched entities are masked to ``-1`` — the reader's "missing id"
+convention — so they contribute no rows, no buckets and no solves) and
+solved by the same :class:`~photon_ml_tpu.game.coordinate.
+RandomEffectCoordinate` / vmapped-bucket machinery as cold training, warm
+started from the prior model's coefficient table through the solver's
+existing key join. Refresh cost is O(touched entities) compute plus one
+O(n) scoring pass per coordinate for the seed.
+
+Observability: ``photon_refresh_*`` counters (touched / carried / solved
+entities per coordinate, patch bytes at publish) and ``refresh.*`` spans
+(``refresh.sweep`` → ``refresh.step``). The publish side's fault site is
+``io.delta_publish`` (io/pipeline.py + serving/registry.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Mapping, Optional, Sequence
+
+import numpy as np
+
+from photon_ml_tpu.evaluation import evaluate_all
+from photon_ml_tpu.game.coordinate import (
+    FixedEffectCoordinate,
+    RandomEffectCoordinate,
+)
+from photon_ml_tpu.game.data import (
+    FixedEffectDataset,
+    GameData,
+    RandomEffectDataset,
+)
+from photon_ml_tpu.game.estimator import (
+    FixedEffectCoordinateConfig,
+    GameOptimizationConfiguration,
+    RandomEffectCoordinateConfig,
+)
+from photon_ml_tpu.game.model import (
+    FixedEffectModel,
+    GameModel,
+    RandomEffectModel,
+)
+from photon_ml_tpu.telemetry import metrics as tmetrics
+from photon_ml_tpu.telemetry import tracing
+from photon_ml_tpu.types import TaskType
+
+logger = logging.getLogger(__name__)
+
+
+def _touched_counter():
+    return tmetrics.counter(
+        "photon_refresh_touched_entities_total",
+        "Entities whose training data changed since the parent model "
+        "(refit candidates), per refresh run", labels=("coordinate",))
+
+
+def _carried_counter():
+    return tmetrics.counter(
+        "photon_refresh_carried_entities_total",
+        "Entities whose coefficients carried forward untouched (unchanged "
+        "or absent data)", labels=("coordinate",))
+
+
+def _solved_counter():
+    return tmetrics.counter(
+        "photon_refresh_solved_entities_total",
+        "Random-effect entities actually re-solved by the incremental "
+        "refit (== touched entities surviving the active-data bounds, "
+        "once per refresh sweep)", labels=("coordinate",))
+
+
+def patch_bytes_counter():
+    return tmetrics.counter(
+        "photon_refresh_patch_bytes_total",
+        "Bytes of published entity-level coefficient patches")
+
+
+@dataclasses.dataclass
+class CoordinateRefreshStats:
+    """Per-coordinate accounting of one refresh run."""
+
+    touched: int = 0
+    carried: int = 0
+    solved: int = 0
+
+
+@dataclasses.dataclass
+class RefreshResult:
+    """One refresh run's outputs.
+
+    ``model`` is the merged full model (touched entities fresh, carried
+    entities bit-identical to the parent) — the next refresh's parent and
+    the source of the full published directory. ``patch`` holds only what
+    changed: every fixed-effect coordinate's (small, always-retrained)
+    model plus, per touched random-effect coordinate, a partial
+    :class:`RandomEffectModel` of just the re-solved entities.
+    ``removed`` lists DENSE entity ids whose models vanished (touched
+    entities that no longer clear the active-data bounds) — the driver
+    maps them to raw ids for the patch metadata, which must communicate
+    the removal or a stale serving row would keep scoring.
+    """
+
+    model: GameModel
+    patch: dict[str, object]
+    removed: dict[str, list[str]]
+    stats: dict[str, CoordinateRefreshStats]
+    validation_history: list[dict]
+    final_evaluation: object = None
+
+
+def _masked_view(data: GameData, re_type: str,
+                 touched: np.ndarray) -> tuple[GameData, np.ndarray]:
+    """A view of ``data`` where every entity NOT in ``touched`` reads as
+    absent (id ``-1``): the dataset build then buckets only touched
+    entities, with untouched rows contributing nothing. Shares the
+    original's device cache — same shards, same labels/weights, so the
+    dense shard image and label uploads are reused, not re-shipped."""
+    ids = data.id_columns[re_type]
+    keep = np.isin(ids, touched)
+    view = dataclasses.replace(
+        data, id_columns={**data.id_columns,
+                          re_type: np.where(keep, ids, np.int64(-1))})
+    object.__setattr__(view, "_device_cache", data._device_cache)
+    return view, keep
+
+
+def refresh_game_model(
+    task: TaskType,
+    coordinate_configs: Mapping[str, object],
+    update_sequence: Sequence[str],
+    data: GameData,
+    configuration: GameOptimizationConfiguration,
+    initial_models: Mapping[str, object],
+    touched_entities: Mapping[str, np.ndarray],
+    *,
+    n_sweeps: int = 1,
+    validation=None,  # (GameData, evaluators) | zero-arg callable -> same
+) -> RefreshResult:
+    """Run ``n_sweeps`` incremental refresh sweeps.
+
+    ``initial_models`` must cover EVERY coordinate in the update sequence
+    (a refresh warm-starts an existing deployment; a coordinate without a
+    parent model needs a full retrain, not a refresh).
+    ``touched_entities`` maps random-effect coordinate ids to the DENSE
+    entity ids whose data changed; a missing/empty entry means the whole
+    coordinate carries forward without a single solve. Fixed-effect
+    coordinates always retrain (the global data changed by definition when
+    anything did; the solve is one warm-started GLM).
+    """
+    seq = list(update_sequence)
+    missing = [cid for cid in seq if cid not in initial_models]
+    if missing:
+        raise ValueError(
+            f"refresh needs a prior model for every coordinate; missing "
+            f"{missing} — run a full train_game for new coordinates")
+    models: dict[str, object] = {cid: initial_models[cid] for cid in seq}
+    prior_entities: dict[str, np.ndarray] = {}
+
+    # --- build coordinates once (touched-only datasets for REs) -----------
+    coords: dict[str, object] = {}
+    touched_masks: dict[str, np.ndarray] = {}
+    stats = {cid: CoordinateRefreshStats() for cid in seq}
+    for cid in seq:
+        cfg = coordinate_configs.get(cid)
+        if isinstance(cfg, FixedEffectCoordinateConfig):
+            ds = FixedEffectDataset.build(cid, data, cfg.feature_shard_id)
+            coords[cid] = FixedEffectCoordinate(
+                coordinate_id=cid, dataset=ds, task=task,
+                config=cfg.optimization, lam=configuration.lam(cid),
+                downsampler=cfg.downsampler)
+        elif isinstance(cfg, RandomEffectCoordinateConfig):
+            prior = models[cid]
+            prior_entities[cid] = (
+                np.unique(prior.keys // prior.dim) if len(prior.keys)
+                else np.zeros(0, np.int64))
+            touched = np.asarray(touched_entities.get(cid, ()), np.int64)
+            stats[cid].touched = len(touched)
+            if not len(touched):
+                continue  # whole coordinate carries forward
+            view, keep = _masked_view(
+                data, cfg.dataset.random_effect_type, touched)
+            ds = RandomEffectDataset.build(cid, view, cfg.dataset)
+            coords[cid] = RandomEffectCoordinate(
+                coordinate_id=cid, dataset=ds, data=view, task=task,
+                config=cfg.optimization, lam=configuration.lam(cid),
+                design_dtype=cfg.design_dtype)
+            touched_masks[cid] = keep
+        else:
+            raise ValueError(
+                f"refresh does not support coordinate {cid!r} of type "
+                f"{type(cfg).__name__} (factored coordinates re-learn a "
+                f"projection — run a full retrain)")
+
+    # --- seed the score decomposition from the prior model ----------------
+    # (exactly how coordinate descent seeds initial_models: each
+    # coordinate's full-data margin, so carried entities' contributions
+    # are present in the residual from sweep 0)
+    scores = {cid: np.asarray(models[cid].score(data), np.float32)
+              for cid in seq}
+    total = data.offsets.astype(np.float32)
+    for cid in seq:
+        total = total + scores[cid]
+
+    patch: dict[str, object] = {}
+    history: list[dict] = []
+    final_evaluation = None
+    for sweep in range(n_sweeps):
+        with tracing.span("refresh.sweep", sweep=sweep):
+            for cid in seq:
+                coord = coords.get(cid)
+                if coord is None:
+                    continue  # carried random-effect coordinate
+                with tracing.span("refresh.step", coordinate=cid,
+                                  sweep=sweep):
+                    residual = total - scores[cid]
+                    model, new_scores = coord.train(
+                        residual, models.get(cid), sweep=sweep)
+                    new_scores = np.asarray(new_scores, np.float32)
+                    if isinstance(coord, RandomEffectCoordinate):
+                        _solved_counter().labels(coordinate=cid).inc(
+                            model.n_entities)
+                        stats[cid].solved += model.n_entities
+                        mask = touched_masks[cid]
+                        new_scores = np.where(mask, new_scores,
+                                              scores[cid])
+                        patch[cid] = model
+                        model = models[cid].merge(
+                            model,
+                            drop_entities=touched_entities.get(cid, ()))
+                    else:
+                        patch[cid] = model
+                    models[cid] = model
+                    scores[cid] = new_scores
+                    total = residual + new_scores
+            if validation is not None:
+                if callable(validation):
+                    validation = validation()
+                vdata, evaluators = validation
+                with tracing.span("refresh.validate", sweep=sweep):
+                    gm = GameModel(
+                        coordinates={c: models[c] for c in seq}, task=task)
+                    results = evaluate_all(
+                        evaluators, gm.score(vdata), vdata.labels,
+                        weights=vdata.weights, id_tags=vdata.id_columns)
+                history.append(results.as_dict())
+                final_evaluation = results
+                logger.info("refresh sweep %d validation: %s", sweep,
+                            results)
+
+    # carried accounting + removals (touched entities that fell below the
+    # active-data bounds: their prior model rows were dropped by merge and
+    # the patch must tell serving to zero them)
+    removed: dict[str, list[str]] = {}
+    for cid in seq:
+        cfg = coordinate_configs.get(cid)
+        if not isinstance(cfg, RandomEffectCoordinateConfig):
+            continue
+        touched = np.asarray(touched_entities.get(cid, ()), np.int64)
+        merged = models[cid]
+        kept = (np.unique(merged.keys // merged.dim) if len(merged.keys)
+                else np.zeros(0, np.int64))
+        stats[cid].carried = int(
+            len(np.setdiff1d(prior_entities[cid], touched,
+                             assume_unique=False)))
+        gone = np.setdiff1d(
+            np.intersect1d(touched, prior_entities[cid]), kept)
+        if len(gone):
+            removed[cid] = [int(e) for e in gone]
+        _touched_counter().labels(coordinate=cid).inc(len(touched))
+        _carried_counter().labels(coordinate=cid).inc(stats[cid].carried)
+    return RefreshResult(
+        model=GameModel(coordinates={cid: models[cid] for cid in seq},
+                        task=task),
+        patch=patch, removed=removed, stats=stats,
+        validation_history=history, final_evaluation=final_evaluation)

@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
-from typing import Mapping, Optional
+from typing import Mapping, Optional, Sequence
 
 import numpy as np
 
@@ -200,6 +200,101 @@ class RandomEffectModel:
             feat = np.tile(np.arange(d, dtype=np.int64), len(uniq))
             table = self.lookup(ent, feat).reshape(len(uniq), d)
             out[valid] = np.einsum("nd,nd->n", z[valid], table[inv])
+        return out
+
+    def merge(self, update: "RandomEffectModel",
+              drop_entities: Sequence[int] = ()) -> "RandomEffectModel":
+        """Entity-level patch merge: entities present in ``update`` (or
+        listed in ``drop_entities``) have their rows REPLACED by (resp.
+        dropped in favor of) the update's; every other entity's rows carry
+        forward bit-identically. The continuous-training loop's model-side
+        counterpart of :meth:`photon_ml_tpu.serving.store.
+        EntityCoefficientStore.apply_patch` — both sides must agree on the
+        replace-whole-entity semantics or a patched serving table and the
+        published merged model would drift.
+
+        Both models must live in the same key space (same ``dim``, same
+        dense entity-id universe, no projector). Variances survive only
+        when BOTH sides carry them (a mixed merge would leave the variance
+        table misaligned with the coefficients).
+        """
+        if update.random_effect_type != self.random_effect_type:
+            raise ValueError(
+                f"merge across random-effect types "
+                f"{self.random_effect_type!r} != {update.random_effect_type!r}")
+        if update.dim != self.dim:
+            raise ValueError(f"merge across dims {self.dim} != {update.dim}")
+        if self.projector is not None or update.projector is not None:
+            raise ValueError("merge expects shard-space models "
+                             "(call to_shard_space() first)")
+        upd_entities = (np.unique(update.keys // self.dim)
+                        if len(update.keys) else np.zeros(0, np.int64))
+        drop = np.union1d(np.asarray(list(drop_entities), np.int64),
+                          upd_entities)
+        keep = (~np.isin(self.keys // self.dim, drop) if len(self.keys)
+                else np.zeros(0, bool))
+        keys = np.concatenate([self.keys[keep], update.keys])
+        coeffs = np.concatenate([
+            np.asarray(self.coeffs, np.float32)[keep],
+            np.asarray(update.coeffs, np.float32)])
+        variances = None
+        if self.variances is not None and update.variances is not None:
+            variances = np.concatenate([
+                np.asarray(self.variances, np.float32)[keep],
+                np.asarray(update.variances, np.float32)])
+        order = np.argsort(keys, kind="stable")
+        return RandomEffectModel(
+            random_effect_type=self.random_effect_type,
+            feature_shard_id=self.feature_shard_id, task=self.task,
+            dim=self.dim, keys=keys[order], coeffs=coeffs[order],
+            variances=None if variances is None else variances[order])
+
+    def remap_entities(self, new_of_old: Mapping[int, int]
+                       ) -> "RandomEffectModel":
+        """The same coefficients under a different dense entity-id
+        universe (``old dense id → new dense id``). Dense ids are a
+        per-run artifact of vocabulary order; a patch loaded under its own
+        vocabulary must be remapped into the serving store's universe
+        before :meth:`merge`. Every entity must be mapped — a silent drop
+        here would silently lose a patched entity."""
+        if not len(self.keys):
+            return self
+        ent = self.keys // self.dim
+        feat = self.keys % self.dim
+        lut = np.full(int(ent.max()) + 1, -1, np.int64)
+        for old, new in new_of_old.items():
+            if 0 <= int(old) < len(lut):
+                lut[int(old)] = int(new)
+        new_ent = lut[ent]
+        if (new_ent < 0).any():
+            missing = np.unique(ent[new_ent < 0])[:5]
+            raise KeyError(
+                f"remap_entities: no mapping for dense entities "
+                f"{missing.tolist()}")
+        keys = new_ent * np.int64(self.dim) + feat
+        order = np.argsort(keys, kind="stable")
+        return dataclasses.replace(
+            self, keys=keys[order],
+            coeffs=np.asarray(self.coeffs, np.float32)[order],
+            variances=(None if self.variances is None
+                       else np.asarray(self.variances, np.float32)[order]),
+            coeffs_device=None)
+
+    def entity_rows(self, dense_ids: Sequence[int]) -> np.ndarray:
+        """Dense ``(len(dense_ids), dim)`` coefficient rows for the given
+        entities (0 where absent) — the layout a serving table patch
+        overwrites rows with."""
+        ids = np.asarray(list(dense_ids), np.int64)
+        out = np.zeros((len(ids), self.dim), np.float32)
+        if not len(self.keys) or not len(ids):
+            return out
+        ent = self.keys // self.dim
+        feat = self.keys % self.dim
+        pos_of = {int(e): i for i, e in enumerate(ids)}
+        mask = np.isin(ent, ids)
+        rows = np.fromiter((pos_of[int(e)] for e in ent[mask]), np.int64,
+                           count=int(mask.sum()))
+        out[rows, feat[mask]] = np.asarray(self.coeffs, np.float32)[mask]
         return out
 
     def to_shard_space(self) -> "RandomEffectModel":

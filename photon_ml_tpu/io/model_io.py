@@ -162,6 +162,20 @@ def _write_coordinate_part(output_dir: str, cid: str, cm,
     return part
 
 
+#: the lineage fields every ``model-metadata.json`` carries (null when the
+#: writer supplies no lineage — deterministic, so byte-identity contracts
+#: on repeated saves of the same model hold): ``parentModel`` is the
+#: lineage id of the model this one warm-started from,``trainedAt`` an ISO
+#: timestamp stamped by the driver, ``dataManifest`` the digest of the
+#: run's ``data-manifest.json`` (continuous/delta.py).
+LINEAGE_FIELDS = ("parentModel", "trainedAt", "dataManifest")
+
+
+def _apply_lineage(metadata: dict, lineage) -> None:
+    for field in LINEAGE_FIELDS:
+        metadata[field] = (lineage or {}).get(field)
+
+
 def save_game_model(
     output_dir: str,
     model: GameModel,
@@ -170,6 +184,7 @@ def save_game_model(
     *,
     sparsity_threshold: float = 0.0,
     executor=None,
+    lineage: Optional[dict] = None,
 ) -> None:
     """Write the reference's fixed-effect/random-effect directory tree.
 
@@ -179,12 +194,15 @@ def save_game_model(
     the save wall the *max* of the coordinate writes instead of their sum.
     The written bytes are identical either way (same writers, same record
     order; only the spec-mandated random container sync markers differ
-    between any two Avro writes)."""
+    between any two Avro writes). ``lineage`` fills the
+    :data:`LINEAGE_FIELDS` (null otherwise, keeping repeated saves of the
+    same model deterministic)."""
     os.makedirs(output_dir, exist_ok=True)
     # one combined device→host pull for every coordinate's tables (vs one
     # round trip per coordinate as each writer touches its arrays)
     model.materialize()
     metadata = {"task": model.task.value, "coordinates": {}}
+    _apply_lineage(metadata, lineage)
     jobs = []
     for cid, cm in model.coordinates.items():
         kind, extra = _coordinate_kind(cm)
@@ -308,6 +326,105 @@ def _re_records(model: RandomEffectModel, index_map: IndexMap,
             "means": means,
             "variances": variances,
         }
+
+
+#: the ``kind`` metadata value marking an entity-level coefficient patch
+#: (continuous-training delta publish) instead of a full model tree
+PATCH_KIND = "coefficient-patch"
+
+
+def save_game_model_patch(
+    output_dir: str,
+    patch_models: dict[str, "FixedEffectModel | RandomEffectModel"],
+    index_maps: dict[str, IndexMap],
+    entity_vocabs: dict[str, dict[str, int]],
+    *,
+    task: TaskType,
+    parent_model: str,
+    model_id: str,
+    removed: Optional[dict[str, list[str]]] = None,
+    lineage: Optional[dict] = None,
+    sparsity_threshold: float = 0.0,
+) -> None:
+    """Write an entity-level coefficient patch (continuous training's
+    delta-publish artifact).
+
+    Same directory layout and record shapes as a full model — a patch IS a
+    model tree, just a partial one: fixed-effect coordinates in full
+    (always retrained, one record each), random-effect coordinates holding
+    ONLY the re-solved entities' records. The metadata marks it
+    ``kind=coefficient-patch`` and records its lineage: ``parentModel``
+    (the lineage id of the model whose serving tables it patches — the
+    registry refuses a mismatch) and ``modelId`` (the lineage id of the
+    equivalent merged full model, which becomes the patched version's
+    identity so the NEXT patch can chain). ``removed`` lists raw entity
+    ids per coordinate whose models vanished this refresh; serving zeroes
+    their rows.
+    """
+    os.makedirs(output_dir, exist_ok=True)
+    metadata: dict = {"task": task.value, "kind": PATCH_KIND,
+                      "modelId": model_id, "parentModel": parent_model,
+                      "coordinates": {}}
+    _apply_lineage(metadata, {**(lineage or {}),
+                              "parentModel": parent_model})
+    for cid, cm in patch_models.items():
+        kind, extra = _coordinate_kind(cm)
+        entry = {"type": kind, **extra}
+        rm = (removed or {}).get(cid)
+        if rm:
+            entry["removedEntities"] = sorted(rm)
+        metadata["coordinates"][cid] = entry
+        _write_coordinate_part(output_dir, cid, cm,
+                               index_maps[cm.feature_shard_id],
+                               entity_vocabs, sparsity_threshold)
+    metadata_path = os.path.join(output_dir, "model-metadata.json")
+    with open(metadata_path, "w") as f:
+        json.dump(metadata, f, indent=2)
+    from photon_ml_tpu.io.pipeline import _save_bytes
+
+    _save_bytes().inc(os.path.getsize(metadata_path))
+
+
+def model_kind(model_dir: str) -> str:
+    """``"model"`` or ``"coefficient-patch"`` for a resolved model dir."""
+    with open(os.path.join(model_dir, "model-metadata.json")) as f:
+        return json.load(f).get("kind") or "model"
+
+
+def model_lineage_id(model_dir: str) -> str:
+    """Content identity of a saved model: blake2b over the metadata's
+    structural fields and every coordinate's DECODED records.
+
+    Writer-agnostic on purpose: Avro container bytes differ between any
+    two writes (random sync markers) and alias dirs rewrite metadata with
+    ``aliasOf``, but the records — and therefore this id — are identical
+    for the same model content. This is the currency of the continuous
+    loop's lineage checks: a patch names its parent's lineage id and the
+    serving registry refuses to overlay it on any other version's tables.
+    """
+    import hashlib
+
+    model_dir = resolve_game_model_dir(model_dir)
+    with open(os.path.join(model_dir, "model-metadata.json")) as f:
+        metadata = json.load(f)
+    h = hashlib.blake2b(digest_size=16)
+    structural = {
+        "task": metadata["task"],
+        "kind": metadata.get("kind"),
+        "coordinates": {
+            cid: {k: info.get(k) for k in ("type", "featureShardId",
+                                           "randomEffectType")}
+            for cid, info in metadata["coordinates"].items()},
+    }
+    h.update(json.dumps(structural, sort_keys=True).encode())
+    for cid in sorted(metadata["coordinates"]):
+        info = metadata["coordinates"][cid]
+        part = os.path.join(model_dir, info["type"], cid, "coefficients",
+                            "part-00000.avro")
+        h.update(cid.encode())
+        for rec in iter_avro_file(part):
+            h.update(json.dumps(rec, sort_keys=True).encode())
+    return h.hexdigest()
 
 
 def resolve_game_model_dir(path: str) -> str:

@@ -119,6 +119,7 @@ def _gc_stale_staging(parent: str, base: str) -> None:
 def save_game_model_atomic(output_dir: str, model, index_maps, entity_vocabs,
                            *, sparsity_threshold: float = 0.0,
                            executor: Optional[ThreadPoolExecutor] = None,
+                           lineage: Optional[dict] = None,
                            ) -> None:
     """:func:`photon_ml_tpu.io.model_io.save_game_model` with crash-safe
     publication: the model tree is written into a hidden staging sibling
@@ -142,7 +143,7 @@ def save_game_model_atomic(output_dir: str, model, index_maps, entity_vocabs,
         try:
             save_game_model(staging, model, index_maps, entity_vocabs,
                             sparsity_threshold=sparsity_threshold,
-                            executor=executor)
+                            executor=executor, lineage=lineage)
             fault_point("io.model_save", path=output_dir)
             publish_dir(staging, output_dir)
         except BaseException:
@@ -150,6 +151,51 @@ def save_game_model_atomic(output_dir: str, model, index_maps, entity_vocabs,
             raise
 
     retry(attempt, name=f"io.model_save:{base}")
+
+
+def save_model_patch_atomic(output_dir: str, patch_models, index_maps,
+                            entity_vocabs, *, task, parent_model: str,
+                            model_id: str, removed=None,
+                            lineage: Optional[dict] = None,
+                            sparsity_threshold: float = 0.0) -> int:
+    """:func:`photon_ml_tpu.io.model_io.save_game_model_patch` with the
+    same staged atomic publication as full models, under the
+    ``io.delta_publish`` fault site (staging fully written, rename not yet
+    done). A fault or crash there leaves the previous patch — or nothing —
+    visible; a registry or watch-dir poll can never observe a partial
+    patch. Returns the published patch's payload bytes (the
+    ``photon_refresh_patch_bytes_total`` increment)."""
+    from photon_ml_tpu.io.model_io import save_game_model_patch
+    from photon_ml_tpu.resilience import fault_point, retry
+
+    output_dir = os.path.normpath(output_dir)
+    parent = os.path.dirname(os.path.abspath(output_dir))
+    os.makedirs(parent, exist_ok=True)
+    base = os.path.basename(output_dir)
+
+    def attempt() -> None:
+        _gc_stale_staging(parent, base)
+        staging = tempfile.mkdtemp(prefix=f".{base}-stage-", suffix=".tmp",
+                                   dir=parent)
+        try:
+            with tracing.span("refresh.publish", path=output_dir):
+                save_game_model_patch(
+                    staging, patch_models, index_maps, entity_vocabs,
+                    task=task, parent_model=parent_model, model_id=model_id,
+                    removed=removed, lineage=lineage,
+                    sparsity_threshold=sparsity_threshold)
+                fault_point("io.delta_publish", path=output_dir)
+                publish_dir(staging, output_dir)
+        except BaseException:
+            shutil.rmtree(staging, ignore_errors=True)
+            raise
+
+    retry(attempt, name=f"io.delta_publish:{base}")
+    total = 0
+    for dirpath, _dirs, files in os.walk(output_dir):
+        for name in files:
+            total += os.path.getsize(os.path.join(dirpath, name))
+    return total
 
 
 def publish_model_alias(src_dir: str, dst_dir: str) -> None:
@@ -234,6 +280,7 @@ class BackgroundSaver:
 
     def submit_game_save(self, output_dir: str, model, index_maps,
                          entity_vocabs, *, sparsity_threshold: float = 0.0,
+                         lineage: Optional[dict] = None,
                          ) -> Future:
         """Stage + atomically publish a GAME model at ``output_dir`` in the
         background, fanning its per-coordinate part-files out on the
@@ -245,7 +292,7 @@ class BackgroundSaver:
                 save_game_model_atomic(
                     output_dir, model, index_maps, entity_vocabs,
                     sparsity_threshold=sparsity_threshold,
-                    executor=self._parts)
+                    executor=self._parts, lineage=lineage)
 
         return self._track(f"model:{output_dir}",
                            self._saves.submit(ctx.run, job))

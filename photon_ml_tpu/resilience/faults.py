@@ -13,6 +13,14 @@ misbehave. The registered sites:
                           fully-written staging tree and the atomic
                           retire-then-rename (``io/pipeline.py``) — the
                           background saver's crash window
+``io.delta_publish``      the continuous-training delta path: one visit per
+                          patch-publish attempt (``io/pipeline.py::
+                          save_model_patch_atomic``, same crash window as
+                          ``io.model_save``) and one per patch ACTIVATION
+                          (``serving/registry.py::load_patch``, after
+                          validation, before the version registers) — a
+                          fault in either leaves the previously active
+                          version serving with no partial patch visible
 ``collective``            host-side collectives (allgather/allreduce) and
                           ``jax.distributed.initialize``
 ``optimizer.step``        one visit per coordinate-descent coordinate step
@@ -47,8 +55,8 @@ import numpy as np
 
 #: canonical site names (free-form strings are accepted; these are the ones
 #: the framework threads)
-SITES = ("io.read", "ckpt.save", "io.model_save", "collective",
-         "optimizer.step", "worker.stall")
+SITES = ("io.read", "ckpt.save", "io.model_save", "io.delta_publish",
+         "collective", "optimizer.step", "worker.stall")
 
 _MODES = ("raise", "nan", "stall", "kill")
 

@@ -16,6 +16,11 @@ built here as four layers (see SERVING.md for the architecture doc):
   — microbatching queue and the stdlib JSON endpoint
   (``/score`` / ``/healthz`` / ``/reload``) behind
   ``python -m photon_ml_tpu serve_game``.
+- :mod:`~photon_ml_tpu.serving.watcher` — registry-driven discovery:
+  poll a publish directory and activate new versions (full model dirs
+  or continuous-training coefficient patches — see CONTINUOUS.md)
+  through the same validate-then-activate path
+  (``serve_game --watch-dir``).
 """
 
 from photon_ml_tpu.serving.batcher import MicroBatcher  # noqa: F401
@@ -30,3 +35,4 @@ from photon_ml_tpu.serving.registry import (  # noqa: F401
     ServingModel,
 )
 from photon_ml_tpu.serving.store import EntityCoefficientStore  # noqa: F401
+from photon_ml_tpu.serving.watcher import ModelDirectoryWatcher  # noqa: F401

@@ -37,9 +37,11 @@ from photon_ml_tpu.game.model import FixedEffectModel, GameModel
 from photon_ml_tpu.io.data_reader import FeatureShardConfig
 from photon_ml_tpu.io.index import IndexMap
 from photon_ml_tpu.io.model_io import (
+    PATCH_KIND,
     find_feature_index_dir,
     game_model_entity_vocabs,
     load_game_model,
+    model_lineage_id,
     resolve_game_model_dir,
 )
 from photon_ml_tpu.serving.engine import ScoringEngine
@@ -57,6 +59,14 @@ class ServingModel:
     index_maps: Mapping[str, IndexMap]
     stores: Mapping[str, EntityCoefficientStore]
     engine: ScoringEngine
+    #: content identity (io.model_io.model_lineage_id) of the model this
+    #: version serves — for a patched version, the patch's ``modelId``
+    #: (the equivalent merged full model), so patches chain
+    lineage: Optional[str] = None
+    #: raw→dense entity-id universe the version's models were loaded
+    #: under; a patch's entities are remapped into it before merging
+    entity_vocabs: Mapping[str, Mapping[str, int]] = dataclasses.field(
+        default_factory=dict)
 
     def score(self, records: Sequence[dict]):
         return self.engine.score(records)
@@ -156,8 +166,56 @@ class ModelRegistry:
         return sm
 
     def reload(self, model_dir: str) -> ServingModel:
-        """The ``/reload`` endpoint's verb: load-validate-activate."""
+        """The ``/reload`` endpoint's verb: load-validate-activate. Routes
+        by the candidate's metadata ``kind`` — full model dirs rebuild the
+        tables, coefficient patches overlay the active version's
+        (:meth:`load_patch`) — so one publish directory can mix both."""
+        try:
+            from photon_ml_tpu.io.model_io import model_kind
+
+            kind = model_kind(resolve_game_model_dir(model_dir))
+        except Exception as e:
+            self.bus.post("model_reload_rejected", path=model_dir,
+                          error=repr(e))
+            raise
+        if kind == PATCH_KIND:
+            return self.load_patch(model_dir, activate=True)
         return self.load(model_dir, activate=True)
+
+    def load_patch(self, patch_dir: str, *,
+                   activate: bool = True) -> ServingModel:
+        """Derive version N+1 from the ACTIVE version by overlaying an
+        entity-level coefficient patch: only the touched rows of the dense
+        device tables are overwritten (``EntityCoefficientStore.
+        apply_patch``), untouched coordinates share the parent's tables
+        outright. Validated like any candidate — metadata checks, lineage
+        match against the active version, every part file read — before
+        anything registers; a failure (including an ``io.delta_publish``
+        injected fault) leaves the active version serving and the registry
+        unchanged."""
+        from photon_ml_tpu.resilience import retry
+
+        name = f"serving.patch:{os.path.basename(os.path.normpath(patch_dir))}"
+        try:
+            loaded = retry(lambda: self._load_patch_validated(patch_dir),
+                           name=name)
+        except Exception as e:
+            self.bus.post("model_reload_rejected", path=patch_dir,
+                          error=repr(e))
+            raise
+        with self._lock:
+            version = self._next_version
+            self._next_version += 1
+            sm = ServingModel(version=version, **loaded)
+            self._versions[version] = sm
+        if self.warmup:
+            sm.engine.warmup()
+        self.bus.post("model_loaded", version=version, path=sm.model_dir,
+                      n_entities={cid: s.n_entities
+                                  for cid, s in sm.stores.items()})
+        if activate:
+            self.activate(version)
+        return sm
 
     def retire(self, version: int) -> None:
         """Drop a non-active version (frees its device tables once
@@ -192,7 +250,95 @@ class ModelRegistry:
                                stores, max_batch=self.max_batch)
         return {"model_dir": model_dir, "model": model,
                 "index_maps": index_maps, "stores": stores,
-                "engine": engine}
+                "engine": engine,
+                "lineage": model_lineage_id(model_dir),
+                "entity_vocabs": vocabs}
+
+    def _load_patch_validated(self, patch_dir: str) -> dict:
+        from photon_ml_tpu.resilience import fault_point
+
+        parent = self.active_or_none()
+        if parent is None:
+            raise RuntimeError(
+                "patch activation needs an active parent version (load a "
+                "full model first)")
+        model_dir = resolve_game_model_dir(patch_dir)
+        with open(os.path.join(model_dir, "model-metadata.json")) as f:
+            metadata = json.load(f)
+        if metadata.get("kind") != PATCH_KIND:
+            raise ValueError(
+                f"{model_dir}: not a coefficient patch "
+                f"(kind={metadata.get('kind')!r})")
+        want = metadata.get("parentModel")
+        if not want or want != parent.lineage:
+            raise ValueError(
+                f"{model_dir}: patch parentModel {want!r} does not match "
+                f"the active version's lineage {parent.lineage!r} — a "
+                f"patch only overlays the exact model it was computed "
+                f"against (refresh from the currently served model, or "
+                f"publish a full model instead)")
+        self._check_metadata(model_dir, metadata)
+        patch_vocabs = game_model_entity_vocabs(model_dir, metadata)
+        # the patch rides its parent's feature space by contract (the
+        # refresh presets the parent's index maps), so the parent's loaded
+        # maps ARE the patch's — no re-read, and no way to drift
+        patch_model = load_game_model(model_dir, parent.index_maps,
+                                      patch_vocabs)
+        # the activation-side fault window: everything validated, nothing
+        # registered — an injected fault here must leave the active
+        # version serving and the registry consistent
+        fault_point("io.delta_publish", path=model_dir)
+        # union id universe: the parent's vocab extended by new entities
+        vocabs: dict = {t: dict(v)
+                        for t, v in parent.entity_vocabs.items()}
+        for t, pv in patch_vocabs.items():
+            tgt = vocabs.setdefault(t, {})
+            for raw in pv:
+                tgt.setdefault(raw, len(tgt))
+        removed_by_cid = {
+            cid: info.get("removedEntities") or []
+            for cid, info in metadata["coordinates"].items()}
+        coordinates = dict(parent.model.coordinates)
+        stores: dict[str, EntityCoefficientStore] = {}
+        for cid, cm in parent.model.coordinates.items():
+            if isinstance(cm, FixedEffectModel):
+                if cid in patch_model.coordinates:
+                    coordinates[cid] = patch_model.coordinates[cid]
+                continue
+            upd = patch_model.coordinates.get(cid)
+            removed = removed_by_cid.get(cid, [])
+            if upd is None and not removed:
+                # untouched coordinate: the parent's device table is
+                # shared, not copied — versions alias immutable arrays
+                stores[cid] = parent.stores[cid]
+                continue
+            t = cm.random_effect_type
+            drop_dense = [vocabs[t][raw] for raw in removed
+                          if raw in vocabs[t]]
+            if upd is not None:
+                # host-side model merge keeps ServingModel.model truthful
+                # (the engine scores from the stores; the model backs
+                # introspection and any batch-path reuse)
+                lut = {int(patch_vocabs[t][raw]): int(vocabs[t][raw])
+                       for raw in patch_vocabs[t]}
+                upd_union = upd.remap_entities(lut)
+            else:
+                upd_union = dataclasses.replace(
+                    cm, keys=cm.keys[:0], coeffs=cm.coeffs[:0],
+                    variances=None, coeffs_device=None)
+            coordinates[cid] = cm.merge(upd_union,
+                                        drop_entities=drop_dense)
+            stores[cid] = parent.stores[cid].apply_patch(
+                upd, patch_vocabs.get(t, {}), removed=removed)
+        model = GameModel(coordinates=coordinates,
+                          task=parent.model.task)
+        engine = ScoringEngine(model, self.shard_configs,
+                               parent.index_maps, stores,
+                               max_batch=self.max_batch)
+        return {"model_dir": model_dir, "model": model,
+                "index_maps": parent.index_maps, "stores": stores,
+                "engine": engine, "lineage": metadata.get("modelId"),
+                "entity_vocabs": vocabs}
 
     def _check_metadata(self, model_dir: str, metadata: dict) -> None:
         """Structural validation before any heavy load — mirrors the

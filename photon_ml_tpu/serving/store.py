@@ -56,6 +56,91 @@ class EntityCoefficientStore:
             (fb if r is None else get(r, fb) for r in raw_ids),
             np.int32, count=len(raw_ids))
 
+    def apply_patch(self, update: Optional[RandomEffectModel],
+                    update_vocab: Mapping[str, int],
+                    removed: Sequence[str] = (),
+                    ) -> "EntityCoefficientStore":
+        """Derive the NEXT version's table by overwriting only the touched
+        rows — the continuous-training delta-activation path, O(touched)
+        instead of the O(all entities) rebuild :meth:`build` performs.
+
+        ``update`` is the patch's partial model (only re-solved entities)
+        in ITS OWN dense-id space, with ``update_vocab`` mapping raw ids
+        to those dense ids; rows are matched by RAW id, the stable
+        cross-version identity. Entities already in this store have their
+        row overwritten; new entities append fresh rows; ``removed`` raw
+        ids (models dropped by the refresh's active-data bounds) have
+        their rows zeroed, scoring exactly like the cold-start fallback.
+        The update is FUNCTIONAL — this store's device table is never
+        mutated (in-flight requests hold it), a new array is derived and
+        the previous version stays instantly restorable.
+
+        This method and :meth:`build` are the only sanctioned writers of
+        serving device tables (hygiene rule 5,
+        ``tools/check_resilience_hygiene.py``).
+        """
+        import jax.numpy as jnp
+
+        if update is not None:
+            if update.projector is not None:
+                raise ValueError("patches must be shard-space models")
+            if update.dim != self.dim:
+                raise ValueError(
+                    f"patch dim {update.dim} != store dim {self.dim}")
+            if update.random_effect_type != self.random_effect_type:
+                raise ValueError(
+                    f"patch random-effect type "
+                    f"{update.random_effect_type!r} != store "
+                    f"{self.random_effect_type!r}")
+        n_old = self.fallback_row
+        updates: dict[int, np.ndarray] = {}
+        new_raws: list[str] = []
+
+        def target_row(raw: str) -> int:
+            r = self.row_of_id.get(raw)
+            if r is None or r == n_old:
+                # unseen raw id, or a vocab-merge entry parked on the
+                # fallback zeros row (never writable): append a fresh row
+                new_raws.append(raw)
+                return n_old + len(new_raws) - 1
+            return r
+
+        # removals first so an id both removed and re-added resolves to
+        # the update's row, not the zeroing
+        for raw in removed:
+            r = self.row_of_id.get(raw)
+            if r is not None and r != n_old:
+                updates[r] = np.zeros(self.dim, np.float32)
+        if update is not None and len(update.keys):
+            ent = np.unique(np.asarray(update.keys) // update.dim)
+            reverse = {int(d): raw for raw, d in update_vocab.items()}
+            block = update.entity_rows(ent)
+            for i, e in enumerate(ent):
+                raw = reverse.get(int(e))
+                if raw is None:
+                    raise ValueError(
+                        f"patch entity {int(e)} has no vocabulary entry")
+                updates[target_row(raw)] = block[i]
+        body = self.table[:n_old]
+        if new_raws:
+            body = jnp.concatenate(
+                [body, jnp.zeros((len(new_raws), self.dim), jnp.float32)])
+        if updates:
+            rows = np.fromiter(updates.keys(), np.int32, len(updates))
+            vals = np.stack(list(updates.values()))
+            body = body.at[jnp.asarray(rows)].set(jnp.asarray(vals))
+        table = jnp.concatenate(
+            [body, jnp.zeros((1, self.dim), jnp.float32)])
+        fallback = n_old + len(new_raws)
+        row_of_id = {raw: (fallback if r == n_old else r)
+                     for raw, r in self.row_of_id.items()}
+        for i, raw in enumerate(new_raws):
+            row_of_id[raw] = n_old + i
+        return EntityCoefficientStore(
+            random_effect_type=self.random_effect_type,
+            feature_shard_id=self.feature_shard_id, dim=self.dim,
+            table=table, row_of_id=row_of_id)
+
     @staticmethod
     def build(model: RandomEffectModel,
               entity_vocab: Mapping[str, int]) -> "EntityCoefficientStore":

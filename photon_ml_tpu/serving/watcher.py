@@ -1,0 +1,116 @@
+"""Registry-driven model discovery: poll a publish directory, apply what
+lands there.
+
+The manual ``/reload`` endpoint makes an operator the delivery mechanism;
+a continuously refreshing deployment instead PUBLISHES into a directory
+(full model dirs from ``train_game``/``refresh_game``, coefficient
+patches from ``refresh_game``) and every serving host picks versions up
+itself. The watcher polls the directory, applies each new entry — in
+sorted name order, so ``v0001…``-style publishers get ordered activation
+— through the registry's existing validate-then-activate paths
+(:meth:`~photon_ml_tpu.serving.registry.ModelRegistry.reload`, which
+routes full dirs vs patches by metadata ``kind``), and keeps serving the
+current version when a candidate is rejected.
+
+Publication atomicity is what makes polling safe: the training side's
+staged retire-then-rename (``io/pipeline.py``) means a directory either
+is absent or is complete — the watcher can never observe a half-written
+model. An entry that fails validation is marked seen and skipped forever
+(its ``model_reload_rejected`` event/metric is the operator's signal);
+republish under a new name after fixing it.
+
+Waiting uses ``threading.Event.wait`` — serving code never sleeps
+(hygiene rule 2) and never reads ``perf_counter`` (telemetry hygiene).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Optional
+
+from photon_ml_tpu.serving.registry import ModelRegistry
+
+logger = logging.getLogger(__name__)
+
+
+class ModelDirectoryWatcher:
+    """Polls ``watch_dir`` for new model/patch directories and applies
+    them to ``registry`` through validate-then-activate."""
+
+    def __init__(self, registry: ModelRegistry, watch_dir: str, *,
+                 poll_s: float = 10.0):
+        self.registry = registry
+        self.watch_dir = watch_dir
+        self.poll_s = float(poll_s)
+        self._seen: set[str] = set()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.n_applied = 0
+        self.n_rejected = 0
+
+    # --- one poll ---------------------------------------------------------
+    def scan_once(self) -> int:
+        """Apply every unseen entry (sorted by name); returns how many
+        activated. Directly callable — the thread loop is just this on a
+        timer, and tests drive it synchronously."""
+        try:
+            names = sorted(
+                n for n in os.listdir(self.watch_dir)
+                if not n.startswith(".")
+                and os.path.isdir(os.path.join(self.watch_dir, n)))
+        except FileNotFoundError:
+            return 0  # publish dir not created yet — nothing to do
+        applied = 0
+        for name in names:
+            if name in self._seen:
+                continue
+            path = os.path.join(self.watch_dir, name)
+            try:
+                from photon_ml_tpu.io.model_io import resolve_game_model_dir
+
+                resolve_game_model_dir(path)
+            except FileNotFoundError:
+                # not a model dir (scratch, logs, …): ignore but DON'T
+                # mark seen — a run dir whose best/ publishes later must
+                # still be picked up
+                continue
+            self._seen.add(name)
+            try:
+                sm = self.registry.reload(path)
+            except Exception as e:
+                # rejected candidates never disturb the active version;
+                # the registry already posted model_reload_rejected
+                self.n_rejected += 1
+                logger.warning("watch-dir candidate %s rejected: %r",
+                               path, e)
+                continue
+            self.n_applied += 1
+            applied += 1
+            logger.info("watch-dir activated %s as version %d", path,
+                        sm.version)
+        return applied
+
+    # --- lifecycle --------------------------------------------------------
+    def start(self) -> "ModelDirectoryWatcher":
+        def loop() -> None:
+            # immediate first scan (catch-up on restart), then the timer
+            while True:
+                try:
+                    self.scan_once()
+                except Exception:
+                    logger.exception("watch-dir scan failed; will retry")
+                if self._stop.wait(self.poll_s):
+                    return
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="photon-serving-watch")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None

@@ -1,0 +1,615 @@
+"""Continuous-training subsystem tests (photon_ml_tpu/continuous/ +
+refresh_game + serving delta activation).
+
+The load-bearing contracts, each locked here:
+
+- **incremental refit solves exactly the touched set**: a refresh against
+  data where K entities changed re-solves exactly K entities (asserted
+  via ``photon_refresh_solved_entities_total``) and carries everyone else
+  forward bit-identically;
+- **delta-publish parity**: serving scores after patch activation are
+  bit-identical to a full table rebuild from the refresh's published
+  merged model — touched, untouched, and cold-start entities alike;
+- **publish/activation atomicity**: a fault at ``io.delta_publish``
+  leaves the previously active version serving and the registry
+  consistent (no partial patch visible);
+- **lineage**: every save records parentModel/trainedAt/dataManifest; a
+  patch whose ``parentModel`` doesn't match the active version's lineage
+  is refused;
+- **warm starts help**: a warm-started fit on unchanged data reaches the
+  cold run's validation metric in strictly fewer CD sweeps (GAME) /
+  optimizer iterations (GLM);
+- the estimator's partial-retrain path (``initial_models``/``locked``)
+  in a single process: locked coordinates come back bit-identical.
+"""
+
+import json
+import os
+import shutil
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.cli import refresh_game as refresh_game_cli
+from photon_ml_tpu.cli import serve_game as serve_game_cli
+from photon_ml_tpu.cli import train_game as train_game_cli
+from photon_ml_tpu.cli.config import parse_feature_shard_config
+from photon_ml_tpu.continuous import delta as delta_mod
+from photon_ml_tpu.io.data_reader import write_training_examples
+from photon_ml_tpu.resilience import FaultPlan, FaultSpec, injected
+from photon_ml_tpu.serving import ModelRegistry
+from photon_ml_tpu.telemetry import metrics as tmetrics
+
+SHARDS = "global=fixed|intercept,user=user|noIntercept"
+SHARD_CONFIGS = tuple(parse_feature_shard_config(s)
+                      for s in SHARDS.split(","))
+COORDS = [
+    "global=fixed,shard=global,reg=L2",
+    "perUser=random,entity=userId,shard=user,reg=L2",
+]
+COMMON = [
+    "--feature-shards", SHARDS,
+    "--coordinates", *COORDS,
+    "--update-sequence", "global,perUser",
+    "--grid", "global=0.1", "perUser=1",
+    "--evaluators", "",
+]
+D_FIXED, D_USER, N_USERS = 6, 3, 12
+
+
+def _records(n, seed, *, mutate_users=(), new_users=0, cold_users=0,
+             param_seed=777):
+    """Mixed-effect logistic records. The FIRST ``n`` rows are a pure
+    function of ``seed`` — runs with different ``mutate_users`` share
+    byte-identical rows for every unmutated user (the refresh delta's
+    ground truth). ``mutate_users`` perturbs those users' feature rows in
+    place; ``new_users`` APPENDS 8 rows per brand-new user id (existing
+    rows untouched); ``cold_users`` relabels the last rows with ids no
+    model has seen (request-side fallback)."""
+    prng = np.random.default_rng(param_seed)
+    w = prng.normal(size=D_FIXED)
+    u = 1.5 * prng.normal(size=(N_USERS + max(new_users, 1), D_USER))
+    rng = np.random.default_rng(seed)
+    xf = rng.normal(size=(n, D_FIXED))
+    xu = rng.normal(size=(n, D_USER))
+    users = rng.integers(0, N_USERS, size=n)
+    mutate = np.isin(users, list(mutate_users))
+    xu = np.where(mutate[:, None], xu * 1.25, xu)
+    margin = xf @ w + np.einsum("nd,nd->n", xu, u[users])
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-margin))).astype(float)
+    if new_users:
+        rng2 = np.random.default_rng(seed + 5000)
+        m = 8 * new_users
+        xf2 = rng2.normal(size=(m, D_FIXED))
+        xu2 = rng2.normal(size=(m, D_USER))
+        users2 = N_USERS + np.arange(new_users).repeat(8)
+        margin2 = xf2 @ w + np.einsum("nd,nd->n", xu2, u[users2])
+        y2 = (rng2.uniform(size=m)
+              < 1 / (1 + np.exp(-margin2))).astype(float)
+        xf = np.concatenate([xf, xf2])
+        xu = np.concatenate([xu, xu2])
+        users = np.concatenate([users, users2])
+        y = np.concatenate([y, y2])
+    out = []
+    for i in range(len(y)):
+        feats = [{"name": f"fixed.x{j}", "term": "",
+                  "value": float(xf[i, j])} for j in range(D_FIXED)]
+        feats += [{"name": f"user.z{j}", "term": "",
+                   "value": float(xu[i, j])} for j in range(D_USER)]
+        uid = (f"uCOLD{i}" if i >= len(y) - cold_users
+               else f"u{users[i]}")
+        out.append({"uid": str(i), "response": float(y[i]),
+                    "offset": None, "weight": None, "features": feats,
+                    "metadataMap": {"userId": uid}})
+    return out
+
+
+def _counter_value(name, **labels):
+    fam = tmetrics.default_registry().get(name)
+    if fam is None:
+        return 0.0
+    try:
+        return fam.labels(**labels).value
+    except Exception:
+        return 0.0
+
+
+MUTATED = (1, 3)
+NEW_USERS = 1
+K_TOUCHED = len(MUTATED) + NEW_USERS
+
+
+@pytest.fixture(scope="module")
+def loop(tmp_path_factory):
+    """One full continuous-training loop: base train run R0 (records its
+    data manifest), a refresh R1 against data where exactly K_TOUCHED
+    users changed (2 mutated + 1 new), and a request set with cold
+    users."""
+    tmp = str(tmp_path_factory.mktemp("continuous"))
+    d0 = os.path.join(tmp, "d0.avro")
+    write_training_examples(d0, _records(600, 0))
+    r0 = os.path.join(tmp, "r0")
+    train_game_cli.run(["--training-data", d0, "--output-dir", r0]
+                       + COMMON)
+
+    d1 = os.path.join(tmp, "d1.avro")
+    write_training_examples(
+        d1, _records(600, 0, mutate_users=MUTATED, new_users=NEW_USERS))
+    solved_before = _counter_value(
+        "photon_refresh_solved_entities_total", coordinate="perUser")
+    r1 = os.path.join(tmp, "r1")
+    result = refresh_game_cli.run(
+        ["--prior-dir", r0, "--training-data", d1, "--output-dir", r1]
+        + COMMON)
+    solved_delta = _counter_value(
+        "photon_refresh_solved_entities_total",
+        coordinate="perUser") - solved_before
+    requests = _records(60, 11, cold_users=4)
+    return {"tmp": tmp, "d0": d0, "d1": d1, "r0": r0, "r1": r1,
+            "result": result, "solved_delta": solved_delta,
+            "requests": requests}
+
+
+class TestDelta:
+    def _data(self, records):
+        from photon_ml_tpu.io import AvroDataReader
+
+        reader = AvroDataReader(shard_configs=SHARD_CONFIGS)
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as td:
+            p = os.path.join(td, "x.avro")
+            write_training_examples(p, records)
+            return reader.read(p, id_columns=("userId",))
+
+    def test_fingerprints_are_row_order_invariant(self):
+        recs = _records(200, 7)
+        data_a, _, va = self._data(recs)
+        order = np.random.default_rng(0).permutation(len(recs))
+        data_b, _, vb = self._data([recs[i] for i in order])
+        fa = delta_mod.entity_fingerprints(data_a, "userId", "user")
+        fb = delta_mod.entity_fingerprints(data_b, "userId", "user")
+        ra = {vid: fa[d] for vid, d in va["userId"].items()}
+        rb = {vid: fb[d] for vid, d in vb["userId"].items()}
+        assert ra == rb
+
+    def test_change_detection_flags_exactly_the_mutated_users(self):
+        data_a, _, va = self._data(_records(300, 3))
+        data_b, _, vb = self._data(
+            _records(300, 3, mutate_users=(2, 5), new_users=1))
+        ma = delta_mod.build_manifest(
+            data_a, {"perUser": ("userId", "user")}, va)
+        mb = delta_mod.build_manifest(
+            data_b, {"perUser": ("userId", "user")}, vb)
+        d = delta_mod.coordinate_deltas(ma, mb)["perUser"]
+        assert set(d.touched) == {"u2", "u5", f"u{N_USERS}"}
+        assert set(d.carried) == {f"u{i}" for i in range(N_USERS)} - {
+            "u2", "u5"}
+        # no prior manifest: everything touched (cold-cost refresh)
+        d0 = delta_mod.coordinate_deltas(None, mb)["perUser"]
+        assert len(d0.touched) == N_USERS + 1 and not d0.carried
+
+    def test_manifest_roundtrip_and_digest(self, tmp_path):
+        data, _, v = self._data(_records(150, 9))
+        m = delta_mod.build_manifest(data, {"perUser": ("userId", "user")},
+                                     v)
+        p = str(tmp_path / "m.json")
+        delta_mod.save_manifest(p, m)
+        assert delta_mod.load_manifest(p) == m
+        assert delta_mod.manifest_digest(
+            delta_mod.load_manifest(p)) == delta_mod.manifest_digest(m)
+        assert delta_mod.load_manifest(str(tmp_path / "nope.json")) is None
+
+
+class TestLineage:
+    def test_train_game_records_manifest_and_lineage(self, loop):
+        assert os.path.exists(os.path.join(loop["r0"],
+                                           "data-manifest.json"))
+        with open(os.path.join(loop["r0"], "best",
+                               "model-metadata.json")) as f:
+            md = json.load(f)
+        assert md["parentModel"] is None
+        assert isinstance(md["trainedAt"], str)
+        manifest = delta_mod.load_manifest(
+            os.path.join(loop["r0"], "data-manifest.json"))
+        assert md["dataManifest"] == delta_mod.manifest_digest(manifest)
+
+    def test_refresh_output_chains_lineage(self, loop):
+        from photon_ml_tpu.io.model_io import model_lineage_id
+
+        r0_id = model_lineage_id(loop["r0"])
+        with open(os.path.join(loop["r1"], "best",
+                               "model-metadata.json")) as f:
+            md1 = json.load(f)
+        assert md1["parentModel"] == r0_id
+        with open(os.path.join(loop["r1"], "patch",
+                               "model-metadata.json")) as f:
+            pmd = json.load(f)
+        assert pmd["kind"] == "coefficient-patch"
+        assert pmd["parentModel"] == r0_id
+        assert pmd["modelId"] == model_lineage_id(
+            os.path.join(loop["r1"], "best"))
+
+    def test_lineage_id_ignores_sync_markers_and_aliases(self, loop,
+                                                         tmp_path):
+        from photon_ml_tpu.io.model_io import model_lineage_id
+        from photon_ml_tpu.io.pipeline import publish_model_alias
+
+        src = os.path.join(loop["r0"], "best")
+        alias = str(tmp_path / "alias")
+        publish_model_alias(src, alias)
+        assert model_lineage_id(alias) == model_lineage_id(src)
+
+
+class TestRefresh:
+    def test_solves_exactly_the_touched_entities(self, loop):
+        """The acceptance headline: K touched entities → exactly K
+        solves, asserted via photon_refresh_solved_entities_total."""
+        assert loop["solved_delta"] == K_TOUCHED
+        res = loop["result"]
+        assert res["solved"]["perUser"] == K_TOUCHED
+        assert res["touched"]["perUser"] == K_TOUCHED
+        # everyone the prior model knew and whose data didn't change
+        assert res["carried"]["perUser"] == N_USERS - len(MUTATED)
+
+    def test_untouched_coefficients_carry_bit_identically(self, loop):
+        from photon_ml_tpu.io.index import IndexMap
+        from photon_ml_tpu.io.model_io import (
+            game_model_entity_vocabs,
+            load_game_model,
+        )
+
+        maps = {c.shard_id: IndexMap.load(os.path.join(
+            loop["r0"], "feature-indexes", f"{c.shard_id}.json"))
+            for c in SHARD_CONFIGS}
+        v0 = game_model_entity_vocabs(os.path.join(loop["r0"], "best"))
+        v1 = game_model_entity_vocabs(os.path.join(loop["r1"], "best"))
+        m0 = load_game_model(os.path.join(loop["r0"], "best"), maps, v0)
+        m1 = load_game_model(os.path.join(loop["r1"], "best"), maps, v1)
+        re0, re1 = m0.coordinates["perUser"], m1.coordinates["perUser"]
+        touched = {f"u{i}" for i in MUTATED}
+        for raw, dense0 in v0["userId"].items():
+            if raw in touched:
+                continue
+            row0 = re0.entity_rows([dense0])[0]
+            row1 = re1.entity_rows([v1["userId"][raw]])[0]
+            assert np.array_equal(row0, row1), raw
+        # and the touched users actually changed
+        for raw in touched:
+            row0 = re0.entity_rows([v0["userId"][raw]])[0]
+            row1 = re1.entity_rows([v1["userId"][raw]])[0]
+            assert not np.array_equal(row0, row1), raw
+
+
+class TestDeltaPublish:
+    def test_patch_activation_bit_identical_to_full_rebuild(self, loop):
+        """Acceptance parity: patch applied onto the parent's device
+        tables == full table rebuild from the refresh's merged model —
+        touched, untouched, and cold-start entities alike."""
+        ra = ModelRegistry(SHARD_CONFIGS)
+        ra.load(loop["r0"])
+        sm = ra.reload(os.path.join(loop["r1"], "patch"))  # kind dispatch
+        rb = ModelRegistry(SHARD_CONFIGS)
+        full = rb.load(loop["r1"])
+        a = ra.active().score(loop["requests"])
+        b = rb.active().score(loop["requests"])
+        assert np.array_equal(a, b)
+        assert ra.active_version == 2
+        # the patched version's identity IS the merged full model's —
+        # the NEXT patch (parent = R1) chains onto it
+        assert sm.lineage == full.lineage
+        # cold users present and falling back identically
+        cold = [r for r in loop["requests"]
+                if r["metadataMap"]["userId"].startswith("uCOLD")]
+        assert len(cold) == 4
+        anon = [{**r, "metadataMap": {}} for r in cold]
+        assert np.array_equal(ra.active().score(cold),
+                              ra.active().score(anon))
+
+    def test_new_entity_appends_row(self, loop):
+        ra = ModelRegistry(SHARD_CONFIGS)
+        v1 = ra.load(loop["r0"])
+        ra.reload(os.path.join(loop["r1"], "patch"))
+        new_raw = f"u{N_USERS}"
+        assert new_raw not in v1.stores["perUser"].row_of_id
+        assert new_raw in ra.active().stores["perUser"].row_of_id
+        # the parent's table object was not mutated: its row universe and
+        # fallback row are exactly as built (version immutability)
+        assert v1.stores["perUser"].table.shape[0] < \
+            ra.active().stores["perUser"].table.shape[0]
+
+    def test_fault_at_delta_publish_keeps_active_serving(self, loop):
+        """Acceptance chaos: a fault injected at io.delta_publish leaves
+        the previously active version serving and the registry consistent
+        — no partial patch visible."""
+        registry = ModelRegistry(SHARD_CONFIGS)
+        registry.load(loop["r0"])
+        before = registry.active().score(loop["requests"][:8])
+        plan = FaultPlan([FaultSpec(site="io.delta_publish", rate=1.0)])
+        with injected(plan):
+            with pytest.raises(Exception):
+                registry.load_patch(os.path.join(loop["r1"], "patch"))
+        assert plan.fired("io.delta_publish"), "the fault never fired"
+        assert registry.active_version == 1
+        assert registry.versions() == [1]
+        assert np.array_equal(
+            registry.active().score(loop["requests"][:8]), before)
+        # and with the plan gone the same patch applies cleanly
+        registry.load_patch(os.path.join(loop["r1"], "patch"))
+        assert registry.active_version == 2
+
+    def test_fault_mid_patch_save_retries_and_publishes(self, loop,
+                                                        tmp_path):
+        """Publish-side window: staging fully written, rename not done —
+        the save retries under the default policy and the published dir
+        is complete, with no staging leftovers."""
+        from photon_ml_tpu.io.index import IndexMap
+        from photon_ml_tpu.io.model_io import (
+            game_model_entity_vocabs,
+            load_game_model,
+            model_kind,
+        )
+        from photon_ml_tpu.io.pipeline import save_model_patch_atomic
+        from photon_ml_tpu.types import TaskType
+
+        patch_src = os.path.join(loop["r1"], "patch")
+        maps = {c.shard_id: IndexMap.load(os.path.join(
+            loop["r1"], "feature-indexes", f"{c.shard_id}.json"))
+            for c in SHARD_CONFIGS}
+        vocabs = game_model_entity_vocabs(patch_src)
+        models = dict(load_game_model(patch_src, maps, vocabs).coordinates)
+        out = str(tmp_path / "patch-copy")
+        plan = FaultPlan([FaultSpec(site="io.delta_publish", at=(0,))])
+        with injected(plan):
+            save_model_patch_atomic(
+                out, models, maps, vocabs,
+                task=TaskType.LOGISTIC_REGRESSION,
+                parent_model="p", model_id="m")
+        assert plan.fired("io.delta_publish")
+        assert model_kind(out) == "coefficient-patch"
+        assert [n for n in os.listdir(tmp_path)
+                if n.endswith(".tmp")] == []
+
+    def test_patch_refused_on_lineage_mismatch(self, loop):
+        registry = ModelRegistry(SHARD_CONFIGS)
+        registry.load(loop["r1"])  # active is R1, patch parents R0
+        with pytest.raises(ValueError, match="lineage"):
+            registry.load_patch(os.path.join(loop["r1"], "patch"))
+        assert registry.active_version == 1
+        # and a patch needs SOME active parent
+        empty = ModelRegistry(SHARD_CONFIGS)
+        with pytest.raises(Exception):
+            empty.load_patch(os.path.join(loop["r1"], "patch"))
+
+
+class TestWatchDir:
+    def _get(self, url):
+        with urllib.request.urlopen(url, timeout=60) as resp:
+            return json.loads(resp.read())
+
+    def test_watch_dir_applies_patch_then_full(self, loop, tmp_path):
+        """Registry-driven discovery: entries land in the publish dir and
+        activate in sorted order through validate-then-activate — the
+        patch (onto R0) first, then the full R1 run dir; a garbage entry
+        is rejected without disturbing anything."""
+        watch = str(tmp_path / "publish")
+        os.makedirs(watch)
+        server = serve_game_cli.build_server([
+            "--model-dir", loop["r0"],
+            "--feature-shards", SHARDS,
+            "--port", "0", "--no-warmup",
+            "--watch-dir", watch, "--watch-poll-s", "0.2",
+        ]).start()
+        try:
+            base = server.url
+            assert self._get(base + "/healthz")["version"] == 1
+            os.mkdir(os.path.join(watch, "a-garbage"))
+            with open(os.path.join(watch, "a-garbage",
+                                   "model-metadata.json"), "w") as f:
+                f.write("{ not json")
+            shutil.copytree(os.path.join(loop["r1"], "patch"),
+                            os.path.join(watch, "b-patch"))
+            shutil.copytree(loop["r1"], os.path.join(watch, "c-full"))
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if self._get(base + "/healthz")["version"] == 3:
+                    break
+            health = self._get(base + "/healthz")
+            assert health["version"] == 3
+            assert server.watcher.n_applied == 2
+            assert server.watcher.n_rejected == 1
+            # served scores now == a direct load of R1
+            rb = ModelRegistry(SHARD_CONFIGS)
+            rb.load(loop["r1"])
+            direct = rb.active().score(loop["requests"][:5])
+            import urllib.request as _rq
+
+            req = _rq.Request(
+                base + "/score",
+                data=json.dumps(
+                    {"records": loop["requests"][:5]}).encode(),
+                headers={"Content-Type": "application/json"})
+            with _rq.urlopen(req, timeout=60) as resp:
+                out = json.loads(resp.read())
+            assert out["version"] == 3
+            assert np.array_equal(
+                np.asarray(out["scores"], np.float32), direct)
+        finally:
+            server.stop()
+            server.telemetry.close()
+
+
+class TestEstimatorPartialRetrain:
+    """Direct tier-1 coverage for fit(initial_models=..., locked=...) —
+    previously only the multihost/multiprocess tests touched it."""
+
+    def _setup(self):
+        from photon_ml_tpu.game.data import RandomEffectDatasetConfig
+        from photon_ml_tpu.game.estimator import (
+            FixedEffectCoordinateConfig,
+            GameEstimator,
+            GameOptimizationConfiguration,
+        )
+        from photon_ml_tpu.game.estimator import (
+            RandomEffectCoordinateConfig as REConfig,
+        )
+        from photon_ml_tpu.testing import make_mixed_effect
+        from photon_ml_tpu.types import TaskType
+
+        from photon_ml_tpu.glm.problem import GLMOptimizationConfiguration
+        from photon_ml_tpu.ops.regularization import RegularizationContext
+        from photon_ml_tpu.types import RegularizationType
+
+        data, _ = make_mixed_effect(n=1600, n_entities=25, seed=0)
+        vdata, _ = make_mixed_effect(n=800, n_entities=25, seed=1)
+        opt = GLMOptimizationConfiguration(
+            regularization=RegularizationContext(RegularizationType.L2))
+        configs = {
+            "global": FixedEffectCoordinateConfig("fixed",
+                                                  optimization=opt),
+            "perEntity": REConfig(RandomEffectDatasetConfig(
+                random_effect_type="entityId", feature_shard_id="re"),
+                optimization=opt),
+        }
+        config = GameOptimizationConfiguration(
+            {"global": 0.1, "perEntity": 1.0})
+        return (data, vdata, configs, config, GameEstimator, TaskType)
+
+    def test_locked_coordinates_come_back_bit_identical(self):
+        data, _v, configs, config, GameEstimator, TaskType = self._setup()
+        est = GameEstimator(
+            task=TaskType.LOGISTIC_REGRESSION,
+            coordinate_configs=configs,
+            update_sequence=["global", "perEntity"], n_cd_iterations=1)
+        cold = est.fit(data, [config])[0]
+        prior = dict(cold.model.coordinates)
+        # lock perEntity: no config entry needed, no dataset built
+        est2 = GameEstimator(
+            task=TaskType.LOGISTIC_REGRESSION,
+            coordinate_configs={"global": configs["global"]},
+            update_sequence=["global", "perEntity"], n_cd_iterations=1)
+        part = est2.fit(data, [config], initial_models=prior,
+                        locked=["perEntity"])[0]
+        re_prior = prior["perEntity"]
+        re_part = part.model.coordinates["perEntity"]
+        assert np.array_equal(re_prior.keys, re_part.keys)
+        assert np.array_equal(np.asarray(re_prior.coeffs),
+                              np.asarray(re_part.coeffs))
+        # the unlocked coordinate DID retrain against the frozen scores
+        assert part.model.coordinates["global"] is not prior["global"]
+
+    def test_warm_start_reaches_cold_metric_in_fewer_sweeps(self):
+        from photon_ml_tpu.evaluation import parse_evaluators
+
+        data, vdata, configs, config, GameEstimator, TaskType = \
+            self._setup()
+        evaluators = parse_evaluators(["LOGISTIC_LOSS"])
+        est = GameEstimator(
+            task=TaskType.LOGISTIC_REGRESSION,
+            coordinate_configs=configs,
+            update_sequence=["global", "perEntity"], n_cd_iterations=4)
+        cold = est.fit(data, [config], validation=(vdata, evaluators))[0]
+        losses = [h["LOGISTIC_LOSS"] for h in cold.validation_history]
+        target = losses[-1] + 1e-7
+        k_cold = next(i for i, v in enumerate(losses) if v <= target) + 1
+        assert k_cold >= 2, (
+            f"cold run converged in one sweep ({losses}); the fixture "
+            f"must need 2+ sweeps for this test to mean anything")
+        warm = est.fit(data, [config],
+                       validation=(vdata, evaluators),
+                       initial_models=dict(cold.model.coordinates))[0]
+        wlosses = [h["LOGISTIC_LOSS"] for h in warm.validation_history]
+        k_warm = next(
+            (i for i, v in enumerate(wlosses) if v <= target), None)
+        assert k_warm is not None, (wlosses, target)
+        assert k_warm + 1 < k_cold, (wlosses, losses)
+
+
+class TestWarmStartGLM:
+    def test_warm_start_converges_in_fewer_iterations(self, tmp_path):
+        from photon_ml_tpu.cli import train_glm as train_glm_cli
+
+        recs = _records(400, 21)
+        train = str(tmp_path / "glm.avro")
+        write_training_examples(train, recs)
+
+        def iterations(out_dir):
+            its = []
+            with open(os.path.join(out_dir, "metrics.jsonl")) as f:
+                for line in f:
+                    rec = json.loads(line)
+                    if rec.get("stage") == "train":
+                        its.append(rec["iterations"])
+            return its
+
+        cold_dir = str(tmp_path / "cold")
+        train_glm_cli.run([
+            "--training-data", train, "--output-dir", cold_dir,
+            "--regularization-weights", "1.0"])
+        warm_dir = str(tmp_path / "warm")
+        train_glm_cli.run([
+            "--training-data", train, "--output-dir", warm_dir,
+            "--regularization-weights", "1.0",
+            "--warm-start", cold_dir])
+        (cold_it,), (warm_it,) = iterations(cold_dir), iterations(warm_dir)
+        assert cold_it > 1
+        assert warm_it < cold_it
+
+    def test_warm_start_refuses_batched_mode(self, tmp_path):
+        from photon_ml_tpu.cli import train_glm as train_glm_cli
+
+        with pytest.raises(SystemExit, match="warm-start"):
+            train_glm_cli.run([
+                "--training-data", "x", "--output-dir", str(tmp_path),
+                "--sweep-mode", "batched", "--warm-start", "y"])
+
+
+class TestRefreshWarmStart:
+    def test_refresh_on_unchanged_data_solves_nothing_and_holds_metric(
+            self, loop, tmp_path):
+        """The production fast path: refresh against IDENTICAL data —
+        zero entities solve, the merged model scores exactly like the
+        parent."""
+        out = str(tmp_path / "noop")
+        res = refresh_game_cli.run(
+            ["--prior-dir", loop["r0"], "--training-data", loop["d0"],
+             "--output-dir", out] + COMMON)
+        assert res["solved"]["perUser"] == 0
+        assert res["touched"]["perUser"] == 0
+        assert res["carried"]["perUser"] == N_USERS
+        # the patch carries ONLY the (always-retrained) fixed effect —
+        # not a single random-effect record rides it
+        with open(os.path.join(out, "patch",
+                               "model-metadata.json")) as f:
+            pmd = json.load(f)
+        assert sorted(pmd["coordinates"]) == ["global"]
+        # every random-effect coefficient carried BIT-identically
+        from photon_ml_tpu.io.index import IndexMap
+        from photon_ml_tpu.io.model_io import (
+            game_model_entity_vocabs,
+            load_game_model,
+        )
+
+        maps = {c.shard_id: IndexMap.load(os.path.join(
+            loop["r0"], "feature-indexes", f"{c.shard_id}.json"))
+            for c in SHARD_CONFIGS}
+        v = game_model_entity_vocabs(os.path.join(loop["r0"], "best"))
+        re0 = load_game_model(os.path.join(loop["r0"], "best"), maps,
+                              v).coordinates["perUser"]
+        re1 = load_game_model(os.path.join(out, "best"), maps,
+                              v).coordinates["perUser"]
+        assert np.array_equal(re0.keys, re1.keys)
+        assert np.array_equal(np.asarray(re0.coeffs),
+                              np.asarray(re1.coeffs))
+        # and the patch (FE delta only) applied onto the parent equals
+        # the refresh's full rebuild — the parity contract holds even
+        # when nothing random-effect moved
+        ra = ModelRegistry(SHARD_CONFIGS)
+        ra.load(loop["r0"])
+        ra.reload(os.path.join(out, "patch"))
+        rb = ModelRegistry(SHARD_CONFIGS)
+        rb.load(out)
+        assert np.array_equal(ra.active().score(loop["requests"]),
+                              rb.active().score(loop["requests"]))

@@ -53,6 +53,17 @@ def test_package_is_clean():
     ("import subprocess\nsubprocess.check_output(['x'])\n", 0),
     ("proc.kill()\n", 0),
     ("class X:\n    def kill(self):\n        pass\nX().kill()\n", 0),
+    # rule 5: serving coefficient-table writes outside serving/store.py
+    ("store.table[3] = row\n", 1),
+    ("store.table[3, :] += row\n", 1),
+    ("store.table = new_table\n", 1),
+    ("t = store.table.at[rows].set(vals)\n", 1),
+    ("sm.stores[cid].table.at[r].set(v)\n", 1),
+    # reads (gathers, shape probes) and unrelated .at/.table names are fine
+    ("x = store.table[rows]\n", 0),
+    ("n = store.table.shape[0]\n", 0),
+    ("y = arr.at[rows].set(vals)\n", 0),  # local array, not a store table
+    ("table[3] = row\n", 0),  # bare name, not an attribute
 ])
 def test_detector(snippet, n):
     assert len(hygiene.check_source(snippet, "photon_ml_tpu/x.py")) == n
@@ -71,6 +82,17 @@ def test_io_package_may_write_part_files():
     # cli/ is NOT exempt — the rule exists for the drivers
     assert len(hygiene.check_source(
         src, os.path.join("photon_ml_tpu", "cli", "train_game.py"))) == 1
+
+
+def test_store_module_may_write_tables():
+    src = ("x = store.table.at[rows].set(vals)\n"
+           "store.table = t\n")
+    assert hygiene.check_source(
+        src, os.path.join("photon_ml_tpu", "serving", "store.py")) == []
+    # the registry/engine are NOT exempt — a table derived behind the
+    # store's back breaks version immutability
+    assert len(hygiene.check_source(
+        src, os.path.join("photon_ml_tpu", "serving", "registry.py"))) == 2
 
 
 def test_supervisor_module_may_manage_processes():

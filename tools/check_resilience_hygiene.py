@@ -25,6 +25,15 @@ Four rules, all load-bearing for the resilience subsystem:
    hold the coordinator port, or die unnoticed with no liveness signal).
    Blocking one-shot helpers (``subprocess.run`` — e.g. the native
    toolchain probe) stay legal: they cannot outlive their caller.
+5. **No serving coefficient-table writes outside ``serving/store.py``** —
+   the dense per-entity device tables are IMMUTABLE per version: in-flight
+   requests hold references, hot-swap/rollback relies on old versions
+   staying intact, and the continuous-training delta path derives version
+   N+1 functionally (``EntityCoefficientStore.apply_patch``). A
+   ``x.table[...] = ...`` / ``x.table = ...`` rebinding or a
+   ``x.table.at[...]`` functional update anywhere else builds a divergent
+   table behind the registry's back — route every table derivation through
+   ``store.py``'s ``build`` / ``apply_patch``.
 
 Run directly (``python tools/check_resilience_hygiene.py [root]``, exit 1 on
 violations) or through the tier-1 test ``tests/test_resilience_hygiene.py``.
@@ -47,6 +56,10 @@ PART_WRITE_ALLOWED_PREFIX = os.path.join("photon_ml_tpu", "io") + os.sep
 #: fleet's process lifecycle)
 PROCESS_ALLOWED = {os.path.join("photon_ml_tpu", "resilience",
                                 "supervisor.py")}
+
+#: the one module allowed to write/derive serving coefficient tables
+#: (EntityCoefficientStore.build / apply_patch)
+STORE_ALLOWED = {os.path.join("photon_ml_tpu", "serving", "store.py")}
 
 
 def _is_time_sleep(node: ast.AST, time_aliases: set[str],
@@ -104,6 +117,30 @@ def _is_process_call(node: ast.AST, subprocess_aliases: set[str],
     return False
 
 
+def _is_table_attr(node: ast.AST) -> bool:
+    return isinstance(node, ast.Attribute) and node.attr == "table"
+
+
+def _store_table_writes(tree: ast.AST) -> list[ast.AST]:
+    """Nodes mutating/deriving a serving ``.table`` (rule 5): subscript or
+    attribute assignment targets over ``<expr>.table``, and functional
+    ``<expr>.table.at[...]`` updates."""
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                if _is_table_attr(t):
+                    out.append(t)
+                elif isinstance(t, ast.Subscript) and _is_table_attr(t.value):
+                    out.append(t)
+        elif (isinstance(node, ast.Attribute) and node.attr == "at"
+              and _is_table_attr(node.value)):
+            out.append(node)
+    return out
+
+
 def check_source(source: str, rel_path: str) -> list[str]:
     """Violations in one file, as ``path:line: message`` strings."""
     tree = ast.parse(source, filename=rel_path)
@@ -111,6 +148,7 @@ def check_source(source: str, rel_path: str) -> list[str]:
     part_ok = os.path.normpath(rel_path).startswith(
         PART_WRITE_ALLOWED_PREFIX)
     process_ok = rel_path in {os.path.normpath(p) for p in PROCESS_ALLOWED}
+    store_ok = rel_path in {os.path.normpath(p) for p in STORE_ALLOWED}
 
     # resolve what `time` / `sleep` / `subprocess` / `os` are bound to in
     # this module
@@ -168,6 +206,13 @@ def check_source(source: str, rel_path: str) -> list[str]:
                        f"supervisor (an untracked child survives "
                        f"_kill_fleet or dies without a liveness signal); "
                        f"route process management through FleetSupervisor")
+    if not store_ok:
+        for node in _store_table_writes(tree):
+            out.append(f"{rel_path}:{node.lineno}: serving coefficient-"
+                       f"table write outside serving/store.py — version "
+                       f"tables are immutable (hot-swap/rollback and the "
+                       f"delta path depend on it); derive new tables "
+                       f"through EntityCoefficientStore.build/apply_patch")
     return out
 
 
