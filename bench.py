@@ -709,6 +709,90 @@ def bench_random_effect():
 
 
 # --------------------------------------------------------------------------
+# 3b. fused Pallas RE sweep kernel vs the XLA per-bucket solve
+# --------------------------------------------------------------------------
+
+#: (rows, entities, dim) mixes for the re_sweep microbench: the power-law
+#: small-dim default shape, and a fewer-but-fatter mix so the kernel's
+#: wider-lane blocks get exercised too
+RE_SWEEP_SHAPES = [
+    (1_500_000, 25_000, 8),
+    (750_000, 4_000, 32),
+]
+
+
+def bench_re_sweep():
+    """Microbench the fused Pallas random-effect sweep kernel
+    (``ops/pallas_re.py``, engaged by ``RandomEffectSolver(fused=True)``)
+    against the XLA ``_solve_bucket`` two-pass path on identical datasets,
+    at the ``RE_SWEEP_SHAPES`` bucket mixes × {float32, bfloat16} design
+    dtypes. One ``re_sweep_entities_per_sec_*`` line per dtype (aggregate
+    entities/s across shapes); ``vs_baseline`` = XLA wall / fused wall on
+    the same shapes — >1 means the single-pass kernel is winning. Off-TPU
+    both paths lower to the same XLA closed form (the kernel gate is
+    inert), so the ratio degenerates to ~1 by construction.
+    """
+    import dataclasses
+
+    from photon_ml_tpu.game.data import (
+        RandomEffectDataset,
+        RandomEffectDatasetConfig,
+    )
+    from photon_ml_tpu.game.random_effect import RandomEffectSolver
+    from photon_ml_tpu.glm.problem import GLMOptimizationConfiguration
+    from photon_ml_tpu.ops.regularization import L2Regularization
+    from photon_ml_tpu.optimize import OptimizerConfig
+    from photon_ml_tpu.types import TaskType
+
+    base = RandomEffectSolver(
+        task=TaskType.LOGISTIC_REGRESSION,
+        config=GLMOptimizationConfiguration(
+            regularization=L2Regularization,
+            optimizer_config=OptimizerConfig(max_iterations=25,
+                                             tolerance=1e-6,
+                                             track_states=False)))
+
+    def timed_train(solver, dataset, offsets):
+        model, scores = solver.train(dataset, offsets, 1.0)  # compile + warm
+        _ = float(np.asarray(scores[:1])[0])
+        _heartbeat()
+        best = float("inf")
+        for _rep in range(2):
+            t0 = time.perf_counter()
+            model, scores = solver.train(dataset, offsets, 1.0)
+            _ = float(np.asarray(scores[:1])[0])
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    for dtype_tag, design_dtype in (("f32", "float32"), ("bf16", "bfloat16")):
+        fused_s = xla_s = 0.0
+        entities = 0
+        extras = {}
+        for (n, n_ent, d) in RE_SWEEP_SHAPES:
+            data, _xr, _y, _ent = _make_re_problem(n, n_ent, d, seed=1)
+            cfg = RandomEffectDatasetConfig("entityId", "re",
+                                            bucket_strategy="histogram",
+                                            max_sample_buckets=4)
+            # one dataset per path: the device bucket cache keys by design
+            # dtype, not by solver, so sharing one would hide the second
+            # path's upload cost asymmetrically
+            walls = {}
+            offsets = np.zeros(data.n_samples, np.float32)
+            for tag, fused in (("fused", True), ("xla", False)):
+                dataset = RandomEffectDataset.build("perEntity", data, cfg)
+                solver = dataclasses.replace(base, fused=fused,
+                                             design_dtype=design_dtype)
+                walls[tag] = timed_train(solver, dataset, offsets)
+            entities += dataset.n_active_entities
+            extras[f"s{n_ent}x{d}_fused_s"] = round(walls["fused"], 3)
+            extras[f"s{n_ent}x{d}_xla_s"] = round(walls["xla"], 3)
+            fused_s += walls["fused"]
+            xla_s += walls["xla"]
+        _emit(f"re_sweep_entities_per_sec_{dtype_tag}",
+              entities / fused_s, "entities/s", xla_s / fused_s, **extras)
+
+
+# --------------------------------------------------------------------------
 # 4. full coordinate-descent sweep (fixed + 2 random effects)
 # --------------------------------------------------------------------------
 
@@ -1242,7 +1326,8 @@ def main(argv=None):
 
     p = argparse.ArgumentParser()
     p.add_argument("--only",
-                   choices=["glm", "re", "cd", "ingest", "e2e", "refresh"],
+                   choices=["glm", "re", "re_sweep", "cd", "ingest", "e2e",
+                            "refresh"],
                    help="run a single benchmark instead of the full suite")
     args = p.parse_args(argv)
     _setup_compile_cache()
@@ -1266,8 +1351,9 @@ def main(argv=None):
     if args.only:
         try:
             {"glm": bench_glm, "re": bench_random_effect,
-             "cd": bench_cd_sweep, "ingest": bench_ingest,
-             "e2e": bench_end_to_end, "refresh": bench_refresh}[args.only]()
+             "re_sweep": bench_re_sweep, "cd": bench_cd_sweep,
+             "ingest": bench_ingest, "e2e": bench_end_to_end,
+             "refresh": bench_refresh}[args.only]()
         finally:
             _emit_summary()
         return
@@ -1305,6 +1391,8 @@ def main(argv=None):
         bench_refresh()
         drain()
         bench_ingest()
+        drain()
+        bench_re_sweep()
         drain()
         bench_random_effect()
     finally:

@@ -34,6 +34,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-batch", type=int, default=1024,
                    help="largest padded batch bucket; bigger requests are "
                         "chunked")
+    p.add_argument("--table-dtype",
+                   choices=["float32", "bfloat16", "int8"],
+                   default="float32",
+                   help="storage dtype of the dense per-entity coefficient "
+                        "tables: bfloat16 halves and int8 (per-row scales) "
+                        "quarters the resident bytes per entity — the "
+                        "entities-per-host lever — at the documented "
+                        "score-parity tolerances (bf16 ~1e-2 rel, int8 "
+                        "~5e-2 rel; float32 keeps batch bit-parity). "
+                        "Patches activated on a quantized store requantize "
+                        "only the touched rows")
     p.add_argument("--microbatch", type=int, default=64,
                    help="microbatcher max coalesced batch; 0 disables the "
                         "batcher (single requests hit the engine directly)")
@@ -93,7 +104,8 @@ def build_server(argv: Optional[Sequence[str]] = None):
     shard_configs = tuple(parse_feature_shard_config(s)
                           for s in args.feature_shards.split(","))
     registry = ModelRegistry(shard_configs, max_batch=args.max_batch,
-                             warmup=not args.no_warmup)
+                             warmup=not args.no_warmup,
+                             table_dtype=args.table_dtype)
     registry.load(args.model_dir)
     batcher = None
     if args.microbatch > 0:

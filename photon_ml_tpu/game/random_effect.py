@@ -86,6 +86,17 @@ class RandomEffectSolver:
     #: the wire (labels/weights/coefficients stay f32; margins accumulate
     #: f32 via preferred_element_type)
     design_dtype: str = "float32"
+    #: engage the single-pass Pallas entity kernel inside the bucket solves
+    #: (ops/pallas_re.py): each L-BFGS evaluation then reads the (E, S, D)
+    #: design ONCE instead of XLA's margins-then-gradient double pass.
+    #: Inert off-TPU (without ``fused_interpret``) and for projected /
+    #: streaming datasets and VMEM-oversized lanes — those keep the XLA
+    #: closed form transparently, same gate discipline as the fixed
+    #: effect's ``GLMObjective(fused=True)``.
+    fused: bool = True
+    #: testing only: run the entity kernel through the Pallas interpreter
+    #: on non-TPU backends (orders of magnitude slower than XLA)
+    fused_interpret: bool = False
 
     @property
     def _x_dtype(self):
@@ -105,7 +116,9 @@ class RandomEffectSolver:
                     self.config.optimizer_config, track_states=False)))
 
     def _problem(self) -> OptimizationProblem:
-        objective = GLMObjective(loss=loss_for_task(self.task))
+        objective = GLMObjective(loss=loss_for_task(self.task),
+                                 fused_entity=self.fused,
+                                 fused_interpret=self.fused_interpret)
         return OptimizationProblem(objective, self.config)
 
     def _lane_axes(self) -> tuple[str, ...]:
@@ -766,6 +779,7 @@ def _solve_bucket_impl(solver, x, labels, offsets, weights, w0, lam):
     """Batched bucket solve body (the traced program behind
     :meth:`RandomEffectSolver._solve_bucket`)."""
     problem = solver._problem()
+    objective = problem.objective
 
     def solve_one(xe, ye, oe, we, w0e, lam_):
         data = GLMData(design=DenseDesign(x=xe), labels=ye,
@@ -776,7 +790,37 @@ def _solve_bucket_impl(solver, x, labels, offsets, weights, w0, lam):
             variances = jnp.zeros((0,), xe.dtype)
         return result.w, variances, result.converged
 
-    batch = jax.vmap(solve_one, in_axes=(0, 0, 0, 0, 0, None))
+    def batch(x, labels, offsets, weights, w0, lam):
+        # Pre-pad the entity batch to the Pallas kernel's block plan with
+        # weight-0 lanes (zero data ⇒ gradient = L2 at w0=0 = 0: they
+        # converge immediately, exactly like _put's mesh padding). Padding
+        # INSIDE the traced objective instead would copy the full
+        # (E, S, D) design on every L-BFGS evaluation — the measured
+        # regression pallas_glm's auto mode exists to avoid. Zero when the
+        # kernel is not engaged (non-TPU, oversized lanes) or the plan
+        # already divides; under shard_map this runs per shard, so each
+        # device pads its own slice.
+        e_real = x.shape[0]
+        pad = 0
+        if objective.fused_entity and (jax.default_backend() == "tpu"
+                                       or objective.fused_interpret):
+            from photon_ml_tpu.ops.pallas_re import entity_pad
+
+            pad = entity_pad(e_real, x.shape[1], x.shape[2], x.dtype)
+        if pad:
+            x = jnp.pad(x, ((0, pad), (0, 0), (0, 0)))
+            labels = jnp.pad(labels, ((0, pad), (0, 0)))
+            offsets = jnp.pad(offsets, ((0, pad), (0, 0)))
+            weights = jnp.pad(weights, ((0, pad), (0, 0)))
+            w0 = jnp.pad(w0, ((0, pad), (0, 0)))
+        w_out, variances, conv = jax.vmap(
+            solve_one, in_axes=(0, 0, 0, 0, 0, None))(
+                x, labels, offsets, weights, w0, lam)
+        if pad:
+            w_out, variances, conv = (w_out[:e_real], variances[:e_real],
+                                      conv[:e_real])
+        return w_out, variances, conv
+
     if solver.mesh is None:
         return batch(x, labels, offsets, weights, w0, lam)
     # Entity-parallel: each device solves its contiguous slice of lanes.

@@ -100,6 +100,14 @@ class GLMObjective:
     #: designs with identity normalization — other cases fall back to
     #: autodiff transparently). See photon_ml_tpu/ops/pallas_glm.py.
     fused: bool = False
+    #: entity-batched variant of ``fused`` (the random-effect bucket solve):
+    #: under a vmap carrying the batch axis on every operand, dispatch the
+    #: single-pass (E, S, D) Pallas kernel (ops/pallas_re.py). A separate
+    #: switch because eligibility differs — per-entity designs are small, so
+    #: the gate is the ENTITY block plan (lane_fits_vmem), not
+    #: auto_block_rows over the sample dim. Set by RandomEffectSolver; the
+    #: two flags are not meant to be combined.
+    fused_entity: bool = False
     #: testing only: run the fused kernel through the Pallas interpreter on
     #: non-TPU backends instead of falling back to the closed form. The
     #: interpreter is orders of magnitude slower than XLA — never in prod.
@@ -172,7 +180,38 @@ class GLMObjective:
 
         return auto_block_rows(data.n_samples, data.design.x.dtype) is not None
 
+    def _entity_fused_eligible(self, data: GLMData) -> bool:
+        """Gate for the entity-batched kernel (``fused_entity``) — same
+        backend/design/normalization conditions as :meth:`_fused_eligible`,
+        but the shape test is the per-entity VMEM plan: under the bucket
+        vmap this objective sees ONE (S, D) lane, and the kernel blocks
+        over entities, so ``auto_block_rows`` over samples is the wrong
+        question."""
+        on_tpu = jax.default_backend() == "tpu"
+        if not (self.fused_entity and (on_tpu or self.fused_interpret)
+                and isinstance(data.design, DenseDesign)
+                and self.normalization.is_identity):
+            return False
+        from photon_ml_tpu.ops.pallas_re import lane_fits_vmem
+
+        return lane_fits_vmem(data.n_samples, data.dim, data.design.x.dtype)
+
     def value_and_grad(self, w: Array, data: GLMData, l2=0.0) -> tuple[Array, Array]:
+        if self._entity_fused_eligible(data):
+            from photon_ml_tpu.ops.pallas_re import (
+                vmappable_entity_value_and_grad,
+            )
+
+            # custom-vmap wrapper: the bucket solve's all-operands vmap
+            # dispatches the single-pass entity kernel; called unbatched it
+            # is the closed form (identical math, one lane)
+            vag = vmappable_entity_value_and_grad(
+                self.loss, jax.default_backend() != "tpu")
+            value, grad = vag(data.design.x, w, data.labels, data.offsets,
+                              data.weights)
+            l2 = jnp.asarray(l2, value.dtype)
+            return (value + self._l2_term(w, l2),
+                    grad + l2 * self._reg_w(w))
         if self._fused_eligible(data):
             from photon_ml_tpu.ops.pallas_glm import vmappable_value_and_grad
 

@@ -16,7 +16,11 @@ total runs :func:`photon_ml_tpu.game.model.sum_coordinate_margins` — the
 same reduction, same coordinate order, as the batch scorer. Online scores
 are bit-identical to ``score_game`` output (tests/test_serving.py locks
 this). Without x64 (TPU serving) accumulation degrades to f32 and parity is
-approximate.
+approximate. Quantized coefficient tables (``--table-dtype bfloat16/int8``)
+trade that exactness for footprint: rows dequantize in-trace
+(:func:`photon_ml_tpu.serving.store.gather_rows`) and scores hold the
+documented relative tolerances instead (bf16 ≤ 1e-2, int8 ≤ 5e-2 — the
+score-parity gates in tests/test_serving.py).
 """
 
 from __future__ import annotations
@@ -35,6 +39,7 @@ from photon_ml_tpu.game.model import (
 from photon_ml_tpu.io.data_reader import FeatureShardConfig, _record_features
 from photon_ml_tpu.io.index import IndexMap
 from photon_ml_tpu.types import INTERCEPT_KEY
+from photon_ml_tpu.serving import store as _store
 from photon_ml_tpu.serving.store import EntityCoefficientStore
 from photon_ml_tpu.telemetry import metrics as _metrics
 from photon_ml_tpu.telemetry import profiling as _profiling
@@ -103,13 +108,16 @@ class ScoringEngine:
                                  f"random-effect coordinate {cid!r}")
         # model parameters ride as jit ARGUMENTS, not closure constants:
         # constants get baked into every bucket's executable (compile-time
-        # and image bloat proportional to table size × bucket count)
+        # and image bloat proportional to table size × bucket count).
+        # Random-effect tables ride as (table, scales) pairs — possibly
+        # quantized storage, dequantized in-trace by store.gather_rows
         self._params = {
             "fe": {cid: jnp.asarray(
                 np.asarray(cm.model.coefficients.means, np.float32))
                 for cid, cm in self._coords
                 if isinstance(cm, FixedEffectModel)},
-            "re": {cid: self.stores[cid].table for cid in self._re_order},
+            "re": {cid: self.stores[cid].device_params
+                   for cid in self._re_order},
         }
         self._lock = threading.Lock()
         self._compile_count = 0
@@ -130,7 +138,11 @@ class ScoringEngine:
                 if isinstance(cm, FixedEffectModel):
                     m = x @ params["fe"][cid].astype(accum)
                 else:
-                    tab = params["re"][cid][rows[i_r[cid]]].astype(accum)
+                    # quantized tables dequantize HERE, fused into the
+                    # scoring trace (store.gather_rows is the sanctioned
+                    # home of the table numeric format — hygiene rule 5)
+                    tab = _store.gather_rows(params["re"][cid],
+                                             rows[i_r[cid]], accum)
                     m = jnp.sum(x * tab, axis=1)
                 margins.append(m.astype(jnp.float32))
             return sum_coordinate_margins(offsets, margins, xp=jnp)

@@ -45,7 +45,16 @@ from photon_ml_tpu.io.model_io import (
     resolve_game_model_dir,
 )
 from photon_ml_tpu.serving.engine import ScoringEngine
-from photon_ml_tpu.serving.store import EntityCoefficientStore
+from photon_ml_tpu.serving.store import TABLE_DTYPES, EntityCoefficientStore
+from photon_ml_tpu.telemetry import metrics as _metrics
+
+#: resident bytes of the ACTIVE version's dense coefficient tables (rows +
+#: int8 scale vectors), per coordinate and storage dtype — the gauge that
+#: proves the quantized-table footprint win (int8 ≥ 3.5x under f32)
+_TABLE_BYTES = _metrics.gauge(
+    "photon_serving_table_bytes",
+    "Device bytes of the active serving coefficient table",
+    labels=("coordinate", "dtype"))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -77,10 +86,18 @@ class ModelRegistry:
 
     def __init__(self, shard_configs: Sequence[FeatureShardConfig], *,
                  max_batch: int = 1024, warmup: bool = False,
+                 table_dtype: str = "float32",
                  bus: Optional[EventBus] = None):
+        if table_dtype not in TABLE_DTYPES:
+            raise ValueError(f"unknown table_dtype {table_dtype!r}; "
+                             f"expected one of {TABLE_DTYPES}")
         self.shard_configs = tuple(shard_configs)
         self.max_batch = max_batch
         self.warmup = warmup
+        #: storage format every loaded version's coefficient tables use;
+        #: patches derive from the parent store, so the dtype survives
+        #: delta activations without re-reading this field
+        self.table_dtype = table_dtype
         self.bus = bus if bus is not None else GLOBAL_BUS
         # lifecycle events (model_loaded/activated/rejected) become metrics
         # (reload counters, active-version gauge) via the telemetry bridge;
@@ -160,6 +177,10 @@ class ModelRegistry:
             sm = self._versions[version]
             previous = self._active
             self._active = sm
+        for cid, store in sm.stores.items():
+            _TABLE_BYTES.labels(coordinate=cid,
+                                dtype=store.table_dtype).set(
+                                    store.table_bytes)
         self.bus.post("model_activated", version=sm.version,
                       previous=None if previous is None
                       else previous.version)
@@ -243,7 +264,8 @@ class ModelRegistry:
         model = load_game_model(model_dir, index_maps, vocabs)
         stores = {
             cid: EntityCoefficientStore.build(
-                cm, vocabs[cm.random_effect_type])
+                cm, vocabs[cm.random_effect_type],
+                table_dtype=self.table_dtype)
             for cid, cm in model.coordinates.items()
             if not isinstance(cm, FixedEffectModel)}
         engine = ScoringEngine(model, self.shard_configs, index_maps,

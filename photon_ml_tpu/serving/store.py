@@ -12,6 +12,22 @@ gather. The extra last row is all-zero — the landing slot for entities the
 model has never seen, which therefore score exactly 0 from this coordinate
 (the GLMix cold-start contract: unseen entities fall back to the fixed
 effect alone, same as the batch path's not-found join).
+
+Quantized tables (``table_dtype``): the dense table is the serving host's
+dominant resident payload — at "hundreds of millions of entities" the f32
+rows are what caps entities-per-host. ``bfloat16`` halves the bytes with a
+plain cast; ``int8`` quarters them with per-row symmetric quantization
+(``q = round(row / scale)``, ``scale = max|row| / 127`` per row — one f32
+scale per entity, amortized over ``dim`` coefficients). Dequantization is
+fused into the jitted score path (:func:`gather_rows` — gather int8 rows,
+cast, multiply by the gathered scales), so the full-precision table is
+NEVER materialized. Parity contract: ``float32`` stays bit-identical to
+the batch scorer; ``bfloat16`` holds ~1e-2 relative score error and
+``int8`` ~5e-2 (locked by the serving score-parity gates). This module is
+the ONE home of table construction AND of the quantize/dequantize numeric
+format (hygiene rule 5, ``tools/check_resilience_hygiene.py``): an ad-hoc
+cast or scale-multiply of a ``.table`` array elsewhere would silently
+disagree with the scale semantics here.
 """
 
 from __future__ import annotations
@@ -23,21 +39,77 @@ import numpy as np
 
 from photon_ml_tpu.game.model import RandomEffectModel
 
+#: supported on-device table storage formats, in decreasing precision
+TABLE_DTYPES = ("float32", "bfloat16", "int8")
+
+
+def quantize_rows(rows: np.ndarray) -> "tuple[np.ndarray, np.ndarray]":
+    """Per-row symmetric int8 quantization: ``(q int8 rows, f32 scales)``
+    with ``row ≈ q * scale``. All-zero rows get scale 1.0 (any scale
+    reconstructs zeros; 1.0 keeps the dequant multiply well-conditioned) —
+    which makes the fallback row's dequantized value EXACTLY zero, so the
+    cold-start contract survives quantization bit-for-bit."""
+    rows = np.asarray(rows, np.float32)
+    amax = (np.max(np.abs(rows), axis=1) if rows.size
+            else np.zeros((rows.shape[0],), np.float32))
+    scales = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
+    q = np.clip(np.rint(rows / scales[:, None]), -127, 127).astype(np.int8)
+    return q, scales
+
+
+def _pack_table(dense: np.ndarray, table_dtype: str):
+    """Host f32 dense rows → ``(device table, device scales | None)`` in
+    the requested storage format. The single constructor both
+    :meth:`EntityCoefficientStore.build` and the patch path's row
+    requantization route through."""
+    import jax.numpy as jnp
+
+    if table_dtype == "float32":
+        return jnp.asarray(dense, jnp.float32), None
+    if table_dtype == "bfloat16":
+        return jnp.asarray(dense, jnp.bfloat16), None
+    if table_dtype == "int8":
+        q, scales = quantize_rows(dense)
+        return jnp.asarray(q), jnp.asarray(scales)
+    raise ValueError(
+        f"unknown table_dtype {table_dtype!r}; expected one of {TABLE_DTYPES}")
+
+
+def gather_rows(params, rows, dtype):
+    """Dequantizing row gather for the jitted score path: ``params`` is
+    :attr:`EntityCoefficientStore.device_params` ``(table, scales)``;
+    returns ``(n, dim)`` rows in ``dtype``. Traced inside the engine's
+    scoring program, so the dequant (cast + per-row scale multiply for
+    int8) fuses with the margin contraction — the full-precision table
+    never exists in HBM. With f32 tables this is exactly the plain
+    ``table[rows].astype(dtype)`` the engine always did: the f32
+    online/batch bit-parity contract is untouched."""
+    table, scales = params
+    out = table[rows].astype(dtype)
+    if scales is not None:
+        out = out * scales[rows][:, None].astype(dtype)
+    return out
+
 
 @dataclasses.dataclass(frozen=True)
 class EntityCoefficientStore:
     """Dense per-entity coefficient table for one random-effect coordinate.
 
-    ``table`` is ``(n_entities + 1, dim)`` float32 on device; row
-    ``n_entities`` is the all-zero fallback row. ``row_of_id`` maps the raw
-    entity id string to its table row.
+    ``table`` is ``(n_entities + 1, dim)`` on device in ``table_dtype``
+    storage (float32 / bfloat16 / int8); row ``n_entities`` is the
+    fallback row (zeros — dequantizes to exact zeros in every format).
+    ``row_of_id`` maps the raw entity id string to its table row.
+    ``scales`` is the ``(n_entities + 1,)`` f32 per-row dequantization
+    scale vector for int8 tables, ``None`` otherwise.
     """
 
     random_effect_type: str
     feature_shard_id: str
     dim: int
-    table: object  # jax.Array (n_entities + 1, dim) float32
+    table: object  # jax.Array (n_entities + 1, dim) in table_dtype
     row_of_id: Mapping[str, int]
+    table_dtype: str = "float32"
+    scales: object = None  # jax.Array (n_entities + 1,) f32 — int8 only
 
     @property
     def n_entities(self) -> int:
@@ -47,14 +119,40 @@ class EntityCoefficientStore:
     def fallback_row(self) -> int:
         return int(self.table.shape[0]) - 1
 
+    @property
+    def device_params(self):
+        """``(table, scales)`` — the engine's jit argument pytree; consume
+        through :func:`gather_rows`."""
+        return (self.table, self.scales)
+
+    @property
+    def table_bytes(self) -> int:
+        """Resident device bytes of this coordinate's table (dense rows +
+        int8 scale vector) — the ``photon_serving_table_bytes`` gauge."""
+        n = int(np.prod(self.table.shape)) * self.table.dtype.itemsize
+        if self.scales is not None:
+            n += int(self.scales.shape[0]) * 4
+        return n
+
     def rows_for(self, raw_ids: Sequence[Optional[str]]) -> np.ndarray:
         """Table row per raw entity id; unseen/missing ids land on the
         zero fallback row."""
         fb = self.fallback_row
+        n = len(raw_ids)
+        if n == 1:
+            # the microbatched / single-lookup hot path: no generator, no
+            # fromiter machinery for one probe
+            r = raw_ids[0]
+            return np.array([fb if r is None else self.row_of_id.get(r, fb)],
+                            np.int32)
         get = self.row_of_id.get
+        if all(r is None for r in raw_ids):
+            # id-less traffic (/rank-style candidate batches, warmup
+            # padding): one fill beats n dict probes through a generator
+            return np.full(n, fb, np.int32)
         return np.fromiter(
             (fb if r is None else get(r, fb) for r in raw_ids),
-            np.int32, count=len(raw_ids))
+            np.int32, count=n)
 
     def apply_patch(self, update: Optional[RandomEffectModel],
                     update_vocab: Mapping[str, int],
@@ -73,7 +171,11 @@ class EntityCoefficientStore:
         their rows zeroed, scoring exactly like the cold-start fallback.
         The update is FUNCTIONAL — this store's device table is never
         mutated (in-flight requests hold it), a new array is derived and
-        the previous version stays instantly restorable.
+        the previous version stays instantly restorable. The derived
+        store keeps this store's ``table_dtype``: touched rows are
+        re-quantized in isolation (per-row scales make that exact — no
+        other row's scale shifts), untouched rows are carried
+        bit-identically.
 
         This method and :meth:`build` are the only sanctioned writers of
         serving device tables (hygiene rule 5,
@@ -122,15 +224,28 @@ class EntityCoefficientStore:
                         f"patch entity {int(e)} has no vocabulary entry")
                 updates[target_row(raw)] = block[i]
         body = self.table[:n_old]
+        sbody = None if self.scales is None else self.scales[:n_old]
         if new_raws:
             body = jnp.concatenate(
-                [body, jnp.zeros((len(new_raws), self.dim), jnp.float32)])
+                [body, jnp.zeros((len(new_raws), self.dim), body.dtype)])
+            if sbody is not None:
+                sbody = jnp.concatenate(
+                    [sbody, jnp.ones((len(new_raws),), jnp.float32)])
         if updates:
             rows = np.fromiter(updates.keys(), np.int32, len(updates))
             vals = np.stack(list(updates.values()))
-            body = body.at[jnp.asarray(rows)].set(jnp.asarray(vals))
+            rows_d = jnp.asarray(rows)
+            if self.table_dtype == "int8":
+                q, s = quantize_rows(vals)
+                body = body.at[rows_d].set(jnp.asarray(q))
+                sbody = sbody.at[rows_d].set(jnp.asarray(s))
+            else:
+                body = body.at[rows_d].set(
+                    jnp.asarray(vals).astype(body.dtype))
         table = jnp.concatenate(
-            [body, jnp.zeros((1, self.dim), jnp.float32)])
+            [body, jnp.zeros((1, self.dim), body.dtype)])
+        scales = (None if sbody is None
+                  else jnp.concatenate([sbody, jnp.ones((1,), jnp.float32)]))
         fallback = n_old + len(new_raws)
         row_of_id = {raw: (fallback if r == n_old else r)
                      for raw, r in self.row_of_id.items()}
@@ -139,20 +254,25 @@ class EntityCoefficientStore:
         return EntityCoefficientStore(
             random_effect_type=self.random_effect_type,
             feature_shard_id=self.feature_shard_id, dim=self.dim,
-            table=table, row_of_id=row_of_id)
+            table=table, row_of_id=row_of_id,
+            table_dtype=self.table_dtype, scales=scales)
 
     @staticmethod
     def build(model: RandomEffectModel,
-              entity_vocab: Mapping[str, int]) -> "EntityCoefficientStore":
-        """Pack a loaded :class:`RandomEffectModel`'s sparse table densely.
+              entity_vocab: Mapping[str, int],
+              table_dtype: str = "float32") -> "EntityCoefficientStore":
+        """Pack a loaded :class:`RandomEffectModel`'s sparse table densely,
+        in ``table_dtype`` storage (see the module docstring for the
+        quantization format and parity contract).
 
         ``entity_vocab`` is the model-derived raw→dense id map
         (:func:`photon_ml_tpu.io.model_io.game_model_entity_vocabs`). Models
         fresh off disk are always in shard space (export back-projects), so
         a projector here is a usage error, not a supported layout.
         """
-        import jax.numpy as jnp
-
+        if table_dtype not in TABLE_DTYPES:
+            raise ValueError(f"unknown table_dtype {table_dtype!r}; "
+                             f"expected one of {TABLE_DTYPES}")
         if model.projector is not None:
             raise ValueError(
                 "serving expects shard-space models (call to_shard_space() "
@@ -173,7 +293,9 @@ class EntityCoefficientStore:
         fallback = len(uniq)
         row_of_id = {raw: row_of_dense.get(d, fallback)
                      for raw, d in entity_vocab.items()}
+        table, scales = _pack_table(dense, table_dtype)
         return EntityCoefficientStore(
             random_effect_type=model.random_effect_type,
             feature_shard_id=model.feature_shard_id,
-            dim=model.dim, table=jnp.asarray(dense), row_of_id=row_of_id)
+            dim=model.dim, table=table, row_of_id=row_of_id,
+            table_dtype=table_dtype, scales=scales)

@@ -383,6 +383,47 @@ class TestGameEstimator:
         err = np.abs(np.asarray(re16.coeffs) - np.asarray(re32.coeffs))
         assert np.median(err) < 5e-2, float(np.median(err))
 
+    def test_bf16_designs_score_parity_vs_f32(self):
+        """The serving-facing half of the bf16 contract: a model FITTED
+        with bfloat16 designs must SCORE (GameModel.score — the score_game
+        / serving-parity core) within tolerance of the f32 fit on held-out
+        data — the fit-quality assertions above can't see a scoring-path
+        regression."""
+        import dataclasses as dc
+
+        data, _ = make_mixed_data(n=1500, n_entities=19)
+        held_out, _ = make_mixed_data(n=600, n_entities=19, seed=13)
+        cfg = GLMOptimizationConfiguration(regularization=L2Regularization)
+        grid = [GameOptimizationConfiguration(
+            {"global": 0.01, "perEntity": 1.0})]
+
+        def fit(dtype):
+            est = GameEstimator(
+                task=TaskType.LOGISTIC_REGRESSION,
+                coordinate_configs={
+                    "global": dc.replace(
+                        FixedEffectCoordinateConfig(
+                            feature_shard_id="fixed", optimization=cfg),
+                        design_dtype=dtype),
+                    "perEntity": dc.replace(
+                        RandomEffectCoordinateConfig(
+                            dataset=RandomEffectDatasetConfig(
+                                "entityId", "re"),
+                            optimization=cfg),
+                        design_dtype=dtype),
+                },
+                update_sequence=["global", "perEntity"], n_cd_iterations=2)
+            return est.fit(data, grid)[0].model
+
+        s32 = np.asarray(fit("float32").score(held_out))
+        s16 = np.asarray(fit("bfloat16").score(held_out))
+        rel = np.abs(s16 - s32) / np.maximum(np.abs(s32), 1.0)
+        # design rounding perturbs every per-entity optimum a little; the
+        # scored margins must still track f32 closely in the typical case
+        # and stay bounded in the tail
+        assert np.median(rel) < 1e-2, float(np.median(rel))
+        assert rel.max() < 2e-1, float(rel.max())
+
     def test_fit_with_entity_mesh_matches_unsharded(self):
         """End-to-end estimator path with a 2D dp x ep mesh: the fixed
         effect shards samples over 'data' (psum'd compiled L-BFGS) and the

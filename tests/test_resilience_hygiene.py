@@ -64,6 +64,18 @@ def test_package_is_clean():
     ("n = store.table.shape[0]\n", 0),
     ("y = arr.at[rows].set(vals)\n", 0),  # local array, not a store table
     ("table[3] = row\n", 0),  # bare name, not an attribute
+    # rule 5 (quantization half): dtype casts / scale arithmetic over a
+    # .table array are ad-hoc quantize/dequantize outside the store's
+    # format home
+    ("t = store.table.astype(np.float32)\n", 1),
+    ("t = store.table[rows].astype(accum)\n", 1),
+    ("t = sm.stores[cid].table.astype(jnp.bfloat16)\n", 1),
+    ("d = store.table[rows] * scales[rows]\n", 1),
+    ("q = rows_f32 / store.table\n", 1),
+    # reads, adds (margin sums), and non-table casts stay legal
+    ("t = x.astype(np.float32)\n", 0),
+    ("m = margins + other\n", 0),
+    ("s = store.table[rows] + bias\n", 0),
 ])
 def test_detector(snippet, n):
     assert len(hygiene.check_source(snippet, "photon_ml_tpu/x.py")) == n
@@ -93,6 +105,17 @@ def test_store_module_may_write_tables():
     # store's back breaks version immutability
     assert len(hygiene.check_source(
         src, os.path.join("photon_ml_tpu", "serving", "registry.py"))) == 2
+
+
+def test_store_module_may_quantize_tables():
+    src = ("q = self.table.astype(jnp.int8)\n"
+           "d = self.table[rows] * scales[rows]\n")
+    assert hygiene.check_source(
+        src, os.path.join("photon_ml_tpu", "serving", "store.py")) == []
+    # the engine is NOT exempt — its dequant must route through
+    # store.gather_rows so the scale semantics have one home
+    assert len(hygiene.check_source(
+        src, os.path.join("photon_ml_tpu", "serving", "engine.py"))) == 2
 
 
 def test_supervisor_module_may_manage_processes():

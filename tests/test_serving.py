@@ -280,6 +280,162 @@ class TestBatcher:
             batcher.close()
 
 
+class TestQuantizedTables:
+    """--table-dtype score-parity gates (ISSUE 9): f32 stays bit-identical
+    to the batch scorer, bfloat16 holds ≤ 1e-2 relative, int8 ≤ 5e-2;
+    cold-start rows dequantize to exact zeros; patch activation on a
+    quantized store requantizes ONLY touched rows and matches a full
+    rebuild; int8 cuts photon_serving_table_bytes ≥ 3.5x vs f32."""
+
+    def _scores(self, trained, table_dtype):
+        registry = ModelRegistry(SHARD_CONFIGS, table_dtype=table_dtype)
+        sm = registry.load(trained["v1"])
+        return sm, sm.score(trained["requests"])
+
+    def test_f32_table_bit_identical(self, trained):
+        _, base = self._scores(trained, "float32")
+        registry = ModelRegistry(SHARD_CONFIGS)
+        assert np.array_equal(base,
+                              registry.load(trained["v1"]).score(
+                                  trained["requests"]))
+
+    @pytest.mark.parametrize("table_dtype, rel", [("bfloat16", 1e-2),
+                                                  ("int8", 5e-2)])
+    def test_quantized_score_parity_gate(self, trained, table_dtype, rel):
+        _, base = self._scores(trained, "float32")
+        _, quant = self._scores(trained, table_dtype)
+        err = np.abs(quant - base) / np.maximum(np.abs(base), 1.0)
+        assert err.max() <= rel, (table_dtype, err.max())
+
+    @pytest.mark.parametrize("table_dtype", ["bfloat16", "int8"])
+    def test_cold_start_fallback_survives_quantization(self, trained,
+                                                       table_dtype):
+        """Unseen entities must score EXACTLY like id-less records: the
+        fallback row's zeros dequantize to exact zeros in every format."""
+        sm, _ = self._scores(trained, table_dtype)
+        cold = [r for r in trained["requests"]
+                if r["metadataMap"]["userId"].startswith("uCOLD")]
+        anonymized = [{**r, "metadataMap": {}} for r in cold]
+        assert np.array_equal(sm.score(cold), sm.score(anonymized))
+
+    def test_zero_recompiles_with_quantized_tables(self, trained):
+        registry = ModelRegistry(SHARD_CONFIGS, max_batch=16,
+                                 table_dtype="int8")
+        sm = registry.load(trained["v1"])
+        sm.engine.warmup()
+        frozen = sm.engine.compile_count
+        for size in (1, 3, 5, 9, 16):
+            sm.score(trained["requests"][:size])
+        assert sm.engine.compile_count == frozen
+
+    def test_rows_for_fast_paths(self, trained):
+        registry = ModelRegistry(SHARD_CONFIGS)
+        store = registry.load(trained["v1"]).stores["perUser"]
+        generic = lambda ids: np.fromiter(
+            (store.fallback_row if r is None
+             else store.row_of_id.get(r, store.fallback_row) for r in ids),
+            np.int32, count=len(ids))
+        for ids in (["u1"], [None], ["nope"], [None, None, None],
+                    ["u0", None, "u2", "nope"], []):
+            assert np.array_equal(store.rows_for(ids), generic(ids)), ids
+        assert store.rows_for(["u1"]).dtype == np.int32
+        assert store.rows_for([None] * 5).dtype == np.int32
+
+    def test_registry_rejects_unknown_dtype(self):
+        with pytest.raises(ValueError, match="table_dtype"):
+            ModelRegistry(SHARD_CONFIGS, table_dtype="fp8")
+
+    def _wide_model(self, dim=48, n_ent=64):
+        from photon_ml_tpu.game.model import RandomEffectModel
+        from photon_ml_tpu.types import TaskType
+
+        rng = np.random.default_rng(1)
+        coeffs = rng.normal(size=(n_ent, dim)).astype(np.float32)
+        keys = np.arange(n_ent * dim, dtype=np.int64)
+        model = RandomEffectModel(
+            random_effect_type="userId", feature_shard_id="user",
+            task=TaskType.LOGISTIC_REGRESSION, dim=dim, keys=keys,
+            coeffs=coeffs.reshape(-1))
+        vocab = {f"u{e}": e for e in range(n_ent)}
+        return model, vocab, coeffs
+
+    def test_int8_table_bytes_cut_at_least_3_5x(self):
+        from photon_ml_tpu.serving.store import EntityCoefficientStore
+
+        model, vocab, _ = self._wide_model()
+        f32 = EntityCoefficientStore.build(model, vocab)
+        i8 = EntityCoefficientStore.build(model, vocab, table_dtype="int8")
+        bf16 = EntityCoefficientStore.build(model, vocab,
+                                            table_dtype="bfloat16")
+        assert f32.table_bytes / i8.table_bytes >= 3.5
+        assert f32.table_bytes / bf16.table_bytes == 2.0
+
+    def test_table_bytes_gauge_set_on_activate(self, trained):
+        from photon_ml_tpu.telemetry.metrics import default_registry
+
+        registry = ModelRegistry(SHARD_CONFIGS, table_dtype="int8")
+        sm = registry.load(trained["v1"])
+        fam = default_registry().get("photon_serving_table_bytes")
+        assert fam is not None
+        got = fam.labels(coordinate="perUser", dtype="int8").value
+        assert got == sm.stores["perUser"].table_bytes > 0
+
+    @pytest.mark.parametrize("table_dtype", ["bfloat16", "int8"])
+    def test_patch_matches_full_rebuild(self, table_dtype):
+        """apply_patch on a quantized store == a from-scratch quantized
+        build of the merged model, row for row by raw id: per-row scales
+        make touched-row requantization exact, untouched rows carry
+        bit-identically."""
+        from photon_ml_tpu.game.model import RandomEffectModel
+        from photon_ml_tpu.serving.store import (
+            EntityCoefficientStore,
+            gather_rows,
+        )
+        from photon_ml_tpu.types import TaskType
+
+        import jax.numpy as jnp
+
+        model, vocab, coeffs = self._wide_model(dim=16, n_ent=20)
+        store = EntityCoefficientStore.build(model, vocab,
+                                             table_dtype=table_dtype)
+        rng = np.random.default_rng(7)
+        # touch entities 3 and 11, add uNEW, remove u5
+        upd_rows = rng.normal(size=(3, 16)).astype(np.float32) * 3
+        upd = RandomEffectModel(
+            random_effect_type="userId", feature_shard_id="user",
+            task=TaskType.LOGISTIC_REGRESSION, dim=16,
+            keys=np.arange(3 * 16, dtype=np.int64),
+            coeffs=upd_rows.reshape(-1))
+        patched = store.apply_patch(
+            upd, {"u3": 0, "u11": 1, "uNEW": 2}, removed=["u5"])
+        assert patched.table_dtype == table_dtype
+
+        merged = coeffs.copy()
+        merged[3], merged[11] = upd_rows[0], upd_rows[1]
+        merged[5] = 0.0
+        merged_all = np.vstack([merged, upd_rows[2:3]])
+        vocab2 = dict(vocab)
+        vocab2["uNEW"] = 20
+        rebuilt_model = RandomEffectModel(
+            random_effect_type="userId", feature_shard_id="user",
+            task=TaskType.LOGISTIC_REGRESSION, dim=16,
+            keys=np.arange(21 * 16, dtype=np.int64),
+            coeffs=merged_all.reshape(-1))
+        rebuilt = EntityCoefficientStore.build(rebuilt_model, vocab2,
+                                               table_dtype=table_dtype)
+        ids = list(vocab2) + [None, "unseen"]
+        got = np.asarray(gather_rows(
+            patched.device_params, jnp.asarray(patched.rows_for(ids)),
+            jnp.float32))
+        want = np.asarray(gather_rows(
+            rebuilt.device_params, jnp.asarray(rebuilt.rows_for(ids)),
+            jnp.float32))
+        assert np.array_equal(got, want)
+        # removed + unseen rows are exact zeros
+        assert not got[list(vocab2).index("u5")].any()
+        assert not got[-2:].any()
+
+
 class TestHttpEndToEnd:
     def _post(self, url, payload):
         req = urllib.request.Request(
@@ -334,6 +490,21 @@ class TestHttpEndToEnd:
             with pytest.raises(urllib.error.HTTPError) as err:
                 self._post(base + "/score", {"records": []})
             assert err.value.code == 400
+        finally:
+            server.stop()
+
+    def test_table_dtype_flag_reaches_registry(self, trained):
+        server = serve_game_cli.build_server([
+            "--model-dir", trained["v1"],
+            "--feature-shards", SHARDS,
+            "--port", "0", "--no-warmup", "--table-dtype", "bfloat16",
+        ]).start()
+        try:
+            registry = server.service.registry
+            assert registry.table_dtype == "bfloat16"
+            st = registry.active().stores["perUser"]
+            assert st.table_dtype == "bfloat16"
+            assert str(st.table.dtype) == "bfloat16"
         finally:
             server.stop()
 

@@ -25,15 +25,22 @@ Four rules, all load-bearing for the resilience subsystem:
    hold the coordinator port, or die unnoticed with no liveness signal).
    Blocking one-shot helpers (``subprocess.run`` — e.g. the native
    toolchain probe) stay legal: they cannot outlive their caller.
-5. **No serving coefficient-table writes outside ``serving/store.py``** —
-   the dense per-entity device tables are IMMUTABLE per version: in-flight
-   requests hold references, hot-swap/rollback relies on old versions
-   staying intact, and the continuous-training delta path derives version
-   N+1 functionally (``EntityCoefficientStore.apply_patch``). A
-   ``x.table[...] = ...`` / ``x.table = ...`` rebinding or a
-   ``x.table.at[...]`` functional update anywhere else builds a divergent
-   table behind the registry's back — route every table derivation through
-   ``store.py``'s ``build`` / ``apply_patch``.
+5. **No serving coefficient-table writes — or quantize/dequantize math —
+   outside ``serving/store.py``** — the dense per-entity device tables are
+   IMMUTABLE per version: in-flight requests hold references,
+   hot-swap/rollback relies on old versions staying intact, and the
+   continuous-training delta path derives version N+1 functionally
+   (``EntityCoefficientStore.apply_patch``). A ``x.table[...] = ...`` /
+   ``x.table = ...`` rebinding or a ``x.table.at[...]`` functional update
+   anywhere else builds a divergent table behind the registry's back —
+   route every table derivation through ``store.py``'s ``build`` /
+   ``apply_patch``. Since tables may be stored QUANTIZED (bfloat16 / int8
+   with per-row scales), the table's numeric format is likewise a
+   store.py-private contract: an ``<...>.table<...>.astype(...)`` cast or
+   a ``*``/``/`` arithmetic expression over a ``.table`` array anywhere
+   else is an ad-hoc quantize/dequantize that silently disagrees with
+   ``store.gather_rows``'s scale semantics — read rows through
+   ``gather_rows`` / ``device_params`` instead.
 
 Run directly (``python tools/check_resilience_hygiene.py [root]``, exit 1 on
 violations) or through the tier-1 test ``tests/test_resilience_hygiene.py``.
@@ -119,6 +126,33 @@ def _is_process_call(node: ast.AST, subprocess_aliases: set[str],
 
 def _is_table_attr(node: ast.AST) -> bool:
     return isinstance(node, ast.Attribute) and node.attr == "table"
+
+
+def _contains_table_attr(node: ast.AST) -> bool:
+    return any(_is_table_attr(sub) for sub in ast.walk(node))
+
+
+def _store_table_quant(tree: ast.AST) -> list[ast.AST]:
+    """Rule 5 (quantization half): nodes performing numeric-format work on
+    a serving ``.table`` array — an ``.astype(...)`` cast whose receiver
+    involves ``.table`` (``store.table.astype(...)``,
+    ``store.table[rows].astype(...)``), or a ``*`` / ``/`` arithmetic
+    expression with a ``.table`` operand (a scale multiply/divide). Either
+    is an ad-hoc quantize/dequantize outside the store's one sanctioned
+    format home (``quantize_rows`` / ``gather_rows``)."""
+    out = []
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "astype"
+                and _contains_table_attr(node.func.value)):
+            out.append(node)
+        elif (isinstance(node, ast.BinOp)
+              and isinstance(node.op, (ast.Mult, ast.Div))
+              and (_contains_table_attr(node.left)
+                   or _contains_table_attr(node.right))):
+            out.append(node)
+    return out
 
 
 def _store_table_writes(tree: ast.AST) -> list[ast.AST]:
@@ -213,6 +247,12 @@ def check_source(source: str, rel_path: str) -> list[str]:
                        f"tables are immutable (hot-swap/rollback and the "
                        f"delta path depend on it); derive new tables "
                        f"through EntityCoefficientStore.build/apply_patch")
+        for node in _store_table_quant(tree):
+            out.append(f"{rel_path}:{node.lineno}: quantize/dequantize of "
+                       f"a serving .table array outside serving/store.py — "
+                       f"table storage format (dtype + per-row scales) is "
+                       f"a store.py-private contract; read rows through "
+                       f"store.gather_rows / device_params")
     return out
 
 
