@@ -377,6 +377,51 @@ def _stash_perf_report(telemetry_dir: "str | None") -> "dict | None":
         return None
 
 
+def _quality_extras(out_dir: "str | None", train_avro: str) -> dict:
+    """Model-quality overhead extras for the e2e metric line: the size of
+    the published quality-baseline.json (baseline work is train-side and
+    background-thread only — the wall already proves it cost ~0) and the
+    canary shadow-scoring wall (the activation-time cost a --canary-gate
+    deployment pays, measured by reloading the trained model against a
+    64-record reservoir drawn from its own training sample). Never fails
+    the bench."""
+    if not out_dir:
+        return {}
+    extras: dict = {}
+    baseline_path = os.path.join(out_dir, "quality-baseline.json")
+    extras["quality_baseline_bytes"] = (
+        os.path.getsize(baseline_path)
+        if os.path.exists(baseline_path) else 0)
+    try:
+        from photon_ml_tpu.cli.config import parse_feature_shard_config
+        from photon_ml_tpu.io.avro import iter_avro_file
+        from photon_ml_tpu.quality import CanaryConfig
+        from photon_ml_tpu.serving import ModelRegistry
+
+        shard_configs = tuple(
+            parse_feature_shard_config(s)
+            for s in "global=g|intercept,item=it|noIntercept".split(","))
+        records = []
+        for rec in iter_avro_file(train_avro):
+            records.append(rec)
+            if len(records) >= 64:
+                break
+        registry = ModelRegistry(shard_configs, canary=CanaryConfig())
+        registry.load(out_dir)
+        registry.observe_requests(records)
+        # reload the same model: the canary shadow-scores the reservoir
+        # through both engines (divergence 0 by construction) — its wall
+        # is the pure canary-evaluation cost
+        sm = registry.load(out_dir)
+        if sm.canary is not None:
+            extras["canary_eval_s"] = round(sm.canary["seconds"], 4)
+            extras["canary_divergence"] = round(
+                sm.canary["divergence"], 6)
+    except Exception as e:
+        extras["canary_eval_error"] = repr(e)[:200]
+    return extras
+
+
 # gate the FULL suite by default; main() flips this off for --only subset
 # runs (every unrun metric would read as "vanished" = regression).
 # PHOTON_BENCH_GATE=0/1 overrides either way.
@@ -1214,7 +1259,7 @@ def bench_end_to_end():
         # perf_report async-I/O-overlap section (and a regression gate
         # verdict, see _gate_line) can then PROVE how much of the
         # save/read wall was hidden under train, from artifacts alone.
-        wall, stages, best_td, restarts = None, {}, None, None
+        wall, stages, best_td, best_out, restarts = None, {}, None, None, None
         for i in range(2):
             _residue_drain()
             out = os.path.join(tmp, f"out{i}")
@@ -1227,11 +1272,12 @@ def bench_end_to_end():
             assert os.path.exists(
                 os.path.join(out, "best", "model-metadata.json"))
             if wall is None or w < wall:
-                wall, stages, best_td = w, _stages_of(out), td
+                wall, stages, best_td, best_out = w, _stages_of(out), td, out
                 # supervised runs report their restart count; the extra
                 # makes recovery overhead visible round-over-round
                 restarts = res.get("restarts")
         overlap = _stash_perf_report(best_td)
+        quality_extras = _quality_extras(best_out, train)
     e2e_rate = E2E_ROWS / wall
     base_rate = 1.0 / (1.0 / py_ingest_rate + 1.0 / host_cd_rate)
     extra = {}
@@ -1244,6 +1290,7 @@ def bench_end_to_end():
                 extra[f"{cls}_io_s"] = round(overlap[cls]["seconds"], 3)
                 extra[f"{cls}_hidden_pct"] = round(
                     overlap[cls]["hidden_pct"], 1)
+    extra.update(quality_extras)
     # self-describing metric line: the run configuration rides as extras so
     # round-over-round artifacts are comparable without reading this source
     _emit("game_end_to_end_rows_per_sec", e2e_rate, "rows/s",

@@ -410,6 +410,96 @@ def install_telemetry(config: TelemetryConfig):
                            metrics_port=config.metrics_port)
 
 
+# ---------------------------------------------------------------------------
+# Model-quality configuration (serve_game; baseline knobs on the trainers)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class QualityConfig:
+    """serve_game's model-quality knobs, round-trippable through a JSON
+    config file like :class:`ResilienceConfig`.
+
+    ``canary_gate`` refuses divergent candidates at activation
+    (``canary_bound`` None = the table dtype's documented score
+    tolerance, see quality/canary.py); ``quality_poll_s`` (0 = disabled)
+    runs the background drift evaluator at that period, raising
+    ``quality_drift_detected`` past ``drift_threshold`` (PSI).
+    """
+
+    canary_gate: bool = False
+    canary_bound: Optional[float] = None
+    quality_poll_s: float = 0.0
+    drift_threshold: float = 0.25
+
+    def __post_init__(self):
+        if self.quality_poll_s < 0:
+            raise ValueError(f"quality_poll_s must be >= 0, "
+                             f"got {self.quality_poll_s}")
+        if self.canary_bound is not None and self.canary_bound < 0:
+            raise ValueError(f"canary_bound must be >= 0, "
+                             f"got {self.canary_bound}")
+
+    # --- config-file round-trip ------------------------------------------
+    def as_dict(self) -> dict:
+        return {"canaryGate": self.canary_gate,
+                "canaryBound": self.canary_bound,
+                "qualityPollS": self.quality_poll_s,
+                "driftThreshold": self.drift_threshold}
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "QualityConfig":
+        return cls(
+            canary_gate=bool(d.get("canaryGate", False)),
+            canary_bound=(None if d.get("canaryBound") is None
+                          else float(d["canaryBound"])),
+            quality_poll_s=float(d.get("qualityPollS", 0.0)),
+            drift_threshold=float(d.get("driftThreshold", 0.25)))
+
+    # --- materialization --------------------------------------------------
+    def canary(self):
+        from photon_ml_tpu.quality import CanaryConfig
+
+        return CanaryConfig(gate=self.canary_gate, bound=self.canary_bound)
+
+
+def add_quality_flags(parser) -> None:
+    """The serve_game model-quality flags (drift monitoring + canary)."""
+    parser.add_argument(
+        "--canary-gate", action="store_true",
+        help="REFUSE a /reload or watch-dir candidate — exactly like a "
+             "validation failure, the incumbent keeps serving — when its "
+             "shadow scores over a reservoir of recent live requests "
+             "diverge from the incumbent's past the bound. Without the "
+             "flag the divergence is still measured and annotated onto "
+             "the activation")
+    parser.add_argument(
+        "--canary-bound", type=float, default=None,
+        help="max relative score divergence the canary accepts; default "
+             "= the configured --table-dtype's documented score "
+             "tolerance (bf16 1e-2, int8 5e-2; float32 takes 5e-2). "
+             "Widen it for intended large model changes")
+    parser.add_argument(
+        "--quality-poll-s", type=float, default=0.0,
+        help="period of the background drift evaluator: fold the live "
+             "score distribution against the active model's train-time "
+             "quality-baseline.json into photon_quality_drift_score "
+             "gauges, posting quality_drift_detected past "
+             "--drift-threshold (0 disables; evaluation is host-side "
+             "accumulator reads — never touches the score path)")
+    parser.add_argument(
+        "--drift-threshold", type=float, default=0.25,
+        help="total-score PSI above which quality_drift_detected fires "
+             "(rule of thumb: >0.25 = significant population shift)")
+
+
+def quality_from_args(args) -> QualityConfig:
+    return QualityConfig(canary_gate=args.canary_gate,
+                         canary_bound=args.canary_bound,
+                         quality_poll_s=args.quality_poll_s,
+                         drift_threshold=args.drift_threshold)
+
+
 def parse_grid(specs: Sequence[str]) -> list[Mapping[str, float]]:
     """``coordId=0.1;1;10`` groups → cartesian product of per-coordinate
     lambda lists (the reference's hyperparameter grid)."""

@@ -249,6 +249,27 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
             lambda path: delta_mod.save_manifest(path, manifest),
             os.path.join(args.output_dir, delta_mod.MANIFEST_NAME),
             label="io.save.manifest")
+        # quality baseline of the REFRESHED model, carrying the refresh's
+        # lineage (parentModel/trainedAt/dataManifest) — published at the
+        # run root, where serving's find_baseline discovers it for both
+        # the full dir and the sibling patch/ activation
+        from photon_ml_tpu.quality import (
+            BASELINE_NAME,
+            baseline_from_game,
+            save_baseline,
+        )
+
+        _b_source = validation[0] if validation is not None else data
+
+        def _write_baseline(path, model=result.model, bdata=_b_source,
+                            blineage=lineage):
+            save_baseline(path, baseline_from_game(
+                model, bdata, task=task, lineage=blineage))
+
+        saver.submit_file_write(
+            _write_baseline,
+            os.path.join(args.output_dir, BASELINE_NAME),
+            label="quality.baseline")
         with timed("Save models", run_logger):
             saver.join()
         GLOBAL_BUS.post("model_saved", path=best_dir)

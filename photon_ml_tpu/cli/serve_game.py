@@ -63,8 +63,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "never disturb the active version")
     p.add_argument("--watch-poll-s", type=float, default=10.0,
                    help="poll interval for --watch-dir (seconds)")
-    from photon_ml_tpu.cli.config import add_telemetry_flags
+    from photon_ml_tpu.cli.config import (
+        add_quality_flags,
+        add_telemetry_flags,
+    )
 
+    add_quality_flags(p)
     add_telemetry_flags(p)
     return p
 
@@ -101,11 +105,15 @@ def build_server(argv: Optional[Sequence[str]] = None):
         ServingService,
     )
 
+    from photon_ml_tpu.cli.config import quality_from_args
+
+    quality = quality_from_args(args)
     shard_configs = tuple(parse_feature_shard_config(s)
                           for s in args.feature_shards.split(","))
     registry = ModelRegistry(shard_configs, max_batch=args.max_batch,
                              warmup=not args.no_warmup,
-                             table_dtype=args.table_dtype)
+                             table_dtype=args.table_dtype,
+                             canary=quality.canary())
     registry.load(args.model_dir)
     batcher = None
     if args.microbatch > 0:
@@ -122,6 +130,15 @@ def build_server(argv: Optional[Sequence[str]] = None):
 
         server.watcher = ModelDirectoryWatcher(
             registry, args.watch_dir, poll_s=args.watch_poll_s).start()
+    server.drift_evaluator = None
+    if quality.quality_poll_s > 0:
+        # background model-quality evaluator: live score distribution vs
+        # the active version's train-time baseline (quality/monitor.py)
+        from photon_ml_tpu.quality import DriftEvaluator
+
+        server.drift_evaluator = DriftEvaluator(
+            registry, threshold=quality.drift_threshold,
+            poll_s=quality.quality_poll_s).start()
     return server
 
 
@@ -135,6 +152,8 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
     except KeyboardInterrupt:
         pass
     finally:
+        if server.drift_evaluator is not None:
+            server.drift_evaluator.stop()
         if server.watcher is not None:
             server.watcher.stop()
         server.stop()

@@ -693,6 +693,35 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
 
         if chief:
             best_dir = os.path.join(args.output_dir, "best")
+            # train-time quality baseline (quality/baseline.py): profile
+            # the winner's score distribution on the validation set (the
+            # training set when the run has none — still a reference
+            # distribution for online drift) and publish it at the run
+            # root next to best/ and data-manifest.json. The whole
+            # computation rides the background writer pool: score-side
+            # work never touches the training wall, and the serving
+            # registry rediscovers the artifact at load time.
+            from photon_ml_tpu.quality import (
+                BASELINE_NAME,
+                baseline_from_game,
+                save_baseline,
+            )
+
+            if validation is not None:
+                _b_source = (validation() if callable(validation)
+                             else validation)[0]
+            else:
+                _b_source = data
+
+            def _write_baseline(path, model=best.model, bdata=_b_source,
+                                blineage=lineage):
+                save_baseline(path, baseline_from_game(
+                    model, bdata, task=task, lineage=blineage))
+
+            saver.submit_file_write(
+                _write_baseline,
+                os.path.join(args.output_dir, BASELINE_NAME),
+                label="quality.baseline")
             if not args.output_all_models and not _best_pre_submitted[0]:
                 # multi-config grid / tuning without --output-all-models:
                 # the winner is only known now — submit its (sole) save

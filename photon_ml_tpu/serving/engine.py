@@ -123,6 +123,11 @@ class ScoringEngine:
         self._compile_count = 0
         self._n_calls = 0
         self._n_scored = 0
+        #: optional photon_ml_tpu.quality.QualityMonitor, attached by the
+        #: registry at load time. Accumulation is host-side numpy over
+        #: arrays score_batch already holds — the jitted program, the f32
+        #: bit-parity and the zero-recompile contract are untouched.
+        self.monitor = None
         accum = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
 
         def _score_padded(params, offsets, xs, rows):
@@ -218,6 +223,20 @@ class ScoringEngine:
         with self._lock:
             self._n_calls += 1
             self._n_scored += batch.n
+        monitor = self.monitor
+        if monitor is not None:
+            # live quality accumulation (quality/monitor.py): fallback-row
+            # hits per coordinate + nonzero design cells per shard are
+            # host facts this batch already materialized; the score
+            # binning itself happens inside the monitor (hygiene rule 6)
+            cold = {
+                cid: int(np.count_nonzero(
+                    np.asarray(r) == self.stores[cid].fallback_row))
+                for cid, r in zip(self._re_order, batch.rows)}
+            coverage = {
+                cfg.shard_id: (int(np.count_nonzero(x)), int(x.size))
+                for cfg, x in zip(self.shard_configs, batch.xs)}
+            monitor.observe(out, cold=cold, coverage=coverage)
         return out
 
     def _score_chunk(self, batch: RequestBatch, lo: int, hi: int) -> np.ndarray:

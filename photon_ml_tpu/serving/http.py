@@ -81,6 +81,9 @@ class ServingService:
         with self._lock:
             self.n_requests += 1
             self.n_scored += len(records)
+        # scored records feed the canary reservoir: the shadow-scoring
+        # workload future /reload candidates are judged against
+        self.registry.observe_requests(records)
         self.registry.bus.post("serving_request", batch=len(records),
                                latency_ms=latency_ms, version=version)
         return {"scores": scores, "version": version,
@@ -88,16 +91,28 @@ class ServingService:
 
     def healthz(self) -> dict:
         active = self.registry.active_or_none()
-        return {
+        out = {
             "status": "ok" if active is not None else "no_model",
             "version": self.registry.active_version,
             "versions": self.registry.versions(),
+            # content lineage of the ACTIVE version + the model it was
+            # refreshed from: a fleet probe can now see which hosts serve
+            # which model content, and what each refreshed into what,
+            # without scraping /metrics
+            "model_lineage_id": None if active is None else active.lineage,
+            "parentModel": (None if active is None
+                            else active.parent_lineage),
+            "quality_baseline": (active is not None
+                                 and active.baseline is not None),
             "compiles": (0 if active is None
                          else active.engine.compile_count),
             "requests": self.n_requests,
             "scored": self.n_scored,
             "uptime_s": round(time.monotonic() - self._started_monotonic, 1),
         }
+        if active is not None and active.canary is not None:
+            out["canary"] = active.canary
+        return out
 
     def reload(self, payload: dict) -> dict:
         model_dir = payload.get("model_dir") or self.default_model_dir
@@ -106,8 +121,13 @@ class ServingService:
                              "configured)")
         previous = self.registry.active_version
         sm = self.registry.reload(model_dir)
-        return {"version": sm.version, "previous": previous,
-                "model_dir": sm.model_dir}
+        out = {"version": sm.version, "previous": previous,
+               "model_dir": sm.model_dir}
+        if sm.canary is not None:
+            # canary annotation of this activation (divergence vs the
+            # incumbent over the request reservoir, quality/canary.py)
+            out["canary"] = sm.canary
+        return out
 
     def close(self) -> None:
         if self.batcher is not None:

@@ -44,6 +44,14 @@ from photon_ml_tpu.io.model_io import (
     model_lineage_id,
     resolve_game_model_dir,
 )
+from photon_ml_tpu.quality import (
+    CanaryConfig,
+    QualityMonitor,
+    RequestReservoir,
+    find_baseline,
+    load_baseline,
+    run_canary,
+)
 from photon_ml_tpu.serving.engine import ScoringEngine
 from photon_ml_tpu.serving.store import TABLE_DTYPES, EntityCoefficientStore
 from photon_ml_tpu.telemetry import metrics as _metrics
@@ -76,6 +84,16 @@ class ServingModel:
     #: under; a patch's entities are remapped into it before merging
     entity_vocabs: Mapping[str, Mapping[str, int]] = dataclasses.field(
         default_factory=dict)
+    #: lineage of the model THIS one was trained from (metadata
+    #: ``parentModel`` — the continuous-training chain, surfaced by
+    #: ``/healthz`` so a fleet probe sees what refreshed into what)
+    parent_lineage: Optional[str] = None
+    #: train-time quality profile discovered next to the model dir
+    #: (quality/baseline.py); seeds the engine's online monitor
+    baseline: object = None
+    #: canary annotation of this version's activation (divergence vs the
+    #: incumbent over the request reservoir), None when not evaluated
+    canary: Optional[Mapping] = None
 
     def score(self, records: Sequence[dict]):
         return self.engine.score(records)
@@ -87,6 +105,7 @@ class ModelRegistry:
     def __init__(self, shard_configs: Sequence[FeatureShardConfig], *,
                  max_batch: int = 1024, warmup: bool = False,
                  table_dtype: str = "float32",
+                 canary: Optional[CanaryConfig] = None,
                  bus: Optional[EventBus] = None):
         if table_dtype not in TABLE_DTYPES:
             raise ValueError(f"unknown table_dtype {table_dtype!r}; "
@@ -94,6 +113,14 @@ class ModelRegistry:
         self.shard_configs = tuple(shard_configs)
         self.max_batch = max_batch
         self.warmup = warmup
+        #: canary-activation policy (quality/canary.py): None disables
+        #: shadow-scoring entirely; CanaryConfig(gate=False) annotates
+        #: activations; gate=True refuses divergent candidates
+        self.canary = canary
+        #: bounded uniform sample of recent live request records — the
+        #: canary's shadow-scoring workload (fed by ServingService.score
+        #: via observe_requests; harmless and empty when unused)
+        self.reservoir = RequestReservoir()
         #: storage format every loaded version's coefficient tables use;
         #: patches derive from the parent store, so the dtype survives
         #: delta activations without re-reading this field
@@ -134,6 +161,11 @@ class ModelRegistry:
         with self._lock:
             return self._versions[version]
 
+    def observe_requests(self, records: Sequence[dict]) -> None:
+        """Feed scored request records into the canary reservoir (the
+        serving front end calls this per request; cheap bookkeeping)."""
+        self.reservoir.add(records)
+
     # --- lifecycle --------------------------------------------------------
     def load(self, model_dir: str, *, activate: bool = True) -> ServingModel:
         """Load + validate a candidate dir; register (and by default
@@ -144,6 +176,11 @@ class ModelRegistry:
         name = f"serving.load:{os.path.basename(os.path.normpath(model_dir))}"
         try:
             loaded = retry(lambda: self._load_validated(model_dir), name=name)
+            # structural validation passed; now the PREDICTIONS are
+            # judged: shadow-score the request reservoir against the
+            # incumbent (quality/canary.py). A CanaryRejected under the
+            # gate takes the same reject path as a corrupt candidate.
+            loaded["canary"] = self._canary_evaluate(loaded)
         except Exception as e:
             # the reject is part of the observable lifecycle: the bridge
             # counts it (photon_model_reload_rejects_total) and operators
@@ -220,6 +257,7 @@ class ModelRegistry:
         try:
             loaded = retry(lambda: self._load_patch_validated(patch_dir),
                            name=name)
+            loaded["canary"] = self._canary_evaluate(loaded)
         except Exception as e:
             self.bus.post("model_reload_rejected", path=patch_dir,
                           error=repr(e))
@@ -270,10 +308,17 @@ class ModelRegistry:
             if not isinstance(cm, FixedEffectModel)}
         engine = ScoringEngine(model, self.shard_configs, index_maps,
                                stores, max_batch=self.max_batch)
+        # train-time quality profile, published at the run root by the
+        # training/refresh drivers; absent baselines degrade the online
+        # monitor (no score bins), never the load
+        baseline = load_baseline(find_baseline(model_dir))
+        engine.monitor = QualityMonitor(baseline)
         return {"model_dir": model_dir, "model": model,
                 "index_maps": index_maps, "stores": stores,
                 "engine": engine,
                 "lineage": model_lineage_id(model_dir),
+                "parent_lineage": metadata.get("parentModel"),
+                "baseline": baseline,
                 "entity_vocabs": vocabs}
 
     def _load_patch_validated(self, patch_dir: str) -> dict:
@@ -357,10 +402,36 @@ class ModelRegistry:
         engine = ScoringEngine(model, self.shard_configs,
                                parent.index_maps, stores,
                                max_batch=self.max_batch)
+        # the refresh publishes its baseline at ITS run root (the patch's
+        # parent dir); when the patch was shipped alone, inherit the
+        # incumbent's baseline rather than serve unmonitored
+        baseline = load_baseline(find_baseline(model_dir)) or parent.baseline
+        engine.monitor = QualityMonitor(baseline)
         return {"model_dir": model_dir, "model": model,
                 "index_maps": parent.index_maps, "stores": stores,
                 "engine": engine, "lineage": metadata.get("modelId"),
+                "parent_lineage": metadata.get("parentModel"),
+                "baseline": baseline,
                 "entity_vocabs": vocabs}
+
+    def _canary_evaluate(self, loaded: dict) -> Optional[dict]:
+        """Shadow-score the request reservoir through the validated
+        candidate vs the incumbent. None (skipped) without a canary
+        config, an incumbent, or enough reservoir traffic; raises
+        CanaryRejected past the bound when the config gates."""
+        cfg = self.canary
+        if cfg is None:
+            return None
+        incumbent = self._active
+        if incumbent is None:
+            return None
+        records = self.reservoir.sample()
+        if len(records) < cfg.min_records:
+            return None
+        return run_canary(
+            incumbent.engine.score, loaded["engine"].score, records,
+            bound=cfg.bound_for(self.table_dtype), gate=cfg.gate,
+            candidate_dir=loaded["model_dir"], bus=self.bus)
 
     def _check_metadata(self, model_dir: str, metadata: dict) -> None:
         """Structural validation before any heavy load — mirrors the

@@ -15,9 +15,11 @@ current version when a candidate is rejected.
 Publication atomicity is what makes polling safe: the training side's
 staged retire-then-rename (``io/pipeline.py``) means a directory either
 is absent or is complete — the watcher can never observe a half-written
-model. An entry that fails validation is marked seen and skipped forever
-(its ``model_reload_rejected`` event/metric is the operator's signal);
-republish under a new name after fixing it.
+model. An entry that fails validation — or, under a canary-gated
+registry (``serve_game --canary-gate``, quality/canary.py), whose shadow
+scores diverge from the incumbent past the bound — is marked seen and
+skipped forever (its ``model_reload_rejected`` event/metric is the
+operator's signal); republish under a new name after fixing it.
 
 Waiting uses ``threading.Event.wait`` — serving code never sleeps
 (hygiene rule 2) and never reads ``perf_counter`` (telemetry hygiene).
@@ -88,8 +90,15 @@ class ModelDirectoryWatcher:
                 continue
             self.n_applied += 1
             applied += 1
-            logger.info("watch-dir activated %s as version %d", path,
-                        sm.version)
+            if sm.canary is not None:
+                logger.info(
+                    "watch-dir activated %s as version %d (canary: %s, "
+                    "divergence %.4g over %d records)", path, sm.version,
+                    sm.canary["verdict"], sm.canary["divergence"],
+                    sm.canary["n"])
+            else:
+                logger.info("watch-dir activated %s as version %d", path,
+                            sm.version)
         return applied
 
     # --- lifecycle --------------------------------------------------------

@@ -103,6 +103,15 @@ def _make_listener(reg: MetricsRegistry) -> Callable:
     supervisor_exhausted = reg.counter(
         "photon_supervisor_exhausted_total",
         "Supervised runs abandoned past their restart budget or deadline")
+    drift_events = reg.counter(
+        "photon_quality_drift_events_total",
+        "quality_drift_detected events: the live score distribution's "
+        "PSI vs the active model's baseline crossed the drift threshold")
+    canary_evals = reg.counter(
+        "photon_quality_canary_evals_total",
+        "Canary shadow-scoring evaluations at activation time, by "
+        "verdict (pass | divergent | rejected — a closed vocabulary)",
+        labels=("verdict",))
 
     def listener(event) -> None:
         name, p = event.name, event.payload
@@ -143,6 +152,11 @@ def _make_listener(reg: MetricsRegistry) -> Callable:
             supervisor_restarts.inc()
         elif name == "supervisor_exhausted":
             supervisor_exhausted.inc()
+        elif name == "quality_drift_detected":
+            drift_events.inc()
+        elif name == "canary_evaluated":
+            canary_evals.labels(
+                verdict=str(p.get("verdict", "pass"))).inc()
 
     return listener
 

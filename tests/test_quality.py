@@ -1,0 +1,714 @@
+"""Model-quality observability tests (photon_ml_tpu/quality/ + wiring).
+
+The load-bearing contracts, each locked here:
+
+- **baseline emission**: train_game/refresh_game publish
+  ``quality-baseline.json`` at the run root (score bins + calibration +
+  per-coordinate stats + lineage), and refresh baselines carry the
+  continuous-training lineage chain;
+- **monitors are inert on the score path**: f32 serving scores stay
+  BIT-identical with accumulation on, and the zero-recompile contract
+  holds;
+- **drift e2e**: a shifted live request distribution moves
+  ``photon_quality_drift_score`` and fires ``quality_drift_detected``;
+- **canary gate**: a structurally-valid but predictively corrupted
+  candidate is refused (``--canary-gate``) with the incumbent still
+  serving bit-identically; without the gate the activation is annotated;
+- **watcher rejection paths**: a failing candidate leaves the incumbent
+  serving, bumps ``photon_model_reload_rejects_total``, and is NOT
+  re-attempted on later poll ticks;
+- ``/healthz`` exposes the active version's lineage fields;
+- the quality report renders deterministically (golden).
+"""
+
+import json
+import os
+import shutil
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.cli import serve_game as serve_game_cli
+from photon_ml_tpu.cli import train_game as train_game_cli
+from photon_ml_tpu.cli.config import parse_feature_shard_config
+from photon_ml_tpu.events import GLOBAL_BUS
+from photon_ml_tpu.io.data_reader import write_training_examples
+from photon_ml_tpu.quality import (
+    BASELINE_NAME,
+    CanaryConfig,
+    CanaryRejected,
+    DriftEvaluator,
+    QualityMonitor,
+    RequestReservoir,
+    bin_scores,
+    compute_baseline,
+    find_baseline,
+    ks_statistic,
+    load_baseline,
+    population_stability_index,
+    quantile_edges,
+)
+from photon_ml_tpu.serving import ModelRegistry
+from photon_ml_tpu.serving.watcher import ModelDirectoryWatcher
+from photon_ml_tpu.telemetry.metrics import default_registry
+
+SHARDS = "global=fixed|intercept,user=user|noIntercept"
+SHARD_CONFIGS = tuple(parse_feature_shard_config(s)
+                      for s in SHARDS.split(","))
+COORDS = [
+    "global=fixed,shard=global,reg=L2",
+    "perUser=random,entity=userId,shard=user,reg=L2",
+]
+D_FIXED, D_USER, N_USERS = 6, 3, 9
+N_VAL = 300
+
+
+def _records(n, seed, *, cold_users=0, param_seed=777, feature_scale=1.0):
+    """Mixed-effect logistic records (the test_serving generator);
+    ``feature_scale`` > 1 shifts the request distribution — the drift
+    injection."""
+    prng = np.random.default_rng(param_seed)
+    w = prng.normal(size=D_FIXED)
+    u = 1.5 * prng.normal(size=(N_USERS, D_USER))
+    rng = np.random.default_rng(seed)
+    xf = rng.normal(size=(n, D_FIXED)) * feature_scale
+    xu = rng.normal(size=(n, D_USER)) * feature_scale
+    users = rng.integers(0, N_USERS, size=n)
+    margin = xf @ w + np.einsum("nd,nd->n", xu, u[users])
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-margin))).astype(float)
+    out = []
+    for i in range(n):
+        feats = [{"name": f"fixed.x{j}", "term": "", "value": float(xf[i, j])}
+                 for j in range(D_FIXED)]
+        feats += [{"name": f"user.z{j}", "term": "", "value": float(xu[i, j])}
+                  for j in range(D_USER)]
+        uid = (f"uCOLD{i}" if i >= n - cold_users else f"u{users[i]}")
+        out.append({
+            "uid": str(i), "response": float(y[i]), "offset": None,
+            "weight": None, "features": feats,
+            "metadataMap": {"userId": uid},
+        })
+    return out
+
+
+def _counter_value(name, **labels):
+    fam = default_registry().get(name)
+    if fam is None:
+        return 0.0
+    return fam.labels(**labels).value
+
+
+def _corrupt_copy(src_run, dst, scale=200.0):
+    """Copy a trained run and scale every coefficient: structurally valid
+    (every validation check passes), predictively garbage — exactly the
+    failure class only the canary catches."""
+    from photon_ml_tpu.io.avro import iter_avro_file, write_avro_file
+    from photon_ml_tpu.io.schemas import BAYESIAN_LINEAR_MODEL_AVRO
+
+    shutil.copytree(src_run, dst)
+    model_dir = os.path.join(dst, "best")
+    for sub in ("fixed-effect", "random-effect"):
+        root = os.path.join(model_dir, sub)
+        if not os.path.isdir(root):
+            continue
+        for cid in os.listdir(root):
+            part = os.path.join(root, cid, "coefficients",
+                                "part-00000.avro")
+            recs = list(iter_avro_file(part))
+            for r in recs:
+                for e in r.get("means") or []:
+                    e["value"] = float(e["value"]) * scale
+            write_avro_file(part, recs, BAYESIAN_LINEAR_MODEL_AVRO)
+    return dst
+
+
+@pytest.fixture(scope="module")
+def trained(tmp_path_factory):
+    """One tiny trained run (with validation, so the baseline profiles
+    the validation scores) + request sets."""
+    tmp = str(tmp_path_factory.mktemp("quality"))
+    train_path = os.path.join(tmp, "train.avro")
+    val_path = os.path.join(tmp, "val.avro")
+    write_training_examples(train_path, _records(500, seed=0))
+    write_training_examples(val_path, _records(N_VAL, seed=3))
+    out = os.path.join(tmp, "run-v1")
+    train_game_cli.run([
+        "--training-data", train_path,
+        "--validation-data", val_path,
+        "--output-dir", out,
+        "--feature-shards", SHARDS,
+        "--coordinates", *COORDS,
+        "--update-sequence", "global,perUser",
+        "--grid", "global=0.1", "perUser=1",
+        "--evaluators", "AUC",
+    ])
+    return {
+        "tmp": tmp,
+        "train": train_path,
+        "val": val_path,
+        "v1": out,
+        "requests": _records(200, seed=11, cold_users=10),
+        # the drift injection: enough heavily-shifted traffic that the
+        # ACCUMULATED live distribution (quiet 200 + shifted 280) moves
+        # well past the PSI threshold, not just the shifted slice alone
+        "shifted": _records(280, seed=21, feature_scale=8.0),
+    }
+
+
+# ---------------------------------------------------------------------------
+# drift arithmetic units
+# ---------------------------------------------------------------------------
+
+
+class TestDriftMath:
+    def test_psi_small_on_same_distribution(self):
+        rng = np.random.default_rng(0)
+        base = rng.normal(size=4000)
+        edges = quantile_edges(base, 10)
+        expected = bin_scores(base, edges)
+        live = bin_scores(rng.normal(size=4000), edges)
+        assert population_stability_index(expected, live) < 0.05
+        assert ks_statistic(expected, live) < 0.05
+
+    def test_psi_large_on_shifted_distribution(self):
+        rng = np.random.default_rng(0)
+        base = rng.normal(size=4000)
+        edges = quantile_edges(base, 10)
+        expected = bin_scores(base, edges)
+        shifted = bin_scores(rng.normal(size=4000) + 3.0, edges)
+        assert population_stability_index(expected, shifted) > 1.0
+        assert 0.5 < ks_statistic(expected, shifted) <= 1.0
+
+    def test_mismatched_bins_raise(self):
+        with pytest.raises(ValueError):
+            population_stability_index([1, 2, 3], [1, 2])
+        with pytest.raises(ValueError):
+            ks_statistic([1, 2, 3], [1, 2])
+
+    def test_bin_scores_covers_everything(self):
+        edges = quantile_edges(np.arange(100.0), 10)
+        counts = bin_scores(np.array([-1e9, 0.0, 50.0, 1e9]), edges)
+        assert counts.sum() == 4
+        assert counts[0] >= 1 and counts[-1] >= 1  # open outer bins
+
+    def test_compute_baseline_roundtrip(self, tmp_path):
+        rng = np.random.default_rng(1)
+        s = rng.normal(size=1000)
+        y = (rng.uniform(size=1000) < 1 / (1 + np.exp(-s))).astype(float)
+        b = compute_baseline(s, y, task="LOGISTIC_REGRESSION",
+                             margins={"global": s * 0.5},
+                             cold_rates={"perUser": 0.02},
+                             coverage={"user": 0.4},
+                             lineage={"trainedAt": "t"})
+        assert b.n_bins == 10
+        assert abs(sum(b.proportions) - 1.0) < 1e-9
+        assert 0.5 < b.auc < 1.0
+        assert b.calibration is not None and "pValue" in b.calibration
+        from photon_ml_tpu.quality import QualityBaseline, save_baseline
+
+        path = str(tmp_path / "b.json")
+        save_baseline(path, b)
+        b2 = load_baseline(path)
+        assert isinstance(b2, QualityBaseline)
+        assert b2.proportions == b.proportions
+        assert b2.edges == b.edges
+        assert b2.lineage == {"trainedAt": "t"}
+        assert load_baseline(str(tmp_path / "missing.json")) is None
+
+    def test_reservoir_bounded_uniform(self):
+        r = RequestReservoir(capacity=16, seed=7)
+        r.add([{"i": i} for i in range(1000)])
+        sample = r.sample()
+        assert len(sample) == len(r) == 16
+        # a uniform sample of 0..999 is overwhelmingly unlikely to stay
+        # inside the first 16 submissions
+        assert any(rec["i"] >= 16 for rec in sample)
+
+
+# ---------------------------------------------------------------------------
+# baseline emission (train + refresh)
+# ---------------------------------------------------------------------------
+
+
+class TestBaselineEmission:
+    def test_train_game_publishes_baseline(self, trained):
+        path = os.path.join(trained["v1"], BASELINE_NAME)
+        assert os.path.exists(path)
+        b = load_baseline(path)
+        assert b.n_samples == N_VAL  # profiled the VALIDATION scores
+        assert set(b.coordinates) == {"global", "perUser"}
+        assert set(b.coverage) == {"global", "user"}
+        assert "perUser" in b.cold_rates
+        assert b.task == "LOGISTIC_REGRESSION"
+        assert abs(sum(b.proportions) - 1.0) < 1e-9
+        assert b.auc is None or 0.0 < b.auc <= 1.0
+        assert b.calibration is not None
+        assert b.lineage and b.lineage.get("trainedAt")
+        # serving discovers it from the resolved model dir (run/best)
+        assert find_baseline(os.path.join(trained["v1"], "best")) == path
+
+    def test_refresh_game_carries_lineage(self, trained):
+        from photon_ml_tpu.cli import refresh_game as refresh_game_cli
+        from photon_ml_tpu.io.model_io import model_lineage_id
+
+        out = os.path.join(trained["tmp"], "refresh-1")
+        refresh_game_cli.run([
+            "--prior-dir", trained["v1"],
+            "--training-data", trained["train"],
+            "--validation-data", trained["val"],
+            "--output-dir", out,
+            "--feature-shards", SHARDS,
+            "--coordinates", *COORDS,
+            "--update-sequence", "global,perUser",
+            "--grid", "global=0.1", "perUser=1",
+            "--evaluators", "AUC",
+        ])
+        path = os.path.join(out, BASELINE_NAME)
+        b = load_baseline(path)
+        assert b is not None and b.n_samples == N_VAL
+        # the continuous-training chain rides the baseline too
+        assert b.lineage["parentModel"] == model_lineage_id(trained["v1"])
+        # the sibling patch/ activation resolves the SAME baseline
+        assert find_baseline(os.path.join(out, "patch")) == path
+
+
+# ---------------------------------------------------------------------------
+# monitors: inert on the score path, live on the metrics
+# ---------------------------------------------------------------------------
+
+
+class TestMonitors:
+    def test_f32_scores_bit_identical_with_monitor(self, trained, tmp_path):
+        """The acceptance contract: identical model with and without a
+        discovered baseline (monitor bins on vs off) scores every request
+        bit-identically."""
+        with_baseline = ModelRegistry(SHARD_CONFIGS)
+        sm1 = with_baseline.load(trained["v1"])
+        assert sm1.baseline is not None
+        assert sm1.engine.monitor is not None
+
+        bare = str(tmp_path / "no-baseline")
+        shutil.copytree(trained["v1"], bare)
+        os.remove(os.path.join(bare, BASELINE_NAME))
+        without = ModelRegistry(SHARD_CONFIGS)
+        sm2 = without.load(bare)
+        assert sm2.baseline is None
+
+        a = sm1.score(trained["requests"])
+        b = sm2.score(trained["requests"])
+        assert np.array_equal(a, b)
+
+    def test_zero_recompiles_with_accumulation_on(self, trained):
+        registry = ModelRegistry(SHARD_CONFIGS, max_batch=32)
+        sm = registry.load(trained["v1"])
+        assert sm.engine.monitor.baseline is not None
+        sm.engine.warmup()
+        frozen = sm.engine.compile_count
+        for size in (1, 2, 3, 5, 8, 13, 21, 32, 50):
+            sm.score(trained["requests"][:size])
+        assert sm.engine.compile_count == frozen
+        assert sm.engine.monitor.n_rows >= sum(
+            (1, 2, 3, 5, 8, 13, 21, 32, 50))
+
+    def test_cold_start_counter_matches_cold_requests(self, trained):
+        before = _counter_value("photon_quality_cold_start_total",
+                                coordinate="perUser")
+        registry = ModelRegistry(SHARD_CONFIGS)
+        sm = registry.load(trained["v1"])
+        cold = [r for r in trained["requests"]
+                if r["metadataMap"]["userId"].startswith("uCOLD")]
+        warm = [r for r in trained["requests"]
+                if not r["metadataMap"]["userId"].startswith("uCOLD")]
+        sm.score(cold + warm)
+        moved = _counter_value("photon_quality_cold_start_total",
+                               coordinate="perUser") - before
+        assert moved == len(cold) > 0
+
+    def test_drift_e2e_shifted_distribution_fires_event(self, trained):
+        """Acceptance e2e: serve → in-distribution traffic is quiet →
+        shifted traffic moves photon_quality_drift_score past the
+        threshold and fires quality_drift_detected (bridged to
+        photon_quality_drift_events_total)."""
+        events = []
+        unsubscribe = GLOBAL_BUS.subscribe(
+            lambda e: events.append(e)
+            if e.name == "quality_drift_detected" else None)
+        server = serve_game_cli.build_server([
+            "--model-dir", trained["v1"],
+            "--feature-shards", SHARDS,
+            "--port", "0", "--max-batch", "32", "--microbatch", "0",
+        ]).start()
+        try:
+            registry = server.service.registry
+            assert registry.active().baseline is not None
+            evaluator = DriftEvaluator(registry, threshold=0.25,
+                                       min_rows=40)
+
+            def post(recs):
+                req = urllib.request.Request(
+                    server.url + "/score",
+                    data=json.dumps({"records": recs}).encode(),
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    return json.loads(resp.read())
+
+            for lo in range(0, 200, 50):
+                post(trained["requests"][lo:lo + 50])
+            quiet = evaluator.evaluate_once()
+            psi_quiet = quiet[("__total__", "psi")]
+            assert psi_quiet < 0.25
+            assert not events
+
+            for lo in range(0, 280, 70):
+                post(trained["shifted"][lo:lo + 70])
+            drift_before = _counter_value(
+                "photon_quality_drift_events_total")
+            loud = evaluator.evaluate_once()
+            psi_loud = loud[("__total__", "psi")]
+            assert psi_loud > 0.25 > psi_quiet
+            assert len(events) == 1
+            assert events[0].payload["psi"] == pytest.approx(psi_loud,
+                                                             rel=1e-3)
+            # the gauge and the bridged counter are scrape-visible
+            gauge = default_registry().get("photon_quality_drift_score")
+            assert gauge.labels(coordinate="__total__",
+                                kind="psi").value == pytest.approx(psi_loud)
+            assert (_counter_value("photon_quality_drift_events_total")
+                    - drift_before) == 1
+            with urllib.request.urlopen(server.url + "/metrics",
+                                        timeout=60) as resp:
+                text = resp.read().decode()
+            assert "photon_quality_drift_score" in text
+            assert "photon_quality_scores_total" in text
+        finally:
+            unsubscribe()
+            server.stop()
+
+    def test_monitor_without_baseline_still_counts(self):
+        m = QualityMonitor(None)
+        m.observe(np.zeros(5), cold={"perUser": 2},
+                  coverage={"user": (3, 15)})
+        assert m.n_rows == 5
+        assert m.drift_scores() == {}  # no baseline → no drift claims
+
+
+# ---------------------------------------------------------------------------
+# canary-gated activation
+# ---------------------------------------------------------------------------
+
+
+class TestCanary:
+    def test_gate_refuses_corrupt_candidate_incumbent_bit_identical(
+            self, trained, tmp_path):
+        """Acceptance e2e: a structurally-valid but predictively
+        corrupted candidate is refused by the gate; the incumbent keeps
+        serving bit-identically; the reject is metric-visible."""
+        registry = ModelRegistry(
+            SHARD_CONFIGS, canary=CanaryConfig(gate=True))
+        registry.load(trained["v1"])
+        registry.observe_requests(trained["requests"][:64])
+        before = registry.active().score(trained["requests"])
+
+        corrupt = _corrupt_copy(trained["v1"], str(tmp_path / "corrupt"))
+        rejects0 = _counter_value("photon_model_reload_rejects_total")
+        canary0 = _counter_value("photon_quality_canary_rejects_total")
+        with pytest.raises(CanaryRejected):
+            registry.reload(corrupt)
+        assert registry.active_version == 1
+        assert np.array_equal(registry.active().score(trained["requests"]),
+                              before)
+        assert (_counter_value("photon_model_reload_rejects_total")
+                - rejects0) == 1
+        assert (_counter_value("photon_quality_canary_rejects_total")
+                - canary0) == 1
+
+    def test_without_gate_activation_is_annotated(self, trained, tmp_path):
+        registry = ModelRegistry(SHARD_CONFIGS, canary=CanaryConfig())
+        registry.load(trained["v1"])
+        registry.observe_requests(trained["requests"][:64])
+        corrupt = _corrupt_copy(trained["v1"],
+                                str(tmp_path / "corrupt-annotated"))
+        sm = registry.reload(corrupt)  # activates, but annotated
+        assert registry.active_version == sm.version == 2
+        assert sm.canary["verdict"] == "divergent"
+        assert sm.canary["divergence"] > sm.canary["bound"]
+        # the canary always judges against the CURRENT incumbent:
+        # re-activating the same content diverges by ~nothing
+        sm3 = registry.reload(corrupt)
+        assert sm3.canary["verdict"] == "pass"
+        assert sm3.canary["divergence"] < sm3.canary["bound"]
+
+    def test_canary_skipped_without_traffic_or_incumbent(self, trained):
+        registry = ModelRegistry(
+            SHARD_CONFIGS, canary=CanaryConfig(gate=True))
+        sm1 = registry.load(trained["v1"])  # no incumbent → skipped
+        assert sm1.canary is None
+        sm2 = registry.reload(trained["v1"])  # empty reservoir → skipped
+        assert sm2.canary is None
+
+    def test_default_bounds_track_table_dtype(self):
+        cfg = CanaryConfig()
+        assert cfg.bound_for("bfloat16") == pytest.approx(1e-2)
+        assert cfg.bound_for("int8") == pytest.approx(5e-2)
+        assert cfg.bound_for("float32") == pytest.approx(5e-2)
+        assert CanaryConfig(bound=0.3).bound_for("int8") == 0.3
+
+    def test_serve_game_canary_gate_http(self, trained, tmp_path):
+        """--canary-gate over HTTP: /reload of the corrupt candidate
+        409s with the incumbent untouched; /reload of a good candidate
+        succeeds with the canary annotation in the response."""
+        server = serve_game_cli.build_server([
+            "--model-dir", trained["v1"],
+            "--feature-shards", SHARDS,
+            "--port", "0", "--max-batch", "16", "--microbatch", "0",
+            "--canary-gate",
+        ]).start()
+        try:
+            def post(path, payload):
+                req = urllib.request.Request(
+                    server.url + path,
+                    data=json.dumps(payload).encode(),
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    return json.loads(resp.read())
+
+            assert server.service.registry.canary.gate
+            out = post("/score", {"records": trained["requests"][:16]})
+            scores_before = out["scores"]
+            corrupt = _corrupt_copy(trained["v1"],
+                                    str(tmp_path / "corrupt-http"))
+            with pytest.raises(urllib.error.HTTPError) as err:
+                post("/reload", {"model_dir": corrupt})
+            assert err.value.code == 409
+            with urllib.request.urlopen(server.url + "/healthz",
+                                        timeout=60) as resp:
+                health = json.loads(resp.read())
+            assert health["version"] == 1
+            # the incumbent still serves the same bits
+            assert post("/score",
+                        {"records": trained["requests"][:16]})["scores"] \
+                == scores_before
+            good = post("/reload", {"model_dir": trained["v1"]})
+            assert good["version"] == 2
+            assert good["canary"]["verdict"] == "pass"
+        finally:
+            server.stop()
+
+    def test_healthz_reports_lineage(self, trained):
+        from photon_ml_tpu.io.model_io import model_lineage_id
+
+        server = serve_game_cli.build_server([
+            "--model-dir", trained["v1"],
+            "--feature-shards", SHARDS,
+            "--port", "0", "--no-warmup", "--microbatch", "0",
+        ]).start()
+        try:
+            with urllib.request.urlopen(server.url + "/healthz",
+                                        timeout=60) as resp:
+                health = json.loads(resp.read())
+            assert health["model_lineage_id"] == model_lineage_id(
+                trained["v1"])
+            assert health["parentModel"] is None  # cold training run
+            assert health["quality_baseline"] is True
+        finally:
+            server.stop()
+
+    def test_drift_evaluator_flag_starts_background_thread(self, trained):
+        server = serve_game_cli.build_server([
+            "--model-dir", trained["v1"],
+            "--feature-shards", SHARDS,
+            "--port", "0", "--no-warmup", "--microbatch", "0",
+            "--quality-poll-s", "30", "--drift-threshold", "0.4",
+        ]).start()
+        try:
+            assert server.drift_evaluator is not None
+            assert server.drift_evaluator.threshold == 0.4
+        finally:
+            server.drift_evaluator.stop()
+            server.stop()
+
+
+# ---------------------------------------------------------------------------
+# watcher rejection paths (ISSUE 10 satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestWatcherRejection:
+    def _publish(self, watch_dir, name, src):
+        dst = os.path.join(watch_dir, name)
+        shutil.copytree(src, dst)
+        return dst
+
+    def test_structural_reject_keeps_incumbent_and_never_retries(
+            self, trained, tmp_path):
+        registry = ModelRegistry(SHARD_CONFIGS)
+        registry.load(trained["v1"])
+        before = registry.active().score(trained["requests"][:8])
+        watch = str(tmp_path / "watch")
+        os.makedirs(watch)
+        broken = self._publish(watch, "v0002-broken", trained["v1"])
+        os.remove(os.path.join(broken, "best", "random-effect", "perUser",
+                               "coefficients", "part-00000.avro"))
+        watcher = ModelDirectoryWatcher(registry, watch, poll_s=999)
+
+        rejects0 = _counter_value("photon_model_reload_rejects_total")
+        assert watcher.scan_once() == 0
+        assert watcher.n_rejected == 1
+        assert (_counter_value("photon_model_reload_rejects_total")
+                - rejects0) == 1
+        assert registry.active_version == 1
+        assert np.array_equal(
+            registry.active().score(trained["requests"][:8]), before)
+
+        # later poll ticks must NOT re-attempt the rejected candidate
+        for _ in range(3):
+            assert watcher.scan_once() == 0
+        assert watcher.n_rejected == 1
+        assert (_counter_value("photon_model_reload_rejects_total")
+                - rejects0) == 1
+
+        # a fixed republish under a NEW name is picked up normally
+        self._publish(watch, "v0003-good", trained["v1"])
+        assert watcher.scan_once() == 1
+        assert registry.active_version == 2
+
+    def test_canary_reject_via_watcher_keeps_incumbent(
+            self, trained, tmp_path):
+        registry = ModelRegistry(
+            SHARD_CONFIGS, canary=CanaryConfig(gate=True))
+        registry.load(trained["v1"])
+        registry.observe_requests(trained["requests"][:64])
+        before = registry.active().score(trained["requests"][:8])
+        watch = str(tmp_path / "watch-canary")
+        os.makedirs(watch)
+        _corrupt_copy(trained["v1"],
+                      os.path.join(watch, "v0002-poisoned"))
+        watcher = ModelDirectoryWatcher(registry, watch, poll_s=999)
+
+        rejects0 = _counter_value("photon_model_reload_rejects_total")
+        assert watcher.scan_once() == 0
+        assert watcher.n_rejected == 1
+        assert (_counter_value("photon_model_reload_rejects_total")
+                - rejects0) == 1
+        assert registry.active_version == 1
+        assert np.array_equal(
+            registry.active().score(trained["requests"][:8]), before)
+        assert watcher.scan_once() == 0  # never re-attempted
+        assert watcher.n_rejected == 1
+
+
+# ---------------------------------------------------------------------------
+# the quality report (golden, like perf_report)
+# ---------------------------------------------------------------------------
+
+QUALITY_PROM = """\
+# HELP photon_quality_scored_rows_total rows
+# TYPE photon_quality_scored_rows_total counter
+photon_quality_scored_rows_total 200
+# HELP photon_quality_scores_total live bins
+# TYPE photon_quality_scores_total counter
+photon_quality_scores_total{bin="0"} 60
+photon_quality_scores_total{bin="1"} 140
+# HELP photon_quality_cold_start_total cold
+# TYPE photon_quality_cold_start_total counter
+photon_quality_cold_start_total{coordinate="perUser"} 10
+# HELP photon_quality_feature_coverage_ratio coverage
+# TYPE photon_quality_feature_coverage_ratio gauge
+photon_quality_feature_coverage_ratio{shard="user"} 0.5
+# HELP photon_quality_drift_score drift
+# TYPE photon_quality_drift_score gauge
+photon_quality_drift_score{coordinate="__total__",kind="psi"} 0.42
+photon_quality_drift_score{coordinate="__total__",kind="ks"} 0.2
+photon_quality_drift_score{coordinate="perUser",kind="cold_start"} 0.01
+# HELP photon_quality_drift_events_total events
+# TYPE photon_quality_drift_events_total counter
+photon_quality_drift_events_total 2
+"""
+
+QUALITY_BASELINE = {
+    "nSamples": 300,
+    "meanScore": 0.1234,
+    "stdScore": 1.5,
+    "positiveRate": 0.5,
+    "auc": 0.75,
+    "scoreBins": {"edges": [0.0], "proportions": [0.5, 0.5]},
+    "coldRates": {"perUser": 0.02},
+    "coverage": {"user": 0.45},
+    "lineage": {"parentModel": "abc123", "trainedAt": "2026-08-04"},
+    "calibration": {"binCounts": [150, 150], "chiSquare": 3.2,
+                    "pValue": 0.36},
+}
+
+QUALITY_TRACE = [
+    {"name": "quality.canary", "span_id": 1, "parent_id": None,
+     "ts": 100.0, "t0": 0.0, "t1": 0.5, "seconds": 0.5,
+     "candidate": "pub/v0002", "n": 64, "divergence": 0.000012,
+     "bound": 0.05, "verdict": "pass"},
+    {"name": "quality.canary", "span_id": 2, "parent_id": None,
+     "ts": 200.0, "t0": 1.0, "t1": 1.4, "seconds": 0.4,
+     "candidate": "pub/v0003", "n": 64, "divergence": 0.8,
+     "bound": 0.05, "verdict": "rejected"},
+]
+
+EXPECTED_QUALITY_REPORT = """\
+== photon model-quality report ==
+baseline: n=300 mean=0.1234 std=1.5000 positive_rate=0.500 auc=0.750
+lineage: parentModel=abc123 trainedAt=2026-08-04
+calibration (Hosmer-Lemeshow): chi2=3.200 p=0.3600 over 2 bins
+
+-- live traffic --
+scored rows: 200
+cold-start perUser: 10 hits, rate 0.0500 (baseline 0.0200)
+coverage user: 0.5000 (baseline 0.4500)
+
+-- score distribution (baseline vs live) --
+ bin        upper  baseline%    live%
+   0       0.0000       50.0     30.0
+   1         +inf       50.0     70.0
+
+-- drift (photon_quality_drift_score) --
+coordinate       kind             score  threshold  verdict
+__total__        ks              0.2000      0.250  ok
+__total__        psi             0.4200      0.250  DRIFT
+perUser          cold_start      0.0100      0.250  ok
+drift events fired: 2
+
+-- canary history (quality.canary spans) --
+candidate=pub/v0002 n=64 divergence=0.000012 bound=0.05 verdict=pass
+candidate=pub/v0003 n=64 divergence=0.800000 bound=0.05 verdict=rejected
+"""
+
+
+class TestQualityReport:
+    @pytest.fixture()
+    def tool(self):
+        import importlib
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        sys.path.insert(0, os.path.join(repo, "tools"))
+        try:
+            return importlib.import_module("quality_report")
+        finally:
+            sys.path.pop(0)
+
+    def test_golden_report(self, tool):
+        got = tool.build_report(QUALITY_PROM, QUALITY_TRACE,
+                                QUALITY_BASELINE, threshold=0.25)
+        assert got == EXPECTED_QUALITY_REPORT
+
+    def test_cli_renders_run_dir(self, tool, tmp_path, capsys):
+        (tmp_path / "metrics.prom").write_text(QUALITY_PROM)
+        (tmp_path / "trace.jsonl").write_text(
+            "".join(json.dumps(r) + "\n" for r in QUALITY_TRACE))
+        (tmp_path / "quality-baseline.json").write_text(
+            json.dumps(QUALITY_BASELINE))
+        assert tool.main([str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "DRIFT" in out and "canary history" in out
+
+    def test_no_baseline_renders_placeholder(self, tool):
+        report = tool.build_report(QUALITY_PROM, [], None)
+        assert "baseline: (none" in report

@@ -105,8 +105,10 @@ def test_wall_clock_duration_legal_inside_telemetry():
     # dynamic names are out of the lint's reach (registry plumbing)
     ('from photon_ml_tpu.telemetry import metrics as m\n'
      'name = f()\nm.counter(name, "help")\n', 0),
-    # unrelated .histogram calls with non-literal args don't trip it
-    ('import numpy as np\nnp.histogram(data, bins=10)\n', 0),
+    # non-numpy .histogram calls with non-literal args trip NEITHER the
+    # naming lint NOR rule 6 (np.histogram itself is rule 6's business —
+    # see test_binning_math_confined_to_quality)
+    ('obj.histogram(data, bins=10)\n', 0),
 ])
 def test_metric_naming_lint(snippet, n):
     rel = os.path.join("photon_ml_tpu", "game", "x.py")
@@ -131,3 +133,34 @@ def test_private_registry_via_module_attribute_banned():
     rel = os.path.join("photon_ml_tpu", "io", "x.py")
     out = hygiene.check_source(src, rel)
     assert len(out) == 1 and "default_registry" in out[0]
+
+
+@pytest.mark.parametrize("snippet, n", [
+    # numpy/jax.numpy histogram binning outside quality/ (rule 6)
+    ("import numpy as np\nnp.histogram(x, bins=10)\n", 1),
+    ("import numpy\nnumpy.histogram_bin_edges(x)\n", 1),
+    ("import jax.numpy as jnp\njnp.histogram(x)\n", 1),
+    ("from jax import numpy as jnp\njnp.histogram(x)\n", 1),
+    ("import jax.numpy\njax.numpy.histogram(x)\n", 1),
+    # a .histogram attribute on anything that is NOT numpy stays legal
+    # (the telemetry registry's own factory, custom objects)
+    ("reg.histogram('photon_x_seconds', 'help')\n", 0),
+    ("obj.histogram(data)\n", 0),
+    # re-deriving the drift statistics forks the arithmetic
+    ("def population_stability_index(e, a):\n    return 0.0\n", 1),
+    ("def ks_statistic(e, a):\n    return 0.0\n", 1),
+    # CALLING quality's exported functions is the sanctioned path
+    ("from photon_ml_tpu.quality import population_stability_index\n"
+     "population_stability_index(e, a)\n", 0),
+])
+def test_binning_math_confined_to_quality(snippet, n):
+    rel = os.path.join("photon_ml_tpu", "serving", "x.py")
+    assert len(hygiene.check_source(snippet, rel)) == n, \
+        hygiene.check_source(snippet, rel)
+
+
+def test_binning_math_legal_inside_quality():
+    src = ("import numpy as np\nnp.histogram(x, bins=10)\n"
+           "def population_stability_index(e, a):\n    return 0.0\n")
+    assert hygiene.check_source(
+        src, os.path.join("photon_ml_tpu", "quality", "x.py")) == []

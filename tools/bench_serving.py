@@ -19,7 +19,12 @@ mixed batch sizes from worker threads, and reports:
   for in-process runs, where the bench is the only traffic — parity
   assertions between the scraped counters and the client-side tallies
   (requests counted == requests sent, recompiles metric == healthz
-  compiles delta, histogram count == scored requests).
+  compiles delta, histogram count == scored requests),
+- the ``photon_quality_*`` model-quality families (quality/monitor.py):
+  scored-row and cold-start counter deltas across the load, with a HARD
+  parity assert for in-process runs that the server's cold-start counter
+  moved by exactly the client-side tally of unknown-entity references
+  the bench sent (computed per record against the store's own row map).
 
 Output: one JSON line per metric + a terminal ``suite_summary`` line, the
 same artifact shape as bench.py.
@@ -177,6 +182,23 @@ def main(argv=None):
         base = server.url
 
     pool = _request_pool(args, server)
+    cold_refs = None
+    if server is not None:
+        # per-pool-record count of entity references landing on a store's
+        # zero fallback row (unknown or missing id) — the client-side
+        # ground truth the scraped photon_quality_cold_start_total delta
+        # must match exactly for an in-process run
+        stores = list(server.service.registry.active().stores.values())
+
+        def _cold_count(rec):
+            meta = rec.get("metadataMap") or {}
+            return sum(
+                int(store.rows_for(
+                    [meta.get(store.random_effect_type)])[0]
+                    == store.fallback_row)
+                for store in stores)
+
+        cold_refs = [_cold_count(r) for r in pool]
     sizes = [int(s) for s in args.batch_sizes.split(",") if s]
     compiles0 = _http_json(base + "/healthz")["compiles"]
     metrics0 = _scrape_metrics(base)
@@ -185,6 +207,7 @@ def main(argv=None):
     errors: list[str] = []
     lock = threading.Lock()
     counter = {"i": 0}
+    cold_sent = {"n": 0}
 
     def worker():
         while True:
@@ -205,6 +228,10 @@ def main(argv=None):
                 continue
             with lock:
                 latencies.append((time.perf_counter() - t0) * 1e3)
+                if cold_refs is not None:
+                    cold_sent["n"] += sum(
+                        cold_refs[(i + j) % len(pool)]
+                        for j in range(size))
 
     t0 = time.perf_counter()
     threads = [threading.Thread(target=worker)
@@ -272,9 +299,40 @@ def main(argv=None):
             "active_version": series_value(
                 metrics1, "photon_model_active_version"),
         })
+        # model-quality families (quality/monitor.py): the engine-side
+        # accumulation across the load window
+        def _labeled_delta(name, label):
+            out = {}
+            for labels, v1 in metrics1.get(name, []):
+                if label in labels:
+                    v0 = series_value(metrics0 or {}, name,
+                                      {label: labels[label]})
+                    out[labels[label]] = v1 - v0
+            return out
+
+        cold_by_cid = _labeled_delta("photon_quality_cold_start_total",
+                                     "coordinate")
+        quality_cold = int(sum(cold_by_cid.values()))
+        quality_rows = int(delta("photon_quality_scored_rows_total"))
+        results.append({
+            "metric": "serving_quality_metrics",
+            "value": quality_cold,
+            "unit": "cold-start entity refs "
+                    "(photon_quality_cold_start_total delta)",
+            "cold_start_by_coordinate": {k: int(v)
+                                         for k, v in cold_by_cid.items()},
+            "scored_rows": quality_rows,
+            "client_cold_sent": (cold_sent["n"] if cold_refs is not None
+                                 else None),
+        })
         if server is not None:
             # in-process run = the bench is the only traffic, so the
             # server's own books must match the client's exactly
+            if cold_refs is not None and quality_cold != cold_sent["n"]:
+                parity_failures.append(
+                    f"photon_quality_cold_start_total moved "
+                    f"{quality_cold}, client sent {cold_sent['n']} "
+                    f"unknown-entity references")
             if requests_metric != len(latencies):
                 parity_failures.append(
                     f"requests_total moved {requests_metric}, client "

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Static telemetry-hygiene check over ``photon_ml_tpu/``.
 
-Five rules, all load-bearing for the telemetry subsystem (the sibling of
+Six rules, all load-bearing for the telemetry subsystem (the sibling of
 ``check_resilience_hygiene.py``, same contract: run directly or through the
 tier-1 test):
 
@@ -35,6 +35,15 @@ tier-1 test):
    clock: wrong under clock jumps AND invisible to telemetry. Durations
    come from registry timers or spans; ``time.time()`` alone (a
    timestamp) stays legal.
+6. **Drift/binning math lives in ``photon_ml_tpu/quality/``** — the
+   quality layer compares a live score histogram against a train-time
+   baseline through ONE binning and ONE PSI/KS implementation
+   (``quality/baseline.py``). A second ``np.histogram`` over scores, or a
+   re-derived ``population_stability_index``, would silently disagree
+   about bin edges or proportion floors — and "drift" would mean
+   different things on the two sides of the comparison. Detected:
+   ``numpy``/``jax.numpy`` ``histogram*`` calls, and local definitions of
+   the drift statistics, outside ``photon_ml_tpu/quality/``.
 
 Run directly (``python tools/check_telemetry_hygiene.py [root]``, exit 1 on
 violations) or through the tier-1 test ``tests/test_telemetry_hygiene.py``.
@@ -63,6 +72,18 @@ REGISTRY_ALLOWED_PREFIX = os.path.join("photon_ml_tpu", "telemetry") + os.sep
 METRIC_FACTORIES = frozenset({"counter", "gauge", "histogram"})
 
 METRIC_NAME_RE = re.compile(r"photon_[a-z0-9_]+\Z")
+
+#: the one subtree whose job IS score binning + drift statistics
+QUALITY_ALLOWED_PREFIX = os.path.join("photon_ml_tpu", "quality") + os.sep
+
+#: numpy/jax.numpy histogram-binning entry points (rule 6)
+HISTOGRAM_ATTRS = frozenset({"histogram", "histogram2d", "histogramdd",
+                             "histogram_bin_edges"})
+
+#: drift-statistic names whose DEFINITION outside quality/ forks the
+#: arithmetic (calling quality's exported functions is of course fine)
+DRIFT_STAT_NAMES = frozenset({"population_stability_index", "psi",
+                              "ks_statistic", "kolmogorov_smirnov"})
 
 
 def _is_perf_counter(node: ast.AST, time_aliases: set[str],
@@ -104,17 +125,24 @@ def check_source(source: str, rel_path: str) -> list[str]:
                        for p in PRINT_ALLOWED_PREFIXES))
     pc_banned = not rel_path.startswith(TIMING_ALLOWED_PREFIX)
     registry_ok = rel_path.startswith(REGISTRY_ALLOWED_PREFIX)
+    binning_banned = not rel_path.startswith(QUALITY_ALLOWED_PREFIX)
 
-    # resolve what `time` / `perf_counter` / `time.time` are bound to
+    # resolve what `time` / `perf_counter` / `time.time` / numpy are
+    # bound to
     time_aliases: set[str] = set()
     pc_names: set[str] = set()
     tt_names: set[str] = set()  # from-imports of time.time
     metric_fn_names: set[str] = set()  # from-imports of counter/gauge/...
+    np_aliases: set[str] = set()  # names bound to numpy / jax.numpy
     for node in ast.walk(tree):
         if isinstance(node, ast.Import):
             for a in node.names:
                 if a.name == "time":
                     time_aliases.add(a.asname or "time")
+                elif a.name == "numpy":
+                    np_aliases.add(a.asname or "numpy")
+                elif a.name == "jax.numpy" and a.asname:
+                    np_aliases.add(a.asname)
         elif isinstance(node, ast.ImportFrom):
             if node.module == "time":
                 for a in node.names:
@@ -126,6 +154,17 @@ def check_source(source: str, rel_path: str) -> list[str]:
                 for a in node.names:
                     if a.name in METRIC_FACTORIES:
                         metric_fn_names.add(a.asname or a.name)
+            elif node.module == "jax":
+                for a in node.names:
+                    if a.name == "numpy":
+                        np_aliases.add(a.asname or "numpy")
+
+    def _is_np_module(v: ast.AST) -> bool:
+        if isinstance(v, ast.Name):
+            return v.id in np_aliases
+        # the bare `import jax.numpy` spelling: jax.numpy.histogram(...)
+        return (isinstance(v, ast.Attribute) and v.attr == "numpy"
+                and isinstance(v.value, ast.Name) and v.value.id == "jax")
 
     def _is_wall_clock_call(node: ast.AST) -> bool:
         if not isinstance(node, ast.Call):
@@ -159,6 +198,23 @@ def check_source(source: str, rel_path: str) -> list[str]:
                        f"time.time() — the wall clock is for timestamps "
                        f"(it jumps); measure durations with a registry "
                        f"timer or a tracing span")
+        elif (binning_banned and isinstance(node, ast.Call)
+              and isinstance(node.func, ast.Attribute)
+              and node.func.attr in HISTOGRAM_ATTRS
+              and _is_np_module(node.func.value)):
+            out.append(
+                f"{rel_path}:{node.lineno}: {node.func.attr}() outside "
+                f"photon_ml_tpu/quality/ — score-histogram binning lives "
+                f"in quality/baseline.py (bin_scores/quantile_edges) so "
+                f"live and baseline distributions always share bin "
+                f"edges; a second binning silently redefines drift")
+        elif (binning_banned and isinstance(node, ast.FunctionDef)
+              and node.name in DRIFT_STAT_NAMES):
+            out.append(
+                f"{rel_path}:{node.lineno}: drift statistic "
+                f"{node.name}() defined outside photon_ml_tpu/quality/ — "
+                f"PSI/KS have ONE implementation (quality/baseline.py); "
+                f"import it instead of re-deriving the arithmetic")
         elif isinstance(node, ast.Call):
             func = node.func
             is_factory = (

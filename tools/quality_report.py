@@ -1,0 +1,259 @@
+#!/usr/bin/env python
+"""Model-quality report: baseline vs live drift + canary history.
+
+The perf report (``tools/perf_report.py``) answers "where did the
+wall-clock go"; this one answers "is the model still predicting what it
+was trained to predict". It renders a ``--telemetry-dir``'s artifacts —
+``metrics.prom`` (the ``photon_quality_*`` families the serving monitors
+accumulate) and ``trace.jsonl`` (the ``quality.canary`` activation
+spans) — against the model's train-time ``quality-baseline.json`` into
+one deterministic text report:
+
+- **baseline** — the training/refresh run's reference profile (samples,
+  mean/std, positive rate, AUC, lineage);
+- **live traffic** — scored rows, per-coordinate cold-start rates and
+  per-shard feature coverage, each against its baseline expectation;
+- **score distribution** — the baseline's equal-mass bins vs the live
+  histogram, side by side;
+- **drift** — every ``photon_quality_drift_score{coordinate,kind}``
+  gauge with a DRIFT/ok verdict at the threshold;
+- **canary history** — each activation-time shadow-scoring evaluation
+  (divergence, bound, verdict) in trace order.
+
+Usage::
+
+    python tools/quality_report.py DIR [--baseline PATH] [--threshold T]
+
+where DIR is the serving run's ``--telemetry-dir``. The baseline defaults
+to ``DIR/quality-baseline.json`` when present (copy it next to the
+telemetry for archival) — point ``--baseline`` at the model run root
+otherwise. All drift arithmetic already happened in
+``photon_ml_tpu/quality/`` (hygiene rule 6); this tool only renders.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Mapping, Optional, Sequence
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from photon_ml_tpu.telemetry import prometheus as tprom  # noqa: E402
+
+
+def load_spans(path: str) -> list[dict]:
+    """Span records from a trace file (annotations dropped)."""
+    spans = []
+    if not os.path.exists(path):
+        return spans
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            if rec.get("span_id") is None:
+                continue
+            spans.append(rec)
+    return spans
+
+
+def _labeled(parsed: Mapping, series: str, label: str) -> dict[str, float]:
+    out: dict[str, float] = {}
+    for labels, value in parsed.get(series, ()):
+        if label in labels:
+            out[labels[label]] = out.get(labels[label], 0.0) + value
+    return out
+
+
+def _pairs(parsed: Mapping, series: str, l1: str, l2: str) -> dict:
+    out: dict = {}
+    for labels, value in parsed.get(series, ()):
+        if l1 in labels and l2 in labels:
+            out[(labels[l1], labels[l2])] = value
+    return out
+
+
+def _scalar(parsed: Mapping, series: str) -> float:
+    for labels, value in parsed.get(series, ()):
+        if not labels:
+            return value
+    return 0.0
+
+
+def _fmt_opt(v, fmt: str = "{:.3f}") -> str:
+    return "n/a" if v is None else fmt.format(float(v))
+
+
+def build_report(prom_text: str, spans: Sequence[Mapping],
+                 baseline: Optional[Mapping],
+                 threshold: float = 0.25) -> str:
+    """The report text (the CLI prints it; tests golden-compare it).
+    ``baseline`` is the parsed ``quality-baseline.json`` dict or None."""
+    parsed = tprom.parse_text(prom_text)
+    lines: list[str] = ["== photon model-quality report =="]
+
+    # --- baseline ---------------------------------------------------------
+    if baseline:
+        bins = baseline.get("scoreBins") or {}
+        lines.append(
+            f"baseline: n={int(baseline.get('nSamples', 0))} "
+            f"mean={float(baseline.get('meanScore', 0.0)):.4f} "
+            f"std={float(baseline.get('stdScore', 0.0)):.4f} "
+            f"positive_rate={_fmt_opt(baseline.get('positiveRate'))} "
+            f"auc={_fmt_opt(baseline.get('auc'))}")
+        lineage = baseline.get("lineage") or {}
+        if lineage:
+            parts = [f"{k}={lineage[k]}" for k in sorted(lineage)
+                     if lineage[k] is not None]
+            if parts:
+                lines.append("lineage: " + " ".join(parts))
+        cal = baseline.get("calibration")
+        if cal:
+            lines.append(
+                f"calibration (Hosmer-Lemeshow): chi2="
+                f"{float(cal.get('chiSquare', 0.0)):.3f} "
+                f"p={float(cal.get('pValue', 0.0)):.4f} over "
+                f"{len(cal.get('binCounts', ()))} bins")
+    else:
+        bins = {}
+        lines.append("baseline: (none — pass --baseline or publish "
+                     "quality-baseline.json with the model)")
+
+    # --- live traffic -----------------------------------------------------
+    rows = _scalar(parsed, "photon_quality_scored_rows_total")
+    lines.append("")
+    lines.append("-- live traffic --")
+    lines.append(f"scored rows: {int(rows)}")
+    cold = _labeled(parsed, "photon_quality_cold_start_total", "coordinate")
+    base_cold = (baseline or {}).get("coldRates") or {}
+    for cid in sorted(set(cold) | set(base_cold)):
+        hits = cold.get(cid, 0.0)
+        rate = hits / rows if rows else 0.0
+        base = base_cold.get(cid)
+        lines.append(f"cold-start {cid}: {int(hits)} hits, rate "
+                     f"{rate:.4f} (baseline {_fmt_opt(base, '{:.4f}')})")
+    cov = _labeled(parsed, "photon_quality_feature_coverage_ratio", "shard")
+    base_cov = (baseline or {}).get("coverage") or {}
+    for sid in sorted(set(cov) | set(base_cov)):
+        lines.append(
+            f"coverage {sid}: {_fmt_opt(cov.get(sid), '{:.4f}')} "
+            f"(baseline {_fmt_opt(base_cov.get(sid), '{:.4f}')})")
+
+    # --- score distribution -----------------------------------------------
+    live_bins = _labeled(parsed, "photon_quality_scores_total", "bin")
+    props = bins.get("proportions") or ()
+    edges = bins.get("edges") or ()
+    if props:
+        lines.append("")
+        lines.append("-- score distribution (baseline vs live) --")
+        lines.append(f"{'bin':>4} {'upper':>12} {'baseline%':>10} "
+                     f"{'live%':>8}")
+        live_total = sum(live_bins.get(str(i), 0.0)
+                         for i in range(len(props)))
+        for i, p in enumerate(props):
+            upper = (f"{float(edges[i]):.4f}" if i < len(edges)
+                     else "+inf")
+            live = live_bins.get(str(i), 0.0)
+            live_pct = 100.0 * live / live_total if live_total else 0.0
+            lines.append(f"{i:>4d} {upper:>12} {100.0 * float(p):>10.1f} "
+                         f"{live_pct:>8.1f}")
+
+    # --- drift ------------------------------------------------------------
+    drift = _pairs(parsed, "photon_quality_drift_score",
+                   "coordinate", "kind")
+    lines.append("")
+    lines.append("-- drift (photon_quality_drift_score) --")
+    if drift:
+        lines.append(f"{'coordinate':<16} {'kind':<12} {'score':>9} "
+                     f"{'threshold':>10}  verdict")
+        for (coordinate, kind) in sorted(drift):
+            v = drift[(coordinate, kind)]
+            # the configured threshold gates the PSI alarm; other kinds
+            # are shown against it as a reference line only
+            verdict = ("DRIFT" if kind == "psi" and v > threshold
+                       else "ok")
+            lines.append(f"{coordinate:<16} {kind:<12} {v:>9.4f} "
+                         f"{threshold:>10.3f}  {verdict}")
+    else:
+        lines.append("  (no drift gauges in snapshot — is the drift "
+                     "evaluator running? serve_game --quality-poll-s)")
+    events = _scalar(parsed, "photon_quality_drift_events_total")
+    if events:
+        lines.append(f"drift events fired: {int(events)}")
+
+    # --- canary history ---------------------------------------------------
+    lines.append("")
+    lines.append("-- canary history (quality.canary spans) --")
+    canaries = [s for s in spans if s.get("name") == "quality.canary"]
+    if canaries:
+        for s in sorted(canaries, key=lambda s: float(s.get("ts", 0.0))):
+            lines.append(
+                f"candidate={s.get('candidate', '?')} "
+                f"n={int(s.get('n', 0))} "
+                f"divergence={float(s.get('divergence', 0.0)):.6f} "
+                f"bound={float(s.get('bound', 0.0)):.4g} "
+                f"verdict={s.get('verdict', '?')}")
+    else:
+        lines.append("  (no canary evaluations)")
+    rejects = _scalar(parsed, "photon_quality_canary_rejects_total")
+    if rejects:
+        lines.append(f"canary rejections: {int(rejects)}")
+    return "\n".join(lines) + "\n"
+
+
+def resolve_inputs(run_dir: str) -> tuple[str, str]:
+    """(trace path, metrics path), preferring merged/aggregate artifacts
+    (same convention as tools/perf_report.py)."""
+    trace = os.path.join(run_dir, "trace.merged.jsonl")
+    if not os.path.exists(trace):
+        trace = os.path.join(run_dir, "trace.jsonl")
+    prom = os.path.join(run_dir, "metrics.aggregate.prom")
+    if not os.path.exists(prom):
+        prom = os.path.join(run_dir, "metrics.prom")
+    return trace, prom
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        description="Render a model-quality report (baseline vs live "
+                    "drift + canary history) from a --telemetry-dir run")
+    p.add_argument("run_dir", help="the serving run's --telemetry-dir")
+    p.add_argument("--baseline", default=None,
+                   help="quality-baseline.json (or a model run root "
+                        "containing one); default: "
+                        "<run_dir>/quality-baseline.json when present")
+    p.add_argument("--threshold", type=float, default=0.25,
+                   help="PSI threshold for the DRIFT verdict")
+    args = p.parse_args(argv)
+    trace_path, prom_path = resolve_inputs(args.run_dir)
+    prom_text = ""
+    if os.path.exists(prom_path):
+        with open(prom_path, encoding="utf-8") as f:
+            prom_text = f.read()
+    elif not os.path.exists(trace_path):
+        print(f"no metrics.prom or trace.jsonl under {args.run_dir} "
+              f"(was the run started with --telemetry-dir?)",
+              file=sys.stderr)
+        return 1
+    baseline = None
+    bpath = args.baseline
+    if bpath and os.path.isdir(bpath):
+        bpath = os.path.join(bpath, "quality-baseline.json")
+    if not bpath:
+        candidate = os.path.join(args.run_dir, "quality-baseline.json")
+        bpath = candidate if os.path.exists(candidate) else None
+    if bpath and os.path.exists(bpath):
+        with open(bpath, encoding="utf-8") as f:
+            baseline = json.load(f)
+    spans = load_spans(trace_path)
+    sys.stdout.write(build_report(prom_text, spans, baseline,
+                                  threshold=args.threshold))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
