@@ -1300,6 +1300,93 @@ def bench_end_to_end():
           wall_s=round(wall, 2), stage_s=stages, **extra)
 
 
+# --------------------------------------------------------------------------
+# 8. open-loop serving latency + p99 SLO gate
+# --------------------------------------------------------------------------
+
+SERVING_ROWS = 20_000
+SERVING_USERS = 500
+SERVING_SONGS = 200
+SERVING_REQUESTS = 400
+SERVING_TARGET_QPS = 100.0
+
+
+def bench_serving_slo():
+    """Open-loop serving bench (tools/bench_serving.py machinery): train a
+    tiny GAME model, serve it in-process, fire a fixed-schedule load at
+    ``SERVING_TARGET_QPS``, and report latency-CORRECTED percentiles (the
+    closed-loop client's numbers hide coordinated omission — ROADMAP
+    "Tail-latency push"). The metric is achieved requests/s;
+    ``vs_baseline`` is the p99 SLO headroom (SLO / corrected p99, >1 =
+    inside SLO), and the ``slo_verdict`` extra carries the
+    ``tools/bench_gate.py`` ok/regression verdict on that headroom.
+    ``PHOTON_SERVING_SLO_P99_MS`` overrides the SLO (default 250 ms —
+    sized for this box's CPU-serving tail under 100 QPS, not a production
+    claim)."""
+    import argparse
+    import tempfile
+
+    from photon_ml_tpu.cli import serve_game as serve_game_cli
+    from photon_ml_tpu.cli import train_game as train_game_cli
+
+    bench_serving = _tools_module("bench_serving")
+    slo_ms = float(os.environ.get("PHOTON_SERVING_SLO_P99_MS", 250.0))
+    train = _cached_fixture("serving", _write_e2e_file, SERVING_ROWS,
+                            SERVING_USERS, SERVING_SONGS)
+    shards = "global=g|intercept,item=it|noIntercept"
+    with tempfile.TemporaryDirectory() as tmp:
+        out = os.path.join(tmp, "model")
+        train_game_cli.run([
+            "--training-data", train,
+            "--output-dir", out,
+            "--feature-shards", shards,
+            "--coordinates",
+            "global=fixed,shard=global,reg=L2,maxIter=25",
+            ("perUser=random,entity=userId,shard=item,reg=L2,maxIter=25,"
+             "buckets=histogram,maxSampleBuckets=4"),
+            "--update-sequence", "global,perUser",
+            "--grid", "global=0.001", "perUser=1",
+            "--data-validation", "VALIDATE_DISABLED",
+            "--evaluators", "",
+        ])
+        _heartbeat()
+        server = serve_game_cli.build_server([
+            "--model-dir", out, "--feature-shards", shards,
+            "--port", "0", "--max-wait-ms", "1",
+        ]).start()
+        try:
+            pool = bench_serving._request_pool(
+                argparse.Namespace(data=None, pool=128), server)
+            metrics0 = bench_serving._scrape_metrics(server.url)
+            run = bench_serving.open_loop_run(
+                server.url, pool, [1, 1, 1, 2, 4],
+                target_qps=SERVING_TARGET_QPS, requests=SERVING_REQUESTS,
+                concurrency=16)
+            metrics1 = bench_serving._scrape_metrics(server.url)
+        finally:
+            server.stop()
+    corrected_p99 = bench_serving._percentile(run["corrected_ms"], 99)
+    verdict = bench_serving.slo_gate_verdict(corrected_p99, slo_ms)
+    extras = {
+        "corrected_p50_ms": round(
+            bench_serving._percentile(run["corrected_ms"], 50), 3),
+        "corrected_p99_ms": round(corrected_p99, 3),
+        "uncorrected_p99_ms": round(
+            bench_serving._percentile(run["uncorrected_ms"], 99), 3),
+        "target_qps": SERVING_TARGET_QPS,
+        "slo_p99_ms": slo_ms,
+        "slo_verdict": verdict["verdict"],
+        "n_errors": len(run["errors"]),
+    }
+    if metrics1 is not None:
+        stages = bench_serving.stage_breakdown(metrics0, metrics1)
+        if stages:
+            extras["stage_ms"] = {k: v["p50_ms"] for k, v in stages.items()}
+    _emit("serving_open_loop_qps", run["achieved_qps"],
+          "req/s (open loop, latency-corrected percentiles)",
+          verdict["headroom"], **extras)
+
+
 REFRESH_ROWS = 200_000
 REFRESH_USERS = 4_000
 REFRESH_SONGS = 2_000
@@ -1374,7 +1461,7 @@ def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--only",
                    choices=["glm", "re", "re_sweep", "cd", "ingest", "e2e",
-                            "refresh"],
+                            "refresh", "serving"],
                    help="run a single benchmark instead of the full suite")
     args = p.parse_args(argv)
     _setup_compile_cache()
@@ -1400,7 +1487,8 @@ def main(argv=None):
             {"glm": bench_glm, "re": bench_random_effect,
              "re_sweep": bench_re_sweep, "cd": bench_cd_sweep,
              "ingest": bench_ingest, "e2e": bench_end_to_end,
-             "refresh": bench_refresh}[args.only]()
+             "refresh": bench_refresh,
+             "serving": bench_serving_slo}[args.only]()
         finally:
             _emit_summary()
         return
@@ -1438,6 +1526,8 @@ def main(argv=None):
         bench_refresh()
         drain()
         bench_ingest()
+        drain()
+        bench_serving_slo()
         drain()
         bench_re_sweep()
         drain()

@@ -63,6 +63,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "never disturb the active version")
     p.add_argument("--watch-poll-s", type=float, default=10.0,
                    help="poll interval for --watch-dir (seconds)")
+    p.add_argument("--reqlog-dir", metavar="DIR", default=None,
+                   help="enable the durable request/score log: sampled "
+                        "requests land in rotated Avro segments under DIR "
+                        "(request id, entity ids, scores, model lineage, "
+                        "stage timings — serving/reqlog.py), written off "
+                        "the request path on a background writer pool. "
+                        "tools/reqlog_replay.py re-scores the log "
+                        "bit-identically against the named lineage. "
+                        "Default: no request log")
+    p.add_argument("--reqlog-sample", type=float, default=1.0,
+                   help="request-log sampling rate in [0,1], decided "
+                        "deterministically per request id (default 1.0 = "
+                        "log everything that fits the budget)")
+    p.add_argument("--reqlog-segment-records", type=int, default=256,
+                   help="requests per log segment file (smaller = fresher "
+                        "on disk, more files)")
+    p.add_argument("--reqlog-max-mb", type=float, default=64.0,
+                   help="total on-disk request-log budget; oldest segments "
+                        "rotate out past it")
     from photon_ml_tpu.cli.config import (
         add_quality_flags,
         add_telemetry_flags,
@@ -120,8 +139,16 @@ def build_server(argv: Optional[Sequence[str]] = None):
         batcher = MicroBatcher(
             lambda records: registry.active().score(records),
             max_batch=args.microbatch, max_wait_ms=args.max_wait_ms)
+    reqlog = None
+    if args.reqlog_dir:
+        from photon_ml_tpu.serving import RequestLog
+
+        reqlog = RequestLog(
+            args.reqlog_dir, sample_rate=args.reqlog_sample,
+            segment_records=args.reqlog_segment_records,
+            max_bytes=int(args.reqlog_max_mb * (1 << 20)))
     service = ServingService(registry, default_model_dir=args.model_dir,
-                             batcher=batcher)
+                             batcher=batcher, reqlog=reqlog)
     server = GameServer(service, host=args.host, port=args.port)
     server.telemetry = telemetry  # closed by run()'s finally
     server.watcher = None

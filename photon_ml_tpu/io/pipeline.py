@@ -326,6 +326,26 @@ class BackgroundSaver:
                            self._parts.submit(ctx.run, job))
 
     # --- completion -------------------------------------------------------
+    def collect(self) -> list:
+        """Prune completed background writes WITHOUT blocking; returns the
+        ``(label, exception)`` pairs of completed writes that failed (empty
+        when everything so far succeeded). Long-lived owners — the serving
+        request log submits writes for a process's whole lifetime — call
+        this periodically so the pending list stays bounded and write
+        errors surface as counters instead of an unbounded deferred
+        :meth:`join`. In-flight writes stay tracked for the final join."""
+        with self._lock:
+            pending = self._pending
+            done = [(label, fut) for label, fut in pending if fut.done()]
+            self._pending = [(label, fut) for label, fut in pending
+                             if not fut.done()]
+        errors = []
+        for label, fut in done:
+            exc = fut.exception()
+            if exc is not None:
+                errors.append((label, exc))
+        return errors
+
     def join(self) -> None:
         """Wait for every submitted write; the first error (in submission
         order) propagates — a failed background save must fail the run,

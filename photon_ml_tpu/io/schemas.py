@@ -75,6 +75,44 @@ SCORING_RESULT_AVRO = {
     ],
 }
 
+# Serving request/score log (serving/reqlog.py — the one sanctioned writer,
+# telemetry hygiene rule 7). One record per SERVED REQUEST: the request id
+# assigned at the HTTP layer, the model lineage that answered it, the
+# per-stage timings the front end measured, and the full scored records
+# (features + entity ids + score) so ``tools/reqlog_replay.py`` can re-score
+# the exact inputs against the named lineage and assert bit-parity.
+REQUEST_LOG_SCORED_RECORD_AVRO = {
+    "type": "record",
+    "name": "RequestLogScoredRecordAvro",
+    "namespace": NAMESPACE,
+    "fields": [
+        {"name": "features", "type": {"type": "array", "items": FEATURE_AVRO}},
+        {"name": "metadataMap",
+         "type": ["null", {"type": "map", "values": "string"}],
+         "default": None},
+        {"name": "offset", "type": ["null", "double"], "default": None},
+        # the served f32 score widened to double — exact, so replay
+        # comparison is bit-level
+        {"name": "score", "type": "double"},
+    ],
+}
+
+REQUEST_LOG_AVRO = {
+    "type": "record",
+    "name": "RequestLogAvro",
+    "namespace": NAMESPACE,
+    "fields": [
+        {"name": "requestId", "type": "string"},
+        {"name": "ts", "type": "double"},  # wall-clock timestamp (epoch s)
+        {"name": "modelVersion", "type": "long"},
+        {"name": "modelLineage", "type": ["null", "string"], "default": None},
+        {"name": "stageMs", "type": {"type": "map", "values": "double"},
+         "default": {}},
+        {"name": "records",
+         "type": {"type": "array", "items": REQUEST_LOG_SCORED_RECORD_AVRO}},
+    ],
+}
+
 FEATURE_SUMMARIZATION_RESULT_AVRO = {
     "type": "record",
     "name": "FeatureSummarizationResultAvro",

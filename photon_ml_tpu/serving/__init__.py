@@ -16,6 +16,11 @@ built here as four layers (see SERVING.md for the architecture doc):
   — microbatching queue and the stdlib JSON endpoint
   (``/score`` / ``/healthz`` / ``/reload``) behind
   ``python -m photon_ml_tpu serve_game``.
+- :mod:`~photon_ml_tpu.serving.reqlog` — durable, sampled Avro
+  request/score log with rotation and a scrape-visible byte/record
+  budget, written off the request path through the background writer
+  pool (``serve_game --reqlog-dir``; replayed bit-identically by
+  ``tools/reqlog_replay.py``).
 - :mod:`~photon_ml_tpu.serving.watcher` — registry-driven discovery:
   poll a publish directory and activate new versions (full model dirs
   or continuous-training coefficient patches — see CONTINUOUS.md)
@@ -29,7 +34,12 @@ from photon_ml_tpu.serving.engine import (  # noqa: F401
     ScoringEngine,
     next_bucket,
 )
-from photon_ml_tpu.serving.http import GameServer, ServingService  # noqa: F401
+from photon_ml_tpu.serving.http import (  # noqa: F401
+    REQUEST_ID_HEADER,
+    GameServer,
+    ServingService,
+)
+from photon_ml_tpu.serving.reqlog import RequestLog, iter_reqlog  # noqa: F401
 from photon_ml_tpu.serving.registry import (  # noqa: F401
     ModelRegistry,
     ServingModel,

@@ -11,13 +11,29 @@ drains up to ``max_batch`` requests per scoring call, waiting at most
 Swap interaction: the score function is resolved PER BATCH (the registry's
 active engine), so a hot-swap takes effect at the next batch boundary and a
 batch never mixes versions.
+
+Worker-death contract: an ordinary scoring exception fails only its batch
+(the Futures get the exception, the worker keeps draining). Anything that
+escapes that per-batch handling — a BaseException out of the score fn, a
+bug in the drain loop itself — would previously strand every enqueued
+Future forever and accept new submissions into a queue nothing drains.
+Now the dying worker fails the in-flight batch and every queued Future
+with a ``RuntimeError`` naming the cause, and later :meth:`submit` calls
+raise the same error instead of enqueueing into a dead batcher.
+
+Observability: each request's time parked in the queue lands in
+``photon_serving_stage_seconds{stage="queue_wait"}`` — one stage of the
+request-path critical path (OBSERVABILITY.md "Request path"). Enqueue
+stamps ``time.monotonic()`` (a scheduling clock; the hygiene-sanctioned
+source for cross-thread deadlines/waits) and the drain observes the delta
+into the registry histogram.
 """
 
 from __future__ import annotations
 
 import collections
 import threading
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 from typing import Callable, Optional, Sequence
 
 import numpy as np
@@ -36,6 +52,25 @@ _BATCH_SIZE = _metrics.histogram(
 _QUEUE_DEPTH = _metrics.gauge(
     "photon_serving_queue_depth", "Microbatcher queue depth")
 _metrics.mark_host_owned("photon_serving_queue_depth")
+#: per-stage request-path critical path (parse, queue_wait, batch_assemble,
+#: execute, respond) — this module owns the queue_wait stage
+_STAGE_SECONDS = _metrics.histogram(
+    "photon_serving_stage_seconds",
+    "Serving request time per request-path stage "
+    "(parse | queue_wait | batch_assemble | execute | respond)",
+    labels=("stage",))
+
+
+def _resolve(fut: Future, *, result=None, exception=None) -> None:
+    """Set a Future's outcome, tolerating cancelled futures — a submitter
+    that gave up must not take the worker (or the abort path) down."""
+    try:
+        if exception is not None:
+            fut.set_exception(exception)
+        else:
+            fut.set_result(result)
+    except InvalidStateError:
+        pass
 
 
 class MicroBatcher:
@@ -56,6 +91,11 @@ class MicroBatcher:
         self._cond = threading.Condition()
         self._queue: collections.deque = collections.deque()
         self._closed = False
+        #: the BaseException that killed the worker, None while healthy
+        self._dead: Optional[BaseException] = None
+        #: the batch the worker is scoring right now — failed alongside the
+        #: queue if the worker dies mid-score
+        self._inflight: list = []
         self.n_batches = 0
         self.n_coalesced = 0  # requests that shared a batch with others
         self._worker = threading.Thread(target=self._run, daemon=True,
@@ -63,12 +103,18 @@ class MicroBatcher:
         self._worker.start()
 
     def submit(self, record: dict) -> "Future[float]":
-        """Enqueue one record; the Future resolves to its float score."""
+        """Enqueue one record; the Future resolves to its float score.
+        Raises once the batcher is closed or its worker has died."""
+        import time
+
         fut: Future = Future()
         with self._cond:
+            if self._dead is not None:
+                raise RuntimeError(
+                    f"batcher worker died: {self._dead!r}") from self._dead
             if self._closed:
                 raise RuntimeError("batcher is closed")
-            self._queue.append((record, fut))
+            self._queue.append((record, fut, time.monotonic()))
             _QUEUE_DEPTH.set(len(self._queue))
             self._cond.notify()
         return fut
@@ -87,23 +133,77 @@ class MicroBatcher:
 
     # --- worker -----------------------------------------------------------
     def _run(self) -> None:
-        while True:
-            batch = self._next_batch()
-            if batch is None:
-                return
-            records = [r for r, _ in batch]
-            _BATCH_SIZE.observe(len(records))
-            try:
-                scores = self._score_fn(records)
-            except Exception as e:  # score failure fails THIS batch only
-                for _, fut in batch:
-                    fut.set_exception(e)
-                continue
-            self.n_batches += 1
-            if len(batch) > 1:
-                self.n_coalesced += len(batch)
-            for (_, fut), s in zip(batch, np.asarray(scores)):
-                fut.set_result(float(s))
+        try:
+            while True:
+                batch = self._next_batch()
+                if batch is None:
+                    return
+                self._process(batch)
+        except BaseException as e:
+            # the drain loop itself died (BaseException out of the score
+            # fn, a bug in the batching machinery): without this, queued
+            # Futures hang forever and submitters keep feeding a queue
+            # nothing reads
+            self._abort(e)
+            raise
+
+    def _process(self, batch: list) -> None:
+        import time
+
+        records = [r for r, _, _ in batch]
+        _BATCH_SIZE.observe(len(records))
+        now = time.monotonic()
+        wait_hist = _STAGE_SECONDS.labels(stage="queue_wait")
+        for _, _, t_enq in batch:
+            wait_hist.observe(max(now - t_enq, 0.0))
+        with self._cond:
+            self._inflight = batch
+        # NOTE: _inflight is cleared only on the resolved paths below — a
+        # BaseException escaping this method must leave it set so _abort
+        # can fail the very batch that killed the worker
+        try:
+            scores = self._score_fn(records)
+        except Exception as e:  # score failure fails THIS batch only
+            self._finish(batch, exception=e)
+            return
+        arr = np.asarray(scores)
+        if arr.shape[:1] != (len(batch),):
+            # contract violation from the score fn: fail the batch loudly
+            # instead of silently zip-truncating some Futures into an
+            # eternal hang
+            self._finish(batch, exception=RuntimeError(
+                f"score_fn returned {arr.shape[:1] or (0,)} scores "
+                f"for a batch of {len(batch)}"))
+            return
+        self.n_batches += 1
+        if len(batch) > 1:
+            self.n_coalesced += len(batch)
+        self._finish(batch, scores=arr)
+
+    def _finish(self, batch: list, *, scores=None, exception=None) -> None:
+        if exception is not None:
+            for _, fut, _ in batch:
+                _resolve(fut, exception=exception)
+        else:
+            for (_, fut, _), s in zip(batch, scores):
+                _resolve(fut, result=float(s))
+        with self._cond:
+            self._inflight = []
+
+    def _abort(self, exc: BaseException) -> None:
+        """Worker death: fail the in-flight batch and every queued Future,
+        and poison future submissions."""
+        with self._cond:
+            self._dead = exc
+            pending = list(self._inflight) + list(self._queue)
+            self._inflight = []
+            self._queue.clear()
+            _QUEUE_DEPTH.set(0)
+            self._cond.notify_all()
+        err = RuntimeError(f"batcher worker died: {exc!r}")
+        err.__cause__ = exc
+        for _, fut, _ in pending:
+            _resolve(fut, exception=err)
 
     def _next_batch(self):
         """Block for the first request, then linger ``max_wait_s`` for

@@ -49,6 +49,16 @@ _SCORE_LATENCY = _metrics.histogram(
     "photon_serving_score_latency_seconds",
     "Engine scoring time per padded batch bucket", labels=("bucket",))
 
+#: per-stage request-path critical path (same family the HTTP front end
+#: and the microbatcher feed) — the engine owns the batch_assemble stage
+#: (record → host arrays packing) and the execute stage (pad + jit
+#: dispatch + D2H across every chunk of a batch)
+_STAGE_SECONDS = _metrics.histogram(
+    "photon_serving_stage_seconds",
+    "Serving request time per request-path stage "
+    "(parse | queue_wait | batch_assemble | execute | respond)",
+    labels=("stage",))
+
 #: the fn label serving's traces count under — the SAME
 #: ``photon_compiles_total{fn}`` family the training paths use
 #: (telemetry/profiling.py), so one scrape expression covers every
@@ -211,15 +221,18 @@ class ScoringEngine:
     # --- scoring ----------------------------------------------------------
     def score(self, records: Sequence[dict]) -> np.ndarray:
         """Total GAME score per record (float32, batch-path parity)."""
-        return self.score_batch(self.pack(records))
+        with _STAGE_SECONDS.labels(stage="batch_assemble").time():
+            batch = self.pack(records)
+        return self.score_batch(batch)
 
     def score_batch(self, batch: RequestBatch) -> np.ndarray:
         out = np.empty(batch.n, np.float32)
         # batches past the largest bucket chunk — per-sample independence
         # makes the split score-invariant
-        for lo in range(0, batch.n, self.max_batch):
-            hi = min(lo + self.max_batch, batch.n)
-            out[lo:hi] = self._score_chunk(batch, lo, hi)
+        with _STAGE_SECONDS.labels(stage="execute").time():
+            for lo in range(0, batch.n, self.max_batch):
+                hi = min(lo + self.max_batch, batch.n)
+                out[lo:hi] = self._score_chunk(batch, lo, hi)
         with self._lock:
             self._n_calls += 1
             self._n_scored += batch.n

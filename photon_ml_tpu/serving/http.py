@@ -4,18 +4,33 @@ Three JSON endpoints over ``http.server`` (no web framework in the image,
 and none needed — handlers are thin marshaling around the registry/batcher):
 
 - ``POST /score``  — ``{"records": [...]}`` (or ``{"record": {...}}``) →
-  ``{"scores": [...], "version": v, "latency_ms": ...}``. Records are
-  TrainingExampleAvro-shaped dicts (``features`` list, ``metadataMap``,
-  optional ``offset``). Single records route through the microbatcher when
-  enabled; explicit batches go straight to the engine.
+  ``{"scores": [...], "version": v, "latency_ms": ..., "request_id": ...}``.
+  Records are TrainingExampleAvro-shaped dicts (``features`` list,
+  ``metadataMap``, optional ``offset``). Single records route through the
+  microbatcher when enabled; explicit batches go straight to the engine.
 - ``GET /healthz`` — liveness + the serving counters the bench asserts on
-  (active version, engine compile count, requests/scores served).
+  (active version, engine compile count, requests/scores served, canary
+  reservoir size, request-log budget).
 - ``GET /metrics`` — Prometheus text exposition of the process-global
-  telemetry registry (request latency histogram, per-bucket score
-  latency, recompile counter, active version gauge, ...).
+  telemetry registry (request latency histogram, per-stage request-path
+  histogram, per-bucket score latency, recompile counter, ...).
 - ``POST /reload`` — ``{"model_dir": "..."} `` (optional; defaults to the
   dir served at startup) → validate + hot-swap. A corrupt candidate
   returns 409 and the active version keeps serving.
+
+**Per-request observability** (OBSERVABILITY.md "Request path"): every
+request gets an id at this layer — honored from an inbound
+``X-Photon-Request-Id`` header, else generated (``uuid4`` hex; telemetry
+hygiene rule 7 confines request-id generation HERE so one request never
+carries two identities) — echoed back both as a response header and in the
+``/score`` JSON body. A ``serving.request`` span (tagged with the id) wraps
+the whole handler with ``serving.parse`` / ``serving.score`` /
+``serving.respond`` children, and every stage of the critical path lands in
+``photon_serving_stage_seconds{stage=parse|queue_wait|batch_assemble|
+execute|respond}`` (the queue/engine stages are fed by batcher.py /
+engine.py). When a :class:`~photon_ml_tpu.serving.reqlog.RequestLog` is
+attached, scored requests are sampled into the durable Avro request log
+with the id, model lineage and stage timings.
 
 Every scored request posts a ``serving_request`` event on the registry's
 :class:`~photon_ml_tpu.events.EventBus` (latency, batch size, version) —
@@ -31,12 +46,15 @@ from __future__ import annotations
 import json
 import threading
 import time
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Mapping, Optional
 
 from photon_ml_tpu.serving.batcher import MicroBatcher
 from photon_ml_tpu.serving.registry import ModelRegistry
+from photon_ml_tpu.serving.reqlog import RequestLog
 from photon_ml_tpu.telemetry import metrics as _metrics
+from photon_ml_tpu.telemetry import tracing as _tracing
 
 #: end-to-end /score handling time (pack + engine + marshaling), the
 #: server-side complement of the bench's client-observed latency
@@ -44,16 +62,35 @@ _REQUEST_LATENCY = _metrics.histogram(
     "photon_serving_request_latency_seconds",
     "End-to-end /score request handling time")
 
+#: per-stage request-path critical path — this module owns the parse and
+#: respond stages (batcher.py owns queue_wait; engine.py owns
+#: batch_assemble and execute)
+_STAGE_SECONDS = _metrics.histogram(
+    "photon_serving_stage_seconds",
+    "Serving request time per request-path stage "
+    "(parse | queue_wait | batch_assemble | execute | respond)",
+    labels=("stage",))
+
+#: the inbound/outbound request-id header
+REQUEST_ID_HEADER = "X-Photon-Request-Id"
+
+
+def new_request_id() -> str:
+    """The ONE place a serving request id is minted (hygiene rule 7)."""
+    return uuid.uuid4().hex
+
 
 class ServingService:
     """Endpoint logic, HTTP-free (testable directly; the handler is thin)."""
 
     def __init__(self, registry: ModelRegistry, *,
                  default_model_dir: Optional[str] = None,
-                 batcher: Optional[MicroBatcher] = None):
+                 batcher: Optional[MicroBatcher] = None,
+                 reqlog: Optional[RequestLog] = None):
         self.registry = registry
         self.default_model_dir = default_model_dir
         self.batcher = batcher
+        self.reqlog = reqlog
         self._lock = threading.Lock()
         self.n_requests = 0
         self.n_scored = 0
@@ -62,7 +99,16 @@ class ServingService:
         self._started_monotonic = time.monotonic()
 
     # --- endpoints --------------------------------------------------------
-    def score(self, payload: dict) -> dict:
+    def score(self, payload: dict,
+              request_id: Optional[str] = None,
+              stage_ms: Optional[Mapping[str, float]] = None) -> dict:
+        """Score one request. ``request_id`` is assigned by the HTTP layer
+        (direct embedders may omit it — one is minted here so the span and
+        the request log never carry an empty identity); ``stage_ms`` folds
+        the HTTP layer's already-measured stages (parse) into the logged
+        timings."""
+        if request_id is None:
+            request_id = new_request_id()
         if "record" in payload:
             records = [payload["record"]]
         else:
@@ -70,13 +116,16 @@ class ServingService:
         if not isinstance(records, list) or not records:
             raise ValueError("payload needs 'records': [non-empty list] "
                              "or 'record': {...}")
-        with _REQUEST_LATENCY.time() as timer:
+        with _REQUEST_LATENCY.time() as timer, \
+                _tracing.span("serving.score", request_id=request_id,
+                              batch=len(records)) as sp:
             version = self.registry.active_version
             if self.batcher is not None and len(records) == 1:
                 scores = [self.batcher.score(records[0])]
             else:
                 scores = [float(s)
                           for s in self.registry.active().score(records)]
+            sp.set(version=version)
         latency_ms = timer.seconds * 1e3
         with self._lock:
             self.n_requests += 1
@@ -84,10 +133,23 @@ class ServingService:
         # scored records feed the canary reservoir: the shadow-scoring
         # workload future /reload candidates are judged against
         self.registry.observe_requests(records)
+        if self.reqlog is not None:
+            timings = dict(stage_ms or {})
+            timings["score"] = latency_ms
+            self.reqlog.log(request_id=request_id, records=records,
+                            scores=scores, version=version,
+                            lineage=self._active_lineage(),
+                            stage_ms=timings)
         self.registry.bus.post("serving_request", batch=len(records),
-                               latency_ms=latency_ms, version=version)
+                               latency_ms=latency_ms, version=version,
+                               request_id=request_id)
         return {"scores": scores, "version": version,
-                "latency_ms": round(latency_ms, 3)}
+                "latency_ms": round(latency_ms, 3),
+                "request_id": request_id}
+
+    def _active_lineage(self) -> Optional[str]:
+        active = self.registry.active_or_none()
+        return None if active is None else active.lineage
 
     def healthz(self) -> dict:
         active = self.registry.active_or_none()
@@ -108,8 +170,13 @@ class ServingService:
                          else active.engine.compile_count),
             "requests": self.n_requests,
             "scored": self.n_scored,
+            # the canary's shadow-scoring workload size — how much live
+            # traffic the next /reload candidate will be judged against
+            "reservoir": len(self.registry.reservoir),
             "uptime_s": round(time.monotonic() - self._started_monotonic, 1),
         }
+        if self.reqlog is not None:
+            out["reqlog"] = self.reqlog.stats()
         if active is not None and active.canary is not None:
             out["canary"] = active.canary
         return out
@@ -132,6 +199,8 @@ class ServingService:
     def close(self) -> None:
         if self.batcher is not None:
             self.batcher.close()
+        if self.reqlog is not None:
+            self.reqlog.close()
 
 
 def _make_handler(service: ServingService):
@@ -139,6 +208,13 @@ def _make_handler(service: ServingService):
         # per-request log lines go nowhere useful under test/bench load
         def log_message(self, fmt, *args):  # noqa: D102
             pass
+
+        def _request_id(self) -> str:
+            """Honor the inbound header; mint otherwise. Echoed on every
+            response by :meth:`_reply_raw`."""
+            inbound = self.headers.get(REQUEST_ID_HEADER)
+            self.request_id = inbound.strip() if inbound else new_request_id()
+            return self.request_id
 
         def _reply(self, status: int, body: dict) -> None:
             self._reply_raw(status, json.dumps(body).encode(),
@@ -149,6 +225,9 @@ def _make_handler(service: ServingService):
             self.send_response(status)
             self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(data)))
+            rid = getattr(self, "request_id", None)
+            if rid is not None:
+                self.send_header(REQUEST_ID_HEADER, rid)
             self.end_headers()
             self.wfile.write(data)
 
@@ -159,6 +238,7 @@ def _make_handler(service: ServingService):
             return json.loads(self.rfile.read(length) or b"{}")
 
         def do_GET(self):  # noqa: N802
+            self._request_id()
             if self.path == "/healthz":
                 self._reply(200, service.healthz())
             elif self.path == "/metrics":
@@ -172,18 +252,35 @@ def _make_handler(service: ServingService):
                 self._reply(404, {"error": f"unknown path {self.path}"})
 
         def do_POST(self):  # noqa: N802
-            try:
-                payload = self._payload()
-            except (ValueError, json.JSONDecodeError) as e:
-                self._reply(400, {"error": f"bad JSON: {e}"})
+            rid = self._request_id()
+            with _tracing.span("serving.request", request_id=rid,
+                               path=self.path):
+                self._post_traced(rid)
+
+        def _post_traced(self, rid: str) -> None:
+            with _tracing.span("serving.parse", request_id=rid), \
+                    _STAGE_SECONDS.labels(stage="parse").time() as parse_t:
+                try:
+                    payload = self._payload()
+                    parse_error = None
+                except (ValueError, json.JSONDecodeError) as e:
+                    parse_error = e
+            if parse_error is not None:
+                self._reply(400, {"error": f"bad JSON: {parse_error}"})
                 return
             if self.path == "/score":
                 try:
-                    self._reply(200, service.score(payload))
+                    out = service.score(
+                        payload, request_id=rid,
+                        stage_ms={"parse": parse_t.seconds * 1e3})
+                    status = 200
                 except ValueError as e:
-                    self._reply(400, {"error": str(e)})
+                    out, status = {"error": str(e)}, 400
                 except Exception as e:
-                    self._reply(500, {"error": repr(e)})
+                    out, status = {"error": repr(e)}, 500
+                with _tracing.span("serving.respond", request_id=rid), \
+                        _STAGE_SECONDS.labels(stage="respond").time():
+                    self._reply(status, out)
             elif self.path == "/reload":
                 try:
                     self._reply(200, service.reload(payload))

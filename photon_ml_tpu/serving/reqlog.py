@@ -1,0 +1,296 @@
+"""Durable request/score log: bounded, sampled Avro segments per request.
+
+The :class:`~photon_ml_tpu.quality.canary.RequestReservoir` keeps a small
+in-memory sample of live traffic for activation-time shadow scoring; the
+feedback-join loop (ROADMAP "Close the loop") needs the on-disk
+generalization — *what* was served, by *which* model content, and where
+each millisecond went. This module is that log:
+
+- one Avro record per served request (``RequestLogAvro``,
+  :mod:`photon_ml_tpu.io.schemas`): request id, wall timestamp, model
+  version + content lineage, the front end's per-stage timings, and the
+  full scored records (features, entity ids, offset, f32 score widened to
+  double — exact). ``tools/reqlog_replay.py`` re-scores the logged inputs
+  against the named lineage and asserts bit-parity;
+- **sampled** deterministically by request id (``crc32(id)`` against
+  ``sample_rate`` — the same request either logs on every host or on
+  none, so a fleet's logs join without duplicate-rate skew);
+- **segmented + rotated**: records buffer in memory and flush as complete
+  Avro container files (``reqlog-NNNNNNNN.avro``) every
+  ``segment_records`` requests; ``max_bytes`` bounds the directory by
+  deleting the oldest segments (retention, counted separately from loss);
+- **off the request path**: segment writes run on a
+  :class:`~photon_ml_tpu.io.pipeline.BackgroundSaver` pool under
+  ``io.save.reqlog`` spans; the log path never blocks scoring. If the
+  writer falls behind the ``max_buffered`` budget, new requests are
+  DROPPED and counted — backpressure degrades the log, never the traffic;
+- budget metrics: ``photon_reqlog_records_total`` /
+  ``photon_reqlog_bytes_total`` / ``photon_reqlog_dropped_total``
+  (dropped = buffer-budget or write-error losses; sampling is not a
+  drop), all scrape-visible and mirrored into ``/healthz``.
+
+Telemetry hygiene rule 7 makes this module the ONE place that writes
+``RequestLogAvro`` files (``tools/check_telemetry_hygiene.py``): a second
+writer would fork the log format away from the replay tool and the
+feedback joiner.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import zlib
+from typing import Mapping, Optional, Sequence
+
+from photon_ml_tpu.io.avro import write_avro_file
+from photon_ml_tpu.io.pipeline import BackgroundSaver
+from photon_ml_tpu.io.schemas import REQUEST_LOG_AVRO
+from photon_ml_tpu.telemetry import metrics as _metrics
+
+_RECORDS_TOTAL = _metrics.counter(
+    "photon_reqlog_records_total",
+    "Request-log records durably written (post-sampling)")
+_BYTES_TOTAL = _metrics.counter(
+    "photon_reqlog_bytes_total",
+    "Bytes of request-log Avro segments written")
+_DROPPED_TOTAL = _metrics.counter(
+    "photon_reqlog_dropped_total",
+    "Request-log records LOST after sampling selected them: writer "
+    "backpressure past the buffer budget, or failed segment writes")
+
+#: sampling hash granularity: crc32(request id) % _SAMPLE_MOD < rate * MOD
+_SAMPLE_MOD = 1 << 16
+
+
+class RequestLog:
+    """Bounded, sampled, background-written Avro request/score log.
+
+    Thread-safe. ``saver=None`` builds a private single-writer
+    :class:`BackgroundSaver` (closed with the log); passing the server's
+    shared pool is also fine — segment writes are tracked and pruned via
+    :meth:`BackgroundSaver.collect`, so a process-lifetime log never grows
+    the pool's pending list unboundedly.
+    """
+
+    def __init__(self, log_dir: str, *, sample_rate: float = 1.0,
+                 segment_records: int = 256,
+                 max_bytes: int = 64 << 20,
+                 max_buffered: Optional[int] = None,
+                 saver: Optional[BackgroundSaver] = None):
+        if not 0.0 <= sample_rate <= 1.0:
+            raise ValueError(
+                f"sample_rate must be in [0, 1], got {sample_rate}")
+        if segment_records < 1:
+            raise ValueError(
+                f"segment_records must be >= 1, got {segment_records}")
+        self.log_dir = os.path.abspath(log_dir)
+        os.makedirs(self.log_dir, exist_ok=True)
+        self.sample_rate = float(sample_rate)
+        self.segment_records = int(segment_records)
+        self.max_bytes = int(max_bytes)
+        #: backpressure budget: records allowed in not-yet-durable buffers
+        #: (the in-memory buffer plus submitted-but-unfinished segments)
+        self.max_buffered = (8 * self.segment_records
+                             if max_buffered is None else int(max_buffered))
+        self._saver = saver if saver is not None else BackgroundSaver(
+            part_workers=1, save_workers=1)
+        self._own_saver = saver is None
+        self._lock = threading.Lock()
+        self._buffer: list[dict] = []
+        self._in_flight = 0  # records submitted, not yet confirmed written
+        self._seq = 0
+        #: [(path, records, bytes)] of live segments, oldest first —
+        #: what rotation walks (bytes filled in post-write)
+        self._segments: list[list] = []
+        self._closed = False
+        #: this log's own outstanding segment futures (pruned as they
+        #: complete; a shared pool's other writes are never touched)
+        self._futures: list = []
+        self.n_records = 0
+        self.n_bytes = 0
+        self.n_dropped = 0
+        self.n_rotated = 0
+
+    # --- sampling ---------------------------------------------------------
+    def should_log(self, request_id: str) -> bool:
+        """Deterministic per-id sampling decision (same id → same verdict
+        on every host and every retry)."""
+        if self.sample_rate >= 1.0:
+            return True
+        if self.sample_rate <= 0.0:
+            return False
+        h = zlib.crc32(str(request_id).encode("utf-8")) % _SAMPLE_MOD
+        return h < int(self.sample_rate * _SAMPLE_MOD)
+
+    # --- logging ----------------------------------------------------------
+    def log(self, *, request_id: str, records: Sequence[dict],
+            scores: Sequence[float], version: int,
+            lineage: Optional[str] = None,
+            stage_ms: Optional[Mapping[str, float]] = None) -> bool:
+        """Append one served request (post-sampling; callers may skip the
+        call entirely when :meth:`should_log` says no). Returns True when
+        the request was accepted into the log, False when sampled out or
+        dropped on backpressure."""
+        if not self.should_log(request_id):
+            return False
+        entry = {
+            "requestId": str(request_id),
+            "ts": time.time(),
+            "modelVersion": int(version if version is not None else -1),
+            "modelLineage": lineage,
+            "stageMs": {k: float(v) for k, v in (stage_ms or {}).items()},
+            "records": [{
+                "features": [{"name": f.get("name", ""),
+                              "term": f.get("term") or "",
+                              "value": float(f.get("value", 0.0))}
+                             for f in (rec.get("features") or [])],
+                "metadataMap": rec.get("metadataMap"),
+                "offset": (None if rec.get("offset") is None
+                           else float(rec["offset"])),
+                "score": float(s),
+            } for rec, s in zip(records, scores)],
+        }
+        flush_batch = None
+        with self._lock:
+            if self._closed:
+                return False
+            if len(self._buffer) + self._in_flight >= self.max_buffered:
+                # the writer is behind budget: shed the LOG record, never
+                # the request — and make the loss scrape-visible
+                self.n_dropped += 1
+                _DROPPED_TOTAL.inc()
+                return False
+            self._buffer.append(entry)
+            if len(self._buffer) >= self.segment_records:
+                flush_batch = self._take_buffer_locked()
+        if flush_batch is not None:
+            self._submit_segment(flush_batch)
+        return True
+
+    def flush(self) -> None:
+        """Submit whatever is buffered as a (possibly short) segment."""
+        with self._lock:
+            batch = self._take_buffer_locked()
+        if batch is not None:
+            self._submit_segment(batch)
+
+    # --- segment machinery ------------------------------------------------
+    def _take_buffer_locked(self):
+        if not self._buffer:
+            return None
+        batch, self._buffer = self._buffer, []
+        self._seq += 1
+        self._in_flight += len(batch)
+        return (self._seq, batch)
+
+    def _submit_segment(self, seq_batch) -> None:
+        seq, batch = seq_batch
+        path = os.path.join(self.log_dir, f"reqlog-{seq:08d}.avro")
+
+        def write() -> None:
+            import logging
+
+            tmp = path + ".tmp"
+            try:
+                write_avro_file(tmp, batch, REQUEST_LOG_AVRO)
+                os.replace(tmp, path)
+            except Exception as e:
+                # a failed segment is LOSS, surfaced through the budget
+                # counter — the log must never fail serving or shutdown
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+                with self._lock:
+                    self._in_flight -= len(batch)
+                    self.n_dropped += len(batch)
+                _DROPPED_TOTAL.inc(len(batch))
+                logging.getLogger(__name__).error(
+                    "reqlog segment write %s failed: %r", path, e)
+                return
+            size = os.path.getsize(path)
+            with self._lock:
+                self._in_flight -= len(batch)
+                self._segments.append([path, len(batch), size])
+                self.n_records += len(batch)
+                self.n_bytes += size
+            _RECORDS_TOTAL.inc(len(batch))
+            _BYTES_TOTAL.inc(size)
+            self._rotate()
+
+        fut = self._saver.submit(write, label="io.save.reqlog", path=path)
+        with self._lock:
+            self._futures = [f for f in self._futures if not f.done()]
+            self._futures.append(fut)
+        if self._own_saver:
+            # keep the private pool's pending list bounded for the life of
+            # the process (a shared pool's owner does its own join, which
+            # collect() must not pre-empt — it would swallow their errors)
+            self._saver.collect()
+
+    def _rotate(self) -> None:
+        """Retention: delete oldest segments while the directory exceeds
+        ``max_bytes``. Rotated-out records are retention, not loss — they
+        were durably written (and counted) first."""
+        while True:
+            with self._lock:
+                total = sum(s[2] for s in self._segments)
+                if total <= self.max_bytes or len(self._segments) <= 1:
+                    return
+                path, n, _size = self._segments.pop(0)
+                self.n_rotated += n
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+    # --- introspection ----------------------------------------------------
+    def stats(self) -> dict:
+        """The ``/healthz`` payload: budget counters + config."""
+        with self._lock:
+            return {
+                "dir": self.log_dir,
+                "sample_rate": self.sample_rate,
+                "records": self.n_records,
+                "bytes": self.n_bytes,
+                "dropped": self.n_dropped,
+                "rotated": self.n_rotated,
+                "buffered": len(self._buffer) + self._in_flight,
+                "segments": len(self._segments),
+            }
+
+    def segment_paths(self) -> list[str]:
+        with self._lock:
+            return [s[0] for s in self._segments]
+
+    def close(self) -> None:
+        """Flush the tail segment and wait for this log's writes. Write
+        errors land in the dropped counter inside the write jobs (the log
+        must never fail the server's shutdown path)."""
+        with self._lock:
+            if self._closed:
+                return
+        self.flush()
+        with self._lock:
+            self._closed = True
+            futures, self._futures = self._futures, []
+        for fut in futures:
+            try:
+                fut.result()
+            except Exception:
+                pass  # already counted as dropped by the write job
+        if self._own_saver:
+            self._saver.collect()
+            self._saver.close()
+
+
+def iter_reqlog(log_dir: str):
+    """Yield every logged request record across a directory's segments,
+    oldest segment first (the replay tool's and feedback joiner's read
+    path; resilient to a concurrent writer — half-written ``.tmp`` staging
+    files are invisible by construction)."""
+    from photon_ml_tpu.io.avro import iter_avro_file
+
+    for name in sorted(os.listdir(log_dir)):
+        if not (name.startswith("reqlog-") and name.endswith(".avro")):
+            continue
+        yield from iter_avro_file(os.path.join(log_dir, name))

@@ -1,0 +1,159 @@
+"""Unit tests for the durable serving request log (serving/reqlog.py).
+
+Model-free contracts: deterministic sampling, segment rotation under the
+byte budget, backpressure drops (counted, never blocking), background
+writes through the BackgroundSaver pool (collect() pruning), and the Avro
+round trip. The model-coupled contracts — bit-identical replay, request-id
+propagation — live in tests/test_serving.py next to the serving fixture.
+"""
+
+import os
+
+import pytest
+
+from photon_ml_tpu.io.pipeline import BackgroundSaver
+from photon_ml_tpu.serving.reqlog import RequestLog, iter_reqlog
+
+
+def _one_record(i: int) -> dict:
+    return {"features": [{"name": "f.x", "term": "", "value": float(i)}],
+            "metadataMap": {"userId": f"u{i}"}, "offset": None}
+
+
+def _log_n(rl: RequestLog, n: int, *, prefix: str = "r") -> int:
+    accepted = 0
+    for i in range(n):
+        accepted += int(rl.log(request_id=f"{prefix}{i}",
+                               records=[_one_record(i)], scores=[float(i)],
+                               version=1, lineage="lin",
+                               stage_ms={"parse": 0.1}))
+    return accepted
+
+
+class TestSampling:
+    def test_rate_one_logs_everything(self, tmp_path):
+        rl = RequestLog(str(tmp_path), segment_records=4)
+        assert _log_n(rl, 10) == 10
+        rl.close()
+        assert rl.stats()["records"] == 10
+
+    def test_rate_zero_logs_nothing(self, tmp_path):
+        rl = RequestLog(str(tmp_path), sample_rate=0.0)
+        assert _log_n(rl, 10) == 0
+        rl.close()
+        assert rl.stats()["records"] == 0
+        assert rl.stats()["dropped"] == 0  # sampling is not loss
+
+    def test_sampling_is_deterministic_per_id(self, tmp_path):
+        rl1 = RequestLog(str(tmp_path / "a"), sample_rate=0.5)
+        rl2 = RequestLog(str(tmp_path / "b"), sample_rate=0.5)
+        ids = [f"req-{i}" for i in range(2000)]
+        picks1 = [rl1.should_log(i) for i in ids]
+        picks2 = [rl2.should_log(i) for i in ids]
+        # same id → same verdict on every host (fleet logs join cleanly)
+        assert picks1 == picks2
+        frac = sum(picks1) / len(picks1)
+        assert 0.40 < frac < 0.60, frac
+        rl1.close()
+        rl2.close()
+
+    def test_invalid_config_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="sample_rate"):
+            RequestLog(str(tmp_path), sample_rate=1.5)
+        with pytest.raises(ValueError, match="segment_records"):
+            RequestLog(str(tmp_path), segment_records=0)
+
+
+class TestSegments:
+    def test_segment_files_and_round_trip(self, tmp_path):
+        rl = RequestLog(str(tmp_path), segment_records=3)
+        _log_n(rl, 7)
+        rl.close()
+        files = sorted(f for f in os.listdir(tmp_path)
+                       if f.endswith(".avro"))
+        assert files == ["reqlog-00000001.avro", "reqlog-00000002.avro",
+                         "reqlog-00000003.avro"]
+        entries = list(iter_reqlog(str(tmp_path)))
+        assert [e["requestId"] for e in entries] == [f"r{i}"
+                                                    for i in range(7)]
+        e = entries[3]
+        assert e["records"][0]["score"] == 3.0
+        assert e["records"][0]["metadataMap"] == {"userId": "u3"}
+        assert e["modelLineage"] == "lin"
+        assert e["modelVersion"] == 1
+        assert e["stageMs"] == {"parse": 0.1}
+        assert e["ts"] > 0
+
+    def test_rotation_bounds_disk(self, tmp_path):
+        rl = RequestLog(str(tmp_path), segment_records=2, max_bytes=1200,
+                        max_buffered=100)
+        _log_n(rl, 20)
+        rl.close()
+        stats = rl.stats()
+        # everything was durably written first (rotation is retention,
+        # not loss)...
+        assert stats["records"] == 20
+        assert stats["dropped"] == 0
+        assert stats["rotated"] > 0
+        # ...and the directory is bounded by the budget
+        total = sum(os.path.getsize(os.path.join(tmp_path, f))
+                    for f in os.listdir(tmp_path))
+        assert total <= 1200 + 1024  # one segment of slack past the bound
+        # the survivors are the NEWEST segments
+        entries = list(iter_reqlog(str(tmp_path)))
+        assert entries[-1]["requestId"] == "r19"
+
+    def test_backpressure_drops_and_counts(self, tmp_path):
+        # segment threshold never reached → the buffer can only drain at
+        # close; the budget caps it and the overflow counts as dropped
+        rl = RequestLog(str(tmp_path), segment_records=100, max_buffered=3)
+        accepted = _log_n(rl, 10)
+        assert accepted == 3
+        assert rl.stats()["dropped"] == 7
+        assert rl.stats()["buffered"] == 3
+        rl.close()
+        assert rl.stats()["records"] == 3
+        assert len(list(iter_reqlog(str(tmp_path)))) == 3
+
+    def test_closed_log_refuses_quietly(self, tmp_path):
+        rl = RequestLog(str(tmp_path))
+        rl.close()
+        assert rl.log(request_id="x", records=[_one_record(0)],
+                      scores=[0.0], version=1) is False
+        rl.close()  # idempotent
+
+    def test_shared_saver_pool(self, tmp_path):
+        """A shared BackgroundSaver pool works and is NOT closed (or
+        error-drained) by the log — the owner keeps join semantics."""
+        saver = BackgroundSaver(part_workers=1, save_workers=1)
+        try:
+            rl = RequestLog(str(tmp_path), segment_records=2, saver=saver)
+            _log_n(rl, 5)
+            rl.close()
+            assert rl.stats()["records"] == 5
+            saver.join()  # no reqlog errors leaked into the pool
+        finally:
+            saver.close()
+
+
+class TestBackgroundSaverCollect:
+    def test_collect_prunes_and_reports_errors(self, tmp_path):
+        saver = BackgroundSaver(part_workers=1, save_workers=1)
+        try:
+            ok = saver.submit(lambda: None, label="io.save.ok")
+            bad = saver.submit(
+                lambda: (_ for _ in ()).throw(RuntimeError("disk full")),
+                label="io.save.bad")
+            for fut in (ok, bad):
+                try:
+                    fut.result(timeout=30)
+                except RuntimeError:
+                    pass
+            errors = saver.collect()
+            assert [label for label, _ in errors] == ["io.save.bad"]
+            assert isinstance(errors[0][1], RuntimeError)
+            # pruned: a later join sees nothing (no double-raise)
+            saver.join()
+            assert saver.collect() == []
+        finally:
+            saver.close()

@@ -279,6 +279,58 @@ class TestBatcher:
         finally:
             batcher.close()
 
+    @pytest.mark.filterwarnings(
+        "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+    def test_dead_worker_fails_pending_and_future_submits(self):
+        """Regression: a BaseException out of the score fn used to kill
+        the worker thread silently — the in-flight batch's Future AND
+        every queued Future hung forever, and submitters kept feeding a
+        queue nothing drained. Worker death must fail them all loudly."""
+        release = threading.Event()
+
+        class WorkerKiller(BaseException):
+            pass
+
+        def lethal(rs):
+            release.wait(30)
+            raise WorkerKiller("simulated worker death")
+
+        batcher = MicroBatcher(lethal, max_batch=1, max_wait_ms=0.0)
+        f_inflight = batcher.submit({"features": []})
+        f_queued = batcher.submit({"features": []})  # behind max_batch=1
+        release.set()
+        with pytest.raises(RuntimeError, match="worker died"):
+            f_inflight.result(timeout=30)
+        with pytest.raises(RuntimeError, match="worker died"):
+            f_queued.result(timeout=30)
+        # the worker is gone: submitting must refuse, not hang
+        with pytest.raises(RuntimeError, match="worker died"):
+            batcher.submit({"features": []})
+
+    def test_short_score_vector_fails_batch_not_worker(self):
+        """A score fn returning the wrong number of scores used to
+        zip-truncate: surplus Futures never resolved. Now the whole batch
+        fails loudly and the worker lives on."""
+        calls = [0]
+
+        def miscounting(rs):
+            calls[0] += 1
+            if calls[0] == 1:
+                return np.zeros(len(rs) - 1, np.float32)  # one short
+            return np.zeros(len(rs), np.float32)
+
+        batcher = MicroBatcher(miscounting, max_batch=4, max_wait_ms=50.0)
+        try:
+            f1 = batcher.submit({"features": []})
+            f2 = batcher.submit({"features": []})
+            for f in (f1, f2):
+                with pytest.raises(RuntimeError, match="scores"):
+                    f.result(timeout=30)
+            # the worker survived the contract violation
+            assert batcher.submit({"features": []}).result(timeout=30) == 0.0
+        finally:
+            batcher.close()
+
 
 class TestQuantizedTables:
     """--table-dtype score-parity gates (ISSUE 9): f32 stays bit-identical
@@ -436,6 +488,68 @@ class TestQuantizedTables:
         assert not got[-2:].any()
 
 
+class TestReqlogReplay:
+    """tools/reqlog_replay.py: the request log is self-verifying — logged
+    scores replay bit-identically through the named lineage; a tampered
+    log (or a wrong model) is caught."""
+
+    def _tool(self):
+        import sys
+
+        tools = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools")
+        if tools not in sys.path:
+            sys.path.insert(0, tools)
+        import reqlog_replay
+
+        return reqlog_replay
+
+    def _make_log(self, trained, logdir):
+        from photon_ml_tpu.serving import RequestLog, ServingService
+
+        registry = ModelRegistry(SHARD_CONFIGS)
+        registry.load(trained["v1"])
+        reqlog = RequestLog(logdir, segment_records=4)
+        service = ServingService(registry, reqlog=reqlog)
+        for i in range(0, 12, 3):
+            service.score({"records": trained["requests"][i:i + 3]})
+        service.close()
+
+    def test_replay_bit_identical(self, trained, tmp_path):
+        logdir = str(tmp_path / "logs")
+        self._make_log(trained, logdir)
+        rc = self._tool().main([
+            "--reqlog-dir", logdir, "--model-dir", trained["v1"],
+            "--feature-shards", SHARDS])
+        assert rc == 0
+
+    def test_replay_detects_tampered_score(self, trained, tmp_path):
+        from photon_ml_tpu.io.avro import iter_avro_file, write_avro_file
+        from photon_ml_tpu.io.schemas import REQUEST_LOG_AVRO
+
+        logdir = str(tmp_path / "logs")
+        self._make_log(trained, logdir)
+        seg = os.path.join(logdir, sorted(os.listdir(logdir))[0])
+        entries = list(iter_avro_file(seg))
+        entries[0]["records"][0]["score"] += 1.0
+        write_avro_file(seg, entries, REQUEST_LOG_AVRO)
+        rc = self._tool().main([
+            "--reqlog-dir", logdir, "--model-dir", trained["v1"],
+            "--feature-shards", SHARDS])
+        assert rc == 1
+
+    def test_replay_skips_foreign_lineage(self, trained, tmp_path):
+        """A log written under v1's lineage replayed against v2: every
+        request is lineage-skipped — no false mismatches, and 'nothing
+        replayable' is its own exit code."""
+        logdir = str(tmp_path / "logs")
+        self._make_log(trained, logdir)
+        rc = self._tool().main([
+            "--reqlog-dir", logdir, "--model-dir", trained["v2"],
+            "--feature-shards", SHARDS])
+        assert rc == 2
+
+
 class TestHttpEndToEnd:
     def _post(self, url, payload):
         req = urllib.request.Request(
@@ -507,6 +621,104 @@ class TestHttpEndToEnd:
             assert str(st.table.dtype) == "bfloat16"
         finally:
             server.stop()
+
+    def test_request_id_propagation_end_to_end(self, trained, tmp_path):
+        """Satellite contract: the id is honored from the inbound header
+        (or generated), present on every serving.* child span, in the
+        durable request-log record, and echoed in the response (header +
+        body) — over a live serve_game server with tracing + reqlog on."""
+        tdir = str(tmp_path / "telemetry")
+        logdir = str(tmp_path / "reqlog")
+        server = serve_game_cli.build_server([
+            "--model-dir", trained["v1"],
+            "--feature-shards", SHARDS,
+            "--port", "0", "--max-batch", "8", "--max-wait-ms", "1",
+            "--telemetry-dir", tdir,
+            "--reqlog-dir", logdir, "--reqlog-segment-records", "1",
+        ]).start()
+        try:
+            base = server.url
+            rid = "req-id-e2e-42"
+            req = urllib.request.Request(
+                base + "/score",
+                data=json.dumps(
+                    {"records": trained["requests"][:2]}).encode(),
+                headers={"Content-Type": "application/json",
+                         "X-Photon-Request-Id": rid})
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                # echoed as a response header...
+                assert resp.headers["X-Photon-Request-Id"] == rid
+                out = json.loads(resp.read())
+            # ...and in the body
+            assert out["request_id"] == rid
+            # absent header → a fresh id is generated and echoed
+            out2 = self._post(base + "/score",
+                              {"record": trained["requests"][0]})
+            assert out2["request_id"] and out2["request_id"] != rid
+            # /healthz surfaces the reqlog budget and the canary
+            # reservoir size (hygiene satellite)
+            health = self._get(base + "/healthz")
+            assert health["reservoir"] >= 3
+            assert health["reqlog"]["sample_rate"] == 1.0
+            assert health["reqlog"]["dropped"] == 0
+        finally:
+            server.stop()
+            server.telemetry.close()
+        # every serving.* span of the request carries the id, nested
+        # under the one serving.request root
+        with open(os.path.join(tdir, "trace.jsonl")) as f:
+            spans = [json.loads(line) for line in f
+                     if line.strip() and json.loads(line).get("span_id")]
+        mine = [s for s in spans if s.get("request_id") == rid]
+        names = {s["name"] for s in mine}
+        assert {"serving.request", "serving.parse", "serving.score",
+                "serving.respond"} <= names, names
+        root = next(s for s in mine if s["name"] == "serving.request")
+        for s in mine:
+            if s["name"] != "serving.request":
+                assert s["parent_id"] == root["span_id"], s
+        # the durable request log holds the id, the lineage, and the
+        # exact served scores
+        from photon_ml_tpu.serving import iter_reqlog
+
+        entries = {e["requestId"]: e for e in iter_reqlog(logdir)}
+        assert rid in entries and out2["request_id"] in entries
+        entry = entries[rid]
+        assert [r["score"] for r in entry["records"]] == out["scores"]
+        assert entry["modelVersion"] == 1
+        assert entry["modelLineage"]
+        assert "parse" in entry["stageMs"] and "score" in entry["stageMs"]
+
+    def test_parity_and_zero_recompiles_with_observability_on(
+            self, trained, tmp_path):
+        """Acceptance gate: with tracing AND the request log enabled, the
+        jitted score path keeps f32 bit-parity and the zero-recompile
+        contract — observability must never perturb the numbers."""
+        from photon_ml_tpu.serving import RequestLog, ServingService
+        from photon_ml_tpu.telemetry import tracing
+
+        plain = ModelRegistry(SHARD_CONFIGS, max_batch=16)
+        base_scores = plain.load(trained["v1"]).score(trained["requests"])
+
+        tracing.configure(str(tmp_path / "trace.jsonl"))
+        try:
+            reqlog = RequestLog(str(tmp_path / "reqlog"),
+                                segment_records=8)
+            registry = ModelRegistry(SHARD_CONFIGS, max_batch=16)
+            sm = registry.load(trained["v1"])
+            sm.engine.warmup()
+            frozen = sm.engine.compile_count
+            service = ServingService(registry, reqlog=reqlog)
+            out = service.score({"records": trained["requests"]})
+            assert np.array_equal(
+                np.asarray(out["scores"], np.float32), base_scores)
+            for size in (1, 3, 5, 9, 16):
+                service.score({"records": trained["requests"][:size]})
+            assert sm.engine.compile_count == frozen
+            service.close()
+            assert reqlog.stats()["records"] == 6
+        finally:
+            tracing.close()
 
     def test_serving_request_events_on_bus(self, trained):
         from photon_ml_tpu.events import EventBus

@@ -681,6 +681,56 @@ class TestServeGameMetricsEndpoint:
             server.telemetry.close()
 
 
+class TestServingStageHistograms:
+    _get = TestServeGameMetricsEndpoint._get
+    _post = TestServeGameMetricsEndpoint._post
+
+    def test_every_stage_lands_and_perf_report_renders_section(
+            self, telemetry_run):
+        """The request-path critical path: one live request populates all
+        five photon_serving_stage_seconds stages (parse and respond from
+        the HTTP layer, queue_wait from the microbatcher, batch_assemble
+        and execute from the engine), and perf_report renders the serving
+        section from the scrape alone."""
+        from photon_ml_tpu.cli import serve_game as serve_game_cli
+
+        server = serve_game_cli.build_server([
+            "--model-dir", telemetry_run["model_dir"],
+            "--feature-shards", SHARDS,
+            "--port", "0", "--max-batch", "8", "--max-wait-ms", "1",
+        ]).start()
+        try:
+            base = server.url
+            recs = _records(4, seed=31)
+            # a single record rides the microbatcher (queue_wait); the
+            # batch goes straight to the engine (batch_assemble/execute)
+            self._post(base + "/score", {"record": recs[0]})
+            self._post(base + "/score", {"records": recs})
+            text = self._get(base + "/metrics")
+        finally:
+            server.stop()
+            server.telemetry.close()
+        parsed = tprom.parse_text(text)
+        for stage in ("parse", "queue_wait", "batch_assemble", "execute",
+                      "respond"):
+            assert tprom.series_value(
+                parsed, "photon_serving_stage_seconds_count",
+                {"stage": stage}) >= 1, stage
+        import sys as _sys
+
+        _sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools"))
+        import perf_report
+
+        report = perf_report.build_report([], text)
+        assert "serving request path" in report
+        for stage in ("parse", "queue_wait", "batch_assemble", "execute",
+                      "respond"):
+            assert stage in report
+        assert "requests " in report  # the end-to-end histogram line
+
+
 class TestTelemetryOverheadGuard:
     def test_scores_bit_identical_and_zero_recompiles_with_tracing(
             self, telemetry_run, tmp_path):

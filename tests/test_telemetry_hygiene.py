@@ -164,3 +164,60 @@ def test_binning_math_legal_inside_quality():
            "def population_stability_index(e, a):\n    return 0.0\n")
     assert hygiene.check_source(
         src, os.path.join("photon_ml_tpu", "quality", "x.py")) == []
+
+
+@pytest.mark.parametrize("snippet, n", [
+    # request-id generation primitives outside serving/http.py (rule 7)
+    ("import uuid\nrid = uuid.uuid4().hex\n", 1),
+    ("import uuid as u\nrid = u.uuid1()\n", 1),
+    ("from uuid import uuid4\nrid = uuid4()\n", 1),
+    ("from uuid import uuid4 as mk\nrid = mk()\n", 1),
+    ("import secrets\nrid = secrets.token_hex(8)\n", 1),
+    ("from secrets import token_urlsafe\nrid = token_urlsafe()\n", 1),
+    # PARSING an id is not minting one; unrelated attrs stay legal
+    ("import uuid\nuuid.UUID('00000000-0000-0000-0000-000000000000')\n", 0),
+    ("obj.uuid4()\n", 0),
+])
+@pytest.mark.parametrize("subdir", ["serving", "game", "io"])
+def test_request_id_generation_confined(snippet, n, subdir):
+    rel = os.path.join("photon_ml_tpu", subdir, "x.py")
+    out = hygiene.check_source(snippet, rel)
+    assert len(out) == n, out
+    if n:
+        assert "request-id" in out[0]
+
+
+def test_request_id_generation_legal_in_http():
+    src = "import uuid\nrid = uuid.uuid4().hex\n"
+    assert hygiene.check_source(
+        src, os.path.join("photon_ml_tpu", "serving", "http.py")) == []
+
+
+@pytest.mark.parametrize("snippet, n", [
+    # RequestLogAvro references outside the sanctioned writer (rule 7):
+    # the from-import is one violation, each use another
+    ("from photon_ml_tpu.io.schemas import REQUEST_LOG_AVRO\n", 1),
+    ("from photon_ml_tpu.io.schemas import REQUEST_LOG_AVRO\n"
+     "write_avro_file(p, recs, REQUEST_LOG_AVRO)\n", 2),
+    ("from photon_ml_tpu.io import schemas\n"
+     "write_avro_file(p, recs, schemas.REQUEST_LOG_AVRO)\n", 1),
+    # other schemas stay free
+    ("from photon_ml_tpu.io.schemas import SCORING_RESULT_AVRO\n", 0),
+])
+@pytest.mark.parametrize("subdir", ["serving", "game", "io"])
+def test_request_log_writes_confined(snippet, n, subdir):
+    rel = os.path.join("photon_ml_tpu", subdir, "x.py")
+    out = hygiene.check_source(snippet, rel)
+    assert len(out) == n, out
+    if n:
+        assert "REQUEST_LOG_AVRO" in out[0]
+
+
+@pytest.mark.parametrize("rel", [
+    os.path.join("photon_ml_tpu", "serving", "reqlog.py"),
+    os.path.join("photon_ml_tpu", "io", "schemas.py"),
+])
+def test_request_log_schema_legal_in_sanctioned_files(rel):
+    src = ("from photon_ml_tpu.io.schemas import REQUEST_LOG_AVRO\n"
+           "write_avro_file(p, recs, REQUEST_LOG_AVRO)\n")
+    assert hygiene.check_source(src, rel) == []

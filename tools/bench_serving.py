@@ -1,30 +1,42 @@
 """Load generator / latency bench for the online serving subsystem.
 
 Spins up an in-process :class:`GameServer` over a trained GAME model (or
-targets an already-running server via ``--url``), replays request traffic at
-mixed batch sizes from worker threads, and reports:
+targets an already-running server via ``--url``) and replays request
+traffic in one of two modes:
 
-- ``serving_score_latency_ms`` — p50/p99 end-to-end HTTP latency plus
-  throughput (requests/s, rows/s),
-- the engine recompile count across the loaded phase (the zero-recompile
-  contract: after warmup it must not move — asserted by
-  tests/test_serving.py, *reported* here),
-- per-request metrics stream: the service posts one ``serving_request``
-  event per scored request on the EventBus; the bench subscribes a listener
-  and folds them into the summary (server-side latency vs. the
-  client-observed one),
-- a ``/metrics`` scrape (before and after the load) folding the SERVER'S
-  own Prometheus histogram into the report: request-latency quantiles
-  estimated from the bucket deltas, the recompile counter delta, and —
-  for in-process runs, where the bench is the only traffic — parity
-  assertions between the scraped counters and the client-side tallies
-  (requests counted == requests sent, recompiles metric == healthz
-  compiles delta, histogram count == scored requests),
-- the ``photon_quality_*`` model-quality families (quality/monitor.py):
-  scored-row and cold-start counter deltas across the load, with a HARD
-  parity assert for in-process runs that the server's cold-start counter
-  moved by exactly the client-side tally of unknown-entity references
-  the bench sent (computed per record against the store's own row map).
+- ``--mode closed`` (default, the historical mode): ``--concurrency``
+  worker threads each issue the next request the moment the previous one
+  returns. Percentiles are labeled ``closed_loop_*`` because this
+  methodology **hides coordinated omission** — a server stall simply
+  pauses the senders, so the stall shows up in at most ``concurrency``
+  samples instead of every request that WOULD have arrived. Closed-loop
+  numbers measure the server at the load it permits, not the load you
+  asked for. (The old ``value``/``p99_ms`` keys remain as aliases so
+  ``bench_gate`` baselines keep comparing.)
+- ``--mode open --target-qps N``: requests fire on a fixed schedule
+  (request *i* is due at ``t0 + i/N``) regardless of completions, and
+  every latency is measured from the request's SCHEDULED time — the
+  HdrHistogram-style correction. If the server stalls, queued schedule
+  slots keep accumulating wait, so ``corrected_p99`` reflects what real
+  open traffic would experience; the ``uncorrected_*`` numbers (send →
+  response) are reported next to it to expose the gap.
+  ``--slo-p99-ms`` adds a p99 SLO gate on the corrected percentile whose
+  ``ok``/``regression`` verdict is produced by ``tools/bench_gate.py``
+  (exit 1 on regression).
+
+Both modes also report:
+
+- the engine recompile count across the load phase (the zero-recompile
+  contract: after warmup it must not move),
+- a ``/metrics`` scrape (before and after) folding the SERVER'S own
+  histograms into the report: request-latency quantiles from bucket
+  deltas, the per-stage request-path breakdown
+  (``photon_serving_stage_seconds{stage=parse|queue_wait|batch_assemble|
+  execute|respond}``), the recompile counter delta, and — for in-process
+  runs, where the bench is the only traffic — parity assertions between
+  the scraped counters and the client-side tallies,
+- the ``photon_quality_*`` model-quality families (quality/monitor.py)
+  with the cold-start parity assert.
 
 Output: one JSON line per metric + a terminal ``suite_summary`` line, the
 same artifact shape as bench.py.
@@ -33,13 +45,16 @@ Usage::
 
     python tools/bench_serving.py --model-dir out/ \
         --feature-shards 'global=fixed|intercept,user=user|noIntercept' \
-        --data val.avro --requests 500 --concurrency 4
+        --requests 500 --concurrency 4
+    python tools/bench_serving.py --model-dir out/ --feature-shards ... \
+        --mode open --target-qps 200 --requests 1000 --slo-p99-ms 50
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import math
 import threading
 import time
 import urllib.request
@@ -77,8 +92,6 @@ def _scrape_metrics(base: str):
 def _histogram_delta(m0, m1, name: str):
     """(uppers, cumulative-count deltas, count delta) for one label-free
     histogram between two scrapes — the load window's own distribution."""
-    import math
-
     from photon_ml_tpu.telemetry.prometheus import series_value
 
     buckets1 = m1.get(name + "_bucket", [])
@@ -94,6 +107,137 @@ def _histogram_delta(m0, m1, name: str):
     count = (series_value(m1, name + "_count")
              - series_value(m0 or {}, name + "_count"))
     return uppers[:-1], deltas, int(count)
+
+
+def _labeled_histogram_delta(m0, m1, name: str, label_name: str):
+    """Per label value: (uppers, cumulative-count deltas, count delta) of a
+    one-label histogram family between two scrapes (the per-stage
+    breakdown's raw material)."""
+    from photon_ml_tpu.telemetry.prometheus import series_value
+
+    by_label: dict[str, list] = {}
+    for labels, v1 in m1.get(name + "_bucket", []):
+        lv = labels.get(label_name)
+        le = labels.get("le")
+        if lv is None or le is None:
+            continue
+        v0 = series_value(m0 or {}, name + "_bucket",
+                          {label_name: lv, "le": le})
+        by_label.setdefault(lv, []).append(
+            (math.inf if le == "+Inf" else float(le), int(v1 - v0)))
+    out = {}
+    for lv, pairs in by_label.items():
+        pairs.sort(key=lambda p: p[0])
+        uppers = [u for u, _ in pairs]
+        deltas = [d for _, d in pairs]
+        count = (series_value(m1, name + "_count", {label_name: lv})
+                 - series_value(m0 or {}, name + "_count",
+                                {label_name: lv}))
+        out[lv] = (uppers[:-1], deltas, int(count))
+    return out
+
+
+def stage_breakdown(m0, m1) -> dict:
+    """The request-path critical path across the load window, per stage:
+    count + bucket-interpolated p50/p99 ms from the server's
+    ``photon_serving_stage_seconds`` histograms."""
+    from photon_ml_tpu.telemetry.metrics import quantile_from_buckets
+
+    out = {}
+    for stage, (uppers, cum, count) in sorted(_labeled_histogram_delta(
+            m0, m1, "photon_serving_stage_seconds", "stage").items()):
+        if count <= 0:
+            continue
+        out[stage] = {
+            "count": count,
+            "p50_ms": round(quantile_from_buckets(uppers, cum, 0.50) * 1e3, 3),
+            "p99_ms": round(quantile_from_buckets(uppers, cum, 0.99) * 1e3, 3),
+        }
+    return out
+
+
+def open_loop_run(base: str, pool, sizes, *, target_qps: float,
+                  requests: int, concurrency: int = 16,
+                  timeout: float = 60.0) -> dict:
+    """Fire ``requests`` requests on an open-loop schedule at
+    ``target_qps`` and return schedule-corrected + uncorrected latencies.
+
+    Request *i* is DUE at ``start + i/target_qps``; a worker that reaches
+    it early sleeps, one that reaches it late (every worker stuck behind a
+    server stall) sends immediately — and the wait it accumulated counts
+    into the corrected latency, exactly as it would for a real arrival
+    process. ``concurrency`` bounds in-flight requests (stdlib urllib has
+    no async client); size it above ``target_qps × expected latency`` so
+    the schedule, not the sender, is the limiting factor."""
+    lock = threading.Lock()
+    counter = {"i": 0}
+    corrected: list[float] = []
+    uncorrected: list[float] = []
+    errors: list[str] = []
+    sent_rows = {"n": 0}
+    start = time.perf_counter() + 0.05
+
+    def worker():
+        while True:
+            with lock:
+                i = counter["i"]
+                if i >= requests:
+                    return
+                counter["i"] += 1
+            due = start + i / target_qps
+            now = time.perf_counter()
+            if due > now:
+                time.sleep(due - now)
+            size = sizes[i % len(sizes)]
+            recs = [pool[(i + j) % len(pool)] for j in range(size)]
+            t_send = time.perf_counter()
+            try:
+                out = _http_json(base + "/score", {"records": recs},
+                                 timeout=timeout)
+                assert len(out["scores"]) == size
+            except Exception as e:
+                with lock:
+                    errors.append(repr(e))
+                continue
+            t_done = time.perf_counter()
+            with lock:
+                corrected.append((t_done - due) * 1e3)
+                uncorrected.append((t_done - t_send) * 1e3)
+                sent_rows["n"] += size
+
+    threads = [threading.Thread(target=worker) for _ in range(concurrency)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    return {"corrected_ms": corrected, "uncorrected_ms": uncorrected,
+            "errors": errors, "wall_s": wall, "rows": sent_rows["n"],
+            "achieved_qps": len(corrected) / wall if wall > 0 else 0.0}
+
+
+def slo_gate_verdict(corrected_p99_ms: float, slo_p99_ms: float) -> dict:
+    """The p99 SLO as a ``tools/bench_gate.py`` verdict: headroom =
+    slo/p99 (a rate-shaped metric, higher is better) gated at threshold 0
+    against a fixed baseline of 1.0 — headroom < 1 (p99 over SLO) is a
+    ``regression``, headroom ≥ 1 is ``ok``. Reusing the gate keeps one
+    verdict vocabulary across the whole bench trajectory."""
+    import bench_gate
+
+    headroom = (slo_p99_ms / corrected_p99_ms
+                if corrected_p99_ms > 0 else float("inf"))
+    current = {"metrics": {
+        "serving_p99_slo_headroom": {"value": min(headroom, 1e9)}}}
+    baseline = {"metrics": {
+        "serving_p99_slo_headroom": {"value": 1.0}}}
+    verdict = bench_gate.gate({"rc": 0, "summary": current},
+                              {"rc": 0, "summary": baseline},
+                              threshold=0.0)
+    verdict["slo_p99_ms"] = slo_p99_ms
+    verdict["corrected_p99_ms"] = round(corrected_p99_ms, 3)
+    verdict["headroom"] = round(headroom, 4)
+    return verdict
 
 
 def _request_pool(args, server):
@@ -150,8 +294,21 @@ def main(argv=None):
                                  "of spawning one in-process")
     p.add_argument("--data", help="avro file of records to replay "
                                   "(default: synthesize from the model)")
+    p.add_argument("--mode", choices=["closed", "open"], default="closed",
+                   help="closed = workers re-send on completion (hides "
+                        "coordinated omission; percentiles labeled "
+                        "closed_loop_*); open = fixed --target-qps "
+                        "schedule with latency-corrected percentiles")
+    p.add_argument("--target-qps", type=float, default=100.0,
+                   help="open-loop arrival rate (requests/s)")
+    p.add_argument("--slo-p99-ms", type=float, default=None,
+                   help="open-loop p99 SLO on the CORRECTED percentile; "
+                        "emits a bench_gate ok/regression verdict and "
+                        "exits 1 on regression")
     p.add_argument("--requests", type=int, default=200)
-    p.add_argument("--concurrency", type=int, default=4)
+    p.add_argument("--concurrency", type=int, default=4,
+                   help="closed-loop worker threads; open-loop max "
+                        "in-flight requests (default 16 there)")
     p.add_argument("--batch-sizes", default="1,1,1,2,4,8",
                    help="cycled per request (skew toward singles, like "
                         "real traffic)")
@@ -205,69 +362,129 @@ def main(argv=None):
 
     latencies: list[float] = []
     errors: list[str] = []
-    lock = threading.Lock()
-    counter = {"i": 0}
-    cold_sent = {"n": 0}
+    results: list[dict] = []
+    slo_line = None
 
-    def worker():
-        while True:
-            with lock:
-                i = counter["i"]
-                if i >= args.requests:
-                    return
-                counter["i"] += 1
-            size = sizes[i % len(sizes)]
-            recs = [pool[(i + j) % len(pool)] for j in range(size)]
-            t0 = time.perf_counter()
-            try:
-                out = _http_json(base + "/score", {"records": recs})
-                assert len(out["scores"]) == size
-            except Exception as e:
-                with lock:
-                    errors.append(repr(e))
-                continue
-            with lock:
-                latencies.append((time.perf_counter() - t0) * 1e3)
-                if cold_refs is not None:
-                    cold_sent["n"] += sum(
-                        cold_refs[(i + j) % len(pool)]
-                        for j in range(size))
-
-    t0 = time.perf_counter()
-    threads = [threading.Thread(target=worker)
-               for _ in range(args.concurrency)]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    wall = time.perf_counter() - t0
-    health = _http_json(base + "/healthz")
-    metrics1 = _scrape_metrics(base)
-
-    rows = sum(sizes[i % len(sizes)] for i in range(args.requests))
-    results = [{
-        "metric": "serving_score_latency_ms",
-        "value": round(_percentile(latencies, 50), 3),
-        "unit": "ms p50 (client-observed, HTTP included)",
-        "p99_ms": round(_percentile(latencies, 99), 3),
-        "requests_per_sec": round(len(latencies) / wall, 1),
-        "rows_per_sec": round(rows / wall, 1),
-        "n_requests": len(latencies),
-        "n_errors": len(errors),
-        "concurrency": args.concurrency,
-        "batch_sizes": sizes,
-        "recompiles_during_load": health["compiles"] - compiles0,
-        "version": health["version"],
-    }]
-    if server_events:
-        sl = [e.payload["latency_ms"] for e in server_events]
+    if args.mode == "open":
+        concurrency = args.concurrency if args.concurrency != 4 else 16
+        run = open_loop_run(base, pool, sizes,
+                            target_qps=args.target_qps,
+                            requests=args.requests,
+                            concurrency=concurrency)
+        latencies = run["uncorrected_ms"]
+        errors = run["errors"]
+        wall = run["wall_s"]
+        rows = run["rows"]
+        corrected_p99 = _percentile(run["corrected_ms"], 99)
+        health = _http_json(base + "/healthz")
+        metrics1 = _scrape_metrics(base)
         results.append({
-            "metric": "serving_server_latency_ms",
-            "value": round(_percentile(sl, 50), 3),
-            "unit": "ms p50 (server-side, via EventBus serving_request)",
-            "p99_ms": round(_percentile(sl, 99), 3),
-            "n_events": len(sl),
+            "metric": "serving_open_loop_latency_ms",
+            "value": round(_percentile(run["corrected_ms"], 50), 3),
+            "unit": "ms p50 (open-loop, latency-corrected from schedule)",
+            "corrected_p50_ms": round(
+                _percentile(run["corrected_ms"], 50), 3),
+            "corrected_p99_ms": round(corrected_p99, 3),
+            "uncorrected_p50_ms": round(_percentile(latencies, 50), 3),
+            "uncorrected_p99_ms": round(_percentile(latencies, 99), 3),
+            "target_qps": args.target_qps,
+            "achieved_qps": round(run["achieved_qps"], 1),
+            "rows_per_sec": round(rows / wall, 1) if wall > 0 else 0.0,
+            "n_requests": len(run["corrected_ms"]),
+            "n_errors": len(errors),
+            "concurrency": concurrency,
+            "batch_sizes": sizes,
+            "recompiles_during_load": health["compiles"] - compiles0,
+            "version": health["version"],
         })
+        if metrics1 is not None:
+            stages = stage_breakdown(metrics0, metrics1)
+            if stages:
+                results.append({
+                    "metric": "serving_stage_breakdown",
+                    "value": stages.get("execute", {}).get("p50_ms", 0.0),
+                    "unit": "ms p50 of the execute stage "
+                            "(photon_serving_stage_seconds deltas)",
+                    "stages": stages,
+                })
+        if args.slo_p99_ms is not None:
+            slo_line = {"metric": "serving_slo_gate"}
+            slo_line.update(slo_gate_verdict(corrected_p99,
+                                             args.slo_p99_ms))
+            results.append(slo_line)
+    else:
+        lock = threading.Lock()
+        counter = {"i": 0}
+        cold_sent = {"n": 0}
+
+        def worker():
+            while True:
+                with lock:
+                    i = counter["i"]
+                    if i >= args.requests:
+                        return
+                    counter["i"] += 1
+                size = sizes[i % len(sizes)]
+                recs = [pool[(i + j) % len(pool)] for j in range(size)]
+                t0 = time.perf_counter()
+                try:
+                    out = _http_json(base + "/score", {"records": recs})
+                    assert len(out["scores"]) == size
+                except Exception as e:
+                    with lock:
+                        errors.append(repr(e))
+                    continue
+                with lock:
+                    latencies.append((time.perf_counter() - t0) * 1e3)
+                    if cold_refs is not None:
+                        cold_sent["n"] += sum(
+                            cold_refs[(i + j) % len(pool)]
+                            for j in range(size))
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=worker)
+                   for _ in range(args.concurrency)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        health = _http_json(base + "/healthz")
+        metrics1 = _scrape_metrics(base)
+
+        rows = sum(sizes[i % len(sizes)] for i in range(args.requests))
+        results.append({
+            "metric": "serving_score_latency_ms",
+            # closed_loop_* are the honest names (this methodology hides
+            # coordinated omission); value/p99_ms stay as aliases so
+            # bench_gate baselines keep comparing round over round
+            "value": round(_percentile(latencies, 50), 3),
+            "unit": "ms p50 (closed-loop client-observed, HTTP included; "
+                    "hides coordinated omission — see --mode open)",
+            "closed_loop_p50_ms": round(_percentile(latencies, 50), 3),
+            "closed_loop_p99_ms": round(_percentile(latencies, 99), 3),
+            "p99_ms": round(_percentile(latencies, 99), 3),
+            "requests_per_sec": round(len(latencies) / wall, 1),
+            "rows_per_sec": round(rows / wall, 1),
+            "n_requests": len(latencies),
+            "n_errors": len(errors),
+            "concurrency": args.concurrency,
+            "batch_sizes": sizes,
+            "recompiles_during_load": health["compiles"] - compiles0,
+            "version": health["version"],
+        })
+        if server_events:
+            sl = [e.payload["latency_ms"] for e in server_events]
+            results.append({
+                "metric": "serving_server_latency_ms",
+                "value": round(_percentile(sl, 50), 3),
+                "unit": "ms p50 (closed-loop server-side, via EventBus "
+                        "serving_request)",
+                "closed_loop_p50_ms": round(_percentile(sl, 50), 3),
+                "closed_loop_p99_ms": round(_percentile(sl, 99), 3),
+                "p99_ms": round(_percentile(sl, 99), 3),
+                "n_events": len(sl),
+            })
     parity_failures: list[str] = []
     if metrics1 is not None:
         from photon_ml_tpu.telemetry.metrics import quantile_from_buckets
@@ -288,7 +505,7 @@ def main(argv=None):
         recompiles_metric = int(delta("photon_compiles_total",
                                       {"fn": "serving.score"}))
         requests_metric = int(delta("photon_serving_requests_total"))
-        results.append({
+        scrape_line = {
             "metric": "serving_metrics_scrape",
             "value": q(0.50),
             "unit": "ms p50 (server histogram, bucket-interpolated)",
@@ -298,7 +515,12 @@ def main(argv=None):
             "recompiles_total": recompiles_metric,
             "active_version": series_value(
                 metrics1, "photon_model_active_version"),
-        })
+        }
+        if args.mode == "closed":
+            stages = stage_breakdown(metrics0, metrics1)
+            if stages:
+                scrape_line["stages"] = stages
+        results.append(scrape_line)
         # model-quality families (quality/monitor.py): the engine-side
         # accumulation across the load window
         def _labeled_delta(name, label):
@@ -322,39 +544,45 @@ def main(argv=None):
             "cold_start_by_coordinate": {k: int(v)
                                          for k, v in cold_by_cid.items()},
             "scored_rows": quality_rows,
-            "client_cold_sent": (cold_sent["n"] if cold_refs is not None
-                                 else None),
+            "client_cold_sent": (cold_sent["n"]
+                                 if args.mode == "closed"
+                                 and cold_refs is not None else None),
         })
         if server is not None:
             # in-process run = the bench is the only traffic, so the
             # server's own books must match the client's exactly
-            if cold_refs is not None and quality_cold != cold_sent["n"]:
+            n_done = (len(latencies) if args.mode == "closed"
+                      else len(run["corrected_ms"]))
+            if (args.mode == "closed" and cold_refs is not None
+                    and quality_cold != cold_sent["n"]):
                 parity_failures.append(
                     f"photon_quality_cold_start_total moved "
                     f"{quality_cold}, client sent {cold_sent['n']} "
                     f"unknown-entity references")
-            if requests_metric != len(latencies):
+            if requests_metric != n_done:
                 parity_failures.append(
                     f"requests_total moved {requests_metric}, client "
-                    f"completed {len(latencies)}")
-            if hist_count != len(latencies):
+                    f"completed {n_done}")
+            if hist_count != n_done:
                 parity_failures.append(
                     f"latency histogram counted {hist_count} requests, "
-                    f"client completed {len(latencies)}")
+                    f"client completed {n_done}")
             if recompiles_metric != health["compiles"] - compiles0:
                 parity_failures.append(
                     f"recompiles_total moved {recompiles_metric}, healthz "
                     f"compile counter moved {health['compiles'] - compiles0}")
     for r in results:
         print(json.dumps(r), flush=True)
+    head = results[0]
     print(json.dumps({
         "metric": "suite_summary",
-        "value": results[0]["value"],
-        "unit": results[0]["unit"],
-        "p99_ms": results[0]["p99_ms"],
-        "zero_recompiles": results[0]["recompiles_during_load"] == 0,
+        "value": head["value"],
+        "unit": head["unit"],
+        "p99_ms": head.get("corrected_p99_ms", head.get("p99_ms")),
+        "zero_recompiles": head["recompiles_during_load"] == 0,
         "metrics_parity": not parity_failures if metrics1 is not None
         else None,
+        "slo_verdict": slo_line.get("verdict") if slo_line else None,
         "n_errors": len(errors),
         "wall_s": round(wall, 2),
     }), flush=True)
@@ -365,6 +593,11 @@ def main(argv=None):
     if parity_failures:
         raise SystemExit("server-side /metrics disagree with the client's "
                          "measurements: " + "; ".join(parity_failures))
+    if slo_line is not None and slo_line.get("verdict") == "regression":
+        raise SystemExit(
+            f"p99 SLO gate: corrected p99 "
+            f"{slo_line['corrected_p99_ms']} ms > SLO "
+            f"{slo_line['slo_p99_ms']} ms (verdict: regression)")
 
 
 if __name__ == "__main__":

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Static telemetry-hygiene check over ``photon_ml_tpu/``.
 
-Six rules, all load-bearing for the telemetry subsystem (the sibling of
+Seven rules, all load-bearing for the telemetry subsystem (the sibling of
 ``check_resilience_hygiene.py``, same contract: run directly or through the
 tier-1 test):
 
@@ -45,6 +45,17 @@ tier-1 test):
    ``numpy``/``jax.numpy`` ``histogram*`` calls, and local definitions of
    the drift statistics, outside ``photon_ml_tpu/quality/``.
 
+7. **Request identity and the request log have ONE home each** — a
+   serving request id is minted in ``photon_ml_tpu/serving/http.py``
+   (``new_request_id``) and nowhere else: a second generation site
+   (detected: ``uuid.uuid1/3/4/5`` and ``secrets.token_hex/urlsafe``
+   calls) would hand one request two identities and break the
+   span↔reqlog↔response join. Likewise the ``RequestLogAvro`` format is
+   written only by ``photon_ml_tpu/serving/reqlog.py`` (detected: any
+   reference to ``REQUEST_LOG_AVRO`` outside reqlog.py and its
+   definition in ``io/schemas.py``): a second writer forks the on-disk
+   log away from ``tools/reqlog_replay.py`` and the feedback joiner.
+
 Run directly (``python tools/check_telemetry_hygiene.py [root]``, exit 1 on
 violations) or through the tier-1 test ``tests/test_telemetry_hygiene.py``.
 """
@@ -84,6 +95,21 @@ HISTOGRAM_ATTRS = frozenset({"histogram", "histogram2d", "histogramdd",
 #: arithmetic (calling quality's exported functions is of course fine)
 DRIFT_STAT_NAMES = frozenset({"population_stability_index", "psi",
                               "ks_statistic", "kolmogorov_smirnov"})
+
+#: rule 7: the one request-id mint (serving/http.py) and the request-id
+#: generation primitives whose CALL anywhere else forks request identity
+REQUEST_ID_ALLOWED_FILES = {os.path.join("photon_ml_tpu", "serving",
+                                         "http.py")}
+ID_GEN_UUID_FNS = frozenset({"uuid1", "uuid3", "uuid4", "uuid5"})
+ID_GEN_SECRETS_FNS = frozenset({"token_hex", "token_urlsafe"})
+
+#: rule 7: the one RequestLogAvro writer (serving/reqlog.py) plus the
+#: schema's definition site
+REQLOG_SCHEMA_NAME = "REQUEST_LOG_AVRO"
+REQLOG_ALLOWED_FILES = {
+    os.path.join("photon_ml_tpu", "serving", "reqlog.py"),
+    os.path.join("photon_ml_tpu", "io", "schemas.py"),
+}
 
 
 def _is_perf_counter(node: ast.AST, time_aliases: set[str],
@@ -126,6 +152,8 @@ def check_source(source: str, rel_path: str) -> list[str]:
     pc_banned = not rel_path.startswith(TIMING_ALLOWED_PREFIX)
     registry_ok = rel_path.startswith(REGISTRY_ALLOWED_PREFIX)
     binning_banned = not rel_path.startswith(QUALITY_ALLOWED_PREFIX)
+    id_gen_banned = rel_path not in REQUEST_ID_ALLOWED_FILES
+    reqlog_banned = rel_path not in REQLOG_ALLOWED_FILES
 
     # resolve what `time` / `perf_counter` / `time.time` / numpy are
     # bound to
@@ -134,6 +162,9 @@ def check_source(source: str, rel_path: str) -> list[str]:
     tt_names: set[str] = set()  # from-imports of time.time
     metric_fn_names: set[str] = set()  # from-imports of counter/gauge/...
     np_aliases: set[str] = set()  # names bound to numpy / jax.numpy
+    uuid_aliases: set[str] = set()  # names bound to the uuid module
+    secrets_aliases: set[str] = set()  # names bound to secrets
+    id_gen_names: set[str] = set()  # from-imports of uuid4/token_hex/...
     for node in ast.walk(tree):
         if isinstance(node, ast.Import):
             for a in node.names:
@@ -143,6 +174,10 @@ def check_source(source: str, rel_path: str) -> list[str]:
                     np_aliases.add(a.asname or "numpy")
                 elif a.name == "jax.numpy" and a.asname:
                     np_aliases.add(a.asname)
+                elif a.name == "uuid":
+                    uuid_aliases.add(a.asname or "uuid")
+                elif a.name == "secrets":
+                    secrets_aliases.add(a.asname or "secrets")
         elif isinstance(node, ast.ImportFrom):
             if node.module == "time":
                 for a in node.names:
@@ -158,6 +193,14 @@ def check_source(source: str, rel_path: str) -> list[str]:
                 for a in node.names:
                     if a.name == "numpy":
                         np_aliases.add(a.asname or "numpy")
+            elif node.module == "uuid":
+                for a in node.names:
+                    if a.name in ID_GEN_UUID_FNS:
+                        id_gen_names.add(a.asname or a.name)
+            elif node.module == "secrets":
+                for a in node.names:
+                    if a.name in ID_GEN_SECRETS_FNS:
+                        id_gen_names.add(a.asname or a.name)
 
     def _is_np_module(v: ast.AST) -> bool:
         if isinstance(v, ast.Name):
@@ -165,6 +208,25 @@ def check_source(source: str, rel_path: str) -> list[str]:
         # the bare `import jax.numpy` spelling: jax.numpy.histogram(...)
         return (isinstance(v, ast.Attribute) and v.attr == "numpy"
                 and isinstance(v.value, ast.Name) and v.value.id == "jax")
+
+    def _is_id_gen_call(node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        f = node.func
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+            return ((f.value.id in uuid_aliases
+                     and f.attr in ID_GEN_UUID_FNS)
+                    or (f.value.id in secrets_aliases
+                        and f.attr in ID_GEN_SECRETS_FNS))
+        return isinstance(f, ast.Name) and f.id in id_gen_names
+
+    def _is_reqlog_schema_ref(node: ast.AST) -> bool:
+        if isinstance(node, ast.Name) and node.id == REQLOG_SCHEMA_NAME:
+            return True
+        if isinstance(node, ast.Attribute) and node.attr == REQLOG_SCHEMA_NAME:
+            return True
+        return (isinstance(node, ast.ImportFrom)
+                and any(a.name == REQLOG_SCHEMA_NAME for a in node.names))
 
     def _is_wall_clock_call(node: ast.AST) -> bool:
         if not isinstance(node, ast.Call):
@@ -215,6 +277,18 @@ def check_source(source: str, rel_path: str) -> list[str]:
                 f"{node.name}() defined outside photon_ml_tpu/quality/ — "
                 f"PSI/KS have ONE implementation (quality/baseline.py); "
                 f"import it instead of re-deriving the arithmetic")
+        elif id_gen_banned and _is_id_gen_call(node):
+            out.append(
+                f"{rel_path}:{node.lineno}: request-id generation outside "
+                f"photon_ml_tpu/serving/http.py — a serving request is "
+                f"identified ONCE (new_request_id); a second mint breaks "
+                f"the span/reqlog/response join (hygiene rule 7)")
+        elif reqlog_banned and _is_reqlog_schema_ref(node):
+            out.append(
+                f"{rel_path}:{node.lineno}: {REQLOG_SCHEMA_NAME} referenced "
+                f"outside photon_ml_tpu/serving/reqlog.py — the request "
+                f"log has ONE writer; a second one forks the on-disk "
+                f"format away from tools/reqlog_replay.py (hygiene rule 7)")
         elif isinstance(node, ast.Call):
             func = node.func
             is_factory = (

@@ -22,6 +22,13 @@ This tool renders them into one deterministic text report:
   only when the trace carries I/O spans);
 - **per-coordinate table** — ``cd.step`` spans folded per coordinate with
   the optimizer-iteration counters;
+- **serving request path** — the per-stage critical path of a serving
+  snapshot (``photon_serving_stage_seconds{stage=...}``: parse →
+  queue_wait → batch_assemble → execute → respond) with
+  bucket-interpolated p50/p99 per stage plus the end-to-end
+  ``photon_serving_request_latency_seconds`` summary and the request-log
+  budget counters — the serving counterpart of the training critical
+  path (section present only when the snapshot carries serving series);
 - **FLOPs/s estimate** — ``photon_flops_total{fn}`` over the execute-sum
   seconds (dispatch-side; a lower bound on device throughput).
 
@@ -158,6 +165,76 @@ def io_overlap(spans: Sequence[Mapping]) -> Optional[dict]:
     return out
 
 
+def _histogram_quantiles(parsed: Mapping, name: str,
+                         match: Optional[Mapping[str, str]] = None,
+                         ) -> Optional[dict]:
+    """count/total_s/p50/p99 of one histogram series in a snapshot (the
+    series whose labels contain ``match``); None when absent/empty."""
+    import math
+
+    from photon_ml_tpu.telemetry.metrics import quantile_from_buckets
+
+    match = dict(match or {})
+    pairs = []
+    for labels, value in parsed.get(name + "_bucket", ()):
+        if not all(labels.get(k) == v for k, v in match.items()):
+            continue
+        le = labels.get("le")
+        pairs.append((math.inf if le == "+Inf" else float(le), int(value)))
+    if not pairs:
+        return None
+    pairs.sort(key=lambda p: p[0])
+    uppers = [u for u, _ in pairs][:-1]
+    cum = [c for _, c in pairs]
+    count = cum[-1]
+    if count == 0:
+        return None
+    total = 0.0
+    for labels, value in parsed.get(name + "_sum", ()):
+        if all(labels.get(k) == v for k, v in match.items()):
+            total = value
+            break
+    return {"count": int(count), "total_s": float(total),
+            "p50_ms": quantile_from_buckets(uppers, cum, 0.50) * 1e3,
+            "p99_ms": quantile_from_buckets(uppers, cum, 0.99) * 1e3}
+
+
+def serving_request_path(parsed: Mapping) -> Optional[dict]:
+    """The serving snapshot's per-stage critical path: stage histograms
+    (``photon_serving_stage_seconds``), the end-to-end request histogram,
+    and the request-log budget counters. None when the snapshot carries no
+    serving stage series (a training-only run)."""
+    stages = {}
+    seen = {labels.get("stage")
+            for labels, _ in parsed.get(
+                "photon_serving_stage_seconds_bucket", ())}
+    for stage in sorted(s for s in seen if s):
+        q = _histogram_quantiles(parsed, "photon_serving_stage_seconds",
+                                 {"stage": stage})
+        if q is not None:
+            stages[stage] = q
+    if not stages:
+        return None
+    out = {
+        "stages": stages,
+        "request": _histogram_quantiles(
+            parsed, "photon_serving_request_latency_seconds"),
+        "reqlog": None,
+    }
+    reqlog = {}
+    for key, series in (("records", "photon_reqlog_records_total"),
+                        ("bytes", "photon_reqlog_bytes_total"),
+                        ("dropped", "photon_reqlog_dropped_total")):
+        samples = parsed.get(series, ())
+        if samples:
+            reqlog[key] = sum(v for _, v in samples)
+    if reqlog:
+        out["reqlog"] = {"records": reqlog.get("records", 0),
+                         "bytes": reqlog.get("bytes", 0),
+                         "dropped": reqlog.get("dropped", 0)}
+    return out
+
+
 def _labeled(parsed: Mapping, series: str, label: str) -> dict[str, float]:
     """{label value: sample value} over one series' samples."""
     out: dict[str, float] = {}
@@ -263,6 +340,43 @@ def build_report(spans: Sequence[Mapping], prom_text: str,
                           for ph in ("trace", "lower", "backend")
                           if ph in xla_s or ph in xla_n)
         lines.append(f"process-wide XLA pipeline (any jit): {parts}")
+
+    # --- serving request path --------------------------------------------
+    serving = serving_request_path(parsed)
+    if serving is not None:
+        lines.append("")
+        lines.append("-- serving request path (per-stage critical path) --")
+        req = serving["request"]
+        if req is not None:
+            lines.append(
+                f"requests {req['count']}: p50 {req['p50_ms']:.3f} ms, "
+                f"p99 {req['p99_ms']:.3f} ms "
+                f"(photon_serving_request_latency_seconds)")
+        lines.append(f"{'stage':<16} {'count':>8} {'total_s':>10} "
+                     f"{'p50_ms':>9} {'p99_ms':>9}")
+        for stage in ("parse", "queue_wait", "batch_assemble", "execute",
+                      "respond"):
+            st = serving["stages"].get(stage)
+            if st is None:
+                continue
+            lines.append(f"{stage:<16} {st['count']:>8d} "
+                         f"{st['total_s']:>10.3f} {st['p50_ms']:>9.3f} "
+                         f"{st['p99_ms']:>9.3f}")
+        # stages not in the canonical order still render (forward compat)
+        for stage in sorted(serving["stages"]):
+            if stage in ("parse", "queue_wait", "batch_assemble",
+                         "execute", "respond"):
+                continue
+            st = serving["stages"][stage]
+            lines.append(f"{stage:<16} {st['count']:>8d} "
+                         f"{st['total_s']:>10.3f} {st['p50_ms']:>9.3f} "
+                         f"{st['p99_ms']:>9.3f}")
+        if serving["reqlog"] is not None:
+            r = serving["reqlog"]
+            lines.append(
+                f"request log: {int(r['records'])} records / "
+                f"{_fmt_count(r['bytes'])}B written, "
+                f"{int(r['dropped'])} dropped")
 
     # --- per-coordinate table --------------------------------------------
     steps = [s for s in spans if s["name"] == "cd.step"]

@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""Replay a serving request log against a model and assert score parity.
+
+The durable request log (``serve_game --reqlog-dir``,
+:mod:`photon_ml_tpu.serving.reqlog`) records, per served request, the full
+scored inputs, the f32 scores (widened to double — exact), and the content
+lineage (``io.model_io.model_lineage_id``) of the model version that
+answered. That makes the log self-verifying: load the named model, re-score
+the logged records through a fresh engine, and the scores must come back
+**bit-identical** — the same parity contract tests/test_serving.py locks
+between the online and batch paths, now checkable against production
+traffic after the fact. A mismatch means either the model dir does not
+hold the lineage the log names (wrong artifact) or the score path broke
+determinism (a real bug).
+
+Requests logged under a DIFFERENT lineage than the loaded model (traffic
+that straddled a hot-swap) are skipped and counted — replay them against
+their own model dir. Requests with no recorded lineage replay too unless
+``--require-lineage``.
+
+Output: one JSON line per anomaly (first few mismatches, with per-record
+deltas) + a terminal summary line. Exit 0 when every replayed request
+matched, 1 on any mismatch, 2 when nothing was replayable.
+
+Usage::
+
+    python tools/reqlog_replay.py --reqlog-dir logs/ --model-dir out/ \
+        --feature-shards 'global=fixed|intercept,user=user|noIntercept'
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Optional, Sequence
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def replay(reqlog_dir: str, registry, *, require_lineage: bool = False,
+           max_report: int = 5) -> dict:
+    """Replay every logged request through ``registry``'s active version;
+    returns the summary dict (the CLI prints it). Kept importable so the
+    tier-1 test drives the exact code path the operator runs."""
+    import numpy as np
+
+    from photon_ml_tpu.serving.reqlog import iter_reqlog
+
+    sm = registry.active()
+    lineage = sm.lineage
+    summary = {"replayed": 0, "matched": 0, "mismatched": 0,
+               "skipped_lineage": 0, "lineage": lineage}
+    reports = []
+    for entry in iter_reqlog(reqlog_dir):
+        logged_lineage = entry.get("modelLineage")
+        if logged_lineage is not None and logged_lineage != lineage:
+            summary["skipped_lineage"] += 1
+            continue
+        if logged_lineage is None and require_lineage:
+            summary["skipped_lineage"] += 1
+            continue
+        records = [{"features": r["features"],
+                    "metadataMap": r["metadataMap"],
+                    "offset": r["offset"]} for r in entry["records"]]
+        logged = np.array([r["score"] for r in entry["records"]], np.float64)
+        got = np.asarray(sm.score(records), np.float32).astype(np.float64)
+        summary["replayed"] += 1
+        if np.array_equal(got, logged):
+            summary["matched"] += 1
+        else:
+            summary["mismatched"] += 1
+            if len(reports) < max_report:
+                reports.append({
+                    "metric": "reqlog_replay_mismatch",
+                    "request_id": entry["requestId"],
+                    "logged": [float(x) for x in logged],
+                    "replayed": [float(x) for x in got],
+                    "max_abs_delta": float(np.max(np.abs(got - logged))),
+                })
+    summary["reports"] = reports
+    return summary
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        description="Re-score a serving request log against a model dir "
+                    "and assert bit-identical replay")
+    p.add_argument("--reqlog-dir", required=True,
+                   help="the server's --reqlog-dir (reqlog-*.avro segments)")
+    p.add_argument("--model-dir", required=True,
+                   help="the model dir holding the lineage the log names")
+    p.add_argument("--feature-shards", required=True,
+                   help="same shard specs the server ran with")
+    p.add_argument("--table-dtype",
+                   choices=["float32", "bfloat16", "int8"],
+                   default="float32",
+                   help="must match the serving table dtype: quantized "
+                        "tables only replay bit-identically against the "
+                        "same storage format")
+    p.add_argument("--require-lineage", action="store_true",
+                   help="skip (instead of replaying) requests logged "
+                        "without a model lineage")
+    args = p.parse_args(argv)
+
+    import jax
+
+    if jax.default_backend() == "cpu" and not jax.config.jax_enable_x64:
+        # the f64 margin accumulation serve_game enables on CPU — replay
+        # must run the same numerics the serving process ran
+        jax.config.update("jax_enable_x64", True)
+
+    from photon_ml_tpu.cli.config import parse_feature_shard_config
+    from photon_ml_tpu.serving import ModelRegistry
+
+    shard_configs = tuple(parse_feature_shard_config(s)
+                          for s in args.feature_shards.split(","))
+    registry = ModelRegistry(shard_configs, table_dtype=args.table_dtype)
+    registry.load(args.model_dir)
+    summary = replay(args.reqlog_dir, registry,
+                     require_lineage=args.require_lineage)
+    for rep in summary.pop("reports"):
+        print(json.dumps(rep), flush=True)
+    summary["metric"] = "reqlog_replay_summary"
+    print(json.dumps(summary), flush=True)
+    if summary["mismatched"]:
+        return 1
+    if not summary["replayed"]:
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
