@@ -1,0 +1,38 @@
+"""Unified static-analysis framework (see ANALYSIS.md).
+
+One engine (:mod:`photon_ml_tpu.analysis.engine`) behind every lint pass:
+
+- :mod:`~photon_ml_tpu.analysis.rules_resilience` — the five resilience
+  hygiene rules (``res-*``), formerly ``tools/check_resilience_hygiene.py``
+- :mod:`~photon_ml_tpu.analysis.rules_telemetry` — the seven telemetry
+  hygiene rules (``tel-*``), formerly ``tools/check_telemetry_hygiene.py``
+- :mod:`~photon_ml_tpu.analysis.rules_trace` — jit/trace purity
+  (``trace-*``): Python side effects inside traced code
+- :mod:`~photon_ml_tpu.analysis.rules_concurrency` — lock discipline
+  (``lock-*``): the ``# guarded-by:`` annotation convention
+- :mod:`~photon_ml_tpu.analysis.rules_project` — whole-tree consistency
+  (``obs-metric-catalog``, ``res-fault-coverage``)
+
+CLI: ``python tools/photon_lint.py`` (all passes) or the legacy hygiene
+shims (their original subsets, unchanged output).
+"""
+
+from photon_ml_tpu.analysis.engine import (
+    Finding,
+    Project,
+    FileContext,
+    Report,
+    all_rules,
+    check_source,
+    run,
+)
+
+__all__ = [
+    "Finding",
+    "FileContext",
+    "Project",
+    "Report",
+    "all_rules",
+    "check_source",
+    "run",
+]

@@ -1,0 +1,224 @@
+"""Resilience hygiene rules (``res-*``) — the five passes that used to be
+``tools/check_resilience_hygiene.py`` (that file is now a thin shim over
+this module; its output format, exit codes and tier-1 test are unchanged).
+
+All five are load-bearing for the resilience subsystem; each rule's
+docstring below is the contract. Messages are byte-identical to the
+pre-engine tool — the shim-compat test locks that.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from photon_ml_tpu.analysis.engine import FileContext, rule
+
+#: the one module allowed to sleep (it owns backoff + injected stalls)
+SLEEP_ALLOWED = {os.path.join("photon_ml_tpu", "resilience", "retry.py")}
+
+#: the package prefix allowed to write model part-files (it owns the
+#: atomic staged publish)
+PART_WRITE_ALLOWED_PREFIX = os.path.join("photon_ml_tpu", "io") + os.sep
+
+#: the one module allowed to spawn or signal processes (it owns the
+#: fleet's process lifecycle)
+PROCESS_ALLOWED = {os.path.join("photon_ml_tpu", "resilience",
+                                "supervisor.py")}
+
+#: the one module allowed to write/derive serving coefficient tables
+#: (EntityCoefficientStore.build / apply_patch)
+STORE_ALLOWED = {os.path.join("photon_ml_tpu", "serving", "store.py")}
+
+
+@rule("res-bare-except",
+      "no bare `except:` — it swallows KeyboardInterrupt/SystemExit")
+def check_bare_except(ctx: FileContext):
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            yield ctx.finding(
+                "res-bare-except", node,
+                "bare `except:` — catch a type (it swallows "
+                "KeyboardInterrupt/SystemExit)")
+
+
+def _is_time_sleep(node: ast.AST, time_aliases: set[str],
+                   sleep_names: set[str]) -> bool:
+    if isinstance(node, ast.Attribute) and node.attr == "sleep":
+        return isinstance(node.value, ast.Name) and node.value.id in time_aliases
+    if isinstance(node, ast.Name):
+        return node.id in sleep_names
+    return False
+
+
+@rule("res-sleep",
+      "no time.sleep outside resilience/retry.py — one wait chokepoint")
+def check_sleep(ctx: FileContext):
+    if ctx.path in {os.path.normpath(p) for p in SLEEP_ALLOWED}:
+        return
+    time_aliases = ctx.module_aliases("time")
+    sleep_names = ctx.from_aliases("time", "sleep")
+    for node in ast.walk(ctx.tree):
+        if _is_time_sleep(node, time_aliases, sleep_names):
+            yield ctx.finding(
+                "res-sleep", node,
+                "time.sleep outside resilience/retry.py — route waits "
+                "through the retry module so deadlines and the watchdog "
+                "see them")
+
+
+def _is_part_file_write(node: ast.AST) -> bool:
+    """True for ``open(..)`` / ``write_avro_file(..)`` calls whose argument
+    tree contains a ``part-*.avro`` string literal (the model part-file
+    naming contract — ``os.path.join(..., "part-00000.avro")`` included)."""
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    name = fn.id if isinstance(fn, ast.Name) else (
+        fn.attr if isinstance(fn, ast.Attribute) else None)
+    if name not in ("open", "write_avro_file"):
+        return False
+    for sub in ast.walk(node):
+        if (isinstance(sub, ast.Constant) and isinstance(sub.value, str)
+                and "part-" in sub.value and sub.value.endswith(".avro")):
+            # reads are fine: only flag an explicit write mode / the writer
+            if name == "write_avro_file":
+                return True
+            mode = None
+            if len(node.args) > 1 and isinstance(node.args[1], ast.Constant):
+                mode = node.args[1].value
+            for kw in node.keywords:
+                if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                    mode = kw.value.value
+            return isinstance(mode, str) and ("w" in mode or "a" in mode
+                                              or "x" in mode)
+    return False
+
+
+@rule("res-part-write",
+      "no model part-file writes outside io/ — atomic staged publish only")
+def check_part_write(ctx: FileContext):
+    if ctx.path.startswith(PART_WRITE_ALLOWED_PREFIX):
+        return
+    for node in ast.walk(ctx.tree):
+        if _is_part_file_write(node):
+            yield ctx.finding(
+                "res-part-write", node,
+                "model part-file write outside io/ — a bare part-*.avro "
+                "write bypasses the atomic staged publish; route through "
+                "io.model_io.save_game_model / io.pipeline.BackgroundSaver")
+
+
+def _is_process_call(node: ast.AST, subprocess_aliases: set[str],
+                     os_aliases: set[str], popen_names: set[str],
+                     kill_names: set[str]) -> bool:
+    """True for ``subprocess.Popen(..)`` / ``os.kill``/``os.killpg`` calls
+    (module- and from-import aliases included)."""
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+        if fn.attr == "Popen" and fn.value.id in subprocess_aliases:
+            return True
+        if fn.attr in ("kill", "killpg") and fn.value.id in os_aliases:
+            return True
+    if isinstance(fn, ast.Name):
+        return fn.id in popen_names or fn.id in kill_names
+    return False
+
+
+@rule("res-process",
+      "no subprocess.Popen/os.kill outside resilience/supervisor.py")
+def check_process(ctx: FileContext):
+    if ctx.path in {os.path.normpath(p) for p in PROCESS_ALLOWED}:
+        return
+    subprocess_aliases = ctx.module_aliases("subprocess")
+    os_aliases = ctx.module_aliases("os")
+    popen_names = ctx.from_aliases("subprocess", "Popen")
+    kill_names = ctx.from_aliases("os", "kill", "killpg")
+    for node in ast.walk(ctx.tree):
+        if _is_process_call(node, subprocess_aliases, os_aliases,
+                            popen_names, kill_names):
+            yield ctx.finding(
+                "res-process", node,
+                "subprocess.Popen/os.kill outside resilience/supervisor.py "
+                "— process lifecycle must stay visible to the fleet "
+                "supervisor (an untracked child survives _kill_fleet or "
+                "dies without a liveness signal); route process management "
+                "through FleetSupervisor")
+
+
+def _is_table_attr(node: ast.AST) -> bool:
+    return isinstance(node, ast.Attribute) and node.attr == "table"
+
+
+def _contains_table_attr(node: ast.AST) -> bool:
+    return any(_is_table_attr(sub) for sub in ast.walk(node))
+
+
+def _store_table_writes(tree: ast.AST) -> list[ast.AST]:
+    """Nodes mutating/deriving a serving ``.table``: subscript or attribute
+    assignment targets over ``<expr>.table``, and functional
+    ``<expr>.table.at[...]`` updates."""
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                if _is_table_attr(t):
+                    out.append(t)
+                elif isinstance(t, ast.Subscript) and _is_table_attr(t.value):
+                    out.append(t)
+        elif (isinstance(node, ast.Attribute) and node.attr == "at"
+              and _is_table_attr(node.value)):
+            out.append(node)
+    return out
+
+
+def _store_table_quant(tree: ast.AST) -> list[ast.AST]:
+    """Quantization half of the table rule: an ``.astype(...)`` cast whose
+    receiver involves ``.table``, or a ``*`` / ``/`` arithmetic expression
+    with a ``.table`` operand (a scale multiply/divide) — either is an
+    ad-hoc quantize/dequantize outside the store's one sanctioned format
+    home (``quantize_rows`` / ``gather_rows``)."""
+    out = []
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "astype"
+                and _contains_table_attr(node.func.value)):
+            out.append(node)
+        elif (isinstance(node, ast.BinOp)
+              and isinstance(node.op, (ast.Mult, ast.Div))
+              and (_contains_table_attr(node.left)
+                   or _contains_table_attr(node.right))):
+            out.append(node)
+    return out
+
+
+@rule("res-table-home",
+      "serving coefficient-table writes and quantize/dequantize math stay "
+      "in serving/store.py")
+def check_table_home(ctx: FileContext):
+    if ctx.path in {os.path.normpath(p) for p in STORE_ALLOWED}:
+        return
+    for node in _store_table_writes(ctx.tree):
+        yield ctx.finding(
+            "res-table-home", node,
+            "serving coefficient-table write outside serving/store.py — "
+            "version tables are immutable (hot-swap/rollback and the "
+            "delta path depend on it); derive new tables through "
+            "EntityCoefficientStore.build/apply_patch")
+    for node in _store_table_quant(ctx.tree):
+        yield ctx.finding(
+            "res-table-home", node,
+            "quantize/dequantize of a serving .table array outside "
+            "serving/store.py — table storage format (dtype + per-row "
+            "scales) is a store.py-private contract; read rows through "
+            "store.gather_rows / device_params")
+
+
+#: the shim's rule subset, in the legacy tool's documented order
+RESILIENCE_RULE_IDS = ("res-bare-except", "res-sleep", "res-part-write",
+                       "res-process", "res-table-home")

@@ -1,0 +1,308 @@
+"""Telemetry hygiene rules (``tel-*``) — the seven passes that used to be
+``tools/check_telemetry_hygiene.py`` (now a thin shim over this module;
+output format, exit codes and tier-1 test unchanged).
+
+Messages are byte-identical to the pre-engine tool — the shim-compat test
+locks that.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from photon_ml_tpu.analysis.engine import FileContext, rule
+
+#: stdout owners: the CLI drivers and the module runner
+PRINT_ALLOWED_PREFIXES = (
+    os.path.join("photon_ml_tpu", "cli") + os.sep,
+)
+PRINT_ALLOWED_FILES = {os.path.join("photon_ml_tpu", "__main__.py")}
+
+#: the one subtree whose job IS timing: the sanctioned timers live here
+TIMING_ALLOWED_PREFIX = os.path.join("photon_ml_tpu", "telemetry") + os.sep
+
+#: the one place allowed to construct MetricsRegistry instances
+REGISTRY_ALLOWED_PREFIX = os.path.join("photon_ml_tpu", "telemetry") + os.sep
+
+#: metric-family registration methods/functions
+METRIC_FACTORIES = frozenset({"counter", "gauge", "histogram"})
+
+METRIC_NAME_RE = re.compile(r"photon_[a-z0-9_]+\Z")
+
+#: the one subtree whose job IS score binning + drift statistics
+QUALITY_ALLOWED_PREFIX = os.path.join("photon_ml_tpu", "quality") + os.sep
+
+#: numpy/jax.numpy histogram-binning entry points
+HISTOGRAM_ATTRS = frozenset({"histogram", "histogram2d", "histogramdd",
+                             "histogram_bin_edges"})
+
+#: drift-statistic names whose DEFINITION outside quality/ forks the
+#: arithmetic (calling quality's exported functions is of course fine)
+DRIFT_STAT_NAMES = frozenset({"population_stability_index", "psi",
+                              "ks_statistic", "kolmogorov_smirnov"})
+
+#: the one request-id mint (serving/http.py) and the request-id
+#: generation primitives whose CALL anywhere else forks request identity
+REQUEST_ID_ALLOWED_FILES = {os.path.join("photon_ml_tpu", "serving",
+                                         "http.py")}
+ID_GEN_UUID_FNS = frozenset({"uuid1", "uuid3", "uuid4", "uuid5"})
+ID_GEN_SECRETS_FNS = frozenset({"token_hex", "token_urlsafe"})
+
+#: the one RequestLogAvro writer (serving/reqlog.py) plus the schema's
+#: definition site
+REQLOG_SCHEMA_NAME = "REQUEST_LOG_AVRO"
+REQLOG_ALLOWED_FILES = {
+    os.path.join("photon_ml_tpu", "serving", "reqlog.py"),
+    os.path.join("photon_ml_tpu", "io", "schemas.py"),
+}
+
+
+def _print_ok(ctx: FileContext) -> bool:
+    return (ctx.path in PRINT_ALLOWED_FILES
+            or any(ctx.path.startswith(p) for p in PRINT_ALLOWED_PREFIXES))
+
+
+@rule("tel-print",
+      "no print() outside CLI entry points — stdout belongs to the drivers")
+def check_print(ctx: FileContext):
+    if _print_ok(ctx):
+        return
+    for node in ast.walk(ctx.tree):
+        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                and node.func.id == "print"):
+            yield ctx.finding(
+                "tel-print", node,
+                "print() outside a CLI entry point — library code logs, "
+                "counts (telemetry.metrics) or spans (telemetry.tracing); "
+                "stdout belongs to the drivers")
+
+
+def _is_perf_counter(node: ast.AST, time_aliases: set[str],
+                     pc_names: set[str]) -> bool:
+    if isinstance(node, ast.Attribute) and node.attr == "perf_counter":
+        return (isinstance(node.value, ast.Name)
+                and node.value.id in time_aliases)
+    if isinstance(node, ast.Name):
+        return node.id in pc_names
+    return False
+
+
+@rule("tel-perf-counter",
+      "no time.perf_counter outside telemetry/ — durations route through "
+      "registry timers/spans")
+def check_perf_counter(ctx: FileContext):
+    if ctx.path.startswith(TIMING_ALLOWED_PREFIX):
+        return
+    time_aliases = ctx.module_aliases("time")
+    pc_names = ctx.from_aliases("time", "perf_counter")
+    for node in ast.walk(ctx.tree):
+        if _is_perf_counter(node, time_aliases, pc_names):
+            yield ctx.finding(
+                "tel-perf-counter", node,
+                "time.perf_counter outside telemetry/ — measure durations "
+                "through the metrics registry's Histogram.time() or a "
+                "tracing span so /metrics and trace.jsonl see them")
+
+
+@rule("tel-wall-clock",
+      "no wall-clock duration arithmetic — time.time() is a timestamp, "
+      "not a timer")
+def check_wall_clock(ctx: FileContext):
+    if ctx.path.startswith(TIMING_ALLOWED_PREFIX):
+        return
+    time_aliases = ctx.module_aliases("time")
+    tt_names = ctx.from_aliases("time", "time")
+
+    def _is_wall_clock_call(node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "time":
+            return (isinstance(f.value, ast.Name)
+                    and f.value.id in time_aliases)
+        return isinstance(f, ast.Name) and f.id in tt_names
+
+    for node in ast.walk(ctx.tree):
+        if (isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub)
+                and (_is_wall_clock_call(node.left)
+                     or _is_wall_clock_call(node.right))):
+            yield ctx.finding(
+                "tel-wall-clock", node,
+                "duration computed from time.time() — the wall clock is "
+                "for timestamps (it jumps); measure durations with a "
+                "registry timer or a tracing span")
+
+
+def _metric_call_args(node: ast.Call):
+    """(name, help) literals of a metric-factory call; non-literal fields
+    come back as None (dynamic names/helps are out of the lint's reach —
+    the registry's internal plumbing passes them through variables)."""
+    name = help_ = None
+    if node.args and isinstance(node.args[0], ast.Constant) \
+            and isinstance(node.args[0].value, str):
+        name = node.args[0].value
+    if len(node.args) > 1 and isinstance(node.args[1], ast.Constant) \
+            and isinstance(node.args[1].value, str):
+        help_ = node.args[1].value
+    for kw in node.keywords:
+        if kw.arg == "help_" and isinstance(kw.value, ast.Constant) \
+                and isinstance(kw.value.value, str):
+            help_ = kw.value.value
+    has_help_arg = len(node.args) > 1 or any(kw.arg == "help_"
+                                             for kw in node.keywords)
+    return name, help_, has_help_arg
+
+
+def _factory_calls(ctx: FileContext):
+    """Every metric-factory call node in the file (attribute spelling on
+    any receiver, or a from-imported factory name)."""
+    metric_fn_names = ctx.from_aliases("photon_ml_tpu.telemetry.metrics",
+                                       *METRIC_FACTORIES)
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if ((isinstance(func, ast.Attribute)
+             and func.attr in METRIC_FACTORIES)
+                or (isinstance(func, ast.Name)
+                    and func.id in metric_fn_names)):
+            yield node
+
+
+@rule("tel-metric-name",
+      "literal metric names match photon_[a-z0-9_]+ and carry help text")
+def check_metric_name(ctx: FileContext):
+    for node in _factory_calls(ctx):
+        name, help_, has_help = _metric_call_args(node)
+        if name is None:
+            continue
+        if not METRIC_NAME_RE.fullmatch(name):
+            yield ctx.finding(
+                "tel-metric-name", node,
+                f"metric name {name!r} must match photon_[a-z0-9_]+ — the "
+                f"fleet aggregate merges by family name, so every family "
+                f"carries the photon_ prefix")
+        if not has_help or (help_ is not None and not help_.strip()):
+            yield ctx.finding(
+                "tel-metric-name", node,
+                f"metric {name!r} registered without help text — a scrape "
+                f"nobody can interpret; say what the number means")
+
+
+@rule("tel-registry",
+      "no MetricsRegistry() outside telemetry/ — one process-global "
+      "registry")
+def check_registry(ctx: FileContext):
+    if ctx.path.startswith(REGISTRY_ALLOWED_PREFIX):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if ((isinstance(func, ast.Name) and func.id == "MetricsRegistry")
+                or (isinstance(func, ast.Attribute)
+                    and func.attr == "MetricsRegistry")):
+            yield ctx.finding(
+                "tel-registry", node,
+                "MetricsRegistry() outside photon_ml_tpu/telemetry/ — the "
+                "process-global default_registry() is the only sanctioned "
+                "registry outside tests; a private one forks the namespace "
+                "away from /metrics and the fleet fold")
+
+
+def _np_aliases(ctx: FileContext) -> set[str]:
+    out = ctx.module_aliases("numpy")
+    out |= {a for a in ctx.module_aliases("jax.numpy")}
+    out |= ctx.from_aliases("jax", "numpy")
+    return out
+
+
+@rule("tel-drift-home",
+      "score binning + PSI/KS live in quality/ — one drift arithmetic")
+def check_drift_home(ctx: FileContext):
+    if ctx.path.startswith(QUALITY_ALLOWED_PREFIX):
+        return
+    np_aliases = _np_aliases(ctx)
+
+    def _is_np_module(v: ast.AST) -> bool:
+        if isinstance(v, ast.Name):
+            return v.id in np_aliases
+        # the bare `import jax.numpy` spelling: jax.numpy.histogram(...)
+        return (isinstance(v, ast.Attribute) and v.attr == "numpy"
+                and isinstance(v.value, ast.Name) and v.value.id == "jax")
+
+    for node in ast.walk(ctx.tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in HISTOGRAM_ATTRS
+                and _is_np_module(node.func.value)):
+            yield ctx.finding(
+                "tel-drift-home", node,
+                f"{node.func.attr}() outside photon_ml_tpu/quality/ — "
+                f"score-histogram binning lives in quality/baseline.py "
+                f"(bin_scores/quantile_edges) so live and baseline "
+                f"distributions always share bin edges; a second binning "
+                f"silently redefines drift")
+        elif (isinstance(node, ast.FunctionDef)
+              and node.name in DRIFT_STAT_NAMES):
+            yield ctx.finding(
+                "tel-drift-home", node,
+                f"drift statistic {node.name}() defined outside "
+                f"photon_ml_tpu/quality/ — PSI/KS have ONE implementation "
+                f"(quality/baseline.py); import it instead of re-deriving "
+                f"the arithmetic")
+
+
+@rule("tel-request-identity",
+      "request ids are minted in serving/http.py only; RequestLogAvro is "
+      "written by serving/reqlog.py only")
+def check_request_identity(ctx: FileContext):
+    uuid_aliases = ctx.module_aliases("uuid")
+    secrets_aliases = ctx.module_aliases("secrets")
+    id_gen_names = (ctx.from_aliases("uuid", *ID_GEN_UUID_FNS)
+                    | ctx.from_aliases("secrets", *ID_GEN_SECRETS_FNS))
+
+    def _is_id_gen_call(node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        f = node.func
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+            return ((f.value.id in uuid_aliases
+                     and f.attr in ID_GEN_UUID_FNS)
+                    or (f.value.id in secrets_aliases
+                        and f.attr in ID_GEN_SECRETS_FNS))
+        return isinstance(f, ast.Name) and f.id in id_gen_names
+
+    def _is_reqlog_schema_ref(node: ast.AST) -> bool:
+        if isinstance(node, ast.Name) and node.id == REQLOG_SCHEMA_NAME:
+            return True
+        if isinstance(node, ast.Attribute) and node.attr == REQLOG_SCHEMA_NAME:
+            return True
+        return (isinstance(node, ast.ImportFrom)
+                and any(a.name == REQLOG_SCHEMA_NAME for a in node.names))
+
+    id_gen_banned = ctx.path not in REQUEST_ID_ALLOWED_FILES
+    reqlog_banned = ctx.path not in REQLOG_ALLOWED_FILES
+    for node in ast.walk(ctx.tree):
+        if id_gen_banned and _is_id_gen_call(node):
+            yield ctx.finding(
+                "tel-request-identity", node,
+                "request-id generation outside photon_ml_tpu/serving/"
+                "http.py — a serving request is identified ONCE "
+                "(new_request_id); a second mint breaks the span/reqlog/"
+                "response join (hygiene rule 7)")
+        elif reqlog_banned and _is_reqlog_schema_ref(node):
+            yield ctx.finding(
+                "tel-request-identity", node,
+                f"{REQLOG_SCHEMA_NAME} referenced outside "
+                f"photon_ml_tpu/serving/reqlog.py — the request log has "
+                f"ONE writer; a second one forks the on-disk format away "
+                f"from tools/reqlog_replay.py (hygiene rule 7)")
+
+
+#: the shim's rule subset, in the legacy tool's documented order
+TELEMETRY_RULE_IDS = ("tel-print", "tel-perf-counter", "tel-metric-name",
+                      "tel-registry", "tel-wall-clock", "tel-drift-home",
+                      "tel-request-identity")

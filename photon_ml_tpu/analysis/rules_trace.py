@@ -1,0 +1,356 @@
+"""Trace-safety rules (``trace-*``): Python side effects inside jit-traced
+code — the static half of the zero-recompile and bit-parity contracts.
+
+A jitted function's Python body runs at TRACE time only; anything impure
+there either silently runs once per compile (a print that "works" in a
+unit test and never fires in production), reads a clock/rng that bakes a
+trace-time value into every execution, or forces a host sync that defeats
+async dispatch. None of those break a test — they rot silently until a
+recompile or a refactor changes behavior. These rules walk every function
+*reachable from a trace-registration site* in the same module and flag
+what AST analysis can actually prove:
+
+- registration sites: ``@jax.jit`` / ``jax.jit(f)`` (incl.
+  ``functools.partial(jax.jit, ...)`` decorators and ``jit(vmap(f))``
+  nesting), ``profile_jit(f, name)``, ``pl.pallas_call(kernel, ...)``,
+  ``@jax.custom_batching.custom_vmap``;
+- reachability: same-file calls from a traced function to a named
+  function (module-level or nested) mark the callee traced too —
+  cross-module reachability is out of static reach and out of scope;
+- ``trace-print`` — ``print()`` inside traced code;
+- ``trace-clock`` — any ``time.*`` call inside traced code (a trace-time
+  clock read is a constant baked into the executable);
+- ``trace-random`` — stdlib ``random.*`` / ``np.random.*`` calls (host
+  RNG state read at trace time; use ``jax.random`` with explicit keys);
+- ``trace-host-sync`` — ``.item()`` calls, ``np.asarray``/``np.array``
+  over traced values, and ``float(x)``/``int(x)`` applied directly to a
+  function parameter (almost certainly a tracer): each forces the device
+  to sync mid-trace or fails under jit;
+- ``trace-mutable-global`` — a ``global`` statement, or a read of a
+  module-level name bound to a mutable literal (``list``/``dict``/``set``
+  and friends): closure-captured mutable state makes the traced program
+  depend on when tracing happened.
+
+Intentional trace-time effects exist (e.g. the serving engine counts
+compiles from inside the traced body BECAUSE it runs once per trace) —
+those carry a justified ``# photon-lint: disable=trace-* -- reason``
+suppression, which is the point: the exception is written down where it
+lives.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from photon_ml_tpu.analysis.engine import FileContext, rule
+
+#: call/decorator heads that register a function for tracing
+_TRACE_WRAPPER_ATTRS = frozenset({"jit", "pallas_call", "custom_vmap",
+                                  "profile_jit"})
+
+#: container constructors whose module-level result is mutable shared state
+_MUTABLE_CTORS = frozenset({"list", "dict", "set", "deque", "defaultdict",
+                            "OrderedDict", "Counter"})
+
+
+def _head_name(expr: ast.AST) -> Optional[str]:
+    """The trailing identifier of a Name/Attribute chain (``jax.jit`` →
+    ``jit``; ``pl.pallas_call`` → ``pallas_call``)."""
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+def _is_trace_wrapper(expr: ast.AST) -> bool:
+    """True when ``expr`` names a tracing entry point. Also looks through
+    ``functools.partial(jax.jit, ...)`` decorator spellings."""
+    if _head_name(expr) in _TRACE_WRAPPER_ATTRS:
+        return True
+    if isinstance(expr, ast.Call) and _head_name(expr.func) == "partial":
+        return any(_is_trace_wrapper(a) for a in expr.args[:1])
+    return False
+
+
+def _unwrap_fn_arg(arg: ast.AST) -> ast.AST:
+    """Look through wrapper calls (``jit(vmap(f))`` → ``f``)."""
+    while isinstance(arg, ast.Call) and arg.args:
+        arg = arg.args[0]
+    return arg
+
+
+class _Scopes:
+    """Lexical scope index: resolve a bare function name at any node the
+    way Python would (innermost def outward; class bodies are NOT in the
+    chain — a method is never reachable by bare name from nested code)."""
+
+    def __init__(self, tree: ast.Module):
+        scope_types = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        #: id(node) -> innermost enclosing scope node (None = module)
+        self.enclosing: dict[int, Optional[ast.AST]] = {}
+        #: scope key -> {name: FunctionDef} of functions DIRECTLY inside
+        self.defs: dict[Optional[int], dict[str, ast.AST]] = {None: {}}
+        # BFS order puts outer scopes first, so inner walks overwrite —
+        # the final value is the innermost enclosing scope
+        scopes = [n for n in ast.walk(tree) if isinstance(n, scope_types)]
+        for scope in scopes:
+            parent = self.enclosing.get(id(scope))
+            if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                key = None if parent is None else id(parent)
+                self.defs.setdefault(key, {})[scope.name] = scope
+            for n in ast.walk(scope):
+                if n is not scope:
+                    self.enclosing[id(n)] = scope
+        self._parent = {id(s): self.enclosing.get(id(s)) for s in scopes}
+
+    def resolve(self, name: str, at: ast.AST) -> Optional[ast.AST]:
+        scope = self.enclosing.get(id(at))
+        first = True
+        while True:
+            # class scopes resolve names only for code directly in the
+            # class body, never for nested functions (Python scoping)
+            if not isinstance(scope, ast.ClassDef) or first:
+                fn = self.defs.get(None if scope is None
+                                   else id(scope), {}).get(name)
+                if fn is not None:
+                    return fn
+            first = False
+            if scope is None:
+                return None
+            scope = self._parent.get(id(scope))
+
+
+def traced_functions(ctx: FileContext) -> list:
+    """Every function node reachable from a trace-registration site in
+    this file (decorated, passed to a wrapper by name, or called by name
+    from an already-traced function). Names resolve lexically, so a
+    method that merely shares a name with a traced local function is not
+    dragged in."""
+    scopes = _Scopes(ctx.tree)
+    traced: list = []
+    seen: set[int] = set()
+
+    def add(node) -> None:
+        if id(node) not in seen:
+            seen.add(id(node))
+            traced.append(node)
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_is_trace_wrapper(d) for d in node.decorator_list):
+                add(node)
+        elif isinstance(node, ast.Call) and _is_trace_wrapper(node.func):
+            arg = _unwrap_fn_arg(node.args[0]) if node.args else None
+            if isinstance(arg, ast.Name):
+                fn = scopes.resolve(arg.id, node)
+                if fn is not None:
+                    add(fn)
+            elif isinstance(arg, ast.Lambda):
+                add(arg)
+    # fixed point over same-file calls by name
+    frontier = list(traced)
+    while frontier:
+        fn = frontier.pop()
+        for node in _iter_traced_nodes(fn):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)):
+                callee = scopes.resolve(node.func.id, node)
+                if callee is not None and id(callee) not in seen:
+                    add(callee)
+                    frontier.append(callee)
+    return traced
+
+
+def _mutable_globals(tree: ast.Module) -> set[str]:
+    """Module-level names bound to a mutable literal or container
+    constructor — the closure captures a traced function must not read."""
+    out: set[str] = set()
+    for stmt in tree.body:
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+            targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                       else [stmt.target])
+            value = stmt.value
+            mutable = isinstance(value, (ast.List, ast.Dict, ast.Set,
+                                         ast.ListComp, ast.DictComp,
+                                         ast.SetComp))
+            if (isinstance(value, ast.Call)
+                    and _head_name(value.func) in _MUTABLE_CTORS):
+                mutable = True
+            if not mutable:
+                continue
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+def _iter_traced_nodes(fn) -> Iterator[ast.AST]:
+    """Walk a traced function's body — nested defs included (they trace
+    with it)."""
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    for stmt in body:
+        yield from ast.walk(stmt)
+
+
+def _param_names(fn) -> set[str]:
+    args = fn.args
+    names = [a.arg for a in (args.posonlyargs + args.args + args.kwonlyargs)]
+    if args.vararg:
+        names.append(args.vararg.arg)
+    if args.kwarg:
+        names.append(args.kwarg.arg)
+    return set(names)
+
+
+def _fn_label(fn) -> str:
+    return getattr(fn, "name", "<lambda>")
+
+
+@rule("trace-print", "no print() inside jit-traced code", scope="all")
+def check_trace_print(ctx: FileContext):
+    for fn in traced_functions(ctx):
+        for node in _iter_traced_nodes(fn):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "print"):
+                yield ctx.finding(
+                    "trace-print", node,
+                    f"print() inside traced function {_fn_label(fn)}() — "
+                    f"it runs at trace time only (once per compiled "
+                    f"shape, never per call); use jax.debug.print or log "
+                    f"outside the jit boundary")
+
+
+@rule("trace-clock", "no time.* calls inside jit-traced code", scope="all")
+def check_trace_clock(ctx: FileContext):
+    time_aliases = ctx.module_aliases("time")
+    time_fn_names = ctx.from_aliases("time", "time", "perf_counter",
+                                     "monotonic", "sleep", "process_time",
+                                     "monotonic_ns", "perf_counter_ns",
+                                     "time_ns")
+    for fn in traced_functions(ctx):
+        for node in _iter_traced_nodes(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            hit = (isinstance(f, ast.Attribute)
+                   and isinstance(f.value, ast.Name)
+                   and f.value.id in time_aliases) \
+                or (isinstance(f, ast.Name) and f.id in time_fn_names)
+            if hit:
+                yield ctx.finding(
+                    "trace-clock", node,
+                    f"clock read inside traced function {_fn_label(fn)}() "
+                    f"— it executes at trace time and bakes that instant "
+                    f"into the compiled program; measure outside the jit "
+                    f"boundary (registry timers / spans)")
+
+
+@rule("trace-random",
+      "no host RNG (random.* / np.random.*) inside jit-traced code",
+      scope="all")
+def check_trace_random(ctx: FileContext):
+    random_aliases = ctx.module_aliases("random")
+    np_aliases = ctx.module_aliases("numpy") | ctx.from_aliases("jax",
+                                                                "numpy")
+    random_fn_names = ctx.from_aliases(
+        "random", "random", "randint", "randrange", "uniform", "choice",
+        "shuffle", "sample", "gauss", "normalvariate")
+    for fn in traced_functions(ctx):
+        for node in _iter_traced_nodes(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            hit = False
+            if isinstance(f, ast.Attribute):
+                v = f.value
+                # random.<fn>(...)
+                if isinstance(v, ast.Name) and v.id in random_aliases:
+                    hit = True
+                # np.random.<fn>(...)
+                elif (isinstance(v, ast.Attribute) and v.attr == "random"
+                      and isinstance(v.value, ast.Name)
+                      and v.value.id in np_aliases):
+                    hit = True
+            elif isinstance(f, ast.Name) and f.id in random_fn_names:
+                hit = True
+            if hit:
+                yield ctx.finding(
+                    "trace-random", node,
+                    f"host RNG call inside traced function "
+                    f"{_fn_label(fn)}() — the draw happens at trace time "
+                    f"and freezes into the executable (bit-parity breaks "
+                    f"across recompiles); thread a jax.random key instead")
+
+
+@rule("trace-host-sync",
+      "no host syncs (.item(), np.asarray, float(param)) inside jit-traced "
+      "code", scope="all")
+def check_trace_host_sync(ctx: FileContext):
+    np_aliases = ctx.module_aliases("numpy")
+    for fn in traced_functions(ctx):
+        params = _param_names(fn)
+        for node in _iter_traced_nodes(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr == "item":
+                yield ctx.finding(
+                    "trace-host-sync", node,
+                    f".item() inside traced function {_fn_label(fn)}() — "
+                    f"forces a device sync mid-trace (and fails on "
+                    f"abstract tracers); keep values on device or move "
+                    f"the read outside the jit boundary")
+            elif (isinstance(f, ast.Attribute)
+                  and f.attr in ("asarray", "array")
+                  and isinstance(f.value, ast.Name)
+                  and f.value.id in np_aliases):
+                yield ctx.finding(
+                    "trace-host-sync", node,
+                    f"np.{f.attr}() inside traced function "
+                    f"{_fn_label(fn)}() — materializes the value on the "
+                    f"host at trace time; use jnp.{f.attr} (stays on "
+                    f"device) or hoist the conversion out of the trace")
+            elif (isinstance(f, ast.Name) and f.id in ("float", "int")
+                  and len(node.args) == 1
+                  and isinstance(node.args[0], ast.Name)
+                  and node.args[0].id in params):
+                yield ctx.finding(
+                    "trace-host-sync", node,
+                    f"{f.id}() over parameter {node.args[0].id!r} inside "
+                    f"traced function {_fn_label(fn)}() — concretizes a "
+                    f"tracer (host sync, or ConcretizationTypeError under "
+                    f"jit); keep the value abstract or mark the argument "
+                    f"static")
+
+
+@rule("trace-mutable-global",
+      "no mutable module-global capture inside jit-traced code",
+      scope="all")
+def check_trace_mutable_global(ctx: FileContext):
+    mutable = _mutable_globals(ctx.tree)
+    for fn in traced_functions(ctx):
+        local_stores: set[str] = set()
+        for node in _iter_traced_nodes(fn):
+            if isinstance(node, ast.Name) and isinstance(node.ctx,
+                                                         ast.Store):
+                local_stores.add(node.id)
+        for node in _iter_traced_nodes(fn):
+            if isinstance(node, ast.Global):
+                yield ctx.finding(
+                    "trace-mutable-global", node,
+                    f"`global` inside traced function {_fn_label(fn)}() — "
+                    f"trace-time writes to module state run once per "
+                    f"compile, not per call; return the value instead")
+            elif (isinstance(node, ast.Name)
+                  and isinstance(node.ctx, ast.Load)
+                  and node.id in mutable and node.id not in local_stores):
+                yield ctx.finding(
+                    "trace-mutable-global", node,
+                    f"traced function {_fn_label(fn)}() reads mutable "
+                    f"module global {node.id!r} — the closure captures "
+                    f"whatever it held at trace time (silent staleness "
+                    f"after mutation, and a recompile changes behavior); "
+                    f"pass it as an argument or make it immutable")

@@ -270,7 +270,7 @@ class BackgroundSaver:
         self._saves = ThreadPoolExecutor(
             max_workers=save_workers, thread_name_prefix="photon-save")
         self._lock = threading.Lock()
-        self._pending: list[tuple[str, Future]] = []
+        self._pending: list[tuple[str, Future]] = []  # guarded-by: _lock
 
     # --- submission -------------------------------------------------------
     def _track(self, label: str, fut: Future) -> Future:

@@ -92,12 +92,12 @@ class QualityMonitor:
                        if baseline is not None and baseline.edges else None)
         self._counts = (np.zeros(len(baseline.proportions), np.float64)
                         if baseline is not None and baseline.proportions
-                        else None)
-        self._rows = 0
-        self._score_sum = 0.0
-        self._cold: dict[str, int] = {}
-        self._cov_nnz: dict[str, int] = {}
-        self._cov_cells: dict[str, int] = {}
+                        else None)  # guarded-by: _lock
+        self._rows = 0  # guarded-by: _lock
+        self._score_sum = 0.0  # guarded-by: _lock
+        self._cold: dict[str, int] = {}  # guarded-by: _lock
+        self._cov_nnz: dict[str, int] = {}  # guarded-by: _lock
+        self._cov_cells: dict[str, int] = {}  # guarded-by: _lock
 
     # --- accumulation (engine side) ---------------------------------------
     def observe(self, scores: np.ndarray,
@@ -195,10 +195,15 @@ class DriftEvaluator:
         self.threshold = float(threshold)
         self.min_rows = int(min_rows)
         self.poll_s = float(poll_s)
-        self.n_detections = 0
+        #: the evaluator thread and synchronous callers (tests, a manual
+        #: evaluate_once) both touch these — the lock-discipline pass
+        #: flagged the bare writes, so they now share a lock
+        self._lock = threading.Lock()
+        self.n_detections = 0  # guarded-by: _lock
         self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
-        self.last: dict = {}
+        #: start/stop are operator-lifecycle calls from one control thread
+        self._thread: Optional[threading.Thread] = None  # guarded-by: caller
+        self.last: dict = {}  # guarded-by: _lock
 
     def evaluate_once(self) -> dict:
         """One evaluation pass: compute drift scores for the active
@@ -216,13 +221,15 @@ class DriftEvaluator:
             _DRIFT.labels(coordinate=coordinate, kind=kind).set(value)
         psi = scores.get((TOTAL_COORDINATE, "psi"))
         if psi is not None and psi > self.threshold:
-            self.n_detections += 1
+            with self._lock:
+                self.n_detections += 1
             self.registry.bus.post(
                 "quality_drift_detected", version=sm.version,
                 psi=round(psi, 6),
                 ks=round(scores.get((TOTAL_COORDINATE, "ks"), 0.0), 6),
                 threshold=self.threshold, rows=monitor.n_rows)
-        self.last = {f"{c}/{k}": v for (c, k), v in scores.items()}
+        with self._lock:
+            self.last = {f"{c}/{k}": v for (c, k), v in scores.items()}
         return scores
 
     # --- lifecycle --------------------------------------------------------

@@ -89,15 +89,16 @@ class MicroBatcher:
         self.max_batch = max_batch
         self.max_wait_s = max_wait_ms / 1000.0
         self._cond = threading.Condition()
-        self._queue: collections.deque = collections.deque()
-        self._closed = False
+        self._queue: collections.deque = collections.deque()  # guarded-by: _cond
+        self._closed = False  # guarded-by: _cond
         #: the BaseException that killed the worker, None while healthy
-        self._dead: Optional[BaseException] = None
+        self._dead: Optional[BaseException] = None  # guarded-by: _cond
         #: the batch the worker is scoring right now — failed alongside the
         #: queue if the worker dies mid-score
-        self._inflight: list = []
-        self.n_batches = 0
-        self.n_coalesced = 0  # requests that shared a batch with others
+        self._inflight: list = []  # guarded-by: _cond
+        self.n_batches = 0  # guarded-by: _cond
+        #: requests that shared a batch with others
+        self.n_coalesced = 0  # guarded-by: _cond
         self._worker = threading.Thread(target=self._run, daemon=True,
                                         name="photon-serving-batcher")
         self._worker.start()
@@ -175,9 +176,13 @@ class MicroBatcher:
                 f"score_fn returned {arr.shape[:1] or (0,)} scores "
                 f"for a batch of {len(batch)}"))
             return
-        self.n_batches += 1
-        if len(batch) > 1:
-            self.n_coalesced += len(batch)
+        with self._cond:
+            # the worker is the only writer, but healthz/tests read these
+            # stats from other threads — the lock-discipline pass flagged
+            # the bare increments
+            self.n_batches += 1
+            if len(batch) > 1:
+                self.n_coalesced += len(batch)
         self._finish(batch, scores=arr)
 
     def _finish(self, batch: list, *, scores=None, exception=None) -> None:

@@ -130,9 +130,11 @@ class ScoringEngine:
                    for cid in self._re_order},
         }
         self._lock = threading.Lock()
+        #: bumped from inside the traced body (trace time only — jit
+        #: serializes traces), so it is deliberately NOT lock-annotated
         self._compile_count = 0
-        self._n_calls = 0
-        self._n_scored = 0
+        self._n_calls = 0  # guarded-by: _lock
+        self._n_scored = 0  # guarded-by: _lock
         #: optional photon_ml_tpu.quality.QualityMonitor, attached by the
         #: registry at load time. Accumulation is host-side numpy over
         #: arrays score_batch already holds — the jitted program, the f32

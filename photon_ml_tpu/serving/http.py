@@ -92,8 +92,8 @@ class ServingService:
         self.batcher = batcher
         self.reqlog = reqlog
         self._lock = threading.Lock()
-        self.n_requests = 0
-        self.n_scored = 0
+        self.n_requests = 0  # guarded-by: _lock
+        self.n_scored = 0  # guarded-by: _lock
         # monotonic: uptime is a DURATION (immune to wall-clock jumps, and
         # telemetry hygiene rule 5 bans wall-clock arithmetic for durations)
         self._started_monotonic = time.monotonic()
@@ -304,7 +304,8 @@ class GameServer:
         self.service = service
         self._httpd = ThreadingHTTPServer((host, port),
                                           _make_handler(service))
-        self._thread: Optional[threading.Thread] = None
+        #: start/stop are operator-lifecycle calls from one control thread
+        self._thread: Optional[threading.Thread] = None  # guarded-by: caller
 
     @property
     def port(self) -> int:

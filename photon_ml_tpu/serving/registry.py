@@ -134,9 +134,9 @@ class ModelRegistry:
 
         bridge.bind(bus=self.bus)
         self._lock = threading.Lock()
-        self._versions: dict[int, ServingModel] = {}
-        self._active: Optional[ServingModel] = None
-        self._next_version = 1
+        self._versions: dict[int, ServingModel] = {}  # guarded-by: _lock
+        self._active: Optional[ServingModel] = None  # guarded-by: _lock
+        self._next_version = 1  # guarded-by: _lock
 
     # --- queries ----------------------------------------------------------
     def active(self) -> ServingModel:

@@ -97,20 +97,21 @@ class RequestLog:
             part_workers=1, save_workers=1)
         self._own_saver = saver is None
         self._lock = threading.Lock()
-        self._buffer: list[dict] = []
-        self._in_flight = 0  # records submitted, not yet confirmed written
-        self._seq = 0
+        self._buffer: list[dict] = []  # guarded-by: _lock
+        #: records submitted, not yet confirmed written
+        self._in_flight = 0  # guarded-by: _lock
+        self._seq = 0  # guarded-by: _lock
         #: [(path, records, bytes)] of live segments, oldest first —
         #: what rotation walks (bytes filled in post-write)
-        self._segments: list[list] = []
-        self._closed = False
+        self._segments: list[list] = []  # guarded-by: _lock
+        self._closed = False  # guarded-by: _lock
         #: this log's own outstanding segment futures (pruned as they
         #: complete; a shared pool's other writes are never touched)
-        self._futures: list = []
-        self.n_records = 0
-        self.n_bytes = 0
-        self.n_dropped = 0
-        self.n_rotated = 0
+        self._futures: list = []  # guarded-by: _lock
+        self.n_records = 0  # guarded-by: _lock
+        self.n_bytes = 0  # guarded-by: _lock
+        self.n_dropped = 0  # guarded-by: _lock
+        self.n_rotated = 0  # guarded-by: _lock
 
     # --- sampling ---------------------------------------------------------
     def should_log(self, request_id: str) -> bool:

@@ -46,11 +46,16 @@ class ModelDirectoryWatcher:
         self.registry = registry
         self.watch_dir = watch_dir
         self.poll_s = float(poll_s)
-        self._seen: set[str] = set()
+        #: the poller thread mutates these while tests (and a future
+        #: /healthz payload) read them — the lock-discipline pass flagged
+        #: the bare mutations, so they now share a lock
+        self._lock = threading.Lock()
+        self._seen: set[str] = set()  # guarded-by: _lock
         self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
-        self.n_applied = 0
-        self.n_rejected = 0
+        #: start/stop are operator-lifecycle calls from one control thread
+        self._thread: Optional[threading.Thread] = None  # guarded-by: caller
+        self.n_applied = 0  # guarded-by: _lock
+        self.n_rejected = 0  # guarded-by: _lock
 
     # --- one poll ---------------------------------------------------------
     def scan_once(self) -> int:
@@ -66,8 +71,9 @@ class ModelDirectoryWatcher:
             return 0  # publish dir not created yet — nothing to do
         applied = 0
         for name in names:
-            if name in self._seen:
-                continue
+            with self._lock:
+                if name in self._seen:
+                    continue
             path = os.path.join(self.watch_dir, name)
             try:
                 from photon_ml_tpu.io.model_io import resolve_game_model_dir
@@ -78,17 +84,20 @@ class ModelDirectoryWatcher:
                 # mark seen — a run dir whose best/ publishes later must
                 # still be picked up
                 continue
-            self._seen.add(name)
+            with self._lock:
+                self._seen.add(name)
             try:
                 sm = self.registry.reload(path)
             except Exception as e:
                 # rejected candidates never disturb the active version;
                 # the registry already posted model_reload_rejected
-                self.n_rejected += 1
+                with self._lock:
+                    self.n_rejected += 1
                 logger.warning("watch-dir candidate %s rejected: %r",
                                path, e)
                 continue
-            self.n_applied += 1
+            with self._lock:
+                self.n_applied += 1
             applied += 1
             if sm.canary is not None:
                 logger.info(

@@ -163,14 +163,16 @@ class TelemetrySession:
         self.telemetry_dir = telemetry_dir
         self.registry = registry if registry is not None \
             else default_registry()
-        self._unbind = bridge.bind(bus=bus, registry=self.registry)
-        self._sampler = None
-        self._owns_tracer = False
-        self._aggregator = None
-        self._server = None
-        self._unhook = lambda: None
-        self._snap_stop: Optional[threading.Event] = None
-        self._snap_thread: Optional[threading.Thread] = None
+        # session components: built here, torn down in close() — both
+        # calls come from the one driver thread that owns the session
+        self._unbind = bridge.bind(bus=bus, registry=self.registry)  # guarded-by: caller
+        self._sampler = None  # guarded-by: caller
+        self._owns_tracer = False  # guarded-by: caller
+        self._aggregator = None  # guarded-by: caller
+        self._server = None  # guarded-by: caller
+        self._unhook = lambda: None  # guarded-by: caller
+        self._snap_stop: Optional[threading.Event] = None  # guarded-by: caller
+        self._snap_thread: Optional[threading.Thread] = None  # guarded-by: caller
         if telemetry_dir:
             os.makedirs(telemetry_dir, exist_ok=True)
             tracing.configure(os.path.join(telemetry_dir, "trace.jsonl"),

@@ -184,7 +184,7 @@ class FleetMetricsAggregator:
         self.registry = registry if registry is not None \
             else default_registry()
         self._lock = threading.Lock()
-        self._latest: Optional[str] = None
+        self._latest: Optional[str] = None  # guarded-by: _lock
 
     def local_text(self) -> str:
         """This process's registry rendered for the fold (host-owned gauges
@@ -303,7 +303,8 @@ class MetricsHTTPServer:
                  host: str = "127.0.0.1", port: int = 0):
         self._httpd = ThreadingHTTPServer((host, port),
                                           _make_handler(provider))
-        self._thread: Optional[threading.Thread] = None
+        #: start/stop are operator-lifecycle calls from one control thread
+        self._thread: Optional[threading.Thread] = None  # guarded-by: caller
 
     @property
     def port(self) -> int:

@@ -77,7 +77,8 @@ class DeviceStatsSampler:
         self._samples = reg.counter("photon_device_samples_total",
                                     "Completed sampler polls")
         self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
+        #: start/close are operator-lifecycle calls from one control thread
+        self._thread: Optional[threading.Thread] = None  # guarded-by: caller
 
     def sample_once(self) -> None:
         """One poll (also callable synchronously from tests)."""

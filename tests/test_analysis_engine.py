@@ -1,0 +1,614 @@
+"""Unit tests for the unified static-analysis engine
+(``photon_ml_tpu/analysis/``): per-rule fixtures with known violations,
+suppression semantics (positive + suppressed + justified cases), the
+machine-readable JSON report, and shim message compatibility.
+
+Tree-wide zero-finding runs and CLI exit codes live in
+``tests/test_photon_lint.py``; the legacy hygiene subsets keep their own
+tier-1 wrappers (``test_resilience_hygiene.py`` /
+``test_telemetry_hygiene.py``)."""
+
+import json
+import os
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from photon_ml_tpu.analysis import engine  # noqa: E402
+
+PKG = os.path.join("photon_ml_tpu", "x.py")
+
+
+def check(source, rules, rel=PKG):
+    return engine.check_source(textwrap.dedent(source), rel, rules)
+
+
+def rule_ids(findings):
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+
+def test_all_rules_catalog():
+    rules = engine.all_rules()
+    # the 12 legacy hygiene rules...
+    legacy = {"res-bare-except", "res-sleep", "res-part-write",
+              "res-process", "res-table-home", "tel-print",
+              "tel-perf-counter", "tel-metric-name", "tel-registry",
+              "tel-wall-clock", "tel-drift-home", "tel-request-identity"}
+    # ...the two new passes...
+    new = {"trace-print", "trace-clock", "trace-random", "trace-host-sync",
+           "trace-mutable-global", "lock-guarded-write",
+           "lock-missing-guard"}
+    # ...and the whole-tree consistency rules
+    project = {"obs-metric-catalog", "res-fault-coverage"}
+    assert legacy | new | project <= set(rules)
+    assert all(r.summary for r in rules.values())
+    # legacy rules stay scoped to the package; the new passes cover tools/
+    assert all(rules[r].scope == "package" for r in legacy)
+    assert all(rules[r].scope == "all" for r in new)
+    assert all(rules[r].scope == "project" for r in project)
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+
+def test_justified_suppression_silences_the_finding():
+    src = """
+    import time
+    time.sleep(1)  # photon-lint: disable=res-sleep -- chaos fixture needs a raw stall
+    """
+    assert check(src, ["res-sleep"]) == []
+
+
+def test_suppression_without_reason_is_itself_a_finding():
+    src = """
+    import time
+    time.sleep(1)  # photon-lint: disable=res-sleep
+    """
+    out = check(src, ["res-sleep"])
+    assert sorted(rule_ids(out)) == ["lint-suppression", "res-sleep"]
+
+
+def test_suppression_with_unknown_rule_id_is_flagged():
+    src = "x = 1  # photon-lint: disable=no-such-rule -- because\n"
+    out = check(src, ["res-sleep"])
+    assert rule_ids(out) == ["lint-suppression"]
+    assert "no-such-rule" in out[0].message
+
+
+def test_suppression_only_covers_its_rule():
+    src = """
+    import time
+    time.sleep(1)  # photon-lint: disable=res-bare-except -- wrong id
+    """
+    out = check(src, ["res-sleep", "res-bare-except"])
+    assert rule_ids(out) == ["res-sleep"]
+
+
+def test_def_line_suppression_covers_the_whole_body():
+    src = """
+    import time
+
+    def stall_helper():  # photon-lint: disable=res-sleep -- test-only stall helper
+        time.sleep(1)
+        time.sleep(2)
+
+    time.sleep(3)
+    """
+    out = check(src, ["res-sleep"])
+    assert [f.line for f in out] == [8]
+
+
+def test_class_line_suppression_covers_methods():
+    src = """
+    import threading
+
+    class W:  # photon-lint: disable=lock-missing-guard -- single-writer by construction
+        def __init__(self):
+            self.n = 0
+            threading.Thread(target=self.run).start()
+
+        def run(self):
+            self.n += 1
+    """
+    assert check(src, ["lock-missing-guard"]) == []
+
+
+def test_multi_rule_suppression():
+    src = """
+    import time
+    d = time.time() - time.perf_counter()  # photon-lint: disable=tel-wall-clock,tel-perf-counter -- fixture
+    """
+    assert check(src, ["tel-wall-clock", "tel-perf-counter"]) == []
+
+
+# ---------------------------------------------------------------------------
+# trace-safety fixtures
+# ---------------------------------------------------------------------------
+
+TRACE_RULES = ["trace-print", "trace-clock", "trace-random",
+               "trace-host-sync", "trace-mutable-global"]
+
+
+def test_trace_decorated_jit_function_flags_side_effects():
+    src = """
+    import time
+    import random
+    import numpy as np
+    import jax
+
+    @jax.jit
+    def bad(x):
+        print("tracing")
+        t = time.time()
+        r = random.random()
+        h = np.asarray(x)
+        return x + t + r
+    """
+    out = check(src, TRACE_RULES)
+    assert rule_ids(out) == ["trace-print", "trace-clock", "trace-random",
+                             "trace-host-sync"]
+
+
+def test_trace_partial_jit_decorator_and_item_and_float_param():
+    src = """
+    import functools
+    import jax
+
+    @functools.partial(jax.jit, static_argnames=("n",))
+    def bad(x, n):
+        v = x.mean().item()
+        f = float(x)
+        return v + f
+    """
+    out = check(src, TRACE_RULES)
+    assert rule_ids(out) == ["trace-host-sync", "trace-host-sync"]
+
+
+def test_trace_callsite_registration_and_reachability():
+    src = """
+    import numpy as np
+    import jax
+
+    def helper(x):
+        return np.asarray(x)
+
+    def entry(x):
+        return helper(x) + 1
+
+    entry_jit = jax.jit(entry)
+
+    def never_traced(x):
+        return np.asarray(x)  # fine: not reachable from a jit site
+    """
+    out = check(src, TRACE_RULES)
+    assert [(f.rule, f.line) for f in out] == [("trace-host-sync", 6)]
+
+
+def test_trace_jit_vmap_nesting_and_lambda():
+    src = """
+    import time
+    import jax
+
+    def solve_one(w):
+        time.monotonic()
+        return w
+
+    ws = jax.jit(jax.vmap(solve_one))
+    f = jax.jit(lambda x: time.time() + x)
+    """
+    out = check(src, TRACE_RULES)
+    assert rule_ids(out) == ["trace-clock", "trace-clock"]
+
+
+def test_trace_profile_jit_and_pallas_call():
+    src = """
+    import numpy as np
+    from photon_ml_tpu.telemetry.profiling import profile_jit
+    import jax.experimental.pallas as pl
+
+    def train(x):
+        print("side effect")
+        return x
+
+    train_fn = profile_jit(train, "game.fixed_effect")
+
+    def kernel(x_ref, o_ref):
+        np.random.rand()
+        o_ref[...] = x_ref[...]
+
+    def launch(x):
+        return pl.pallas_call(kernel, out_shape=None)(x)
+    """
+    out = check(src, TRACE_RULES)
+    assert rule_ids(out) == ["trace-print", "trace-random"]
+
+
+def test_trace_mutable_global_capture_and_global_stmt():
+    src = """
+    import jax
+
+    _CACHE = {}
+    _LIMITS = (1, 2)  # immutable: fine to close over
+
+    @jax.jit
+    def bad(x):
+        global _TOTAL
+        _TOTAL = x
+        return x + _CACHE.get("k", 0) + _LIMITS[0]
+    """
+    out = check(src, TRACE_RULES)
+    assert rule_ids(out) == ["trace-mutable-global", "trace-mutable-global"]
+
+
+def test_trace_method_name_collision_is_not_dragged_in():
+    # a *method* named train must not be conflated with a traced local
+    # function of the same name (lexical scope resolution)
+    src = """
+    import numpy as np
+    from photon_ml_tpu.telemetry.profiling import profile_jit
+
+    def make():
+        def train(x):
+            return x
+
+        return profile_jit(train, "x")
+
+    class Coordinate:
+        def train(self, offsets):
+            return np.asarray(offsets)  # host code, not traced
+    """
+    assert check(src, TRACE_RULES) == []
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline fixtures
+# ---------------------------------------------------------------------------
+
+LOCK_RULES = ["lock-guarded-write", "lock-missing-guard"]
+
+
+def test_lock_guarded_write_outside_lock_is_flagged():
+    src = """
+    import threading
+
+    class Q:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []  # guarded-by: _lock
+
+        def put(self, x):
+            self._items.append(x)
+    """
+    out = check(src, LOCK_RULES)
+    assert rule_ids(out) == ["lock-guarded-write"]
+    assert "self._items" in out[0].message
+
+
+def test_lock_guarded_write_inside_lock_is_clean():
+    src = """
+    import threading
+
+    class Q:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []  # guarded-by: _lock
+            self._n = 0       # guarded-by: _lock
+
+        def put(self, x):
+            with self._lock:
+                self._items.append(x)
+                self._n += 1
+    """
+    assert check(src, LOCK_RULES) == []
+
+
+def test_lock_condition_variable_counts_as_a_lock():
+    src = """
+    import threading
+
+    class Q:
+        def __init__(self):
+            self._cond = threading.Condition()
+            self._queue = []  # guarded-by: _cond
+
+        def put(self, x):
+            with self._cond:
+                self._queue.append(x)
+                self._cond.notify()
+
+        def bad_put(self, x):
+            self._queue.append(x)
+    """
+    out = check(src, LOCK_RULES)
+    assert [(f.rule, f.line) for f in out] == [("lock-guarded-write", 15)]
+
+
+def test_lock_threaded_class_must_annotate_mutations():
+    src = """
+    import threading
+
+    class W:
+        def __init__(self):
+            self.jobs = 0
+            threading.Thread(target=self.run, daemon=True).start()
+
+        def run(self):
+            self.jobs += 1
+    """
+    out = check(src, LOCK_RULES)
+    assert rule_ids(out) == ["lock-missing-guard"]
+
+
+def test_lock_unthreaded_class_needs_no_annotations():
+    src = """
+    class Plain:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self):
+            self.n += 1
+    """
+    assert check(src, LOCK_RULES) == []
+
+
+def test_lock_executor_submit_makes_a_class_threaded():
+    src = """
+    class S:
+        def __init__(self, pool):
+            self._pool = pool
+            self.pending = []
+
+        def kick(self, fn):
+            self.pending.append(self._pool.submit(fn))
+    """
+    out = check(src, LOCK_RULES)
+    assert rule_ids(out) == ["lock-missing-guard"]
+
+
+def test_lock_locked_suffix_method_is_exempt():
+    src = """
+    import threading
+
+    class Q:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._buf = []  # guarded-by: _lock
+
+        def _take_buffer_locked(self):
+            batch, self._buf = self._buf, []
+            return batch
+    """
+    assert check(src, LOCK_RULES) == []
+
+
+def test_lock_caller_guard_satisfies_completeness():
+    src = """
+    import threading
+
+    class Server:
+        def __init__(self):
+            self._thread = None  # guarded-by: caller
+
+        def start(self):
+            self._thread = threading.Thread(target=lambda: None)
+            self._thread.start()
+
+        def stop(self):
+            self._thread = None
+    """
+    assert check(src, LOCK_RULES) == []
+
+
+def test_lock_write_in_except_handler_is_seen():
+    src = """
+    import threading
+
+    class W:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.errors = 0  # guarded-by: _lock
+            threading.Thread(target=self.run).start()
+
+        def run(self):
+            try:
+                pass
+            except Exception:
+                self.errors += 1
+    """
+    out = check(src, LOCK_RULES)
+    assert rule_ids(out) == ["lock-guarded-write"]
+
+
+def test_lock_closure_does_not_inherit_the_with_block():
+    # a nested def lexically under `with self._lock:` runs LATER, without
+    # the lock — its writes must still be flagged
+    src = """
+    import threading
+
+    class W:
+        def __init__(self, pool):
+            self._lock = threading.Lock()
+            self._pool = pool
+            self.done = 0  # guarded-by: _lock
+
+        def kick(self):
+            with self._lock:
+                def job():
+                    self.done += 1
+                self._pool.submit(job)
+    """
+    out = check(src, LOCK_RULES)
+    assert rule_ids(out) == ["lock-guarded-write"]
+
+
+def test_lock_tuple_swap_target_is_seen():
+    src = """
+    import threading
+
+    class W:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._pending = []  # guarded-by: _lock
+            threading.Thread(target=self.run).start()
+
+        def run(self):
+            pending, self._pending = self._pending, []
+    """
+    out = check(src, LOCK_RULES)
+    assert rule_ids(out) == ["lock-guarded-write"]
+
+
+# ---------------------------------------------------------------------------
+# project rules (synthetic trees)
+# ---------------------------------------------------------------------------
+
+
+def _write(root, rel, text):
+    path = os.path.join(root, rel)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(textwrap.dedent(text))
+
+
+def test_metric_catalog_drift_both_directions(tmp_path):
+    root = str(tmp_path)
+    _write(root, "photon_ml_tpu/m.py", """
+    from photon_ml_tpu.telemetry import metrics as _metrics
+    _C = _metrics.counter("photon_undocumented_total", "help text")
+    """)
+    _write(root, "OBSERVABILITY.md", """
+    | family | type | labels | meaning |
+    |---|---|---|---|
+    | `photon_ghost_total` | counter | — | documented but never registered |
+    """)
+    report = engine.run(root, rule_ids=["obs-metric-catalog"])
+    got = {(f.path, f.rule): f.message for f in report.findings}
+    assert len(report.findings) == 2
+    assert any("photon_undocumented_total" in m for m in got.values())
+    assert any("photon_ghost_total" in m for m in got.values())
+
+
+def test_metric_catalog_clean_when_in_sync(tmp_path):
+    root = str(tmp_path)
+    _write(root, "photon_ml_tpu/m.py", """
+    from photon_ml_tpu.telemetry import metrics as _metrics
+    _C = _metrics.counter("photon_good_total", "help text")
+    """)
+    _write(root, "OBSERVABILITY.md", """
+    | `photon_good_total` | counter | — | a documented family |
+    """)
+    report = engine.run(root, rule_ids=["obs-metric-catalog"])
+    assert report.findings == []
+
+
+def test_fault_site_coverage_rule(tmp_path):
+    root = str(tmp_path)
+    _write(root, "photon_ml_tpu/resilience/faults.py", """
+    SITES = ("io.read", "never.injected")
+
+    def fault_point(site, **kw):
+        pass
+    """)
+    _write(root, "photon_ml_tpu/io/reader.py", """
+    from photon_ml_tpu.resilience.faults import fault_point
+
+    def read(path):
+        fault_point("io.read", path=path)
+    """)
+    _write(root, "tests/test_chaos.py", """
+    def test_read_fault():
+        assert "io.read"
+    """)
+    report = engine.run(root, rule_ids=["res-fault-coverage"])
+    msgs = [f.message for f in report.findings]
+    # never.injected: no injection call site AND no test mentions it
+    assert len(msgs) == 2
+    assert all("never.injected" in m for m in msgs)
+    assert any("injects" in m for m in msgs)
+    assert any("tests/" in m for m in msgs)
+
+
+# ---------------------------------------------------------------------------
+# JSON report (golden)
+# ---------------------------------------------------------------------------
+
+
+def test_json_report_golden(tmp_path):
+    root = str(tmp_path)
+    _write(root, "photon_ml_tpu/x.py", """
+    import time
+    time.sleep(1)
+    time.sleep(2)  # photon-lint: disable=res-sleep -- fixture: sanctioned stall
+    """)
+    report = engine.run(root, rule_ids=["res-sleep"])
+    assert json.loads(report.to_json()) == {
+        "version": 1,
+        "rules": ["res-sleep"],
+        "findings": [{
+            "path": os.path.join("photon_ml_tpu", "x.py"),
+            "line": 3,
+            "rule": "res-sleep",
+            "message": ("time.sleep outside resilience/retry.py — route "
+                        "waits through the retry module so deadlines and "
+                        "the watchdog see them"),
+        }],
+        "suppressed": [{
+            "path": os.path.join("photon_ml_tpu", "x.py"),
+            "line": 4,
+            "rule": "res-sleep",
+            "message": ("time.sleep outside resilience/retry.py — route "
+                        "waits through the retry module so deadlines and "
+                        "the watchdog see them"),
+            "reason": "fixture: sanctioned stall",
+        }],
+        "counts": {"findings": 1, "suppressed": 1},
+    }
+
+
+# ---------------------------------------------------------------------------
+# shim compatibility (message byte-parity with the pre-engine tools)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("snippet, expected", [
+    ("try:\n    pass\nexcept:\n    pass\n",
+     ["photon_ml_tpu/x.py:3: bare `except:` — catch a type (it swallows "
+      "KeyboardInterrupt/SystemExit)"]),
+    ("import time\ntime.sleep(1)\n",
+     ["photon_ml_tpu/x.py:2: time.sleep outside resilience/retry.py — "
+      "route waits through the retry module so deadlines and the "
+      "watchdog see them"]),
+])
+def test_resilience_shim_messages_are_byte_identical(snippet, expected):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import check_resilience_hygiene as shim
+
+    assert shim.check_source(snippet, "photon_ml_tpu/x.py") == expected
+
+
+@pytest.mark.parametrize("snippet, expected", [
+    ("print('x')\n",
+     ["photon_ml_tpu/x.py:1: print() outside a CLI entry point — library "
+      "code logs, counts (telemetry.metrics) or spans (telemetry."
+      "tracing); stdout belongs to the drivers"]),
+    ("import time\nd = time.time() - 1.0\n",
+     ["photon_ml_tpu/x.py:2: duration computed from time.time() — the "
+      "wall clock is for timestamps (it jumps); measure durations with a "
+      "registry timer or a tracing span"]),
+])
+def test_telemetry_shim_messages_are_byte_identical(snippet, expected):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import check_telemetry_hygiene as shim
+
+    assert shim.check_source(snippet, "photon_ml_tpu/x.py") == expected

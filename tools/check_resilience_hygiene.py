@@ -1,276 +1,92 @@
 #!/usr/bin/env python
-"""Static resilience-hygiene check over ``photon_ml_tpu/``.
+"""Static resilience-hygiene check over ``photon_ml_tpu/`` — now a thin
+shim over the unified analysis engine (``photon_ml_tpu/analysis/``, see
+ANALYSIS.md). Output format (``path:line: message``), exit codes and the
+tier-1 test are unchanged from the pre-engine tool.
 
-Four rules, all load-bearing for the resilience subsystem:
+Five rules, all load-bearing for the resilience subsystem
+(``photon_ml_tpu/analysis/rules_resilience.py`` holds the detectors):
 
-1. **No bare ``except:``** — a bare handler swallows ``KeyboardInterrupt``
-   and ``SystemExit``, which is exactly how a "resilient" run turns into an
-   unkillable one. Catch a type (``except Exception:`` at minimum).
-2. **No ``time.sleep`` outside ``resilience/retry.py``** — every wait must
-   route through the retry module's sanctioned sleep so backoff, deadlines,
-   and injected stalls share one accounting chokepoint; an ad-hoc sleep is
-   invisible to ``--retry-deadline-s`` and to the bench watchdog.
-3. **No model/index part-file writes outside ``io/``** — a bare
-   ``open(...part-*.avro, "w")`` (or direct ``write_avro_file`` of a
-   part-file) in driver code bypasses the staged-directory
-   retire-then-rename publish in ``io/pipeline.py``: a crash mid-write
-   would expose a partial model to the serving registry. Part-files are
-   written by ``io/model_io.py`` and published atomically
+1. **No bare ``except:``** (``res-bare-except``) — a bare handler swallows
+   ``KeyboardInterrupt`` and ``SystemExit``, which is exactly how a
+   "resilient" run turns into an unkillable one. Catch a type
+   (``except Exception:`` at minimum).
+2. **No ``time.sleep`` outside ``resilience/retry.py``** (``res-sleep``) —
+   every wait must route through the retry module's sanctioned sleep so
+   backoff, deadlines, and injected stalls share one accounting
+   chokepoint; an ad-hoc sleep is invisible to ``--retry-deadline-s`` and
+   to the bench watchdog.
+3. **No model/index part-file writes outside ``io/``**
+   (``res-part-write``) — a bare ``open(...part-*.avro, "w")`` (or direct
+   ``write_avro_file`` of a part-file) in driver code bypasses the staged-
+   directory retire-then-rename publish in ``io/pipeline.py``: a crash
+   mid-write would expose a partial model to the serving registry.
+   Part-files are written by ``io/model_io.py`` and published atomically
    (``save_game_model_atomic`` / ``BackgroundSaver``) — route through
    them.
 4. **No ``subprocess.Popen`` / ``os.kill`` outside
-   ``resilience/supervisor.py``** — process lifecycle must stay visible to
-   the fleet supervisor: a driver-forked child is invisible to the restart
-   logic that claims to own recovery (it would survive ``_kill_fleet`` and
-   hold the coordinator port, or die unnoticed with no liveness signal).
-   Blocking one-shot helpers (``subprocess.run`` — e.g. the native
-   toolchain probe) stay legal: they cannot outlive their caller.
+   ``resilience/supervisor.py``** (``res-process``) — process lifecycle
+   must stay visible to the fleet supervisor: a driver-forked child is
+   invisible to the restart logic that claims to own recovery (it would
+   survive ``_kill_fleet`` and hold the coordinator port, or die unnoticed
+   with no liveness signal). Blocking one-shot helpers (``subprocess.run``
+   — e.g. the native toolchain probe) stay legal: they cannot outlive
+   their caller.
 5. **No serving coefficient-table writes — or quantize/dequantize math —
-   outside ``serving/store.py``** — the dense per-entity device tables are
-   IMMUTABLE per version: in-flight requests hold references,
-   hot-swap/rollback relies on old versions staying intact, and the
-   continuous-training delta path derives version N+1 functionally
-   (``EntityCoefficientStore.apply_patch``). A ``x.table[...] = ...`` /
-   ``x.table = ...`` rebinding or a ``x.table.at[...]`` functional update
-   anywhere else builds a divergent table behind the registry's back —
-   route every table derivation through ``store.py``'s ``build`` /
-   ``apply_patch``. Since tables may be stored QUANTIZED (bfloat16 / int8
-   with per-row scales), the table's numeric format is likewise a
-   store.py-private contract: an ``<...>.table<...>.astype(...)`` cast or
-   a ``*``/``/`` arithmetic expression over a ``.table`` array anywhere
-   else is an ad-hoc quantize/dequantize that silently disagrees with
+   outside ``serving/store.py``** (``res-table-home``) — the dense
+   per-entity device tables are IMMUTABLE per version: in-flight requests
+   hold references, hot-swap/rollback relies on old versions staying
+   intact, and the continuous-training delta path derives version N+1
+   functionally (``EntityCoefficientStore.apply_patch``). A
+   ``x.table[...] = ...`` / ``x.table = ...`` rebinding or a
+   ``x.table.at[...]`` functional update anywhere else builds a divergent
+   table behind the registry's back — route every table derivation through
+   ``store.py``'s ``build`` / ``apply_patch``. Since tables may be stored
+   QUANTIZED (bfloat16 / int8 with per-row scales), the table's numeric
+   format is likewise a store.py-private contract: an
+   ``<...>.table<...>.astype(...)`` cast or a ``*``/``/`` arithmetic
+   expression over a ``.table`` array anywhere else is an ad-hoc
+   quantize/dequantize that silently disagrees with
    ``store.gather_rows``'s scale semantics — read rows through
    ``gather_rows`` / ``device_params`` instead.
 
-Run directly (``python tools/check_resilience_hygiene.py [root]``, exit 1 on
-violations) or through the tier-1 test ``tests/test_resilience_hygiene.py``.
+Run directly (``python tools/check_resilience_hygiene.py [root]``, exit 1
+on violations) or through the tier-1 test
+``tests/test_resilience_hygiene.py``. The full engine CLI —
+including the trace-safety and lock-discipline passes these five ride
+alongside — is ``python tools/photon_lint.py``.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
-#: the one module allowed to sleep (it owns backoff + injected stalls)
-SLEEP_ALLOWED = {os.path.join("photon_ml_tpu", "resilience", "retry.py")}
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-#: the package prefix allowed to write model part-files (it owns the
-#: atomic staged publish)
-PART_WRITE_ALLOWED_PREFIX = os.path.join("photon_ml_tpu", "io") + os.sep
-
-#: the one module allowed to spawn or signal processes (it owns the
-#: fleet's process lifecycle)
-PROCESS_ALLOWED = {os.path.join("photon_ml_tpu", "resilience",
-                                "supervisor.py")}
-
-#: the one module allowed to write/derive serving coefficient tables
-#: (EntityCoefficientStore.build / apply_patch)
-STORE_ALLOWED = {os.path.join("photon_ml_tpu", "serving", "store.py")}
-
-
-def _is_time_sleep(node: ast.AST, time_aliases: set[str],
-                   sleep_names: set[str]) -> bool:
-    if isinstance(node, ast.Attribute) and node.attr == "sleep":
-        return isinstance(node.value, ast.Name) and node.value.id in time_aliases
-    if isinstance(node, ast.Name):
-        return node.id in sleep_names
-    return False
-
-
-def _is_part_file_write(node: ast.AST) -> bool:
-    """True for ``open(..)`` / ``write_avro_file(..)`` calls whose argument
-    tree contains a ``part-*.avro`` string literal (the model part-file
-    naming contract — ``os.path.join(..., "part-00000.avro")`` included)."""
-    if not isinstance(node, ast.Call):
-        return False
-    fn = node.func
-    name = fn.id if isinstance(fn, ast.Name) else (
-        fn.attr if isinstance(fn, ast.Attribute) else None)
-    if name not in ("open", "write_avro_file"):
-        return False
-    for sub in ast.walk(node):
-        if (isinstance(sub, ast.Constant) and isinstance(sub.value, str)
-                and "part-" in sub.value and sub.value.endswith(".avro")):
-            # reads are fine: only flag an explicit write mode / the writer
-            if name == "write_avro_file":
-                return True
-            mode = None
-            if len(node.args) > 1 and isinstance(node.args[1], ast.Constant):
-                mode = node.args[1].value
-            for kw in node.keywords:
-                if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
-                    mode = kw.value.value
-            return isinstance(mode, str) and ("w" in mode or "a" in mode
-                                              or "x" in mode)
-    return False
-
-
-def _is_process_call(node: ast.AST, subprocess_aliases: set[str],
-                     os_aliases: set[str], popen_names: set[str],
-                     kill_names: set[str]) -> bool:
-    """True for ``subprocess.Popen(..)`` / ``os.kill``/``os.killpg`` calls
-    (module- and from-import aliases included)."""
-    if not isinstance(node, ast.Call):
-        return False
-    fn = node.func
-    if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
-        if fn.attr == "Popen" and fn.value.id in subprocess_aliases:
-            return True
-        if fn.attr in ("kill", "killpg") and fn.value.id in os_aliases:
-            return True
-    if isinstance(fn, ast.Name):
-        return fn.id in popen_names or fn.id in kill_names
-    return False
-
-
-def _is_table_attr(node: ast.AST) -> bool:
-    return isinstance(node, ast.Attribute) and node.attr == "table"
-
-
-def _contains_table_attr(node: ast.AST) -> bool:
-    return any(_is_table_attr(sub) for sub in ast.walk(node))
-
-
-def _store_table_quant(tree: ast.AST) -> list[ast.AST]:
-    """Rule 5 (quantization half): nodes performing numeric-format work on
-    a serving ``.table`` array — an ``.astype(...)`` cast whose receiver
-    involves ``.table`` (``store.table.astype(...)``,
-    ``store.table[rows].astype(...)``), or a ``*`` / ``/`` arithmetic
-    expression with a ``.table`` operand (a scale multiply/divide). Either
-    is an ad-hoc quantize/dequantize outside the store's one sanctioned
-    format home (``quantize_rows`` / ``gather_rows``)."""
-    out = []
-    for node in ast.walk(tree):
-        if (isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Attribute)
-                and node.func.attr == "astype"
-                and _contains_table_attr(node.func.value)):
-            out.append(node)
-        elif (isinstance(node, ast.BinOp)
-              and isinstance(node.op, (ast.Mult, ast.Div))
-              and (_contains_table_attr(node.left)
-                   or _contains_table_attr(node.right))):
-            out.append(node)
-    return out
-
-
-def _store_table_writes(tree: ast.AST) -> list[ast.AST]:
-    """Nodes mutating/deriving a serving ``.table`` (rule 5): subscript or
-    attribute assignment targets over ``<expr>.table``, and functional
-    ``<expr>.table.at[...]`` updates."""
-    out = []
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
-            targets = (node.targets if isinstance(node, ast.Assign)
-                       else [node.target])
-            for t in targets:
-                if _is_table_attr(t):
-                    out.append(t)
-                elif isinstance(t, ast.Subscript) and _is_table_attr(t.value):
-                    out.append(t)
-        elif (isinstance(node, ast.Attribute) and node.attr == "at"
-              and _is_table_attr(node.value)):
-            out.append(node)
-    return out
+from photon_ml_tpu.analysis import engine  # noqa: E402
+from photon_ml_tpu.analysis.rules_resilience import (  # noqa: E402,F401
+    PART_WRITE_ALLOWED_PREFIX,
+    PROCESS_ALLOWED,
+    RESILIENCE_RULE_IDS,
+    SLEEP_ALLOWED,
+    STORE_ALLOWED,
+)
 
 
 def check_source(source: str, rel_path: str) -> list[str]:
     """Violations in one file, as ``path:line: message`` strings."""
-    tree = ast.parse(source, filename=rel_path)
-    sleep_ok = rel_path in {os.path.normpath(p) for p in SLEEP_ALLOWED}
-    part_ok = os.path.normpath(rel_path).startswith(
-        PART_WRITE_ALLOWED_PREFIX)
-    process_ok = rel_path in {os.path.normpath(p) for p in PROCESS_ALLOWED}
-    store_ok = rel_path in {os.path.normpath(p) for p in STORE_ALLOWED}
-
-    # resolve what `time` / `sleep` / `subprocess` / `os` are bound to in
-    # this module
-    time_aliases: set[str] = set()
-    sleep_names: set[str] = set()
-    subprocess_aliases: set[str] = set()
-    os_aliases: set[str] = set()
-    popen_names: set[str] = set()
-    kill_names: set[str] = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for a in node.names:
-                if a.name == "time":
-                    time_aliases.add(a.asname or "time")
-                elif a.name == "subprocess":
-                    subprocess_aliases.add(a.asname or "subprocess")
-                elif a.name == "os":
-                    os_aliases.add(a.asname or "os")
-        elif isinstance(node, ast.ImportFrom) and node.module == "time":
-            for a in node.names:
-                if a.name == "sleep":
-                    sleep_names.add(a.asname or "sleep")
-        elif isinstance(node, ast.ImportFrom) and node.module == "subprocess":
-            for a in node.names:
-                if a.name == "Popen":
-                    popen_names.add(a.asname or "Popen")
-        elif isinstance(node, ast.ImportFrom) and node.module == "os":
-            for a in node.names:
-                if a.name in ("kill", "killpg"):
-                    kill_names.add(a.asname or a.name)
-
-    out = []
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ExceptHandler) and node.type is None:
-            out.append(f"{rel_path}:{node.lineno}: bare `except:` — catch a "
-                       f"type (it swallows KeyboardInterrupt/SystemExit)")
-        elif (not sleep_ok
-              and _is_time_sleep(node, time_aliases, sleep_names)):
-            out.append(f"{rel_path}:{node.lineno}: time.sleep outside "
-                       f"resilience/retry.py — route waits through the "
-                       f"retry module so deadlines and the watchdog see "
-                       f"them")
-        elif not part_ok and _is_part_file_write(node):
-            out.append(f"{rel_path}:{node.lineno}: model part-file write "
-                       f"outside io/ — a bare part-*.avro write bypasses "
-                       f"the atomic staged publish; route through "
-                       f"io.model_io.save_game_model / "
-                       f"io.pipeline.BackgroundSaver")
-        elif (not process_ok
-              and _is_process_call(node, subprocess_aliases, os_aliases,
-                                   popen_names, kill_names)):
-            out.append(f"{rel_path}:{node.lineno}: subprocess.Popen/os.kill "
-                       f"outside resilience/supervisor.py — process "
-                       f"lifecycle must stay visible to the fleet "
-                       f"supervisor (an untracked child survives "
-                       f"_kill_fleet or dies without a liveness signal); "
-                       f"route process management through FleetSupervisor")
-    if not store_ok:
-        for node in _store_table_writes(tree):
-            out.append(f"{rel_path}:{node.lineno}: serving coefficient-"
-                       f"table write outside serving/store.py — version "
-                       f"tables are immutable (hot-swap/rollback and the "
-                       f"delta path depend on it); derive new tables "
-                       f"through EntityCoefficientStore.build/apply_patch")
-        for node in _store_table_quant(tree):
-            out.append(f"{rel_path}:{node.lineno}: quantize/dequantize of "
-                       f"a serving .table array outside serving/store.py — "
-                       f"table storage format (dtype + per-row scales) is "
-                       f"a store.py-private contract; read rows through "
-                       f"store.gather_rows / device_params")
-    return out
+    return [f.legacy() for f in engine.check_source(
+        source, rel_path, RESILIENCE_RULE_IDS)]
 
 
 def main(root: str = ".") -> int:
-    pkg = os.path.join(root, "photon_ml_tpu")
-    violations: list[str] = []
-    for dirpath, _, filenames in os.walk(pkg):
-        for name in sorted(filenames):
-            if not name.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, name)
-            rel = os.path.normpath(os.path.relpath(path, root))
-            with open(path, encoding="utf-8") as f:
-                violations.extend(check_source(f.read(), rel))
-    for v in violations:
-        print(v)
-    if violations:
-        print(f"{len(violations)} resilience-hygiene violation(s)")
+    report = engine.run(root, rule_ids=RESILIENCE_RULE_IDS,
+                        prefixes=("photon_ml_tpu",))
+    for f in report.findings:
+        print(f.legacy())
+    if report.findings:
+        print(f"{len(report.findings)} resilience-hygiene violation(s)")
         return 1
     return 0
 

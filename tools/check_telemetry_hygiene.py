@@ -1,346 +1,108 @@
 #!/usr/bin/env python
-"""Static telemetry-hygiene check over ``photon_ml_tpu/``.
+"""Static telemetry-hygiene check over ``photon_ml_tpu/`` — now a thin
+shim over the unified analysis engine (``photon_ml_tpu/analysis/``, see
+ANALYSIS.md; the sibling of ``check_resilience_hygiene.py``, same
+contract: run directly or through the tier-1 test). Output format
+(``path:line: message``) and exit codes are unchanged from the pre-engine
+tool.
 
-Seven rules, all load-bearing for the telemetry subsystem (the sibling of
-``check_resilience_hygiene.py``, same contract: run directly or through the
-tier-1 test):
+Seven rules, all load-bearing for the telemetry subsystem
+(``photon_ml_tpu/analysis/rules_telemetry.py`` holds the detectors):
 
-1. **No ``print(`` outside CLI entry points** — anything printed from
-   library code bypasses the run log, the metrics registry, AND the trace
-   file: it is observability that evaporates when stdout does. Library code
-   logs (``logging``), counts (``telemetry.metrics``), or spans
-   (``telemetry.tracing``). Only the CLI drivers (``photon_ml_tpu/cli/``)
-   and the module runner (``__main__.py``) own stdout.
-2. **No ``time.perf_counter`` outside ``photon_ml_tpu/telemetry/``** —
-   every duration measurement routes through the registry's histogram
-   timer (``Histogram.time()``) or a tracing span, so every latency
-   number lands in ``/metrics``/``trace.jsonl`` with consistent clocking;
-   an ad-hoc ``perf_counter`` pair is a measurement the scrape can never
-   see. (Originally serving-only; the profiling layer extended it
-   package-wide — rule 5.) ``time.monotonic`` (deadlines) and
-   ``time.time`` (timestamps) stay legal — they are scheduling clocks,
-   not duration measurements.
-3. **Metric naming** — every ``counter(``/``gauge(``/``histogram(``
-   registration with a literal name must match ``photon_[a-z0-9_]+`` and
-   carry non-empty help text. The fleet aggregator merges snapshots by
-   family name across processes and versions; an off-prefix or
-   helpless metric is a scrape nobody can interpret.
-4. **One registry** — no module outside ``photon_ml_tpu/telemetry/``
-   constructs a ``MetricsRegistry``: the process-global default is the
-   only sanctioned registry outside tests. A second registry silently
-   forks the metric namespace and its series never reach ``/metrics`` or
-   the fleet fold.
-5. **No wall-clock duration arithmetic** — a subtraction with a
-   ``time.time()`` call on either side computes a duration from the wall
-   clock: wrong under clock jumps AND invisible to telemetry. Durations
-   come from registry timers or spans; ``time.time()`` alone (a
-   timestamp) stays legal.
-6. **Drift/binning math lives in ``photon_ml_tpu/quality/``** — the
-   quality layer compares a live score histogram against a train-time
-   baseline through ONE binning and ONE PSI/KS implementation
-   (``quality/baseline.py``). A second ``np.histogram`` over scores, or a
-   re-derived ``population_stability_index``, would silently disagree
-   about bin edges or proportion floors — and "drift" would mean
-   different things on the two sides of the comparison. Detected:
-   ``numpy``/``jax.numpy`` ``histogram*`` calls, and local definitions of
-   the drift statistics, outside ``photon_ml_tpu/quality/``.
+1. **No ``print(`` outside CLI entry points** (``tel-print``) — anything
+   printed from library code bypasses the run log, the metrics registry,
+   AND the trace file: it is observability that evaporates when stdout
+   does. Library code logs (``logging``), counts (``telemetry.metrics``),
+   or spans (``telemetry.tracing``). Only the CLI drivers
+   (``photon_ml_tpu/cli/``) and the module runner (``__main__.py``) own
+   stdout.
+2. **No ``time.perf_counter`` outside ``photon_ml_tpu/telemetry/``**
+   (``tel-perf-counter``) — every duration measurement routes through the
+   registry's histogram timer (``Histogram.time()``) or a tracing span,
+   so every latency number lands in ``/metrics``/``trace.jsonl`` with
+   consistent clocking; an ad-hoc ``perf_counter`` pair is a measurement
+   the scrape can never see. (Originally serving-only; the profiling
+   layer extended it package-wide — rule 5.) ``time.monotonic``
+   (deadlines) and ``time.time`` (timestamps) stay legal — they are
+   scheduling clocks, not duration measurements.
+3. **Metric naming** (``tel-metric-name``) — every
+   ``counter(``/``gauge(``/``histogram(`` registration with a literal
+   name must match ``photon_[a-z0-9_]+`` and carry non-empty help text.
+   The fleet aggregator merges snapshots by family name across processes
+   and versions; an off-prefix or helpless metric is a scrape nobody can
+   interpret.
+4. **One registry** (``tel-registry``) — no module outside
+   ``photon_ml_tpu/telemetry/`` constructs a ``MetricsRegistry``: the
+   process-global default is the only sanctioned registry outside tests.
+   A second registry silently forks the metric namespace and its series
+   never reach ``/metrics`` or the fleet fold.
+5. **No wall-clock duration arithmetic** (``tel-wall-clock``) — a
+   subtraction with a ``time.time()`` call on either side computes a
+   duration from the wall clock: wrong under clock jumps AND invisible to
+   telemetry. Durations come from registry timers or spans;
+   ``time.time()`` alone (a timestamp) stays legal.
+6. **Drift/binning math lives in ``photon_ml_tpu/quality/``**
+   (``tel-drift-home``) — the quality layer compares a live score
+   histogram against a train-time baseline through ONE binning and ONE
+   PSI/KS implementation (``quality/baseline.py``). A second
+   ``np.histogram`` over scores, or a re-derived
+   ``population_stability_index``, would silently disagree about bin
+   edges or proportion floors — and "drift" would mean different things
+   on the two sides of the comparison. Detected: ``numpy``/``jax.numpy``
+   ``histogram*`` calls, and local definitions of the drift statistics,
+   outside ``photon_ml_tpu/quality/``.
+7. **Request identity and the request log have ONE home each**
+   (``tel-request-identity``) — a serving request id is minted in
+   ``photon_ml_tpu/serving/http.py`` (``new_request_id``) and nowhere
+   else: a second generation site (detected: ``uuid.uuid1/3/4/5`` and
+   ``secrets.token_hex/urlsafe`` calls) would hand one request two
+   identities and break the span↔reqlog↔response join. Likewise the
+   ``RequestLogAvro`` format is written only by
+   ``photon_ml_tpu/serving/reqlog.py`` (detected: any reference to
+   ``REQUEST_LOG_AVRO`` outside reqlog.py and its definition in
+   ``io/schemas.py``): a second writer forks the on-disk log away from
+   ``tools/reqlog_replay.py`` and the feedback joiner.
 
-7. **Request identity and the request log have ONE home each** — a
-   serving request id is minted in ``photon_ml_tpu/serving/http.py``
-   (``new_request_id``) and nowhere else: a second generation site
-   (detected: ``uuid.uuid1/3/4/5`` and ``secrets.token_hex/urlsafe``
-   calls) would hand one request two identities and break the
-   span↔reqlog↔response join. Likewise the ``RequestLogAvro`` format is
-   written only by ``photon_ml_tpu/serving/reqlog.py`` (detected: any
-   reference to ``REQUEST_LOG_AVRO`` outside reqlog.py and its
-   definition in ``io/schemas.py``): a second writer forks the on-disk
-   log away from ``tools/reqlog_replay.py`` and the feedback joiner.
-
-Run directly (``python tools/check_telemetry_hygiene.py [root]``, exit 1 on
-violations) or through the tier-1 test ``tests/test_telemetry_hygiene.py``.
+Run directly (``python tools/check_telemetry_hygiene.py [root]``, exit 1
+on violations) or through the tier-1 test
+``tests/test_telemetry_hygiene.py``. The full engine CLI is
+``python tools/photon_lint.py``.
 """
 
 from __future__ import annotations
 
-import ast
 import os
-import re
 import sys
 
-#: stdout owners: the CLI drivers and the module runner
-PRINT_ALLOWED_PREFIXES = (
-    os.path.join("photon_ml_tpu", "cli") + os.sep,
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from photon_ml_tpu.analysis import engine  # noqa: E402
+from photon_ml_tpu.analysis.rules_telemetry import (  # noqa: E402,F401
+    METRIC_FACTORIES,
+    METRIC_NAME_RE,
+    PRINT_ALLOWED_FILES,
+    PRINT_ALLOWED_PREFIXES,
+    REQLOG_ALLOWED_FILES,
+    REQLOG_SCHEMA_NAME,
+    REQUEST_ID_ALLOWED_FILES,
+    TELEMETRY_RULE_IDS,
+    TIMING_ALLOWED_PREFIX,
 )
-PRINT_ALLOWED_FILES = {os.path.join("photon_ml_tpu", "__main__.py")}
-
-#: the one subtree whose job IS timing: the sanctioned timers live here
-TIMING_ALLOWED_PREFIX = os.path.join("photon_ml_tpu", "telemetry") + os.sep
-
-#: the one place allowed to construct MetricsRegistry instances
-REGISTRY_ALLOWED_PREFIX = os.path.join("photon_ml_tpu", "telemetry") + os.sep
-
-#: metric-family registration methods/functions
-METRIC_FACTORIES = frozenset({"counter", "gauge", "histogram"})
-
-METRIC_NAME_RE = re.compile(r"photon_[a-z0-9_]+\Z")
-
-#: the one subtree whose job IS score binning + drift statistics
-QUALITY_ALLOWED_PREFIX = os.path.join("photon_ml_tpu", "quality") + os.sep
-
-#: numpy/jax.numpy histogram-binning entry points (rule 6)
-HISTOGRAM_ATTRS = frozenset({"histogram", "histogram2d", "histogramdd",
-                             "histogram_bin_edges"})
-
-#: drift-statistic names whose DEFINITION outside quality/ forks the
-#: arithmetic (calling quality's exported functions is of course fine)
-DRIFT_STAT_NAMES = frozenset({"population_stability_index", "psi",
-                              "ks_statistic", "kolmogorov_smirnov"})
-
-#: rule 7: the one request-id mint (serving/http.py) and the request-id
-#: generation primitives whose CALL anywhere else forks request identity
-REQUEST_ID_ALLOWED_FILES = {os.path.join("photon_ml_tpu", "serving",
-                                         "http.py")}
-ID_GEN_UUID_FNS = frozenset({"uuid1", "uuid3", "uuid4", "uuid5"})
-ID_GEN_SECRETS_FNS = frozenset({"token_hex", "token_urlsafe"})
-
-#: rule 7: the one RequestLogAvro writer (serving/reqlog.py) plus the
-#: schema's definition site
-REQLOG_SCHEMA_NAME = "REQUEST_LOG_AVRO"
-REQLOG_ALLOWED_FILES = {
-    os.path.join("photon_ml_tpu", "serving", "reqlog.py"),
-    os.path.join("photon_ml_tpu", "io", "schemas.py"),
-}
-
-
-def _is_perf_counter(node: ast.AST, time_aliases: set[str],
-                     pc_names: set[str]) -> bool:
-    if isinstance(node, ast.Attribute) and node.attr == "perf_counter":
-        return (isinstance(node.value, ast.Name)
-                and node.value.id in time_aliases)
-    if isinstance(node, ast.Name):
-        return node.id in pc_names
-    return False
-
-
-def _metric_call_args(node: ast.Call):
-    """(name, help) literals of a metric-factory call; non-literal fields
-    come back as None (dynamic names/helps are out of the lint's reach —
-    the registry's internal plumbing passes them through variables)."""
-    name = help_ = None
-    if node.args and isinstance(node.args[0], ast.Constant) \
-            and isinstance(node.args[0].value, str):
-        name = node.args[0].value
-    if len(node.args) > 1 and isinstance(node.args[1], ast.Constant) \
-            and isinstance(node.args[1].value, str):
-        help_ = node.args[1].value
-    for kw in node.keywords:
-        if kw.arg == "help_" and isinstance(kw.value, ast.Constant) \
-                and isinstance(kw.value.value, str):
-            help_ = kw.value.value
-    has_help_arg = len(node.args) > 1 or any(kw.arg == "help_"
-                                             for kw in node.keywords)
-    return name, help_, has_help_arg
 
 
 def check_source(source: str, rel_path: str) -> list[str]:
     """Violations in one file, as ``path:line: message`` strings."""
-    tree = ast.parse(source, filename=rel_path)
-    rel_path = os.path.normpath(rel_path)
-    print_ok = (rel_path in PRINT_ALLOWED_FILES
-                or any(rel_path.startswith(p)
-                       for p in PRINT_ALLOWED_PREFIXES))
-    pc_banned = not rel_path.startswith(TIMING_ALLOWED_PREFIX)
-    registry_ok = rel_path.startswith(REGISTRY_ALLOWED_PREFIX)
-    binning_banned = not rel_path.startswith(QUALITY_ALLOWED_PREFIX)
-    id_gen_banned = rel_path not in REQUEST_ID_ALLOWED_FILES
-    reqlog_banned = rel_path not in REQLOG_ALLOWED_FILES
-
-    # resolve what `time` / `perf_counter` / `time.time` / numpy are
-    # bound to
-    time_aliases: set[str] = set()
-    pc_names: set[str] = set()
-    tt_names: set[str] = set()  # from-imports of time.time
-    metric_fn_names: set[str] = set()  # from-imports of counter/gauge/...
-    np_aliases: set[str] = set()  # names bound to numpy / jax.numpy
-    uuid_aliases: set[str] = set()  # names bound to the uuid module
-    secrets_aliases: set[str] = set()  # names bound to secrets
-    id_gen_names: set[str] = set()  # from-imports of uuid4/token_hex/...
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for a in node.names:
-                if a.name == "time":
-                    time_aliases.add(a.asname or "time")
-                elif a.name == "numpy":
-                    np_aliases.add(a.asname or "numpy")
-                elif a.name == "jax.numpy" and a.asname:
-                    np_aliases.add(a.asname)
-                elif a.name == "uuid":
-                    uuid_aliases.add(a.asname or "uuid")
-                elif a.name == "secrets":
-                    secrets_aliases.add(a.asname or "secrets")
-        elif isinstance(node, ast.ImportFrom):
-            if node.module == "time":
-                for a in node.names:
-                    if a.name == "perf_counter":
-                        pc_names.add(a.asname or "perf_counter")
-                    elif a.name == "time":
-                        tt_names.add(a.asname or "time")
-            elif node.module == "photon_ml_tpu.telemetry.metrics":
-                for a in node.names:
-                    if a.name in METRIC_FACTORIES:
-                        metric_fn_names.add(a.asname or a.name)
-            elif node.module == "jax":
-                for a in node.names:
-                    if a.name == "numpy":
-                        np_aliases.add(a.asname or "numpy")
-            elif node.module == "uuid":
-                for a in node.names:
-                    if a.name in ID_GEN_UUID_FNS:
-                        id_gen_names.add(a.asname or a.name)
-            elif node.module == "secrets":
-                for a in node.names:
-                    if a.name in ID_GEN_SECRETS_FNS:
-                        id_gen_names.add(a.asname or a.name)
-
-    def _is_np_module(v: ast.AST) -> bool:
-        if isinstance(v, ast.Name):
-            return v.id in np_aliases
-        # the bare `import jax.numpy` spelling: jax.numpy.histogram(...)
-        return (isinstance(v, ast.Attribute) and v.attr == "numpy"
-                and isinstance(v.value, ast.Name) and v.value.id == "jax")
-
-    def _is_id_gen_call(node: ast.AST) -> bool:
-        if not isinstance(node, ast.Call):
-            return False
-        f = node.func
-        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
-            return ((f.value.id in uuid_aliases
-                     and f.attr in ID_GEN_UUID_FNS)
-                    or (f.value.id in secrets_aliases
-                        and f.attr in ID_GEN_SECRETS_FNS))
-        return isinstance(f, ast.Name) and f.id in id_gen_names
-
-    def _is_reqlog_schema_ref(node: ast.AST) -> bool:
-        if isinstance(node, ast.Name) and node.id == REQLOG_SCHEMA_NAME:
-            return True
-        if isinstance(node, ast.Attribute) and node.attr == REQLOG_SCHEMA_NAME:
-            return True
-        return (isinstance(node, ast.ImportFrom)
-                and any(a.name == REQLOG_SCHEMA_NAME for a in node.names))
-
-    def _is_wall_clock_call(node: ast.AST) -> bool:
-        if not isinstance(node, ast.Call):
-            return False
-        f = node.func
-        if isinstance(f, ast.Attribute) and f.attr == "time":
-            return (isinstance(f.value, ast.Name)
-                    and f.value.id in time_aliases)
-        return isinstance(f, ast.Name) and f.id in tt_names
-
-    out = []
-    for node in ast.walk(tree):
-        if (not print_ok and isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Name)
-                and node.func.id == "print"):
-            out.append(f"{rel_path}:{node.lineno}: print() outside a CLI "
-                       f"entry point — library code logs, counts "
-                       f"(telemetry.metrics) or spans (telemetry.tracing); "
-                       f"stdout belongs to the drivers")
-        elif (pc_banned
-              and _is_perf_counter(node, time_aliases, pc_names)):
-            out.append(f"{rel_path}:{node.lineno}: time.perf_counter "
-                       f"outside telemetry/ — measure durations through "
-                       f"the metrics registry's Histogram.time() or a "
-                       f"tracing span so /metrics and trace.jsonl see them")
-        elif (pc_banned and isinstance(node, ast.BinOp)
-              and isinstance(node.op, ast.Sub)
-              and (_is_wall_clock_call(node.left)
-                   or _is_wall_clock_call(node.right))):
-            out.append(f"{rel_path}:{node.lineno}: duration computed from "
-                       f"time.time() — the wall clock is for timestamps "
-                       f"(it jumps); measure durations with a registry "
-                       f"timer or a tracing span")
-        elif (binning_banned and isinstance(node, ast.Call)
-              and isinstance(node.func, ast.Attribute)
-              and node.func.attr in HISTOGRAM_ATTRS
-              and _is_np_module(node.func.value)):
-            out.append(
-                f"{rel_path}:{node.lineno}: {node.func.attr}() outside "
-                f"photon_ml_tpu/quality/ — score-histogram binning lives "
-                f"in quality/baseline.py (bin_scores/quantile_edges) so "
-                f"live and baseline distributions always share bin "
-                f"edges; a second binning silently redefines drift")
-        elif (binning_banned and isinstance(node, ast.FunctionDef)
-              and node.name in DRIFT_STAT_NAMES):
-            out.append(
-                f"{rel_path}:{node.lineno}: drift statistic "
-                f"{node.name}() defined outside photon_ml_tpu/quality/ — "
-                f"PSI/KS have ONE implementation (quality/baseline.py); "
-                f"import it instead of re-deriving the arithmetic")
-        elif id_gen_banned and _is_id_gen_call(node):
-            out.append(
-                f"{rel_path}:{node.lineno}: request-id generation outside "
-                f"photon_ml_tpu/serving/http.py — a serving request is "
-                f"identified ONCE (new_request_id); a second mint breaks "
-                f"the span/reqlog/response join (hygiene rule 7)")
-        elif reqlog_banned and _is_reqlog_schema_ref(node):
-            out.append(
-                f"{rel_path}:{node.lineno}: {REQLOG_SCHEMA_NAME} referenced "
-                f"outside photon_ml_tpu/serving/reqlog.py — the request "
-                f"log has ONE writer; a second one forks the on-disk "
-                f"format away from tools/reqlog_replay.py (hygiene rule 7)")
-        elif isinstance(node, ast.Call):
-            func = node.func
-            is_factory = (
-                (isinstance(func, ast.Attribute)
-                 and func.attr in METRIC_FACTORIES)
-                or (isinstance(func, ast.Name)
-                    and func.id in metric_fn_names))
-            if is_factory:
-                name, help_, has_help = _metric_call_args(node)
-                if name is not None:
-                    if not METRIC_NAME_RE.fullmatch(name):
-                        out.append(
-                            f"{rel_path}:{node.lineno}: metric name "
-                            f"{name!r} must match photon_[a-z0-9_]+ — the "
-                            f"fleet aggregate merges by family name, so "
-                            f"every family carries the photon_ prefix")
-                    if not has_help or (help_ is not None
-                                        and not help_.strip()):
-                        out.append(
-                            f"{rel_path}:{node.lineno}: metric {name!r} "
-                            f"registered without help text — a scrape "
-                            f"nobody can interpret; say what the number "
-                            f"means")
-            if (not registry_ok
-                    and ((isinstance(func, ast.Name)
-                          and func.id == "MetricsRegistry")
-                         or (isinstance(func, ast.Attribute)
-                             and func.attr == "MetricsRegistry"))):
-                out.append(
-                    f"{rel_path}:{node.lineno}: MetricsRegistry() outside "
-                    f"photon_ml_tpu/telemetry/ — the process-global "
-                    f"default_registry() is the only sanctioned registry "
-                    f"outside tests; a private one forks the namespace "
-                    f"away from /metrics and the fleet fold")
-    return out
+    return [f.legacy() for f in engine.check_source(
+        source, rel_path, TELEMETRY_RULE_IDS)]
 
 
 def main(root: str = ".") -> int:
-    pkg = os.path.join(root, "photon_ml_tpu")
-    violations: list[str] = []
-    for dirpath, _, filenames in os.walk(pkg):
-        for name in sorted(filenames):
-            if not name.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, name)
-            rel = os.path.normpath(os.path.relpath(path, root))
-            with open(path, encoding="utf-8") as f:
-                violations.extend(check_source(f.read(), rel))
-    for v in violations:
-        print(v)
-    if violations:
-        print(f"{len(violations)} telemetry-hygiene violation(s)")
+    report = engine.run(root, rule_ids=TELEMETRY_RULE_IDS,
+                        prefixes=("photon_ml_tpu",))
+    for f in report.findings:
+        print(f.legacy())
+    if report.findings:
+        print(f"{len(report.findings)} telemetry-hygiene violation(s)")
         return 1
     return 0
 

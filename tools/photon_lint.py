@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""photon-lint: run every static-analysis pass over the tree.
+
+The unified front end of ``photon_ml_tpu/analysis/`` (see ANALYSIS.md).
+Runs the 12 legacy hygiene rules (``res-*``/``tel-*``), the trace-safety
+pass (``trace-*``), the lock-discipline pass (``lock-*``) and the
+whole-tree consistency rules (``obs-metric-catalog``,
+``res-fault-coverage``) over ``photon_ml_tpu/`` + ``tools/`` and reports
+``path:line rule-id message`` per finding.
+
+Usage::
+
+    python tools/photon_lint.py [root]
+        [--rules res-sleep,trace-clock]   # subset by rule id
+        [--json]                          # machine-readable report
+        [--list-rules]                    # rule catalog, one id per line
+
+Exit codes follow the ``bench_gate.py`` verdict convention: 0 = clean,
+1 = findings (fix or suppress with a justified ``# photon-lint:
+disable=<rule-id> -- <reason>``), 2 = the LINT failed (unknown rule id,
+unparseable source, crash) — rerun/fix the invocation, nothing is known
+about the tree.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from photon_ml_tpu.analysis import engine  # noqa: E402
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="photon_lint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("root", nargs="?", default=".",
+                        help="repo root to scan (default: .)")
+    parser.add_argument("--rules", default=None,
+                        help="comma-separated rule ids (default: all)")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the machine-readable JSON report")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalog and exit")
+    args = parser.parse_args(argv)
+
+    try:
+        if args.list_rules:
+            for rid, r in sorted(engine.all_rules().items()):
+                print(f"{rid:24s} [{r.scope}] {r.summary}")
+            return 0
+        rule_ids = (None if args.rules is None
+                    else [s.strip() for s in args.rules.split(",")
+                          if s.strip()])
+        report = engine.run(args.root, rule_ids=rule_ids)
+    except Exception as e:
+        print(f"photon-lint: internal error: {e!r}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(report.to_json())
+    else:
+        for f in report.findings:
+            print(f.render())
+        if report.findings:
+            print(f"{len(report.findings)} finding(s) "
+                  f"({len(report.suppressed)} suppressed with "
+                  f"justification)")
+    return 1 if report.findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
