@@ -219,6 +219,122 @@ def check_table_home(ctx: FileContext):
             "store.gather_rows / device_params")
 
 
+#: serving/ — the one package where every queue must be bounded (the
+#: admission-control contract: overload sheds loudly, it never queues
+#: forever)
+SERVING_PREFIX = os.path.join("photon_ml_tpu", "serving") + os.sep
+
+
+def _const_zero(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Constant)
+            and isinstance(node.value, int) and node.value == 0)
+
+
+def _has_bound(node: ast.Call, kwarg: str, pos: int) -> bool:
+    """Does this constructor call carry a bound — ``kwarg=`` (non-zero
+    when a constant) or a positional argument at ``pos``?"""
+    for kw in node.keywords:
+        if kw.arg == kwarg:
+            return not _const_zero(kw.value)
+    if len(node.args) > pos:
+        return not _const_zero(node.args[pos])
+    return False
+
+
+def _fifo_attrs(tree: ast.AST) -> set[str]:
+    """``self.<attr>`` names used FIFO-style: ``.pop(0)`` or
+    ``.insert(0, ...)`` — a plain list serving as a queue."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)):
+            continue
+        recv = node.func.value
+        if not (isinstance(recv, ast.Attribute)
+                and isinstance(recv.value, ast.Name)
+                and recv.value.id == "self"):
+            continue
+        if (node.func.attr in ("pop", "insert") and node.args
+                and _const_zero(node.args[0])):
+            out.add(recv.attr)
+    return out
+
+
+@rule("res-bounded-queue",
+      "no unbounded deque()/queue.Queue()/list-as-queue construction "
+      "inside serving/ — overload must shed, not queue forever")
+def check_bounded_queue(ctx: FileContext):
+    if not ctx.path.startswith(SERVING_PREFIX):
+        return
+    deque_names = ctx.from_aliases("collections", "deque")
+    collections_aliases = ctx.module_aliases("collections")
+    queue_cls_names = ctx.from_aliases("queue", "Queue", "LifoQueue",
+                                       "PriorityQueue")
+    simple_names = ctx.from_aliases("queue", "SimpleQueue")
+    queue_aliases = ctx.module_aliases("queue")
+    fifo = _fifo_attrs(ctx.tree)
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            is_deque = (
+                (isinstance(fn, ast.Name) and fn.id in deque_names)
+                or (isinstance(fn, ast.Attribute) and fn.attr == "deque"
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id in collections_aliases))
+            is_queue = (
+                (isinstance(fn, ast.Name)
+                 and fn.id in queue_cls_names)
+                or (isinstance(fn, ast.Attribute)
+                    and fn.attr in ("Queue", "LifoQueue", "PriorityQueue")
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id in queue_aliases))
+            is_simple = (
+                (isinstance(fn, ast.Name) and fn.id in simple_names)
+                or (isinstance(fn, ast.Attribute)
+                    and fn.attr == "SimpleQueue"
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id in queue_aliases))
+            if is_deque and not _has_bound(node, "maxlen", 1):
+                yield ctx.finding(
+                    "res-bounded-queue", node,
+                    "unbounded deque() in serving/ — a request queue with "
+                    "no bound degrades overload into unbounded latency; "
+                    "pass maxlen= or justify the explicit admission check "
+                    "with a suppression")
+            elif is_queue and not _has_bound(node, "maxsize", 0):
+                yield ctx.finding(
+                    "res-bounded-queue", node,
+                    "unbounded queue.Queue() in serving/ — pass a "
+                    "positive maxsize (or justify with a suppression)")
+            elif is_simple:
+                yield ctx.finding(
+                    "res-bounded-queue", node,
+                    "queue.SimpleQueue() in serving/ has no capacity "
+                    "bound at all — use queue.Queue(maxsize=N)")
+        elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            value = node.value
+            is_empty_list = (isinstance(value, ast.List) and not value.elts
+                             ) or (isinstance(value, ast.Call)
+                                   and isinstance(value.func, ast.Name)
+                                   and value.func.id == "list"
+                                   and not value.args and not value.keywords)
+            if not is_empty_list:
+                continue
+            for t in targets:
+                if (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self" and t.attr in fifo):
+                    yield ctx.finding(
+                        "res-bounded-queue", t,
+                        f"list-as-queue in serving/: self.{t.attr} is "
+                        f"drained with pop(0)/insert(0, ..) but "
+                        f"constructed with no bound — bound it or "
+                        f"justify the bounding logic with a suppression")
+
+
 #: the shim's rule subset, in the legacy tool's documented order
+#: (``res-bounded-queue`` is engine-only — it postdates the legacy tool)
 RESILIENCE_RULE_IDS = ("res-bare-except", "res-sleep", "res-part-write",
                        "res-process", "res-table-home")

@@ -50,6 +50,27 @@ def build_parser() -> argparse.ArgumentParser:
                         "batcher (single requests hit the engine directly)")
     p.add_argument("--max-wait-ms", type=float, default=2.0,
                    help="microbatcher linger after the first queued request")
+    p.add_argument("--max-queue", type=int, default=1024,
+                   help="admission-control bound on the microbatcher "
+                        "queue: a submit against a full queue is shed "
+                        "with a typed 429 + Retry-After (counted in "
+                        "photon_shed_total{reason=queue_full}) instead "
+                        "of queueing forever; 0 = unbounded (NOT "
+                        "recommended under real traffic)")
+    p.add_argument("--request-timeout-ms", type=float, default=0.0,
+                   help="server-side deadline for requests that carry no "
+                        "X-Photon-Deadline-Ms header: the budget is "
+                        "stamped at parse and checked at queue drain — "
+                        "an expired request is shed (429, reason="
+                        "deadline) BEFORE it reaches the engine. 0 = no "
+                        "server default")
+    p.add_argument("--brownout-poll-s", type=float, default=1.0,
+                   help="poll interval of the brownout controller "
+                        "(serving/overload.py) watching queue pressure "
+                        "and shedding optional work — reqlog sampling, "
+                        "quality accumulation, span tracing, then "
+                        "traffic — one level per tick, restoring in "
+                        "reverse on recovery. 0 disables the controller")
     p.add_argument("--no-warmup", action="store_true",
                    help="skip pre-compiling the bucket executables at "
                         "startup (first requests then pay the compiles)")
@@ -138,7 +159,14 @@ def build_server(argv: Optional[Sequence[str]] = None):
     if args.microbatch > 0:
         batcher = MicroBatcher(
             lambda records: registry.active().score(records),
-            max_batch=args.microbatch, max_wait_ms=args.max_wait_ms)
+            max_batch=args.microbatch, max_wait_ms=args.max_wait_ms,
+            max_queue=args.max_queue if args.max_queue > 0 else None)
+    overload = None
+    if batcher is not None and args.brownout_poll_s > 0:
+        from photon_ml_tpu.serving import OverloadController
+
+        overload = OverloadController(
+            batcher, poll_s=args.brownout_poll_s).start()
     reqlog = None
     if args.reqlog_dir:
         from photon_ml_tpu.serving import RequestLog
@@ -148,7 +176,9 @@ def build_server(argv: Optional[Sequence[str]] = None):
             segment_records=args.reqlog_segment_records,
             max_bytes=int(args.reqlog_max_mb * (1 << 20)))
     service = ServingService(registry, default_model_dir=args.model_dir,
-                             batcher=batcher, reqlog=reqlog)
+                             batcher=batcher, reqlog=reqlog,
+                             default_timeout_ms=args.request_timeout_ms,
+                             overload=overload)
     server = GameServer(service, host=args.host, port=args.port)
     server.telemetry = telemetry  # closed by run()'s finally
     server.watcher = None
@@ -173,7 +203,7 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
     server = build_server(argv)
     version = server.service.registry.active_version
     print(f"serving GAME model version {version} on {server.url} "
-          f"(/score /healthz /metrics /reload)", flush=True)
+          f"(/score /healthz /readyz /metrics /reload)", flush=True)
     try:
         server.serve_forever()
     except KeyboardInterrupt:

@@ -28,6 +28,24 @@ misbehave. The registered sites:
 ``worker.stall``          one visit per sweep (``mode="stall"`` sleeps;
                           ``mode="kill"`` dies abruptly — the supervised-
                           recovery crash site)
+``serving.parse``         one visit per POST parse in the serving front end
+                          (``serving/http.py``) — a fault surfaces as a 500
+                          on that request only
+``serving.execute``       one visit per scoring call
+                          (``serving/engine.py::ScoringEngine.score``) — a
+                          fault fails that batch's requests; the batcher
+                          worker and every other request survive
+``serving.reload``        one visit per ``/reload``/watch-dir activation
+                          attempt (``serving/registry.py::reload``) — a
+                          fault rejects the candidate and the incumbent
+                          keeps serving
+``serving.watch_tick``    one visit per watch-dir poll
+                          (``serving/watcher.py::scan_once``) — the poll
+                          loop retries next tick, no candidate is lost
+``io.save.reqlog``        one visit per request-log segment write on the
+                          background pool (``serving/reqlog.py``) — a
+                          fault counts the segment as dropped (loss, not
+                          retention) and never disturbs traffic
 ========================  ====================================================
 
 Activation is explicit only: :func:`activate` / the :func:`injected` context
@@ -56,7 +74,9 @@ import numpy as np
 #: canonical site names (free-form strings are accepted; these are the ones
 #: the framework threads)
 SITES = ("io.read", "ckpt.save", "io.model_save", "io.delta_publish",
-         "collective", "optimizer.step", "worker.stall")
+         "collective", "optimizer.step", "worker.stall",
+         "serving.parse", "serving.execute", "serving.reload",
+         "serving.watch_tick", "io.save.reqlog")
 
 _MODES = ("raise", "nan", "stall", "kill")
 

@@ -26,8 +26,18 @@ built here as four layers (see SERVING.md for the architecture doc):
   or continuous-training coefficient patches — see CONTINUOUS.md)
   through the same validate-then-activate path
   (``serve_game --watch-dir``).
+- :mod:`~photon_ml_tpu.serving.overload` — overload protection: typed
+  load shedding (:class:`Shed` → 429 + ``Retry-After``, counted in
+  ``photon_shed_total{reason}``), deadline budgets
+  (``X-Photon-Deadline-Ms``), and the brownout controller that sheds
+  optional work (reqlog → quality → tracing) before traffic
+  (SERVING.md "Serving under overload").
 """
 
+from photon_ml_tpu.serving.overload import (  # noqa: F401
+    OverloadController,
+    Shed,
+)
 from photon_ml_tpu.serving.batcher import MicroBatcher  # noqa: F401
 from photon_ml_tpu.serving.engine import (  # noqa: F401
     RequestBatch,

@@ -12,6 +12,18 @@ Swap interaction: the score function is resolved PER BATCH (the registry's
 active engine), so a hot-swap takes effect at the next batch boundary and a
 batch never mixes versions.
 
+Admission control (SERVING.md "Serving under overload"): ``max_queue``
+bounds the queue — a submit against a full queue is refused with a typed
+:class:`~photon_ml_tpu.serving.overload.Shed` (``reason="queue_full"``,
+mapped to 429 by the HTTP layer) instead of parking behind work the host
+cannot catch up on. Requests may carry a monotonic ``deadline``; the
+drain checks it as each batch assembles and sheds expired entries
+(``reason="deadline"``) rather than scoring for a caller that already gave
+up — a shed request NEVER reaches the engine's execute stage. A
+``score(timeout=)`` caller that times out cancels its Future, and the
+drain discards cancelled (abandoned) entries without letting them consume
+a batch slot.
+
 Worker-death contract: an ordinary scoring exception fails only its batch
 (the Futures get the exception, the worker keeps draining). Anything that
 escapes that per-batch handling — a BaseException out of the score fn, a
@@ -34,10 +46,12 @@ from __future__ import annotations
 import collections
 import threading
 from concurrent.futures import Future, InvalidStateError
+from concurrent.futures import TimeoutError as FutureTimeoutError
 from typing import Callable, Optional, Sequence
 
 import numpy as np
 
+from photon_ml_tpu.serving import overload as _overload
 from photon_ml_tpu.telemetry import metrics as _metrics
 
 #: how well the linger window coalesces traffic — the distribution should
@@ -78,18 +92,26 @@ class MicroBatcher:
 
     ``score_fn(records) -> np.ndarray`` scores one homogeneous batch (the
     registry's active version). Thread-safe; :meth:`submit` never blocks
-    beyond the queue lock.
+    beyond the queue lock. ``max_queue=None`` leaves the queue unbounded
+    (embedder's choice — ``serve_game`` always bounds it).
     """
 
     def __init__(self, score_fn: Callable[[Sequence[dict]], np.ndarray], *,
-                 max_batch: int = 64, max_wait_ms: float = 2.0):
+                 max_batch: int = 64, max_wait_ms: float = 2.0,
+                 max_queue: Optional[int] = None):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if max_queue is not None and max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1 (or None for "
+                             f"unbounded), got {max_queue}")
         self._score_fn = score_fn
         self.max_batch = max_batch
         self.max_wait_s = max_wait_ms / 1000.0
+        self.max_queue = max_queue
         self._cond = threading.Condition()
-        self._queue: collections.deque = collections.deque()  # guarded-by: _cond
+        # bounded by the max_queue admission check in submit() (a maxlen
+        # deque would silently evict — shedding must be loud and typed)
+        self._queue: collections.deque = collections.deque()  # guarded-by: _cond  # photon-lint: disable=res-bounded-queue -- bounded by the explicit max_queue Shed check in submit(); maxlen would drop silently
         self._closed = False  # guarded-by: _cond
         #: the BaseException that killed the worker, None while healthy
         self._dead: Optional[BaseException] = None  # guarded-by: _cond
@@ -103,9 +125,25 @@ class MicroBatcher:
                                         name="photon-serving-batcher")
         self._worker.start()
 
-    def submit(self, record: dict) -> "Future[float]":
+    @property
+    def dead(self) -> Optional[BaseException]:
+        """The exception that killed the worker, None while healthy (the
+        ``/readyz`` liveness signal)."""
+        with self._cond:
+            return self._dead
+
+    def queue_depth(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+    def submit(self, record: dict,
+               deadline: Optional[float] = None) -> "Future[float]":
         """Enqueue one record; the Future resolves to its float score.
-        Raises once the batcher is closed or its worker has died."""
+        ``deadline`` is an absolute ``time.monotonic()`` instant — an
+        entry still queued past it is shed at drain time. Raises
+        :class:`~photon_ml_tpu.serving.overload.Shed` when the bounded
+        queue is full, RuntimeError once the batcher is closed or its
+        worker has died."""
         import time
 
         fut: Future = Future()
@@ -115,15 +153,32 @@ class MicroBatcher:
                     f"batcher worker died: {self._dead!r}") from self._dead
             if self._closed:
                 raise RuntimeError("batcher is closed")
-            self._queue.append((record, fut, time.monotonic()))
+            if (self.max_queue is not None
+                    and len(self._queue) >= self.max_queue):
+                # admission control: refuse NOW (429 + Retry-After at the
+                # HTTP layer) instead of queueing work the host is too far
+                # behind to finish before the caller gives up
+                raise _overload.shed(
+                    "queue_full",
+                    message=f"queue full ({len(self._queue)}/"
+                            f"{self.max_queue} requests waiting)",
+                    retry_after_s=max(self.max_wait_s * 2, 0.05))
+            self._queue.append((record, fut, time.monotonic(), deadline))
             _QUEUE_DEPTH.set(len(self._queue))
             self._cond.notify()
         return fut
 
-    def score(self, record: dict,
-              timeout: Optional[float] = None) -> float:
-        """Blocking convenience wrapper around :meth:`submit`."""
-        return self.submit(record).result(timeout=timeout)
+    def score(self, record: dict, timeout: Optional[float] = None,
+              deadline: Optional[float] = None) -> float:
+        """Blocking convenience wrapper around :meth:`submit`. On timeout
+        the Future is cancelled so the abandoned entry is discarded at
+        drain time instead of consuming a batch slot."""
+        fut = self.submit(record, deadline=deadline)
+        try:
+            return fut.result(timeout=timeout)
+        except FutureTimeoutError:
+            fut.cancel()
+            raise
 
     def close(self) -> None:
         """Drain outstanding work, then stop the worker."""
@@ -151,11 +206,11 @@ class MicroBatcher:
     def _process(self, batch: list) -> None:
         import time
 
-        records = [r for r, _, _ in batch]
+        records = [r for r, _, _, _ in batch]
         _BATCH_SIZE.observe(len(records))
         now = time.monotonic()
         wait_hist = _STAGE_SECONDS.labels(stage="queue_wait")
-        for _, _, t_enq in batch:
+        for _, _, t_enq, _ in batch:
             wait_hist.observe(max(now - t_enq, 0.0))
         with self._cond:
             self._inflight = batch
@@ -187,10 +242,10 @@ class MicroBatcher:
 
     def _finish(self, batch: list, *, scores=None, exception=None) -> None:
         if exception is not None:
-            for _, fut, _ in batch:
+            for _, fut, _, _ in batch:
                 _resolve(fut, exception=exception)
         else:
-            for (_, fut, _), s in zip(batch, scores):
+            for (_, fut, _, _), s in zip(batch, scores):
                 _resolve(fut, result=float(s))
         with self._cond:
             self._inflight = []
@@ -207,30 +262,53 @@ class MicroBatcher:
             self._cond.notify_all()
         err = RuntimeError(f"batcher worker died: {exc!r}")
         err.__cause__ = exc
-        for _, fut, _ in pending:
+        for _, fut, _, _ in pending:
             _resolve(fut, exception=err)
 
     def _next_batch(self):
         """Block for the first request, then linger ``max_wait_s`` for
-        followers (or until ``max_batch`` is reached). None = closed and
-        drained."""
+        followers (or until ``max_batch`` is reached). Expired-deadline
+        entries are shed here — at queue drain, before any batch
+        assembly — and cancelled (abandoned) entries are discarded;
+        neither consumes a batch slot or reaches the score fn. None =
+        closed and drained."""
         import time
 
-        with self._cond:
-            while not self._queue:
-                if self._closed:
-                    return None
-                self._cond.wait()
-            if self.max_wait_s > 0:
-                deadline = time.monotonic() + self.max_wait_s
-                while (len(self._queue) < self.max_batch
-                       and not self._closed):
-                    remaining = deadline - time.monotonic()
-                    if remaining <= 0:
-                        break
-                    self._cond.wait(timeout=remaining)
-            out = []
-            while self._queue and len(out) < self.max_batch:
-                out.append(self._queue.popleft())
-            _QUEUE_DEPTH.set(len(self._queue))
-            return out
+        while True:
+            expired = []
+            with self._cond:
+                while not self._queue:
+                    if self._closed:
+                        return None
+                    self._cond.wait()
+                if self.max_wait_s > 0:
+                    linger = time.monotonic() + self.max_wait_s
+                    while (len(self._queue) < self.max_batch
+                           and not self._closed):
+                        remaining = linger - time.monotonic()
+                        if remaining <= 0:
+                            break
+                        self._cond.wait(timeout=remaining)
+                out = []
+                now = time.monotonic()
+                while self._queue and len(out) < self.max_batch:
+                    entry = self._queue.popleft()
+                    _, fut, _, deadline = entry
+                    if fut.cancelled():
+                        # abandoned by a timed-out score() caller: the
+                        # request has no listener — don't spend a slot
+                        continue
+                    if deadline is not None and now >= deadline:
+                        expired.append(entry)
+                        continue
+                    out.append(entry)
+                _QUEUE_DEPTH.set(len(self._queue))
+            for _, fut, _, _ in expired:
+                # shed, not scored: the caller's budget is already gone
+                _resolve(fut, exception=_overload.shed(
+                    "deadline",
+                    message="deadline expired while queued"))
+            if out:
+                return out
+            # everything drained this round was expired or abandoned —
+            # go back to waiting for live work

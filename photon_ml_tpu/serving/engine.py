@@ -38,7 +38,9 @@ from photon_ml_tpu.game.model import (
 )
 from photon_ml_tpu.io.data_reader import FeatureShardConfig, _record_features
 from photon_ml_tpu.io.index import IndexMap
+from photon_ml_tpu.resilience.faults import fault_point
 from photon_ml_tpu.types import INTERCEPT_KEY
+from photon_ml_tpu.serving import overload as _overload
 from photon_ml_tpu.serving import store as _store
 from photon_ml_tpu.serving.store import EntityCoefficientStore
 from photon_ml_tpu.telemetry import metrics as _metrics
@@ -223,6 +225,11 @@ class ScoringEngine:
     # --- scoring ----------------------------------------------------------
     def score(self, records: Sequence[dict]) -> np.ndarray:
         """Total GAME score per record (float32, batch-path parity)."""
+        # the serving-side chaos site: one visit per scoring call, BEFORE
+        # any stage work — an injected fault fails this batch (its Futures
+        # get the error, the batcher worker survives) and a request shed by
+        # admission control never even reaches this point
+        fault_point("serving.execute", n=len(records))
         with _STAGE_SECONDS.labels(stage="batch_assemble").time():
             batch = self.pack(records)
         return self.score_batch(batch)
@@ -239,6 +246,10 @@ class ScoringEngine:
             self._n_calls += 1
             self._n_scored += batch.n
         monitor = self.monitor
+        if monitor is not None and _overload.is_shed("quality"):
+            # brownout level 2+: quality accumulation is optional work —
+            # shed it before shedding traffic (SERVING.md overload ladder)
+            monitor = None
         if monitor is not None:
             # live quality accumulation (quality/monitor.py): fallback-row
             # hits per coordinate + nonzero design cells per shard are

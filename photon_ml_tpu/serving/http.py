@@ -8,9 +8,18 @@ and none needed — handlers are thin marshaling around the registry/batcher):
   Records are TrainingExampleAvro-shaped dicts (``features`` list,
   ``metadataMap``, optional ``offset``). Single records route through the
   microbatcher when enabled; explicit batches go straight to the engine.
+  A request refused by admission control — full bounded queue, expired
+  ``X-Photon-Deadline-Ms`` budget, max brownout — returns **429** with a
+  ``Retry-After`` header and a ``reason`` body field (never a hang; see
+  SERVING.md "Serving under overload"). A deadline's remaining budget is
+  echoed back (header + ``deadline_ms``) like the request id.
 - ``GET /healthz`` — liveness + the serving counters the bench asserts on
   (active version, engine compile count, requests/scores served, canary
-  reservoir size, request-log budget).
+  reservoir size, request-log budget, queue depth / shed tallies /
+  brownout level).
+- ``GET /readyz`` — readiness: 503 (with reasons) while there is no
+  active model, the batcher worker is dead, or brownout is at max level;
+  what load balancers and ``bench_serving`` gate on.
 - ``GET /metrics`` — Prometheus text exposition of the process-global
   telemetry registry (request latency histogram, per-stage request-path
   histogram, per-bucket score latency, recompile counter, ...).
@@ -43,6 +52,7 @@ never calls ``time.perf_counter`` directly — see
 
 from __future__ import annotations
 
+import contextlib
 import json
 import threading
 import time
@@ -50,6 +60,8 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Mapping, Optional
 
+from photon_ml_tpu.resilience.faults import fault_point
+from photon_ml_tpu.serving import overload as _overload
 from photon_ml_tpu.serving.batcher import MicroBatcher
 from photon_ml_tpu.serving.registry import ModelRegistry
 from photon_ml_tpu.serving.reqlog import RequestLog
@@ -74,10 +86,36 @@ _STAGE_SECONDS = _metrics.histogram(
 #: the inbound/outbound request-id header
 REQUEST_ID_HEADER = "X-Photon-Request-Id"
 
+#: inbound: the caller's remaining latency budget in milliseconds, stamped
+#: against the monotonic clock at parse time; outbound: the budget still
+#: remaining when the response was written (echoed like the request id)
+DEADLINE_HEADER = "X-Photon-Deadline-Ms"
+
 
 def new_request_id() -> str:
     """The ONE place a serving request id is minted (hygiene rule 7)."""
     return uuid.uuid4().hex
+
+
+class _NullSpan:
+    """Span stand-in while brownout sheds tracing (level 3+)."""
+
+    def set(self, **attrs) -> None:
+        pass
+
+
+_NULL_SPAN = _NullSpan()
+
+
+@contextlib.contextmanager
+def _maybe_span(name: str, **attrs):
+    """A ``serving.*`` span — unless brownout has shed span tracing
+    (optional work goes before traffic; SERVING.md overload ladder)."""
+    if _overload.is_shed("tracing"):
+        yield _NULL_SPAN
+        return
+    with _tracing.span(name, **attrs) as sp:
+        yield sp
 
 
 class ServingService:
@@ -86,11 +124,19 @@ class ServingService:
     def __init__(self, registry: ModelRegistry, *,
                  default_model_dir: Optional[str] = None,
                  batcher: Optional[MicroBatcher] = None,
-                 reqlog: Optional[RequestLog] = None):
+                 reqlog: Optional[RequestLog] = None,
+                 default_timeout_ms: float = 0.0,
+                 overload=None):
         self.registry = registry
         self.default_model_dir = default_model_dir
         self.batcher = batcher
         self.reqlog = reqlog
+        #: server-side deadline applied to requests that carry no
+        #: X-Photon-Deadline-Ms of their own (0 = none)
+        self.default_timeout_ms = float(default_timeout_ms)
+        #: optional OverloadController (serving/overload.py), owned here:
+        #: closed with the service, surfaced by /readyz
+        self.overload = overload
         self._lock = threading.Lock()
         self.n_requests = 0  # guarded-by: _lock
         self.n_scored = 0  # guarded-by: _lock
@@ -98,15 +144,49 @@ class ServingService:
         # telemetry hygiene rule 5 bans wall-clock arithmetic for durations)
         self._started_monotonic = time.monotonic()
 
+    # --- deadlines --------------------------------------------------------
+    def resolve_deadline(self,
+                         budget_ms: "str | float | None") -> Optional[float]:
+        """Stamp a request's latency budget against the monotonic clock —
+        called AT PARSE TIME so queueing and scoring spend the same
+        budget the caller measures. ``budget_ms`` is the raw
+        ``X-Photon-Deadline-Ms`` header (or a number); absent, the
+        server-side ``default_timeout_ms`` applies; neither → None (no
+        deadline). Raises ValueError on an unparsable header."""
+        if budget_ms is None or budget_ms == "":
+            budget_ms = (self.default_timeout_ms
+                         if self.default_timeout_ms > 0 else None)
+        if budget_ms is None:
+            return None
+        try:
+            budget = float(budget_ms)
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"bad {DEADLINE_HEADER} header {budget_ms!r} (want a "
+                f"millisecond budget)") from None
+        return time.monotonic() + budget / 1e3
+
+    @staticmethod
+    def remaining_ms(deadline: Optional[float]) -> Optional[float]:
+        if deadline is None:
+            return None
+        return max(0.0, (deadline - time.monotonic()) * 1e3)
+
     # --- endpoints --------------------------------------------------------
     def score(self, payload: dict,
               request_id: Optional[str] = None,
-              stage_ms: Optional[Mapping[str, float]] = None) -> dict:
+              stage_ms: Optional[Mapping[str, float]] = None,
+              deadline: Optional[float] = None) -> dict:
         """Score one request. ``request_id`` is assigned by the HTTP layer
         (direct embedders may omit it — one is minted here so the span and
         the request log never carry an empty identity); ``stage_ms`` folds
         the HTTP layer's already-measured stages (parse) into the logged
-        timings."""
+        timings; ``deadline`` is the absolute monotonic instant from
+        :meth:`resolve_deadline`. Raises
+        :class:`~photon_ml_tpu.serving.overload.Shed` (→ 429) when the
+        request is refused by admission control — an expired deadline, a
+        full microbatcher queue, or max brownout — WITHOUT it ever
+        reaching the engine's execute stage or the latency histogram."""
         if request_id is None:
             request_id = new_request_id()
         if "record" in payload:
@@ -116,15 +196,33 @@ class ServingService:
         if not isinstance(records, list) or not records:
             raise ValueError("payload needs 'records': [non-empty list] "
                              "or 'record': {...}")
+        if deadline is not None and time.monotonic() >= deadline:
+            # the caller already gave up — scoring would be pure waste
+            raise _overload.shed(
+                "deadline", message="deadline expired before scoring")
+        if _overload.traffic_shed():
+            raise _overload.shed(
+                "brownout",
+                message=f"brownout level {_overload.level()} is shedding "
+                        f"traffic",
+                retry_after_s=2.0)
         with _REQUEST_LATENCY.time() as timer, \
-                _tracing.span("serving.score", request_id=request_id,
-                              batch=len(records)) as sp:
+                _maybe_span("serving.score", request_id=request_id,
+                            batch=len(records)) as sp:
             version = self.registry.active_version
-            if self.batcher is not None and len(records) == 1:
-                scores = [self.batcher.score(records[0])]
-            else:
-                scores = [float(s)
-                          for s in self.registry.active().score(records)]
+            try:
+                if self.batcher is not None and len(records) == 1:
+                    scores = [self.batcher.score(records[0],
+                                                 deadline=deadline)]
+                else:
+                    scores = [float(s)
+                              for s in self.registry.active().score(records)]
+            except _overload.Shed:
+                # shed while queued (queue_full at submit, deadline at
+                # drain): excluded from the latency distribution — a
+                # refusal is not a serving latency
+                timer.discard()
+                raise
             sp.set(version=version)
         latency_ms = timer.seconds * 1e3
         with self._lock:
@@ -143,9 +241,14 @@ class ServingService:
         self.registry.bus.post("serving_request", batch=len(records),
                                latency_ms=latency_ms, version=version,
                                request_id=request_id)
-        return {"scores": scores, "version": version,
-                "latency_ms": round(latency_ms, 3),
-                "request_id": request_id}
+        out = {"scores": scores, "version": version,
+               "latency_ms": round(latency_ms, 3),
+               "request_id": request_id}
+        if deadline is not None:
+            # echo the remaining budget like the request id: the caller
+            # (or a downstream hop) sees how much headroom survived
+            out["deadline_ms"] = round(self.remaining_ms(deadline), 1)
+        return out
 
     def _active_lineage(self) -> Optional[str]:
         active = self.registry.active_or_none()
@@ -174,12 +277,44 @@ class ServingService:
             # traffic the next /reload candidate will be judged against
             "reservoir": len(self.registry.reservoir),
             "uptime_s": round(time.monotonic() - self._started_monotonic, 1),
+            # the overload story, mirrored into /readyz: how deep the
+            # queue is, what has been shed so far, how degraded we are
+            "queue_depth": (0 if self.batcher is None
+                            else self.batcher.queue_depth()),
+            "shed": _overload.shed_counts(),
+            "brownout_level": _overload.level(),
         }
         if self.reqlog is not None:
             out["reqlog"] = self.reqlog.stats()
         if active is not None and active.canary is not None:
             out["canary"] = active.canary
         return out
+
+    def readyz(self) -> tuple[int, dict]:
+        """Readiness, as distinct from liveness: a process can be alive
+        (``/healthz`` answers) yet unable to serve — no active model, a
+        dead batcher worker, or brownout at max level (shedding traffic).
+        Returns ``(status, body)``: 200 ready / 503 not ready, with the
+        reasons and the same overload telemetry ``/healthz`` carries, so
+        a load balancer can both gate on the code and explain the gate."""
+        reasons = []
+        if self.registry.active_or_none() is None:
+            reasons.append("no_active_model")
+        if self.batcher is not None and self.batcher.dead is not None:
+            reasons.append("batcher_worker_dead")
+        lvl = _overload.level()
+        if lvl >= _overload.MAX_LEVEL:
+            reasons.append("brownout_max")
+        body = {
+            "ready": not reasons,
+            "reasons": reasons,
+            "version": self.registry.active_version,
+            "queue_depth": (0 if self.batcher is None
+                            else self.batcher.queue_depth()),
+            "shed": _overload.shed_counts(),
+            "brownout_level": lvl,
+        }
+        return (200 if not reasons else 503), body
 
     def reload(self, payload: dict) -> dict:
         model_dir = payload.get("model_dir") or self.default_model_dir
@@ -197,6 +332,9 @@ class ServingService:
         return out
 
     def close(self) -> None:
+        if self.overload is not None:
+            # stops the controller AND restores brownout level 0
+            self.overload.stop()
         if self.batcher is not None:
             self.batcher.close()
         if self.reqlog is not None:
@@ -216,18 +354,28 @@ def _make_handler(service: ServingService):
             self.request_id = inbound.strip() if inbound else new_request_id()
             return self.request_id
 
-        def _reply(self, status: int, body: dict) -> None:
+        def _reply(self, status: int, body: dict,
+                   headers: Optional[dict] = None) -> None:
             self._reply_raw(status, json.dumps(body).encode(),
-                            "application/json")
+                            "application/json", headers=headers)
 
         def _reply_raw(self, status: int, data: bytes,
-                       content_type: str) -> None:
+                       content_type: str,
+                       headers: Optional[dict] = None) -> None:
             self.send_response(status)
             self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(data)))
             rid = getattr(self, "request_id", None)
             if rid is not None:
                 self.send_header(REQUEST_ID_HEADER, rid)
+            deadline = getattr(self, "deadline", None)
+            if deadline is not None:
+                # remaining budget at respond time, echoed like the id
+                self.send_header(
+                    DEADLINE_HEADER,
+                    f"{service.remaining_ms(deadline):.1f}")
+            for key, value in (headers or {}).items():
+                self.send_header(key, value)
             self.end_headers()
             self.wfile.write(data)
 
@@ -241,6 +389,9 @@ def _make_handler(service: ServingService):
             self._request_id()
             if self.path == "/healthz":
                 self._reply(200, service.healthz())
+            elif self.path == "/readyz":
+                status, body = service.readyz()
+                self._reply(status, body)
             elif self.path == "/metrics":
                 from photon_ml_tpu.telemetry.prometheus import (
                     CONTENT_TYPE,
@@ -253,34 +404,56 @@ def _make_handler(service: ServingService):
 
         def do_POST(self):  # noqa: N802
             rid = self._request_id()
-            with _tracing.span("serving.request", request_id=rid,
-                               path=self.path):
+            with _maybe_span("serving.request", request_id=rid,
+                             path=self.path):
                 self._post_traced(rid)
 
         def _post_traced(self, rid: str) -> None:
-            with _tracing.span("serving.parse", request_id=rid), \
+            payload = None
+            with _maybe_span("serving.parse", request_id=rid), \
                     _STAGE_SECONDS.labels(stage="parse").time() as parse_t:
                 try:
+                    fault_point("serving.parse", path=self.path)
                     payload = self._payload()
+                    # the deadline budget is stamped HERE, at parse: the
+                    # queue wait and the scoring spend the same budget
+                    # the caller started measuring at send
+                    self.deadline = service.resolve_deadline(
+                        self.headers.get(DEADLINE_HEADER))
                     parse_error = None
                 except (ValueError, json.JSONDecodeError) as e:
-                    parse_error = e
+                    parse_error = (400, f"bad request: {e}")
+                except Exception as e:
+                    # an injected serving.parse fault (or a genuine parse-
+                    # path bug) is a server error, not the client's JSON
+                    parse_error = (500, repr(e))
             if parse_error is not None:
-                self._reply(400, {"error": f"bad JSON: {parse_error}"})
+                status, message = parse_error
+                self._reply(status, {"error": message})
                 return
             if self.path == "/score":
+                headers = None
                 try:
                     out = service.score(
                         payload, request_id=rid,
-                        stage_ms={"parse": parse_t.seconds * 1e3})
+                        stage_ms={"parse": parse_t.seconds * 1e3},
+                        deadline=self.deadline)
                     status = 200
+                except _overload.Shed as e:
+                    # admission control refused the request: 429 with a
+                    # Retry-After hint — never a hang, never a 500
+                    out = {"error": str(e), "reason": e.reason,
+                           "request_id": rid}
+                    status = 429
+                    headers = {
+                        "Retry-After": str(max(1, round(e.retry_after_s)))}
                 except ValueError as e:
                     out, status = {"error": str(e)}, 400
                 except Exception as e:
                     out, status = {"error": repr(e)}, 500
-                with _tracing.span("serving.respond", request_id=rid), \
+                with _maybe_span("serving.respond", request_id=rid), \
                         _STAGE_SECONDS.labels(stage="respond").time():
-                    self._reply(status, out)
+                    self._reply(status, out, headers=headers)
             elif self.path == "/reload":
                 try:
                     self._reply(200, service.reload(payload))

@@ -230,7 +230,11 @@ class ModelRegistry:
         (:meth:`load_patch`) — so one publish directory can mix both."""
         try:
             from photon_ml_tpu.io.model_io import model_kind
+            from photon_ml_tpu.resilience import fault_point
 
+            # chaos site: a faulted reload takes the same reject path as
+            # a corrupt candidate — the incumbent version keeps serving
+            fault_point("serving.reload", path=model_dir)
             kind = model_kind(resolve_game_model_dir(model_dir))
         except Exception as e:
             self.bus.post("model_reload_rejected", path=model_dir,

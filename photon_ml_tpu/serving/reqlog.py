@@ -46,6 +46,8 @@ from typing import Mapping, Optional, Sequence
 from photon_ml_tpu.io.avro import write_avro_file
 from photon_ml_tpu.io.pipeline import BackgroundSaver
 from photon_ml_tpu.io.schemas import REQUEST_LOG_AVRO
+from photon_ml_tpu.resilience.faults import fault_point
+from photon_ml_tpu.serving import overload as _overload
 from photon_ml_tpu.telemetry import metrics as _metrics
 
 _RECORDS_TOTAL = _metrics.counter(
@@ -103,7 +105,7 @@ class RequestLog:
         self._seq = 0  # guarded-by: _lock
         #: [(path, records, bytes)] of live segments, oldest first —
         #: what rotation walks (bytes filled in post-write)
-        self._segments: list[list] = []  # guarded-by: _lock
+        self._segments: list[list] = []  # guarded-by: _lock  # photon-lint: disable=res-bounded-queue -- bounded by max_bytes: _rotate()'s pop(0) IS the bound (retention, not a request queue)
         self._closed = False  # guarded-by: _lock
         #: this log's own outstanding segment futures (pruned as they
         #: complete; a shared pool's other writes are never touched)
@@ -116,7 +118,12 @@ class RequestLog:
     # --- sampling ---------------------------------------------------------
     def should_log(self, request_id: str) -> bool:
         """Deterministic per-id sampling decision (same id → same verdict
-        on every host and every retry)."""
+        on every host and every retry). Brownout level 1+ suspends
+        sampling entirely — the request log is the FIRST optional work
+        shed under overload (SERVING.md ladder), restored automatically
+        on recovery."""
+        if _overload.is_shed("reqlog"):
+            return False
         if self.sample_rate >= 1.0:
             return True
         if self.sample_rate <= 0.0:
@@ -194,6 +201,9 @@ class RequestLog:
 
             tmp = path + ".tmp"
             try:
+                # chaos site: a failed segment write must surface as LOSS
+                # in the dropped counter and never disturb serving
+                fault_point("io.save.reqlog", path=path)
                 write_avro_file(tmp, batch, REQUEST_LOG_AVRO)
                 os.replace(tmp, path)
             except Exception as e:

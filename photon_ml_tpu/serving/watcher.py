@@ -32,6 +32,7 @@ import os
 import threading
 from typing import Optional
 
+from photon_ml_tpu.resilience.faults import fault_point
 from photon_ml_tpu.serving.registry import ModelRegistry
 
 logger = logging.getLogger(__name__)
@@ -62,6 +63,10 @@ class ModelDirectoryWatcher:
         """Apply every unseen entry (sorted by name); returns how many
         activated. Directly callable — the thread loop is just this on a
         timer, and tests drive it synchronously."""
+        # chaos site: a faulted tick is swallowed by the poll loop and the
+        # NEXT tick picks up whatever this one missed (nothing is marked
+        # seen before its reload attempt, so no candidate is lost)
+        fault_point("serving.watch_tick", dir=self.watch_dir)
         try:
             names = sorted(
                 n for n in os.listdir(self.watch_dir)

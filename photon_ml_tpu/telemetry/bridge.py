@@ -112,6 +112,11 @@ def _make_listener(reg: MetricsRegistry) -> Callable:
         "Canary shadow-scoring evaluations at activation time, by "
         "verdict (pass | divergent | rejected — a closed vocabulary)",
         labels=("verdict",))
+    brownout_changes = reg.counter(
+        "photon_brownout_changes_total",
+        "Serving brownout level transitions (up = degrading under "
+        "pressure, down = recovering — a closed vocabulary)",
+        labels=("direction",))
 
     def listener(event) -> None:
         name, p = event.name, event.payload
@@ -157,6 +162,10 @@ def _make_listener(reg: MetricsRegistry) -> Callable:
         elif name == "canary_evaluated":
             canary_evals.labels(
                 verdict=str(p.get("verdict", "pass"))).inc()
+        elif name == "brownout_changed":
+            direction = ("up" if float(p.get("level", 0))
+                         > float(p.get("previous", 0)) else "down")
+            brownout_changes.labels(direction=direction).inc()
 
     return listener
 

@@ -52,11 +52,12 @@ class _Timer:
     exit and leaves them on ``t.seconds`` for callers that also need the
     value (e.g. a response payload)."""
 
-    __slots__ = ("_hist", "_t0", "seconds")
+    __slots__ = ("_hist", "_t0", "seconds", "_discarded")
 
     def __init__(self, hist: "Histogram"):
         self._hist = hist
         self.seconds = 0.0
+        self._discarded = False
 
     def __enter__(self) -> "_Timer":
         self._t0 = time.perf_counter()
@@ -67,9 +68,17 @@ class _Timer:
         the observation itself still happens once, at exit."""
         return time.perf_counter() - self._t0
 
+    def discard(self) -> None:
+        """Suppress the exit-time observation: the timed region turned out
+        not to represent the measured population (e.g. a request shed by
+        admission control must not pollute the latency distribution).
+        ``seconds`` is still filled in at exit for the caller."""
+        self._discarded = True
+
     def __exit__(self, *exc) -> None:
         self.seconds = time.perf_counter() - self._t0
-        self._hist.observe(self.seconds)
+        if not self._discarded:
+            self._hist.observe(self.seconds)
 
 
 class Counter:

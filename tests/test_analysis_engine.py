@@ -612,3 +612,95 @@ def test_telemetry_shim_messages_are_byte_identical(snippet, expected):
     import check_telemetry_hygiene as shim
 
     assert shim.check_source(snippet, "photon_ml_tpu/x.py") == expected
+
+
+# ---------------------------------------------------------------------------
+# res-bounded-queue (serving/ only — the admission-control contract)
+# ---------------------------------------------------------------------------
+
+SERVING = os.path.join("photon_ml_tpu", "serving", "x.py")
+
+
+def test_bounded_queue_flags_unbounded_deque_in_serving_only():
+    src = """
+        import collections
+
+        class Batcher:
+            def __init__(self):
+                self.q = collections.deque()
+    """
+    assert rule_ids(check(src, ["res-bounded-queue"], rel=SERVING)) == \
+        ["res-bounded-queue"]
+    # the same construction outside serving/ is not a request queue
+    assert check(src, ["res-bounded-queue"]) == []
+
+
+def test_bounded_queue_accepts_bounded_deque_and_from_import_alias():
+    clean = """
+        import collections
+        from collections import deque
+
+        class Batcher:
+            def __init__(self):
+                self.a = collections.deque(maxlen=128)
+                self.b = deque((), 128)
+    """
+    assert check(clean, ["res-bounded-queue"], rel=SERVING) == []
+    bad = """
+        from collections import deque as dq
+
+        class Batcher:
+            def __init__(self):
+                self.q = dq()
+    """
+    assert rule_ids(check(bad, ["res-bounded-queue"], rel=SERVING)) == \
+        ["res-bounded-queue"]
+
+
+def test_bounded_queue_flags_queue_constructions():
+    src = """
+        import queue
+        from queue import Queue, SimpleQueue
+
+        class Front:
+            def __init__(self):
+                self.a = queue.Queue()          # unbounded
+                self.b = Queue(maxsize=0)       # explicit unbounded
+                self.c = queue.Queue(64)        # bounded: fine
+                self.d = Queue(maxsize=64)      # bounded: fine
+                self.e = SimpleQueue()          # never boundable
+    """
+    got = check(src, ["res-bounded-queue"], rel=SERVING)
+    assert rule_ids(got) == ["res-bounded-queue"] * 3
+    assert [f.line for f in got] == [7, 8, 11]
+
+
+def test_bounded_queue_flags_list_as_queue():
+    src = """
+        class Log:
+            def __init__(self):
+                self.segments = []
+                self.plain = []
+
+            def rotate(self):
+                self.segments.pop(0)
+
+            def note(self, x):
+                self.plain.append(x)
+    """
+    got = check(src, ["res-bounded-queue"], rel=SERVING)
+    # only the FIFO-drained attribute is a queue; the append-only list
+    # is not flagged
+    assert rule_ids(got) == ["res-bounded-queue"]
+    assert "segments" in got[0].message
+
+
+def test_bounded_queue_suppression_needs_justification():
+    src = """
+        import collections
+
+        class Batcher:
+            def __init__(self):
+                self.q = collections.deque()  # photon-lint: disable=res-bounded-queue -- bounded by the admission check in submit()
+    """
+    assert check(src, ["res-bounded-queue"], rel=SERVING) == []

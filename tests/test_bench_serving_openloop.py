@@ -53,6 +53,17 @@ class _StubHandler(BaseHTTPRequestHandler):
         with self.server.lock:
             i = self.server.request_index
             self.server.request_index += 1
+        if i in self.server.shed_at:
+            # an admission-control refusal, as serve_game sheds it
+            body = json.dumps({"error": "request shed (queue_full)",
+                               "reason": "queue_full"}).encode()
+            self.send_response(429)
+            self.send_header("Retry-After", "1")
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         stall = self.server.stall_at.get(i, 0.0)
         if stall:
             time.sleep(stall)
@@ -71,6 +82,7 @@ def stub_server():
     httpd.lock = threading.Lock()
     httpd.request_index = 0
     httpd.stall_at = {}
+    httpd.shed_at = set()
     thread = threading.Thread(target=httpd.serve_forever, daemon=True)
     thread.start()
     try:
@@ -121,6 +133,25 @@ class TestCoordinatedOmission:
         corrected_p99 = bench_serving._percentile(run["corrected_ms"], 99)
         assert corrected_p99 < 250.0, corrected_p99
         assert run["achieved_qps"] > 100.0
+
+
+class TestShedClassification:
+    def test_429s_counted_as_shed_not_errors_and_excluded(self,
+                                                          stub_server):
+        """Satellite: shed (429) responses are a separate population —
+        counted in ``shed``, excluded from both latency lists, never in
+        ``errors`` — and the accounting identity served + shed + errored
+        == offered holds."""
+        stub_server.shed_at = {2, 5, 9}
+        run = bench_serving.open_loop_run(
+            _base(stub_server), POOL, [1],
+            target_qps=400.0, requests=40, concurrency=4)
+        assert run["shed"] == 3
+        assert not run["errors"]
+        assert len(run["corrected_ms"]) == 37
+        assert len(run["uncorrected_ms"]) == 37
+        assert (len(run["corrected_ms"]) + run["shed"]
+                + len(run["errors"]) == run["offered"] == 40)
 
 
 class TestSloGate:

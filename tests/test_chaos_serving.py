@@ -1,0 +1,27 @@
+"""Tier-1 smoke (and nightly full grid) for the serving chaos harness
+(``tools/chaos_serving.py``) — the acceptance cell of the overload PR:
+under an injected ``serving.execute``/``serving.parse`` fault plan and
+open-loop load, the accounting identity ``shed + served + errored ==
+offered`` holds, the client-observed sheds match the ``photon_shed_total``
+delta, no Future is stranded (queue drains, worker alive, ``/readyz``
+agrees), and the incumbent model keeps serving BIT-identically across an
+injected ``serving.reload`` fault."""
+
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import chaos_serving  # noqa: E402
+
+
+def test_chaos_serving_smoke_budget():
+    assert chaos_serving.main(["--budget", "smoke"]) == 0
+
+
+@pytest.mark.slow
+def test_chaos_serving_full_grid():
+    assert chaos_serving.main([]) == 0

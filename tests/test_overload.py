@@ -1,0 +1,626 @@
+"""Overload-protection tests (photon_ml_tpu/serving/overload.py + the
+admission-control plumbing through batcher/http/engine/reqlog/watcher).
+
+The load-bearing contracts locked here:
+
+- **admission control**: a full bounded queue or an expired deadline
+  sheds the request with a typed ``Shed`` → HTTP 429 + ``Retry-After``
+  (never a hang), counted once in ``photon_shed_total{reason}``, and a
+  shed request NEVER reaches the engine's execute stage (asserted via the
+  stage histogram);
+- **deadline propagation**: ``X-Photon-Deadline-Ms`` (or the server
+  default ``--request-timeout-ms``) is stamped at parse, checked at
+  queue drain, and the remaining budget is echoed back like the request
+  id;
+- **brownout**: the controller sheds optional work in the documented
+  order (reqlog → quality → tracing → traffic), restores in reverse, and
+  max level flips ``/readyz`` to 503;
+- **abandoned requests**: a ``score(timeout=)`` caller that gives up
+  cancels its Future and the drain discards it without a batch slot
+  (the PR's leak-fix regression);
+- **bit-parity**: f32 scores and the zero-recompile contract hold with
+  admission control, deadlines, and the brownout controller enabled;
+- the five serving fault sites — ``serving.parse``, ``serving.execute``,
+  ``serving.reload``, ``serving.watch_tick``, ``io.save.reqlog`` — each
+  injected and survived (res-fault-coverage).
+"""
+
+import json
+import os
+import shutil
+import threading
+import time
+import urllib.error
+import urllib.request
+from concurrent.futures import TimeoutError as FutureTimeoutError
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.cli import serve_game as serve_game_cli
+from photon_ml_tpu.cli import train_game as train_game_cli
+from photon_ml_tpu.cli.config import parse_feature_shard_config
+from photon_ml_tpu.io.data_reader import write_training_examples
+from photon_ml_tpu.resilience import FaultPlan, InjectedFault, injected
+from photon_ml_tpu.serving import (
+    MicroBatcher,
+    ModelRegistry,
+    OverloadController,
+    RequestLog,
+    ServingService,
+    Shed,
+)
+from photon_ml_tpu.serving import overload
+from photon_ml_tpu.telemetry import metrics as _metrics
+
+SHARDS = "global=fixed|intercept,user=user|noIntercept"
+SHARD_CONFIGS = tuple(parse_feature_shard_config(s)
+                      for s in SHARDS.split(","))
+COORDS = [
+    "global=fixed,shard=global,reg=L2",
+    "perUser=random,entity=userId,shard=user,reg=L2",
+]
+D_FIXED, D_USER, N_USERS = 5, 3, 7
+
+
+def _records(n, seed, *, cold_users=0):
+    prng = np.random.default_rng(777)
+    w = prng.normal(size=D_FIXED)
+    u = 1.5 * prng.normal(size=(N_USERS, D_USER))
+    rng = np.random.default_rng(seed)
+    xf = rng.normal(size=(n, D_FIXED))
+    xu = rng.normal(size=(n, D_USER))
+    users = rng.integers(0, N_USERS, size=n)
+    margin = xf @ w + np.einsum("nd,nd->n", xu, u[users])
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-margin))).astype(float)
+    out = []
+    for i in range(n):
+        feats = [{"name": f"fixed.x{j}", "term": "", "value": float(xf[i, j])}
+                 for j in range(D_FIXED)]
+        feats += [{"name": f"user.z{j}", "term": "", "value": float(xu[i, j])}
+                  for j in range(D_USER)]
+        uid = (f"uCOLD{i}" if i >= n - cold_users else f"u{users[i]}")
+        out.append({
+            "uid": str(i), "response": float(y[i]), "offset": None,
+            "weight": None, "features": feats,
+            "metadataMap": {"userId": uid},
+        })
+    return out
+
+
+@pytest.fixture(scope="module")
+def trained(tmp_path_factory):
+    tmp = str(tmp_path_factory.mktemp("overload"))
+    train_path = os.path.join(tmp, "train.avro")
+    write_training_examples(train_path, _records(400, seed=0))
+    out = os.path.join(tmp, "run")
+    train_game_cli.run([
+        "--training-data", train_path,
+        "--output-dir", out,
+        "--feature-shards", SHARDS,
+        "--coordinates", *COORDS,
+        "--update-sequence", "global,perUser",
+        "--grid", "global=0.1", "perUser=1",
+        "--evaluators", "",
+    ])
+    return {"tmp": tmp, "model": out,
+            "requests": _records(40, seed=11, cold_users=3)}
+
+
+@pytest.fixture(autouse=True)
+def _full_service():
+    """Brownout state is process-global — never leak a degraded level
+    into the next test."""
+    overload.set_level(0)
+    yield
+    overload.set_level(0)
+
+
+def _stage_count(stage: str) -> int:
+    return _metrics.histogram(
+        "photon_serving_stage_seconds",
+        "Serving request time per request-path stage "
+        "(parse | queue_wait | batch_assemble | execute | respond)",
+        labels=("stage",)).labels(stage=stage).count
+
+
+def _post(url, payload, headers=None):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        return dict(resp.headers), json.loads(resp.read())
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=60) as resp:
+        return json.loads(resp.read())
+
+
+class _GatedScorer:
+    """Score fn that parks the worker until released, recording exactly
+    which records it was ever asked to score."""
+
+    def __init__(self):
+        self.started = threading.Event()
+        self.gate = threading.Event()
+        self.seen = []
+
+    def __call__(self, records):
+        self.started.set()
+        assert self.gate.wait(10)
+        self.seen.extend(r["i"] for r in records)
+        return np.zeros(len(records), np.float32)
+
+
+class TestAdmissionControl:
+    def test_queue_full_shed_is_typed_counted_and_never_scored(self):
+        fn = _GatedScorer()
+        b = MicroBatcher(fn, max_batch=4, max_wait_ms=0, max_queue=2)
+        try:
+            shed0 = overload.shed_counts()["queue_full"]
+            f0 = b.submit({"i": 0})
+            assert fn.started.wait(10)  # worker parked on record 0
+            f1 = b.submit({"i": 1})
+            f2 = b.submit({"i": 2})
+            assert b.queue_depth() == 2
+            with pytest.raises(Shed) as err:
+                b.submit({"i": 3})
+            assert err.value.reason == "queue_full"
+            assert err.value.retry_after_s > 0
+            assert overload.shed_counts()["queue_full"] == shed0 + 1
+            fn.gate.set()
+            assert [f0.result(10), f1.result(10), f2.result(10)] == \
+                [0.0, 0.0, 0.0]
+            # the shed record never reached the score fn
+            assert sorted(fn.seen) == [0, 1, 2]
+        finally:
+            fn.gate.set()
+            b.close()
+
+    def test_expired_deadline_shed_at_drain_never_scored(self):
+        fn = _GatedScorer()
+        b = MicroBatcher(fn, max_batch=4, max_wait_ms=0)
+        try:
+            shed0 = overload.shed_counts()["deadline"]
+            f0 = b.submit({"i": 0})
+            assert fn.started.wait(10)
+            # queued with a budget that expires while the worker is busy
+            f1 = b.submit({"i": 1}, deadline=time.monotonic() + 0.01)
+            f2 = b.submit({"i": 2}, deadline=time.monotonic() + 60.0)
+            time.sleep(0.05)
+            fn.gate.set()
+            assert f0.result(10) == 0.0
+            with pytest.raises(Shed) as err:
+                f1.result(10)
+            assert err.value.reason == "deadline"
+            assert f2.result(10) == 0.0
+            assert overload.shed_counts()["deadline"] == shed0 + 1
+            assert sorted(fn.seen) == [0, 2]  # the expired one never scored
+        finally:
+            fn.gate.set()
+            b.close()
+
+    def test_timed_out_caller_is_cancelled_at_drain(self):
+        """Satellite regression: a ``score(timeout=)`` that gives up used
+        to leave its Future enqueued, consuming a batch slot forever."""
+        fn = _GatedScorer()
+        b = MicroBatcher(fn, max_batch=1, max_wait_ms=0)
+        try:
+            f0 = b.submit({"i": 0})
+            assert fn.started.wait(10)
+            with pytest.raises(FutureTimeoutError):
+                b.score({"i": 1}, timeout=0.05)  # abandoned
+            f2 = b.submit({"i": 2})
+            fn.gate.set()
+            assert f0.result(10) == 0.0
+            assert f2.result(10) == 0.0
+            # the abandoned record was discarded at drain: never scored,
+            # never spent a max_batch=1 slot
+            assert sorted(fn.seen) == [0, 2]
+        finally:
+            fn.gate.set()
+            b.close()
+
+
+class TestDeadlineHttp:
+    @pytest.fixture(scope="class")
+    def server(self, trained):
+        server = serve_game_cli.build_server([
+            "--model-dir", trained["model"],
+            "--feature-shards", SHARDS,
+            "--port", "0", "--max-batch", "8", "--max-wait-ms", "1",
+            "--max-queue", "8", "--brownout-poll-s", "0",
+        ]).start()
+        yield server
+        server.stop()
+
+    def test_expired_deadline_is_shed_before_execute(self, trained, server):
+        """Acceptance gate: an expired X-Photon-Deadline-Ms request is
+        429, and the execute stage histogram proves the engine never ran
+        for it."""
+        executes0 = _stage_count("execute")
+        shed0 = overload.shed_counts()["deadline"]
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(server.url + "/score",
+                  {"record": trained["requests"][0]},
+                  headers={"X-Photon-Deadline-Ms": "0"})
+        assert err.value.code == 429
+        assert err.value.headers["Retry-After"]
+        body = json.loads(err.value.read())
+        assert body["reason"] == "deadline"
+        assert _stage_count("execute") == executes0  # never reached execute
+        assert overload.shed_counts()["deadline"] == shed0 + 1
+
+    def test_remaining_budget_echoed_like_the_request_id(self, trained,
+                                                         server):
+        headers, out = _post(server.url + "/score",
+                             {"record": trained["requests"][0]},
+                             headers={"X-Photon-Deadline-Ms": "30000"})
+        echoed = float(headers["X-Photon-Deadline-Ms"])
+        assert 0.0 < echoed <= 30000.0
+        assert 0.0 < out["deadline_ms"] <= 30000.0
+        # no deadline → no echo
+        headers2, out2 = _post(server.url + "/score",
+                               {"record": trained["requests"][0]})
+        assert "X-Photon-Deadline-Ms" not in headers2
+        assert "deadline_ms" not in out2
+
+    def test_unparsable_deadline_header_is_400(self, trained, server):
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(server.url + "/score",
+                  {"record": trained["requests"][0]},
+                  headers={"X-Photon-Deadline-Ms": "soon"})
+        assert err.value.code == 400
+
+    def test_server_default_timeout_applies_without_header(self, trained):
+        server = serve_game_cli.build_server([
+            "--model-dir", trained["model"],
+            "--feature-shards", SHARDS,
+            "--port", "0", "--max-batch", "8", "--no-warmup",
+            "--request-timeout-ms", "30000", "--brownout-poll-s", "0",
+        ]).start()
+        try:
+            _headers, out = _post(server.url + "/score",
+                                  {"record": trained["requests"][0]})
+            assert 0.0 < out["deadline_ms"] <= 30000.0
+        finally:
+            server.stop()
+
+    def test_readyz_reports_ready_with_overload_telemetry(self, server):
+        out = _get(server.url + "/readyz")
+        assert out["ready"] is True and out["reasons"] == []
+        assert out["version"] == 1
+        assert out["queue_depth"] == 0
+        assert set(out["shed"]) == {"queue_full", "deadline", "brownout"}
+        assert out["brownout_level"] == 0
+        # /healthz mirrors the same overload story
+        health = _get(server.url + "/healthz")
+        assert {"queue_depth", "shed", "brownout_level"} <= health.keys()
+
+    def test_max_brownout_sheds_traffic_and_fails_readyz(self, trained,
+                                                         server):
+        shed0 = overload.shed_counts()["brownout"]
+        overload.set_level(overload.MAX_LEVEL)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _post(server.url + "/score",
+                      {"record": trained["requests"][0]})
+            assert err.value.code == 429
+            assert json.loads(err.value.read())["reason"] == "brownout"
+            assert overload.shed_counts()["brownout"] == shed0 + 1
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _get(server.url + "/readyz")
+            assert err.value.code == 503
+            assert "brownout_max" in json.loads(err.value.read())["reasons"]
+        finally:
+            overload.set_level(0)
+        # recovery: full service again
+        _headers, out = _post(server.url + "/score",
+                              {"record": trained["requests"][0]})
+        assert len(out["scores"]) == 1
+        assert _get(server.url + "/readyz")["ready"] is True
+
+
+class TestBrownoutController:
+    def test_ladder_escalates_in_order_and_restores_in_reverse(self):
+        from photon_ml_tpu.events import EventBus
+
+        bus = EventBus()
+        events = []
+        bus.subscribe(lambda e: events.append(e.payload)
+                      if e.name == "brownout_changed" else None)
+        depth = {"v": 0}
+        fake = SimpleNamespace(queue_depth=lambda: depth["v"], max_queue=10)
+        ctrl = OverloadController(fake, poll_s=999.0, bus=bus)
+        assert overload.level() == 0
+        depth["v"] = 9  # 90% utilization: hot
+        shed_trail = []
+        for want in (1, 2, 3, 4):
+            assert ctrl.tick() == want
+            shed_trail.append([f for f in overload.FEATURES
+                               if overload.is_shed(f)])
+        # the documented order: reqlog first, then quality, then tracing
+        assert shed_trail == [["reqlog"], ["reqlog", "quality"],
+                              ["reqlog", "quality", "tracing"],
+                              ["reqlog", "quality", "tracing"]]
+        assert overload.traffic_shed()
+        assert ctrl.tick() == 4  # clamped at max
+        depth["v"] = 0  # cool: restore one level per tick, reverse order
+        assert [ctrl.tick() for _ in range(4)] == [3, 2, 1, 0]
+        assert not any(overload.is_shed(f) for f in overload.FEATURES)
+        assert not overload.traffic_shed()
+        directions = [("up" if e["level"] > e["previous"] else "down")
+                      for e in events]
+        assert directions == ["up"] * 4 + ["down"] * 4
+
+    def test_hysteresis_holds_level_between_watermarks(self):
+        depth = {"v": 9}
+        fake = SimpleNamespace(queue_depth=lambda: depth["v"], max_queue=10)
+        ctrl = OverloadController(fake, poll_s=999.0)
+        assert ctrl.tick() == 1
+        depth["v"] = 5  # between low (25%) and high (75%): hold
+        assert ctrl.tick() == 1
+        depth["v"] = 1
+        assert ctrl.tick() == 0
+
+    def test_queue_wait_p99_escalates_even_under_capacity(self):
+        fake = SimpleNamespace(queue_depth=lambda: 1, max_queue=1000)
+        ctrl = OverloadController(fake, poll_s=999.0, wait_p99_ms=50.0)
+        assert ctrl.tick() == 0  # no queue_wait observations: calm
+        hist = _metrics.histogram(
+            "photon_serving_stage_seconds",
+            "Serving request time per request-path stage "
+            "(parse | queue_wait | batch_assemble | execute | respond)",
+            labels=("stage",)).labels(stage="queue_wait")
+        for _ in range(100):
+            hist.observe(0.5)  # 500 ms queue waits this window
+        assert ctrl.tick() == 1
+        # next window is quiet again -> recovery
+        assert ctrl.tick() == 0
+
+    def test_brownout_suspends_reqlog_sampling(self, tmp_path):
+        log = RequestLog(str(tmp_path / "rl"), sample_rate=1.0)
+        try:
+            assert log.should_log("some-request")
+            overload.set_level(1)
+            assert not log.should_log("some-request")
+            overload.set_level(0)
+            assert log.should_log("some-request")
+        finally:
+            log.close()
+
+    def test_brownout_suspends_quality_accumulation(self, trained):
+        registry = ModelRegistry(SHARD_CONFIGS, max_batch=16)
+        sm = registry.load(trained["model"])
+        rows = _metrics.counter(
+            "photon_quality_scored_rows_total",
+            "Rows the online quality monitor accumulated")
+        before = rows.value
+        sm.engine.score(trained["requests"][:4])
+        assert rows.value == before + 4  # level 0: accumulating
+        overload.set_level(2)
+        sm.engine.score(trained["requests"][:4])
+        assert rows.value == before + 4  # level 2: quality shed
+        overload.set_level(1)
+        sm.engine.score(trained["requests"][:4])
+        assert rows.value == before + 8  # level 1 sheds only reqlog
+
+
+class TestReadyzService:
+    def test_no_active_model_is_not_ready(self):
+        service = ServingService(ModelRegistry(SHARD_CONFIGS))
+        status, body = service.readyz()
+        assert status == 503
+        assert "no_active_model" in body["reasons"]
+
+    def test_dead_batcher_worker_is_not_ready(self, trained):
+        class _Die(BaseException):
+            pass
+
+        def fn(records):
+            raise _Die("boom")
+
+        registry = ModelRegistry(SHARD_CONFIGS, max_batch=16)
+        registry.load(trained["model"])
+        b = MicroBatcher(fn, max_wait_ms=0)
+        fut = b.submit({"i": 0})
+        with pytest.raises(RuntimeError, match="worker died"):
+            fut.result(timeout=10)
+        service = ServingService(registry, batcher=b)
+        status, body = service.readyz()
+        assert status == 503
+        assert "batcher_worker_dead" in body["reasons"]
+
+
+class TestServingFaultSites:
+    """One injected fault per serving site, each surviving exactly as
+    RESILIENCE.md documents (the res-fault-coverage lint rule requires
+    every site exercised here)."""
+
+    def test_serving_parse_fault_fails_that_request_only(self, trained):
+        server = serve_game_cli.build_server([
+            "--model-dir", trained["model"],
+            "--feature-shards", SHARDS,
+            "--port", "0", "--max-batch", "8", "--no-warmup",
+            "--brownout-poll-s", "0",
+        ]).start()
+        try:
+            plan = FaultPlan.from_json(
+                {"specs": [{"site": "serving.parse", "at": [0]}]})
+            with injected(plan):
+                with pytest.raises(urllib.error.HTTPError) as err:
+                    _post(server.url + "/score",
+                          {"record": trained["requests"][0]})
+                assert err.value.code == 500
+                # the NEXT request parses and scores normally
+                _headers, out = _post(server.url + "/score",
+                                      {"record": trained["requests"][0]})
+                assert len(out["scores"]) == 1
+        finally:
+            server.stop()
+
+    def test_serving_execute_fault_fails_batch_not_engine(self, trained):
+        registry = ModelRegistry(SHARD_CONFIGS, max_batch=16)
+        registry.load(trained["model"])
+        service = ServingService(registry)
+        baseline = service.score(
+            {"records": trained["requests"][:3]})["scores"]
+        plan = FaultPlan.from_json(
+            {"specs": [{"site": "serving.execute", "at": [0]}]})
+        with injected(plan):
+            with pytest.raises(InjectedFault):
+                service.score({"records": trained["requests"][:3]})
+            # the engine survives; the next call scores bit-identically
+            again = service.score(
+                {"records": trained["requests"][:3]})["scores"]
+        assert again == baseline
+
+    def test_serving_reload_fault_keeps_incumbent_serving(self, trained):
+        registry = ModelRegistry(SHARD_CONFIGS, max_batch=16)
+        registry.load(trained["model"])
+        rejected = []
+        registry.bus.subscribe(
+            lambda e: rejected.append(e.payload)
+            if e.name == "model_reload_rejected" else None)
+        baseline = registry.active().score(trained["requests"][:4])
+        plan = FaultPlan.from_json(
+            {"specs": [{"site": "serving.reload", "at": [0]}]})
+        with injected(plan):
+            with pytest.raises(InjectedFault):
+                registry.reload(trained["model"])
+        assert registry.active_version == 1
+        assert len(rejected) == 1
+        assert np.array_equal(
+            registry.active().score(trained["requests"][:4]), baseline)
+
+    def test_serving_watch_tick_fault_retries_next_tick(self, trained,
+                                                        tmp_path):
+        from photon_ml_tpu.serving import ModelDirectoryWatcher
+
+        watch = str(tmp_path / "publish")
+        os.makedirs(watch)
+        shutil.copytree(trained["model"], os.path.join(watch, "m1"))
+        registry = ModelRegistry(SHARD_CONFIGS, max_batch=16)
+        watcher = ModelDirectoryWatcher(registry, watch, poll_s=999.0)
+        plan = FaultPlan.from_json(
+            {"specs": [{"site": "serving.watch_tick", "at": [0]}]})
+        with injected(plan):
+            with pytest.raises(InjectedFault):
+                watcher.scan_once()  # the faulted tick applies nothing
+            assert registry.active_or_none() is None
+            # the next tick picks the candidate up — nothing was lost
+            assert watcher.scan_once() == 1
+        assert registry.active_version == 1
+
+    def test_reqlog_segment_write_fault_counts_dropped(self, tmp_path):
+        log = RequestLog(str(tmp_path / "rl"), segment_records=2)
+        plan = FaultPlan.from_json(
+            {"specs": [{"site": "io.save.reqlog", "at": [0]}]})
+        with injected(plan):
+            for i in range(2):
+                assert log.log(request_id=f"r{i}", records=[{}],
+                               scores=[0.0], version=1)
+            log.flush()
+            # second segment survives the plan (at=[0] already fired)
+            for i in range(2, 4):
+                assert log.log(request_id=f"r{i}", records=[{}],
+                               scores=[0.0], version=1)
+            log.close()
+        stats = log.stats()
+        assert stats["dropped"] == 2  # the faulted segment is LOSS
+        assert stats["records"] == 2  # the later segment wrote fine
+        assert len(log.segment_paths()) == 1
+
+
+class TestParityWithOverloadProtectionOn:
+    def test_f32_bit_parity_and_zero_recompiles(self, trained):
+        """Acceptance gate: admission control, deadlines and a LIVE
+        brownout controller (at level 0) must not perturb the jitted
+        score path — same pattern as the PR 11 observability-on test."""
+        plain = ModelRegistry(SHARD_CONFIGS, max_batch=16)
+        base_scores = plain.load(trained["model"]).score(trained["requests"])
+
+        server = serve_game_cli.build_server([
+            "--model-dir", trained["model"],
+            "--feature-shards", SHARDS,
+            "--port", "0", "--max-batch", "16", "--max-wait-ms", "1",
+            "--max-queue", "64", "--request-timeout-ms", "30000",
+            "--brownout-poll-s", "0.2",
+        ]).start()
+        try:
+            service = server.service
+            assert service.overload is not None  # the controller is live
+            engine = service.registry.active().engine
+            frozen = engine.compile_count
+            out = service.score(
+                {"records": trained["requests"]},
+                deadline=service.resolve_deadline(None))
+            assert np.array_equal(
+                np.asarray(out["scores"], np.float32), base_scores)
+            # singles ride the bounded batcher queue with a deadline
+            for i in (0, 1, 5):
+                single = service.score(
+                    {"record": trained["requests"][i]},
+                    deadline=service.resolve_deadline(None))
+                assert np.float32(single["scores"][0]) == base_scores[i]
+            for size in (1, 3, 7, 16):
+                service.score({"records": trained["requests"][:size]})
+            assert engine.compile_count == frozen
+            assert overload.level() == 0  # unpressured: no degradation
+        finally:
+            server.stop()
+
+
+class TestBenchShedding:
+    def test_slo_verdict_distinguishes_slow_from_shedding(self):
+        import sys
+
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools"))
+        import bench_serving
+
+        slow = bench_serving.slo_gate_verdict(400.0, 100.0, shed_rate=0.0)
+        assert (slow["verdict"], slow["cause"]) == ("regression", "slow")
+        shedding = bench_serving.slo_gate_verdict(400.0, 100.0,
+                                                  shed_rate=0.3)
+        assert (shedding["verdict"], shedding["cause"]) == (
+            "regression", "shedding")
+        assert shedding["shed_rate"] == 0.3
+        ok = bench_serving.slo_gate_verdict(50.0, 100.0, shed_rate=0.0)
+        assert ok["verdict"] == "ok" and "cause" not in ok
+
+    def test_open_mode_sheds_under_tiny_max_queue(self, trained, capsys):
+        """Satellite regression: with --max-queue deliberately tiny the
+        open-loop bench reports 429s as shed_rate (excluded from the
+        percentiles), treats them as overload — not errors — and the
+        scraped photon_shed_total delta matches the client's count (the
+        in-process parity assert; a mismatch raises SystemExit)."""
+        import sys
+
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools"))
+        import bench_serving
+
+        bench_serving.main([
+            "--model-dir", trained["model"],
+            "--feature-shards", SHARDS,
+            "--mode", "open", "--target-qps", "500",
+            "--requests", "120", "--batch-sizes", "1",
+            "--max-queue", "1", "--max-wait-ms", "50",
+        ])
+        lines = [json.loads(line) for line in
+                 capsys.readouterr().out.strip().splitlines()]
+        by_metric = {ln["metric"]: ln for ln in lines}
+        open_line = by_metric["serving_open_loop_latency_ms"]
+        assert open_line["n_shed"] > 0
+        assert open_line["shed_rate"] > 0
+        assert open_line["n_errors"] == 0
+        # accounting identity: served + shed == offered (no errors)
+        assert open_line["n_requests"] + open_line["n_shed"] == 120
+        summary = by_metric["suite_summary"]
+        assert summary["shed_rate"] == open_line["shed_rate"]
+        assert summary["metrics_parity"] is True

@@ -57,6 +57,7 @@ import json
 import math
 import threading
 import time
+import urllib.error
 import urllib.request
 
 
@@ -75,6 +76,26 @@ def _http_json(url: str, payload=None, timeout=60.0):
             headers={"Content-Type": "application/json"})
     with urllib.request.urlopen(req, timeout=timeout) as resp:
         return json.loads(resp.read())
+
+
+def wait_ready(base: str, timeout_s: float = 60.0) -> dict:
+    """Block until the server reports READY on ``/readyz`` (503 = up but
+    not serving — warming, no model yet, or max brownout). Falls back to
+    one ``/healthz`` probe against pre-readyz builds (404)."""
+    deadline = time.perf_counter() + timeout_s
+    last = None
+    while time.perf_counter() < deadline:
+        try:
+            return _http_json(base + "/readyz", timeout=5.0)
+        except urllib.error.HTTPError as e:
+            if e.code == 404:  # pre-readyz server: liveness is the best gate
+                return _http_json(base + "/healthz", timeout=5.0)
+            last = f"HTTP {e.code}"
+        except Exception as e:
+            last = repr(e)
+        time.sleep(0.1)
+    raise SystemExit(f"server at {base} never became ready within "
+                     f"{timeout_s}s (last: {last})")
 
 
 def _scrape_metrics(base: str):
@@ -174,6 +195,7 @@ def open_loop_run(base: str, pool, sizes, *, target_qps: float,
     corrected: list[float] = []
     uncorrected: list[float] = []
     errors: list[str] = []
+    shed = {"n": 0}
     sent_rows = {"n": 0}
     start = time.perf_counter() + 0.05
 
@@ -195,6 +217,17 @@ def open_loop_run(base: str, pool, sizes, *, target_qps: float,
                 out = _http_json(base + "/score", {"records": recs},
                                  timeout=timeout)
                 assert len(out["scores"]) == size
+            except urllib.error.HTTPError as e:
+                if e.code == 429:
+                    # shed by admission control: that's the server
+                    # WORKING under overload, not failing — counted
+                    # separately, excluded from the latency population
+                    with lock:
+                        shed["n"] += 1
+                else:
+                    with lock:
+                        errors.append(repr(e))
+                continue
             except Exception as e:
                 with lock:
                     errors.append(repr(e))
@@ -212,17 +245,29 @@ def open_loop_run(base: str, pool, sizes, *, target_qps: float,
     for t in threads:
         t.join()
     wall = time.perf_counter() - t0
+    # the load-accounting identity every run must satisfy (and the chaos
+    # harness asserts): served + shed + errored == offered
+    assert len(corrected) + shed["n"] + len(errors) == requests
     return {"corrected_ms": corrected, "uncorrected_ms": uncorrected,
-            "errors": errors, "wall_s": wall, "rows": sent_rows["n"],
+            "errors": errors, "shed": shed["n"], "offered": requests,
+            "wall_s": wall, "rows": sent_rows["n"],
             "achieved_qps": len(corrected) / wall if wall > 0 else 0.0}
 
 
-def slo_gate_verdict(corrected_p99_ms: float, slo_p99_ms: float) -> dict:
+def slo_gate_verdict(corrected_p99_ms: float, slo_p99_ms: float,
+                     shed_rate: float = 0.0) -> dict:
     """The p99 SLO as a ``tools/bench_gate.py`` verdict: headroom =
     slo/p99 (a rate-shaped metric, higher is better) gated at threshold 0
     against a fixed baseline of 1.0 — headroom < 1 (p99 over SLO) is a
     ``regression``, headroom ≥ 1 is ``ok``. Reusing the gate keeps one
-    verdict vocabulary across the whole bench trajectory."""
+    verdict vocabulary across the whole bench trajectory.
+
+    ``shed_rate`` (shed responses / offered requests) distinguishes the
+    two overload failure shapes: a regression with sheds is the server
+    DEGRADING BY DESIGN (``cause="shedding"`` — raise capacity or the
+    queue bound), one without is plain tail latency (``cause="slow"`` —
+    optimize the path). Shed responses are excluded from the percentiles
+    the gate judges."""
     import bench_gate
 
     headroom = (slo_p99_ms / corrected_p99_ms
@@ -237,6 +282,9 @@ def slo_gate_verdict(corrected_p99_ms: float, slo_p99_ms: float) -> dict:
     verdict["slo_p99_ms"] = slo_p99_ms
     verdict["corrected_p99_ms"] = round(corrected_p99_ms, 3)
     verdict["headroom"] = round(headroom, 4)
+    verdict["shed_rate"] = round(shed_rate, 4)
+    if verdict.get("verdict") == "regression":
+        verdict["cause"] = "shedding" if shed_rate > 0 else "slow"
     return verdict
 
 
@@ -315,6 +363,11 @@ def main(argv=None):
     p.add_argument("--pool", type=int, default=256,
                    help="synthetic request pool size")
     p.add_argument("--max-wait-ms", type=float, default=2.0)
+    p.add_argument("--max-queue", type=int, default=None,
+                   help="admission-control queue bound passed through to "
+                        "the in-process server (serve_game --max-queue); "
+                        "saturating it turns overload into 429 sheds "
+                        "reported as shed_rate instead of latency")
     args = p.parse_args(argv)
 
     server = None
@@ -331,13 +384,19 @@ def main(argv=None):
         GLOBAL_BUS.subscribe(
             lambda e: server_events.append(e)
             if e.name == "serving_request" else None)
-        server = build_server([
+        argv_server = [
             "--model-dir", args.model_dir,
             "--feature-shards", args.feature_shards,
             "--port", "0", "--max-wait-ms", str(args.max_wait_ms),
-        ]).start()
+        ]
+        if args.max_queue is not None:
+            argv_server += ["--max-queue", str(args.max_queue)]
+        server = build_server(argv_server).start()
         base = server.url
 
+    # readiness, not liveness: warming buckets / loading tables answer
+    # /healthz long before they can serve — gate the load on /readyz
+    wait_ready(base)
     pool = _request_pool(args, server)
     cold_refs = None
     if server is not None:
@@ -375,13 +434,15 @@ def main(argv=None):
         errors = run["errors"]
         wall = run["wall_s"]
         rows = run["rows"]
+        shed_rate = run["shed"] / run["offered"] if run["offered"] else 0.0
         corrected_p99 = _percentile(run["corrected_ms"], 99)
         health = _http_json(base + "/healthz")
         metrics1 = _scrape_metrics(base)
         results.append({
             "metric": "serving_open_loop_latency_ms",
             "value": round(_percentile(run["corrected_ms"], 50), 3),
-            "unit": "ms p50 (open-loop, latency-corrected from schedule)",
+            "unit": "ms p50 (open-loop, latency-corrected from schedule; "
+                    "429 sheds excluded, reported as shed_rate)",
             "corrected_p50_ms": round(
                 _percentile(run["corrected_ms"], 50), 3),
             "corrected_p99_ms": round(corrected_p99, 3),
@@ -391,6 +452,8 @@ def main(argv=None):
             "achieved_qps": round(run["achieved_qps"], 1),
             "rows_per_sec": round(rows / wall, 1) if wall > 0 else 0.0,
             "n_requests": len(run["corrected_ms"]),
+            "n_shed": run["shed"],
+            "shed_rate": round(shed_rate, 4),
             "n_errors": len(errors),
             "concurrency": concurrency,
             "batch_sizes": sizes,
@@ -410,7 +473,8 @@ def main(argv=None):
         if args.slo_p99_ms is not None:
             slo_line = {"metric": "serving_slo_gate"}
             slo_line.update(slo_gate_verdict(corrected_p99,
-                                             args.slo_p99_ms))
+                                             args.slo_p99_ms,
+                                             shed_rate=shed_rate))
             results.append(slo_line)
     else:
         lock = threading.Lock()
@@ -553,6 +617,15 @@ def main(argv=None):
             # server's own books must match the client's exactly
             n_done = (len(latencies) if args.mode == "closed"
                       else len(run["corrected_ms"]))
+            if args.mode == "open":
+                # every client-observed 429 is exactly one server-side
+                # shed (and vice versa) — the admission-control books
+                shed_metric = int(sum(_labeled_delta(
+                    "photon_shed_total", "reason").values()))
+                if shed_metric != run["shed"]:
+                    parity_failures.append(
+                        f"photon_shed_total moved {shed_metric}, client "
+                        f"observed {run['shed']} 429 responses")
             if (args.mode == "closed" and cold_refs is not None
                     and quality_cold != cold_sent["n"]):
                 parity_failures.append(
@@ -583,6 +656,7 @@ def main(argv=None):
         "metrics_parity": not parity_failures if metrics1 is not None
         else None,
         "slo_verdict": slo_line.get("verdict") if slo_line else None,
+        "shed_rate": head.get("shed_rate"),
         "n_errors": len(errors),
         "wall_s": round(wall, 2),
     }), flush=True)
@@ -594,10 +668,14 @@ def main(argv=None):
         raise SystemExit("server-side /metrics disagree with the client's "
                          "measurements: " + "; ".join(parity_failures))
     if slo_line is not None and slo_line.get("verdict") == "regression":
+        cause = slo_line.get("cause", "slow")
         raise SystemExit(
             f"p99 SLO gate: corrected p99 "
             f"{slo_line['corrected_p99_ms']} ms > SLO "
-            f"{slo_line['slo_p99_ms']} ms (verdict: regression)")
+            f"{slo_line['slo_p99_ms']} ms (verdict: regression, cause: "
+            f"{cause}"
+            + (f", shed_rate {slo_line['shed_rate']}" if cause == "shedding"
+               else "") + ")")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,283 @@
+#!/usr/bin/env python
+"""Serving chaos harness: open-loop load against a live ``serve_game``
+under seeded fault plans — the serving twin of ``chaos_sweep.py``.
+
+``chaos_sweep.py`` proves training survives injected faults with model
+quality intact; this tool proves the REQUEST PATH survives them with its
+books intact. For every ``(seed, rate)`` cell it activates a randomized-
+but-seeded ``FaultPlan`` over the serving injection sites
+(``serving.execute`` fails scoring calls, ``serving.parse`` fails request
+parses) and drives open-loop load (``bench_serving.open_loop_run`` — the
+coordinated-omission-proof generator) against an in-process server,
+asserting:
+
+- **accounting identity**: every offered request is accounted for exactly
+  once — ``shed + served + errored == offered`` — and the client-observed
+  shed count matches the server's ``photon_shed_total`` delta;
+- **no stranded futures**: after the load drains, the microbatcher queue
+  is empty, its worker is alive, and a fresh request scores promptly
+  (``/readyz`` agrees);
+- **error-rate ceiling**: injected faults fail individual requests, they
+  never amplify past ``--error-ceiling`` of offered traffic (a batch
+  fault fails one microbatch, not the worker);
+- **incumbent-keeps-serving**: across an injected ``serving.reload``
+  fault the ``/reload`` returns 409 and the active version's scores stay
+  BIT-IDENTICAL before/after — delivery faults never corrupt serving.
+
+A failing cell reproduces exactly: the printed plan JSON IS the repro
+(``PHOTON_FAULT_PLAN='<plan>' python -m photon_ml_tpu serve_game ...``).
+
+Budgets::
+
+    --budget smoke   1 seed x 1 rate, small load   (the tier-1 invocation)
+    --budget full    the full --seeds x --rates grid (nightly)
+
+Exit code: 0 = every cell passed, 1 = failures (listed last).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+for _p in (REPO, os.path.join(REPO, "tools")):
+    if _p not in sys.path:
+        sys.path.insert(0, _p)
+
+import bench_serving  # noqa: E402
+import chaos_sweep  # noqa: E402
+
+
+def train_model(tmp: str, rows: int) -> tuple[str, str]:
+    """Tiny mixed-effect GAME model (the chaos_sweep dataset shape) →
+    (model output dir, training avro path — reused as the request pool)."""
+    from photon_ml_tpu.cli import train_game
+
+    train = os.path.join(tmp, "train.avro")
+    chaos_sweep.write_dataset(train, rows, seed=3)
+    out = os.path.join(tmp, "model")
+    train_game.run([
+        "--training-data", train,
+        "--output-dir", out,
+        "--feature-shards", chaos_sweep.SHARDS,
+        "--coordinates", *chaos_sweep.COORDS,
+        "--update-sequence", "global,perUser",
+        "--grid", "global=0.1", "perUser=1",
+        "--evaluators", "",
+    ])
+    return out, train
+
+
+def build_plan(seed: int, rate: float) -> dict:
+    """One seeded symmetric plan over the request-path sites. Parse
+    faults fire at a quarter of the execute rate (a parse fault fails one
+    request; an execute fault fails a whole microbatch)."""
+    return {"seed": seed, "specs": [
+        {"site": "serving.execute", "rate": rate},
+        {"site": "serving.parse", "rate": rate / 4},
+    ]}
+
+
+def scraped_shed_total(base: str) -> float:
+    """Sum of ``photon_shed_total`` across reasons, from ``/metrics``."""
+    snapshot = bench_serving._scrape_metrics(base)
+    return sum(v for _labels, v in
+               (snapshot or {}).get("photon_shed_total", []))
+
+
+def settle(server, base: str, timeout_s: float = 10.0) -> dict:
+    """Wait for the post-load queue to drain; returns the final /readyz
+    body. The in-process handles let the stranded-future check be exact:
+    queue depth straight from the batcher, worker liveness from its
+    death flag."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if server.service.batcher.queue_depth() == 0:
+            break
+        time.sleep(0.05)
+    return bench_serving._http_json(base + "/readyz")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="serving chaos harness: open-loop load under seeded "
+                    "fault plans, accounting + bit-parity asserts")
+    p.add_argument("--seeds", default="0,1",
+                   help="comma-separated plan seeds")
+    p.add_argument("--rates", default="0.02,0.05",
+                   help="comma-separated per-site fault rates")
+    p.add_argument("--budget", choices=["smoke", "full"], default="full",
+                   help="smoke = 1 seed x 1 rate, small load (tier-1)")
+    p.add_argument("--requests", type=int, default=300,
+                   help="offered requests per load cell")
+    p.add_argument("--target-qps", type=float, default=300.0)
+    p.add_argument("--error-ceiling", type=float, default=0.25,
+                   help="max tolerated errored/offered fraction per cell "
+                        "(injected execute faults fail whole microbatches, "
+                        "so the ceiling sits well above the raw rate)")
+    p.add_argument("--max-queue", type=int, default=64,
+                   help="admission bound of the harness server")
+    p.add_argument("--rows", type=int, default=400,
+                   help="training rows for the tiny model")
+    p.add_argument("--output", default=None,
+                   help="where to write chaos_serving.json (default: the "
+                        "harness temp dir, i.e. discarded)")
+    args = p.parse_args(argv)
+
+    seeds = [int(s) for s in args.seeds.split(",") if s]
+    rates = [float(r) for r in args.rates.split(",") if r]
+    requests = args.requests
+    if args.budget == "smoke":
+        seeds, rates, requests = seeds[:1], rates[:1], min(requests, 150)
+
+    from photon_ml_tpu.cli import serve_game
+    from photon_ml_tpu.resilience import FaultPlan, injected
+    from photon_ml_tpu.resilience.retry import (
+        get_default_policy,
+        set_default_policy,
+    )
+
+    cells: list[dict] = []
+    failures: list[str] = []
+    prev_policy = get_default_policy()
+    with tempfile.TemporaryDirectory() as tmp:
+        model_dir, train_path = train_model(tmp, args.rows)
+        set_default_policy(prev_policy)  # the training driver installs its own
+        server = serve_game.build_server([
+            "--model-dir", model_dir,
+            "--feature-shards", chaos_sweep.SHARDS,
+            "--port", "0",
+            "--microbatch", "8", "--max-wait-ms", "1",
+            "--max-queue", str(args.max_queue),
+            # brownout has its own tier-1 tests; a live controller would
+            # make a cell's shed counts depend on tick timing
+            "--brownout-poll-s", "0",
+        ]).start()
+        base = server.url
+        bench_serving.wait_ready(base)
+        from photon_ml_tpu.io.avro import iter_avro_file
+
+        pool = list(iter_avro_file(train_path))[:256]
+        probe = {"records": pool[:5]}
+        probe_scores = bench_serving._http_json(
+            base + "/score", probe)["scores"]
+        print(f"[chaos-serving] model up at {base}, probe scores pinned "
+              f"({len(probe_scores)} records)", flush=True)
+
+        try:
+            for seed in seeds:
+                for rate in rates:
+                    plan_obj = build_plan(seed, rate)
+                    cell = {"seed": seed, "rate": rate, "plan": plan_obj}
+                    shed0 = scraped_shed_total(base)
+                    with injected(FaultPlan.from_json(plan_obj)):
+                        run = bench_serving.open_loop_run(
+                            base, pool, [1], target_qps=args.target_qps,
+                            requests=requests)
+                    served = len(run["corrected_ms"])
+                    shed, errored = run["shed"], len(run["errors"])
+                    ready = settle(server, base)
+                    shed_delta = scraped_shed_total(base) - shed0
+                    probe_after = bench_serving._http_json(
+                        base + "/score", probe)["scores"]
+                    cell.update(
+                        offered=run["offered"], served=served, shed=shed,
+                        errored=errored, error_rate=errored / run["offered"],
+                        shed_metric_delta=shed_delta,
+                        queue_depth_after=ready["queue_depth"],
+                        ready_after=ready["ready"])
+                    problems = []
+                    if served + shed + errored != run["offered"]:
+                        problems.append(
+                            f"accounting broke: {served}+{shed}+{errored} "
+                            f"!= {run['offered']}")
+                    if shed_delta != shed:
+                        problems.append(
+                            f"photon_shed_total moved {shed_delta}, client "
+                            f"saw {shed} 429s")
+                    if errored > args.error_ceiling * run["offered"]:
+                        problems.append(
+                            f"error rate {errored / run['offered']:.3f} > "
+                            f"ceiling {args.error_ceiling}")
+                    if not ready["ready"] or ready["queue_depth"] != 0:
+                        problems.append(
+                            f"stranded work after drain: readyz={ready}")
+                    if server.service.batcher.dead is not None:
+                        problems.append(
+                            f"batcher worker died: "
+                            f"{server.service.batcher.dead!r}")
+                    if probe_after != probe_scores:
+                        problems.append(
+                            "probe scores changed under load faults")
+                    cell["ok"] = not problems
+                    cells.append(cell)
+                    print(f"[chaos-serving] seed={seed} rate={rate}: "
+                          f"offered={run['offered']} served={served} "
+                          f"shed={shed} errored={errored} "
+                          f"{'ok' if cell['ok'] else 'FAIL'}", flush=True)
+                    if problems:
+                        failures.append(
+                            f"seed={seed} rate={rate}: "
+                            + "; ".join(problems)
+                            + f" — repro with PHOTON_FAULT_PLAN="
+                              f"'{json.dumps(plan_obj)}'")
+
+            # --- incumbent-keeps-serving across an injected reload fault
+            reload_plan = {"seed": 0,
+                           "specs": [{"site": "serving.reload", "at": [0]}]}
+            cell = {"cell": "reload-fault", "plan": reload_plan}
+            version0 = bench_serving._http_json(base + "/healthz")["version"]
+            reload_status = None
+            with injected(FaultPlan.from_json(reload_plan)):
+                try:
+                    bench_serving._http_json(base + "/reload", {})
+                    reload_status = 200
+                except Exception as e:  # urllib HTTPError carries .code
+                    reload_status = getattr(e, "code", None)
+            probe_after = bench_serving._http_json(
+                base + "/score", probe)["scores"]
+            version1 = bench_serving._http_json(base + "/healthz")["version"]
+            problems = []
+            if reload_status != 409:
+                problems.append(f"faulted /reload returned "
+                                f"{reload_status}, want 409")
+            if version1 != version0:
+                problems.append(f"active version moved {version0} → "
+                                f"{version1} across a faulted reload")
+            if probe_after != probe_scores:
+                problems.append("incumbent scores NOT bit-identical "
+                                "across the faulted reload")
+            cell.update(reload_status=reload_status, version=version1,
+                        ok=not problems)
+            cells.append(cell)
+            print(f"[chaos-serving] reload-fault: status={reload_status} "
+                  f"version={version1} "
+                  f"{'ok' if cell['ok'] else 'FAIL'}", flush=True)
+            if problems:
+                failures.append("reload-fault: " + "; ".join(problems))
+        finally:
+            server.stop()
+            server.telemetry.close()
+            set_default_policy(prev_policy)
+
+        artifact = {"budget": args.budget,
+                    "error_ceiling": args.error_ceiling,
+                    "cells": cells, "failures": failures}
+        out_path = args.output or os.path.join(tmp, "chaos_serving.json")
+        with open(out_path, "w") as f:
+            json.dump(artifact, f, indent=2)
+
+    n_ok = sum(1 for c in cells if c["ok"])
+    print(f"[chaos-serving] {n_ok}/{len(cells)} cells passed")
+    for f_ in failures:
+        print(f"[chaos-serving] FAILED: {f_}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
