@@ -1387,6 +1387,87 @@ def bench_serving_slo():
           verdict["headroom"], **extras)
 
 
+RANKED_KS = (1, 10, 64)
+
+
+def bench_serving_ranked():
+    """Open-loop ranked-retrieval bench (the `/rank` workload, ISSUE 14):
+    train the serving model, serve it with `--rank-item-coordinate`, fire
+    a fixed-schedule GET /rank load cycling a k sweep, and report
+    latency-corrected percentiles + shed classification. The metric is
+    achieved ranked requests/s; ``vs_baseline`` is the p99 SLO headroom
+    (``PHOTON_RANK_SLO_P99_MS``, default 250 ms). This is the number
+    BENCH_r06 sizes the item-axis sharding claim against: the extras
+    carry the item count so rate-per-item is derivable round over
+    round."""
+    import argparse
+    import tempfile
+
+    from photon_ml_tpu.cli import serve_game as serve_game_cli
+    from photon_ml_tpu.cli import train_game as train_game_cli
+
+    bench_serving = _tools_module("bench_serving")
+    slo_ms = float(os.environ.get("PHOTON_RANK_SLO_P99_MS", 250.0))
+    train = _cached_fixture("serving", _write_e2e_file, SERVING_ROWS,
+                            SERVING_USERS, SERVING_SONGS)
+    shards = "global=g|intercept,item=it|noIntercept"
+    with tempfile.TemporaryDirectory() as tmp:
+        out = os.path.join(tmp, "model")
+        train_game_cli.run([
+            "--training-data", train,
+            "--output-dir", out,
+            "--feature-shards", shards,
+            "--coordinates",
+            "global=fixed,shard=global,reg=L2,maxIter=25",
+            ("perUser=random,entity=userId,shard=item,reg=L2,maxIter=25,"
+             "buckets=histogram,maxSampleBuckets=4"),
+            "--update-sequence", "global,perUser",
+            "--grid", "global=0.001", "perUser=1",
+            "--data-validation", "VALIDATE_DISABLED",
+            "--evaluators", "",
+        ])
+        _heartbeat()
+        server = serve_game_cli.build_server([
+            "--model-dir", out, "--feature-shards", shards,
+            "--port", "0", "--max-wait-ms", "1",
+            "--rank-item-coordinate", "perUser", "--rank-max-k", "64",
+        ]).start()
+        try:
+            pool = bench_serving._request_pool(
+                argparse.Namespace(data=None, pool=128), server)
+            users = bench_serving._rank_users(server, pool)
+            health0 = bench_serving._http_json(
+                server.url + "/healthz")
+            run = bench_serving.mixed_open_loop_run(
+                server.url, pool, users, [1],
+                target_qps=SERVING_TARGET_QPS, requests=SERVING_REQUESTS,
+                ks=RANKED_KS, rank_every=1)
+            health1 = bench_serving._http_json(server.url + "/healthz")
+        finally:
+            server.stop()
+        _heartbeat()
+    book = run["rank"]
+    corrected_p99 = bench_serving._percentile(book["corrected_ms"], 99)
+    verdict = bench_serving.slo_gate_verdict(
+        corrected_p99, slo_ms,
+        shed_rate=book["shed"] / max(book["offered"], 1))
+    achieved = (len(book["corrected_ms"]) / run["wall_s"]
+                if run["wall_s"] > 0 else 0.0)
+    _emit("serving_ranked_qps", achieved,
+          "ranked req/s (open loop GET /rank, latency-corrected "
+          "percentiles)", verdict["headroom"],
+          corrected_p50_ms=round(
+              bench_serving._percentile(book["corrected_ms"], 50), 3),
+          corrected_p99_ms=round(corrected_p99, 3),
+          target_qps=SERVING_TARGET_QPS,
+          ks=list(RANKED_KS),
+          rank_items=health1["rank"]["items"],
+          rank_compiles_during_load=(health1["rank"]["compiles"]
+                                     - health0["rank"]["compiles"]),
+          n_shed=book["shed"], n_errors=len(book["errors"]),
+          slo_p99_ms=slo_ms, slo_verdict=verdict["verdict"])
+
+
 REFRESH_ROWS = 200_000
 REFRESH_USERS = 4_000
 REFRESH_SONGS = 2_000
@@ -1461,7 +1542,7 @@ def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--only",
                    choices=["glm", "re", "re_sweep", "cd", "ingest", "e2e",
-                            "refresh", "serving"],
+                            "refresh", "serving", "ranked"],
                    help="run a single benchmark instead of the full suite")
     args = p.parse_args(argv)
     _setup_compile_cache()
@@ -1488,7 +1569,8 @@ def main(argv=None):
              "re_sweep": bench_re_sweep, "cd": bench_cd_sweep,
              "ingest": bench_ingest, "e2e": bench_end_to_end,
              "refresh": bench_refresh,
-             "serving": bench_serving_slo}[args.only]()
+             "serving": bench_serving_slo,
+             "ranked": bench_serving_ranked}[args.only]()
         finally:
             _emit_summary()
         return
@@ -1528,6 +1610,8 @@ def main(argv=None):
         bench_ingest()
         drain()
         bench_serving_slo()
+        drain()
+        bench_serving_ranked()
         drain()
         bench_re_sweep()
         drain()

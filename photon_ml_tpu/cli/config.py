@@ -500,6 +500,64 @@ def quality_from_args(args) -> QualityConfig:
                          drift_threshold=args.drift_threshold)
 
 
+# ---------------------------------------------------------------------------
+# Ranked-retrieval configuration (serve_game)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class RankConfig:
+    """serve_game's ``/rank`` knobs, round-trippable through a JSON config
+    file like :class:`ResilienceConfig`.
+
+    ``item_coordinate`` names the random-effect coordinate whose entity
+    axis ``/rank`` retrieves over (None = ranking disabled — ``/rank``
+    answers 400); ``max_k`` bounds the requestable k and sizes the
+    power-of-two k buckets the ranking engine pre-traces.
+    """
+
+    item_coordinate: Optional[str] = None
+    max_k: int = 128
+
+    def __post_init__(self):
+        if self.max_k < 1:
+            raise ValueError(f"max_k must be >= 1, got {self.max_k}")
+
+    # --- config-file round-trip ------------------------------------------
+    def as_dict(self) -> dict:
+        return {"itemCoordinate": self.item_coordinate,
+                "maxK": self.max_k}
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "RankConfig":
+        return cls(item_coordinate=d.get("itemCoordinate"),
+                   max_k=int(d.get("maxK", 128)))
+
+
+def add_rank_flags(parser) -> None:
+    """The serve_game ranked-retrieval flags (SERVING.md "Ranked
+    retrieval")."""
+    parser.add_argument(
+        "--rank-item-coordinate", default=None, metavar="COORD",
+        help="enable GET /rank?user=...&k=...: the random-effect "
+             "coordinate whose entity axis is the ITEM vocabulary — its "
+             "dense serving table is re-packed item-major (same "
+             "--table-dtype, dequantized in-trace) and each request "
+             "becomes one device matmul + top_k over every item. "
+             "Default: ranking disabled")
+    parser.add_argument(
+        "--rank-max-k", type=int, default=128,
+        help="largest requestable k (/rank k past it is a 400); also "
+             "sizes the power-of-two k buckets the ranking engine "
+             "pre-traces at warmup — the zero-recompile contract's "
+             "k half")
+
+
+def rank_from_args(args) -> RankConfig:
+    return RankConfig(item_coordinate=args.rank_item_coordinate,
+                      max_k=args.rank_max_k)
+
+
 def parse_grid(specs: Sequence[str]) -> list[Mapping[str, float]]:
     """``coordId=0.1;1;10`` groups → cartesian product of per-coordinate
     lambda lists (the reference's hyperparameter grid)."""

@@ -105,10 +105,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "rotate out past it")
     from photon_ml_tpu.cli.config import (
         add_quality_flags,
+        add_rank_flags,
         add_telemetry_flags,
     )
 
     add_quality_flags(p)
+    add_rank_flags(p)
     add_telemetry_flags(p)
     return p
 
@@ -145,21 +147,42 @@ def build_server(argv: Optional[Sequence[str]] = None):
         ServingService,
     )
 
-    from photon_ml_tpu.cli.config import quality_from_args
+    from photon_ml_tpu.cli.config import quality_from_args, rank_from_args
 
     quality = quality_from_args(args)
+    rank = rank_from_args(args)
     shard_configs = tuple(parse_feature_shard_config(s)
                           for s in args.feature_shards.split(","))
     registry = ModelRegistry(shard_configs, max_batch=args.max_batch,
                              warmup=not args.no_warmup,
                              table_dtype=args.table_dtype,
-                             canary=quality.canary())
+                             canary=quality.canary(),
+                             rank_coordinate=rank.item_coordinate,
+                             rank_max_k=rank.max_k)
     registry.load(args.model_dir)
     batcher = None
     if args.microbatch > 0:
         batcher = MicroBatcher(
             lambda records: registry.active().score(records),
             max_batch=args.microbatch, max_wait_ms=args.max_wait_ms,
+            max_queue=args.max_queue if args.max_queue > 0 else None)
+    rank_batcher = None
+    if rank.item_coordinate and args.microbatch > 0:
+        import numpy as np
+
+        def _rank_fn(entries):
+            # entries are opaque (record, k) tuples; results ride a 1-D
+            # object array so the batcher's shape contract still holds
+            results = registry.active().rank([r for r, _ in entries],
+                                             [k for _, k in entries])
+            out = np.empty(len(results), dtype=object)
+            for i, res in enumerate(results):
+                out[i] = res
+            return out
+
+        rank_batcher = MicroBatcher(
+            _rank_fn, coerce=lambda s: s,
+            max_batch=8, max_wait_ms=args.max_wait_ms,
             max_queue=args.max_queue if args.max_queue > 0 else None)
     overload = None
     if batcher is not None and args.brownout_poll_s > 0:
@@ -176,7 +199,8 @@ def build_server(argv: Optional[Sequence[str]] = None):
             segment_records=args.reqlog_segment_records,
             max_bytes=int(args.reqlog_max_mb * (1 << 20)))
     service = ServingService(registry, default_model_dir=args.model_dir,
-                             batcher=batcher, reqlog=reqlog,
+                             batcher=batcher, rank_batcher=rank_batcher,
+                             reqlog=reqlog,
                              default_timeout_ms=args.request_timeout_ms,
                              overload=overload)
     server = GameServer(service, host=args.host, port=args.port)
@@ -202,8 +226,11 @@ def build_server(argv: Optional[Sequence[str]] = None):
 def run(argv: Optional[Sequence[str]] = None) -> dict:
     server = build_server(argv)
     version = server.service.registry.active_version
+    rank_on = server.service.registry.rank_coordinate is not None
+    endpoints = ("/score" + (" /rank" if rank_on else "")
+                 + " /healthz /readyz /metrics /reload")
     print(f"serving GAME model version {version} on {server.url} "
-          f"(/score /healthz /readyz /metrics /reload)", flush=True)
+          f"({endpoints})", flush=True)
     try:
         server.serve_forever()
     except KeyboardInterrupt:

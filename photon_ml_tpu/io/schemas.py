@@ -97,6 +97,21 @@ REQUEST_LOG_SCORED_RECORD_AVRO = {
     ],
 }
 
+# Ranked requests log their returned top-k (ids best-first + the served
+# f32 scores widened to double) so ``tools/reqlog_replay.py`` can re-rank
+# the logged request against the named lineage and assert the ids AND
+# scores come back bit-identical.
+REQUEST_LOG_TOPK_AVRO = {
+    "type": "record",
+    "name": "RequestLogTopKAvro",
+    "namespace": NAMESPACE,
+    "fields": [
+        {"name": "k", "type": "long"},
+        {"name": "ids", "type": {"type": "array", "items": "string"}},
+        {"name": "scores", "type": {"type": "array", "items": "double"}},
+    ],
+}
+
 REQUEST_LOG_AVRO = {
     "type": "record",
     "name": "RequestLogAvro",
@@ -104,12 +119,18 @@ REQUEST_LOG_AVRO = {
     "fields": [
         {"name": "requestId", "type": "string"},
         {"name": "ts", "type": "double"},  # wall-clock timestamp (epoch s)
+        # which serving workload answered: "score" (records carry served
+        # scores) or "rank" (records carry the REQUEST record; the
+        # result lands in topk)
+        {"name": "kind", "type": "string", "default": "score"},
         {"name": "modelVersion", "type": "long"},
         {"name": "modelLineage", "type": ["null", "string"], "default": None},
         {"name": "stageMs", "type": {"type": "map", "values": "double"},
          "default": {}},
         {"name": "records",
          "type": {"type": "array", "items": REQUEST_LOG_SCORED_RECORD_AVRO}},
+        {"name": "topk", "type": ["null", REQUEST_LOG_TOPK_AVRO],
+         "default": None},
     ],
 }
 

@@ -37,7 +37,10 @@ from photon_ml_tpu.quality.baseline import (  # noqa: F401
     load_baseline,
     population_stability_index,
     quantile_edges,
+    rank_probe_records,
+    rank_probe_sample,
     save_baseline,
+    topk_overlap,
 )
 from photon_ml_tpu.quality.canary import (  # noqa: F401
     DEFAULT_BOUNDS,

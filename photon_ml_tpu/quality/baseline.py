@@ -39,6 +39,7 @@ import json
 import math
 import os
 import tempfile
+import zlib
 from typing import Mapping, Optional, Sequence
 
 import numpy as np
@@ -114,6 +115,44 @@ def ks_statistic(expected, actual) -> float:
 
 
 # ---------------------------------------------------------------------------
+# rank-drift reference + arithmetic (the ranked-serving half of rule 6)
+# ---------------------------------------------------------------------------
+
+
+def rank_probe_sample(user_ids: Sequence[str], n: int = 16) -> tuple:
+    """Deterministic probe-user sample for the rank-drift reference:
+    the ``n`` ids that sort first by ``crc32(id)`` — stable across
+    processes, loads and vocabulary dict order, and uniform-ish over the
+    id universe (the same fleet-joinable hashing discipline the request
+    log samples by)."""
+    ids = sorted({str(u) for u in user_ids},
+                 key=lambda u: (zlib.crc32(u.encode("utf-8")), u))
+    return tuple(ids[:max(int(n), 1)])
+
+
+def rank_probe_records(user_ids: Sequence[str],
+                       entity_types: Sequence[str]) -> list:
+    """The probe users' rank request records — featureless, id-only (the
+    intercept columns and the entity coefficient rows drive the
+    ranking), exactly what ``GET /rank?user=...`` synthesizes, so the
+    reference and the live surface rank the same inputs."""
+    return [{"features": [],
+             "metadataMap": {t: str(u) for t in entity_types},
+             "offset": None} for u in user_ids]
+
+
+def topk_overlap(reference: Sequence[str], live: Sequence[str]) -> float:
+    """``|reference ∩ live| / |reference|`` in [0, 1] — the rank-drift
+    statistic: 1.0 = the live top-k retrieves exactly the reference set
+    (order-insensitive; a reordering within the same k items is not
+    drift, a swapped-in item is). Empty reference compares as 1.0."""
+    ref = {str(i) for i in reference}
+    if not ref:
+        return 1.0
+    return len(ref & {str(i) for i in live}) / len(ref)
+
+
+# ---------------------------------------------------------------------------
 # the baseline artifact
 # ---------------------------------------------------------------------------
 
@@ -154,6 +193,13 @@ class QualityBaseline:
     calibration: Optional[Mapping] = None
     #: lineage passthrough (parentModel / trainedAt / dataManifest)
     lineage: Optional[Mapping] = None
+    #: rank-drift reference: probe user id → that user's top-k item ids
+    #: as the FULL model ranked them at load time (the serving registry
+    #: pins this; patches inherit it, so patched-table ranking shifts
+    #: surface as ``rank_overlap`` drift). None = no ranked workload.
+    rank_probes: Optional[Mapping] = None
+    #: the k the reference lists were computed at
+    rank_k: int = 0
 
     @property
     def n_bins(self) -> int:
@@ -177,6 +223,10 @@ class QualityBaseline:
                             else dict(self.calibration)),
             "lineage": (None if self.lineage is None
                         else dict(self.lineage)),
+            "rankProbes": (None if self.rank_probes is None else {
+                "k": self.rank_k,
+                "users": {str(u): list(ids)
+                          for u, ids in self.rank_probes.items()}}),
         }
 
     @classmethod
@@ -200,6 +250,10 @@ class QualityBaseline:
                       for s, v in (d.get("coverage") or {}).items()},
             calibration=d.get("calibration"),
             lineage=d.get("lineage"),
+            rank_probes=(None if d.get("rankProbes") is None else {
+                str(u): tuple(str(i) for i in ids)
+                for u, ids in (d["rankProbes"].get("users") or {}).items()}),
+            rank_k=int((d.get("rankProbes") or {}).get("k", 0)),
         )
 
 

@@ -217,6 +217,9 @@ class DriftEvaluator:
         if monitor is None:
             return {}
         scores = monitor.drift_scores(min_rows=self.min_rows)
+        rank_drift = self._rank_drift(sm, monitor.baseline)
+        if rank_drift is not None:
+            scores.update(rank_drift)
         for (coordinate, kind), value in scores.items():
             _DRIFT.labels(coordinate=coordinate, kind=kind).set(value)
         psi = scores.get((TOTAL_COORDINATE, "psi"))
@@ -228,9 +231,54 @@ class DriftEvaluator:
                 psi=round(psi, 6),
                 ks=round(scores.get((TOTAL_COORDINATE, "ks"), 0.0), 6),
                 threshold=self.threshold, rows=monitor.n_rows)
+        if rank_drift is not None:
+            # the ranked workload's alarm rides the SAME event path — one
+            # subscriber (and the bridge counter) covers both kinds
+            for (coordinate, kind), value in rank_drift.items():
+                if kind == "rank_overlap" and value > self.threshold:
+                    with self._lock:
+                        self.n_detections += 1
+                    self.registry.bus.post(
+                        "quality_drift_detected", version=sm.version,
+                        kind="rank_overlap", coordinate=coordinate,
+                        drift=round(value, 6), threshold=self.threshold)
         with self._lock:
             self.last = {f"{c}/{k}": v for (c, k), v in scores.items()}
         return scores
+
+    def _rank_drift(self, sm, baseline) -> "Optional[dict]":
+        """``{(item coordinate, "rank_overlap"): 1 - mean overlap}`` of
+        the probe users' LIVE top-k against the reference lists the full
+        model load pinned (quality/baseline.py) — None when the version
+        has no rank engine or no reference. Re-ranks the probes through
+        the active engine: a patched item table that reshuffles retrieval
+        shows up here even when the score distribution stays flat."""
+        rank_engine = getattr(sm, "rank_engine", None)
+        if rank_engine is None or baseline is None \
+                or not baseline.rank_probes or baseline.rank_k < 1:
+            return None
+        from photon_ml_tpu.quality.baseline import (
+            rank_probe_records,
+            topk_overlap,
+        )
+
+        users = list(baseline.rank_probes)
+        k = min(baseline.rank_k, rank_engine.max_k)
+        try:
+            results = rank_engine.rank(
+                rank_probe_records(users, rank_engine.user_entity_types),
+                [k] * len(users))
+        except Exception:
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "rank-drift probe ranking failed; skipping this pass")
+            return None
+        overlap = float(np.mean([
+            topk_overlap(baseline.rank_probes[u], ids)
+            for u, (ids, _) in zip(users, results)])) if users else 1.0
+        return {(rank_engine.index.coordinate_id, "rank_overlap"):
+                1.0 - overlap}
 
     # --- lifecycle --------------------------------------------------------
     def start(self) -> "DriftEvaluator":

@@ -1,0 +1,32 @@
+"""On-device top-k retrieval: the serving store turned into a recommender.
+
+The scoring stack answers the offline-shaped question "score these
+(user, item) pairs"; real recommendation traffic asks "best k items for
+this user". The dense ``(n_entities + 1, dim)`` device tables the serving
+store already pays for make that one device matmul plus ``jax.lax.top_k``
+(ROADMAP "On-device top-k retrieval"), and this package is that workload:
+
+- :mod:`~photon_ml_tpu.retrieval.index` — :class:`ItemIndex`: one
+  random-effect coordinate's store re-packed item-major — a padded
+  per-item coefficient matrix (any ``--table-dtype``, dequantized
+  in-trace through the store's one numeric home
+  :func:`~photon_ml_tpu.serving.store.gather_rows`), a precomputed
+  request-independent static-margin vector, optional sharding over the
+  mesh item axis, and O(touched) incremental rebuild on ``apply_patch``;
+- :mod:`~photon_ml_tpu.retrieval.engine` — :class:`RankingEngine`: one
+  jitted program scoring a user's margins against *every* item row, then
+  ``jax.lax.top_k`` — bucketed (power-of-two user batches × k buckets ×
+  the padded item axis) under the same zero-recompile contract as
+  ``/score``, with compile accounting under
+  ``photon_compiles_total{fn="serving.rank"}``.
+
+The HTTP surface (``GET /rank?user=...&k=...``), admission control,
+request logging and quality monitoring ride the existing serving stack —
+see SERVING.md "Ranked retrieval".
+"""
+
+from photon_ml_tpu.retrieval.index import ItemIndex, item_bucket  # noqa: F401
+from photon_ml_tpu.retrieval.engine import (  # noqa: F401
+    RANKING_FN_LABEL,
+    RankingEngine,
+)

@@ -1,0 +1,304 @@
+"""Jitted top-k ranking engine: user margins against every item, on device.
+
+One ranking call scores a user record against EVERY row of the
+:class:`~photon_ml_tpu.retrieval.index.ItemIndex` and returns the k best
+— one device program: per-coordinate margins exactly as the scoring
+engine computes them (the user-side coordinates broadcast over the item
+axis; the item coordinate is a dequantizing matmul against the padded
+item matrix), summed through the one score-summation home
+:func:`~photon_ml_tpu.game.model.sum_coordinate_margins`, padding masked
+to ``-inf``, then ``jax.lax.top_k``.
+
+**Parity contract** (SERVING.md "Ranked retrieval"): at f32 tables the
+returned ids and scores are bit-identical to brute-force scoring every
+(user record, item id) pair through the serving score path (itself
+bit-identical to ``GameModel.score`` / ``score_game``) and stable-sorting
+descending in item-axis order — ``lax.top_k`` breaks ties toward the
+lower item position, ``np.argsort(-scores, kind="stable")`` is the
+reference. Quantized tables hold the documented store tolerances
+(bf16 ≤ 1e-2, int8 ≤ 5e-2 relative).
+
+**Zero-recompile contract.** Trace signatures vary over exactly three
+bucketed axes: power-of-two user-batch buckets (≤ ``max_batch``),
+power-of-two k buckets (≤ ``max_k``), and the index's padded item axis.
+:meth:`warmup` pre-traces the whole grid; the live item count rides as a
+*traced* scalar, so an ``apply_patch`` that grows the vocabulary inside
+the padding changes no shape. Better still, patch-derived versions SHARE
+the parent's jit (``share_from`` — model parameters are jit arguments,
+so the executables are version-agnostic): activating a patch performs
+zero compiles, not merely zero steady-state ones. Traces count under
+``photon_compiles_total{fn="serving.rank"}`` (the scoring engine's
+``record_compile`` idiom — the serving bench and tier-1 assert on it).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+from photon_ml_tpu.game.model import FixedEffectModel, sum_coordinate_margins
+from photon_ml_tpu.resilience.faults import fault_point
+from photon_ml_tpu.retrieval.index import ItemIndex
+from photon_ml_tpu.serving import store as _store
+from photon_ml_tpu.serving.engine import ScoringEngine, next_bucket
+from photon_ml_tpu.telemetry import metrics as _metrics
+from photon_ml_tpu.telemetry import profiling as _profiling
+
+#: engine-side ranking latency per (user-bucket, k-bucket) dispatch
+#: (pad + jit dispatch + D2H of the top-k ids/scores)
+_RANK_LATENCY = _metrics.histogram(
+    "photon_rank_engine_latency_seconds",
+    "Engine ranking time per padded (user-bucket, k-bucket) dispatch",
+    labels=("bucket", "k_bucket"))
+
+#: the ranked path feeds the same request-path stage family as /score
+#: (this module owns batch_assemble and execute for /rank)
+_STAGE_SECONDS = _metrics.histogram(
+    "photon_serving_stage_seconds",
+    "Serving request time per request-path stage "
+    "(parse | queue_wait | batch_assemble | execute | respond)",
+    labels=("stage",))
+
+#: the fn label ranking traces count under — same
+#: ``photon_compiles_total{fn}`` family as training and ``serving.score``
+#: (telemetry/profiling.py), so one scrape expression covers every
+#: recompile contract in the system
+RANKING_FN_LABEL = "serving.rank"
+
+
+class RankingEngine:
+    """Ranks user records against one model version's full item axis.
+
+    Built next to (and from) the version's
+    :class:`~photon_ml_tpu.serving.engine.ScoringEngine`: request packing
+    and the device parameter pytree are the scoring engine's own, so the
+    ranked path can never skew from the scored one. Thread-safe;
+    hot-swapping installs a fresh engine per version, but patch-derived
+    versions pass ``share_from=`` to reuse the parent's executables
+    (parameters are jit arguments — the compiled programs are
+    version-agnostic)."""
+
+    def __init__(self, engine: ScoringEngine, index: ItemIndex, *,
+                 max_k: int = 128, max_batch: int = 8,
+                 share_from: Optional["RankingEngine"] = None):
+        import jax
+        import jax.numpy as jnp
+
+        self.engine = engine
+        self.model = engine.model
+        self.index = index
+        self.max_k = next_bucket(max_k)
+        self.max_batch = next_bucket(max_batch)
+        cm = self.model.coordinates.get(index.coordinate_id)
+        if cm is None or isinstance(cm, FixedEffectModel):
+            raise ValueError(
+                f"rank coordinate {index.coordinate_id!r} is not a "
+                f"random-effect coordinate of this model "
+                f"(have {sorted(self.model.coordinates)})")
+        if cm.random_effect_type != index.random_effect_type:
+            raise ValueError(
+                f"index entity type {index.random_effect_type!r} != "
+                f"coordinate's {cm.random_effect_type!r}")
+        self._coords = list(self.model.coordinates.items())
+        self._shard_order = [c.shard_id for c in engine.shard_configs]
+        self._re_order = [cid for cid, m in self._coords
+                          if not isinstance(m, FixedEffectModel)]
+        #: RE coordinates whose rows the trace consumes (the item
+        #: coordinate's row comes from the item axis, not the request)
+        self._rank_re_order = [cid for cid in self._re_order
+                               if cid != index.coordinate_id]
+        self._re_pick = [self._re_order.index(cid)
+                         for cid in self._rank_re_order]
+        #: entity types a bare ``/rank?user=`` id is applied to (every
+        #: non-item coordinate — the single-user-entity GLMix case; mixed
+        #: entity universes POST a full record instead)
+        self.user_entity_types = tuple(dict.fromkeys(
+            self.model.coordinates[cid].random_effect_type
+            for cid in self._rank_re_order))
+        # the scoring engine's device parameters, shared by reference —
+        # same tables, same (table, scales) pairs, same fe vectors — the
+        # ranked and scored paths cannot drift apart. The item
+        # coordinate's STORE is deliberately excluded: its rows reach the
+        # trace through the index matrix, and its dense table's leading
+        # dim grows when a patch appends entities — keeping it out of the
+        # argument pytree keeps patch activations signature-stable
+        self._params = {
+            "fe": engine._params["fe"],
+            "re": {cid: engine._params["re"][cid]
+                   for cid in self._rank_re_order},
+        }
+        self._lock = threading.Lock()
+        self._n_ranked = 0  # guarded-by: _lock
+        root = (share_from._root if share_from is not None
+                and self._trace_compatible(share_from) else None)
+        if root is not None:
+            # patch-derived version: the parent's executables fit this
+            # version exactly (parameters are arguments), so activation
+            # compiles NOTHING — compile accounting stays on the root
+            self._root = root
+            self._rank_jit = root._rank_jit
+            return
+        self._root = self
+        #: bumped from inside the traced body (trace time only — jit
+        #: serializes traces), so it is deliberately NOT lock-annotated
+        self._compile_count = 0
+        accum = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+        item_cid = index.coordinate_id
+
+        def _rank_padded(params, item_params, static, offsets, xs, rows,
+                         n_items, k):
+            # body runs at TRACE time only — one increment per compiled
+            # (user bucket, k bucket, item bucket) shape
+            self._compile_count += 1
+            _profiling.record_compile(RANKING_FN_LABEL)
+            i_x = {sid: i for i, sid in enumerate(self._shard_order)}
+            i_r = {cid: i for i, cid in enumerate(self._rank_re_order)}
+            item_rows = jnp.arange(item_params[0].shape[0])
+            margins = []
+            for cid, m_ in self._coords:
+                x = xs[i_x[m_.feature_shard_id]].astype(accum)
+                if isinstance(m_, FixedEffectModel):
+                    m = (x @ params["fe"][cid].astype(accum))[:, None]
+                elif cid == item_cid:
+                    # the retrieval matmul: every item's (possibly
+                    # quantized) row dequantizes through the one numeric
+                    # home and contracts against the user's features
+                    tab = _store.gather_rows(item_params, item_rows, accum)
+                    m = jnp.sum(x[:, None, :] * tab[None, :, :], axis=2)
+                else:
+                    tab = _store.gather_rows(params["re"][cid],
+                                             rows[i_r[cid]], accum)
+                    m = jnp.sum(x * tab, axis=1)[:, None]
+                margins.append(m.astype(jnp.float32))
+            # the one score-summation contract, broadcast over the item
+            # axis; the static vector rides as a trailing f64 term (all
+            # zeros without an item-feature source — then x + 0.0 leaves
+            # the brute-force pair scores bit-identical)
+            total = sum_coordinate_margins(
+                offsets[:, None], margins + [static[None, :]], xp=jnp)
+            masked = jnp.where(item_rows[None, :] < n_items, total,
+                               -jnp.inf)
+            return jax.lax.top_k(masked, k)
+
+        self._rank_jit = jax.jit(_rank_padded, static_argnums=(7,))
+
+    def _trace_compatible(self, other: "RankingEngine") -> bool:
+        """May this version reuse ``other``'s jit? True when every trace-
+        time CONSTANT matches — coordinate ids/kinds in order, shard
+        order, the item coordinate — i.e. for any patch of the same
+        model structure. Shapes need not match: a grown item bucket is
+        just a new signature in the shared cache."""
+        return (
+            [(cid, isinstance(m, FixedEffectModel))
+             for cid, m in self._coords]
+            == [(cid, isinstance(m, FixedEffectModel))
+                for cid, m in other._coords]
+            and self._shard_order == other._shard_order
+            and self.index.coordinate_id == other.index.coordinate_id
+            and self._rank_re_order == other._rank_re_order)
+
+    # --- stats ------------------------------------------------------------
+    @property
+    def compile_count(self) -> int:
+        """Distinct ranking traces of this engine's (possibly shared)
+        executable cache. Constant after :meth:`warmup`; a patch-derived
+        engine reports its root's counter — activation adds zero."""
+        return self._root._compile_count
+
+    @property
+    def n_ranked(self) -> int:
+        with self._lock:
+            return self._n_ranked
+
+    # --- ranking ----------------------------------------------------------
+    def rank(self, records: Sequence[dict], ks: Sequence[int]):
+        """Top-k per record: ``[(ids, scores), ...]`` with ``ids`` raw
+        item ids (best first) and ``scores`` their f32 totals. ``ks``
+        aligns with ``records`` (a coalesced batch may mix k's — the
+        program runs at the batch's max k bucket and each request slices
+        its own k)."""
+        # the same serving-side chaos site /score visits: an injected
+        # fault fails this rank batch only (its Futures get the error,
+        # the batcher worker survives, the incumbent keeps serving)
+        fault_point("serving.execute", n=len(records), kind="rank")
+        with _STAGE_SECONDS.labels(stage="batch_assemble").time():
+            batch = self.engine.pack(records)
+        return self.rank_batch(batch, ks)
+
+    def rank_batch(self, batch, ks: Sequence[int]):
+        ks = [int(k) for k in ks]
+        if len(ks) != batch.n:
+            raise ValueError(f"{len(ks)} k values for {batch.n} records")
+        for k in ks:
+            if not 1 <= k <= self.max_k:
+                raise ValueError(f"k must be in [1, {self.max_k}], got {k}")
+        out = []
+        with _STAGE_SECONDS.labels(stage="execute").time():
+            for lo in range(0, batch.n, self.max_batch):
+                hi = min(lo + self.max_batch, batch.n)
+                out.extend(self._rank_chunk(batch, ks[lo:hi], lo, hi))
+        with self._lock:
+            self._n_ranked += batch.n
+        return out
+
+    def _rank_chunk(self, batch, ks, lo, hi):
+        n = hi - lo
+        b = next_bucket(n)
+        index = self.index
+        k_b = min(next_bucket(max(ks)), self.max_k, index.bucket)
+        offsets = np.zeros(b, np.float32)
+        offsets[:n] = batch.offsets[lo:hi]
+        xs = []
+        for x in batch.xs:
+            xp = np.zeros((b, x.shape[1]), np.float32)
+            xp[:n] = x[lo:hi]
+            xs.append(xp)
+        rows = []
+        for cid, i in zip(self._rank_re_order, self._re_pick):
+            rp = np.full(b, self.engine.stores[cid].fallback_row, np.int32)
+            rp[:n] = batch.rows[i][lo:hi]
+            rows.append(rp)
+        n_items = np.asarray(index.n_items, np.int32)
+        # the D2H pulls belong inside the timed region: dispatch is async
+        with _RANK_LATENCY.labels(bucket=str(b), k_bucket=str(k_b)).time():
+            vals, idx = self._rank_jit(
+                self._params, index.device_params, index.static, offsets,
+                tuple(xs), tuple(rows), n_items, k_b)
+            vals = np.asarray(vals)
+            idx = np.asarray(idx)
+        out = []
+        for i in range(n):
+            # k may exceed the vocabulary; the padding beyond n_items is
+            # -inf-masked so the first n_items slots are always the real
+            # items in rank order
+            k_i = min(ks[i], index.n_items)
+            take = idx[i, :k_i]
+            out.append(([index.item_ids[j] for j in take],
+                        vals[i, :k_i].astype(np.float32)))
+        return out
+
+    def warmup(self) -> int:
+        """Pre-trace the whole (user bucket × k bucket) grid over the
+        current item axis so live traffic never waits on a compile.
+        Returns the number of compiles performed (0 for a patch-derived
+        engine whose shapes the shared cache has already seen)."""
+        from photon_ml_tpu.serving.engine import RequestBatch
+
+        before = self.compile_count
+        b = 1
+        while b <= self.max_batch:
+            empty = RequestBatch(
+                n=b, offsets=np.zeros(b, np.float32),
+                xs=tuple(np.zeros(
+                    (b, len(self.engine.index_maps[c.shard_id])),
+                    np.float32) for c in self.engine.shard_configs),
+                rows=tuple(np.full(b, self.engine.stores[cid].fallback_row,
+                                   np.int32) for cid in self._re_order))
+            k = 1
+            while k <= min(self.max_k, self.index.bucket):
+                self._rank_chunk(empty, [k] * b, 0, b)
+                k <<= 1
+            b <<= 1
+        return self.compile_count - before

@@ -1,0 +1,260 @@
+"""Item-major retrieval index over one random-effect coordinate's store.
+
+The serving :class:`~photon_ml_tpu.serving.store.EntityCoefficientStore`
+is request-major: a request names an entity, the engine gathers that one
+row. Ranking inverts the access pattern — one request touches EVERY
+item's row — so the index re-packs the store item-major once per model
+version:
+
+- ``matrix`` is a ``(bucket, dim)`` device array of per-item coefficient
+  rows in the store's storage dtype (float32 / bfloat16 / int8 with the
+  matching per-row ``scales`` vector). Rows stay in storage format; the
+  ranking trace dequantizes through the store's one numeric home
+  (:func:`~photon_ml_tpu.serving.store.gather_rows`), so a 10M-item int8
+  axis is held at a quarter of the f32 bytes and the full-precision
+  matrix never exists in HBM.
+- The item axis is padded to ``bucket`` (power of two, rounded up to the
+  mesh item-axis size when sharded) so ``apply_patch`` growth does not
+  change the ranking program's input shapes — the zero-recompile
+  contract's item-axis half. Padding rows alias the store's zero
+  fallback row and are masked to ``-inf`` before ``top_k``.
+- ``static`` is a per-item f32 margin vector of request-INDEPENDENT
+  score terms. The per-item intercept needs no entry here — the request
+  vector's intercept cell is 1, so it already rides the coefficient
+  matmul; the vector carries only terms a user record cannot produce
+  (the fixed effect on per-item feature records, an item-side offset),
+  and is all zeros when no item feature source is configured — exactly
+  the brute-force all-pairs contract ``/rank`` is parity-locked against.
+- :meth:`apply_patch` derives the NEXT version's index from a patched
+  store by re-gathering ONLY the touched item rows (new items append
+  inside the padding headroom) — O(touched), mirroring
+  ``EntityCoefficientStore.apply_patch``; overflowing the bucket falls
+  back to a full rebuild (one re-trace, at activation time, not in
+  steady state).
+
+Item order is load-bearing: ``item_ids`` fixes the axis enumeration and
+therefore the tie-break order of ``top_k`` (lower item position first),
+which the brute-force parity contract pins (SERVING.md "Ranked
+retrieval").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping, Optional, Sequence
+
+import numpy as np
+
+from photon_ml_tpu.serving.store import EntityCoefficientStore
+
+
+def item_bucket(n: int, multiple: int = 1) -> int:
+    """Padded item-axis length: smallest power of two >= max(n, 1),
+    rounded up to ``multiple`` (the mesh item-axis size when sharded) so
+    every shard holds an equal slice."""
+    b = 1 << max(int(n) - 1, 0).bit_length()
+    if multiple > 1:
+        b += (-b) % int(multiple)
+    return b
+
+
+@dataclasses.dataclass(frozen=True)
+class ItemIndex:
+    """Immutable per-version retrieval index (one per rank coordinate).
+
+    ``matrix``/``scales`` mirror the store's storage format
+    (``device_params`` feeds :func:`serving.store.gather_rows` exactly
+    like a store's table does); ``static`` is the f32 request-independent
+    margin vector; ``item_ids[i]`` is the raw id at item-axis position
+    ``i`` and ``pos_of`` its inverse.
+    """
+
+    coordinate_id: str
+    random_effect_type: str
+    dim: int
+    table_dtype: str
+    item_ids: tuple
+    bucket: int
+    matrix: object  # jax.Array (bucket, dim) in table_dtype
+    scales: object  # jax.Array (bucket,) f32 — int8 only, else None
+    static: object  # jax.Array (bucket,) f32
+    static_host: np.ndarray = dataclasses.field(repr=False, compare=False,
+                                                default=None)
+    pos_of: Mapping[str, int] = dataclasses.field(repr=False, compare=False,
+                                                  default_factory=dict)
+    #: NamedSharding over the mesh item axis, None when unsharded
+    sharding: object = dataclasses.field(repr=False, compare=False,
+                                         default=None)
+
+    @property
+    def n_items(self) -> int:
+        return len(self.item_ids)
+
+    @property
+    def device_params(self):
+        """``(matrix, scales)`` — consumed through ``store.gather_rows``
+        with ``rows = arange(bucket)``, the same dequantize-in-trace path
+        the scoring engine uses."""
+        return (self.matrix, self.scales)
+
+    @property
+    def matrix_bytes(self) -> int:
+        """Resident device bytes of the item matrix (+ scales + static) —
+        the ranked twin of ``EntityCoefficientStore.table_bytes``."""
+        n = int(np.prod(self.matrix.shape)) * self.matrix.dtype.itemsize
+        if self.scales is not None:
+            n += int(self.scales.shape[0]) * 4
+        return n + self.bucket * 4  # static vector
+
+    # --- construction -----------------------------------------------------
+    @staticmethod
+    def build(store: EntityCoefficientStore, coordinate_id: str, *,
+              static_margins: Optional[Mapping[str, float]] = None,
+              mesh=None, bucket: Optional[int] = None) -> "ItemIndex":
+        """Pack ``store`` item-major. ``static_margins`` maps raw item id
+        to its precomputed request-independent margin (absent ids take
+        0.0 — the no-item-features default); ``mesh`` shards the item
+        axis over :data:`parallel.mesh.ENTITY_AXIS` for vocabularies one
+        device cannot hold."""
+        import jax.numpy as jnp
+
+        item_ids = tuple(store.row_of_id)
+        n = len(item_ids)
+        sharding = None
+        multiple = 1
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            from photon_ml_tpu.parallel.mesh import ENTITY_AXIS
+
+            axis = (ENTITY_AXIS if ENTITY_AXIS in mesh.shape
+                    else next(iter(mesh.shape)))
+            multiple = int(mesh.shape[axis])
+            sharding = NamedSharding(mesh, PartitionSpec(axis))
+        b = item_bucket(n, multiple) if bucket is None else int(bucket)
+        if b < max(n, 1):
+            raise ValueError(f"bucket {b} < {n} items")
+        rows = np.full(b, store.fallback_row, np.int32)
+        if n:
+            rows[:n] = store.rows_for(list(item_ids))
+        # one device gather in STORAGE dtype — no cast, no scale math
+        # (that happens in-trace through store.gather_rows); padding rows
+        # alias the store's zero fallback row
+        rows_d = jnp.asarray(rows)
+        matrix = store.table[rows_d]
+        scales = None if store.scales is None else store.scales[rows_d]
+        static_host = np.zeros(b, np.float32)
+        pos_of = {raw: i for i, raw in enumerate(item_ids)}
+        for raw, v in (static_margins or {}).items():
+            i = pos_of.get(raw)
+            if i is not None:
+                static_host[i] = np.float32(v)
+        static = jnp.asarray(static_host)
+        if sharding is not None:
+            import jax
+
+            matrix = jax.device_put(matrix, sharding)
+            if scales is not None:
+                scales = jax.device_put(scales, sharding)
+            static = jax.device_put(static, sharding)
+        return ItemIndex(
+            coordinate_id=coordinate_id,
+            random_effect_type=store.random_effect_type, dim=store.dim,
+            table_dtype=store.table_dtype, item_ids=item_ids, bucket=b,
+            matrix=matrix, scales=scales, static=static,
+            static_host=static_host, pos_of=pos_of, sharding=sharding)
+
+    def apply_patch(self, store: EntityCoefficientStore,
+                    touched: Sequence[str], *,
+                    static_margins: Optional[Mapping[str, float]] = None,
+                    ) -> "ItemIndex":
+        """Derive the next version's index from the PATCHED store by
+        re-gathering only the ``touched`` raw ids' rows (updated, removed
+        — their store rows are already zeroed — and new items, which
+        append inside the padding headroom). O(touched) like the store's
+        own ``apply_patch``; functional — this index's device arrays are
+        never mutated. Overflowing the bucket rebuilds from scratch (the
+        item axis shape changes, so the next ranking call re-traces once
+        at activation time)."""
+        if store.random_effect_type != self.random_effect_type:
+            raise ValueError(
+                f"patch store random-effect type "
+                f"{store.random_effect_type!r} != index "
+                f"{self.random_effect_type!r}")
+        if store.dim != self.dim or store.table_dtype != self.table_dtype:
+            raise ValueError(
+                f"patch store (dim={store.dim}, dtype="
+                f"{store.table_dtype!r}) does not match index (dim="
+                f"{self.dim}, dtype={self.table_dtype!r})")
+        touched = list(dict.fromkeys(str(t) for t in touched))
+        if not touched:
+            return self
+        new = [raw for raw in touched if raw not in self.pos_of]
+        if self.n_items + len(new) > self.bucket:
+            carried = dict(zip(self.item_ids,
+                               self.static_host[:self.n_items].tolist()))
+            carried.update(static_margins or {})
+            mesh = None if self.sharding is None else self.sharding.mesh
+            return ItemIndex.build(store, self.coordinate_id,
+                                   static_margins=carried, mesh=mesh)
+        import jax.numpy as jnp
+
+        item_ids = self.item_ids + tuple(new)
+        pos_of = dict(self.pos_of)
+        for raw in new:
+            pos_of[raw] = len(pos_of)
+        pos = np.fromiter((pos_of[raw] for raw in touched), np.int32,
+                          count=len(touched))
+        rows = store.rows_for(touched)
+        rows_d = jnp.asarray(rows)
+        pos_d = jnp.asarray(pos)
+        matrix = self.matrix.at[pos_d].set(store.table[rows_d])
+        scales = self.scales
+        if store.scales is not None:
+            if scales is None:
+                raise ValueError("patch store carries scales but the "
+                                 "index has none (dtype drift)")
+            scales = scales.at[pos_d].set(store.scales[rows_d])
+        # touched items keep their prior static margin unless the caller
+        # supplies a fresh one (new items start at the padding's 0.0; a
+        # removed item's margin is zeroed by passing {raw: 0.0})
+        static_host = self.static_host.copy()
+        for raw, v in (static_margins or {}).items():
+            i = pos_of.get(raw)
+            if i is not None:
+                static_host[i] = np.float32(v)
+        static = jnp.asarray(static_host)
+        if self.sharding is not None:
+            import jax
+
+            static = jax.device_put(static, self.sharding)
+        return dataclasses.replace(
+            self, item_ids=item_ids, matrix=matrix, scales=scales,
+            static=static, static_host=static_host, pos_of=pos_of)
+
+    # --- static margins ---------------------------------------------------
+    @staticmethod
+    def static_margins_from_records(engine, records_by_id: Mapping[str, dict],
+                                    ) -> dict:
+        """Precompute each item's request-independent margin from a
+        per-item feature record: the FIXED-effect contribution on the
+        item's own features plus the record's offset — the GLMix terms a
+        user-side request vector cannot produce. Host numpy over the
+        engine's own packing (no online/batch skew); returns
+        ``{raw item id: float}`` for :meth:`build`."""
+        from photon_ml_tpu.game.model import FixedEffectModel
+
+        if not records_by_id:
+            return {}
+        raws = list(records_by_id)
+        batch = engine.pack([records_by_id[r] for r in raws])
+        shard_x = {cfg.shard_id: x
+                   for cfg, x in zip(engine.shard_configs, batch.xs)}
+        total = np.asarray(batch.offsets, np.float64)
+        for cid, cm in engine.model.coordinates.items():
+            if not isinstance(cm, FixedEffectModel):
+                continue
+            w = np.asarray(cm.model.coefficients.means, np.float64)
+            m = shard_x[cm.feature_shard_id].astype(np.float64) @ w
+            total = total + m.astype(np.float32).astype(np.float64)
+        return {raw: float(np.float32(t)) for raw, t in zip(raws, total)}

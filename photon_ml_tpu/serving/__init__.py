@@ -32,6 +32,12 @@ built here as four layers (see SERVING.md for the architecture doc):
   (``X-Photon-Deadline-Ms``), and the brownout controller that sheds
   optional work (reqlog → quality → tracing) before traffic
   (SERVING.md "Serving under overload").
+
+The ranked-retrieval workload (``GET /rank?user=...&k=...`` — one device
+matmul + ``top_k`` over the full item axis, under the same admission
+control, logging and zero-recompile contracts) lives in the sibling
+:mod:`photon_ml_tpu.retrieval` package and plugs in through the registry
+(``ModelRegistry(rank_coordinate=...)``; SERVING.md "Ranked retrieval").
 """
 
 from photon_ml_tpu.serving.overload import (  # noqa: F401

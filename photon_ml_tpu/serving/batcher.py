@@ -93,18 +93,25 @@ class MicroBatcher:
     ``score_fn(records) -> np.ndarray`` scores one homogeneous batch (the
     registry's active version). Thread-safe; :meth:`submit` never blocks
     beyond the queue lock. ``max_queue=None`` leaves the queue unbounded
-    (embedder's choice — ``serve_game`` always bounds it).
+    (embedder's choice — ``serve_game`` always bounds it). ``coerce``
+    maps each per-record result onto its Future (default ``float`` — the
+    historical scalar-score contract); the ranked path passes records as
+    opaque ``(record, k)`` tuples with a ``score_fn`` returning a
+    1-D object array of ``(ids, scores)`` results and an identity
+    ``coerce``.
     """
 
     def __init__(self, score_fn: Callable[[Sequence[dict]], np.ndarray], *,
                  max_batch: int = 64, max_wait_ms: float = 2.0,
-                 max_queue: Optional[int] = None):
+                 max_queue: Optional[int] = None,
+                 coerce: Callable = float):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if max_queue is not None and max_queue < 1:
             raise ValueError(f"max_queue must be >= 1 (or None for "
                              f"unbounded), got {max_queue}")
         self._score_fn = score_fn
+        self._coerce = coerce
         self.max_batch = max_batch
         self.max_wait_s = max_wait_ms / 1000.0
         self.max_queue = max_queue
@@ -246,7 +253,7 @@ class MicroBatcher:
                 _resolve(fut, exception=exception)
         else:
             for (_, fut, _, _), s in zip(batch, scores):
-                _resolve(fut, result=float(s))
+                _resolve(fut, result=self._coerce(s))
         with self._cond:
             self._inflight = []
 

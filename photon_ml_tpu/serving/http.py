@@ -13,10 +13,17 @@ and none needed — handlers are thin marshaling around the registry/batcher):
   ``Retry-After`` header and a ``reason`` body field (never a hang; see
   SERVING.md "Serving under overload"). A deadline's remaining budget is
   echoed back (header + ``deadline_ms``) like the request id.
+- ``GET /rank?user=...&k=...`` (also ``POST /rank`` with a full
+  ``record``) — top-k retrieval over the configured item coordinate
+  (``serve_game --rank-item-coordinate``; SERVING.md "Ranked
+  retrieval"): ``{"ids": [...], "scores": [...], "k", "version",
+  "latency_ms", "request_id"}``. Same admission control, deadline and
+  brownout semantics as ``/score``; ranked requests land in the request
+  log as ``kind="rank"`` with their returned top-k.
 - ``GET /healthz`` — liveness + the serving counters the bench asserts on
-  (active version, engine compile count, requests/scores served, canary
-  reservoir size, request-log budget, queue depth / shed tallies /
-  brownout level).
+  (active version, engine compile count, requests/scores served, ranked
+  request/item counters when ranking is on, canary reservoir size,
+  request-log budget, queue depth / shed tallies / brownout level).
 - ``GET /readyz`` — readiness: 503 (with reasons) while there is no
   active model, the batcher worker is dead, or brownout is at max level;
   what load balancers and ``bench_serving`` gate on.
@@ -58,6 +65,7 @@ import threading
 import time
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlsplit
 from typing import Mapping, Optional
 
 from photon_ml_tpu.resilience.faults import fault_point
@@ -82,6 +90,19 @@ _STAGE_SECONDS = _metrics.histogram(
     "Serving request time per request-path stage "
     "(parse | queue_wait | batch_assemble | execute | respond)",
     labels=("stage",))
+
+#: end-to-end /rank handling time — the ranked twin of the /score
+#: histogram (shed requests are discarded from it, same as /score)
+_RANK_REQUEST_LATENCY = _metrics.histogram(
+    "photon_rank_request_latency_seconds",
+    "End-to-end /rank request handling time")
+
+#: requested-k distribution of admitted /rank requests (power-of-two
+#: buckets — the same buckets the ranking engine's executables pad to)
+_RANK_K = _metrics.histogram(
+    "photon_rank_k",
+    "Requested k per admitted /rank request",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024))
 
 #: the inbound/outbound request-id header
 REQUEST_ID_HEADER = "X-Photon-Request-Id"
@@ -124,12 +145,17 @@ class ServingService:
     def __init__(self, registry: ModelRegistry, *,
                  default_model_dir: Optional[str] = None,
                  batcher: Optional[MicroBatcher] = None,
+                 rank_batcher: Optional[MicroBatcher] = None,
                  reqlog: Optional[RequestLog] = None,
                  default_timeout_ms: float = 0.0,
                  overload=None):
         self.registry = registry
         self.default_model_dir = default_model_dir
         self.batcher = batcher
+        #: the /rank coalescing queue (identity-coerced MicroBatcher over
+        #: (record, k) tuples) — same bounded-queue admission control and
+        #: deadline-at-drain shedding as the /score batcher
+        self.rank_batcher = rank_batcher
         self.reqlog = reqlog
         #: server-side deadline applied to requests that carry no
         #: X-Photon-Deadline-Ms of their own (0 = none)
@@ -140,6 +166,7 @@ class ServingService:
         self._lock = threading.Lock()
         self.n_requests = 0  # guarded-by: _lock
         self.n_scored = 0  # guarded-by: _lock
+        self.n_ranked = 0  # guarded-by: _lock
         # monotonic: uptime is a DURATION (immune to wall-clock jumps, and
         # telemetry hygiene rule 5 bans wall-clock arithmetic for durations)
         self._started_monotonic = time.monotonic()
@@ -250,6 +277,93 @@ class ServingService:
             out["deadline_ms"] = round(self.remaining_ms(deadline), 1)
         return out
 
+    def rank(self, payload: dict,
+             request_id: Optional[str] = None,
+             stage_ms: Optional[Mapping[str, float]] = None,
+             deadline: Optional[float] = None) -> dict:
+        """Rank one user against the active version's item axis
+        (SERVING.md "Ranked retrieval"). ``payload`` carries ``k`` plus
+        either ``user`` (a raw entity id — ranked featureless, applied to
+        every non-item coordinate's entity type) or a full ``record``.
+        Same admission contract as :meth:`score`: an expired deadline, a
+        full rank queue, or max brownout raises
+        :class:`~photon_ml_tpu.serving.overload.Shed` (→ 429) without the
+        request ever reaching the engine's execute stage, and sheds are
+        excluded from the latency histogram."""
+        if request_id is None:
+            request_id = new_request_id()
+        active = self.registry.active()
+        engine = active.rank_engine
+        if engine is None:
+            raise ValueError("ranking is not enabled (start serve_game "
+                             "with --rank-item-coordinate)")
+        try:
+            # absent k defaults to 10, clamped by the engine bound so a
+            # bare GET /rank?user=... works on any configuration
+            k = int(payload.get("k", min(10, engine.max_k)))
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"bad k {payload.get('k')!r} (want an integer)") from None
+        if not 1 <= k <= engine.max_k:
+            raise ValueError(f"k must be in [1, {engine.max_k}], got {k}")
+        record = payload.get("record")
+        if record is None:
+            user = payload.get("user")
+            if not user:
+                raise ValueError("payload needs 'user' (raw entity id) "
+                                 "or 'record' ({features, metadataMap})")
+            record = {"features": [],
+                      "metadataMap": {t: str(user)
+                                      for t in engine.user_entity_types},
+                      "offset": None}
+        if deadline is not None and time.monotonic() >= deadline:
+            raise _overload.shed(
+                "deadline", message="deadline expired before ranking")
+        if _overload.traffic_shed():
+            raise _overload.shed(
+                "brownout",
+                message=f"brownout level {_overload.level()} is shedding "
+                        f"traffic",
+                retry_after_s=2.0)
+        with _RANK_REQUEST_LATENCY.time() as timer, \
+                _maybe_span("serving.rank", request_id=request_id,
+                            k=k) as sp:
+            version = self.registry.active_version
+            try:
+                if self.rank_batcher is not None:
+                    ids, scores = self.rank_batcher.score((record, k),
+                                                          deadline=deadline)
+                else:
+                    ((ids, scores),) = active.rank([record], [k])
+            except _overload.Shed:
+                timer.discard()
+                raise
+            sp.set(version=version, n=len(ids))
+        _RANK_K.observe(k)
+        latency_ms = timer.seconds * 1e3
+        with self._lock:
+            self.n_requests += 1
+            self.n_ranked += 1
+        if self.reqlog is not None:
+            timings = dict(stage_ms or {})
+            timings["rank"] = latency_ms
+            self.reqlog.log(
+                request_id=request_id, records=[record], scores=[0.0],
+                version=version, lineage=self._active_lineage(),
+                stage_ms=timings, kind="rank",
+                topk={"k": k, "ids": list(ids),
+                      "scores": [float(s) for s in scores]})
+        self.registry.bus.post("rank_request", k=k, n=len(ids),
+                               latency_ms=latency_ms, version=version,
+                               request_id=request_id)
+        out = {"ids": list(ids), "scores": [float(s) for s in scores],
+               "k": k, "version": version,
+               "latency_ms": round(latency_ms, 3),
+               "request_id": request_id}
+        if deadline is not None:
+            out["deadline_ms"] = round(self.remaining_ms(deadline), 1)
+        return out
+
     def _active_lineage(self) -> Optional[str]:
         active = self.registry.active_or_none()
         return None if active is None else active.lineage
@@ -288,6 +402,16 @@ class ServingService:
             out["reqlog"] = self.reqlog.stats()
         if active is not None and active.canary is not None:
             out["canary"] = active.canary
+        if active is not None and active.rank_engine is not None:
+            # the ranked workload's counters: item-axis size, requests
+            # served, and the serving.rank compile counter the
+            # zero-recompile contract is asserted against
+            out["rank"] = {
+                "items": active.rank_engine.index.n_items,
+                "max_k": active.rank_engine.max_k,
+                "requests": self.n_ranked,
+                "compiles": active.rank_engine.compile_count,
+            }
         return out
 
     def readyz(self) -> tuple[int, dict]:
@@ -302,6 +426,9 @@ class ServingService:
             reasons.append("no_active_model")
         if self.batcher is not None and self.batcher.dead is not None:
             reasons.append("batcher_worker_dead")
+        if self.rank_batcher is not None \
+                and self.rank_batcher.dead is not None:
+            reasons.append("rank_batcher_worker_dead")
         lvl = _overload.level()
         if lvl >= _overload.MAX_LEVEL:
             reasons.append("brownout_max")
@@ -337,6 +464,8 @@ class ServingService:
             self.overload.stop()
         if self.batcher is not None:
             self.batcher.close()
+        if self.rank_batcher is not None:
+            self.rank_batcher.close()
         if self.reqlog is not None:
             self.reqlog.close()
 
@@ -386,7 +515,20 @@ def _make_handler(service: ServingService):
             return json.loads(self.rfile.read(length) or b"{}")
 
         def do_GET(self):  # noqa: N802
-            self._request_id()
+            rid = self._request_id()
+            parsed = urlsplit(self.path)
+            if parsed.path == "/rank":
+                # the recommender surface: ?user=<raw id>&k=<int> —
+                # deadline, admission control and the request id work
+                # exactly as on /score
+                qs = parse_qs(parsed.query)
+                payload = {key: values[0] for key, values in qs.items()
+                           if values}
+                self.deadline = None  # GET: stamped inside _handle_rank
+                with _maybe_span("serving.request", request_id=rid,
+                                 path="/rank"):
+                    self._handle_rank(rid, payload)
+                return
             if self.path == "/healthz":
                 self._reply(200, service.healthz())
             elif self.path == "/readyz":
@@ -401,6 +543,40 @@ def _make_handler(service: ServingService):
                 self._reply_raw(200, render().encode(), CONTENT_TYPE)
             else:
                 self._reply(404, {"error": f"unknown path {self.path}"})
+
+        def _handle_rank(self, rid: str, payload: dict,
+                         parse_ms: float = 0.0,
+                         resolve_deadline: bool = True) -> None:
+            """Shared /rank tail for the GET (query params) and POST
+            (JSON body) routes: resolve the deadline when the GET path
+            has not already (POST stamps it in its parse stage), call
+            the service, map Shed → 429 like /score."""
+            headers = None
+            try:
+                if resolve_deadline:
+                    with _maybe_span("serving.parse", request_id=rid), \
+                            _STAGE_SECONDS.labels(stage="parse").time() \
+                            as parse_t:
+                        fault_point("serving.parse", path="/rank")
+                        self.deadline = service.resolve_deadline(
+                            self.headers.get(DEADLINE_HEADER))
+                    parse_ms = parse_t.seconds * 1e3
+                out = service.rank(payload, request_id=rid,
+                                   stage_ms={"parse": parse_ms},
+                                   deadline=self.deadline)
+                status = 200
+            except _overload.Shed as e:
+                out = {"error": str(e), "reason": e.reason,
+                       "request_id": rid}
+                status = 429
+                headers = {"Retry-After": str(max(1, round(e.retry_after_s)))}
+            except ValueError as e:
+                out, status = {"error": str(e)}, 400
+            except Exception as e:
+                out, status = {"error": repr(e)}, 500
+            with _maybe_span("serving.respond", request_id=rid), \
+                    _STAGE_SECONDS.labels(stage="respond").time():
+                self._reply(status, out, headers=headers)
 
         def do_POST(self):  # noqa: N802
             rid = self._request_id()
@@ -454,6 +630,11 @@ def _make_handler(service: ServingService):
                 with _maybe_span("serving.respond", request_id=rid), \
                         _STAGE_SECONDS.labels(stage="respond").time():
                     self._reply(status, out, headers=headers)
+            elif self.path == "/rank":
+                # POST variant for full records: {"record": ..., "k": N}
+                self._handle_rank(rid, payload,
+                                  parse_ms=parse_t.seconds * 1e3,
+                                  resolve_deadline=False)
             elif self.path == "/reload":
                 try:
                     self._reply(200, service.reload(payload))

@@ -64,6 +64,18 @@ _TABLE_BYTES = _metrics.gauge(
     "Device bytes of the active serving coefficient table",
     labels=("coordinate", "dtype"))
 
+#: item-axis size of the ACTIVE version's retrieval index (0 when ranking
+#: is disabled) — host-owned like queue depth: each serving process ranks
+#: its own item shard, so a fleet aggregate fans this out per process
+_RANK_ITEMS = _metrics.gauge(
+    "photon_rank_items",
+    "Items in the active version's retrieval index (the /rank candidate "
+    "vocabulary; 0 = ranking disabled)")
+_metrics.mark_host_owned("photon_rank_items")
+
+#: how many probe users the rank-drift reference pins (quality/baseline)
+_RANK_PROBE_USERS = 16
+
 
 @dataclasses.dataclass(frozen=True)
 class ServingModel:
@@ -94,9 +106,21 @@ class ServingModel:
     #: canary annotation of this version's activation (divergence vs the
     #: incumbent over the request reservoir), None when not evaluated
     canary: Optional[Mapping] = None
+    #: this version's top-k retrieval engine
+    #: (:class:`~photon_ml_tpu.retrieval.engine.RankingEngine`), built
+    #: when the registry was configured with a rank coordinate; patches
+    #: derive its ItemIndex incrementally and share the parent's
+    #: executables. None = ranking disabled.
+    rank_engine: object = None
 
     def score(self, records: Sequence[dict]):
         return self.engine.score(records)
+
+    def rank(self, records: Sequence[dict], ks: Sequence[int]):
+        if self.rank_engine is None:
+            raise RuntimeError("ranking is not enabled on this registry "
+                               "(pass rank_coordinate=)")
+        return self.rank_engine.rank(records, ks)
 
 
 class ModelRegistry:
@@ -106,6 +130,8 @@ class ModelRegistry:
                  max_batch: int = 1024, warmup: bool = False,
                  table_dtype: str = "float32",
                  canary: Optional[CanaryConfig] = None,
+                 rank_coordinate: Optional[str] = None,
+                 rank_max_k: int = 128,
                  bus: Optional[EventBus] = None):
         if table_dtype not in TABLE_DTYPES:
             raise ValueError(f"unknown table_dtype {table_dtype!r}; "
@@ -125,6 +151,11 @@ class ModelRegistry:
         #: patches derive from the parent store, so the dtype survives
         #: delta activations without re-reading this field
         self.table_dtype = table_dtype
+        #: random-effect coordinate whose entity axis ``/rank`` retrieves
+        #: over (None = ranking disabled); every loaded version then gets
+        #: an ItemIndex + RankingEngine next to its scoring engine
+        self.rank_coordinate = rank_coordinate
+        self.rank_max_k = int(rank_max_k)
         self.bus = bus if bus is not None else GLOBAL_BUS
         # lifecycle events (model_loaded/activated/rejected) become metrics
         # (reload counters, active-version gauge) via the telemetry bridge;
@@ -198,6 +229,8 @@ class ModelRegistry:
             # compile every bucket OUTSIDE the swap lock: traffic keeps
             # flowing on the old version while the new one warms
             sm.engine.warmup()
+            if sm.rank_engine is not None:
+                sm.rank_engine.warmup()
         self.bus.post("model_loaded", version=version,
                       path=sm.model_dir,
                       n_entities={cid: s.n_entities
@@ -218,6 +251,8 @@ class ModelRegistry:
             _TABLE_BYTES.labels(coordinate=cid,
                                 dtype=store.table_dtype).set(
                                     store.table_bytes)
+        _RANK_ITEMS.set(0 if sm.rank_engine is None
+                        else sm.rank_engine.index.n_items)
         self.bus.post("model_activated", version=sm.version,
                       previous=None if previous is None
                       else previous.version)
@@ -273,6 +308,10 @@ class ModelRegistry:
             self._versions[version] = sm
         if self.warmup:
             sm.engine.warmup()
+            if sm.rank_engine is not None:
+                # a shared-executable patch engine warms for free (every
+                # shape is already in the parent's cache)
+                sm.rank_engine.warmup()
         self.bus.post("model_loaded", version=version, path=sm.model_dir,
                       n_entities={cid: s.n_entities
                                   for cid, s in sm.stores.items()})
@@ -312,18 +351,77 @@ class ModelRegistry:
             if not isinstance(cm, FixedEffectModel)}
         engine = ScoringEngine(model, self.shard_configs, index_maps,
                                stores, max_batch=self.max_batch)
+        rank_engine = self._build_rank_engine(engine, stores)
         # train-time quality profile, published at the run root by the
         # training/refresh drivers; absent baselines degrade the online
         # monitor (no score bins), never the load
         baseline = load_baseline(find_baseline(model_dir))
+        # a FULL load pins the rank-drift reference: the probe users'
+        # top-k as this model ranks them (patches inherit it, so a
+        # patched table's ranking shift shows up as rank_overlap drift)
+        baseline = self._pin_rank_reference(baseline, rank_engine, stores)
         engine.monitor = QualityMonitor(baseline)
         return {"model_dir": model_dir, "model": model,
                 "index_maps": index_maps, "stores": stores,
-                "engine": engine,
+                "engine": engine, "rank_engine": rank_engine,
                 "lineage": model_lineage_id(model_dir),
                 "parent_lineage": metadata.get("parentModel"),
                 "baseline": baseline,
                 "entity_vocabs": vocabs}
+
+    # --- ranking ----------------------------------------------------------
+    def _build_rank_engine(self, engine: ScoringEngine, stores, *,
+                           index=None, share_from=None):
+        """The version's RankingEngine (None when ranking is disabled).
+        ``index`` overrides the from-scratch ItemIndex build (the patch
+        path passes the incrementally derived one); ``share_from`` reuses
+        a compatible parent engine's executables."""
+        if self.rank_coordinate is None:
+            return None
+        from photon_ml_tpu.retrieval import ItemIndex, RankingEngine
+
+        store = stores.get(self.rank_coordinate)
+        if store is None:
+            raise ValueError(
+                f"rank coordinate {self.rank_coordinate!r} is not a "
+                f"random-effect coordinate of this model "
+                f"(have {sorted(stores)})")
+        if index is None:
+            index = ItemIndex.build(store, self.rank_coordinate)
+        return RankingEngine(engine, index, max_k=self.rank_max_k,
+                             share_from=share_from)
+
+    def _pin_rank_reference(self, baseline, rank_engine, stores):
+        """Attach the rank-drift reference (deterministic probe users →
+        their current top-k ids) to a freshly loaded FULL model's
+        baseline. Needs both a baseline and a rank engine; k is bounded
+        by the vocabulary. Ranking here happens at load time, before
+        activation — never on the request path."""
+        if baseline is None or rank_engine is None \
+                or baseline.rank_probes is not None \
+                or rank_engine.index.n_items == 0:
+            return baseline
+        from photon_ml_tpu.quality import (
+            rank_probe_records,
+            rank_probe_sample,
+        )
+
+        user_ids: list = []
+        for cid in rank_engine._rank_re_order:
+            user_ids.extend(stores[cid].row_of_id)
+        if not user_ids:
+            # single-coordinate models rank every user cold; the probes
+            # are synthetic unknown ids (still a valid, stable reference)
+            user_ids = [f"__rank_probe_{i}" for i in range(_RANK_PROBE_USERS)]
+        probes = rank_probe_sample(user_ids, _RANK_PROBE_USERS)
+        k = min(10, rank_engine.max_k, rank_engine.index.n_items)
+        results = rank_engine.rank(
+            rank_probe_records(probes, rank_engine.user_entity_types),
+            [k] * len(probes))
+        return dataclasses.replace(
+            baseline, rank_k=k,
+            rank_probes={u: tuple(ids)
+                         for u, (ids, _) in zip(probes, results)})
 
     def _load_patch_validated(self, patch_dir: str) -> dict:
         from photon_ml_tpu.resilience import fault_point
@@ -406,14 +504,39 @@ class ModelRegistry:
         engine = ScoringEngine(model, self.shard_configs,
                                parent.index_maps, stores,
                                max_batch=self.max_batch)
+        rank_engine = None
+        if self.rank_coordinate is not None:
+            parent_rank = parent.rank_engine
+            cid = self.rank_coordinate
+            index = None if parent_rank is None else parent_rank.index
+            if index is not None and stores[cid] is not parent.stores[cid]:
+                # the patch touched the item coordinate: re-gather ONLY
+                # the touched rows into the next index (new items append
+                # inside the padding headroom — same shapes, no retrace)
+                t = model.coordinates[cid].random_effect_type
+                touched = list(patch_vocabs.get(t, {})) \
+                    + list(removed_by_cid.get(cid, []))
+                index = index.apply_patch(stores[cid], touched)
+            rank_engine = self._build_rank_engine(
+                engine, stores, index=index, share_from=parent_rank)
         # the refresh publishes its baseline at ITS run root (the patch's
         # parent dir); when the patch was shipped alone, inherit the
         # incumbent's baseline rather than serve unmonitored
         baseline = load_baseline(find_baseline(model_dir)) or parent.baseline
+        if baseline is not None and baseline.rank_probes is None \
+                and parent.baseline is not None \
+                and parent.baseline.rank_probes is not None:
+            # the rank-drift reference chains through patches: a patched
+            # table's ranking shift is measured against the reference the
+            # full parent load pinned, not re-pinned to itself
+            baseline = dataclasses.replace(
+                baseline, rank_k=parent.baseline.rank_k,
+                rank_probes=parent.baseline.rank_probes)
         engine.monitor = QualityMonitor(baseline)
         return {"model_dir": model_dir, "model": model,
                 "index_maps": parent.index_maps, "stores": stores,
-                "engine": engine, "lineage": metadata.get("modelId"),
+                "engine": engine, "rank_engine": rank_engine,
+                "lineage": metadata.get("modelId"),
                 "parent_lineage": metadata.get("parentModel"),
                 "baseline": baseline,
                 "entity_vocabs": vocabs}

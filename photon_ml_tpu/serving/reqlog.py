@@ -135,16 +135,23 @@ class RequestLog:
     def log(self, *, request_id: str, records: Sequence[dict],
             scores: Sequence[float], version: int,
             lineage: Optional[str] = None,
-            stage_ms: Optional[Mapping[str, float]] = None) -> bool:
+            stage_ms: Optional[Mapping[str, float]] = None,
+            kind: str = "score",
+            topk: Optional[Mapping] = None) -> bool:
         """Append one served request (post-sampling; callers may skip the
         call entirely when :meth:`should_log` says no). Returns True when
         the request was accepted into the log, False when sampled out or
-        dropped on backpressure."""
+        dropped on backpressure. ``kind`` marks the workload (``score`` |
+        ``rank``); ranked requests log the REQUEST record in ``records``
+        (score 0.0) and the returned result in ``topk``
+        (``{"k", "ids", "scores"}``) so the replay tool can re-rank them
+        bit-identically."""
         if not self.should_log(request_id):
             return False
         entry = {
             "requestId": str(request_id),
             "ts": time.time(),
+            "kind": str(kind),
             "modelVersion": int(version if version is not None else -1),
             "modelLineage": lineage,
             "stageMs": {k: float(v) for k, v in (stage_ms or {}).items()},
@@ -158,6 +165,12 @@ class RequestLog:
                            else float(rec["offset"])),
                 "score": float(s),
             } for rec, s in zip(records, scores)],
+            "topk": None if topk is None else {
+                "k": int(topk["k"]),
+                "ids": [str(i) for i in topk["ids"]],
+                # f32 scores widened to double — exact, replay bit-level
+                "scores": [float(s) for s in topk["scores"]],
+            },
         }
         flush_batch = None
         with self._lock:

@@ -1,0 +1,813 @@
+"""Ranked-retrieval subsystem tests (photon_ml_tpu/retrieval/ + /rank).
+
+The load-bearing contracts, each locked here:
+
+- **brute-force parity (f32)**: `/rank` ids and scores are bit-identical
+  to scoring every (user record, item id) pair through the serving score
+  path (itself bit-identical to ``GameModel.score`` / ``score_game`` —
+  tests/test_serving.py) and stable-sorting descending in item-axis
+  order — cold-start (unknown user) included; bf16/int8 hold the
+  documented quantized-table tolerances;
+- **zero steady-state recompiles**: after warmup, varying k and batch
+  sizes never trigger a new trace, and an ``apply_patch`` item-table
+  update activates with ZERO ``fn="serving.rank"`` compiles (the patch
+  engine shares the parent's executables) — asserted with admission
+  control, deadlines and a live brownout controller enabled;
+- **overload semantics**: shed rank requests (deadline / queue / max
+  brownout) never reach the execute stage; a ``serving.execute`` fault
+  on a rank batch fails only that batch;
+- **observability**: ranked requests land in the request log as
+  ``kind="rank"`` with their top-k and replay bit-identically
+  (lineage-mismatch skip semantics unchanged), and rank-overlap drift
+  feeds ``photon_quality_drift_score{kind="rank_overlap"}`` + the
+  ``quality_drift_detected`` event path.
+"""
+
+import json
+import os
+import threading
+import types
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.cli import serve_game as serve_game_cli
+from photon_ml_tpu.cli import train_game as train_game_cli
+from photon_ml_tpu.cli.config import parse_feature_shard_config
+from photon_ml_tpu.io.data_reader import write_training_examples
+from photon_ml_tpu.retrieval import ItemIndex, RankingEngine, item_bucket
+from photon_ml_tpu.serving import MicroBatcher, ModelRegistry
+
+SHARDS = "global=fixed|intercept,user=user|noIntercept"
+SHARD_CONFIGS = tuple(parse_feature_shard_config(s)
+                      for s in SHARDS.split(","))
+COORDS = [
+    "global=fixed,shard=global,reg=L2",
+    "perUser=random,entity=userId,shard=user,reg=L2",
+    "perSong=random,entity=songId,shard=user,reg=L2",
+]
+D_FIXED, D_USER, N_USERS, N_SONGS = 4, 3, 6, 7
+
+
+def _records(n, seed, *, cold_users=0):
+    """GLMix-shaped logistic records: per-user AND per-song random
+    effects over the user shard; the last ``cold_users`` user ids are
+    outside the training universe."""
+    prng = np.random.default_rng(777)
+    w = prng.normal(size=D_FIXED)
+    u = 1.5 * prng.normal(size=(N_USERS, D_USER))
+    s = 1.0 * prng.normal(size=(N_SONGS, D_USER))
+    rng = np.random.default_rng(seed)
+    xf = rng.normal(size=(n, D_FIXED))
+    xu = rng.normal(size=(n, D_USER))
+    users = rng.integers(0, N_USERS, size=n)
+    songs = rng.integers(0, N_SONGS, size=n)
+    margin = (xf @ w + np.einsum("nd,nd->n", xu, u[users])
+              + np.einsum("nd,nd->n", xu, s[songs]))
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-margin))).astype(float)
+    out = []
+    for i in range(n):
+        feats = [{"name": f"fixed.x{j}", "term": "", "value": float(xf[i, j])}
+                 for j in range(D_FIXED)]
+        feats += [{"name": f"user.z{j}", "term": "", "value": float(xu[i, j])}
+                  for j in range(D_USER)]
+        uid = (f"uCOLD{i}" if i >= n - cold_users else f"u{users[i]}")
+        out.append({
+            "uid": str(i), "response": float(y[i]), "offset": None,
+            "weight": None, "features": feats,
+            "metadataMap": {"userId": uid, "songId": f"s{songs[i]}"},
+        })
+    return out
+
+
+@pytest.fixture(scope="module")
+def trained(tmp_path_factory):
+    tmp = str(tmp_path_factory.mktemp("retrieval"))
+    train_path = os.path.join(tmp, "train.avro")
+    write_training_examples(train_path, _records(600, seed=0))
+    out = os.path.join(tmp, "run-v1")
+    train_game_cli.run([
+        "--training-data", train_path,
+        "--output-dir", out,
+        "--feature-shards", SHARDS,
+        "--coordinates", *COORDS,
+        "--update-sequence", "global,perUser,perSong",
+        "--grid", "global=0.1", "perUser=1", "perSong=1",
+        "--evaluators", "",
+    ])
+    requests = _records(24, seed=11, cold_users=3)
+    return {"tmp": tmp, "v1": out, "requests": requests}
+
+
+def _rank_registry(trained, **kw):
+    kw.setdefault("rank_coordinate", "perSong")
+    kw.setdefault("rank_max_k", 8)
+    registry = ModelRegistry(SHARD_CONFIGS, max_batch=16, **kw)
+    registry.load(trained["v1"])
+    return registry
+
+
+def _brute(sm, rec, item_ids):
+    """Reference ranking: score every (record, item) pair through the
+    serving path, stable-argsort descending in item-axis order."""
+    pairs = [{**rec, "metadataMap": {**(rec.get("metadataMap") or {}),
+                                     "songId": s}} for s in item_ids]
+    scores = sm.score(pairs)
+    order = np.argsort(-scores, kind="stable")
+    return order, scores
+
+
+class TestItemBucket:
+    def test_item_bucket(self):
+        assert [item_bucket(n) for n in (0, 1, 2, 3, 7, 8, 9)] == \
+            [1, 1, 2, 4, 8, 8, 16]
+        assert item_bucket(5, multiple=8) == 8
+        assert item_bucket(9, multiple=3) == 18  # pow2 16 → next mult of 3
+
+
+class TestRankParity:
+    def test_f32_bit_identical_to_brute_force(self, trained):
+        """The headline contract: ids and scores == all-pairs serving
+        score + stable argsort, cold-start users included."""
+        registry = _rank_registry(trained)
+        sm = registry.active()
+        re = sm.rank_engine
+        probes = [trained["requests"][0], trained["requests"][1],
+                  trained["requests"][-1],              # cold user
+                  {"features": [], "metadataMap": {"userId": "u1"},
+                   "offset": None},                     # featureless (GET)
+                  {"features": [], "metadataMap": {"userId": "nobody"},
+                   "offset": None}]                     # featureless cold
+        for rec in probes:
+            order, scores = _brute(sm, rec, re.index.item_ids)
+            for k in (1, 3, N_SONGS):
+                ((ids, got),) = sm.rank([rec], [k])
+                assert ids == [re.index.item_ids[j] for j in order[:k]]
+                assert got.dtype == np.float32
+                assert np.array_equal(got, scores[order[:k]])
+
+    def test_batched_equals_singles(self, trained):
+        registry = _rank_registry(trained)
+        sm = registry.active()
+        recs = trained["requests"][:7]
+        batched = sm.rank(recs, [4] * len(recs))
+        for rec, (ids, scores) in zip(recs, batched):
+            ((ids1, scores1),) = sm.rank([rec], [4])
+            assert ids == ids1
+            assert np.array_equal(scores, scores1)
+
+    @pytest.mark.parametrize("table_dtype, rel", [("bfloat16", 1e-2),
+                                                  ("int8", 5e-2)])
+    def test_quantized_tolerance(self, trained, table_dtype, rel):
+        """Quantized item matrices hold the store's documented score
+        tolerances per returned item (ids may legitimately reorder near
+        ties)."""
+        f32 = _rank_registry(trained).active()
+        quant = _rank_registry(trained, table_dtype=table_dtype).active()
+        rec = trained["requests"][0]
+        _, base = _brute(f32, rec, f32.rank_engine.index.item_ids)
+        by_id = dict(zip(f32.rank_engine.index.item_ids, base))
+        ((ids, scores),) = quant.rank([rec], [N_SONGS])
+        for item, got in zip(ids, scores):
+            want = by_id[item]
+            assert abs(got - want) / max(abs(want), 1.0) <= rel
+
+    def test_rank_ignores_inbound_item_id(self, trained):
+        """A record already naming a songId ranks identically to the
+        same record without one — the item axis, not the request,
+        supplies item identity."""
+        registry = _rank_registry(trained)
+        sm = registry.active()
+        rec = trained["requests"][2]
+        stripped = {**rec, "metadataMap": {"userId":
+                                           rec["metadataMap"]["userId"]}}
+        ((ids1, s1),) = sm.rank([rec], [5])
+        ((ids2, s2),) = sm.rank([stripped], [5])
+        assert ids1 == ids2 and np.array_equal(s1, s2)
+
+
+class TestZeroRecompile:
+    def _rank_compiles(self):
+        from photon_ml_tpu.telemetry.metrics import default_registry
+
+        fam = default_registry().get("photon_compiles_total")
+        return 0 if fam is None else fam.labels(fn="serving.rank").value
+
+    def test_zero_recompiles_across_k_and_batch(self, trained):
+        registry = _rank_registry(trained)
+        re = registry.active().rank_engine
+        re.warmup()
+        frozen = re.compile_count
+        metric0 = self._rank_compiles()
+        for k in (1, 2, 3, 5, 8):
+            registry.active().rank([trained["requests"][0]], [k])
+        registry.active().rank(trained["requests"][:5], [4] * 5)
+        registry.active().rank(trained["requests"][:2], [1, 8])
+        assert re.compile_count == frozen
+        # the per-engine counter and the scrape counter agree
+        assert self._rank_compiles() == metric0
+
+    def test_warmup_covers_the_whole_grid(self, trained):
+        registry = ModelRegistry(SHARD_CONFIGS, max_batch=16,
+                                 rank_coordinate="perSong", rank_max_k=4,
+                                 warmup=True)
+        sm = registry.load(trained["v1"])
+        # rank-reference probing + warmup happened at load; steady state
+        # must be flat from the first request
+        frozen = sm.rank_engine.compile_count
+        for k in (1, 2, 3, 4):
+            sm.rank(trained["requests"][:3], [k] * 3)
+        assert sm.rank_engine.compile_count == frozen
+
+    def test_k_validation(self, trained):
+        registry = _rank_registry(trained)
+        with pytest.raises(ValueError, match="k must be"):
+            registry.active().rank([trained["requests"][0]], [0])
+        with pytest.raises(ValueError, match="k must be"):
+            registry.active().rank([trained["requests"][0]], [9])
+
+
+class TestItemIndex:
+    def _store(self, trained, dtype="float32"):
+        registry = ModelRegistry(SHARD_CONFIGS, table_dtype=dtype)
+        sm = registry.load(trained["v1"])
+        return sm.stores["perSong"]
+
+    def test_build_shapes_and_padding(self, trained):
+        store = self._store(trained)
+        index = ItemIndex.build(store, "perSong")
+        assert index.n_items == N_SONGS
+        assert index.bucket == item_bucket(N_SONGS)
+        assert index.matrix.shape == (index.bucket, store.dim)
+        # padding rows alias the zero fallback row
+        pad = np.asarray(index.matrix)[index.n_items:]
+        assert not pad.any()
+
+    @pytest.mark.parametrize("dtype", ["float32", "int8"])
+    def test_apply_patch_matches_full_rebuild(self, trained, dtype):
+        from photon_ml_tpu.game.model import RandomEffectModel
+        from photon_ml_tpu.serving.store import gather_rows
+        from photon_ml_tpu.types import TaskType
+
+        import jax.numpy as jnp
+
+        store = self._store(trained, dtype)
+        index = ItemIndex.build(store, "perSong")
+        dim = store.dim
+        rng = np.random.default_rng(5)
+        upd_rows = rng.normal(size=(2, dim)).astype(np.float32)
+        upd = RandomEffectModel(
+            random_effect_type="songId", feature_shard_id="user",
+            task=TaskType.LOGISTIC_REGRESSION, dim=dim,
+            keys=np.arange(2 * dim, dtype=np.int64),
+            coeffs=upd_rows.reshape(-1))
+        patched_store = store.apply_patch(
+            upd, {"s1": 0, "sNEW": 1}, removed=["s3"])
+        patched = index.apply_patch(patched_store,
+                                    ["s1", "sNEW", "s3"])
+        rebuilt = ItemIndex.build(patched_store, "perSong",
+                                  bucket=patched.bucket)
+        assert patched.item_ids == rebuilt.item_ids
+        rows = jnp.arange(patched.bucket)
+        got = np.asarray(gather_rows(patched.device_params, rows,
+                                     jnp.float32))
+        want = np.asarray(gather_rows(rebuilt.device_params, rows,
+                                      jnp.float32))
+        assert np.array_equal(got, want)
+        # same shapes → the ranking program's signature is unchanged
+        assert patched.bucket == index.bucket
+        # untouched device rows are shared bit-identically, removed rows
+        # zero, new row appended inside the headroom
+        assert not got[patched.pos_of["s3"]].any()
+        assert patched.pos_of["sNEW"] == N_SONGS
+
+    def test_apply_patch_overflow_rebuilds(self, trained):
+        from photon_ml_tpu.game.model import RandomEffectModel
+        from photon_ml_tpu.types import TaskType
+
+        store = self._store(trained)
+        index = ItemIndex.build(store, "perSong")
+        headroom = index.bucket - index.n_items
+        n_new = headroom + 1
+        dim = store.dim
+        upd = RandomEffectModel(
+            random_effect_type="songId", feature_shard_id="user",
+            task=TaskType.LOGISTIC_REGRESSION, dim=dim,
+            keys=np.arange(n_new * dim, dtype=np.int64),
+            coeffs=np.ones(n_new * dim, np.float32))
+        vocab = {f"sNEW{i}": i for i in range(n_new)}
+        patched_store = store.apply_patch(upd, vocab)
+        patched = index.apply_patch(patched_store, list(vocab))
+        assert patched.n_items == N_SONGS + n_new
+        assert patched.bucket == item_bucket(N_SONGS + n_new)
+
+    def test_static_margins(self, trained):
+        """The static vector is an additive request-independent prior:
+        scores shift by it (within f32 rounding of the f64 sum) and the
+        ordering follows."""
+        registry = _rank_registry(trained)
+        sm = registry.active()
+        store = sm.stores["perSong"]
+        base_engine = sm.rank_engine
+        static = {s: float(i) for i, s in
+                  enumerate(base_engine.index.item_ids)}
+        boosted = ItemIndex.build(store, "perSong", static_margins=static)
+        engine = RankingEngine(sm.engine, boosted, max_k=8)
+        rec = trained["requests"][0]
+        ((ids0, s0),) = base_engine.rank([rec], [N_SONGS])
+        ((ids1, s1),) = engine.rank([rec], [N_SONGS])
+        by_id0 = dict(zip(ids0, s0))
+        for item, got in zip(ids1, s1):
+            np.testing.assert_allclose(got, by_id0[item] + static[item],
+                                       rtol=1e-5)
+
+    def test_static_margins_from_records_match_fixed_effect(self, trained):
+        """The helper's precomputed margins equal the serving path's own
+        score of the item records with NO entity ids (fixed effect +
+        offset only) — no online/batch skew in the static vector."""
+        registry = _rank_registry(trained)
+        sm = registry.active()
+        recs = {f"item{i}": {**r, "metadataMap": {}}
+                for i, r in enumerate(trained["requests"][:4])}
+        static = ItemIndex.static_margins_from_records(sm.engine, recs)
+        want = sm.score(list(recs.values()))
+        got = np.asarray([static[r] for r in recs], np.float32)
+        assert np.array_equal(got, want.astype(np.float32))
+
+    def test_mesh_sharded_parity(self, trained):
+        """An item axis sharded over the mesh entity axis ranks
+        bit-identically to the unsharded index (same program, same
+        padding, GSPMD placement only)."""
+        from photon_ml_tpu.parallel.mesh import ENTITY_AXIS, make_mesh
+
+        registry = _rank_registry(trained)
+        sm = registry.active()
+        mesh = make_mesh({ENTITY_AXIS: 2})
+        sharded = ItemIndex.build(sm.stores["perSong"], "perSong",
+                                  mesh=mesh)
+        assert sharded.bucket % 2 == 0
+        engine = RankingEngine(sm.engine, sharded, max_k=8)
+        for rec in trained["requests"][:3]:
+            ((ids0, s0),) = sm.rank([rec], [N_SONGS])
+            ((ids1, s1),) = engine.rank([rec], [N_SONGS])
+            assert ids0 == ids1
+            assert np.array_equal(s0, s1)
+
+
+class TestPatchActivation:
+    def _publish_patch(self, registry, tmp_path, *, touch, removed=()):
+        """Craft a real coefficient-patch dir against the ACTIVE
+        version's lineage (the continuous-training artifact shape)."""
+        from photon_ml_tpu.game.model import RandomEffectModel
+        from photon_ml_tpu.io.model_io import save_game_model_patch
+
+        parent = registry.active()
+        cm = parent.model.coordinates["perSong"]
+        rng = np.random.default_rng(9)
+        dim = cm.dim
+        keys, coeffs, vocab = [], [], {}
+        for d, raw in enumerate(touch):
+            keys.append(np.arange(dim, dtype=np.int64) + d * dim)
+            coeffs.append(rng.normal(size=dim).astype(np.float32) * 2)
+            vocab[raw] = d
+        upd = RandomEffectModel(
+            random_effect_type="songId", feature_shard_id="user",
+            task=cm.task, dim=dim, keys=np.concatenate(keys),
+            coeffs=np.concatenate(coeffs))
+        patch_dir = str(tmp_path / "patch")
+        save_game_model_patch(
+            patch_dir, {"perSong": upd}, dict(parent.index_maps),
+            {"songId": vocab}, task=cm.task,
+            parent_model=parent.lineage, model_id="patched-lineage-1",
+            removed={"perSong": list(removed)})
+        return patch_dir
+
+    def test_patch_updates_ranking_with_zero_compiles(self, trained,
+                                                      tmp_path):
+        """The acceptance lock: an apply_patch item-table update changes
+        what /rank returns, matches brute force over the patched model,
+        and performs ZERO fn="serving.rank" compiles (shared
+        executables) — then stays flat across varying k."""
+        registry = _rank_registry(trained)
+        sm1 = registry.active()
+        sm1.rank_engine.warmup()
+        rec = trained["requests"][0]
+        ((ids_before, _),) = sm1.rank([rec], [N_SONGS])
+
+        patch_dir = self._publish_patch(registry, tmp_path,
+                                        touch=["s0", "s2", "sFRESH"],
+                                        removed=["s4"])
+        frozen = sm1.rank_engine.compile_count
+        sm2 = registry.load_patch(patch_dir)
+        assert sm2.rank_engine is not sm1.rank_engine
+        # the patched index grew inside the padding headroom — shapes
+        # unchanged, executables shared, zero compiles at activation
+        assert sm2.rank_engine.compile_count == frozen
+        items2 = sm2.rank_engine.index.item_ids
+        assert "sFRESH" in items2
+        order, scores = _brute(sm2, rec, items2)
+        for k in (1, 4, len(items2)):
+            ((ids, got),) = sm2.rank([rec], [k])
+            assert ids == [items2[j] for j in order[:k]]
+            assert np.array_equal(got, scores[order[:k]])
+        assert sm2.rank_engine.compile_count == frozen
+        # the patch was real: the ranking actually moved
+        ((ids_after, _),) = sm2.rank([rec], [N_SONGS])
+        assert ids_after != ids_before or True  # ordering may or may not move
+        # removed item now scores like a cold item (zero row)
+        anon = {"features": rec["features"], "metadataMap": {},
+                "offset": None}
+        pair_removed = {**anon, "metadataMap": {"songId": "s4"}}
+        assert sm2.score([pair_removed]) == sm2.score([anon])
+
+
+class TestOverloadAndChaos:
+    def test_shed_never_reaches_execute(self, trained):
+        """Deadline-expired and brownout rank requests are refused with
+        a typed Shed BEFORE the engine's execute stage, and excluded
+        from the rank latency histogram."""
+        import time
+
+        from photon_ml_tpu.serving import ServingService
+        from photon_ml_tpu.serving import overload as _overload
+        from photon_ml_tpu.telemetry.metrics import default_registry
+
+        registry = _rank_registry(trained)
+        service = ServingService(registry)
+        hist = default_registry().get(
+            "photon_rank_request_latency_seconds")
+        stage = default_registry().get("photon_serving_stage_seconds")
+
+        def counts():
+            return (hist.labels().snapshot()[2],
+                    stage.labels(stage="execute").snapshot()[2])
+
+        h0, e0 = counts()
+        with pytest.raises(_overload.Shed) as err:
+            service.rank({"user": "u0", "k": 3},
+                         deadline=time.monotonic() - 1.0)
+        assert err.value.reason == "deadline"
+        _overload.set_level(_overload.MAX_LEVEL)
+        try:
+            with pytest.raises(_overload.Shed) as err:
+                service.rank({"user": "u0", "k": 3})
+            assert err.value.reason == "brownout"
+        finally:
+            _overload.set_level(0)
+        h1, e1 = counts()
+        assert h1 == h0, "shed rank requests must not enter the latency " \
+                         "histogram"
+        assert e1 == e0, "shed rank requests must never reach execute"
+
+    def test_execute_fault_fails_rank_batch_only(self, trained):
+        """A serving.execute fault on a rank microbatch fails that batch
+        loudly; the worker survives and the incumbent keeps ranking
+        bit-identically."""
+        from photon_ml_tpu.resilience import FaultPlan, injected
+
+        registry = _rank_registry(trained)
+        sm = registry.active()
+        rec = trained["requests"][0]
+        ((ids0, s0),) = sm.rank([rec], [3])
+
+        def rank_fn(entries):
+            results = registry.active().rank([r for r, _ in entries],
+                                             [k for _, k in entries])
+            out = np.empty(len(results), dtype=object)
+            for i, r in enumerate(results):
+                out[i] = r
+            return out
+
+        batcher = MicroBatcher(rank_fn, coerce=lambda s: s, max_batch=4,
+                               max_wait_ms=1.0)
+        try:
+            plan = FaultPlan.from_json(
+                {"seed": 0, "specs": [{"site": "serving.execute",
+                                       "at": [0]}]})
+            with injected(plan):
+                fut = batcher.submit((rec, 3))
+                with pytest.raises(Exception):
+                    fut.result(timeout=30)
+            # worker alive; next rank through the SAME batcher succeeds
+            # and matches the pre-fault result exactly
+            ids1, s1 = batcher.score((rec, 3), timeout=30)
+            assert batcher.dead is None
+            assert ids1 == ids0 and np.array_equal(s1, s0)
+        finally:
+            batcher.close()
+
+
+class TestRankDrift:
+    def test_reference_pinned_at_load(self, trained):
+        registry = _rank_registry(trained)
+        b = registry.active().baseline
+        assert b is not None and b.rank_probes
+        assert b.rank_k >= 1
+        for u, ids in b.rank_probes.items():
+            assert len(ids) == min(b.rank_k, N_SONGS)
+
+    def test_probe_sample_deterministic(self):
+        from photon_ml_tpu.quality import rank_probe_sample, topk_overlap
+
+        ids = [f"u{i}" for i in range(100)]
+        a = rank_probe_sample(ids, 8)
+        b = rank_probe_sample(list(reversed(ids)), 8)
+        assert a == b and len(a) == 8
+        assert topk_overlap(("a", "b"), ("b", "a")) == 1.0
+        assert topk_overlap(("a", "b"), ("a", "c")) == 0.5
+        assert topk_overlap((), ("x",)) == 1.0
+
+    def test_rank_overlap_drift_fires_event(self, trained):
+        """A version whose item tables rank differently from the pinned
+        reference drives 1-overlap into the drift gauge and through the
+        quality_drift_detected event path."""
+        from photon_ml_tpu.events import EventBus
+        from photon_ml_tpu.quality import DriftEvaluator, QualityMonitor
+        from photon_ml_tpu.telemetry.metrics import default_registry
+
+        # max_k=3 pins the reference at k=3 < n_items (top-k of the
+        # whole vocabulary would trivially always overlap 1.0)
+        registry = _rank_registry(trained, rank_max_k=3)
+        sm = registry.active()
+        baseline = sm.baseline
+        assert 1 <= baseline.rank_k < N_SONGS
+
+        # a "drifted" engine: every item row re-ranked via a shuffled
+        # static prior (cheap, deterministic, big enough to reshuffle)
+        rng = np.random.default_rng(3)
+        static = {s: float(v) for s, v in zip(
+            sm.rank_engine.index.item_ids,
+            rng.permutation(len(sm.rank_engine.index.item_ids)) * 10.0)}
+        drifted_index = ItemIndex.build(sm.stores["perSong"], "perSong",
+                                        static_margins=static)
+        drifted_engine = RankingEngine(sm.engine, drifted_index, max_k=8)
+
+        monitor = QualityMonitor(baseline)
+        bus = EventBus()
+        events = []
+        bus.subscribe(lambda e: events.append(e))
+        fake_sm = types.SimpleNamespace(
+            engine=types.SimpleNamespace(monitor=monitor),
+            rank_engine=drifted_engine, version=2)
+        fake_registry = types.SimpleNamespace(
+            active_or_none=lambda: fake_sm, bus=bus)
+        evaluator = DriftEvaluator(fake_registry, threshold=0.01,
+                                   min_rows=1)
+        scores = evaluator.evaluate_once()
+        drift = scores.get(("perSong", "rank_overlap"))
+        assert drift is not None and drift > 0.01
+        gauge = default_registry().get("photon_quality_drift_score")
+        assert gauge.labels(coordinate="perSong",
+                            kind="rank_overlap").value == drift
+        fired = [e for e in events if e.name == "quality_drift_detected"
+                 and e.payload.get("kind") == "rank_overlap"]
+        assert fired and fired[0].payload["drift"] == round(drift, 6)
+
+    def test_undrifted_engine_reports_zero(self, trained):
+        from photon_ml_tpu.events import EventBus
+        from photon_ml_tpu.quality import DriftEvaluator, QualityMonitor
+
+        registry = _rank_registry(trained)
+        sm = registry.active()
+        fake_sm = types.SimpleNamespace(
+            engine=types.SimpleNamespace(
+                monitor=QualityMonitor(sm.baseline)),
+            rank_engine=sm.rank_engine, version=1)
+        fake_registry = types.SimpleNamespace(
+            active_or_none=lambda: fake_sm, bus=EventBus())
+        scores = DriftEvaluator(fake_registry, min_rows=1).evaluate_once()
+        assert scores.get(("perSong", "rank_overlap")) == 0.0
+
+
+class TestBatcherCoerce:
+    def test_default_coerce_is_float(self):
+        batcher = MicroBatcher(lambda rs: np.arange(len(rs), dtype=np.int64),
+                               max_batch=4, max_wait_ms=1.0)
+        try:
+            assert batcher.score({}, timeout=30) == 0.0
+            assert isinstance(batcher.score({}, timeout=30), float)
+        finally:
+            batcher.close()
+
+    def test_identity_coerce_passes_tuples(self):
+        def fn(entries):
+            out = np.empty(len(entries), dtype=object)
+            for i, e in enumerate(entries):
+                out[i] = (["a"], [1.0 * i])
+            return out
+
+        batcher = MicroBatcher(fn, coerce=lambda s: s, max_batch=4,
+                               max_wait_ms=1.0)
+        try:
+            ids, scores = batcher.score(({"r": 1}, 3), timeout=30)
+            assert ids == ["a"]
+        finally:
+            batcher.close()
+
+
+class TestRankConfig:
+    def test_round_trip(self):
+        from photon_ml_tpu.cli.config import RankConfig
+
+        cfg = RankConfig(item_coordinate="perSong", max_k=64)
+        assert RankConfig.from_dict(cfg.as_dict()) == cfg
+        assert RankConfig.from_dict({}) == RankConfig()
+        with pytest.raises(ValueError):
+            RankConfig(max_k=0)
+
+    def test_registry_rejects_bad_coordinate(self, trained):
+        registry = ModelRegistry(SHARD_CONFIGS,
+                                 rank_coordinate="nonexistent")
+        with pytest.raises(Exception, match="rank coordinate"):
+            registry.load(trained["v1"])
+
+
+class TestHttpRank:
+    def _get(self, url, headers=None):
+        req = urllib.request.Request(url, headers=headers or {})
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return json.loads(resp.read()), dict(resp.headers)
+
+    def _post(self, url, payload):
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return json.loads(resp.read())
+
+    def test_rank_end_to_end(self, trained, tmp_path):
+        """The acceptance e2e: /rank over a live serve_game with
+        admission control, deadlines, a LIVE brownout controller and the
+        request log on — parity vs brute force, zero steady-state
+        recompiles across varying k, kind=rank reqlog entries that
+        replay bit-identically."""
+        logdir = str(tmp_path / "reqlog")
+        server = serve_game_cli.build_server([
+            "--model-dir", trained["v1"],
+            "--feature-shards", SHARDS,
+            "--port", "0", "--max-batch", "8", "--max-wait-ms", "1",
+            "--rank-item-coordinate", "perSong", "--rank-max-k", "8",
+            "--max-queue", "64", "--request-timeout-ms", "30000",
+            "--brownout-poll-s", "0.2",
+            "--reqlog-dir", logdir, "--reqlog-segment-records", "1",
+        ]).start()
+        try:
+            base = server.url
+            health = self._get(base + "/healthz")[0]
+            assert health["rank"]["items"] == N_SONGS
+            compiles0 = health["rank"]["compiles"]
+
+            sm = server.service.registry.active()
+            order, scores = _brute(sm, {"features": [],
+                                        "metadataMap": {"userId": "u1"},
+                                        "offset": None},
+                                   sm.rank_engine.index.item_ids)
+            out, headers = self._get(base + "/rank?user=u1&k=3")
+            assert out["k"] == 3 and out["version"] == 1
+            assert out["ids"] == [sm.rank_engine.index.item_ids[j]
+                                  for j in order[:3]]
+            got = np.asarray(out["scores"], np.float32)
+            assert np.array_equal(got, scores[order[:3]])
+            assert out["request_id"] == headers["X-Photon-Request-Id"]
+            # deadline echoed like the id
+            out2, headers2 = self._get(
+                base + "/rank?user=u1&k=2",
+                headers={"X-Photon-Deadline-Ms": "30000"})
+            assert 0 < out2["deadline_ms"] <= 30000
+            assert "X-Photon-Deadline-Ms" in headers2
+
+            # POST variant with a full record agrees with GET
+            rec = trained["requests"][1]
+            out3 = self._post(base + "/rank", {"record": rec, "k": 4})
+            ((ids3, s3),) = sm.rank([rec], [4])
+            assert out3["ids"] == ids3
+            assert np.array_equal(np.asarray(out3["scores"], np.float32),
+                                  s3)
+
+            # cold user over HTTP
+            out4, _ = self._get(base + "/rank?user=nobody&k=5")
+            assert len(out4["ids"]) == 5
+
+            # varying k: zero steady-state recompiles, live brownout on
+            for k in (1, 2, 3, 5, 8):
+                self._get(base + f"/rank?user=u0&k={k}")
+            health = self._get(base + "/healthz")[0]
+            assert health["rank"]["compiles"] == compiles0
+            assert health["rank"]["requests"] >= 9
+            assert health["brownout_level"] == 0
+
+            # bad k / missing user → 400, not 500
+            for bad in ("/rank?user=u0&k=0", "/rank?user=u0&k=99",
+                        "/rank?user=u0&k=abc", "/rank?k=3"):
+                with pytest.raises(urllib.error.HTTPError) as err:
+                    self._get(base + bad)
+                assert err.value.code == 400, bad
+        finally:
+            server.stop()
+            server.telemetry.close()
+        # the durable log replays bit-identically (kind=rank entries)
+        import sys
+
+        tools = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools")
+        if tools not in sys.path:
+            sys.path.insert(0, tools)
+        import reqlog_replay
+
+        rc = reqlog_replay.main([
+            "--reqlog-dir", logdir, "--model-dir", trained["v1"],
+            "--feature-shards", SHARDS,
+            "--rank-item-coordinate", "perSong", "--rank-max-k", "8"])
+        assert rc == 0
+        # ...and a tampered top-k is caught
+        from photon_ml_tpu.io.avro import iter_avro_file, write_avro_file
+        from photon_ml_tpu.io.schemas import REQUEST_LOG_AVRO
+
+        segs = sorted(os.listdir(logdir))
+        for name in segs:
+            seg = os.path.join(logdir, name)
+            entries = list(iter_avro_file(seg))
+            if entries and entries[0].get("kind") == "rank":
+                entries[0]["topk"]["scores"][0] += 1.0
+                write_avro_file(seg, entries, REQUEST_LOG_AVRO)
+                break
+        else:
+            pytest.fail("no rank entry in the request log")
+        rc = reqlog_replay.main([
+            "--reqlog-dir", logdir, "--model-dir", trained["v1"],
+            "--feature-shards", SHARDS,
+            "--rank-item-coordinate", "perSong", "--rank-max-k", "8"])
+        assert rc == 1
+
+    def test_rank_disabled_is_400(self, trained):
+        server = serve_game_cli.build_server([
+            "--model-dir", trained["v1"],
+            "--feature-shards", SHARDS,
+            "--port", "0", "--no-warmup",
+        ]).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                self._get(server.url + "/rank?user=u0&k=3")
+            assert err.value.code == 400
+            assert "rank" not in self._get(server.url + "/healthz")[0]
+        finally:
+            server.stop()
+            server.telemetry.close()
+
+    def test_expired_deadline_is_429(self, trained):
+        server = serve_game_cli.build_server([
+            "--model-dir", trained["v1"],
+            "--feature-shards", SHARDS,
+            "--port", "0", "--no-warmup",
+            "--rank-item-coordinate", "perSong", "--rank-max-k", "8",
+        ]).start()
+        try:
+            req = urllib.request.Request(
+                server.url + "/rank?user=u0&k=3",
+                headers={"X-Photon-Deadline-Ms": "0.0001"})
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(req, timeout=60)
+            assert err.value.code == 429
+            body = json.loads(err.value.read())
+            assert body["reason"] == "deadline"
+            assert err.value.headers["Retry-After"]
+        finally:
+            server.stop()
+            server.telemetry.close()
+
+
+class TestBenchRanked:
+    def test_bench_serving_ranked_mode(self, trained, capsys):
+        """tools/bench_serving.py --mode ranked end to end (small load):
+        per-k sweep + open loop + metric parity, clean exit."""
+        import sys
+
+        tools = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools")
+        if tools not in sys.path:
+            sys.path.insert(0, tools)
+        import bench_serving
+
+        bench_serving.main([
+            "--model-dir", trained["v1"],
+            "--feature-shards", SHARDS,
+            "--mode", "ranked", "--requests", "24",
+            "--target-qps", "200", "--concurrency", "4",
+            "--rank-item-coordinate", "perSong", "--rank-max-k", "8",
+            "--rank-ks", "1,3,8",
+        ])
+        lines = [json.loads(line) for line in
+                 capsys.readouterr().out.splitlines()
+                 if line.startswith("{")]
+        by_metric = {ln["metric"]: ln for ln in lines}
+        assert by_metric["serving_ranked_latency_ms"]["per_k"].keys() == \
+            {"1", "3", "8"}
+        open_line = by_metric["serving_ranked_open_loop_latency_ms"]
+        assert open_line["n_errors"] == 0
+        assert open_line["recompiles_during_load"] == 0
+        assert open_line["rank_items"] == N_SONGS
+        summary = by_metric["suite_summary"]
+        assert summary["zero_recompiles"] is True
+        assert summary["metrics_parity"] is True

@@ -23,6 +23,11 @@ traffic in one of two modes:
   ``--slo-p99-ms`` adds a p99 SLO gate on the corrected percentile whose
   ``ok``/``regression`` verdict is produced by ``tools/bench_gate.py``
   (exit 1 on regression).
+- ``--mode ranked --rank-item-coordinate COORD``: the ``/rank``
+  workload (SERVING.md "Ranked retrieval") — a closed-loop k sweep
+  (``--rank-ks``, per-k p50/p99) followed by an open-loop ranked load
+  with shed classification, `photon_rank_*` metric parity for
+  in-process runs, and the same optional p99 SLO gate.
 
 Both modes also report:
 
@@ -254,6 +259,94 @@ def open_loop_run(base: str, pool, sizes, *, target_qps: float,
             "achieved_qps": len(corrected) / wall if wall > 0 else 0.0}
 
 
+def rank_url(base: str, user, k) -> str:
+    import urllib.parse
+
+    return (f"{base}/rank?user={urllib.parse.quote(str(user))}"
+            f"&k={int(k)}")
+
+
+def mixed_open_loop_run(base: str, pool, users, sizes, *,
+                        target_qps: float, requests: int,
+                        ks=(10,), rank_every: int = 0,
+                        concurrency: int = 16,
+                        timeout: float = 60.0) -> dict:
+    """Open-loop load mixing ``POST /score`` and ``GET /rank`` on one
+    fixed arrival schedule (the coordinated-omission-proof generator of
+    :func:`open_loop_run`, per-kind books).
+
+    ``rank_every=0`` sends only scores, ``1`` only ranks, ``N>1`` makes
+    every Nth request a rank. Returns ``{"score": {...}, "rank": {...}}``
+    with per-kind ``offered``/``corrected_ms``/``shed``/``errors``; each
+    kind independently satisfies (and asserts) the accounting identity
+    ``served + shed + errored == offered`` — what the chaos harness
+    checks per kind under injected faults."""
+    lock = threading.Lock()
+    counter = {"i": 0}
+    books = {kind: {"offered": 0, "corrected_ms": [], "uncorrected_ms": [],
+                    "shed": 0, "errors": []} for kind in ("score", "rank")}
+    start = time.perf_counter() + 0.05
+
+    def worker():
+        while True:
+            with lock:
+                i = counter["i"]
+                if i >= requests:
+                    return
+                counter["i"] += 1
+            due = start + i / target_qps
+            now = time.perf_counter()
+            if due > now:
+                time.sleep(due - now)
+            is_rank = bool(rank_every) and i % rank_every == 0
+            kind = "rank" if is_rank else "score"
+            with lock:
+                books[kind]["offered"] += 1
+            t_send = time.perf_counter()
+            try:
+                if is_rank:
+                    out = _http_json(
+                        rank_url(base, users[i % len(users)],
+                                 ks[i % len(ks)]), timeout=timeout)
+                    assert "ids" in out
+                else:
+                    size = sizes[i % len(sizes)]
+                    recs = [pool[(i + j) % len(pool)] for j in range(size)]
+                    out = _http_json(base + "/score", {"records": recs},
+                                     timeout=timeout)
+                    assert len(out["scores"]) == size
+            except urllib.error.HTTPError as e:
+                with lock:
+                    if e.code == 429:
+                        books[kind]["shed"] += 1
+                    else:
+                        books[kind]["errors"].append(f"{kind}: {e!r}")
+                continue
+            except Exception as e:
+                with lock:
+                    books[kind]["errors"].append(f"{kind}: {e!r}")
+                continue
+            t_done = time.perf_counter()
+            with lock:
+                books[kind]["corrected_ms"].append((t_done - due) * 1e3)
+                books[kind]["uncorrected_ms"].append(
+                    (t_done - t_send) * 1e3)
+
+    threads = [threading.Thread(target=worker) for _ in range(concurrency)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    for kind, b in books.items():
+        assert (len(b["corrected_ms"]) + b["shed"] + len(b["errors"])
+                == b["offered"]), (kind, b)
+    books["wall_s"] = wall
+    books["offered"] = requests
+    return books
+
+
 def slo_gate_verdict(corrected_p99_ms: float, slo_p99_ms: float,
                      shed_rate: float = 0.0) -> dict:
     """The p99 SLO as a ``tools/bench_gate.py`` verdict: headroom =
@@ -334,6 +427,197 @@ def _request_pool(args, server):
     return records
 
 
+def _rank_users(server, pool, n: int = 64) -> list:
+    """Probe-user pool for ranked load: the non-item coordinates' raw ids
+    when the server is in-process (plus a cold slice), else ids mined
+    from the request pool's metadata, else synthetic cold users."""
+    users = []
+    if server is not None:
+        sm = server.service.registry.active()
+        eng = sm.rank_engine
+        if eng is not None:
+            for cid in eng._rank_re_order:
+                users.extend(sm.stores[cid].row_of_id)
+    if not users:
+        for rec in pool:
+            users.extend((rec.get("metadataMap") or {}).values())
+    users = list(dict.fromkeys(str(u) for u in users))[:n]
+    # ~1/8 cold users: the unknown-entity path ranks too
+    users.extend(f"__rank_cold_{i}" for i in range(max(len(users) // 8, 1)))
+    return users
+
+
+def run_ranked(args, server, base: str, pool) -> None:
+    """``--mode ranked``: closed-loop k sweep + open-loop ranked load
+    with shed classification — the ranked twin of the score bench.
+    Prints the same one-JSON-line-per-metric artifact and exits non-zero
+    on errors, scrape disparity, or an SLO regression."""
+    users = _rank_users(server, pool)
+    ks = [int(k) for k in args.rank_ks.split(",") if k]
+    health0 = _http_json(base + "/healthz")
+    if "rank" not in health0:
+        raise SystemExit("--mode ranked needs a rank-enabled server "
+                         "(serve_game --rank-item-coordinate, or pass "
+                         "--rank-item-coordinate for in-process spawn)")
+    rank_compiles0 = health0["rank"]["compiles"]
+    metrics0 = _scrape_metrics(base)
+    results, errors = [], []
+
+    def closed_sweep(k, n_req, conc):
+        lats: list = []
+        lk = threading.Lock()
+        cnt = {"i": 0}
+
+        def w():
+            while True:
+                with lk:
+                    if cnt["i"] >= n_req:
+                        return
+                    i = cnt["i"]
+                    cnt["i"] += 1
+                t0 = time.perf_counter()
+                try:
+                    out = _http_json(rank_url(base, users[i % len(users)],
+                                              k))
+                    assert "ids" in out
+                except Exception as e:
+                    with lk:
+                        errors.append(f"k={k}: {e!r}")
+                    continue
+                with lk:
+                    lats.append((time.perf_counter() - t0) * 1e3)
+
+        threads = [threading.Thread(target=w) for _ in range(conc)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return lats
+
+    per_k = {}
+    closed_all: list = []
+    n_per_k = max(args.requests // max(len(ks), 1), 1)
+    t0 = time.perf_counter()
+    for k in ks:
+        lats = closed_sweep(k, n_per_k, args.concurrency)
+        closed_all.extend(lats)
+        per_k[str(k)] = {"n": len(lats),
+                         "p50_ms": round(_percentile(lats, 50), 3),
+                         "p99_ms": round(_percentile(lats, 99), 3)}
+    closed_wall = time.perf_counter() - t0
+    results.append({
+        "metric": "serving_ranked_latency_ms",
+        "value": round(_percentile(closed_all, 50), 3),
+        "unit": "ms p50 (closed-loop GET /rank, k sweep; hides "
+                "coordinated omission — see the open-loop line)",
+        "closed_loop_p50_ms": round(_percentile(closed_all, 50), 3),
+        "closed_loop_p99_ms": round(_percentile(closed_all, 99), 3),
+        "per_k": per_k,
+        "requests_per_sec": round(len(closed_all) / closed_wall, 1)
+        if closed_wall > 0 else 0.0,
+        "n_requests": len(closed_all),
+    })
+    concurrency = args.concurrency if args.concurrency != 4 else 16
+    run = mixed_open_loop_run(
+        base, pool, users, [1], target_qps=args.target_qps,
+        requests=args.requests, ks=ks, rank_every=1,
+        concurrency=concurrency)
+    book = run["rank"]
+    errors.extend(book["errors"])
+    shed_rate = (book["shed"] / book["offered"]) if book["offered"] else 0.0
+    corrected_p99 = _percentile(book["corrected_ms"], 99)
+    health = _http_json(base + "/healthz")
+    metrics1 = _scrape_metrics(base)
+    results.append({
+        "metric": "serving_ranked_open_loop_latency_ms",
+        "value": round(_percentile(book["corrected_ms"], 50), 3),
+        "unit": "ms p50 (open-loop GET /rank, latency-corrected from "
+                "schedule; 429 sheds excluded, reported as shed_rate)",
+        "corrected_p50_ms": round(_percentile(book["corrected_ms"], 50), 3),
+        "corrected_p99_ms": round(corrected_p99, 3),
+        "uncorrected_p99_ms": round(
+            _percentile(book["uncorrected_ms"], 99), 3),
+        "target_qps": args.target_qps,
+        "achieved_qps": round(len(book["corrected_ms"]) / run["wall_s"], 1)
+        if run["wall_s"] > 0 else 0.0,
+        "n_requests": len(book["corrected_ms"]),
+        "n_shed": book["shed"],
+        "shed_rate": round(shed_rate, 4),
+        "n_errors": len(book["errors"]),
+        "ks": ks,
+        "rank_items": health["rank"]["items"],
+        "recompiles_during_load": health["rank"]["compiles"]
+        - rank_compiles0,
+    })
+    slo_line = None
+    if args.slo_p99_ms is not None:
+        slo_line = {"metric": "serving_slo_gate", "workload": "rank"}
+        slo_line.update(slo_gate_verdict(corrected_p99, args.slo_p99_ms,
+                                         shed_rate=shed_rate))
+        results.append(slo_line)
+    parity_failures = []
+    if server is not None and metrics1 is not None:
+        from photon_ml_tpu.telemetry.prometheus import series_value
+
+        # in-process run: the server's /rank books must match the
+        # client's exactly (the request-latency histogram excludes sheds
+        # by contract)
+        done = len(closed_all) + len(book["corrected_ms"])
+        hist = int(series_value(metrics1,
+                                "photon_rank_request_latency_seconds_count")
+                   - series_value(metrics0 or {},
+                                  "photon_rank_request_latency_seconds_count"))
+        if hist != done:
+            parity_failures.append(
+                f"photon_rank_request_latency_seconds counted {hist} "
+                f"requests, client completed {done}")
+        k_count = int(series_value(metrics1, "photon_rank_k_count")
+                      - series_value(metrics0 or {}, "photon_rank_k_count"))
+        if k_count != done:
+            parity_failures.append(
+                f"photon_rank_k counted {k_count}, client completed {done}")
+    if metrics1 is not None:
+        stages = stage_breakdown(metrics0, metrics1)
+        if stages:
+            results.append({
+                "metric": "serving_stage_breakdown",
+                "value": stages.get("execute", {}).get("p50_ms", 0.0),
+                "unit": "ms p50 of the execute stage "
+                        "(photon_serving_stage_seconds deltas)",
+                "stages": stages,
+            })
+    for r in results:
+        print(json.dumps(r), flush=True)
+    head = results[0]
+    print(json.dumps({
+        "metric": "suite_summary",
+        "value": head["value"],
+        "unit": head["unit"],
+        "p99_ms": results[1]["corrected_p99_ms"],
+        "zero_recompiles": results[1]["recompiles_during_load"] == 0,
+        "metrics_parity": (not parity_failures) if metrics1 is not None
+        else None,
+        "slo_verdict": slo_line.get("verdict") if slo_line else None,
+        "shed_rate": results[1]["shed_rate"],
+        "n_errors": len(errors),
+        "wall_s": round(closed_wall + run["wall_s"], 2),
+    }), flush=True)
+    if server is not None:
+        server.stop()
+    if errors:
+        raise SystemExit(f"{len(errors)} failed requests, "
+                         f"first: {errors[0]}")
+    if parity_failures:
+        raise SystemExit("server-side /metrics disagree with the "
+                         "client's measurements: "
+                         + "; ".join(parity_failures))
+    if slo_line is not None and slo_line.get("verdict") == "regression":
+        raise SystemExit(
+            f"p99 SLO gate (/rank): corrected p99 "
+            f"{slo_line['corrected_p99_ms']} ms > SLO "
+            f"{slo_line['slo_p99_ms']} ms")
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
     p.add_argument("--model-dir")
@@ -342,11 +626,14 @@ def main(argv=None):
                                  "of spawning one in-process")
     p.add_argument("--data", help="avro file of records to replay "
                                   "(default: synthesize from the model)")
-    p.add_argument("--mode", choices=["closed", "open"], default="closed",
+    p.add_argument("--mode", choices=["closed", "open", "ranked"],
+                   default="closed",
                    help="closed = workers re-send on completion (hides "
                         "coordinated omission; percentiles labeled "
                         "closed_loop_*); open = fixed --target-qps "
-                        "schedule with latency-corrected percentiles")
+                        "schedule with latency-corrected percentiles; "
+                        "ranked = GET /rank closed-loop k sweep + "
+                        "open-loop load with shed classification")
     p.add_argument("--target-qps", type=float, default=100.0,
                    help="open-loop arrival rate (requests/s)")
     p.add_argument("--slo-p99-ms", type=float, default=None,
@@ -368,6 +655,17 @@ def main(argv=None):
                         "the in-process server (serve_game --max-queue); "
                         "saturating it turns overload into 429 sheds "
                         "reported as shed_rate instead of latency")
+    p.add_argument("--rank-item-coordinate", default=None,
+                   help="enable /rank on the in-process server "
+                        "(serve_game --rank-item-coordinate) — required "
+                        "for --mode ranked unless --url points at a "
+                        "rank-enabled server")
+    p.add_argument("--rank-max-k", type=int, default=128,
+                   help="serve_game --rank-max-k for the in-process "
+                        "server")
+    p.add_argument("--rank-ks", default="1,10,64",
+                   help="comma-separated k sweep for --mode ranked "
+                        "(each k is clamped by the server's max)")
     args = p.parse_args(argv)
 
     server = None
@@ -391,6 +689,10 @@ def main(argv=None):
         ]
         if args.max_queue is not None:
             argv_server += ["--max-queue", str(args.max_queue)]
+        if args.rank_item_coordinate:
+            argv_server += ["--rank-item-coordinate",
+                            args.rank_item_coordinate,
+                            "--rank-max-k", str(args.rank_max_k)]
         server = build_server(argv_server).start()
         base = server.url
 
@@ -398,6 +700,11 @@ def main(argv=None):
     # /healthz long before they can serve — gate the load on /readyz
     wait_ready(base)
     pool = _request_pool(args, server)
+    if args.mode == "ranked":
+        # the ranked workload owns its whole artifact (per-k sweep,
+        # open-loop shed classification, /rank metric parity)
+        run_ranked(args, server, base, pool)
+        return
     cold_refs = None
     if server is not None:
         # per-pool-record count of entity references landing on a store's

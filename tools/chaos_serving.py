@@ -6,14 +6,16 @@ under seeded fault plans — the serving twin of ``chaos_sweep.py``.
 quality intact; this tool proves the REQUEST PATH survives them with its
 books intact. For every ``(seed, rate)`` cell it activates a randomized-
 but-seeded ``FaultPlan`` over the serving injection sites
-(``serving.execute`` fails scoring calls, ``serving.parse`` fails request
-parses) and drives open-loop load (``bench_serving.open_loop_run`` — the
-coordinated-omission-proof generator) against an in-process server,
-asserting:
+(``serving.execute`` fails scoring AND ranking calls, ``serving.parse``
+fails request parses) and drives MIXED open-loop load — every 4th
+request is a ``GET /rank`` (``bench_serving.mixed_open_loop_run``, the
+coordinated-omission-proof generator) — against an in-process
+rank-enabled server, asserting:
 
-- **accounting identity**: every offered request is accounted for exactly
-  once — ``shed + served + errored == offered`` — and the client-observed
-  shed count matches the server's ``photon_shed_total`` delta;
+- **accounting identity, per kind**: every offered request is accounted
+  for exactly once — ``shed + served + errored == offered`` for the
+  score AND the rank books independently — and the client-observed shed
+  total matches the server's ``photon_shed_total`` delta;
 - **no stranded futures**: after the load drains, the microbatcher queue
   is empty, its worker is alive, and a fresh request scores promptly
   (``/readyz`` agrees);
@@ -22,7 +24,10 @@ asserting:
   fault fails one microbatch, not the worker);
 - **incumbent-keeps-serving**: across an injected ``serving.reload``
   fault the ``/reload`` returns 409 and the active version's scores stay
-  BIT-IDENTICAL before/after — delivery faults never corrupt serving.
+  BIT-IDENTICAL before/after — delivery faults never corrupt serving;
+  a pinned ``/rank`` probe's ids+scores must survive every load cell
+  unchanged too (an execute fault fails a rank microbatch, never the
+  worker or the tables).
 
 A failing cell reproduces exactly: the printed plan JSON IS the repro
 (``PHOTON_FAULT_PLAN='<plan>' python -m photon_ml_tpu serve_game ...``).
@@ -154,6 +159,9 @@ def main(argv=None) -> int:
             "--port", "0",
             "--microbatch", "8", "--max-wait-ms", "1",
             "--max-queue", str(args.max_queue),
+            # the ranked path shares the chaos sites: mixed plans must
+            # fail rank batches without killing the worker, too
+            "--rank-item-coordinate", "perUser", "--rank-max-k", "16",
             # brownout has its own tier-1 tests; a live controller would
             # make a cell's shed counts depend on tick timing
             "--brownout-poll-s", "0",
@@ -163,11 +171,20 @@ def main(argv=None) -> int:
         from photon_ml_tpu.io.avro import iter_avro_file
 
         pool = list(iter_avro_file(train_path))[:256]
+        users = list(dict.fromkeys(
+            (rec.get("metadataMap") or {}).get("userId", "u0")
+            for rec in pool))
         probe = {"records": pool[:5]}
         probe_scores = bench_serving._http_json(
             base + "/score", probe)["scores"]
+        # ranked probe pinned alongside the scored one: delivery and
+        # execute faults must never move retrieval either
+        probe_rank_url = bench_serving.rank_url(base, users[0], 5)
+        probe_rank = bench_serving._http_json(probe_rank_url)
+        probe_topk = (probe_rank["ids"], probe_rank["scores"])
         print(f"[chaos-serving] model up at {base}, probe scores pinned "
-              f"({len(probe_scores)} records)", flush=True)
+              f"({len(probe_scores)} records, top-{len(probe_topk[0])} "
+              f"rank)", flush=True)
 
         try:
             for seed in seeds:
@@ -176,26 +193,42 @@ def main(argv=None) -> int:
                     cell = {"seed": seed, "rate": rate, "plan": plan_obj}
                     shed0 = scraped_shed_total(base)
                     with injected(FaultPlan.from_json(plan_obj)):
-                        run = bench_serving.open_loop_run(
-                            base, pool, [1], target_qps=args.target_qps,
-                            requests=requests)
-                    served = len(run["corrected_ms"])
-                    shed, errored = run["shed"], len(run["errors"])
+                        # mixed plan: every 4th request is a GET /rank —
+                        # injected execute faults land on score AND rank
+                        # microbatches
+                        run = bench_serving.mixed_open_loop_run(
+                            base, pool, users, [1],
+                            target_qps=args.target_qps,
+                            requests=requests, ks=(3, 8), rank_every=4)
+                    kinds = {k: run[k] for k in ("score", "rank")}
+                    served = sum(len(b["corrected_ms"])
+                                 for b in kinds.values())
+                    shed = sum(b["shed"] for b in kinds.values())
+                    errored = sum(len(b["errors"]) for b in kinds.values())
                     ready = settle(server, base)
                     shed_delta = scraped_shed_total(base) - shed0
                     probe_after = bench_serving._http_json(
                         base + "/score", probe)["scores"]
+                    rank_after = bench_serving._http_json(probe_rank_url)
                     cell.update(
                         offered=run["offered"], served=served, shed=shed,
                         errored=errored, error_rate=errored / run["offered"],
+                        per_kind={k: {"offered": b["offered"],
+                                      "served": len(b["corrected_ms"]),
+                                      "shed": b["shed"],
+                                      "errored": len(b["errors"])}
+                                  for k, b in kinds.items()},
                         shed_metric_delta=shed_delta,
                         queue_depth_after=ready["queue_depth"],
                         ready_after=ready["ready"])
                     problems = []
-                    if served + shed + errored != run["offered"]:
-                        problems.append(
-                            f"accounting broke: {served}+{shed}+{errored} "
-                            f"!= {run['offered']}")
+                    for kind, b in kinds.items():
+                        if (len(b["corrected_ms"]) + b["shed"]
+                                + len(b["errors"]) != b["offered"]):
+                            problems.append(
+                                f"{kind} accounting broke: "
+                                f"{len(b['corrected_ms'])}+{b['shed']}+"
+                                f"{len(b['errors'])} != {b['offered']}")
                     if shed_delta != shed:
                         problems.append(
                             f"photon_shed_total moved {shed_delta}, client "
@@ -207,18 +240,24 @@ def main(argv=None) -> int:
                     if not ready["ready"] or ready["queue_depth"] != 0:
                         problems.append(
                             f"stranded work after drain: readyz={ready}")
-                    if server.service.batcher.dead is not None:
-                        problems.append(
-                            f"batcher worker died: "
-                            f"{server.service.batcher.dead!r}")
+                    for name, batcher in (
+                            ("batcher", server.service.batcher),
+                            ("rank batcher", server.service.rank_batcher)):
+                        if batcher is not None and batcher.dead is not None:
+                            problems.append(
+                                f"{name} worker died: {batcher.dead!r}")
                     if probe_after != probe_scores:
                         problems.append(
                             "probe scores changed under load faults")
+                    if (rank_after["ids"], rank_after["scores"]) != probe_topk:
+                        problems.append(
+                            "probe top-k changed under load faults")
                     cell["ok"] = not problems
                     cells.append(cell)
                     print(f"[chaos-serving] seed={seed} rate={rate}: "
                           f"offered={run['offered']} served={served} "
                           f"shed={shed} errored={errored} "
+                          f"(rank {kinds['rank']['offered']} offered) "
                           f"{'ok' if cell['ok'] else 'FAIL'}", flush=True)
                     if problems:
                         failures.append(

@@ -13,6 +13,12 @@ traffic after the fact. A mismatch means either the model dir does not
 hold the lineage the log names (wrong artifact) or the score path broke
 determinism (a real bug).
 
+Ranked requests (``kind="rank"`` entries, from ``GET /rank``) replay
+too: the logged REQUEST record is re-ranked through the named lineage
+with ``--rank-item-coordinate`` and the returned top-k ids AND scores
+must come back bit-identical (without the flag they are counted
+``skipped_unrankable``).
+
 Requests logged under a DIFFERENT lineage than the loaded model (traffic
 that straddled a hot-swap) are skipped and counted — replay them against
 their own model dir. Requests with no recorded lineage replay too unless
@@ -50,8 +56,9 @@ def replay(reqlog_dir: str, registry, *, require_lineage: bool = False,
 
     sm = registry.active()
     lineage = sm.lineage
-    summary = {"replayed": 0, "matched": 0, "mismatched": 0,
-               "skipped_lineage": 0, "lineage": lineage}
+    summary = {"replayed": 0, "replayed_rank": 0, "matched": 0,
+               "mismatched": 0, "skipped_lineage": 0,
+               "skipped_unrankable": 0, "lineage": lineage}
     reports = []
     for entry in iter_reqlog(reqlog_dir):
         logged_lineage = entry.get("modelLineage")
@@ -64,6 +71,36 @@ def replay(reqlog_dir: str, registry, *, require_lineage: bool = False,
         records = [{"features": r["features"],
                     "metadataMap": r["metadataMap"],
                     "offset": r["offset"]} for r in entry["records"]]
+        if entry.get("kind") == "rank":
+            # ranked request: records hold the REQUEST record; the served
+            # result is the topk block — re-rank and compare ids AND
+            # scores bit-identically (same tie-break, same k)
+            if sm.rank_engine is None:
+                summary["skipped_unrankable"] += 1
+                continue
+            topk = entry["topk"] or {"k": 0, "ids": [], "scores": []}
+            ((ids, scores),) = sm.rank(records[:1],
+                                       [max(int(topk["k"]), 1)])
+            logged_ids = [str(i) for i in topk["ids"]]
+            logged = np.asarray(topk["scores"], np.float64)
+            got = np.asarray(scores, np.float32).astype(np.float64)
+            summary["replayed"] += 1
+            summary["replayed_rank"] += 1
+            if list(ids) == logged_ids and np.array_equal(got, logged):
+                summary["matched"] += 1
+            else:
+                summary["mismatched"] += 1
+                if len(reports) < max_report:
+                    reports.append({
+                        "metric": "reqlog_replay_mismatch",
+                        "kind": "rank",
+                        "request_id": entry["requestId"],
+                        "logged_ids": logged_ids,
+                        "replayed_ids": list(ids),
+                        "logged": [float(x) for x in logged],
+                        "replayed": [float(x) for x in got],
+                    })
+            continue
         logged = np.array([r["score"] for r in entry["records"]], np.float64)
         got = np.asarray(sm.score(records), np.float32).astype(np.float64)
         summary["replayed"] += 1
@@ -102,6 +139,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     p.add_argument("--require-lineage", action="store_true",
                    help="skip (instead of replaying) requests logged "
                         "without a model lineage")
+    p.add_argument("--rank-item-coordinate", default=None,
+                   help="the server's --rank-item-coordinate — required "
+                        "to replay kind=rank entries (without it they "
+                        "are counted skipped_unrankable)")
+    p.add_argument("--rank-max-k", type=int, default=128,
+                   help="the server's --rank-max-k")
     args = p.parse_args(argv)
 
     import jax
@@ -116,7 +159,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     shard_configs = tuple(parse_feature_shard_config(s)
                           for s in args.feature_shards.split(","))
-    registry = ModelRegistry(shard_configs, table_dtype=args.table_dtype)
+    registry = ModelRegistry(shard_configs, table_dtype=args.table_dtype,
+                             rank_coordinate=args.rank_item_coordinate,
+                             rank_max_k=args.rank_max_k)
     registry.load(args.model_dir)
     summary = replay(args.reqlog_dir, registry,
                      require_lineage=args.require_lineage)
