@@ -1387,6 +1387,86 @@ def bench_serving_slo():
           verdict["headroom"], **extras)
 
 
+def bench_serving_fleet():
+    """Open-loop fleet serving bench (the ISSUE 15 workload): the same
+    tiny GAME model served from TWO entity-sharded hosts behind the
+    fleet router (``cli/serve_fleet.py``), open-loop /score load through
+    the router. The metric is achieved requests/s; ``vs_baseline`` is
+    the p99 SLO headroom (``PHOTON_FLEET_SLO_P99_MS``, default 250 ms —
+    one extra local HTTP hop vs the single-host SLO). This is the number
+    BENCH_r06 sizes the fleet against: compare with
+    ``serving_open_loop_qps`` to read the router tax, and the per-host
+    entity counts in the extras to read the table-byte split."""
+    import argparse
+    import tempfile
+
+    from photon_ml_tpu.cli import serve_fleet as serve_fleet_cli
+    from photon_ml_tpu.cli import train_game as train_game_cli
+
+    bench_serving = _tools_module("bench_serving")
+    slo_ms = float(os.environ.get("PHOTON_FLEET_SLO_P99_MS", 250.0))
+    train = _cached_fixture("serving", _write_e2e_file, SERVING_ROWS,
+                            SERVING_USERS, SERVING_SONGS)
+    shards = "global=g|intercept,item=it|noIntercept"
+    with tempfile.TemporaryDirectory() as tmp:
+        out = os.path.join(tmp, "model")
+        train_game_cli.run([
+            "--training-data", train,
+            "--output-dir", out,
+            "--feature-shards", shards,
+            "--coordinates",
+            "global=fixed,shard=global,reg=L2,maxIter=25",
+            ("perUser=random,entity=userId,shard=item,reg=L2,maxIter=25,"
+             "buckets=histogram,maxSampleBuckets=4"),
+            "--update-sequence", "global,perUser",
+            "--grid", "global=0.001", "perUser=1",
+            "--data-validation", "VALIDATE_DISABLED",
+            "--evaluators", "",
+        ])
+        _heartbeat()
+        fleet = serve_fleet_cli.build_fleet([
+            "--model-dir", out, "--feature-shards", shards,
+            "--port", "0", "--max-wait-ms", "1", "--fleet-shards", "2",
+        ])
+        try:
+            pool = bench_serving.fleet_request_pool(
+                argparse.Namespace(data=None, pool=128), fleet)
+            compiles0 = [bench_serving._http_json(u + "/healthz")["compiles"]
+                         for u in fleet.host_urls()]
+            run = bench_serving.open_loop_run(
+                fleet.url, pool, [1, 1, 1, 2, 4],
+                target_qps=SERVING_TARGET_QPS, requests=SERVING_REQUESTS,
+                concurrency=16)
+            compiles1 = [bench_serving._http_json(u + "/healthz")["compiles"]
+                         for u in fleet.host_urls()]
+            entities = [
+                sum(s.n_entities
+                    for s in h.service.registry.active().stores.values())
+                for h in fleet.hosts]
+        finally:
+            fleet.stop()
+        _heartbeat()
+    corrected_p99 = bench_serving._percentile(run["corrected_ms"], 99)
+    verdict = bench_serving.slo_gate_verdict(
+        corrected_p99, slo_ms,
+        shed_rate=run["shed"] / max(run["offered"], 1))
+    _emit("serving_fleet_qps", run["achieved_qps"],
+          "req/s (open loop /score through the fleet router, 2 local "
+          "entity-sharded hosts, latency-corrected percentiles)",
+          verdict["headroom"],
+          corrected_p50_ms=round(
+              bench_serving._percentile(run["corrected_ms"], 50), 3),
+          corrected_p99_ms=round(corrected_p99, 3),
+          target_qps=SERVING_TARGET_QPS,
+          n_shards=2,
+          entities_per_host=entities,
+          recompiles_during_load=[c1 - c0 for c0, c1
+                                  in zip(compiles0, compiles1)],
+          n_shed=run["shed"], n_errors=len(run["errors"]),
+          n_reconnected=run["reconnected"],
+          slo_p99_ms=slo_ms, slo_verdict=verdict["verdict"])
+
+
 RANKED_KS = (1, 10, 64)
 
 
@@ -1542,7 +1622,7 @@ def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--only",
                    choices=["glm", "re", "re_sweep", "cd", "ingest", "e2e",
-                            "refresh", "serving", "ranked"],
+                            "refresh", "serving", "ranked", "fleet"],
                    help="run a single benchmark instead of the full suite")
     args = p.parse_args(argv)
     _setup_compile_cache()
@@ -1570,7 +1650,8 @@ def main(argv=None):
              "ingest": bench_ingest, "e2e": bench_end_to_end,
              "refresh": bench_refresh,
              "serving": bench_serving_slo,
-             "ranked": bench_serving_ranked}[args.only]()
+             "ranked": bench_serving_ranked,
+             "fleet": bench_serving_fleet}[args.only]()
         finally:
             _emit_summary()
         return
@@ -1612,6 +1693,8 @@ def main(argv=None):
         bench_serving_slo()
         drain()
         bench_serving_ranked()
+        drain()
+        bench_serving_fleet()
         drain()
         bench_re_sweep()
         drain()

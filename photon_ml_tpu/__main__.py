@@ -16,6 +16,7 @@ _DRIVERS = {
     "refresh_game": "photon_ml_tpu.cli.refresh_game",
     "score_game": "photon_ml_tpu.cli.score_game",
     "serve_game": "photon_ml_tpu.cli.serve_game",
+    "serve_fleet": "photon_ml_tpu.cli.serve_fleet",
     "build_index": "photon_ml_tpu.cli.build_index",
 }
 

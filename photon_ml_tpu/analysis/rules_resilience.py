@@ -219,6 +219,53 @@ def check_table_home(ctx: FileContext):
             "store.gather_rows / device_params")
 
 
+#: the one module allowed to call crc32 (it owns identity bucketing:
+#: entity→shard placement, request-log sampling, probe selection, fault
+#: seeding all derive from its one hash)
+SHARD_HOME = {os.path.join("photon_ml_tpu", "fleet", "sharding.py")}
+
+#: crc32 over raw BYTES for Avro container integrity is a checksum, not
+#: an identity bucket — the codec keeps its own call
+SHARD_EXEMPT = {os.path.join("photon_ml_tpu", "io", "avro.py")}
+
+
+def _is_crc32_call(node: ast.AST, zlib_aliases: set[str],
+                   binascii_aliases: set[str],
+                   crc_names: set[str]) -> bool:
+    """True for ``zlib.crc32(..)`` / ``binascii.crc32(..)`` calls
+    (module- and from-import aliases included)."""
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    if isinstance(fn, ast.Attribute) and fn.attr == "crc32":
+        return (isinstance(fn.value, ast.Name)
+                and fn.value.id in zlib_aliases | binascii_aliases)
+    if isinstance(fn, ast.Name):
+        return fn.id in crc_names
+    return False
+
+
+@rule("res-shard-home",
+      "entity→shard hashing primitives (crc32 bucketing) stay in "
+      "fleet/sharding.py")
+def check_shard_home(ctx: FileContext):
+    if ctx.path in {os.path.normpath(p) for p in SHARD_HOME | SHARD_EXEMPT}:
+        return
+    zlib_aliases = ctx.module_aliases("zlib")
+    binascii_aliases = ctx.module_aliases("binascii")
+    crc_names = (ctx.from_aliases("zlib", "crc32")
+                 | ctx.from_aliases("binascii", "crc32"))
+    for node in ast.walk(ctx.tree):
+        if _is_crc32_call(node, zlib_aliases, binascii_aliases, crc_names):
+            yield ctx.finding(
+                "res-shard-home", node,
+                "crc32 call outside fleet/sharding.py — identity "
+                "bucketing (entity→shard placement, id sampling) must "
+                "come from the one hashing home or two components can "
+                "silently disagree on which host owns an id; call "
+                "fleet.sharding.shard_of_id/crc_bucket/stable_hash_u32")
+
+
 #: serving/ — the one package where every queue must be bounded (the
 #: admission-control contract: overload sheds loudly, it never queues
 #: forever)

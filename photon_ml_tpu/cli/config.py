@@ -558,6 +558,70 @@ def rank_from_args(args) -> RankConfig:
                       max_k=args.rank_max_k)
 
 
+# ---------------------------------------------------------------------------
+# Fleet-routing configuration (serve_fleet; shard flags on serve_game)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class RouterConfig:
+    """The fleet router's knobs (``serve_fleet``), round-trippable through
+    a JSON config file like :class:`ResilienceConfig`.
+
+    ``fleet_shards`` is N — how many entity-sharded hosts the router
+    fronts (each must serve ``--fleet-shard I --fleet-shard-count N``);
+    ``fanout_timeout_s`` bounds each per-host leg (a slower host becomes
+    a typed 503 ``reason=upstream``, never a hang);
+    ``request_timeout_ms`` is the router-side default deadline for
+    requests carrying no ``X-Photon-Deadline-Ms`` of their own (0 =
+    none), propagated to hosts as the REMAINING budget.
+    """
+
+    fleet_shards: int = 2
+    fanout_timeout_s: float = 30.0
+    request_timeout_ms: float = 0.0
+
+    def __post_init__(self):
+        if self.fleet_shards < 1:
+            raise ValueError(f"fleet_shards must be >= 1, "
+                             f"got {self.fleet_shards}")
+        if self.fanout_timeout_s <= 0:
+            raise ValueError(f"fanout_timeout_s must be > 0, "
+                             f"got {self.fanout_timeout_s}")
+
+    # --- config-file round-trip ------------------------------------------
+    def as_dict(self) -> dict:
+        return {"fleetShards": self.fleet_shards,
+                "fanoutTimeoutS": self.fanout_timeout_s,
+                "requestTimeoutMs": self.request_timeout_ms}
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "RouterConfig":
+        return cls(fleet_shards=int(d.get("fleetShards", 2)),
+                   fanout_timeout_s=float(d.get("fanoutTimeoutS", 30.0)),
+                   request_timeout_ms=float(d.get("requestTimeoutMs", 0.0)))
+
+
+def add_router_flags(parser) -> None:
+    """The serve_fleet routing-tier flags (SERVING.md "Fleet serving")."""
+    parser.add_argument(
+        "--fleet-shards", type=int, default=2, metavar="N",
+        help="how many entity-sharded serving hosts to launch behind the "
+             "router: raw entity ids hash to shards via "
+             "fleet/sharding.py, each host packs only its ~1/N slice of "
+             "every dense coefficient table")
+    parser.add_argument(
+        "--fanout-timeout-s", type=float, default=30.0,
+        help="per-host fan-out leg timeout; a slower or dead host maps "
+             "to a typed 503 (reason=upstream) instead of a hang")
+
+
+def router_from_args(args) -> RouterConfig:
+    return RouterConfig(fleet_shards=args.fleet_shards,
+                        fanout_timeout_s=args.fanout_timeout_s,
+                        request_timeout_ms=args.request_timeout_ms)
+
+
 def parse_grid(specs: Sequence[str]) -> list[Mapping[str, float]]:
     """``coordId=0.1;1;10`` groups → cartesian product of per-coordinate
     lambda lists (the reference's hyperparameter grid)."""

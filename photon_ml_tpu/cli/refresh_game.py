@@ -84,6 +84,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-patch", action="store_true",
                    help="skip the coefficient-patch artifact (full model "
                         "dir only)")
+    p.add_argument("--fleet-shards", type=int, default=0, metavar="N",
+                   help="ALSO publish N per-host patches (patch-shard-I/ "
+                        "next to patch/): the touched entity set is "
+                        "partitioned by the same raw-id hash serving "
+                        "shards by (fleet/sharding.py), each patch "
+                        "carries ONLY that shard's rows plus the "
+                        "always-retrained fixed effect, and its metadata "
+                        "names the shard (fleetShard/fleetShardCount) so "
+                        "a host refuses a foreign shard's patch. 0 "
+                        "(default) = global patch only")
     add_resilience_flags(p)
     add_telemetry_flags(p)
     return p
@@ -276,6 +286,7 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
 
         # --- publish: the entity-level coefficient patch ----------------
         patch_dir = None
+        shard_patch_dirs: list = []
         if not args.no_patch:
             patch_dir = os.path.join(args.output_dir, "patch")
             reverse = {t: {v: k for k, v in vocabs[t].items()}
@@ -284,22 +295,54 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
             for cid, dense_ids in result.removed.items():
                 t = re_coords[cid][0]
                 removed_raw[cid] = [reverse[t][int(e)] for e in dense_ids]
+            model_id = model_lineage_id(best_dir)
+            patch_lineage = {"trainedAt": trained_at,
+                             "dataManifest": manifest_dig}
             with timed("Publish patch", run_logger):
                 patch_bytes = save_model_patch_atomic(
                     patch_dir, result.patch, index_maps, vocabs,
                     task=task, parent_model=prior_lineage,
-                    model_id=model_lineage_id(best_dir),
+                    model_id=model_id,
                     removed=removed_raw,
-                    lineage={"trainedAt": trained_at,
-                             "dataManifest": manifest_dig},
+                    lineage=patch_lineage,
                     sparsity_threshold=args.model_sparsity_threshold)
             patch_bytes_counter().inc(patch_bytes)
             run_logger.metric(stage="patch", bytes=patch_bytes,
                               coordinates=sorted(result.patch))
+            if args.fleet_shards > 0:
+                # per-host patches for an entity-sharded serving fleet:
+                # the SAME hash serving packed by partitions the touched
+                # set, every shard's patch names itself (fleetShard) and
+                # chains to the SAME merged model id — after each host
+                # applies its own patch, the fleet's lineage is uniform
+                from photon_ml_tpu.continuous.refresh import (
+                    partition_patch_by_shard,
+                )
+
+                parts = partition_patch_by_shard(
+                    result.patch, removed_raw, vocabs, args.fleet_shards)
+                with timed("Publish fleet patches", run_logger):
+                    for shard, (models, rm) in enumerate(parts):
+                        sdir = os.path.join(args.output_dir,
+                                            f"patch-shard-{shard}")
+                        sbytes = save_model_patch_atomic(
+                            sdir, models, index_maps, vocabs,
+                            task=task, parent_model=prior_lineage,
+                            model_id=model_id, removed=rm,
+                            lineage=patch_lineage,
+                            sparsity_threshold=(
+                                args.model_sparsity_threshold),
+                            fleet_shard=(shard, args.fleet_shards))
+                        patch_bytes_counter().inc(sbytes)
+                        shard_patch_dirs.append(sdir)
+                        run_logger.metric(
+                            stage="patch", shard=shard,
+                            of=args.fleet_shards, bytes=sbytes)
 
         out = {
             "output_dir": args.output_dir,
             "patch_dir": patch_dir,
+            "shard_patch_dirs": shard_patch_dirs,
             "parent_model": prior_lineage,
             "touched": {cid: st.touched
                         for cid, st in result.stats.items()},

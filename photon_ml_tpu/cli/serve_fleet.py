@@ -1,0 +1,192 @@
+"""Fleet serving driver: ``python -m photon_ml_tpu serve_fleet``.
+
+Launches a local N-host serving fleet in ONE process — N entity-sharded
+``serve_game`` servers (each packing its 1/N slice of every dense
+coefficient table) behind a :class:`~photon_ml_tpu.fleet.router.
+FleetRouter` — and serves the router's endpoints (``/score`` ``/rank``
+``/healthz`` ``/readyz`` ``/metrics`` ``/reload``). This is the test and
+bench topology (and the "does sharding change my scores?" audit tool: it
+must not — f32 responses are bit-identical to an unsharded server). A
+production fleet runs the same pieces across machines: one ``serve_game
+--fleet-shard I --fleet-shard-count N`` per host, one router pointed at
+their URLs; nothing in the protocol assumes shared memory.
+
+In-process hosts share the process-global telemetry registry and
+brownout state, so the per-host brownout controllers stay OFF here (a
+distributed fleet keeps them: each machine degrades on its own
+pressure); the router's ``/metrics`` still folds every host's snapshot
+with host-owned gauges fanned out per shard.
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional, Sequence
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="photon_ml_tpu serve_fleet",
+        description="Serve a saved GAME model from an entity-sharded "
+                    "N-host fleet behind one router")
+    p.add_argument("--model-dir", required=True,
+                   help="a train_game output dir; every host loads it, "
+                        "packing only its shard's entity rows")
+    p.add_argument("--feature-shards", required=True,
+                   help="same shard specs used at training time")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8080,
+                   help="router port; 0 = ephemeral (the test/bench "
+                        "mode). Hosts always bind ephemeral ports")
+    p.add_argument("--max-batch", type=int, default=1024)
+    p.add_argument("--table-dtype",
+                   choices=["float32", "bfloat16", "int8"],
+                   default="float32",
+                   help="per-host table storage dtype (serve_game "
+                        "--table-dtype); composes with sharding — int8 "
+                        "at N hosts is ~N×4 less resident bytes than one "
+                        "f32 host")
+    p.add_argument("--microbatch", type=int, default=64)
+    p.add_argument("--max-wait-ms", type=float, default=2.0)
+    p.add_argument("--max-queue", type=int, default=1024)
+    p.add_argument("--request-timeout-ms", type=float, default=0.0,
+                   help="router-side default deadline for requests with "
+                        "no X-Photon-Deadline-Ms; the REMAINING budget "
+                        "is propagated to every fan-out leg")
+    p.add_argument("--no-warmup", action="store_true")
+    p.add_argument("--rank-item-coordinate", default=None, metavar="COORD",
+                   help="enable fleet /rank: every host indexes its item "
+                        "shard, the router merges per-shard top-k "
+                        "(requires the item coordinate to be the only "
+                        "random effect)")
+    p.add_argument("--rank-max-k", type=int, default=128)
+    from photon_ml_tpu.cli.config import (
+        add_router_flags,
+        add_telemetry_flags,
+    )
+
+    add_router_flags(p)
+    add_telemetry_flags(p)
+    return p
+
+
+class FleetHandle:
+    """The started fleet: router server + N host servers, one stop()."""
+
+    def __init__(self, router_server, hosts, telemetry):
+        self.router_server = router_server
+        self.hosts = hosts
+        self.telemetry = telemetry
+
+    @property
+    def url(self) -> str:
+        return self.router_server.url
+
+    @property
+    def router(self):
+        return self.router_server.router
+
+    def host_urls(self) -> list:
+        return [h.url for h in self.hosts]
+
+    def serve_forever(self) -> None:
+        self.router_server.serve_forever()
+
+    def stop(self) -> None:
+        self.router_server.stop()
+        for host in self.hosts:
+            host.stop()
+        self.telemetry.close()
+
+
+def build_fleet(argv: Optional[Sequence[str]] = None) -> FleetHandle:
+    """Parse flags → started (router + N hosts) fleet, router not yet
+    serving-forever (the programmatic/test entry)."""
+    args = build_parser().parse_args(argv)
+    from photon_ml_tpu.cli.config import (
+        install_telemetry,
+        router_from_args,
+        telemetry_from_args,
+    )
+
+    telemetry = install_telemetry(telemetry_from_args(args))
+    config = router_from_args(args)
+
+    from photon_ml_tpu.cli import serve_game
+    from photon_ml_tpu.fleet.router import FleetRouter, RouterServer
+    from photon_ml_tpu.fleet.sharding import shard_counts
+
+    n = config.fleet_shards
+    host_argv_common = [
+        "--model-dir", args.model_dir,
+        "--feature-shards", args.feature_shards,
+        "--host", args.host, "--port", "0",
+        "--max-batch", str(args.max_batch),
+        "--table-dtype", args.table_dtype,
+        "--microbatch", str(args.microbatch),
+        "--max-wait-ms", str(args.max_wait_ms),
+        "--max-queue", str(args.max_queue),
+        # brownout state is process-global; N in-process hosts sharing it
+        # would shed each other's work — controllers stay off in the
+        # single-process topology (a distributed fleet keeps them on)
+        "--brownout-poll-s", "0",
+        "--fleet-shard-count", str(n),
+    ]
+    if args.no_warmup:
+        host_argv_common.append("--no-warmup")
+    if args.rank_item_coordinate:
+        host_argv_common += ["--rank-item-coordinate",
+                             args.rank_item_coordinate,
+                             "--rank-max-k", str(args.rank_max_k)]
+    hosts = []
+    try:
+        for i in range(n):
+            hosts.append(serve_game.build_server(
+                host_argv_common + ["--fleet-shard", str(i)]).start())
+        router = FleetRouter(
+            [h.url for h in hosts],
+            fanout_timeout_s=config.fanout_timeout_s,
+            default_timeout_ms=config.request_timeout_ms)
+        server = RouterServer(router, host=args.host, port=args.port)
+    except BaseException:
+        for h in hosts:
+            h.stop()
+        telemetry.close()
+        raise
+    # startup balance check: heavy skew means constant/duplicated ids,
+    # not bad luck — surface it in the driver log, never fail serving
+    sample_store = next(iter(
+        hosts[0].service.registry.active().stores.values()), None)
+    handle = FleetHandle(server.start(), hosts, telemetry)
+    if sample_store is not None:
+        import logging
+
+        all_ids = set()
+        for h in hosts:
+            for store in h.service.registry.active().stores.values():
+                all_ids.update(store.row_of_id)
+        logging.getLogger(__name__).info(
+            "fleet shard balance (entities/host): %s",
+            shard_counts(sorted(all_ids), n))
+    return handle
+
+
+def run(argv: Optional[Sequence[str]] = None) -> dict:
+    fleet = build_fleet(argv)
+    rank_on = bool(fleet.hosts[0].service.registry.rank_coordinate)
+    endpoints = ("/score" + (" /rank" if rank_on else "")
+                 + " /healthz /readyz /metrics /reload")
+    print(f"serving GAME fleet ({len(fleet.hosts)} shards) on "
+          f"{fleet.url} ({endpoints}); hosts: "
+          f"{', '.join(fleet.host_urls())}", flush=True)
+    try:
+        fleet.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        fleet.stop()
+    return {"url": fleet.url, "hosts": fleet.host_urls()}
+
+
+if __name__ == "__main__":
+    run()

@@ -74,6 +74,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-warmup", action="store_true",
                    help="skip pre-compiling the bucket executables at "
                         "startup (first requests then pay the compiles)")
+    p.add_argument("--fleet-shard", type=int, default=None, metavar="I",
+                   help="serve fleet shard I of --fleet-shard-count N: "
+                        "the dense per-entity tables pack ONLY the raw "
+                        "ids hashing to this shard "
+                        "(fleet/sharding.py::shard_of_id) — ~1/N of the "
+                        "device bytes per host — and per-host patches "
+                        "from refresh_game --fleet-shards are refused "
+                        "unless their fleetShard matches. Put a "
+                        "serve_fleet router in front (SERVING.md 'Fleet "
+                        "serving'). Default: unsharded")
+    p.add_argument("--fleet-shard-count", type=int, default=None,
+                   metavar="N",
+                   help="the fleet's shard count (required with "
+                        "--fleet-shard)")
     p.add_argument("--watch-dir", metavar="DIR",
                    help="poll DIR for new model versions — full "
                         "train_game/refresh_game output dirs OR "
@@ -153,12 +167,19 @@ def build_server(argv: Optional[Sequence[str]] = None):
     rank = rank_from_args(args)
     shard_configs = tuple(parse_feature_shard_config(s)
                           for s in args.feature_shards.split(","))
+    fleet_shard = None
+    if args.fleet_shard is not None or args.fleet_shard_count is not None:
+        if args.fleet_shard is None or args.fleet_shard_count is None:
+            raise SystemExit("--fleet-shard and --fleet-shard-count go "
+                             "together (I of N)")
+        fleet_shard = (args.fleet_shard, args.fleet_shard_count)
     registry = ModelRegistry(shard_configs, max_batch=args.max_batch,
                              warmup=not args.no_warmup,
                              table_dtype=args.table_dtype,
                              canary=quality.canary(),
                              rank_coordinate=rank.item_coordinate,
-                             rank_max_k=rank.max_k)
+                             rank_max_k=rank.max_k,
+                             fleet_shard=fleet_shard)
     registry.load(args.model_dir)
     batcher = None
     if args.microbatch > 0:

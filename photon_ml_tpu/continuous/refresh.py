@@ -123,6 +123,59 @@ class RefreshResult:
     final_evaluation: object = None
 
 
+def partition_patch_by_shard(patch: Mapping[str, object],
+                             removed_raw: Mapping[str, Sequence[str]],
+                             vocabs: Mapping[str, Mapping[str, int]],
+                             n_shards: int) -> list:
+    """Split one refresh's coefficient patch into N per-host patches for
+    an entity-sharded serving fleet (``refresh_game --fleet-shards N``).
+
+    Shard ``i``'s patch carries: every fixed-effect coordinate's model IN
+    FULL (the fixed effect is replicated on every host — all hosts must
+    take the retrained one), and each random-effect coordinate's partial
+    model restricted to the re-solved entities whose raw ids hash to
+    shard ``i`` (``fleet/sharding.py::shard_of_id`` — the SAME function
+    the serving store packed by, so a host is offered exactly the rows it
+    owns and nothing else). ``removed_raw`` raw ids partition the same
+    way. Returns ``[(patch_models, removed), ...]`` indexed by shard.
+
+    The partition is exact: every touched entity lands in exactly one
+    shard's patch, and concatenating the N patches reproduces the global
+    one — per-host activation equals global activation, host by host.
+    """
+    from photon_ml_tpu.fleet.sharding import shard_of_id
+
+    out = []
+    for shard in range(int(n_shards)):
+        models: dict[str, object] = {}
+        removed: dict[str, list] = {}
+        for cid, model in patch.items():
+            if not isinstance(model, RandomEffectModel):
+                models[cid] = model  # fixed effect: replicated everywhere
+                continue
+            reverse = {int(d): raw
+                       for raw, d in vocabs[model.random_effect_type].items()}
+            keys = np.asarray(model.keys, np.int64)
+            ent = keys // model.dim
+            mask = np.fromiter(
+                (shard_of_id(reverse[int(e)], n_shards) == shard
+                 for e in ent), bool, count=len(ent)) \
+                if len(ent) else np.zeros(0, bool)
+            models[cid] = dataclasses.replace(
+                model, keys=keys[mask],
+                coeffs=np.asarray(model.coeffs)[mask],
+                variances=(None if model.variances is None
+                           else np.asarray(model.variances)[mask]),
+                coeffs_device=None)
+        for cid, raws in (removed_raw or {}).items():
+            mine = [raw for raw in raws
+                    if shard_of_id(raw, n_shards) == shard]
+            if mine:
+                removed[cid] = mine
+        out.append((models, removed))
+    return out
+
+
 def _masked_view(data: GameData, re_type: str,
                  touched: np.ndarray) -> tuple[GameData, np.ndarray]:
     """A view of ``data`` where every entity NOT in ``touched`` reads as

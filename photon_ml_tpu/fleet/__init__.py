@@ -1,0 +1,32 @@
+"""Fleet-scale serving: entity-sharded stores behind a thin routing tier.
+
+One serving process holds one shard (``1/N``) of every random-effect
+coordinate's dense coefficient table (``serve_game --fleet-shard I
+--fleet-shard-count N``); a stdlib-HTTP router in front resolves each
+record's shard from its raw entity ids, fans out over persistent per-host
+connections, and merges per-coordinate margins through the same
+``sum_coordinate_margins`` reduction the single-host engine runs — f32
+scores stay bit-identical to an unsharded server. Model rollout is a
+coordinated two-phase ``/reload`` (every host validates + canaries the
+candidate, the router gates once, then activates everywhere; any refusal
+aborts the epoch with the incumbent serving fleet-wide), so a fleet never
+serves mixed lineages. See SERVING.md "Fleet serving".
+
+- :mod:`~photon_ml_tpu.fleet.sharding` — the ONE deterministic
+  entity-id→shard hashing home (lint rule ``res-shard-home``).
+- :mod:`~photon_ml_tpu.fleet.router` — the routing tier: ``/score`` /
+  ``/rank`` fan-out + merge, two-phase ``/reload``, fleet-folded
+  ``GET /metrics`` (via :mod:`photon_ml_tpu.telemetry.aggregate`), and
+  the ``fleet.fanout`` chaos site.
+- ``python -m photon_ml_tpu serve_fleet`` — launch router + N local
+  hosts in one process (the test/bench topology; production runs one
+  ``serve_game --fleet-shard`` per machine plus a router).
+"""
+
+from photon_ml_tpu.fleet.sharding import (  # noqa: F401
+    crc_bucket,
+    owns_id,
+    partition_by_shard,
+    shard_of_id,
+    stable_hash_u32,
+)

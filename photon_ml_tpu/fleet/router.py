@@ -1,0 +1,835 @@
+"""The fleet routing tier: one thin HTTP front for N entity-sharded hosts.
+
+Each serving host (``serve_game --fleet-shard I --fleet-shard-count N``)
+packs ~1/N of every random-effect coordinate's dense coefficient table
+(``fleet/sharding.py`` decides which ids land where). This router is the
+piece that makes the fleet look like ONE server:
+
+- ``POST /score`` — resolves each record's shard(s) from its raw entity
+  ids and fans out over persistent per-host connections. Records whose
+  entities all live on one shard are scored there outright (that host's
+  f32 totals ARE the response — bit-identical to an unsharded server by
+  construction). Records spanning shards are scored everywhere involved
+  with ``margins=true`` and the router re-runs the ONE score-summation
+  contract, :func:`photon_ml_tpu.game.model.sum_coordinate_margins`, over
+  each coordinate's owner-shard margins — f32 margins widened to double
+  in JSON are exact, and the f64-accumulate-then-f32 reduction is the
+  same arithmetic the host's trace performs, so merged totals are
+  bit-identical too.
+- ``GET/POST /rank`` — fans the request to EVERY host (each ranks its own
+  item shard) and merges the per-shard top-k by score. Exact per-item
+  scores require the user side of the model to be host-invariant — the
+  fixed effect is replicated, so this holds for the standard retrieval
+  setup (item coordinate = the only random effect); a model with
+  user-side RE coordinates is refused rather than silently mis-ranked.
+- ``POST /reload`` — the coordinated two-phase activation: every host
+  validates + canaries + warms the candidate (``phase=prepare``), the
+  router gates ONCE over all verdicts (any refusal, or disagreeing
+  candidate lineages, aborts the epoch with the incumbent serving
+  fleet-wide), then activates everywhere. The single-host watcher +
+  canary gate generalize exactly here: gate at the router, activate
+  everywhere.
+- ``GET /metrics`` — the fleet fold: every host's ``/metrics`` text plus
+  the router's own registry through
+  :func:`photon_ml_tpu.telemetry.aggregate.aggregate_text` (counters and
+  histogram series sum; host-owned gauges — queue depth, brownout level,
+  rank items — are tagged ``process="<shard>"`` and fan out). The same
+  fold ``tools/metrics_fold.py`` runs offline, byte-identically.
+
+Failure mapping: a dead/slow host leg (connection failure, fan-out
+timeout, injected ``fleet.fanout`` fault) becomes a typed
+:class:`~photon_ml_tpu.serving.overload.Shed` with ``reason="upstream"``
+→ **503** + ``Retry-After``; a host's own 429/503 passes through with its
+reason. Every response carries the model content lineage, and a fan-out
+whose legs disagree is refused (503 ``reason=mixed_lineage``) — the
+no-mixed-lineage invariant is enforced per response, not just promised by
+the activation protocol.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+import time
+import urllib.parse
+from concurrent.futures import ThreadPoolExecutor
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Mapping, Optional, Sequence
+
+import numpy as np
+
+from photon_ml_tpu.fleet.sharding import shard_of_id
+from photon_ml_tpu.game.model import sum_coordinate_margins
+from photon_ml_tpu.resilience.faults import fault_point
+from photon_ml_tpu.serving import overload as _overload
+from photon_ml_tpu.serving.http import (
+    DEADLINE_HEADER,
+    REQUEST_ID_HEADER,
+    new_request_id,
+    shed_status,
+)
+from photon_ml_tpu.telemetry import metrics as _metrics
+from photon_ml_tpu.telemetry import tracing as _tracing
+
+#: requests the router answered, by endpoint (score | rank | reload)
+_FLEET_REQUESTS = _metrics.counter(
+    "photon_fleet_requests_total",
+    "Requests served by the fleet router, by endpoint",
+    labels=("endpoint",))
+
+#: one per-host fan-out leg's round trip (connect reuse included)
+_FANOUT_SECONDS = _metrics.histogram(
+    "photon_fleet_fanout_seconds",
+    "Per-host leg latency of a fleet router fan-out", labels=("shard",))
+
+#: legs lost to a dead/slow/faulted host (mapped to 503 reason=upstream)
+_UPSTREAM_ERRORS = _metrics.counter(
+    "photon_fleet_upstream_errors_total",
+    "Fan-out legs that failed (connection error, timeout, injected "
+    "fleet.fanout fault) — each maps to a typed 503 reason=upstream",
+    labels=("shard",))
+
+#: fan-outs refused because host legs answered with different model
+#: content lineages — the invariant two-phase activation exists to keep
+_MIXED_LINEAGE = _metrics.counter(
+    "photon_fleet_mixed_lineage_total",
+    "Fleet responses refused because fan-out legs disagreed on model "
+    "lineage (503 reason=mixed_lineage)")
+
+#: two-phase /reload outcomes (activated | aborted)
+_EPOCHS = _metrics.counter(
+    "photon_fleet_epochs_total",
+    "Coordinated two-phase reload epochs, by outcome "
+    "(activated | aborted)", labels=("outcome",))
+
+#: configured host count (the fleet's N)
+_FLEET_HOSTS = _metrics.gauge(
+    "photon_fleet_hosts",
+    "Serving hosts behind the fleet router (the shard count N)")
+
+
+class MixedLineageError(RuntimeError):
+    """Fan-out legs answered from different model generations — the
+    response is refused (503 ``reason=mixed_lineage``) rather than
+    stitched together from two models."""
+
+
+class HostClient:
+    """Persistent-connection JSON client for one serving host.
+
+    Connections are pooled and reused across requests (the stdlib
+    ``urllib`` one-connection-per-request pattern is exactly the socket
+    churn the tail-latency push removed client-side). A request that dies
+    on a stale keep-alive — the server closed an idle connection under
+    us — is retried ONCE on a fresh connection; a fresh connection
+    failing means the host is actually gone, and the caller maps that to
+    the typed upstream 503.
+    """
+
+    def __init__(self, url: str, shard: int, *, timeout_s: float = 30.0):
+        self.url = url.rstrip("/")
+        self.shard = int(shard)
+        self.timeout_s = float(timeout_s)
+        parsed = urllib.parse.urlsplit(self.url)
+        self._host = parsed.hostname
+        self._port = parsed.port or 80
+        self._lock = threading.Lock()
+        self._free: list = []  # guarded-by: _lock
+
+    def _take(self):
+        with self._lock:
+            if self._free:
+                return self._free.pop()
+        return http.client.HTTPConnection(self._host, self._port,
+                                          timeout=self.timeout_s)
+
+    def _give(self, conn) -> None:
+        with self._lock:
+            self._free.append(conn)
+
+    def request(self, method: str, path: str, payload=None,
+                headers: Optional[Mapping[str, str]] = None,
+                ) -> "tuple[int, dict]":
+        """One JSON request → ``(status, body)``. Raises ``OSError`` /
+        ``http.client.HTTPException`` when the host is unreachable past
+        the bounded reconnect (the caller owns the upstream mapping)."""
+        # the fleet chaos site: one visit per LEG (not per reconnect
+        # attempt) — an injected fault is a host that cannot be reached
+        fault_point("fleet.fanout", host=self.url, path=path)
+        body = None if payload is None else json.dumps(payload).encode()
+        hdrs = {"Content-Type": "application/json", **(headers or {})}
+        last: Optional[BaseException] = None
+        for attempt in range(2):
+            conn = self._take()
+            try:
+                conn.request(method, path, body=body, headers=hdrs)
+                resp = conn.getresponse()
+                data = resp.read()
+                self._give(conn)
+                return resp.status, json.loads(data or b"{}")
+            except (OSError, http.client.HTTPException) as e:
+                # a pooled connection can be stale (server-side idle
+                # close); retry once on a provably fresh one
+                conn.close()
+                last = e
+        raise ConnectionError(
+            f"host {self.url} unreachable after reconnect: {last!r}")
+
+    def close(self) -> None:
+        with self._lock:
+            conns, self._free = self._free, []
+        for conn in conns:
+            conn.close()
+
+
+class FleetRouter:
+    """Endpoint logic of the routing tier, HTTP-free (the handler is
+    thin, like ``serving/http.py``'s). One instance fronts N hosts; host
+    *i* must be serving fleet shard ``(i, N)``."""
+
+    def __init__(self, host_urls: Sequence[str], *,
+                 fanout_timeout_s: float = 30.0,
+                 default_timeout_ms: float = 0.0):
+        if not host_urls:
+            raise ValueError("a fleet router needs at least one host url")
+        self.clients = [HostClient(url, shard=i, timeout_s=fanout_timeout_s)
+                        for i, url in enumerate(host_urls)]
+        self.n_shards = len(self.clients)
+        self.default_timeout_ms = float(default_timeout_ms)
+        #: fan-out worker pool — sized so every shard of two concurrent
+        #: requests can be in flight; legs are short-lived, the pool is
+        #: process-lifetime
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(4, 2 * self.n_shards),
+            thread_name_prefix="photon-fleet-fanout")
+        self._lock = threading.Lock()
+        #: model coordinate walk [(cid, entity_type|None)] in order,
+        #: fetched from a host's /healthz (refreshed after activation)
+        self._coordinates: Optional[list] = None  # guarded-by: _lock
+        self._rank_info: Optional[dict] = None  # guarded-by: _lock
+        self.n_requests = 0  # guarded-by: _lock
+        _FLEET_HOSTS.set(self.n_shards)
+
+    # --- deadlines (same contract as ServingService) ----------------------
+    def resolve_deadline(self,
+                         budget_ms: "str | float | None") -> Optional[float]:
+        if budget_ms is None or budget_ms == "":
+            budget_ms = (self.default_timeout_ms
+                         if self.default_timeout_ms > 0 else None)
+        if budget_ms is None:
+            return None
+        try:
+            budget = float(budget_ms)
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"bad {DEADLINE_HEADER} header {budget_ms!r} (want a "
+                f"millisecond budget)") from None
+        return time.monotonic() + budget / 1e3
+
+    @staticmethod
+    def remaining_ms(deadline: Optional[float]) -> Optional[float]:
+        if deadline is None:
+            return None
+        return max(0.0, (deadline - time.monotonic()) * 1e3)
+
+    def _leg_headers(self, request_id: str,
+                     deadline: Optional[float]) -> dict:
+        """Propagated request identity + the REMAINING deadline budget —
+        a downstream host spends the same budget the caller measures."""
+        headers = {REQUEST_ID_HEADER: request_id}
+        if deadline is not None:
+            headers[DEADLINE_HEADER] = f"{self.remaining_ms(deadline):.1f}"
+        return headers
+
+    # --- topology ---------------------------------------------------------
+    def topology(self, refresh: bool = False) -> "tuple[list, dict]":
+        """``([(cid, entity_type|None), ...], rank_info)`` from a host's
+        /healthz — which entity types route, in which order margins
+        merge, and whether fleet ranking is supportable."""
+        with self._lock:
+            if self._coordinates is not None and not refresh:
+                return self._coordinates, self._rank_info
+        body = self._leg(0, "GET", "/healthz")
+        coords = body.get("coordinates")
+        if not coords:
+            raise RuntimeError(
+                "host 0 reports no active model coordinates — is the "
+                "fleet serving yet?")
+        coordinates = [(cid, etype) for cid, etype in coords]
+        rank_info = body.get("rank") or {}
+        with self._lock:
+            self._coordinates = coordinates
+            self._rank_info = rank_info
+        return coordinates, rank_info
+
+    # --- fan-out machinery ------------------------------------------------
+    def _leg(self, shard: int, method: str, path: str, payload=None,
+             headers=None) -> dict:
+        """One per-host leg: timed, upstream-mapped, shed-passthrough."""
+        client = self.clients[shard]
+        with _FANOUT_SECONDS.labels(shard=str(shard)).time() as timer:
+            try:
+                status, body = client.request(method, path, payload,
+                                              headers=headers)
+            except Exception as e:
+                timer.discard()
+                _UPSTREAM_ERRORS.labels(shard=str(shard)).inc()
+                raise _overload.shed(
+                    "upstream",
+                    message=f"fleet shard {shard} ({client.url}) "
+                            f"unreachable: {e!r}",
+                    retry_after_s=2.0) from e
+        if status in (429, 503):
+            # the HOST already counted this shed; re-raise the typed
+            # refusal without double-counting
+            raise _overload.Shed(body.get("reason", "queue_full"),
+                                 body.get("error", f"shard {shard} shed"))
+        if status != 200:
+            raise RuntimeError(f"fleet shard {shard} {method} {path} -> "
+                               f"{status}: {body.get('error', body)!r}")
+        return body
+
+    def _gather(self, legs: "list[tuple]") -> list:
+        """Run legs concurrently; returns bodies in leg order, raising
+        the FIRST leg failure (after every future settles — no leg is
+        left running against a dead request)."""
+        futures = [self._pool.submit(self._leg, *leg) for leg in legs]
+        results, first_error = [], None
+        for fut in futures:
+            try:
+                results.append(fut.result())
+            except BaseException as e:  # re-raised below, nothing swallowed
+                results.append(None)
+                if first_error is None:
+                    first_error = e
+        if first_error is not None:
+            raise first_error
+        return results
+
+    @staticmethod
+    def _check_lineage(bodies: Sequence[dict]) -> Optional[str]:
+        lineages = {body.get("lineage") for body in bodies}
+        if len(lineages) > 1:
+            _MIXED_LINEAGE.inc()
+            raise MixedLineageError(
+                f"fan-out legs answered from different model lineages "
+                f"{sorted(str(x) for x in lineages)} — refusing to stitch "
+                f"a mixed response (is a reload epoch half-activated?)")
+        return next(iter(lineages)) if lineages else None
+
+    # --- /score -----------------------------------------------------------
+    def _shards_of(self, record: dict,
+                   coordinates: Sequence[tuple]) -> tuple:
+        """The sorted shard set a record's present entity ids hash to
+        (empty metadata → shard 0: any host scores it exactly — every
+        coordinate falls back to the replicated fixed effect + zeros)."""
+        meta = record.get("metadataMap") or {}
+        shards = {shard_of_id(str(meta[etype]), self.n_shards)
+                  for _cid, etype in coordinates
+                  if etype is not None and meta.get(etype) not in (None, "")}
+        return tuple(sorted(shards)) if shards else (0,)
+
+    def score(self, payload: dict,
+              request_id: Optional[str] = None,
+              deadline: Optional[float] = None) -> dict:
+        """Fleet ``/score``: partition → fan out → merge. Single-shard
+        records use the owner host's totals verbatim; cross-shard records
+        merge per-coordinate margins through ``sum_coordinate_margins``
+        (bit-identical either way — SERVING.md "Fleet serving")."""
+        if request_id is None:
+            request_id = new_request_id()
+        if "record" in payload:
+            records = [payload["record"]]
+        else:
+            records = payload.get("records")
+        if not isinstance(records, list) or not records:
+            raise ValueError("payload needs 'records': [non-empty list] "
+                             "or 'record': {...}")
+        if deadline is not None and time.monotonic() >= deadline:
+            raise _overload.shed(
+                "deadline", message="deadline expired before fan-out")
+        coordinates, _ = self.topology()
+        groups: dict[tuple, list[int]] = {}
+        for i, rec in enumerate(records):
+            groups.setdefault(self._shards_of(rec, coordinates),
+                              []).append(i)
+        headers = self._leg_headers(request_id, deadline)
+        legs, plans = [], []
+        for shard_set, idxs in groups.items():
+            recs = [records[i] for i in idxs]
+            if len(shard_set) == 1:
+                plans.append(("direct", shard_set, idxs, [len(legs)]))
+                legs.append((shard_set[0], "POST", "/score",
+                             {"records": recs}, headers))
+            else:
+                # the record spans shards: every involved host scores it
+                # and returns per-coordinate margins; the router keeps,
+                # per coordinate, the margin of the shard that OWNS that
+                # coordinate's entity id
+                plans.append(("margins", shard_set, idxs,
+                              list(range(len(legs),
+                                         len(legs) + len(shard_set)))))
+                for s in shard_set:
+                    legs.append((s, "POST", "/score",
+                                 {"records": recs, "margins": True},
+                                 headers))
+        with _tracing.span("fleet.score", request_id=request_id,
+                           batch=len(records), legs=len(legs)):
+            bodies = self._gather(legs)
+        lineage = self._check_lineage(bodies)
+        scores: list = [None] * len(records)
+        merged = 0
+        version = None
+        for mode, shard_set, idxs, leg_ids in plans:
+            if mode == "direct":
+                body = bodies[leg_ids[0]]
+                if version is None or shard_set[0] == 0:
+                    version = body.get("version")
+                for j, i in enumerate(idxs):
+                    scores[i] = body["scores"][j]
+                continue
+            merged += len(idxs)
+            by_shard = {s: bodies[leg_id]
+                        for s, leg_id in zip(shard_set, leg_ids)}
+            primary = by_shard[shard_set[0]]
+            if version is None:
+                version = primary.get("version")
+            margins_of = {s: dict(b["margins"])
+                          for s, b in by_shard.items()}
+            offsets = np.asarray(primary["offsets"], np.float32)
+            merged_margins = []
+            for cid, etype in coordinates:
+                vals = np.empty(len(idxs), np.float32)
+                for j, i in enumerate(idxs):
+                    meta = records[i].get("metadataMap") or {}
+                    raw = None if etype is None else meta.get(etype)
+                    owner = (shard_set[0] if raw in (None, "")
+                             else shard_of_id(str(raw), self.n_shards))
+                    vals[j] = np.float32(margins_of[owner][cid][j])
+                merged_margins.append(vals)
+            # THE score-summation contract, re-run over the owner-shard
+            # margins: same f64 accumulation, same coordinate order, same
+            # f32 inputs ⇒ the same f32 totals the hosts would produce
+            totals = sum_coordinate_margins(offsets, merged_margins, xp=np)
+            for j, i in enumerate(idxs):
+                scores[i] = float(totals[j])
+        with self._lock:
+            self.n_requests += 1
+        _FLEET_REQUESTS.labels(endpoint="score").inc()
+        out = {"scores": scores, "version": version, "lineage": lineage,
+               "request_id": request_id,
+               "fanout": {"legs": len(legs), "merged": merged}}
+        if deadline is not None:
+            out["deadline_ms"] = round(self.remaining_ms(deadline), 1)
+        return out
+
+    # --- /rank ------------------------------------------------------------
+    def rank(self, payload: dict,
+             request_id: Optional[str] = None,
+             deadline: Optional[float] = None) -> dict:
+        """Fleet ``/rank``: fan the request to every host (each ranks its
+        own item shard) and merge the top-k by score (ties break by shard
+        then within-shard rank — single-host tie order is the global item
+        axis, unrecoverable across a hash partition; real trained scores
+        are distinct). Models with user-side random-effect coordinates
+        are refused: a sharded user store would zero the user's margin on
+        foreign hosts."""
+        if request_id is None:
+            request_id = new_request_id()
+        _coordinates, rank_info = self.topology()
+        if not rank_info:
+            raise ValueError("ranking is not enabled on the fleet's hosts "
+                             "(start them with --rank-item-coordinate)")
+        if rank_info.get("user_re_coordinates"):
+            raise ValueError(
+                f"fleet ranking requires the item coordinate to be the "
+                f"only random effect; user-side RE coordinates "
+                f"{rank_info['user_re_coordinates']} would rank with the "
+                f"user's margin zeroed on foreign shards")
+        try:
+            k = int(payload.get("k", min(10, int(rank_info["max_k"]))))
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"bad k {payload.get('k')!r} (want an integer)") from None
+        if deadline is not None and time.monotonic() >= deadline:
+            raise _overload.shed(
+                "deadline", message="deadline expired before fan-out")
+        leg_payload = {key: payload[key]
+                       for key in ("record", "user") if key in payload}
+        leg_payload["k"] = k
+        headers = self._leg_headers(request_id, deadline)
+        legs = [(s, "POST", "/rank", leg_payload, headers)
+                for s in range(self.n_shards)]
+        with _tracing.span("fleet.rank", request_id=request_id, k=k,
+                           legs=len(legs)):
+            bodies = self._gather(legs)
+        lineage = self._check_lineage(bodies)
+        ranked = []  # (-score, shard, within-shard rank, id)
+        for shard, body in enumerate(bodies):
+            for pos, (item, score) in enumerate(zip(body["ids"],
+                                                    body["scores"])):
+                ranked.append((-float(score), shard, pos, str(item)))
+        ranked.sort()
+        top = ranked[:k]
+        with self._lock:
+            self.n_requests += 1
+        _FLEET_REQUESTS.labels(endpoint="rank").inc()
+        out = {"ids": [item for _s, _sh, _p, item in top],
+               "scores": [-neg for neg, _sh, _p, _i in top],
+               "k": k, "lineage": lineage, "request_id": request_id,
+               "version": bodies[0].get("version")}
+        if deadline is not None:
+            out["deadline_ms"] = round(self.remaining_ms(deadline), 1)
+        return out
+
+    # --- two-phase /reload ------------------------------------------------
+    def reload(self, payload: dict,
+               request_id: Optional[str] = None) -> dict:
+        """Coordinated two-phase activation. ``model_dir`` names one
+        candidate for every host; ``model_dirs`` (length N) names
+        per-host candidates — the ``refresh_game --fleet-shards`` patch
+        layout. Phase 1 (``prepare``) runs each host's full
+        validate+canary+warm gate; ANY refusal — or the prepared
+        candidates disagreeing on lineage — aborts the epoch (prepared
+        versions retired, incumbent serving fleet-wide, 409 up). Phase 2
+        activates every host's prepared version."""
+        if request_id is None:
+            request_id = new_request_id()
+        dirs = payload.get("model_dirs")
+        if dirs is None:
+            model_dir = payload.get("model_dir")
+            if not model_dir:
+                raise ValueError("payload needs 'model_dir' (one for the "
+                                 "whole fleet) or 'model_dirs' (one per "
+                                 "host)")
+            dirs = [model_dir] * self.n_shards
+        if len(dirs) != self.n_shards:
+            raise ValueError(f"'model_dirs' must name {self.n_shards} "
+                             f"dirs (one per host), got {len(dirs)}")
+        headers = self._leg_headers(request_id, None)
+        _FLEET_REQUESTS.labels(endpoint="reload").inc()
+        with _tracing.span("fleet.reload", request_id=request_id):
+            # --- phase 1: every host validates, canaries and warms ------
+            futures = [self._pool.submit(
+                self._leg, s, "POST", "/reload",
+                {"model_dir": dirs[s], "phase": "prepare"}, headers)
+                for s in range(self.n_shards)]
+            prepared: list = [None] * self.n_shards
+            errors: dict[int, str] = {}
+            for s, fut in enumerate(futures):
+                try:
+                    prepared[s] = fut.result()
+                except Exception as e:
+                    errors[s] = repr(e)
+            lineages = {body["lineage"] for body in prepared
+                        if body is not None}
+            if not errors and len(lineages) > 1:
+                errors[-1] = (f"prepared candidates disagree on lineage "
+                              f"{sorted(str(x) for x in lineages)}")
+            if errors:
+                # --- abort: retire whatever prepared; incumbent serves
+                self._abort(prepared, dirs, headers)
+                _EPOCHS.labels(outcome="aborted").inc()
+                raise RuntimeError(
+                    f"two-phase reload aborted — incumbent keeps serving "
+                    f"fleet-wide; refusals: "
+                    + "; ".join(f"shard {s}: {err}"
+                                for s, err in sorted(errors.items())))
+            # --- phase 2: activate everywhere ---------------------------
+            activations = self._gather([
+                (s, "POST", "/reload",
+                 {"phase": "activate", "version": prepared[s]["version"]},
+                 headers)
+                for s in range(self.n_shards)])
+        _EPOCHS.labels(outcome="activated").inc()
+        # coordinate structure may have changed (it rarely does) — the
+        # next request routes on the fresh topology either way
+        self.topology(refresh=True)
+        return {"lineage": next(iter(lineages)),
+                "versions": [a["version"] for a in activations],
+                "previous": [a.get("previous") for a in activations],
+                "request_id": request_id}
+
+    def _abort(self, prepared: Sequence[Optional[dict]],
+               dirs: Sequence[str], headers: dict) -> None:
+        """Best-effort retire of every prepared-but-unactivated version.
+        A host that cannot be reached keeps the version registered (never
+        ACTIVE — it pins some memory until the next successful epoch or
+        restart, it cannot serve)."""
+        for s, body in enumerate(prepared):
+            if body is None:
+                continue
+            try:
+                self._leg(s, "POST", "/reload",
+                          {"phase": "abort", "version": body["version"]},
+                          headers)
+            except Exception:
+                pass  # the abort is advisory; the version was never active
+
+    # --- health + metrics -------------------------------------------------
+    def healthz(self) -> dict:
+        hosts = []
+        for s in range(self.n_shards):
+            try:
+                body = self._leg(s, "GET", "/healthz")
+                hosts.append({"shard": s, "url": self.clients[s].url,
+                              "status": body.get("status"),
+                              "version": body.get("version"),
+                              "lineage": body.get("model_lineage_id"),
+                              "fleet_shard": body.get("fleet_shard")})
+            except Exception as e:
+                hosts.append({"shard": s, "url": self.clients[s].url,
+                              "status": "unreachable", "error": repr(e)})
+        lineages = {h.get("lineage") for h in hosts
+                    if h.get("status") == "ok"}
+        return {"status": "ok" if all(h.get("status") == "ok"
+                                      for h in hosts) else "degraded",
+                "n_shards": self.n_shards,
+                "requests": self.n_requests,
+                "mixed_lineage": len(lineages) > 1,
+                "hosts": hosts,
+                "shed": _overload.shed_counts()}
+
+    def readyz(self) -> "tuple[int, dict]":
+        """Ready iff EVERY shard's host is ready — a fleet missing a
+        shard serves wrong-by-omission scores for that shard's entities,
+        so it is not ready, merely alive."""
+        reasons = []
+        for s in range(self.n_shards):
+            try:
+                status, body = self.clients[s].request("GET", "/readyz")
+                if status != 200:
+                    reasons.append(
+                        f"shard {s}: {','.join(body.get('reasons', []))}")
+            except Exception as e:
+                reasons.append(f"shard {s}: unreachable ({e!r})")
+        body = {"ready": not reasons, "reasons": reasons,
+                "n_shards": self.n_shards}
+        return (200 if not reasons else 503), body
+
+    def host_metrics_texts(self) -> "list[str]":
+        """Each host's raw ``/metrics`` exposition text, in shard order
+        (unreachable hosts contribute an empty snapshot — a scrape must
+        not fail because one host is down)."""
+        import urllib.request
+
+        texts = []
+        for s in range(self.n_shards):
+            client = self.clients[s]
+            try:
+                with urllib.request.urlopen(client.url + "/metrics",
+                                            timeout=client.timeout_s
+                                            ) as resp:
+                    texts.append(resp.read().decode())
+            except Exception:
+                texts.append("")
+        return texts
+
+    def metrics_text(self) -> str:
+        """The fleet-folded exposition: the router's own registry first
+        (chief semantics), then every host's snapshot tagged
+        ``process="<shard>"`` so host-owned gauges fan out — the same
+        fold, fed the same texts, as ``tools/metrics_fold.py`` offline
+        (byte-identical; the tier-1 fold-consistency test locks it)."""
+        from photon_ml_tpu.telemetry.prometheus import render
+
+        return fold_fleet_texts(render(), self.host_metrics_texts())
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=True)
+        for client in self.clients:
+            client.close()
+
+
+def fold_fleet_texts(router_text: str, host_texts: Sequence[str]) -> str:
+    """The fleet metric fold: router snapshot (chief-first) + per-host
+    snapshots with host-owned gauges tagged ``process="<shard>"``,
+    through the ONE merge code path (``telemetry/aggregate.py``)."""
+    from photon_ml_tpu.telemetry.aggregate import aggregate_text
+
+    texts = [router_text]
+    for shard, text in enumerate(host_texts):
+        if text:
+            texts.append(tag_host_owned(text, ("process", str(shard))))
+    return aggregate_text(texts)
+
+
+def tag_host_owned(text: str, tag: "tuple[str, str]") -> str:
+    """Append ``tag`` to every host-owned gauge series of an exposition
+    text (``metrics.mark_host_owned`` declares which). Training renders
+    do this at render time (``render(host_tag=...)``); the router
+    re-tags hosts' already-rendered scrapes — same label, same fan-out
+    semantics."""
+    from photon_ml_tpu.telemetry.metrics import host_owned_gauges
+    from photon_ml_tpu.telemetry.prometheus import parse_text, render
+
+    snapshot = parse_text(text)
+    owned = host_owned_gauges()
+    key, value = tag
+    for name, fam in snapshot.families.items():
+        if fam.get("type") != "gauge" or name not in owned:
+            continue
+        snapshot[name] = [({**labels, key: value}, v)
+                          for labels, v in snapshot.get(name, ())]
+    return render(snapshot)
+
+
+# ---------------------------------------------------------------------------
+# the HTTP front (thin marshaling, like serving/http.py's handler)
+# ---------------------------------------------------------------------------
+
+
+def _make_handler(router: FleetRouter):
+    class Handler(BaseHTTPRequestHandler):
+        # persistent connections, like the serving front end (every
+        # reply carries Content-Length)
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # noqa: D102
+            pass
+
+        def _request_id(self) -> str:
+            inbound = self.headers.get(REQUEST_ID_HEADER)
+            self.request_id = inbound.strip() if inbound \
+                else new_request_id()
+            return self.request_id
+
+        def _reply(self, status: int, body: dict,
+                   headers: Optional[dict] = None) -> None:
+            data = json.dumps(body).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            rid = getattr(self, "request_id", None)
+            if rid is not None:
+                self.send_header(REQUEST_ID_HEADER, rid)
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _payload(self) -> dict:
+            length = int(self.headers.get("Content-Length") or 0)
+            if not length:
+                return {}
+            return json.loads(self.rfile.read(length) or b"{}")
+
+        def _dispatch(self, rid: str, fn, payload: dict,
+                      deadline: Optional[float]) -> None:
+            headers = None
+            try:
+                out = fn(payload, request_id=rid, deadline=deadline)
+                status = 200
+            except _overload.Shed as e:
+                out = {"error": str(e), "reason": e.reason,
+                       "request_id": rid}
+                status = shed_status(e)
+                headers = {"Retry-After":
+                           str(max(1, round(e.retry_after_s)))}
+            except MixedLineageError as e:
+                out = {"error": str(e), "reason": "mixed_lineage",
+                       "request_id": rid}
+                status = 503
+            except ValueError as e:
+                out, status = {"error": str(e)}, 400
+            except Exception as e:
+                out, status = {"error": repr(e)}, 500
+            self._reply(status, out, headers=headers)
+
+        def do_GET(self):  # noqa: N802
+            rid = self._request_id()
+            parsed = urllib.parse.urlsplit(self.path)
+            if parsed.path == "/rank":
+                qs = urllib.parse.parse_qs(parsed.query)
+                payload = {key: values[0] for key, values in qs.items()
+                           if values}
+                try:
+                    deadline = router.resolve_deadline(
+                        self.headers.get(DEADLINE_HEADER))
+                except ValueError as e:
+                    self._reply(400, {"error": str(e)})
+                    return
+                self._dispatch(rid, router.rank, payload, deadline)
+            elif parsed.path == "/healthz":
+                self._reply(200, router.healthz())
+            elif parsed.path == "/readyz":
+                status, body = router.readyz()
+                self._reply(status, body)
+            elif parsed.path == "/metrics":
+                from photon_ml_tpu.telemetry.prometheus import CONTENT_TYPE
+
+                data = router.metrics_text().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+            else:
+                self._reply(404, {"error": f"unknown path {self.path}"})
+
+        def do_POST(self):  # noqa: N802
+            rid = self._request_id()
+            try:
+                payload = self._payload()
+                deadline = router.resolve_deadline(
+                    self.headers.get(DEADLINE_HEADER))
+            except (ValueError, json.JSONDecodeError) as e:
+                self._reply(400, {"error": f"bad request: {e}"})
+                return
+            if self.path == "/score":
+                self._dispatch(rid, router.score, payload, deadline)
+            elif self.path == "/rank":
+                self._dispatch(rid, router.rank, payload, deadline)
+            elif self.path == "/reload":
+                try:
+                    self._reply(200, router.reload(payload,
+                                                   request_id=rid))
+                except Exception as e:
+                    # an aborted epoch is a CONFLICT: the incumbent is
+                    # untouched on every host, exactly like a single
+                    # host's rejected /reload
+                    self._reply(409, {"error": repr(e)})
+            else:
+                self._reply(404, {"error": f"unknown path {self.path}"})
+
+    return Handler
+
+
+class RouterServer:
+    """Threaded HTTP wrapper for :class:`FleetRouter` — the same
+    test-friendly lifecycle as ``serving/http.py::GameServer``."""
+
+    def __init__(self, router: FleetRouter, *, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.router = router
+        self._httpd = ThreadingHTTPServer((host, port),
+                                          _make_handler(router))
+        #: start/stop are operator-lifecycle calls from one control thread
+        self._thread: Optional[threading.Thread] = None  # guarded-by: caller
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "RouterServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True,
+                                        name="photon-fleet-router")
+        self._thread.start()
+        return self
+
+    def serve_forever(self) -> None:
+        self._httpd.serve_forever()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join()
+        self.router.close()

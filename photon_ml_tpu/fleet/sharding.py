@@ -1,0 +1,112 @@
+"""Deterministic entity-id sharding: the ONE crc32 bucketing home.
+
+A serving fleet splits "hundreds of millions of entity coefficient rows"
+(PAPER.md, the GLMix production premise) across N hosts by hashing each
+RAW entity id. Everything downstream depends on every participant —
+serving store packing, the routing tier, ``refresh_game --fleet-shards``
+patch partitioning, offline joins against the request log — computing the
+SAME shard for the same id, forever:
+
+- the hash is ``crc32`` of the UTF-8 id string — stable across processes,
+  Python versions and machines (unlike ``hash()``), cheap, and already
+  the fleet-joinable discipline the request log samples by;
+- the shard is ``crc32(id) % n_shards`` — no seeding, no salting, so two
+  components that never exchange configuration still agree.
+
+This module is the one sanctioned home of that bucketing (lint rule
+``res-shard-home``, ``analysis/rules_resilience.py``): a second crc32
+call site could silently disagree — a different encoding, a signedness
+slip, a salt — and "disagree" here means a router sending a user to a
+host that holds none of their coefficients, or a refresh patching rows a
+host refuses. The pre-existing crc32 users (request-log sampling, the
+rank-probe sample, fault-plan seeding) route through here for the same
+reason; Avro container checksums (``io/avro.py``) are data integrity,
+not identity bucketing, and stay put.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Iterable, Mapping, Sequence
+
+
+def stable_hash_u32(key: str) -> int:
+    """The one identity hash: unsigned crc32 of the UTF-8 key. Every
+    bucketing decision in the system (shard placement, request-log
+    sampling, probe selection, fault-plan seeding) derives from this
+    value, so they all join on the same id universe."""
+    return zlib.crc32(str(key).encode("utf-8")) & 0xFFFFFFFF
+
+
+def crc_bucket(key: str, mod: int) -> int:
+    """``stable_hash_u32(key) % mod`` — the generic bucketing primitive
+    (request-log sampling uses ``mod = 1 << 16``; sharding uses
+    ``mod = n_shards`` via :func:`shard_of_id`)."""
+    return stable_hash_u32(key) % int(mod)
+
+
+def shard_of_id(raw_id: str, n_shards: int) -> int:
+    """The fleet placement function: which of ``n_shards`` hosts owns
+    this raw entity id's coefficient row. Deterministic and
+    configuration-free — the serving store, the router and the refresh
+    partitioner all call this and therefore always agree."""
+    n = int(n_shards)
+    if n < 1:
+        raise ValueError(f"n_shards must be >= 1, got {n_shards}")
+    return crc_bucket(str(raw_id), n)
+
+
+def check_shard(shard: "tuple[int, int] | None") -> "tuple[int, int] | None":
+    """Validate an ``(index, count)`` shard assignment (None = unsharded,
+    the single-host identity). The one place the invariant
+    ``0 <= index < count`` is spelled out."""
+    if shard is None:
+        return None
+    index, count = int(shard[0]), int(shard[1])
+    if count < 1:
+        raise ValueError(f"shard count must be >= 1, got {count}")
+    if not 0 <= index < count:
+        raise ValueError(
+            f"shard index must be in [0, {count}), got {index}")
+    return (index, count)
+
+
+def owns_id(raw_id: str, shard: "tuple[int, int] | None") -> bool:
+    """Does the host holding ``shard`` own this raw id? ``None`` (an
+    unsharded store) owns everything — the single-host degenerate."""
+    if shard is None:
+        return True
+    index, count = shard
+    return shard_of_id(raw_id, count) == index
+
+
+def partition_by_shard(raw_ids: Iterable[str],
+                       n_shards: int) -> "dict[int, list[str]]":
+    """Split raw ids into per-shard lists (every shard present, possibly
+    empty) — the ``refresh_game --fleet-shards`` patch partitioner and
+    the router's batch splitter share this shape."""
+    out: dict[int, list[str]] = {i: [] for i in range(int(n_shards))}
+    for raw in raw_ids:
+        out[shard_of_id(raw, n_shards)].append(raw)
+    return out
+
+
+def shard_vocab(entity_vocab: Mapping[str, int],
+                shard: "tuple[int, int] | None") -> "dict[str, int]":
+    """Restrict a raw→dense entity vocabulary to one shard's slice,
+    preserving iteration order (the store packs rows in vocab order, so
+    a shard's item axis stays a subsequence of the global one)."""
+    if shard is None:
+        return dict(entity_vocab)
+    return {raw: dense for raw, dense in entity_vocab.items()
+            if owns_id(raw, shard)}
+
+
+def shard_counts(raw_ids: Sequence[str], n_shards: int) -> "list[int]":
+    """Per-shard id counts — the balance diagnostic ``serve_fleet`` logs
+    at startup (crc32 is uniform enough that a heavy skew means
+    duplicated or constant ids, not bad luck)."""
+    counts = [0] * int(n_shards)
+    for raw in raw_ids:
+        counts[shard_of_id(raw, n_shards)] += 1
+    return counts

@@ -345,6 +345,7 @@ def save_game_model_patch(
     removed: Optional[dict[str, list[str]]] = None,
     lineage: Optional[dict] = None,
     sparsity_threshold: float = 0.0,
+    fleet_shard: Optional[tuple] = None,
 ) -> None:
     """Write an entity-level coefficient patch (continuous training's
     delta-publish artifact).
@@ -359,12 +360,18 @@ def save_game_model_patch(
     equivalent merged full model, which becomes the patched version's
     identity so the NEXT patch can chain). ``removed`` lists raw entity
     ids per coordinate whose models vanished this refresh; serving zeroes
-    their rows.
+    their rows. ``fleet_shard=(index, count)`` marks a PER-HOST patch
+    (``refresh_game --fleet-shards``): metadata ``fleetShard`` /
+    ``fleetShardCount`` name the one serving shard whose rows it carries,
+    and a host serving any other shard refuses it at validation.
     """
     os.makedirs(output_dir, exist_ok=True)
     metadata: dict = {"task": task.value, "kind": PATCH_KIND,
                       "modelId": model_id, "parentModel": parent_model,
                       "coordinates": {}}
+    if fleet_shard is not None:
+        metadata["fleetShard"] = int(fleet_shard[0])
+        metadata["fleetShardCount"] = int(fleet_shard[1])
     _apply_lineage(metadata, {**(lineage or {}),
                               "parentModel": parent_model})
     for cid, cm in patch_models.items():

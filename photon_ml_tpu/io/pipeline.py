@@ -157,7 +157,8 @@ def save_model_patch_atomic(output_dir: str, patch_models, index_maps,
                             entity_vocabs, *, task, parent_model: str,
                             model_id: str, removed=None,
                             lineage: Optional[dict] = None,
-                            sparsity_threshold: float = 0.0) -> int:
+                            sparsity_threshold: float = 0.0,
+                            fleet_shard: Optional[tuple] = None) -> int:
     """:func:`photon_ml_tpu.io.model_io.save_game_model_patch` with the
     same staged atomic publication as full models, under the
     ``io.delta_publish`` fault site (staging fully written, rename not yet
@@ -183,7 +184,8 @@ def save_model_patch_atomic(output_dir: str, patch_models, index_maps,
                     staging, patch_models, index_maps, entity_vocabs,
                     task=task, parent_model=parent_model, model_id=model_id,
                     removed=removed, lineage=lineage,
-                    sparsity_threshold=sparsity_threshold)
+                    sparsity_threshold=sparsity_threshold,
+                    fleet_shard=fleet_shard)
                 fault_point("io.delta_publish", path=output_dir)
                 publish_dir(staging, output_dir)
         except BaseException:

@@ -39,10 +39,11 @@ import json
 import math
 import os
 import tempfile
-import zlib
 from typing import Mapping, Optional, Sequence
 
 import numpy as np
+
+from photon_ml_tpu.fleet.sharding import stable_hash_u32
 
 #: artifact name, published at the RUN root (``best/`` and
 #: ``all/config-i`` are siblings under it, like ``data-manifest.json``)
@@ -126,7 +127,7 @@ def rank_probe_sample(user_ids: Sequence[str], n: int = 16) -> tuple:
     id universe (the same fleet-joinable hashing discipline the request
     log samples by)."""
     ids = sorted({str(u) for u in user_ids},
-                 key=lambda u: (zlib.crc32(u.encode("utf-8")), u))
+                 key=lambda u: (stable_hash_u32(u), u))
     return tuple(ids[:max(int(n), 1)])
 
 

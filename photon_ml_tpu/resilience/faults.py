@@ -46,6 +46,13 @@ misbehave. The registered sites:
                           background pool (``serving/reqlog.py``) — a
                           fault counts the segment as dropped (loss, not
                           retention) and never disturbs traffic
+``fleet.fanout``          one visit per per-host leg of a fleet-router
+                          fan-out (``fleet/router.py::HostClient``) — a
+                          fault surfaces as that host being unreachable:
+                          the router maps it to a typed 503
+                          (``reason=upstream``) for the affected request
+                          and a two-phase reload epoch ABORTS with the
+                          incumbent serving fleet-wide
 ========================  ====================================================
 
 Activation is explicit only: :func:`activate` / the :func:`injected` context
@@ -66,17 +73,18 @@ import contextlib
 import dataclasses
 import json
 import os
-import zlib
 from typing import Any, Mapping, Optional, Sequence
 
 import numpy as np
+
+from photon_ml_tpu.fleet.sharding import stable_hash_u32
 
 #: canonical site names (free-form strings are accepted; these are the ones
 #: the framework threads)
 SITES = ("io.read", "ckpt.save", "io.model_save", "io.delta_publish",
          "collective", "optimizer.step", "worker.stall",
          "serving.parse", "serving.execute", "serving.reload",
-         "serving.watch_tick", "io.save.reqlog")
+         "serving.watch_tick", "io.save.reqlog", "fleet.fanout")
 
 _MODES = ("raise", "nan", "stall", "kill")
 
@@ -200,7 +208,7 @@ class FaultPlan:
         rng = self._rngs.get(site)
         if rng is None:
             rng = np.random.default_rng(
-                (self.seed, zlib.crc32(site.encode("utf-8"))))
+                (self.seed, stable_hash_u32(site)))
             self._rngs[site] = rng
         return rng
 

@@ -212,6 +212,17 @@ class RankingEngine:
         with self._lock:
             return self._n_ranked
 
+    @property
+    def user_re_coordinates(self) -> tuple:
+        """Random-effect coordinates consumed from the REQUEST side (all
+        but the item coordinate). Surfaced through ``/healthz`` because
+        they gate fleet rank fan-out: on an entity-sharded host such a
+        coordinate's store holds only its shard's users, so a foreign
+        host would silently rank with the user's margin zeroed — the
+        routing tier refuses that configuration instead of mis-ranking
+        (SERVING.md "Fleet serving")."""
+        return tuple(self._rank_re_order)
+
     # --- ranking ----------------------------------------------------------
     def rank(self, records: Sequence[dict], ks: Sequence[int]):
         """Top-k per record: ``[(ids, scores), ...]`` with ``ids`` raw

@@ -75,6 +75,25 @@ def next_bucket(n: int) -> int:
     return 1 << max(int(n) - 1, 0).bit_length()
 
 
+class _ScoreProgram:
+    """One jitted scoring program + its trace counter, shareable across
+    engine instances. A patch-derived model has the same coordinate
+    structure as its parent — only the table CONTENTS differ, and those
+    ride as jit arguments — so the derived engine reuses the parent's
+    executables outright (``ScoringEngine(share_from=parent)``): a patch
+    activation that appends no new table rows compiles NOTHING, on any
+    host. The counter lives here (not on the engine) so ``compile_count``
+    tells the truth for shared programs too."""
+
+    __slots__ = ("jit", "compiles")
+
+    def __init__(self):
+        self.jit = None
+        #: bumped from inside the traced body (trace time only — jit
+        #: serializes traces), deliberately not lock-annotated
+        self.compiles = 0
+
+
 @dataclasses.dataclass(frozen=True)
 class RequestBatch:
     """Host arrays for one batch of scoring requests: per-shard dense
@@ -99,7 +118,8 @@ class ScoringEngine:
                  shard_configs: Sequence[FeatureShardConfig],
                  index_maps: Mapping[str, IndexMap],
                  stores: Mapping[str, EntityCoefficientStore],
-                 *, max_batch: int = 1024):
+                 *, max_batch: int = 1024,
+                 share_from: "Optional[ScoringEngine]" = None):
         import jax
         import jax.numpy as jnp
 
@@ -132,9 +152,6 @@ class ScoringEngine:
                    for cid in self._re_order},
         }
         self._lock = threading.Lock()
-        #: bumped from inside the traced body (trace time only — jit
-        #: serializes traces), so it is deliberately NOT lock-annotated
-        self._compile_count = 0
         self._n_calls = 0  # guarded-by: _lock
         self._n_scored = 0  # guarded-by: _lock
         #: optional photon_ml_tpu.quality.QualityMonitor, attached by the
@@ -142,19 +159,49 @@ class ScoringEngine:
         #: arrays score_batch already holds — the jitted program, the f32
         #: bit-parity and the zero-recompile contract are untouched.
         self.monitor = None
-        accum = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+        self._accum = jnp.float64 if jax.config.jax_enable_x64 \
+            else jnp.float32
+        #: the structural signature executable sharing keys on: same
+        #: shard order, same coordinate walk (id, kind, feature shard),
+        #: same accumulation dtype ⇒ byte-identical traced program
+        self._signature = (
+            tuple(self._shard_order),
+            tuple((cid, isinstance(cm, FixedEffectModel),
+                   cm.feature_shard_id) for cid, cm in self._coords),
+            str(self._accum.__name__),
+        )
+        if share_from is not None \
+                and share_from._signature == self._signature:
+            self._program = share_from._program
+        else:
+            self._program = self._build_program()
+
+    def _build_program(self) -> _ScoreProgram:
+        """Build this engine's jitted program. The closure captures ONLY
+        structural constants (coordinate walk, shard order) and the
+        program's own trace counter — never a specific version's tables —
+        so patch-derived engines can share it verbatim."""
+        import jax
+        import jax.numpy as jnp
+
+        program = _ScoreProgram()
+        accum = self._accum
+        shard_order = tuple(self._shard_order)
+        re_order = tuple(self._re_order)
+        coords = tuple((cid, isinstance(cm, FixedEffectModel),
+                        cm.feature_shard_id) for cid, cm in self._coords)
 
         def _score_padded(params, offsets, xs, rows):
             # body runs at TRACE time only — one increment per compiled
             # bucket shape, the recompile counter the serving bench asserts
-            self._compile_count += 1
+            program.compiles += 1
             _profiling.record_compile(SCORING_FN_LABEL)
             margins = []
-            i_x = {sid: i for i, sid in enumerate(self._shard_order)}
-            i_r = {cid: i for i, cid in enumerate(self._re_order)}
-            for cid, cm in self._coords:
-                x = xs[i_x[cm.feature_shard_id]].astype(accum)
-                if isinstance(cm, FixedEffectModel):
+            i_x = {sid: i for i, sid in enumerate(shard_order)}
+            i_r = {cid: i for i, cid in enumerate(re_order)}
+            for cid, is_fixed, feature_shard_id in coords:
+                x = xs[i_x[feature_shard_id]].astype(accum)
+                if is_fixed:
                     m = x @ params["fe"][cid].astype(accum)
                 else:
                     # quantized tables dequantize HERE, fused into the
@@ -164,20 +211,26 @@ class ScoringEngine:
                                              rows[i_r[cid]], accum)
                     m = jnp.sum(x * tab, axis=1)
                 margins.append(m.astype(jnp.float32))
-            return sum_coordinate_margins(offsets, margins, xp=jnp)
+            # the per-coordinate f32 margins are program outputs too: the
+            # fleet router merges THESE (fleet/router.py) through the same
+            # sum_coordinate_margins reduction — the single-host path
+            # simply never fetches them (async dispatch, total-only D2H)
+            total = sum_coordinate_margins(offsets, margins, xp=jnp)
+            return total, tuple(margins)
 
-        self._score_jit = jax.jit(_score_padded)
+        program.jit = jax.jit(_score_padded)
+        return program
 
     # --- stats ------------------------------------------------------------
     @property
     def compile_count(self) -> int:
-        """Distinct jitted traces of THIS engine so far (== XLA compiles of
-        its scoring program). Constant after :meth:`warmup` — the
-        zero-recompile contract. The process-wide scrape equivalent is
-        ``photon_compiles_total{fn="serving.score"}`` (which sums across
-        hot-swapped engines; this per-engine attribute backs the
-        bench_serving parity assert)."""
-        return self._compile_count
+        """Distinct jitted traces of this engine's PROGRAM so far (== XLA
+        compiles). Constant after :meth:`warmup` — the zero-recompile
+        contract. A patch-derived engine shares its parent's program, so
+        the count carries across activation: a delta of 0 over a swap IS
+        the zero-recompile-activation proof. The process-wide scrape
+        equivalent is ``photon_compiles_total{fn="serving.score"}``."""
+        return self._program.compiles
 
     @property
     def n_scored(self) -> int:
@@ -234,14 +287,35 @@ class ScoringEngine:
             batch = self.pack(records)
         return self.score_batch(batch)
 
-    def score_batch(self, batch: RequestBatch) -> np.ndarray:
+    def score_margins(self, records: Sequence[dict]):
+        """Scores PLUS the per-coordinate f32 margins and offsets — the
+        fleet router's merge inputs (f32 values widened to double in JSON
+        are exact, so the router re-running ``sum_coordinate_margins``
+        over them reproduces this host's totals bit-for-bit). Returns
+        ``(scores (n,) f32, offsets (n,) f32, [(cid, (n,) f32), ...])``
+        in the model's coordinate order."""
+        fault_point("serving.execute", n=len(records))
+        with _STAGE_SECONDS.labels(stage="batch_assemble").time():
+            batch = self.pack(records)
+        scores, margins = self.score_batch(batch, with_margins=True)
+        return scores, batch.offsets, \
+            [(cid, m) for (cid, _cm), m in zip(self._coords, margins)]
+
+    def score_batch(self, batch: RequestBatch, with_margins: bool = False):
         out = np.empty(batch.n, np.float32)
+        margins = [np.empty(batch.n, np.float32)
+                   for _ in self._coords] if with_margins else None
         # batches past the largest bucket chunk — per-sample independence
         # makes the split score-invariant
         with _STAGE_SECONDS.labels(stage="execute").time():
             for lo in range(0, batch.n, self.max_batch):
                 hi = min(lo + self.max_batch, batch.n)
-                out[lo:hi] = self._score_chunk(batch, lo, hi)
+                chunk, chunk_margins = self._score_chunk(
+                    batch, lo, hi, with_margins=with_margins)
+                out[lo:hi] = chunk
+                if with_margins:
+                    for j, m in enumerate(chunk_margins):
+                        margins[j][lo:hi] = m
         with self._lock:
             self._n_calls += 1
             self._n_scored += batch.n
@@ -263,9 +337,10 @@ class ScoringEngine:
                 cfg.shard_id: (int(np.count_nonzero(x)), int(x.size))
                 for cfg, x in zip(self.shard_configs, batch.xs)}
             monitor.observe(out, cold=cold, coverage=coverage)
-        return out
+        return (out, margins) if with_margins else out
 
-    def _score_chunk(self, batch: RequestBatch, lo: int, hi: int) -> np.ndarray:
+    def _score_chunk(self, batch: RequestBatch, lo: int, hi: int,
+                     with_margins: bool = False):
         n = hi - lo
         b = next_bucket(n)
         offsets = np.zeros(b, np.float32)
@@ -282,19 +357,22 @@ class ScoringEngine:
             rows.append(rp)
         # the np.asarray D2H pull belongs inside the timed region: jax
         # dispatch is async, so the jit call alone returns before the
-        # device finishes
+        # device finishes. Margins are fetched only when asked (the fleet
+        # margin-merge path); the single-host path pulls the total alone.
         with _SCORE_LATENCY.labels(bucket=str(b)).time():
-            scores = self._score_jit(self._params, offsets, tuple(xs),
-                                     tuple(rows))
+            scores, margins = self._program.jit(
+                self._params, offsets, tuple(xs), tuple(rows))
             out = np.asarray(scores)[:n]
-        return out
+            out_margins = ([np.asarray(m)[:n] for m in margins]
+                           if with_margins else None)
+        return out, out_margins
 
     def warmup(self, max_bucket: Optional[int] = None) -> int:
         """Pre-trace every bucket executable (1, 2, 4, … ``max_batch``) so
         live traffic never waits on a compile. Returns the number of
         compiles performed."""
         top = self.max_batch if max_bucket is None else next_bucket(max_bucket)
-        before = self._compile_count
+        before = self._program.compiles
         b = 1
         while b <= top:
             empty = RequestBatch(
@@ -305,4 +383,4 @@ class ScoringEngine:
                                    np.int32) for cid in self._re_order))
             self._score_chunk(empty, 0, b)
             b <<= 1
-        return self._compile_count - before
+        return self._program.compiles - before

@@ -128,6 +128,13 @@ class _NullSpan:
 _NULL_SPAN = _NullSpan()
 
 
+def shed_status(e: "_overload.Shed") -> int:
+    """HTTP status for a typed shed: 429 (busy — retry the same place)
+    for admission-control refusals, **503** for ``reason="upstream"``
+    (the fleet router lost a host leg; the capacity is gone, not busy)."""
+    return 503 if e.reason == "upstream" else 429
+
+
 @contextlib.contextmanager
 def _maybe_span(name: str, **attrs):
     """A ``serving.*`` span — unless brownout has shed span tracing
@@ -223,6 +230,11 @@ class ServingService:
         if not isinstance(records, list) or not records:
             raise ValueError("payload needs 'records': [non-empty list] "
                              "or 'record': {...}")
+        # margins=true (the fleet router's merge protocol): respond with
+        # the per-coordinate f32 margins + offsets next to the scores, so
+        # a routing tier can recombine coordinates owned by different
+        # shards through the same sum_coordinate_margins reduction
+        with_margins = bool(payload.get("margins"))
         if deadline is not None and time.monotonic() >= deadline:
             # the caller already gave up — scoring would be pure waste
             raise _overload.shed(
@@ -233,12 +245,19 @@ class ServingService:
                 message=f"brownout level {_overload.level()} is shedding "
                         f"traffic",
                 retry_after_s=2.0)
+        margins = offsets = None
         with _REQUEST_LATENCY.time() as timer, \
                 _maybe_span("serving.score", request_id=request_id,
                             batch=len(records)) as sp:
             version = self.registry.active_version
             try:
-                if self.batcher is not None and len(records) == 1:
+                if with_margins:
+                    # margin responses bypass the batcher: the margin set
+                    # is per-request shaped, not coalescible
+                    raw, offsets, margins = \
+                        self.registry.active().engine.score_margins(records)
+                    scores = [float(s) for s in raw]
+                elif self.batcher is not None and len(records) == 1:
                     scores = [self.batcher.score(records[0],
                                                  deadline=deadline)]
                 else:
@@ -269,8 +288,18 @@ class ServingService:
                                latency_ms=latency_ms, version=version,
                                request_id=request_id)
         out = {"scores": scores, "version": version,
+               # content lineage rides every response so a routing tier
+               # can PROVE no reply ever mixes model generations (the
+               # fleet's no-mixed-lineage invariant is checked per fan-out)
+               "lineage": self._active_lineage(),
                "latency_ms": round(latency_ms, 3),
                "request_id": request_id}
+        if with_margins:
+            # f32 widened to double — exact, so the router re-running
+            # sum_coordinate_margins reproduces this host's totals
+            out["margins"] = [[cid, [float(v) for v in m]]
+                              for cid, m in margins]
+            out["offsets"] = [float(v) for v in offsets]
         if deadline is not None:
             # echo the remaining budget like the request id: the caller
             # (or a downstream hop) sees how much headroom survived
@@ -358,6 +387,7 @@ class ServingService:
                                request_id=request_id)
         out = {"ids": list(ids), "scores": [float(s) for s in scores],
                "k": k, "version": version,
+               "lineage": self._active_lineage(),
                "latency_ms": round(latency_ms, 3),
                "request_id": request_id}
         if deadline is not None:
@@ -383,6 +413,16 @@ class ServingService:
                             else active.parent_lineage),
             "quality_baseline": (active is not None
                                  and active.baseline is not None),
+            # the fleet topology facts a router needs: which shard this
+            # host holds, and the model's coordinate walk (id, entity
+            # type or null for the fixed effect) IN ORDER — the router's
+            # margin merge re-runs sum_coordinate_margins in exactly this
+            # order, and shard resolution hashes these entity types' ids
+            "fleet_shard": (None if self.registry.fleet_shard is None
+                            else list(self.registry.fleet_shard)),
+            "coordinates": (None if active is None else [
+                [cid, getattr(cm, "random_effect_type", None)]
+                for cid, cm in active.model.coordinates.items()]),
             "compiles": (0 if active is None
                          else active.engine.compile_count),
             "requests": self.n_requests,
@@ -411,6 +451,11 @@ class ServingService:
                 "max_k": active.rank_engine.max_k,
                 "requests": self.n_ranked,
                 "compiles": active.rank_engine.compile_count,
+                # user-side RE coordinates constrain fleet rank fan-out
+                # (their sharded stores would drop the user's margin on
+                # foreign hosts); the router refuses to rank past them
+                "user_re_coordinates": list(
+                    active.rank_engine.user_re_coordinates),
             }
         return out
 
@@ -444,14 +489,48 @@ class ServingService:
         return (200 if not reasons else 503), body
 
     def reload(self, payload: dict) -> dict:
+        """One-shot (no ``phase``) or two-phase ``/reload``. The phases
+        are the fleet router's coordination verbs (SERVING.md "Fleet
+        serving") — usable by hand against a single host too:
+
+        - ``phase=prepare`` — validate + canary + warm + REGISTER the
+          candidate without activating; returns its ``version`` +
+          ``lineage``. The incumbent keeps serving.
+        - ``phase=activate`` + ``version`` — pin a prepared version.
+        - ``phase=abort`` + ``version`` — retire a prepared version; the
+          incumbent was never disturbed.
+        """
+        phase = payload.get("phase")
+        if phase in ("activate", "abort"):
+            version = payload.get("version")
+            if not isinstance(version, int):
+                raise ValueError(
+                    f"phase={phase} needs the prepared 'version' (int)")
+            if phase == "activate":
+                previous = self.registry.active_version
+                sm = self.registry.activate(version)
+                return {"version": sm.version, "previous": previous,
+                        "lineage": sm.lineage, "phase": "activated"}
+            self.registry.retire(version)
+            return {"version": self.registry.active_version,
+                    "retired": version, "phase": "aborted"}
+        if phase not in (None, "prepare"):
+            raise ValueError(f"unknown reload phase {phase!r} (want "
+                             f"prepare | activate | abort)")
         model_dir = payload.get("model_dir") or self.default_model_dir
         if not model_dir:
             raise ValueError("payload needs 'model_dir' (no default "
                              "configured)")
         previous = self.registry.active_version
-        sm = self.registry.reload(model_dir)
-        out = {"version": sm.version, "previous": previous,
-               "model_dir": sm.model_dir}
+        if phase == "prepare":
+            sm = self.registry.prepare(model_dir)
+            out = {"version": sm.version, "previous": previous,
+                   "lineage": sm.lineage, "model_dir": sm.model_dir,
+                   "phase": "prepared"}
+        else:
+            sm = self.registry.reload(model_dir)
+            out = {"version": sm.version, "previous": previous,
+                   "model_dir": sm.model_dir}
         if sm.canary is not None:
             # canary annotation of this activation (divergence vs the
             # incumbent over the request reservoir, quality/canary.py)
@@ -472,6 +551,14 @@ class ServingService:
 
 def _make_handler(service: ServingService):
     class Handler(BaseHTTPRequestHandler):
+        # HTTP/1.1 = persistent connections: the stdlib default (1.0)
+        # closes the socket after every response, which taxes every
+        # fleet-router leg (and any keep-alive client) with a fresh TCP
+        # handshake. Every reply carries Content-Length, which is all
+        # 1.1 keep-alive needs; ThreadingHTTPServer's daemon threads
+        # make idle-connection handler threads shutdown-safe.
+        protocol_version = "HTTP/1.1"
+
         # per-request log lines go nowhere useful under test/bench load
         def log_message(self, fmt, *args):  # noqa: D102
             pass
@@ -568,7 +655,7 @@ def _make_handler(service: ServingService):
             except _overload.Shed as e:
                 out = {"error": str(e), "reason": e.reason,
                        "request_id": rid}
-                status = 429
+                status = shed_status(e)
                 headers = {"Retry-After": str(max(1, round(e.retry_after_s)))}
             except ValueError as e:
                 out, status = {"error": str(e)}, 400
@@ -618,9 +705,10 @@ def _make_handler(service: ServingService):
                 except _overload.Shed as e:
                     # admission control refused the request: 429 with a
                     # Retry-After hint — never a hang, never a 500
+                    # (upstream sheds — router-only — map to 503)
                     out = {"error": str(e), "reason": e.reason,
                            "request_id": rid}
-                    status = 429
+                    status = shed_status(e)
                     headers = {
                         "Retry-After": str(max(1, round(e.retry_after_s)))}
                 except ValueError as e:

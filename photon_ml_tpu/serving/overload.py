@@ -60,7 +60,9 @@ logger = logging.getLogger(__name__)
 _SHED_TOTAL = _metrics.counter(
     "photon_shed_total",
     "Requests shed by serving admission control, by reason "
-    "(queue_full | deadline | brownout)", labels=("reason",))
+    "(queue_full | deadline | brownout | upstream — the last is the "
+    "fleet router mapping a dead/slow/faulted host leg to a typed 503)",
+    labels=("reason",))
 
 #: current brownout degradation level (0 = full service, MAX_LEVEL =
 #: shedding traffic). Host-owned: each serving process degrades on its
@@ -72,8 +74,12 @@ _BROWNOUT_LEVEL = _metrics.gauge(
 _metrics.mark_host_owned("photon_brownout_level")
 
 #: the closed shed-reason vocabulary (materialized at import so /metrics
-#: shows every reason at zero before the first shed)
-SHED_REASONS = ("queue_full", "deadline", "brownout")
+#: shows every reason at zero before the first shed). ``upstream`` is the
+#: fleet router's reason — a per-host fan-out leg failed (dead host, slow
+#: host past the fan-out timeout, injected ``fleet.fanout`` fault) — and
+#: maps to **503** rather than 429: the caller did nothing wrong and the
+#: capacity is gone, not busy.
+SHED_REASONS = ("queue_full", "deadline", "brownout", "upstream")
 for _r in SHED_REASONS:
     _SHED_TOTAL.labels(reason=_r)
 

@@ -132,10 +132,19 @@ class ModelRegistry:
                  canary: Optional[CanaryConfig] = None,
                  rank_coordinate: Optional[str] = None,
                  rank_max_k: int = 128,
+                 fleet_shard: Optional[tuple] = None,
                  bus: Optional[EventBus] = None):
         if table_dtype not in TABLE_DTYPES:
             raise ValueError(f"unknown table_dtype {table_dtype!r}; "
                              f"expected one of {TABLE_DTYPES}")
+        from photon_ml_tpu.fleet.sharding import check_shard
+
+        #: this host's fleet shard ``(index, count)``: every loaded
+        #: version's coefficient stores pack only the raw ids hashing to
+        #: it (fleet/sharding.py), and per-host coefficient patches
+        #: carrying a DIFFERENT ``fleetShard`` are refused at validation.
+        #: None = unsharded single-host serving, the historical behavior.
+        self.fleet_shard = check_shard(fleet_shard)
         self.shard_configs = tuple(shard_configs)
         self.max_batch = max_batch
         self.warmup = warmup
@@ -279,6 +288,31 @@ class ModelRegistry:
             return self.load_patch(model_dir, activate=True)
         return self.load(model_dir, activate=True)
 
+    def prepare(self, model_dir: str) -> ServingModel:
+        """Phase one of a coordinated two-phase activation (SERVING.md
+        "Fleet serving"): fully validate + canary the candidate and
+        REGISTER it — warmed, ready to pin — without activating. The
+        router gates once over every host's prepare verdict, then drives
+        :meth:`activate` (phase two) everywhere, or :meth:`retire` (the
+        abort) on any refusal; either way the incumbent keeps serving
+        until the whole fleet has agreed. Routes full dirs vs patches by
+        metadata ``kind``, exactly like :meth:`reload`."""
+        try:
+            from photon_ml_tpu.io.model_io import model_kind
+            from photon_ml_tpu.resilience import fault_point
+
+            # the same chaos surface as a one-shot reload: a faulted
+            # prepare refuses the candidate, the incumbent keeps serving
+            fault_point("serving.reload", path=model_dir, phase="prepare")
+            kind = model_kind(resolve_game_model_dir(model_dir))
+        except Exception as e:
+            self.bus.post("model_reload_rejected", path=model_dir,
+                          error=repr(e))
+            raise
+        if kind == PATCH_KIND:
+            return self.load_patch(model_dir, activate=False)
+        return self.load(model_dir, activate=False)
+
     def load_patch(self, patch_dir: str, *,
                    activate: bool = True) -> ServingModel:
         """Derive version N+1 from the ACTIVE version by overlaying an
@@ -346,12 +380,23 @@ class ModelRegistry:
         stores = {
             cid: EntityCoefficientStore.build(
                 cm, vocabs[cm.random_effect_type],
-                table_dtype=self.table_dtype)
+                table_dtype=self.table_dtype, shard=self.fleet_shard)
             for cid, cm in model.coordinates.items()
             if not isinstance(cm, FixedEffectModel)}
-        engine = ScoringEngine(model, self.shard_configs, index_maps,
-                               stores, max_batch=self.max_batch)
-        rank_engine = self._build_rank_engine(engine, stores)
+        # a reloaded model with the incumbent's coordinate structure
+        # reuses its jitted program outright (tables ride as arguments —
+        # engine.py::_ScoreProgram): a same-shape hot-swap or canary
+        # candidate warms with zero compiles, which is most production
+        # reloads and every patch
+        incumbent = self._active
+        engine = ScoringEngine(
+            model, self.shard_configs, index_maps, stores,
+            max_batch=self.max_batch,
+            share_from=None if incumbent is None else incumbent.engine)
+        rank_engine = self._build_rank_engine(
+            engine, stores,
+            share_from=None if incumbent is None
+            else incumbent.rank_engine)
         # train-time quality profile, published at the run root by the
         # training/refresh drivers; absent baselines degrade the online
         # monitor (no score bins), never the load
@@ -446,6 +491,26 @@ class ModelRegistry:
                 f"patch only overlays the exact model it was computed "
                 f"against (refresh from the currently served model, or "
                 f"publish a full model instead)")
+        patch_shard = metadata.get("fleetShard")
+        patch_count = metadata.get("fleetShardCount")
+        if patch_count is not None:
+            # a per-host patch (refresh_game --fleet-shards) names the ONE
+            # shard whose rows it carries; applying it anywhere else would
+            # silently leave that host's slice stale while claiming the
+            # merged model's lineage — refuse foreign shards outright
+            want_shard = (int(patch_shard), int(patch_count))
+            if self.fleet_shard is None:
+                raise ValueError(
+                    f"{model_dir}: patch is for fleet shard "
+                    f"{want_shard[0]}/{want_shard[1]} but this host is "
+                    f"unsharded — serve with --fleet-shard/"
+                    f"--fleet-shard-count or publish a global patch")
+            if want_shard != self.fleet_shard:
+                raise ValueError(
+                    f"{model_dir}: patch is for fleet shard "
+                    f"{want_shard[0]}/{want_shard[1]}, this host holds "
+                    f"shard {self.fleet_shard[0]}/{self.fleet_shard[1]} "
+                    f"— a foreign shard's patch never applies")
         self._check_metadata(model_dir, metadata)
         patch_vocabs = game_model_entity_vocabs(model_dir, metadata)
         # the patch rides its parent's feature space by contract (the
@@ -501,9 +566,14 @@ class ModelRegistry:
                 upd, patch_vocabs.get(t, {}), removed=removed)
         model = GameModel(coordinates=coordinates,
                           task=parent.model.task)
+        # the derived engine SHARES the parent's jitted executables (the
+        # coordinate structure is identical; tables ride as arguments), so
+        # a patch that appends no new table rows activates with zero
+        # compiles — on a fleet, every untouched host swaps for free
         engine = ScoringEngine(model, self.shard_configs,
                                parent.index_maps, stores,
-                               max_batch=self.max_batch)
+                               max_batch=self.max_batch,
+                               share_from=parent.engine)
         rank_engine = None
         if self.rank_coordinate is not None:
             parent_rank = parent.rank_engine

@@ -40,9 +40,9 @@ from __future__ import annotations
 import os
 import threading
 import time
-import zlib
 from typing import Mapping, Optional, Sequence
 
+from photon_ml_tpu.fleet.sharding import crc_bucket
 from photon_ml_tpu.io.avro import write_avro_file
 from photon_ml_tpu.io.pipeline import BackgroundSaver
 from photon_ml_tpu.io.schemas import REQUEST_LOG_AVRO
@@ -128,7 +128,9 @@ class RequestLog:
             return True
         if self.sample_rate <= 0.0:
             return False
-        h = zlib.crc32(str(request_id).encode("utf-8")) % _SAMPLE_MOD
+        # the one crc32 bucketing home (fleet/sharding.py) — same hash
+        # the fleet shards by, so log joins and shard joins agree
+        h = crc_bucket(str(request_id), _SAMPLE_MOD)
         return h < int(self.sample_rate * _SAMPLE_MOD)
 
     # --- logging ----------------------------------------------------------

@@ -37,6 +37,7 @@ from typing import Mapping, Optional, Sequence
 
 import numpy as np
 
+from photon_ml_tpu.fleet import sharding as _sharding
 from photon_ml_tpu.game.model import RandomEffectModel
 
 #: supported on-device table storage formats, in decreasing precision
@@ -110,6 +111,11 @@ class EntityCoefficientStore:
     row_of_id: Mapping[str, int]
     table_dtype: str = "float32"
     scales: object = None  # jax.Array (n_entities + 1,) f32 — int8 only
+    #: fleet shard view ``(index, count)``: the table holds ONLY the raw
+    #: ids hashing to this shard (``fleet/sharding.py::shard_of_id``);
+    #: every other id lands on the fallback zeros row exactly like an
+    #: unseen entity. None = unsharded (the single-host identity).
+    shard: Optional[tuple] = None
 
     @property
     def n_entities(self) -> int:
@@ -118,6 +124,20 @@ class EntityCoefficientStore:
     @property
     def fallback_row(self) -> int:
         return int(self.table.shape[0]) - 1
+
+    def shard_of(self, raw_id: str) -> Optional[int]:
+        """Which fleet shard owns this raw id (None on an unsharded
+        store). Delegates to the one hashing home,
+        :func:`photon_ml_tpu.fleet.sharding.shard_of_id`."""
+        if self.shard is None:
+            return None
+        return _sharding.shard_of_id(raw_id, self.shard[1])
+
+    def owns(self, raw_id: str) -> bool:
+        """Is this raw id in this store's shard slice? (Unsharded stores
+        own everything.) A sharded store still SCORES foreign ids — they
+        fall back to the zeros row — but never packs rows for them."""
+        return _sharding.owns_id(raw_id, self.shard)
 
     @property
     def device_params(self):
@@ -222,6 +242,12 @@ class EntityCoefficientStore:
                 if raw is None:
                     raise ValueError(
                         f"patch entity {int(e)} has no vocabulary entry")
+                if not self.owns(raw):
+                    # a sharded store applies only its slice of a global
+                    # patch: foreign entities belong to (and are patched
+                    # on) another host — appending them here would grow
+                    # this host back toward the full table
+                    continue
                 updates[target_row(raw)] = block[i]
         body = self.table[:n_old]
         sbody = None if self.scales is None else self.scales[:n_old]
@@ -255,12 +281,14 @@ class EntityCoefficientStore:
             random_effect_type=self.random_effect_type,
             feature_shard_id=self.feature_shard_id, dim=self.dim,
             table=table, row_of_id=row_of_id,
-            table_dtype=self.table_dtype, scales=scales)
+            table_dtype=self.table_dtype, scales=scales,
+            shard=self.shard)
 
     @staticmethod
     def build(model: RandomEffectModel,
               entity_vocab: Mapping[str, int],
-              table_dtype: str = "float32") -> "EntityCoefficientStore":
+              table_dtype: str = "float32",
+              shard: Optional[tuple] = None) -> "EntityCoefficientStore":
         """Pack a loaded :class:`RandomEffectModel`'s sparse table densely,
         in ``table_dtype`` storage (see the module docstring for the
         quantization format and parity contract).
@@ -269,6 +297,14 @@ class EntityCoefficientStore:
         (:func:`photon_ml_tpu.io.model_io.game_model_entity_vocabs`). Models
         fresh off disk are always in shard space (export back-projects), so
         a projector here is a usage error, not a supported layout.
+
+        ``shard=(index, count)`` builds the FLEET shard view: only raw ids
+        hashing to this shard (``fleet/sharding.py::shard_of_id``) get
+        rows, so the host packs ~``1/count`` of the dense table — the
+        entities-per-host lever at "hundreds of millions of entities".
+        Every other id (foreign shard or globally unseen alike) resolves
+        to the fallback zeros row: cold-start semantics are unchanged,
+        and the routing tier is what makes a foreign id never land here.
         """
         if table_dtype not in TABLE_DTYPES:
             raise ValueError(f"unknown table_dtype {table_dtype!r}; "
@@ -278,14 +314,27 @@ class EntityCoefficientStore:
                 "serving expects shard-space models (call to_shard_space() "
                 "before building a store); saved models are already "
                 "back-projected by export")
+        shard = _sharding.check_shard(shard)
+        entity_vocab = _sharding.shard_vocab(entity_vocab, shard)
         keys = np.asarray(model.keys, np.int64)
         ent = keys // model.dim
         feat = keys % model.dim
+        if shard is not None and len(keys):
+            # keep only the shard's entities' coefficients: the dense
+            # table (the device payload) is what sharding shrinks
+            kept_dense = np.fromiter(
+                (int(d) for d in entity_vocab.values()), np.int64,
+                count=len(entity_vocab))
+            mask = np.isin(ent, kept_dense)
+            keys, ent, feat = keys[mask], ent[mask], feat[mask]
+            coeffs = np.asarray(model.coeffs)[mask]
+        else:
+            coeffs = model.coeffs
         uniq = np.unique(ent)
         dense = np.zeros((len(uniq) + 1, model.dim), np.float32)
         if len(keys):
             pos = np.searchsorted(uniq, ent)
-            dense[pos, feat] = model.coeffs
+            dense[pos, feat] = coeffs
         # dense entity id -> packed row, then raw id -> packed row; vocab
         # entries without coefficients (possible when coordinates sharing a
         # re_type merged vocabs) deliberately map to the fallback zeros row
@@ -298,4 +347,4 @@ class EntityCoefficientStore:
             random_effect_type=model.random_effect_type,
             feature_shard_id=model.feature_shard_id,
             dim=model.dim, table=table, row_of_id=row_of_id,
-            table_dtype=table_dtype, scales=scales)
+            table_dtype=table_dtype, scales=scales, shard=shard)

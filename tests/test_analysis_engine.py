@@ -704,3 +704,77 @@ def test_bounded_queue_suppression_needs_justification():
                 self.q = collections.deque()  # photon-lint: disable=res-bounded-queue -- bounded by the admission check in submit()
     """
     assert check(src, ["res-bounded-queue"], rel=SERVING) == []
+
+
+# ---------------------------------------------------------------------------
+# res-shard-home (crc32 identity bucketing confined to fleet/sharding.py)
+# ---------------------------------------------------------------------------
+
+SHARD_HOME = os.path.join("photon_ml_tpu", "fleet", "sharding.py")
+AVRO = os.path.join("photon_ml_tpu", "io", "avro.py")
+
+
+def test_shard_home_flags_crc32_outside_the_home():
+    src = """
+        import zlib
+
+        def shard(raw, n):
+            return zlib.crc32(raw.encode()) % n
+    """
+    assert rule_ids(check(src, ["res-shard-home"])) == ["res-shard-home"]
+
+
+def test_shard_home_allows_the_home_and_the_avro_checksum():
+    src = """
+        import zlib
+
+        def crc_bucket(key, mod):
+            return zlib.crc32(key.encode("utf-8")) % mod
+    """
+    assert check(src, ["res-shard-home"], rel=SHARD_HOME) == []
+    # container checksums over raw bytes are integrity, not identity
+    assert check(src, ["res-shard-home"], rel=AVRO) == []
+
+
+def test_shard_home_sees_aliases_and_binascii():
+    aliased = """
+        import zlib as z
+
+        def f(x):
+            return z.crc32(x)
+    """
+    assert rule_ids(check(aliased, ["res-shard-home"])) == \
+        ["res-shard-home"]
+    from_import = """
+        from binascii import crc32 as c
+
+        def f(x):
+            return c(x)
+    """
+    assert rule_ids(check(from_import, ["res-shard-home"])) == \
+        ["res-shard-home"]
+
+
+def test_shard_home_ignores_unrelated_crc32_names():
+    src = """
+        class Hasher:
+            def crc32(self, x):
+                return 7
+
+        def f(h, x):
+            return h.crc32(x)  # not zlib's — some object's method
+    """
+    assert check(src, ["res-shard-home"]) == []
+
+
+def test_shard_home_clean_call_sites_pass():
+    src = """
+        from photon_ml_tpu.fleet.sharding import crc_bucket, shard_of_id
+
+        def sample(request_id):
+            return crc_bucket(str(request_id), 1 << 16) < 100
+
+        def place(raw, n):
+            return shard_of_id(raw, n)
+    """
+    assert check(src, ["res-shard-home"]) == []

@@ -5,7 +5,12 @@ open-loop load, the accounting identity ``shed + served + errored ==
 offered`` holds, the client-observed sheds match the ``photon_shed_total``
 delta, no Future is stranded (queue drains, worker alive, ``/readyz``
 agrees), and the incumbent model keeps serving BIT-identically across an
-injected ``serving.reload`` fault."""
+injected ``serving.reload`` fault.
+
+``--fleet`` runs the fleet cells instead (ISSUE 15): injected
+``fleet.fanout`` faults, a mid-load host kill + same-port restart, and a
+faulted two-phase reload — per-kind accounting, no mixed-lineage
+response, probe scores bit-identical fleet-wide."""
 
 import os
 import sys
@@ -23,5 +28,20 @@ def test_chaos_serving_smoke_budget():
 
 
 @pytest.mark.slow
+def test_chaos_serving_fleet_smoke_budget():
+    # the fleet cells spin a whole 2-host fleet + its own training; the
+    # tier-1 suite already locks the fleet fault/abort/parity contracts
+    # in tests/test_fleet.py (fleet.fanout included) — the harness cells
+    # run on the nightly lane with the full grid
+    assert chaos_serving.main(["--fleet", "--budget", "smoke",
+                               "--rows", "300"]) == 0
+
+
+@pytest.mark.slow
 def test_chaos_serving_full_grid():
     assert chaos_serving.main([]) == 0
+
+
+@pytest.mark.slow
+def test_chaos_serving_fleet_full():
+    assert chaos_serving.main(["--fleet"]) == 0

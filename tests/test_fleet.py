@@ -1,0 +1,750 @@
+"""Fleet-scale sharded serving tests (photon_ml_tpu/fleet/ + serve_fleet).
+
+The load-bearing contracts, each locked here:
+
+- **router/single-host f32 bit-parity**: ``/score`` and ``/rank``
+  through the router over N=2 entity-sharded hosts are bit-identical to
+  one unsharded server on the same model — cold-start and unknown
+  entities included, and for multi-entity-type models the router's
+  per-coordinate margin merge (``sum_coordinate_margins`` re-run over
+  owner-shard margins) reproduces the totals exactly;
+- **two-phase activation**: a fleet ``/reload`` prepares on every host,
+  gates once, activates everywhere; ANY host's refusal (injected
+  ``serving.reload`` fault) aborts the epoch with the incumbent serving
+  fleet-wide; a dead host leg (injected ``fleet.fanout`` fault) maps to
+  a typed 503 ``reason=upstream``;
+- **per-host patches**: ``refresh_game --fleet-shards N`` partitions the
+  touched entity set by the serving hash; a host REFUSES a foreign
+  shard's patch, applies its own, and a host whose shard saw no touched
+  entities activates with ZERO recompiles (shared executables);
+- **fleet metric fold**: the router's ``/metrics`` fold is byte-identical
+  to ``tools/metrics_fold.py`` run over the same per-host snapshots.
+"""
+
+import json
+import os
+import sys
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.cli import refresh_game as refresh_game_cli
+from photon_ml_tpu.cli import serve_fleet as serve_fleet_cli
+from photon_ml_tpu.cli import serve_game as serve_game_cli
+from photon_ml_tpu.cli import train_game as train_game_cli
+from photon_ml_tpu.fleet.sharding import (
+    check_shard,
+    crc_bucket,
+    owns_id,
+    partition_by_shard,
+    shard_of_id,
+    stable_hash_u32,
+)
+from photon_ml_tpu.io.data_reader import write_training_examples
+from photon_ml_tpu.resilience import FaultPlan, injected
+
+SHARDS = "global=fixed|intercept,user=user|noIntercept"
+COORDS = [
+    "global=fixed,shard=global,reg=L2,maxIter=30",
+    "perUser=random,entity=userId,shard=user,reg=L2,maxIter=30",
+]
+COMMON = [
+    "--feature-shards", SHARDS,
+    "--coordinates", *COORDS,
+    "--update-sequence", "global,perUser",
+    "--grid", "global=0.1", "perUser=1",
+    "--evaluators", "",
+]
+D_FIXED, D_USER, N_USERS = 6, 3, 12
+
+# the two-entity-type model (margin-merge coverage): user AND song
+# random effects, so one record's coordinates can live on DIFFERENT
+# shards and the router must merge margins instead of forwarding
+SHARDS2 = ("global=fixed|intercept,user=user|noIntercept,"
+           "song=song|noIntercept")
+COORDS2 = [
+    "global=fixed,shard=global,reg=L2,maxIter=30",
+    "perUser=random,entity=userId,shard=user,reg=L2,maxIter=30",
+    "perSong=random,entity=songId,shard=song,reg=L2,maxIter=30",
+]
+COMMON2 = [
+    "--feature-shards", SHARDS2,
+    "--coordinates", *COORDS2,
+    "--update-sequence", "global,perUser,perSong",
+    "--grid", "global=0.1", "perUser=1", "perSong=1",
+    "--evaluators", "",
+]
+D_SONG, N_SONGS = 2, 7
+
+
+def _records(n, seed, *, mutate_users=(), cold_users=0, songs=False,
+             param_seed=777):
+    prng = np.random.default_rng(param_seed)
+    w = prng.normal(size=D_FIXED)
+    u = 1.5 * prng.normal(size=(N_USERS, D_USER))
+    v = 1.5 * prng.normal(size=(N_SONGS, D_SONG))
+    rng = np.random.default_rng(seed)
+    xf = rng.normal(size=(n, D_FIXED))
+    xu = rng.normal(size=(n, D_USER))
+    xs = rng.normal(size=(n, D_SONG))
+    users = rng.integers(0, N_USERS, size=n)
+    song_ids = rng.integers(0, N_SONGS, size=n)
+    mutate = np.isin(users, list(mutate_users))
+    xu = np.where(mutate[:, None], xu * 1.25, xu)
+    margin = xf @ w + np.einsum("nd,nd->n", xu, u[users])
+    if songs:
+        margin = margin + np.einsum("nd,nd->n", xs, v[song_ids])
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-margin))).astype(float)
+    out = []
+    for i in range(n):
+        feats = [{"name": f"fixed.x{j}", "term": "",
+                  "value": float(xf[i, j])} for j in range(D_FIXED)]
+        feats += [{"name": f"user.z{j}", "term": "",
+                   "value": float(xu[i, j])} for j in range(D_USER)]
+        meta = {"userId": (f"uCOLD{i}" if i >= n - cold_users
+                           else f"u{users[i]}")}
+        if songs:
+            feats += [{"name": f"song.w{j}", "term": "",
+                       "value": float(xs[i, j])} for j in range(D_SONG)]
+            meta["songId"] = (f"sCOLD{i}" if i >= n - cold_users
+                              else f"s{song_ids[i]}")
+        out.append({"uid": str(i), "response": float(y[i]),
+                    "offset": None, "weight": None, "features": feats,
+                    "metadataMap": meta})
+    return out
+
+
+def _get(url, timeout=60.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _post(url, payload, timeout=60.0, headers=None):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+# ---------------------------------------------------------------------------
+# sharding units (the one hashing home)
+# ---------------------------------------------------------------------------
+
+
+class TestSharding:
+    def test_hash_is_crc32_and_stable(self):
+        import zlib
+
+        assert stable_hash_u32("u1") == zlib.crc32(b"u1")
+        assert crc_bucket("rid", 1 << 16) == zlib.crc32(b"rid") % (1 << 16)
+        assert shard_of_id("u1", 4) == zlib.crc32(b"u1") % 4
+
+    def test_partition_is_exact_and_exhaustive(self):
+        ids = [f"u{i}" for i in range(50)]
+        parts = partition_by_shard(ids, 3)
+        assert sorted(parts) == [0, 1, 2]
+        assert sorted(sum(parts.values(), [])) == sorted(ids)
+        for shard, got in parts.items():
+            assert all(shard_of_id(r, 3) == shard for r in got)
+
+    def test_check_shard_validates(self):
+        assert check_shard(None) is None
+        assert check_shard((1, 2)) == (1, 2)
+        with pytest.raises(ValueError):
+            check_shard((2, 2))
+        with pytest.raises(ValueError):
+            check_shard((0, 0))
+
+    def test_owns_id(self):
+        assert owns_id("anything", None)
+        s = shard_of_id("u7", 2)
+        assert owns_id("u7", (s, 2))
+        assert not owns_id("u7", (1 - s, 2))
+
+
+class TestShardedStore:
+    def _store(self, shard=None, dtype="float32"):
+        from photon_ml_tpu.game.model import RandomEffectModel
+        from photon_ml_tpu.serving.store import EntityCoefficientStore
+        from photon_ml_tpu.types import TaskType
+
+        dim, n = 3, 10
+        rng = np.random.default_rng(0)
+        keys = np.sort(np.arange(n).repeat(dim) * dim
+                       + np.tile(np.arange(dim), n))
+        model = RandomEffectModel(
+            random_effect_type="userId", feature_shard_id="user",
+            task=TaskType.LOGISTIC_REGRESSION, dim=dim,
+            keys=keys.astype(np.int64),
+            coeffs=rng.normal(size=n * dim).astype(np.float32))
+        vocab = {f"u{i}": i for i in range(n)}
+        return EntityCoefficientStore.build(model, vocab,
+                                            table_dtype=dtype,
+                                            shard=shard), vocab
+
+    def test_shard_view_packs_only_owned_rows(self):
+        full, vocab = self._store()
+        s0, _ = self._store(shard=(0, 2))
+        s1, _ = self._store(shard=(1, 2))
+        assert s0.n_entities + s1.n_entities == full.n_entities
+        assert set(s0.row_of_id) | set(s1.row_of_id) == set(vocab)
+        assert all(shard_of_id(r, 2) == 0 for r in s0.row_of_id)
+        # the device payload actually shrank (rows + fallback)
+        assert (s0.table.shape[0] + s1.table.shape[0]
+                == full.table.shape[0] + 1)
+
+    def test_owned_rows_bit_identical_foreign_fall_back(self):
+        full, vocab = self._store()
+        s0, _ = self._store(shard=(0, 2))
+        for raw in vocab:
+            if s0.owns(raw):
+                row = np.asarray(s0.table)[s0.rows_for([raw])[0]]
+                want = np.asarray(full.table)[full.rows_for([raw])[0]]
+                assert np.array_equal(row, want)
+            else:
+                # foreign id → zeros fallback, exactly like an unseen id
+                assert s0.rows_for([raw])[0] == s0.fallback_row
+        assert not np.asarray(s0.table)[s0.fallback_row].any()
+
+    def test_apply_patch_skips_foreign_entities(self):
+        from photon_ml_tpu.game.model import RandomEffectModel
+        from photon_ml_tpu.types import TaskType
+
+        s0, _ = self._store(shard=(0, 2))
+        n0 = s0.n_entities
+        # a GLOBAL patch naming one owned + one foreign NEW entity
+        owned_new = next(f"x{i}" for i in range(100)
+                         if shard_of_id(f"x{i}", 2) == 0)
+        foreign_new = next(f"x{i}" for i in range(100)
+                           if shard_of_id(f"x{i}", 2) == 1)
+        upd_vocab = {owned_new: 0, foreign_new: 1}
+        upd = RandomEffectModel(
+            random_effect_type="userId", feature_shard_id="user",
+            task=TaskType.LOGISTIC_REGRESSION, dim=3,
+            keys=np.array([0, 1, 2, 3, 4, 5], np.int64),
+            coeffs=np.ones(6, np.float32))
+        patched = s0.apply_patch(upd, upd_vocab)
+        assert owned_new in patched.row_of_id
+        assert foreign_new not in patched.row_of_id
+        assert patched.n_entities == n0 + 1
+        assert patched.shard == (0, 2)
+
+
+# ---------------------------------------------------------------------------
+# router parity + protocol (single-RE model, N=2 fleet)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def env(tmp_path_factory):
+    """One trained model served two ways: a single unsharded server and
+    an N=2 fleet (router + two shard hosts), plus a request set with
+    cold users."""
+    tmp = str(tmp_path_factory.mktemp("fleet"))
+    d0 = os.path.join(tmp, "d0.avro")
+    write_training_examples(d0, _records(400, 0))
+    model = os.path.join(tmp, "model")
+    train_game_cli.run(["--training-data", d0, "--output-dir", model]
+                       + COMMON)
+    # --no-warmup: parity fixtures compile lazily for the few shapes the
+    # tests actually score (the eager-warmup contract has its own tier-1
+    # coverage; the `patched` fleet below keeps warmup ON because its
+    # zero-recompile-across-activation assert depends on it)
+    fleet = serve_fleet_cli.build_fleet(
+        ["--model-dir", model, "--feature-shards", SHARDS,
+         "--port", "0", "--fleet-shards", "2", "--no-warmup",
+         "--rank-item-coordinate", "perUser", "--rank-max-k", "16"])
+    # the single server carries the rank surface too (the /rank parity
+    # reference)
+    single = serve_game_cli.build_server(
+        ["--model-dir", model, "--feature-shards", SHARDS, "--port", "0",
+         "--no-warmup", "--rank-item-coordinate", "perUser",
+         "--rank-max-k", "16"]).start()
+    requests = _records(60, 11, cold_users=4)
+    yield {"tmp": tmp, "model": model, "d0": d0,
+           "single": single, "fleet": fleet, "requests": requests}
+    fleet.stop()
+    single.stop()
+
+
+class TestRouterParity:
+    def test_score_bit_identical_to_single_host(self, env):
+        """The headline fleet contract: router f32 scores == unsharded
+        server's, bit for bit — cold/unknown users included."""
+        a = _post(env["single"].url + "/score",
+                  {"records": env["requests"]})
+        b = _post(env["fleet"].url + "/score",
+                  {"records": env["requests"]})
+        assert np.array_equal(
+            np.asarray(a["scores"], np.float64),
+            np.asarray(b["scores"], np.float64))
+        assert b["lineage"] == a["lineage"] is not None
+
+    def test_single_records_and_cold_users(self, env):
+        for rec in env["requests"][:3] + env["requests"][-3:]:
+            a = _post(env["single"].url + "/score", {"record": rec})
+            b = _post(env["fleet"].url + "/score", {"record": rec})
+            assert a["scores"] == b["scores"]
+
+    def test_rank_bit_identical_to_single_host(self, env):
+        """POST /rank with full records (item-shard features give every
+        item a DISTINCT score — a featureless request scores all items
+        identically, where cross-shard merge order is a documented
+        tie-break caveat): ids AND f32 scores bit-identical."""
+        for rec in env["requests"][:6] + env["requests"][-2:]:
+            a = _post(env["single"].url + "/rank",
+                      {"record": rec, "k": 7})
+            b = _post(env["fleet"].url + "/rank",
+                      {"record": rec, "k": 7})
+            assert a["ids"] == b["ids"]
+            assert a["scores"] == b["scores"]
+
+    def test_rank_scores_survive_merge_for_featureless_users(self, env):
+        """Featureless GET /rank: every item ties (zero item design), so
+        the merged ID ORDER may differ from the single host's
+        global-vocab tie-break — but the score multiset and k must
+        survive the merge exactly."""
+        a = _get(env["single"].url + "/rank?user=u1&k=7")
+        b = _get(env["fleet"].url + "/rank?user=u1&k=7")
+        assert sorted(a["scores"]) == sorted(b["scores"])
+        assert len(b["ids"]) == len(set(b["ids"])) == 7
+
+    def test_hosts_pack_disjoint_slices(self, env):
+        stores = [next(iter(h.service.registry.active().stores.values()))
+                  for h in env["fleet"].hosts]
+        ids0, ids1 = set(stores[0].row_of_id), set(stores[1].row_of_id)
+        assert not ids0 & ids1
+        assert len(ids0) + len(ids1) == N_USERS
+        assert stores[0].shard == (0, 2) and stores[1].shard == (1, 2)
+
+    def test_request_id_and_deadline_propagate(self, env):
+        req = urllib.request.Request(
+            env["fleet"].url + "/score",
+            data=json.dumps({"record": env["requests"][0]}).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Photon-Request-Id": "fleet-rid-1",
+                     "X-Photon-Deadline-Ms": "30000"})
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            body = json.loads(resp.read())
+            assert resp.headers["X-Photon-Request-Id"] == "fleet-rid-1"
+        assert body["request_id"] == "fleet-rid-1"
+        assert 0 < body["deadline_ms"] <= 30000
+
+    def test_expired_deadline_sheds_at_router(self, env):
+        req = urllib.request.Request(
+            env["fleet"].url + "/score",
+            data=json.dumps({"record": env["requests"][0]}).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Photon-Deadline-Ms": "0"})
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(req, timeout=60)
+        assert err.value.code == 429
+        assert json.loads(err.value.read())["reason"] == "deadline"
+
+    def test_fanout_fault_maps_to_typed_503(self, env):
+        """An injected fleet.fanout fault IS a dead host: the router
+        answers a typed 503 reason=upstream (never a hang, never a 500)
+        and recovers on the next request."""
+        plan = {"seed": 0, "specs": [{"site": "fleet.fanout", "at": [0]}]}
+        with injected(FaultPlan.from_json(plan)):
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _post(env["fleet"].url + "/score",
+                      {"record": env["requests"][0]})
+        assert err.value.code == 503
+        body = json.loads(err.value.read())
+        assert body["reason"] == "upstream"
+        assert err.value.headers["Retry-After"]
+        # the fleet recovers: the very next request serves
+        out = _post(env["fleet"].url + "/score",
+                    {"record": env["requests"][0]})
+        assert len(out["scores"]) == 1
+
+    def test_readyz_tracks_every_shard(self, env):
+        out = _get(env["fleet"].url + "/readyz")
+        assert out["ready"] is True and out["n_shards"] == 2
+
+
+class TestTwoPhaseReload:
+    def test_prepare_activate_moves_the_whole_fleet(self, env, tmp_path):
+        """The happy path: one router /reload prepares + activates on
+        every host; versions advance everywhere, lineage stays uniform,
+        scores stay bit-identical (same model content re-published)."""
+        before = _post(env["fleet"].url + "/score",
+                       {"records": env["requests"][:8]})
+        versions0 = [_get(u + "/healthz")["version"]
+                     for u in env["fleet"].host_urls()]
+        out = _post(env["fleet"].url + "/reload",
+                    {"model_dir": env["model"]})
+        assert out["versions"] == [v + 1 for v in versions0]
+        assert out["lineage"] == before["lineage"]
+        after = _post(env["fleet"].url + "/score",
+                      {"records": env["requests"][:8]})
+        assert after["scores"] == before["scores"]
+        healths = [_get(u + "/healthz") for u in env["fleet"].host_urls()]
+        assert {h["model_lineage_id"] for h in healths} == {out["lineage"]}
+
+    def test_one_refusal_aborts_the_epoch_fleet_wide(self, env):
+        """Any host's prepare refusal aborts: 409 up, every host's
+        active version untouched, incumbent scores bit-identical — the
+        fleet NEVER serves mixed lineages."""
+        before = _post(env["fleet"].url + "/score",
+                       {"records": env["requests"][:8]})
+        versions0 = [_get(u + "/healthz")["version"]
+                     for u in env["fleet"].host_urls()]
+        plan = {"seed": 0, "specs": [{"site": "serving.reload",
+                                      "at": [0]}]}
+        with injected(FaultPlan.from_json(plan)):
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _post(env["fleet"].url + "/reload",
+                      {"model_dir": env["model"]})
+        assert err.value.code == 409
+        assert "incumbent keeps serving" in json.loads(
+            err.value.read())["error"]
+        versions1 = [_get(u + "/healthz")["version"]
+                     for u in env["fleet"].host_urls()]
+        assert versions1 == versions0
+        after = _post(env["fleet"].url + "/score",
+                      {"records": env["requests"][:8]})
+        assert after["scores"] == before["scores"]
+        assert after["lineage"] == before["lineage"]
+
+    def test_unreachable_host_during_prepare_aborts_too(self, env):
+        """The OTHER refusal shape: a host that cannot be reached for
+        prepare (injected fleet.fanout fault) aborts the epoch exactly
+        like a validation refusal — incumbent everywhere."""
+        versions0 = [_get(u + "/healthz")["version"]
+                     for u in env["fleet"].host_urls()]
+        before = _post(env["fleet"].url + "/score",
+                       {"records": env["requests"][:4]})
+        plan = {"seed": 0, "specs": [{"site": "fleet.fanout", "at": [0]}]}
+        with injected(FaultPlan.from_json(plan)):
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _post(env["fleet"].url + "/reload",
+                      {"model_dir": env["model"]})
+        assert err.value.code == 409
+        assert [_get(u + "/healthz")["version"]
+                for u in env["fleet"].host_urls()] == versions0
+        after = _post(env["fleet"].url + "/score",
+                      {"records": env["requests"][:4]})
+        assert after["scores"] == before["scores"]
+
+    def test_phase_verbs_against_a_single_host(self, env):
+        """The phase protocol is usable host-by-host too: prepare
+        registers without activating; abort retires it."""
+        host = env["fleet"].hosts[0]
+        v0 = _get(host.url + "/healthz")["version"]
+        out = _post(host.url + "/reload",
+                    {"model_dir": env["model"], "phase": "prepare"})
+        assert out["phase"] == "prepared"
+        assert _get(host.url + "/healthz")["version"] == v0  # not active
+        aborted = _post(host.url + "/reload",
+                        {"phase": "abort", "version": out["version"]})
+        assert aborted["phase"] == "aborted"
+        assert out["version"] not in _get(host.url + "/healthz")["versions"]
+
+
+class TestFleetMetricsFold:
+    def test_router_fold_matches_offline_tool_byte_for_byte(self, env,
+                                                            tmp_path):
+        """The router's /metrics fold and tools/metrics_fold.py are the
+        same fold: fed the same snapshots in the same order, the outputs
+        are byte-identical."""
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools"))
+        import metrics_fold
+
+        from photon_ml_tpu.fleet.router import (
+            fold_fleet_texts,
+            tag_host_owned,
+        )
+
+        router = env["fleet"].router
+        host_texts = router.host_metrics_texts()
+        assert all(host_texts)
+        router_text = "# TYPE photon_fleet_hosts gauge\n" \
+                      "photon_fleet_hosts 2\n"
+        live = fold_fleet_texts(router_text, host_texts)
+        # the offline layout: router snapshot as the chief, tagged host
+        # snapshots as workers — exactly what a fleet operator dumps
+        run_dir = tmp_path / "telemetry"
+        (run_dir / "workers").mkdir(parents=True)
+        (run_dir / "metrics.prom").write_text(router_text)
+        for i, text in enumerate(host_texts):
+            proc = run_dir / "workers" / f"proc-{i}"
+            proc.mkdir()
+            (proc / "metrics.prom").write_text(
+                tag_host_owned(text, ("process", str(i))))
+        folded = metrics_fold.fold_metrics(str(run_dir))
+        assert open(folded).read() == live
+
+    def test_host_owned_gauges_fan_out_per_shard(self, env):
+        from photon_ml_tpu.telemetry.prometheus import parse_text
+
+        text = env["fleet"].router.metrics_text()
+        snap = parse_text(text)
+        depth = snap.get("photon_serving_queue_depth", [])
+        procs = {labels.get("process") for labels, _v in depth}
+        assert {"0", "1"} <= procs
+
+
+# ---------------------------------------------------------------------------
+# margin merge (two entity types — records spanning shards)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def env2(tmp_path_factory):
+    tmp = str(tmp_path_factory.mktemp("fleet2"))
+    d0 = os.path.join(tmp, "d0.avro")
+    write_training_examples(d0, _records(400, 0, songs=True))
+    model = os.path.join(tmp, "model")
+    train_game_cli.run(["--training-data", d0, "--output-dir", model]
+                       + COMMON2)
+    single = serve_game_cli.build_server(
+        ["--model-dir", model, "--feature-shards", SHARDS2,
+         "--port", "0", "--no-warmup"]).start()
+    fleet = serve_fleet_cli.build_fleet(
+        ["--model-dir", model, "--feature-shards", SHARDS2,
+         "--port", "0", "--fleet-shards", "2", "--no-warmup"])
+    requests = _records(48, 11, cold_users=4, songs=True)
+    yield {"model": model, "single": single, "fleet": fleet,
+           "requests": requests}
+    fleet.stop()
+    single.stop()
+
+
+class TestMarginMerge:
+    def test_cross_shard_records_merge_bit_identically(self, env2):
+        """Records whose user and song hash to DIFFERENT shards force
+        the margin-merge path; totals must still be bit-identical to the
+        unsharded server (sum_coordinate_margins re-run at the router
+        over owner-shard margins)."""
+        # prove the workload actually spans shards
+        spanning = [r for r in env2["requests"]
+                    if shard_of_id(r["metadataMap"]["userId"], 2)
+                    != shard_of_id(r["metadataMap"]["songId"], 2)]
+        assert spanning, "fixture must produce cross-shard records"
+        a = _post(env2["single"].url + "/score",
+                  {"records": env2["requests"]})
+        b = _post(env2["fleet"].url + "/score",
+                  {"records": env2["requests"]})
+        assert b["fanout"]["merged"] > 0
+        assert np.array_equal(
+            np.asarray(a["scores"], np.float64),
+            np.asarray(b["scores"], np.float64))
+
+    def test_margins_response_reproduces_totals(self, env2):
+        """The host-side margins protocol itself: f32 margins + offsets
+        re-reduced through sum_coordinate_margins == the host's scores,
+        bit for bit (the router's merge relies on exactly this)."""
+        from photon_ml_tpu.game.model import sum_coordinate_margins
+
+        host = env2["fleet"].hosts[0]
+        out = _post(host.url + "/score",
+                    {"records": env2["requests"][:16], "margins": True})
+        offsets = np.asarray(out["offsets"], np.float32)
+        margins = [np.asarray(vals, np.float32)
+                   for _cid, vals in out["margins"]]
+        totals = sum_coordinate_margins(offsets, margins, xp=np)
+        assert np.array_equal(totals,
+                              np.asarray(out["scores"], np.float32))
+
+
+# ---------------------------------------------------------------------------
+# per-host refresh patches (refresh_game --fleet-shards)
+# ---------------------------------------------------------------------------
+
+MUTATED_USER = 1  # its shard gets new coefficients; the other stays pat
+
+
+@pytest.fixture(scope="module")
+def patched(env, tmp_path_factory):
+    """Refresh env's base model (R0) with ONE user's rows changed,
+    publishing global + per-host patches, served by a FRESH fleet still
+    on R0 (env's fleet has moved versions by the two-phase tests)."""
+    tmp = str(tmp_path_factory.mktemp("fleet_patch"))
+    r0 = env["model"]
+    d1 = os.path.join(tmp, "d1.avro")
+    # SAME row count/seed as env's d0: unmutated users' rows are
+    # byte-identical, so the manifest diff touches exactly one user
+    write_training_examples(d1, _records(400, 0,
+                                         mutate_users=(MUTATED_USER,)))
+    r1 = os.path.join(tmp, "r1")
+    result = refresh_game_cli.run(
+        ["--prior-dir", r0, "--training-data", d1, "--output-dir", r1,
+         "--fleet-shards", "2"] + COMMON)
+    fleet = serve_fleet_cli.build_fleet(
+        ["--model-dir", r0, "--feature-shards", SHARDS,
+         "--port", "0", "--fleet-shards", "2"])
+    yield {"tmp": tmp, "r0": r0, "r1": r1, "result": result,
+           "fleet": fleet, "requests": env["requests"]}
+    fleet.stop()
+
+
+class TestFleetPatches:
+    def test_refresh_publishes_named_shard_patches(self, patched):
+        dirs = patched["result"]["shard_patch_dirs"]
+        assert len(dirs) == 2
+        model_ids = set()
+        for i, d in enumerate(dirs):
+            with open(os.path.join(d, "model-metadata.json")) as f:
+                md = json.load(f)
+            assert md["kind"] == "coefficient-patch"
+            assert (md["fleetShard"], md["fleetShardCount"]) == (i, 2)
+            assert md["modelId"]
+            model_ids.add(md["modelId"])
+        # every shard's patch chains to the SAME merged model identity:
+        # after each host applies its own, the fleet's lineage is uniform
+        assert len(model_ids) == 1
+
+    def test_shard_patches_partition_the_touched_set(self, patched):
+        """Exactly the mutated user's rows moved, in exactly its shard's
+        patch; the other shard's patch carries no entities."""
+        from photon_ml_tpu.io.avro import iter_avro_file
+
+        touched_shard = shard_of_id(f"u{MUTATED_USER}", 2)
+        for i, d in enumerate(patched["result"]["shard_patch_dirs"]):
+            part = os.path.join(d, "random-effect", "perUser",
+                                "coefficients", "part-00000.avro")
+            recs = list(iter_avro_file(part))
+            if i == touched_shard:
+                assert len(recs) == 1  # only the mutated user re-solved
+            else:
+                assert recs == []
+
+    def test_host_refuses_foreign_shard_patch(self, patched):
+        """The wrong host's 409 is the contract that makes per-host
+        delivery safe: a misrouted patch can never half-apply."""
+        dirs = patched["result"]["shard_patch_dirs"]
+        host0 = patched["fleet"].hosts[0]
+        v0 = _get(host0.url + "/healthz")["version"]
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(host0.url + "/reload", {"model_dir": dirs[1]})
+        assert err.value.code == 409
+        assert "foreign shard" in json.loads(err.value.read())["error"]
+        assert _get(host0.url + "/healthz")["version"] == v0
+
+    def test_unsharded_host_refuses_shard_patch(self, patched):
+        single = serve_game_cli.build_server(
+            ["--model-dir", patched["r0"], "--feature-shards", SHARDS,
+             "--port", "0"]).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _post(single.url + "/reload",
+                      {"model_dir":
+                       patched["result"]["shard_patch_dirs"][0]})
+            assert err.value.code == 409
+            assert "unsharded" in json.loads(err.value.read())["error"]
+        finally:
+            single.stop()
+
+    def test_per_host_patches_activate_with_zero_recompiles_untouched(
+            self, patched):
+        """The fleet refresh endgame: a two-phase reload with per-host
+        patch dirs activates everywhere; the host whose shard saw NO
+        touched entities compiles NOTHING (shared executables), and the
+        patched fleet scores bit-identically to the refreshed full
+        model served unsharded."""
+        fleet = patched["fleet"]
+        dirs = patched["result"]["shard_patch_dirs"]
+        untouched = 1 - shard_of_id(f"u{MUTATED_USER}", 2)
+        compiles0 = [_get(u + "/healthz")["compiles"]
+                     for u in fleet.host_urls()]
+        out = _post(fleet.url + "/reload", {"model_dirs": list(dirs)})
+        compiles1 = [_get(u + "/healthz")["compiles"]
+                     for u in fleet.host_urls()]
+        # the untouched shard's host shares its parent's executables:
+        # activation compiled nothing there (and nothing anywhere — no
+        # new entities appended on the touched host either)
+        assert compiles1[untouched] - compiles0[untouched] == 0
+        healths = [_get(u + "/healthz") for u in fleet.host_urls()]
+        assert {h["model_lineage_id"] for h in healths} \
+            == {out["lineage"]}
+        # patched fleet == refreshed model served unsharded, bit for bit
+        single = serve_game_cli.build_server(
+            ["--model-dir", patched["r1"], "--feature-shards", SHARDS,
+             "--port", "0"]).start()
+        try:
+            a = _post(single.url + "/score",
+                      {"records": patched["requests"]})
+            b = _post(fleet.url + "/score",
+                      {"records": patched["requests"]})
+            assert np.array_equal(
+                np.asarray(a["scores"], np.float64),
+                np.asarray(b["scores"], np.float64))
+        finally:
+            single.stop()
+
+
+# ---------------------------------------------------------------------------
+# open-loop client reconnect (the PR 14 transient-reset fix)
+# ---------------------------------------------------------------------------
+
+
+class TestOpenLoopReconnect:
+    def test_reset_is_retried_counted_and_excluded(self, monkeypatch):
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools"))
+        import bench_serving
+
+        calls = {"n": 0}
+
+        def flaky(url, payload=None, timeout=60.0):
+            calls["n"] += 1
+            if calls["n"] == 2:  # exactly one request's first attempt
+                raise ConnectionResetError(104, "Connection reset by peer")
+            return {"scores": [0.0] * len(payload["records"])}
+
+        monkeypatch.setattr(bench_serving, "_http_json", flaky)
+        run = bench_serving.open_loop_run(
+            "http://unused", [{"a": 1}], [1], target_qps=1000.0,
+            requests=3, concurrency=1)
+        assert run["reconnected"] == 1
+        assert len(run["corrected_ms"]) == 2  # excluded from percentiles
+        assert run["errors"] == [] and run["shed"] == 0
+        # identity: served (measured + reconnected) == offered
+        assert len(run["corrected_ms"]) + run["reconnected"] == 3
+
+    def test_reset_classifier(self):
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools"))
+        import http.client
+
+        import bench_serving
+
+        assert bench_serving._is_reset(ConnectionResetError())
+        assert bench_serving._is_reset(
+            http.client.RemoteDisconnected("gone"))
+        assert bench_serving._is_reset(
+            urllib.error.URLError(ConnectionResetError()))
+        assert not bench_serving._is_reset(ValueError("nope"))
+        assert not bench_serving._is_reset(
+            urllib.error.HTTPError("u", 429, "too many", {}, None))
+
+
+# ---------------------------------------------------------------------------
+# executable sharing (the zero-recompile-activation mechanism)
+# ---------------------------------------------------------------------------
+
+
+class TestSharedExecutables:
+    def test_share_from_reuses_the_program(self, env):
+        host = env["fleet"].hosts[0]
+        sm = host.service.registry.active()
+        from photon_ml_tpu.serving.engine import ScoringEngine
+
+        sm.engine.warmup(max_bucket=8)  # trace a few buckets eagerly
+        derived = ScoringEngine(sm.model, sm.engine.shard_configs,
+                                sm.index_maps, sm.stores,
+                                max_batch=sm.engine.max_batch,
+                                share_from=sm.engine)
+        assert derived._program is sm.engine._program
+        before = derived.compile_count
+        derived.warmup(max_bucket=8)  # already traced by the parent
+        assert derived.compile_count == before

@@ -58,12 +58,34 @@ Usage::
 from __future__ import annotations
 
 import argparse
+import http.client
 import json
 import math
 import threading
 import time
 import urllib.error
 import urllib.request
+
+#: transient connection deaths the open-loop client retries (bounded):
+#: under full-suite CPU contention the stdlib ThreadingHTTPServer's
+#: accept backlog can RST a connection the server never read — the
+#: request was NOT served, so one reconnect is correctness, not retry
+#: amplification. A request that succeeds only after reconnecting is
+#: counted (``reconnected``) and EXCLUDED from the latency percentiles:
+#: its latency measures the client's retry loop, not the server.
+_RESET_ERRORS = (ConnectionResetError, BrokenPipeError,
+                 http.client.RemoteDisconnected)
+
+#: bounded reconnect budget per request
+_MAX_RECONNECTS = 2
+
+
+def _is_reset(e: BaseException) -> bool:
+    if isinstance(e, _RESET_ERRORS):
+        return True
+    return (isinstance(e, urllib.error.URLError)
+            and not isinstance(e, urllib.error.HTTPError)
+            and isinstance(getattr(e, "reason", None), _RESET_ERRORS))
 
 
 def _percentile(xs, q):
@@ -201,6 +223,7 @@ def open_loop_run(base: str, pool, sizes, *, target_qps: float,
     uncorrected: list[float] = []
     errors: list[str] = []
     shed = {"n": 0}
+    reconnected = {"n": 0}
     sent_rows = {"n": 0}
     start = time.perf_counter() + 0.05
 
@@ -218,30 +241,43 @@ def open_loop_run(base: str, pool, sizes, *, target_qps: float,
             size = sizes[i % len(sizes)]
             recs = [pool[(i + j) % len(pool)] for j in range(size)]
             t_send = time.perf_counter()
-            try:
-                out = _http_json(base + "/score", {"records": recs},
-                                 timeout=timeout)
-                assert len(out["scores"]) == size
-            except urllib.error.HTTPError as e:
-                if e.code == 429:
-                    # shed by admission control: that's the server
-                    # WORKING under overload, not failing — counted
-                    # separately, excluded from the latency population
-                    with lock:
-                        shed["n"] += 1
-                else:
-                    with lock:
-                        errors.append(repr(e))
-                continue
-            except Exception as e:
-                with lock:
-                    errors.append(repr(e))
-                continue
-            t_done = time.perf_counter()
+            resets = 0
+            while True:
+                try:
+                    out = _http_json(base + "/score", {"records": recs},
+                                     timeout=timeout)
+                    assert len(out["scores"]) == size
+                    outcome = "served"
+                except urllib.error.HTTPError as e:
+                    if e.code == 429:
+                        # shed by admission control: that's the server
+                        # WORKING under overload, not failing — counted
+                        # separately, excluded from the latency population
+                        outcome = "shed"
+                    else:
+                        outcome = repr(e)
+                except Exception as e:
+                    if _is_reset(e) and resets < _MAX_RECONNECTS:
+                        # backlog RST: the server never read the request —
+                        # reconnect (bounded), count it, keep the latency
+                        # out of the percentiles
+                        resets += 1
+                        continue
+                    outcome = repr(e)
+                break
             with lock:
-                corrected.append((t_done - due) * 1e3)
-                uncorrected.append((t_done - t_send) * 1e3)
-                sent_rows["n"] += size
+                if outcome == "served":
+                    if resets:
+                        reconnected["n"] += 1
+                    else:
+                        t_done = time.perf_counter()
+                        corrected.append((t_done - due) * 1e3)
+                        uncorrected.append((t_done - t_send) * 1e3)
+                    sent_rows["n"] += size
+                elif outcome == "shed":
+                    shed["n"] += 1
+                else:
+                    errors.append(outcome)
 
     threads = [threading.Thread(target=worker) for _ in range(concurrency)]
     t0 = time.perf_counter()
@@ -251,12 +287,16 @@ def open_loop_run(base: str, pool, sizes, *, target_qps: float,
         t.join()
     wall = time.perf_counter() - t0
     # the load-accounting identity every run must satisfy (and the chaos
-    # harness asserts): served + shed + errored == offered
-    assert len(corrected) + shed["n"] + len(errors) == requests
+    # harness asserts): served + shed + errored == offered (served =
+    # measured + reconnect-served)
+    assert len(corrected) + reconnected["n"] + shed["n"] \
+        + len(errors) == requests
     return {"corrected_ms": corrected, "uncorrected_ms": uncorrected,
             "errors": errors, "shed": shed["n"], "offered": requests,
+            "reconnected": reconnected["n"],
             "wall_s": wall, "rows": sent_rows["n"],
-            "achieved_qps": len(corrected) / wall if wall > 0 else 0.0}
+            "achieved_qps": ((len(corrected) + reconnected["n"]) / wall
+                             if wall > 0 else 0.0)}
 
 
 def rank_url(base: str, user, k) -> str:
@@ -277,14 +317,17 @@ def mixed_open_loop_run(base: str, pool, users, sizes, *,
 
     ``rank_every=0`` sends only scores, ``1`` only ranks, ``N>1`` makes
     every Nth request a rank. Returns ``{"score": {...}, "rank": {...}}``
-    with per-kind ``offered``/``corrected_ms``/``shed``/``errors``; each
-    kind independently satisfies (and asserts) the accounting identity
-    ``served + shed + errored == offered`` — what the chaos harness
-    checks per kind under injected faults."""
+    with per-kind ``offered``/``corrected_ms``/``shed``/``errors``/
+    ``reconnected``/``lineages``; each kind independently satisfies (and
+    asserts) the accounting identity ``served + shed + errored ==
+    offered`` (served = measured + reconnect-served) — what the chaos
+    harness checks per kind under injected faults, along with the
+    ``lineages`` set staying a singleton (no mixed-lineage response)."""
     lock = threading.Lock()
     counter = {"i": 0}
     books = {kind: {"offered": 0, "corrected_ms": [], "uncorrected_ms": [],
-                    "shed": 0, "errors": []} for kind in ("score", "rank")}
+                    "shed": 0, "errors": [], "reconnected": 0,
+                    "lineages": set()} for kind in ("score", "rank")}
     start = time.perf_counter() + 0.05
 
     def worker():
@@ -303,34 +346,52 @@ def mixed_open_loop_run(base: str, pool, users, sizes, *,
             with lock:
                 books[kind]["offered"] += 1
             t_send = time.perf_counter()
-            try:
-                if is_rank:
-                    out = _http_json(
-                        rank_url(base, users[i % len(users)],
-                                 ks[i % len(ks)]), timeout=timeout)
-                    assert "ids" in out
-                else:
-                    size = sizes[i % len(sizes)]
-                    recs = [pool[(i + j) % len(pool)] for j in range(size)]
-                    out = _http_json(base + "/score", {"records": recs},
-                                     timeout=timeout)
-                    assert len(out["scores"]) == size
-            except urllib.error.HTTPError as e:
-                with lock:
-                    if e.code == 429:
-                        books[kind]["shed"] += 1
+            resets = 0
+            out = None
+            while True:
+                try:
+                    if is_rank:
+                        out = _http_json(
+                            rank_url(base, users[i % len(users)],
+                                     ks[i % len(ks)]), timeout=timeout)
+                        assert "ids" in out
                     else:
-                        books[kind]["errors"].append(f"{kind}: {e!r}")
-                continue
-            except Exception as e:
-                with lock:
-                    books[kind]["errors"].append(f"{kind}: {e!r}")
-                continue
-            t_done = time.perf_counter()
+                        size = sizes[i % len(sizes)]
+                        recs = [pool[(i + j) % len(pool)]
+                                for j in range(size)]
+                        out = _http_json(base + "/score",
+                                         {"records": recs},
+                                         timeout=timeout)
+                        assert len(out["scores"]) == size
+                    outcome = "served"
+                except urllib.error.HTTPError as e:
+                    outcome = "shed" if e.code == 429 \
+                        else f"{kind}: {e!r}"
+                except Exception as e:
+                    if _is_reset(e) and resets < _MAX_RECONNECTS:
+                        resets += 1
+                        continue
+                    outcome = f"{kind}: {e!r}"
+                break
             with lock:
-                books[kind]["corrected_ms"].append((t_done - due) * 1e3)
-                books[kind]["uncorrected_ms"].append(
-                    (t_done - t_send) * 1e3)
+                if outcome == "served":
+                    # every served response's content lineage: the chaos
+                    # harness asserts a fleet never answered from two
+                    # model generations in one load window
+                    if "lineage" in out:
+                        books[kind]["lineages"].add(out["lineage"])
+                    if resets:
+                        books[kind]["reconnected"] += 1
+                    else:
+                        t_done = time.perf_counter()
+                        books[kind]["corrected_ms"].append(
+                            (t_done - due) * 1e3)
+                        books[kind]["uncorrected_ms"].append(
+                            (t_done - t_send) * 1e3)
+                elif outcome == "shed":
+                    books[kind]["shed"] += 1
+                else:
+                    books[kind]["errors"].append(outcome)
 
     threads = [threading.Thread(target=worker) for _ in range(concurrency)]
     t0 = time.perf_counter()
@@ -340,8 +401,8 @@ def mixed_open_loop_run(base: str, pool, users, sizes, *,
         t.join()
     wall = time.perf_counter() - t0
     for kind, b in books.items():
-        assert (len(b["corrected_ms"]) + b["shed"] + len(b["errors"])
-                == b["offered"]), (kind, b)
+        assert (len(b["corrected_ms"]) + b["reconnected"] + b["shed"]
+                + len(b["errors"]) == b["offered"]), (kind, b)
     books["wall_s"] = wall
     books["offered"] = requests
     return books
@@ -381,6 +442,38 @@ def slo_gate_verdict(corrected_p99_ms: float, slo_p99_ms: float,
     return verdict
 
 
+def _synthesize_pool(pool_size, shard_configs, index_maps, ids_by_type):
+    """Synthetic replay records over a model's own feature space +
+    per-entity-type raw-id universe (plus ~10% unseen entities — the
+    cold-start path is part of traffic)."""
+    import numpy as np
+
+    from photon_ml_tpu.types import NAME_TERM_DELIMITER
+
+    rng = np.random.default_rng(7)
+    records = []
+    for i in range(pool_size):
+        feats = []
+        for cfg in shard_configs:
+            names = [k for k in index_maps[cfg.shard_id].names()
+                     if not k.startswith("(INTERCEPT)")]
+            take = rng.choice(len(names), size=min(6, len(names)),
+                              replace=False)
+            for t in take:
+                name, _, term = names[int(t)].partition(NAME_TERM_DELIMITER)
+                feats.append({"name": name, "term": term,
+                              "value": float(rng.normal())})
+        meta = {}
+        for re_type, ids in ids_by_type.items():
+            if ids and rng.random() > 0.1:
+                meta[re_type] = ids[int(rng.integers(len(ids)))]
+            else:
+                meta[re_type] = f"__cold_{i}"
+        records.append({"features": feats, "metadataMap": meta,
+                        "offset": None})
+    return records
+
+
 def _request_pool(args, server):
     """Records to replay: --data avro file when given, else synthetic
     records drawn from the model's own feature/entity universe (plus a
@@ -395,36 +488,27 @@ def _request_pool(args, server):
     if server is None:
         raise SystemExit("--data is required with --url (a remote bench "
                          "can't introspect the model's feature space)")
-    import numpy as np
-
-    from photon_ml_tpu.types import NAME_TERM_DELIMITER
-
     sm = server.service.registry.active()
-    rng = np.random.default_rng(7)
-    records = []
-    stores = list(sm.stores.values())
-    for i in range(args.pool):
-        feats = []
-        for cfg in sm.engine.shard_configs:
-            names = [k for k in sm.index_maps[cfg.shard_id].names()
-                     if not k.startswith("(INTERCEPT)")]
-            take = rng.choice(len(names), size=min(6, len(names)),
-                              replace=False)
-            for t in take:
-                name, _, term = names[int(t)].partition(NAME_TERM_DELIMITER)
-                feats.append({"name": name, "term": term,
-                              "value": float(rng.normal())})
-        meta = {}
-        for store in stores:
-            ids = list(store.row_of_id)
-            # ~10% unseen entities: the fallback path is part of traffic
-            if ids and rng.random() > 0.1:
-                meta[store.random_effect_type] = ids[int(rng.integers(len(ids)))]
-            else:
-                meta[store.random_effect_type] = f"__cold_{i}"
-        records.append({"features": feats, "metadataMap": meta,
-                        "offset": None})
-    return records
+    ids_by_type = {store.random_effect_type: list(store.row_of_id)
+                   for store in sm.stores.values()}
+    return _synthesize_pool(args.pool, sm.engine.shard_configs,
+                            sm.index_maps, ids_by_type)
+
+
+def fleet_request_pool(args, fleet):
+    """The fleet twin of :func:`_request_pool`: the id universe is the
+    UNION of every host's shard slice, so replay traffic exercises every
+    shard (plus the cold slice, which hashes wherever it hashes)."""
+    if args.data:
+        return _request_pool(args, None)  # returns the replay file
+    ids_by_type: dict = {}
+    sm0 = fleet.hosts[0].service.registry.active()
+    for host in fleet.hosts:
+        for store in host.service.registry.active().stores.values():
+            ids_by_type.setdefault(
+                store.random_effect_type, []).extend(store.row_of_id)
+    return _synthesize_pool(args.pool, sm0.engine.shard_configs,
+                            sm0.index_maps, ids_by_type)
 
 
 def _rank_users(server, pool, n: int = 64) -> list:
@@ -561,8 +645,9 @@ def run_ranked(args, server, base: str, pool) -> None:
 
         # in-process run: the server's /rank books must match the
         # client's exactly (the request-latency histogram excludes sheds
-        # by contract)
-        done = len(closed_all) + len(book["corrected_ms"])
+        # by contract; reconnect-served requests were served once)
+        done = len(closed_all) + len(book["corrected_ms"]) \
+            + book["reconnected"]
         hist = int(series_value(metrics1,
                                 "photon_rank_request_latency_seconds_count")
                    - series_value(metrics0 or {},
@@ -618,6 +703,103 @@ def run_ranked(args, server, base: str, pool) -> None:
             f"{slo_line['slo_p99_ms']} ms")
 
 
+def run_fleet(args) -> None:
+    """``--mode fleet``: open-loop load through a router over N local
+    entity-sharded hosts (cli/serve_fleet.py) — shed classification, the
+    SLO gate and the zero-recompile assert all reused from the
+    single-host bench; the recompile count sums over every host. Prints
+    the same one-JSON-line-per-metric artifact."""
+    from photon_ml_tpu.cli.serve_fleet import build_fleet
+
+    if not (args.model_dir and args.feature_shards):
+        raise SystemExit("--mode fleet spawns its own fleet: --model-dir "
+                         "and --feature-shards are required")
+    fleet_argv = [
+        "--model-dir", args.model_dir,
+        "--feature-shards", args.feature_shards,
+        "--port", "0", "--max-wait-ms", str(args.max_wait_ms),
+        "--fleet-shards", str(args.fleet_shards),
+    ]
+    if args.max_queue is not None:
+        fleet_argv += ["--max-queue", str(args.max_queue)]
+    if args.rank_item_coordinate:
+        fleet_argv += ["--rank-item-coordinate", args.rank_item_coordinate,
+                       "--rank-max-k", str(args.rank_max_k)]
+    fleet = build_fleet(fleet_argv)
+    base = fleet.url
+    try:
+        wait_ready(base)
+        pool = fleet_request_pool(args, fleet)
+        sizes = [int(s) for s in args.batch_sizes.split(",") if s]
+        compiles0 = [_http_json(h + "/healthz")["compiles"]
+                     for h in fleet.host_urls()]
+        concurrency = args.concurrency if args.concurrency != 4 else 16
+        run = open_loop_run(base, pool, sizes,
+                            target_qps=args.target_qps,
+                            requests=args.requests,
+                            concurrency=concurrency)
+        compiles1 = [_http_json(h + "/healthz")["compiles"]
+                     for h in fleet.host_urls()]
+        health = _http_json(base + "/healthz")
+    finally:
+        fleet.stop()
+    shed_rate = run["shed"] / run["offered"] if run["offered"] else 0.0
+    corrected_p99 = _percentile(run["corrected_ms"], 99)
+    results = [{
+        "metric": "serving_fleet_open_loop_latency_ms",
+        "value": round(_percentile(run["corrected_ms"], 50), 3),
+        "unit": "ms p50 (open-loop POST /score through the fleet router "
+                "at N local hosts, latency-corrected from schedule; 429 "
+                "sheds excluded, reported as shed_rate)",
+        "corrected_p50_ms": round(_percentile(run["corrected_ms"], 50), 3),
+        "corrected_p99_ms": round(corrected_p99, 3),
+        "uncorrected_p99_ms": round(
+            _percentile(run["uncorrected_ms"], 99), 3),
+        "target_qps": args.target_qps,
+        "achieved_qps": round(run["achieved_qps"], 1),
+        "n_requests": len(run["corrected_ms"]),
+        "n_shed": run["shed"],
+        "shed_rate": round(shed_rate, 4),
+        "n_errors": len(run["errors"]),
+        "n_reconnected": run["reconnected"],
+        "n_shards": health["n_shards"],
+        "host_status": [h.get("status") for h in health["hosts"]],
+        # the fleet activation/zero-recompile story: per-host compile
+        # deltas across the load window must all be zero
+        "recompiles_during_load": [c1 - c0 for c0, c1
+                                   in zip(compiles0, compiles1)],
+    }]
+    slo_line = None
+    if args.slo_p99_ms is not None:
+        slo_line = {"metric": "serving_slo_gate", "workload": "fleet"}
+        slo_line.update(slo_gate_verdict(corrected_p99, args.slo_p99_ms,
+                                         shed_rate=shed_rate))
+        results.append(slo_line)
+    for r in results:
+        print(json.dumps(r), flush=True)
+    head = results[0]
+    print(json.dumps({
+        "metric": "suite_summary",
+        "value": head["value"],
+        "unit": head["unit"],
+        "p99_ms": head["corrected_p99_ms"],
+        "zero_recompiles": all(c == 0
+                               for c in head["recompiles_during_load"]),
+        "slo_verdict": slo_line.get("verdict") if slo_line else None,
+        "shed_rate": head["shed_rate"],
+        "n_errors": len(run["errors"]),
+        "wall_s": round(run["wall_s"], 2),
+    }), flush=True)
+    if run["errors"]:
+        raise SystemExit(f"{len(run['errors'])} failed requests, "
+                         f"first: {run['errors'][0]}")
+    if slo_line is not None and slo_line.get("verdict") == "regression":
+        raise SystemExit(
+            f"p99 SLO gate (fleet): corrected p99 "
+            f"{slo_line['corrected_p99_ms']} ms > SLO "
+            f"{slo_line['slo_p99_ms']} ms")
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
     p.add_argument("--model-dir")
@@ -626,14 +808,18 @@ def main(argv=None):
                                  "of spawning one in-process")
     p.add_argument("--data", help="avro file of records to replay "
                                   "(default: synthesize from the model)")
-    p.add_argument("--mode", choices=["closed", "open", "ranked"],
+    p.add_argument("--mode", choices=["closed", "open", "ranked", "fleet"],
                    default="closed",
                    help="closed = workers re-send on completion (hides "
                         "coordinated omission; percentiles labeled "
                         "closed_loop_*); open = fixed --target-qps "
                         "schedule with latency-corrected percentiles; "
                         "ranked = GET /rank closed-loop k sweep + "
-                        "open-loop load with shed classification")
+                        "open-loop load with shed classification; "
+                        "fleet = open-loop /score through a router over "
+                        "--fleet-shards local entity-sharded hosts "
+                        "(serve_fleet), same shed classification + SLO "
+                        "gate")
     p.add_argument("--target-qps", type=float, default=100.0,
                    help="open-loop arrival rate (requests/s)")
     p.add_argument("--slo-p99-ms", type=float, default=None,
@@ -666,7 +852,16 @@ def main(argv=None):
     p.add_argument("--rank-ks", default="1,10,64",
                    help="comma-separated k sweep for --mode ranked "
                         "(each k is clamped by the server's max)")
+    p.add_argument("--fleet-shards", type=int, default=2,
+                   help="--mode fleet: entity-sharded hosts behind the "
+                        "in-process router (serve_fleet --fleet-shards)")
     args = p.parse_args(argv)
+
+    if args.mode == "fleet":
+        # the fleet workload owns its whole artifact (router spawn,
+        # per-host recompile deltas, SLO gate)
+        run_fleet(args)
+        return
 
     server = None
     server_events = []
@@ -762,6 +957,9 @@ def main(argv=None):
             "n_shed": run["shed"],
             "shed_rate": round(shed_rate, 4),
             "n_errors": len(errors),
+            # served after a bounded reconnect-on-reset (backlog RST
+            # under CPU contention): excluded from the percentiles
+            "n_reconnected": run["reconnected"],
             "concurrency": concurrency,
             "batch_sizes": sizes,
             "recompiles_during_load": health["compiles"] - compiles0,
@@ -922,8 +1120,9 @@ def main(argv=None):
         if server is not None:
             # in-process run = the bench is the only traffic, so the
             # server's own books must match the client's exactly
+            # (reconnect-served open-loop requests were served once)
             n_done = (len(latencies) if args.mode == "closed"
-                      else len(run["corrected_ms"]))
+                      else len(run["corrected_ms"]) + run["reconnected"])
             if args.mode == "open":
                 # every client-observed 429 is exactly one server-side
                 # shed (and vice versa) — the admission-control books

@@ -108,6 +108,215 @@ def settle(server, base: str, timeout_s: float = 10.0) -> dict:
     return bench_serving._http_json(base + "/readyz")
 
 
+def run_fleet_chaos(args) -> int:
+    """``--fleet``: the fleet-router chaos cells (ISSUE 15). An N=2
+    entity-sharded fleet (cli/serve_fleet.py) under three failure
+    shapes, each asserting the books and the bit-parity pins:
+
+    - **fanout-fault**: seeded ``fleet.fanout`` faults during mixed
+      open-loop load — per-kind ``served + shed + errored == offered``,
+      no served response EVER carries a second model lineage, probe
+      scores + top-k bit-identical after the storm;
+    - **host-kill**: one host stopped mid-load (the real crash shape) —
+      the identity still holds (lost-shard traffic becomes typed 503s,
+      counted as errors), and after restarting the host on its port the
+      fleet's probe scores are bit-identical to the pinned ones;
+    - **two-phase-abort**: an injected ``serving.reload`` fault fails ONE
+      host's prepare — the epoch aborts (409), every host's version and
+      the probe scores are untouched.
+    """
+    import threading
+
+    from photon_ml_tpu.cli import serve_fleet, serve_game
+    from photon_ml_tpu.resilience import FaultPlan, injected
+    from photon_ml_tpu.resilience.retry import (
+        get_default_policy,
+        set_default_policy,
+    )
+
+    requests = min(args.requests, 150) if args.budget == "smoke" \
+        else args.requests
+    rate = float(args.rates.split(",")[0])
+    cells: list[dict] = []
+    failures: list[str] = []
+    prev_policy = get_default_policy()
+    with tempfile.TemporaryDirectory() as tmp:
+        model_dir, train_path = train_model(tmp, args.rows)
+        set_default_policy(prev_policy)
+        fleet = serve_fleet.build_fleet([
+            "--model-dir", model_dir,
+            "--feature-shards", chaos_sweep.SHARDS,
+            "--port", "0", "--fleet-shards", "2",
+            "--microbatch", "8", "--max-wait-ms", "1",
+            "--max-queue", str(args.max_queue),
+            "--rank-item-coordinate", "perUser", "--rank-max-k", "16",
+        ])
+        base = fleet.url
+        bench_serving.wait_ready(base)
+        from photon_ml_tpu.io.avro import iter_avro_file
+
+        pool = list(iter_avro_file(train_path))[:256]
+        users = list(dict.fromkeys(
+            (rec.get("metadataMap") or {}).get("userId", "u0")
+            for rec in pool))
+        probe = {"records": pool[:5]}
+        probe_scores = bench_serving._http_json(
+            base + "/score", probe)["scores"]
+        probe_rank_url = bench_serving.rank_url(base, users[0], 5)
+        probe_rank = bench_serving._http_json(probe_rank_url)
+        probe_topk = (probe_rank["ids"], probe_rank["scores"])
+        print(f"[chaos-serving] fleet up at {base} "
+              f"(hosts: {', '.join(fleet.host_urls())}), probes pinned",
+              flush=True)
+
+        def run_mixed(n):
+            return bench_serving.mixed_open_loop_run(
+                base, pool, users, [1], target_qps=args.target_qps,
+                requests=n, ks=(3, 8), rank_every=4)
+
+        def check_books(cell, run, ceiling):
+            problems = []
+            for kind in ("score", "rank"):
+                b = run[kind]
+                if (len(b["corrected_ms"]) + b["reconnected"] + b["shed"]
+                        + len(b["errors"]) != b["offered"]):
+                    problems.append(f"{kind} accounting broke: {b}")
+                if len(b["lineages"]) > 1:
+                    problems.append(
+                        f"{kind} responses MIXED lineages: "
+                        f"{sorted(b['lineages'])}")
+            errored = sum(len(run[k]["errors"]) for k in ("score", "rank"))
+            if errored > ceiling * run["offered"]:
+                problems.append(f"error rate {errored / run['offered']:.3f}"
+                                f" > ceiling {ceiling}")
+            cell.update(
+                offered=run["offered"],
+                served=sum(len(run[k]["corrected_ms"])
+                           + run[k]["reconnected"]
+                           for k in ("score", "rank")),
+                shed=sum(run[k]["shed"] for k in ("score", "rank")),
+                errored=errored)
+            return problems
+
+        def check_probes(problems):
+            after = bench_serving._http_json(base + "/score", probe)
+            if after["scores"] != probe_scores:
+                problems.append("probe scores changed")
+            rank_after = bench_serving._http_json(probe_rank_url)
+            if (rank_after["ids"], rank_after["scores"]) != probe_topk:
+                problems.append("probe top-k changed")
+
+        try:
+            # --- cell 1: injected fan-out faults under mixed load -------
+            plan_obj = {"seed": 0,
+                        "specs": [{"site": "fleet.fanout", "rate": rate}]}
+            cell = {"cell": "fanout-fault", "plan": plan_obj}
+            with injected(FaultPlan.from_json(plan_obj)):
+                run = run_mixed(requests)
+            # a faulted leg fails the whole fan-out (typed 503) and
+            # /score legs can fan 2-wide — the ceiling doubles the rate,
+            # plus parse-noise headroom like the single-host grid
+            problems = check_books(cell, run, max(args.error_ceiling,
+                                                  4 * rate))
+            check_probes(problems)
+            cell["ok"] = not problems
+            cells.append(cell)
+            print(f"[chaos-serving] fleet fanout-fault: "
+                  f"offered={run['offered']} served={cell['served']} "
+                  f"errored={cell['errored']} "
+                  f"{'ok' if cell['ok'] else 'FAIL'}", flush=True)
+            if problems:
+                failures.append("fleet fanout-fault: " + "; ".join(problems)
+                                + f" — repro with PHOTON_FAULT_PLAN="
+                                  f"'{json.dumps(plan_obj)}'")
+
+            # --- cell 2: kill one host mid-load, then restart it --------
+            cell = {"cell": "host-kill"}
+            victim = fleet.hosts[1]
+            victim_port = victim.port
+            killer = threading.Timer(
+                0.25 * requests / args.target_qps, victim.stop)
+            killer.start()
+            run = run_mixed(requests)
+            killer.join()
+            # losing one of two shards costs up to ~all rank traffic and
+            # the dead shard's score traffic — the identity is the claim,
+            # not a low error rate
+            problems = check_books(cell, run, 1.0)
+            restarted = serve_game.build_server([
+                "--model-dir", model_dir,
+                "--feature-shards", chaos_sweep.SHARDS,
+                "--port", str(victim_port),
+                "--microbatch", "8", "--max-wait-ms", "1",
+                "--max-queue", str(args.max_queue),
+                "--rank-item-coordinate", "perUser", "--rank-max-k", "16",
+                "--brownout-poll-s", "0",
+                "--fleet-shard", "1", "--fleet-shard-count", "2",
+            ]).start()
+            fleet.hosts[1] = restarted
+            bench_serving.wait_ready(base)
+            check_probes(problems)  # bit-identical across kill + restart
+            ready = bench_serving._http_json(base + "/readyz")
+            if not ready["ready"]:
+                problems.append(f"fleet not ready after restart: {ready}")
+            cell["ok"] = not problems
+            cells.append(cell)
+            print(f"[chaos-serving] fleet host-kill: "
+                  f"offered={run['offered']} served={cell['served']} "
+                  f"errored={cell['errored']} "
+                  f"{'ok' if cell['ok'] else 'FAIL'}", flush=True)
+            if problems:
+                failures.append("fleet host-kill: " + "; ".join(problems))
+
+            # --- cell 3: two-phase abort (one host refuses prepare) -----
+            reload_plan = {"seed": 0,
+                           "specs": [{"site": "serving.reload", "at": [0]}]}
+            cell = {"cell": "two-phase-abort", "plan": reload_plan}
+            versions0 = [bench_serving._http_json(u + "/healthz")["version"]
+                         for u in fleet.host_urls()]
+            status = None
+            with injected(FaultPlan.from_json(reload_plan)):
+                try:
+                    bench_serving._http_json(base + "/reload",
+                                             {"model_dir": model_dir})
+                    status = 200
+                except Exception as e:
+                    status = getattr(e, "code", None)
+            versions1 = [bench_serving._http_json(u + "/healthz")["version"]
+                         for u in fleet.host_urls()]
+            problems = []
+            if status != 409:
+                problems.append(f"faulted two-phase reload returned "
+                                f"{status}, want 409")
+            if versions1 != versions0:
+                problems.append(f"active versions moved {versions0} → "
+                                f"{versions1} across an aborted epoch")
+            check_probes(problems)
+            cell.update(reload_status=status, versions=versions1,
+                        ok=not problems)
+            cells.append(cell)
+            print(f"[chaos-serving] fleet two-phase-abort: status={status} "
+                  f"{'ok' if cell['ok'] else 'FAIL'}", flush=True)
+            if problems:
+                failures.append("fleet two-phase-abort: "
+                                + "; ".join(problems))
+        finally:
+            fleet.stop()
+            set_default_policy(prev_policy)
+
+        artifact = {"budget": args.budget, "fleet": True,
+                    "cells": cells, "failures": failures}
+        out_path = args.output or os.path.join(tmp, "chaos_serving.json")
+        with open(out_path, "w") as f:
+            json.dump(artifact, f, indent=2)
+
+    n_ok = sum(1 for c in cells if c["ok"])
+    print(f"[chaos-serving] {n_ok}/{len(cells)} fleet cells passed")
+    for f_ in failures:
+        print(f"[chaos-serving] FAILED: {f_}")
+    return 1 if failures else 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description="serving chaos harness: open-loop load under seeded "
@@ -132,7 +341,18 @@ def main(argv=None) -> int:
     p.add_argument("--output", default=None,
                    help="where to write chaos_serving.json (default: the "
                         "harness temp dir, i.e. discarded)")
+    p.add_argument("--fleet", action="store_true",
+                   help="run the FLEET cells instead: an N=2 "
+                        "entity-sharded fleet behind the router under "
+                        "injected fleet.fanout faults, a mid-load host "
+                        "kill + restart, and a faulted two-phase reload "
+                        "— accounting identity per kind, no "
+                        "mixed-lineage response, probe scores "
+                        "bit-identical fleet-wide")
     args = p.parse_args(argv)
+
+    if args.fleet:
+        return run_fleet_chaos(args)
 
     seeds = [int(s) for s in args.seeds.split(",") if s]
     rates = [float(r) for r in args.rates.split(",") if r]
@@ -201,7 +421,7 @@ def main(argv=None) -> int:
                             target_qps=args.target_qps,
                             requests=requests, ks=(3, 8), rank_every=4)
                     kinds = {k: run[k] for k in ("score", "rank")}
-                    served = sum(len(b["corrected_ms"])
+                    served = sum(len(b["corrected_ms"]) + b["reconnected"]
                                  for b in kinds.values())
                     shed = sum(b["shed"] for b in kinds.values())
                     errored = sum(len(b["errors"]) for b in kinds.values())
@@ -223,11 +443,13 @@ def main(argv=None) -> int:
                         ready_after=ready["ready"])
                     problems = []
                     for kind, b in kinds.items():
-                        if (len(b["corrected_ms"]) + b["shed"]
+                        if (len(b["corrected_ms"]) + b["reconnected"]
+                                + b["shed"]
                                 + len(b["errors"]) != b["offered"]):
                             problems.append(
                                 f"{kind} accounting broke: "
-                                f"{len(b['corrected_ms'])}+{b['shed']}+"
+                                f"{len(b['corrected_ms'])}+"
+                                f"{b['reconnected']}+{b['shed']}+"
                                 f"{len(b['errors'])} != {b['offered']}")
                     if shed_delta != shed:
                         problems.append(
