@@ -1309,6 +1309,11 @@ SERVING_USERS = 500
 SERVING_SONGS = 200
 SERVING_REQUESTS = 400
 SERVING_TARGET_QPS = 100.0
+# the R=2 fleet keeps 2x the hosts resident per core, so its knee sits
+# below the single-host target on the bench box; an open-loop target
+# past the knee measures queue growth, not the routing machinery —
+# aim the fleet workload below it
+FLEET_TARGET_QPS = 80.0
 
 
 def bench_serving_slo():
@@ -1388,15 +1393,19 @@ def bench_serving_slo():
 
 
 def bench_serving_fleet():
-    """Open-loop fleet serving bench (the ISSUE 15 workload): the same
-    tiny GAME model served from TWO entity-sharded hosts behind the
-    fleet router (``cli/serve_fleet.py``), open-loop /score load through
-    the router. The metric is achieved requests/s; ``vs_baseline`` is
-    the p99 SLO headroom (``PHOTON_FLEET_SLO_P99_MS``, default 250 ms —
-    one extra local HTTP hop vs the single-host SLO). This is the number
-    BENCH_r06 sizes the fleet against: compare with
-    ``serving_open_loop_qps`` to read the router tax, and the per-host
-    entity counts in the extras to read the table-byte split."""
+    """Open-loop fleet serving bench (the ISSUE 15 workload, grown by
+    ISSUE 16): the same tiny GAME model served from two entity-sharded
+    shards at TWO replicas each behind the fleet router
+    (``cli/serve_fleet.py``), open-loop /score load through the router,
+    then one live reshard epoch driven after the timed window. The
+    metric is achieved
+    requests/s; ``vs_baseline`` is the p99 SLO headroom
+    (``PHOTON_FLEET_SLO_P99_MS``, default 250 ms — one extra local HTTP
+    hop vs the single-host SLO). This is the number BENCH_r06 sizes the
+    fleet against: compare with ``serving_open_loop_qps`` to read the
+    router tax, the per-host entity counts in the extras to read the
+    table-byte split, and ``hedge_rate``/``reshard_epochs`` to read the
+    elasticity machinery's footprint under load."""
     import argparse
     import tempfile
 
@@ -1427,18 +1436,48 @@ def bench_serving_fleet():
         fleet = serve_fleet_cli.build_fleet([
             "--model-dir", out, "--feature-shards", shards,
             "--port", "0", "--max-wait-ms", "1", "--fleet-shards", "2",
+            "--replicas", "2",
         ])
+        reshard_box = {}
+
+        def _fire_reshard():
+            # one live shard-map epoch: move eight buckets that actually
+            # hold shard-0 rows, so reshard_epochs and the moved-row
+            # counters record the two-phase machinery doing real repack
+            # work. Runs AFTER the timed window — the prepare warmup's
+            # compile sweep is off the serving path by design, but with
+            # four hosts in one process it starves the box's cores and
+            # would pollute the qps number (reshard UNDER traffic is the
+            # chaos harness's claim, tools/chaos_serving.py --fleet)
+            from photon_ml_tpu.fleet.sharding import bucket_of_id
+            try:
+                smap = fleet.router.shard_map
+                donors = sorted({
+                    bucket_of_id(str(i))
+                    for h in fleet.hosts
+                    for store in h.service.registry.active().stores.values()
+                    for i in store.row_of_id
+                    if smap.shard_of(str(i)) == 0})[:8]
+                reshard_box["out"] = bench_serving._http_json(
+                    fleet.url + "/reshard",
+                    {"moves": {str(b): 1 for b in donors}})
+            except Exception as e:
+                reshard_box["error"] = repr(e)
+
         try:
             pool = bench_serving.fleet_request_pool(
                 argparse.Namespace(data=None, pool=128), fleet)
             compiles0 = [bench_serving._http_json(u + "/healthz")["compiles"]
                          for u in fleet.host_urls()]
+            metrics0 = bench_serving._scrape_process_metrics()
             run = bench_serving.open_loop_run(
                 fleet.url, pool, [1, 1, 1, 2, 4],
-                target_qps=SERVING_TARGET_QPS, requests=SERVING_REQUESTS,
+                target_qps=FLEET_TARGET_QPS, requests=SERVING_REQUESTS,
                 concurrency=16)
             compiles1 = [bench_serving._http_json(u + "/healthz")["compiles"]
                          for u in fleet.host_urls()]
+            _fire_reshard()
+            metrics1 = bench_serving._scrape_process_metrics()
             entities = [
                 sum(s.n_entities
                     for s in h.service.registry.active().stores.values())
@@ -1450,15 +1489,25 @@ def bench_serving_fleet():
     verdict = bench_serving.slo_gate_verdict(
         corrected_p99, slo_ms,
         shed_rate=run["shed"] / max(run["offered"], 1))
+    elastic = bench_serving.fleet_elastic_extras(
+        metrics0, metrics1, run["offered"])
     _emit("serving_fleet_qps", run["achieved_qps"],
           "req/s (open loop /score through the fleet router, 2 local "
-          "entity-sharded hosts, latency-corrected percentiles)",
+          "entity-sharded shards x 2 replicas with hedged fan-out, "
+          "latency-corrected percentiles; one live reshard epoch driven "
+          "after the window, footprint in the extras)",
           verdict["headroom"],
           corrected_p50_ms=round(
               bench_serving._percentile(run["corrected_ms"], 50), 3),
           corrected_p99_ms=round(corrected_p99, 3),
-          target_qps=SERVING_TARGET_QPS,
+          target_qps=FLEET_TARGET_QPS,
           n_shards=2,
+          replicas=2,
+          hedge_rate=elastic["hedge_rate"],
+          replica_retries=elastic["replica_retries"],
+          reshard_epochs=elastic["reshard_epochs"],
+          reshard_moved=(reshard_box.get("out") or {}).get("moved"),
+          reshard_error=reshard_box.get("error"),
           entities_per_host=entities,
           recompiles_during_load=[c1 - c0 for c0, c1
                                   in zip(compiles0, compiles1)],

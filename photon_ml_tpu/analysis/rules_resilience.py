@@ -245,9 +245,34 @@ def _is_crc32_call(node: ast.AST, zlib_aliases: set[str],
     return False
 
 
+#: the virtual-bucket count (``fleet.sharding.N_BUCKETS``); a literal
+#: ``% 4096`` outside the home is ad-hoc bucket math
+_N_BUCKETS_LITERAL = 4096
+
+
+def _is_bucket_mod(node: ast.AST, bucket_names: set[str],
+                   sharding_aliases: set[str]) -> bool:
+    """True for a ``<expr> % 4096`` / ``<expr> % N_BUCKETS`` modulo — the
+    virtual-bucket half of the placement hash recomputed outside the home
+    (``N_BUCKETS`` matched via its from-import alias or as an attribute of
+    an imported ``fleet.sharding`` module alias)."""
+    if not (isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mod)):
+        return False
+    right = node.right
+    if (isinstance(right, ast.Constant)
+            and right.value == _N_BUCKETS_LITERAL):
+        return True
+    if isinstance(right, ast.Name) and right.id in bucket_names:
+        return True
+    return (isinstance(right, ast.Attribute)
+            and right.attr == "N_BUCKETS"
+            and isinstance(right.value, ast.Name)
+            and right.value.id in sharding_aliases)
+
+
 @rule("res-shard-home",
-      "entity→shard hashing primitives (crc32 bucketing) stay in "
-      "fleet/sharding.py")
+      "entity→shard hashing primitives (crc32 + virtual-bucket math) stay "
+      "in fleet/sharding.py")
 def check_shard_home(ctx: FileContext):
     if ctx.path in {os.path.normpath(p) for p in SHARD_HOME | SHARD_EXEMPT}:
         return
@@ -255,6 +280,9 @@ def check_shard_home(ctx: FileContext):
     binascii_aliases = ctx.module_aliases("binascii")
     crc_names = (ctx.from_aliases("zlib", "crc32")
                  | ctx.from_aliases("binascii", "crc32"))
+    bucket_names = ctx.from_aliases("photon_ml_tpu.fleet.sharding",
+                                    "N_BUCKETS")
+    sharding_aliases = ctx.module_aliases("photon_ml_tpu.fleet.sharding")
     for node in ast.walk(ctx.tree):
         if _is_crc32_call(node, zlib_aliases, binascii_aliases, crc_names):
             yield ctx.finding(
@@ -264,6 +292,15 @@ def check_shard_home(ctx: FileContext):
                 "come from the one hashing home or two components can "
                 "silently disagree on which host owns an id; call "
                 "fleet.sharding.shard_of_id/crc_bucket/stable_hash_u32")
+        elif _is_bucket_mod(node, bucket_names, sharding_aliases):
+            yield ctx.finding(
+                "res-shard-home", node,
+                "virtual-bucket modulo outside fleet/sharding.py — "
+                "bucket→shard placement goes through the versioned "
+                "ShardMap (id → bucket → shard); recomputing "
+                "`% N_BUCKETS` elsewhere silently disagrees with a "
+                "resharded map; call fleet.sharding.bucket_of_id/"
+                "ShardMap.shard_of")
 
 
 #: serving/ — the one package where every queue must be bounded (the

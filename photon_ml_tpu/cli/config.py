@@ -568,8 +568,13 @@ class RouterConfig:
     """The fleet router's knobs (``serve_fleet``), round-trippable through
     a JSON config file like :class:`ResilienceConfig`.
 
-    ``fleet_shards`` is N — how many entity-sharded hosts the router
-    fronts (each must serve ``--fleet-shard I --fleet-shard-count N``);
+    ``fleet_shards`` is N — how many entity-sharded shard groups the
+    router fronts; ``replicas`` is R — how many serving hosts per shard
+    group (each serving the SAME ``--fleet-shard I --fleet-shard-count
+    N`` view; R ≥ 2 turns a dead host into a replica retry instead of a
+    503, and lets the router hedge slow legs); ``hedge_delay_ms`` fixes
+    when the backup replica fires against a still-pending primary (0 =
+    adaptive: the p99 of the shard's recent leg latencies);
     ``fanout_timeout_s`` bounds each per-host leg (a slower host becomes
     a typed 503 ``reason=upstream``, never a hang);
     ``request_timeout_ms`` is the router-side default deadline for
@@ -578,6 +583,8 @@ class RouterConfig:
     """
 
     fleet_shards: int = 2
+    replicas: int = 1
+    hedge_delay_ms: float = 0.0
     fanout_timeout_s: float = 30.0
     request_timeout_ms: float = 0.0
 
@@ -585,6 +592,12 @@ class RouterConfig:
         if self.fleet_shards < 1:
             raise ValueError(f"fleet_shards must be >= 1, "
                              f"got {self.fleet_shards}")
+        if self.replicas < 1:
+            raise ValueError(f"replicas must be >= 1, "
+                             f"got {self.replicas}")
+        if self.hedge_delay_ms < 0:
+            raise ValueError(f"hedge_delay_ms must be >= 0, "
+                             f"got {self.hedge_delay_ms}")
         if self.fanout_timeout_s <= 0:
             raise ValueError(f"fanout_timeout_s must be > 0, "
                              f"got {self.fanout_timeout_s}")
@@ -592,12 +605,16 @@ class RouterConfig:
     # --- config-file round-trip ------------------------------------------
     def as_dict(self) -> dict:
         return {"fleetShards": self.fleet_shards,
+                "replicas": self.replicas,
+                "hedgeDelayMs": self.hedge_delay_ms,
                 "fanoutTimeoutS": self.fanout_timeout_s,
                 "requestTimeoutMs": self.request_timeout_ms}
 
     @classmethod
     def from_dict(cls, d: Mapping) -> "RouterConfig":
         return cls(fleet_shards=int(d.get("fleetShards", 2)),
+                   replicas=int(d.get("replicas", 1)),
+                   hedge_delay_ms=float(d.get("hedgeDelayMs", 0.0)),
                    fanout_timeout_s=float(d.get("fanoutTimeoutS", 30.0)),
                    request_timeout_ms=float(d.get("requestTimeoutMs", 0.0)))
 
@@ -611,13 +628,28 @@ def add_router_flags(parser) -> None:
              "fleet/sharding.py, each host packs only its ~1/N slice of "
              "every dense coefficient table")
     parser.add_argument(
+        "--replicas", type=int, default=1, metavar="R",
+        help="serving hosts PER SHARD (R×N hosts total): at R >= 2 a "
+             "dead host becomes a replica retry instead of a 503 "
+             "reason=upstream, and slow legs are hedged (backup fired "
+             "after the p99-derived hedge delay, first answer wins)")
+    parser.add_argument(
+        "--hedge-delay-ms", type=float, default=0.0,
+        help="fixed hedge delay for slow-leg backups (0 = adaptive: the "
+             "p99 of the shard's recent leg latencies; only meaningful "
+             "with --replicas >= 2)")
+    parser.add_argument(
         "--fanout-timeout-s", type=float, default=30.0,
         help="per-host fan-out leg timeout; a slower or dead host maps "
-             "to a typed 503 (reason=upstream) instead of a hang")
+             "to a typed 503 (reason=upstream) instead of a hang, and a "
+             "request's remaining deadline budget caps each leg below "
+             "this")
 
 
 def router_from_args(args) -> RouterConfig:
     return RouterConfig(fleet_shards=args.fleet_shards,
+                        replicas=args.replicas,
+                        hedge_delay_ms=args.hedge_delay_ms,
                         fanout_timeout_s=args.fanout_timeout_s,
                         request_timeout_ms=args.request_timeout_ms)
 

@@ -140,11 +140,16 @@ def build_fleet(argv: Optional[Sequence[str]] = None) -> FleetHandle:
                              "--rank-max-k", str(args.rank_max_k)]
     hosts = []
     try:
+        # shard-major host order ([s0r0, s0r1, s1r0, ...]): every replica
+        # of a group serves the SAME shard view of the same model
         for i in range(n):
-            hosts.append(serve_game.build_server(
-                host_argv_common + ["--fleet-shard", str(i)]).start())
+            for _r in range(config.replicas):
+                hosts.append(serve_game.build_server(
+                    host_argv_common + ["--fleet-shard", str(i)]).start())
         router = FleetRouter(
             [h.url for h in hosts],
+            replicas=config.replicas,
+            hedge_delay_ms=config.hedge_delay_ms,
             fanout_timeout_s=config.fanout_timeout_s,
             default_timeout_ms=config.request_timeout_ms)
         server = RouterServer(router, host=args.host, port=args.port)
@@ -175,8 +180,10 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
     fleet = build_fleet(argv)
     rank_on = bool(fleet.hosts[0].service.registry.rank_coordinate)
     endpoints = ("/score" + (" /rank" if rank_on else "")
-                 + " /healthz /readyz /metrics /reload")
-    print(f"serving GAME fleet ({len(fleet.hosts)} shards) on "
+                 + " /healthz /readyz /metrics /reload /reshard")
+    router = fleet.router
+    print(f"serving GAME fleet ({router.n_shards} shards x "
+          f"{router.replicas} replicas) on "
           f"{fleet.url} ({endpoints}); hosts: "
           f"{', '.join(fleet.host_urls())}", flush=True)
     try:

@@ -10,8 +10,14 @@ SAME shard for the same id, forever:
 - the hash is ``crc32`` of the UTF-8 id string — stable across processes,
   Python versions and machines (unlike ``hash()``), cheap, and already
   the fleet-joinable discipline the request log samples by;
-- the shard is ``crc32(id) % n_shards`` — no seeding, no salting, so two
-  components that never exchange configuration still agree.
+- ids map to one of :data:`N_BUCKETS` fixed **virtual buckets**
+  (``crc32(id) % 4096``), and a bucket→shard table (:class:`ShardMap`)
+  names the owner. The default table is ``bucket % n_shards`` — when
+  ``n_shards`` divides 4096 that reproduces the historical
+  ``crc32(id) % n_shards`` placement exactly — and a RESIZE moves only
+  the reassigned buckets' ids (~1/N of keys) instead of rehashing
+  everything. No seeding, no salting, so two components that never
+  exchange configuration still agree.
 
 This module is the one sanctioned home of that bucketing (lint rule
 ``res-shard-home``, ``analysis/rules_resilience.py``): a second crc32
@@ -26,8 +32,16 @@ not identity bucketing, and stay put.
 
 from __future__ import annotations
 
+import dataclasses
 import zlib
-from typing import Iterable, Mapping, Sequence
+from typing import Iterable, Mapping, Optional, Sequence
+
+#: the fixed virtual-bucket count every id hashes into — a power of two
+#: large enough that per-bucket movement is fine-grained (a reshard moves
+#: whole buckets) and divisible by every practical small fleet size, so
+#: the DEFAULT bucket→shard table reproduces the historical
+#: ``crc32(id) % n_shards`` placement bit-for-bit
+N_BUCKETS = 4096
 
 
 def stable_hash_u32(key: str) -> int:
@@ -45,15 +59,37 @@ def crc_bucket(key: str, mod: int) -> int:
     return stable_hash_u32(key) % int(mod)
 
 
+def bucket_of_id(raw_id: str) -> int:
+    """The id's fixed virtual bucket (``crc32 % N_BUCKETS``) — stable
+    forever; only the bucket→shard TABLE ever moves."""
+    return crc_bucket(str(raw_id), N_BUCKETS)
+
+
 def shard_of_id(raw_id: str, n_shards: int) -> int:
-    """The fleet placement function: which of ``n_shards`` hosts owns
-    this raw entity id's coefficient row. Deterministic and
-    configuration-free — the serving store, the router and the refresh
-    partitioner all call this and therefore always agree."""
+    """The DEFAULT-map fleet placement function: which of ``n_shards``
+    hosts owns this raw entity id's coefficient row, routed through the
+    virtual-bucket layer (``bucket_of_id(id) % n_shards`` — identical to
+    the historical ``crc32(id) % n_shards`` whenever ``n_shards``
+    divides :data:`N_BUCKETS`). Deterministic and configuration-free —
+    the serving store, the router and the refresh partitioner all call
+    this and therefore always agree. A fleet running a NON-default
+    :class:`ShardMap` routes through ``ShardMap.shard_of`` instead."""
     n = int(n_shards)
     if n < 1:
         raise ValueError(f"n_shards must be >= 1, got {n_shards}")
-    return crc_bucket(str(raw_id), n)
+    return bucket_of_id(raw_id) % n
+
+
+def retry_jitter_s(request_id: str, base_s: float = 1.0,
+                   spread_s: float = 2.0) -> float:
+    """Deterministic per-request-id ``Retry-After`` jitter: ``base_s``
+    plus a hash-derived fraction of ``spread_s``. Seeded from
+    :func:`stable_hash_u32` (no wall clock, no global RNG) so the same
+    refused request always gets the same hint while DIFFERENT requests
+    spread over the window — synchronized clients stop retrying in
+    lockstep without the router growing any mutable state."""
+    frac = (stable_hash_u32(f"retry:{request_id}") % 1024) / 1024.0
+    return float(base_s) + float(spread_s) * frac
 
 
 def check_shard(shard: "tuple[int, int] | None") -> "tuple[int, int] | None":
@@ -110,3 +146,153 @@ def shard_counts(raw_ids: Sequence[str], n_shards: int) -> "list[int]":
     for raw in raw_ids:
         counts[shard_of_id(raw, n_shards)] += 1
     return counts
+
+
+# ---------------------------------------------------------------------------
+# the versioned bucket→shard table (live resharding's unit of movement)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardMap:
+    """A versioned bucket→shard table: ``buckets[b]`` names the shard
+    owning virtual bucket ``b``. The map — not the hash — is what a
+    reshard changes, so growing the fleet moves only the reassigned
+    buckets' ids. ``map_hash`` is a content fingerprint (buckets +
+    n_shards + version, crc32 over the packed table — this module IS the
+    crc32 home) that rides every fleet response next to ``lineage``; a
+    router and a host disagreeing on it is refused like a mixed-lineage
+    response."""
+
+    buckets: "tuple[int, ...]"
+    n_shards: int
+    version: int = 1
+
+    def __post_init__(self):
+        object.__setattr__(self, "buckets", tuple(int(b)
+                                                  for b in self.buckets))
+        object.__setattr__(self, "n_shards", int(self.n_shards))
+        object.__setattr__(self, "version", int(self.version))
+        if self.n_shards < 1:
+            raise ValueError(
+                f"shard map needs n_shards >= 1, got {self.n_shards}")
+        if len(self.buckets) != N_BUCKETS:
+            raise ValueError(f"shard map needs exactly {N_BUCKETS} "
+                             f"buckets, got {len(self.buckets)}")
+        bad = [b for b, s in enumerate(self.buckets)
+               if not 0 <= s < self.n_shards]
+        if bad:
+            raise ValueError(
+                f"shard map assigns buckets {bad[:5]} outside "
+                f"[0, {self.n_shards})")
+        packed = b"".join(s.to_bytes(2, "big") for s in self.buckets)
+        digest = zlib.crc32(
+            packed + f"|{self.n_shards}|{self.version}".encode("utf-8"))
+        object.__setattr__(
+            self, "map_hash",
+            f"sm{self.version}-{digest & 0xFFFFFFFF:08x}")
+
+    @classmethod
+    def default(cls, n_shards: int, version: int = 1) -> "ShardMap":
+        """The round-robin table ``bucket % n_shards`` — reproduces
+        :func:`shard_of_id` (and, when ``n_shards`` divides
+        :data:`N_BUCKETS`, the historical ``crc32 % n_shards``) exactly,
+        so a fresh fleet needs no configured map to agree with every
+        incumbent component."""
+        n = int(n_shards)
+        if n < 1:
+            raise ValueError(f"n_shards must be >= 1, got {n_shards}")
+        return cls(buckets=tuple(b % n for b in range(N_BUCKETS)),
+                   n_shards=n, version=version)
+
+    def shard_of(self, raw_id: str) -> int:
+        """Map placement: the shard owning this id's bucket."""
+        return self.buckets[bucket_of_id(raw_id)]
+
+    def owns(self, raw_id: str, shard_index: int) -> bool:
+        return self.shard_of(raw_id) == int(shard_index)
+
+    def moved_buckets(self, other: "ShardMap") -> "list[int]":
+        """Bucket indices assigned differently by ``other`` — the exact
+        movement set of a reshard (every id outside these buckets stays
+        put, the O(moved) contract chaos asserts)."""
+        return [b for b in range(N_BUCKETS)
+                if self.buckets[b] != other.buckets[b]]
+
+    def with_moves(self, moves: "Mapping[int, int]") -> "ShardMap":
+        """A successor map (version + 1) with the named buckets
+        reassigned — the reshard driver's constructor."""
+        buckets = list(self.buckets)
+        for bucket, shard in moves.items():
+            b = int(bucket)
+            if not 0 <= b < N_BUCKETS:
+                raise ValueError(f"bucket {bucket} outside "
+                                 f"[0, {N_BUCKETS})")
+            buckets[b] = int(shard)
+        return ShardMap(buckets=tuple(buckets), n_shards=self.n_shards,
+                        version=self.version + 1)
+
+    def rebalanced(self, n_shards: int) -> "ShardMap":
+        """A successor map resized to ``n_shards`` with MINIMAL bucket
+        movement: buckets keep their owner where possible; only the
+        excess above each shard's fair share moves (deterministically,
+        highest bucket indices first) to under-full shards — growing N
+        therefore moves ~1/N of buckets, never a full rehash."""
+        n = int(n_shards)
+        if n < 1:
+            raise ValueError(f"n_shards must be >= 1, got {n_shards}")
+        per_shard: "dict[int, list[int]]" = {s: [] for s in range(n)}
+        homeless: "list[int]" = []
+        for b, s in enumerate(self.buckets):
+            (per_shard[s] if s < n else homeless).append(b)
+        base, extra = divmod(N_BUCKETS, n)
+        targets = [base + (1 if s < extra else 0) for s in range(n)]
+        for s in range(n):
+            over = len(per_shard[s]) - targets[s]
+            if over > 0:
+                # shed the highest buckets first: deterministic, and a
+                # later shrink tends to move the same buckets back
+                homeless.extend(per_shard[s][-over:])
+                del per_shard[s][-over:]
+        homeless.sort()
+        buckets = list(self.buckets)
+        for s in range(n):
+            need = targets[s] - len(per_shard[s])
+            for b in homeless[:need]:
+                buckets[b] = s
+            homeless = homeless[need:]
+        return ShardMap(buckets=tuple(buckets), n_shards=n,
+                        version=self.version + 1)
+
+    def as_dict(self) -> dict:
+        return {"version": self.version, "nShards": self.n_shards,
+                "mapHash": self.map_hash, "buckets": list(self.buckets)}
+
+    @classmethod
+    def from_dict(cls, data: Mapping) -> "ShardMap":
+        sm = cls(buckets=tuple(data["buckets"]),
+                 n_shards=int(data["nShards"]),
+                 version=int(data.get("version", 1)))
+        want = data.get("mapHash")
+        if want is not None and want != sm.map_hash:
+            raise ValueError(
+                f"shard map content hash mismatch: payload says {want}, "
+                f"content is {sm.map_hash} — refusing a tampered or "
+                f"mis-versioned map")
+        return sm
+
+
+def map_shard_vocab(entity_vocab: Mapping[str, int],
+                    shard_map: "Optional[ShardMap]",
+                    shard: "tuple[int, int] | None") -> "dict[str, int]":
+    """:func:`shard_vocab` under an explicit map: restrict a raw→dense
+    vocabulary to the ids the map assigns to ``shard`` (falling back to
+    the default-map hash when no map is given). Order-preserving, like
+    the default path."""
+    if shard is None:
+        return dict(entity_vocab)
+    if shard_map is None:
+        return shard_vocab(entity_vocab, shard)
+    index = int(shard[0])
+    return {raw: dense for raw, dense in entity_vocab.items()
+            if shard_map.owns(raw, index)}

@@ -53,6 +53,13 @@ misbehave. The registered sites:
                           (``reason=upstream``) for the affected request
                           and a two-phase reload epoch ABORTS with the
                           incumbent serving fleet-wide
+``fleet.replica``         one visit per replica retry/hedge launch inside a
+                          shard's replica group (``fleet/router.py::
+                          FleetRouter._fanout_leg``) — a fault fails that
+                          backup launch: the leg falls back to the remaining
+                          replicas, or surfaces as a typed 503
+                          (``reason=upstream``) when the rotation is
+                          exhausted
 ========================  ====================================================
 
 Activation is explicit only: :func:`activate` / the :func:`injected` context
@@ -84,7 +91,8 @@ from photon_ml_tpu.fleet.sharding import stable_hash_u32
 SITES = ("io.read", "ckpt.save", "io.model_save", "io.delta_publish",
          "collective", "optimizer.step", "worker.stall",
          "serving.parse", "serving.execute", "serving.reload",
-         "serving.watch_tick", "io.save.reqlog", "fleet.fanout")
+         "serving.watch_tick", "io.save.reqlog", "fleet.fanout",
+         "fleet.replica")
 
 _MODES = ("raise", "nan", "stall", "kill")
 

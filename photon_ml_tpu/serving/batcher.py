@@ -75,6 +75,14 @@ _STAGE_SECONDS = _metrics.histogram(
     labels=("stage",))
 
 
+class BatcherClosed(RuntimeError):
+    """Raised by :meth:`MicroBatcher.submit` once :meth:`close` ran —
+    the host is draining. The HTTP layer maps it to a typed 503
+    ``reason=stopping`` (and closes the connection) so a fleet router
+    retries the leg on a replica instead of surfacing a 500 from a
+    stopping host."""
+
+
 def _resolve(fut: Future, *, result=None, exception=None) -> None:
     """Set a Future's outcome, tolerating cancelled futures — a submitter
     that gave up must not take the worker (or the abort path) down."""
@@ -159,7 +167,7 @@ class MicroBatcher:
                 raise RuntimeError(
                     f"batcher worker died: {self._dead!r}") from self._dead
             if self._closed:
-                raise RuntimeError("batcher is closed")
+                raise BatcherClosed("batcher is closed")
             if (self.max_queue is not None
                     and len(self._queue) >= self.max_queue):
                 # admission control: refuse NOW (429 + Retry-After at the

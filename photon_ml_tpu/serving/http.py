@@ -70,7 +70,7 @@ from typing import Mapping, Optional
 
 from photon_ml_tpu.resilience.faults import fault_point
 from photon_ml_tpu.serving import overload as _overload
-from photon_ml_tpu.serving.batcher import MicroBatcher
+from photon_ml_tpu.serving.batcher import BatcherClosed, MicroBatcher
 from photon_ml_tpu.serving.registry import ModelRegistry
 from photon_ml_tpu.serving.reqlog import RequestLog
 from photon_ml_tpu.telemetry import metrics as _metrics
@@ -111,6 +111,20 @@ REQUEST_ID_HEADER = "X-Photon-Request-Id"
 #: against the monotonic clock at parse time; outbound: the budget still
 #: remaining when the response was written (echoed like the request id)
 DEADLINE_HEADER = "X-Photon-Deadline-Ms"
+
+#: the bucket→shard map content hash (``ShardMap.map_hash``). Outbound on
+#: every sharded host's /score + /rank response (next to ``lineage``);
+#: inbound from the fleet router, checked against this host's ACTIVE map —
+#: a disagreement is refused (503, ``reason=shard_map_mismatch``) exactly
+#: like a mixed-lineage fan-out, because answering under the wrong map
+#: would silently score rows this host no longer owns
+SHARD_MAP_HEADER = "X-Photon-Shard-Map"
+
+
+class ShardMapMismatch(RuntimeError):
+    """Router and host disagree on the bucket→shard map. Refused like
+    mixed lineage (SERVING.md "Fleet serving"): mid-reshard, a request
+    routed under one map must never be answered under another."""
 
 
 def new_request_id() -> str:
@@ -206,6 +220,22 @@ class ServingService:
             return None
         return max(0.0, (deadline - time.monotonic()) * 1e3)
 
+    # --- shard map --------------------------------------------------------
+    def check_shard_map(self, claimed: "Optional[str]") -> None:
+        """Refuse a request routed under a different bucket→shard map
+        than this host's active one (``X-Photon-Shard-Map`` header).
+        Absent header → no check (plain clients and unsharded hosts are
+        unaffected); a stale/foreign hash raises
+        :class:`ShardMapMismatch` → 503 ``reason=shard_map_mismatch``."""
+        if not claimed:
+            return
+        have = getattr(self.registry, "shard_map_hash", None)
+        if have is not None and claimed != have:
+            raise ShardMapMismatch(
+                f"request routed under shard map {claimed} but this host "
+                f"serves {have} — refusing rather than answering for "
+                f"rows it may not own")
+
     # --- endpoints --------------------------------------------------------
     def score(self, payload: dict,
               request_id: Optional[str] = None,
@@ -294,6 +324,11 @@ class ServingService:
                "lineage": self._active_lineage(),
                "latency_ms": round(latency_ms, 3),
                "request_id": request_id}
+        smh = getattr(self.registry, "shard_map_hash", None)
+        if smh is not None:
+            # the map hash rides next to lineage: the router proves no
+            # fan-out mixes bucket→shard generations, same as model content
+            out["shard_map"] = smh
         if with_margins:
             # f32 widened to double — exact, so the router re-running
             # sum_coordinate_margins reproduces this host's totals
@@ -390,6 +425,9 @@ class ServingService:
                "lineage": self._active_lineage(),
                "latency_ms": round(latency_ms, 3),
                "request_id": request_id}
+        smh = getattr(self.registry, "shard_map_hash", None)
+        if smh is not None:
+            out["shard_map"] = smh
         if deadline is not None:
             out["deadline_ms"] = round(self.remaining_ms(deadline), 1)
         return out
@@ -420,6 +458,14 @@ class ServingService:
             # order, and shard resolution hashes these entity types' ids
             "fleet_shard": (None if self.registry.fleet_shard is None
                             else list(self.registry.fleet_shard)),
+            # the governing bucket→shard map (sharded hosts only): its
+            # content hash + version, so a router/probe can audit that
+            # every host serves the same map generation
+            "shard_map": (None if getattr(self.registry, "shard_map",
+                                          None) is None
+                          else {"hash": self.registry.shard_map.map_hash,
+                                "version": self.registry.shard_map.version,
+                                "nShards": self.registry.shard_map.n_shards}),
             "coordinates": (None if active is None else [
                 [cid, getattr(cm, "random_effect_type", None)]
                 for cid, cm in active.model.coordinates.items()]),
@@ -517,6 +563,17 @@ class ServingService:
         if phase not in (None, "prepare"):
             raise ValueError(f"unknown reload phase {phase!r} (want "
                              f"prepare | activate | abort)")
+        if phase == "prepare" and payload.get("shard_map") is not None:
+            # LIVE RESHARD prepare: same two-phase verbs, but the
+            # candidate is a bucket→shard map (repacked views of the
+            # ACTIVE model), not a model dir. activate/abort above work
+            # unchanged on the returned version.
+            previous = self.registry.active_version
+            sm, moved = self.registry.prepare_reshard(payload["shard_map"])
+            return {"version": sm.version, "previous": previous,
+                    "lineage": sm.lineage,
+                    "shard_map": sm.shard_map.map_hash,
+                    "moved": moved, "phase": "prepared"}
         model_dir = payload.get("model_dir") or self.default_model_dir
         if not model_dir:
             raise ValueError("payload needs 'model_dir' (no default "
@@ -601,7 +658,24 @@ def _make_handler(service: ServingService):
                 return {}
             return json.loads(self.rfile.read(length) or b"{}")
 
+        def _refuse_if_stopping(self) -> bool:
+            """A stopping host answers every request with a typed 503
+            ``reason=stopping`` and CLOSES the connection. Without this
+            a keep-alive handler thread that outlives
+            ``GameServer.stop()`` keeps answering a pooled fleet-router
+            connection from a closed batcher forever — the restarted
+            host on the same port never gets the socket back."""
+            if not getattr(self.server, "photon_stopping", False):
+                return False
+            self.close_connection = True
+            self._reply(503, {"error": "host is stopping",
+                              "reason": "stopping"},
+                        headers={"Connection": "close"})
+            return True
+
         def do_GET(self):  # noqa: N802
+            if self._refuse_if_stopping():
+                return
             rid = self._request_id()
             parsed = urlsplit(self.path)
             if parsed.path == "/rank":
@@ -648,10 +722,20 @@ def _make_handler(service: ServingService):
                         self.deadline = service.resolve_deadline(
                             self.headers.get(DEADLINE_HEADER))
                     parse_ms = parse_t.seconds * 1e3
+                service.check_shard_map(self.headers.get(SHARD_MAP_HEADER))
                 out = service.rank(payload, request_id=rid,
                                    stage_ms={"parse": parse_ms},
                                    deadline=self.deadline)
                 status = 200
+            except ShardMapMismatch as e:
+                out = {"error": str(e), "reason": "shard_map_mismatch",
+                       "request_id": rid}
+                status = 503
+            except BatcherClosed as e:
+                self.close_connection = True
+                out = {"error": str(e), "reason": "stopping",
+                       "request_id": rid}
+                status = 503
             except _overload.Shed as e:
                 out = {"error": str(e), "reason": e.reason,
                        "request_id": rid}
@@ -666,6 +750,8 @@ def _make_handler(service: ServingService):
                 self._reply(status, out, headers=headers)
 
         def do_POST(self):  # noqa: N802
+            if self._refuse_if_stopping():
+                return
             rid = self._request_id()
             with _maybe_span("serving.request", request_id=rid,
                              path=self.path):
@@ -697,11 +783,26 @@ def _make_handler(service: ServingService):
             if self.path == "/score":
                 headers = None
                 try:
+                    service.check_shard_map(
+                        self.headers.get(SHARD_MAP_HEADER))
                     out = service.score(
                         payload, request_id=rid,
                         stage_ms={"parse": parse_t.seconds * 1e3},
                         deadline=self.deadline)
                     status = 200
+                except ShardMapMismatch as e:
+                    # refused like mixed lineage: the fan-out was routed
+                    # under a different map generation than this host's
+                    out = {"error": str(e), "reason": "shard_map_mismatch",
+                           "request_id": rid}
+                    status = 503
+                except BatcherClosed as e:
+                    # stop() raced this request past the front-door
+                    # refusal: same typed drain answer, same close
+                    self.close_connection = True
+                    out = {"error": str(e), "reason": "stopping",
+                           "request_id": rid}
+                    status = 503
                 except _overload.Shed as e:
                     # admission control refused the request: 429 with a
                     # Retry-After hint — never a hang, never a 500
@@ -769,6 +870,11 @@ class GameServer:
         self._httpd.serve_forever()
 
     def stop(self) -> None:
+        # flip the refuse flag BEFORE teardown: keep-alive handler
+        # threads survive shutdown() (only the accept loop stops), so
+        # they must answer 503 reason=stopping + Connection: close from
+        # here on, not serve stale results from a closing batcher
+        self._httpd.photon_stopping = True
         self._httpd.shutdown()
         self._httpd.server_close()
         if self._thread is not None:

@@ -112,6 +112,11 @@ class ServingModel:
     #: derive its ItemIndex incrementally and share the parent's
     #: executables. None = ranking disabled.
     rank_engine: object = None
+    #: the bucket→shard table this version's stores were packed under
+    #: (``fleet/sharding.py::ShardMap``; None on an unsharded host) —
+    #: activating the version swaps the registry's active map WITH it,
+    #: so a reshard epoch and its rollback move stores and map as one
+    shard_map: object = None
 
     def score(self, records: Sequence[dict]):
         return self.engine.score(records)
@@ -137,7 +142,7 @@ class ModelRegistry:
         if table_dtype not in TABLE_DTYPES:
             raise ValueError(f"unknown table_dtype {table_dtype!r}; "
                              f"expected one of {TABLE_DTYPES}")
-        from photon_ml_tpu.fleet.sharding import check_shard
+        from photon_ml_tpu.fleet.sharding import ShardMap, check_shard
 
         #: this host's fleet shard ``(index, count)``: every loaded
         #: version's coefficient stores pack only the raw ids hashing to
@@ -145,6 +150,12 @@ class ModelRegistry:
         #: carrying a DIFFERENT ``fleetShard`` are refused at validation.
         #: None = unsharded single-host serving, the historical behavior.
         self.fleet_shard = check_shard(fleet_shard)
+        #: the ACTIVE bucket→shard table (None when unsharded). Starts as
+        #: the default map — placement identical to plain ``shard_of_id``
+        #: — and moves only through :meth:`prepare_reshard` + activation,
+        #: so the stores and the map can never disagree.
+        self.shard_map = (None if self.fleet_shard is None
+                          else ShardMap.default(self.fleet_shard[1]))
         self.shard_configs = tuple(shard_configs)
         self.max_batch = max_batch
         self.warmup = warmup
@@ -206,6 +217,14 @@ class ModelRegistry:
         serving front end calls this per request; cheap bookkeeping)."""
         self.reservoir.add(records)
 
+    @property
+    def shard_map_hash(self) -> Optional[str]:
+        """Content hash of the ACTIVE shard map (None when unsharded) —
+        rides every response next to ``lineage`` and is what the router
+        and the host compare to refuse a mixed-map fan-out."""
+        sm = self.shard_map
+        return None if sm is None else sm.map_hash
+
     # --- lifecycle --------------------------------------------------------
     def load(self, model_dir: str, *, activate: bool = True) -> ServingModel:
         """Load + validate a candidate dir; register (and by default
@@ -256,6 +275,11 @@ class ModelRegistry:
             sm = self._versions[version]
             previous = self._active
             self._active = sm
+            if sm.shard_map is not None:
+                # the map travels WITH the version: a reshard epoch's
+                # activation (or its rollback) swaps stores and routing
+                # table in the same atomic pin
+                self.shard_map = sm.shard_map
         for cid, store in sm.stores.items():
             _TABLE_BYTES.labels(coordinate=cid,
                                 dtype=store.table_dtype).set(
@@ -312,6 +336,109 @@ class ModelRegistry:
         if kind == PATCH_KIND:
             return self.load_patch(model_dir, activate=False)
         return self.load(model_dir, activate=False)
+
+    def prepare_reshard(self, shard_map) -> "tuple[ServingModel, dict]":
+        """Phase one of a LIVE RESHARD epoch: repack the active version's
+        stores under a candidate bucket→shard table and register the
+        result — warmed, ready to pin — without activating. Returns
+        ``(prepared, moved)`` where ``moved`` counts this host's row
+        movement per direction (``moved_in`` / ``moved_out`` /
+        ``retained``): only ids whose BUCKET was reassigned appear in the
+        moved tallies — the O(moved) contract chaos asserts. The model
+        content is untouched (same lineage, same coefficients); a
+        coordinate whose membership did not change shares the incumbent's
+        device table outright and costs zero recompiles. Runs the same
+        ``serving.reload`` fault surface as a model prepare, so an
+        injected refusal aborts the fleet epoch with the incumbent map
+        serving everywhere."""
+        from photon_ml_tpu.fleet.sharding import ShardMap
+        from photon_ml_tpu.resilience import fault_point
+
+        if not isinstance(shard_map, ShardMap):
+            shard_map = ShardMap.from_dict(shard_map)
+        parent = self.active()
+        if self.fleet_shard is None:
+            raise ValueError(
+                "reshard needs a fleet-sharded host (serve with "
+                "--fleet-shard/--fleet-shard-count); an unsharded host "
+                "has no bucket table to move")
+        if shard_map.n_shards != self.fleet_shard[1]:
+            raise ValueError(
+                f"shard map names {shard_map.n_shards} shards, this "
+                f"fleet has {self.fleet_shard[1]} hosts per replica "
+                f"group — resizing the host set is a topology change, "
+                f"not a map move")
+        index = self.fleet_shard[0]
+        moved = {"moved_in": 0, "moved_out": 0, "retained": 0}
+        try:
+            fault_point("serving.reload",
+                        path=f"shard-map:{shard_map.map_hash}",
+                        phase="prepare")
+            stores: dict[str, EntityCoefficientStore] = {}
+            for cid, store in parent.stores.items():
+                t = store.random_effect_type
+                vocab = parent.entity_vocabs.get(t, {})
+                old_ids = set(store.row_of_id)
+                new_ids = {raw for raw in vocab
+                           if shard_map.owns(raw, index)}
+                moved["moved_in"] += len(new_ids - old_ids)
+                moved["moved_out"] += len(old_ids - new_ids)
+                moved["retained"] += len(old_ids & new_ids)
+                if new_ids == old_ids:
+                    # membership unchanged: alias the incumbent device
+                    # table (zero bytes moved, zero recompiles) — only
+                    # the governing map reference advances
+                    stores[cid] = dataclasses.replace(
+                        store, shard_map=shard_map)
+                else:
+                    stores[cid] = EntityCoefficientStore.build(
+                        parent.model.coordinates[cid], vocab,
+                        table_dtype=self.table_dtype,
+                        shard=self.fleet_shard, shard_map=shard_map)
+            engine = ScoringEngine(
+                parent.model, self.shard_configs, parent.index_maps,
+                stores, max_batch=self.max_batch,
+                share_from=parent.engine)
+            rank_engine = None
+            if self.rank_coordinate is not None:
+                rank_store = stores.get(self.rank_coordinate)
+                unchanged = (
+                    rank_store is not None
+                    and parent.stores.get(self.rank_coordinate) is not None
+                    and rank_store.table
+                    is parent.stores[self.rank_coordinate].table)
+                rank_engine = self._build_rank_engine(
+                    engine, stores,
+                    index=(parent.rank_engine.index
+                           if unchanged and parent.rank_engine is not None
+                           else None),
+                    share_from=(parent.rank_engine if unchanged else None))
+            engine.monitor = QualityMonitor(parent.baseline)
+        except Exception as e:
+            self.bus.post("model_reload_rejected",
+                          path=f"shard-map:{shard_map.map_hash}",
+                          error=repr(e))
+            raise
+        with self._lock:
+            version = self._next_version
+            self._next_version += 1
+            sm = ServingModel(
+                version=version, model_dir=parent.model_dir,
+                model=parent.model, index_maps=parent.index_maps,
+                stores=stores, engine=engine, lineage=parent.lineage,
+                entity_vocabs=parent.entity_vocabs,
+                parent_lineage=parent.parent_lineage,
+                baseline=parent.baseline, canary=None,
+                rank_engine=rank_engine, shard_map=shard_map)
+            self._versions[version] = sm
+        if self.warmup:
+            sm.engine.warmup()
+            if sm.rank_engine is not None:
+                sm.rank_engine.warmup()
+        self.bus.post("model_loaded", version=version, path=sm.model_dir,
+                      n_entities={cid: s.n_entities
+                                  for cid, s in sm.stores.items()})
+        return sm, moved
 
     def load_patch(self, patch_dir: str, *,
                    activate: bool = True) -> ServingModel:
@@ -380,7 +507,8 @@ class ModelRegistry:
         stores = {
             cid: EntityCoefficientStore.build(
                 cm, vocabs[cm.random_effect_type],
-                table_dtype=self.table_dtype, shard=self.fleet_shard)
+                table_dtype=self.table_dtype, shard=self.fleet_shard,
+                shard_map=self.shard_map)
             for cid, cm in model.coordinates.items()
             if not isinstance(cm, FixedEffectModel)}
         # a reloaded model with the incumbent's coordinate structure
@@ -412,7 +540,8 @@ class ModelRegistry:
                 "lineage": model_lineage_id(model_dir),
                 "parent_lineage": metadata.get("parentModel"),
                 "baseline": baseline,
-                "entity_vocabs": vocabs}
+                "entity_vocabs": vocabs,
+                "shard_map": self.shard_map}
 
     # --- ranking ----------------------------------------------------------
     def _build_rank_engine(self, engine: ScoringEngine, stores, *,
@@ -609,7 +738,9 @@ class ModelRegistry:
                 "lineage": metadata.get("modelId"),
                 "parent_lineage": metadata.get("parentModel"),
                 "baseline": baseline,
-                "entity_vocabs": vocabs}
+                "entity_vocabs": vocabs,
+                "shard_map": parent.shard_map
+                if parent.shard_map is not None else self.shard_map}
 
     def _canary_evaluate(self, loaded: dict) -> Optional[dict]:
         """Shadow-score the request reservoir through the validated

@@ -116,6 +116,12 @@ class EntityCoefficientStore:
     #: every other id lands on the fallback zeros row exactly like an
     #: unseen entity. None = unsharded (the single-host identity).
     shard: Optional[tuple] = None
+    #: the explicit bucket→shard table governing ownership
+    #: (``fleet/sharding.py::ShardMap``); None = the default map (plain
+    #: ``shard_of_id`` hashing — identical placement). Carried so a
+    #: post-reshard store patches and answers ownership by the MAP, not
+    #: the default hash.
+    shard_map: Optional[object] = None
 
     @property
     def n_entities(self) -> int:
@@ -128,15 +134,21 @@ class EntityCoefficientStore:
     def shard_of(self, raw_id: str) -> Optional[int]:
         """Which fleet shard owns this raw id (None on an unsharded
         store). Delegates to the one hashing home,
-        :func:`photon_ml_tpu.fleet.sharding.shard_of_id`."""
+        :func:`photon_ml_tpu.fleet.sharding` — the explicit
+        :class:`~photon_ml_tpu.fleet.sharding.ShardMap` when one governs
+        this store, the default hash otherwise."""
         if self.shard is None:
             return None
+        if self.shard_map is not None:
+            return self.shard_map.shard_of(raw_id)
         return _sharding.shard_of_id(raw_id, self.shard[1])
 
     def owns(self, raw_id: str) -> bool:
         """Is this raw id in this store's shard slice? (Unsharded stores
         own everything.) A sharded store still SCORES foreign ids — they
         fall back to the zeros row — but never packs rows for them."""
+        if self.shard is not None and self.shard_map is not None:
+            return self.shard_map.owns(raw_id, self.shard[0])
         return _sharding.owns_id(raw_id, self.shard)
 
     @property
@@ -282,13 +294,14 @@ class EntityCoefficientStore:
             feature_shard_id=self.feature_shard_id, dim=self.dim,
             table=table, row_of_id=row_of_id,
             table_dtype=self.table_dtype, scales=scales,
-            shard=self.shard)
+            shard=self.shard, shard_map=self.shard_map)
 
     @staticmethod
     def build(model: RandomEffectModel,
               entity_vocab: Mapping[str, int],
               table_dtype: str = "float32",
-              shard: Optional[tuple] = None) -> "EntityCoefficientStore":
+              shard: Optional[tuple] = None,
+              shard_map=None) -> "EntityCoefficientStore":
         """Pack a loaded :class:`RandomEffectModel`'s sparse table densely,
         in ``table_dtype`` storage (see the module docstring for the
         quantization format and parity contract).
@@ -305,6 +318,11 @@ class EntityCoefficientStore:
         Every other id (foreign shard or globally unseen alike) resolves
         to the fallback zeros row: cold-start semantics are unchanged,
         and the routing tier is what makes a foreign id never land here.
+
+        ``shard_map`` (a ``fleet/sharding.py::ShardMap``) replaces the
+        default hash placement with the explicit bucket→shard table —
+        the live-reshard repack path; ownership questions on the built
+        store answer by the same map.
         """
         if table_dtype not in TABLE_DTYPES:
             raise ValueError(f"unknown table_dtype {table_dtype!r}; "
@@ -315,7 +333,8 @@ class EntityCoefficientStore:
                 "before building a store); saved models are already "
                 "back-projected by export")
         shard = _sharding.check_shard(shard)
-        entity_vocab = _sharding.shard_vocab(entity_vocab, shard)
+        entity_vocab = _sharding.map_shard_vocab(entity_vocab, shard_map,
+                                                 shard)
         keys = np.asarray(model.keys, np.int64)
         ent = keys // model.dim
         feat = keys % model.dim
@@ -347,4 +366,5 @@ class EntityCoefficientStore:
             random_effect_type=model.random_effect_type,
             feature_shard_id=model.feature_shard_id,
             dim=model.dim, table=table, row_of_id=row_of_id,
-            table_dtype=table_dtype, scales=scales, shard=shard)
+            table_dtype=table_dtype, scales=scales, shard=shard,
+            shard_map=shard_map)

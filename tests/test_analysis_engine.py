@@ -778,3 +778,50 @@ def test_shard_home_clean_call_sites_pass():
             return shard_of_id(raw, n)
     """
     assert check(src, ["res-shard-home"]) == []
+
+
+def test_shard_home_flags_virtual_bucket_modulo():
+    # the literal bucket count recomputed outside the home
+    literal = """
+        def bucket(h):
+            return h % 4096
+    """
+    got = check(literal, ["res-shard-home"])
+    assert rule_ids(got) == ["res-shard-home"]
+    assert "ShardMap" in got[0].message
+    # ...and via the imported constant, from-import or module-attribute
+    from_import = """
+        from photon_ml_tpu.fleet.sharding import N_BUCKETS
+
+        def bucket(h):
+            return h % N_BUCKETS
+    """
+    assert rule_ids(check(from_import, ["res-shard-home"])) == \
+        ["res-shard-home"]
+    via_module = """
+        import photon_ml_tpu.fleet.sharding as sharding
+
+        def bucket(h):
+            return h % sharding.N_BUCKETS
+    """
+    assert rule_ids(check(via_module, ["res-shard-home"])) == \
+        ["res-shard-home"]
+
+
+def test_shard_home_bucket_modulo_allowed_in_the_home():
+    src = """
+        def bucket(h):
+            return h % 4096
+    """
+    assert check(src, ["res-shard-home"], rel=SHARD_HOME) == []
+
+
+def test_shard_home_ignores_unrelated_modulo():
+    src = """
+        def wrap(i, n):
+            return i % n
+
+        def page(off):
+            return off % 1024
+    """
+    assert check(src, ["res-shard-home"]) == []

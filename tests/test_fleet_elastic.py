@@ -1,0 +1,497 @@
+"""Elastic-fleet tests (replica groups + hedged fan-out + live reshard).
+
+The PR 16 contracts, each locked here:
+
+- **versioned shard map**: the default ``ShardMap`` reproduces the
+  historical ``crc32 % N`` placement exactly; ``with_moves`` builds a
+  successor (version + 1) whose ``moved_buckets`` is exactly the named
+  set; ``map_hash`` fingerprints content and ``from_dict`` refuses a
+  tampered payload;
+- **replica groups**: an R=2 fleet scores bit-identically to one
+  unsharded server; killing one replica mid-fleet is a replica RETRY
+  (``photon_fleet_replica_retries_total``), never a client-visible 503
+  ``reason=upstream``; an injected ``fleet.replica`` fault is the backup
+  path itself dying — the leg degrades to the R=1 outcome (typed 503);
+- **hedged fan-out**: a hedged request is counted ONCE (one served
+  response, ``photon_fleet_requests_total`` advances by one) and the
+  cancelled loser's pooled connection comes back — nothing stranded;
+- **deadline budget**: a spent ``X-Photon-Deadline-Ms`` budget sheds
+  with ``reason=deadline`` (the caller ran out of time; no host was
+  lost) and the upstream ``Retry-After`` hint is deterministic per
+  request id (``retry_jitter_s``);
+- **live reshard**: ``/reshard`` drives a new map through the two-phase
+  epoch — moved-row counters are O(moved), f32 scores are bit-identical
+  across the swap, and an injected refusal aborts fleet-wide with the
+  incumbent map serving.
+"""
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+import zlib
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.cli import serve_fleet as serve_fleet_cli
+from photon_ml_tpu.cli import serve_game as serve_game_cli
+from photon_ml_tpu.cli import train_game as train_game_cli
+from photon_ml_tpu.cli.config import RouterConfig
+from photon_ml_tpu.fleet.sharding import (
+    N_BUCKETS,
+    ShardMap,
+    bucket_of_id,
+    retry_jitter_s,
+    shard_of_id,
+    stable_hash_u32,
+)
+from photon_ml_tpu.io.data_reader import write_training_examples
+from photon_ml_tpu.resilience import FaultPlan, injected
+
+SHARDS = "global=fixed|intercept,user=user|noIntercept"
+COMMON = [
+    "--feature-shards", SHARDS,
+    "--coordinates",
+    "global=fixed,shard=global,reg=L2,maxIter=15",
+    "perUser=random,entity=userId,shard=user,reg=L2,maxIter=15",
+    "--update-sequence", "global,perUser",
+    "--grid", "global=0.1", "perUser=1",
+    "--evaluators", "",
+]
+D_FIXED, D_USER, N_USERS = 4, 2, 10
+
+
+def _records(n, seed, *, cold_users=0):
+    prng = np.random.default_rng(99)
+    w = prng.normal(size=D_FIXED)
+    u = 1.5 * prng.normal(size=(N_USERS, D_USER))
+    rng = np.random.default_rng(seed)
+    xf = rng.normal(size=(n, D_FIXED))
+    xu = rng.normal(size=(n, D_USER))
+    users = rng.integers(0, N_USERS, size=n)
+    margin = xf @ w + np.einsum("nd,nd->n", xu, u[users])
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-margin))).astype(float)
+    out = []
+    for i in range(n):
+        feats = [{"name": f"fixed.x{j}", "term": "",
+                  "value": float(xf[i, j])} for j in range(D_FIXED)]
+        feats += [{"name": f"user.z{j}", "term": "",
+                   "value": float(xu[i, j])} for j in range(D_USER)]
+        uid = f"uCOLD{i}" if i >= n - cold_users else f"u{users[i]}"
+        out.append({"uid": str(i), "response": float(y[i]),
+                    "offset": None, "weight": None, "features": feats,
+                    "metadataMap": {"userId": uid}})
+    return out
+
+
+def _get(url, timeout=60.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _post(url, payload, timeout=60.0, headers=None):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _metric(name, labels=None):
+    """Current value of one process-registry series (0.0 if absent)."""
+    from photon_ml_tpu.telemetry.prometheus import (
+        parse_text,
+        render,
+        series_value,
+    )
+
+    return series_value(parse_text(render()), name, labels)
+
+
+# ---------------------------------------------------------------------------
+# shard-map units (no servers)
+# ---------------------------------------------------------------------------
+
+
+class TestShardMap:
+    def test_default_map_reproduces_crc32_mod_n(self):
+        smap = ShardMap.default(2)
+        for raw in [f"u{i}" for i in range(64)] + ["", "x", "songs/9"]:
+            assert smap.shard_of(raw) == zlib.crc32(raw.encode()) % 2
+            assert smap.shard_of(raw) == shard_of_id(raw, 2)
+        assert bucket_of_id("u7") == zlib.crc32(b"u7") % N_BUCKETS
+
+    def test_with_moves_bumps_version_and_moves_exactly(self):
+        base = ShardMap.default(2)
+        moves = {0: 1, 7: 1, 4090: 0}
+        succ = base.with_moves(moves)
+        assert succ.version == base.version + 1
+        # only buckets whose owner actually CHANGED count as moved
+        changed = [b for b, s in moves.items() if base.buckets[b] != s]
+        assert sorted(base.moved_buckets(succ)) == sorted(changed)
+        for b in range(N_BUCKETS):
+            want = moves.get(b, base.buckets[b])
+            assert succ.buckets[b] == want
+
+    def test_map_hash_is_content_addressed(self):
+        a = ShardMap.default(2)
+        assert a.map_hash == ShardMap.default(2).map_hash
+        assert a.map_hash.startswith("sm1-")
+        b = a.with_moves({3: 1})
+        assert b.map_hash != a.map_hash
+        # version participates: same buckets, different epoch, new hash
+        c = ShardMap(buckets=a.buckets, n_shards=2, version=2)
+        assert c.map_hash != a.map_hash
+
+    def test_from_dict_round_trip_and_tamper_refusal(self):
+        smap = ShardMap.default(3).with_moves({1: 2})
+        clone = ShardMap.from_dict(json.loads(json.dumps(smap.as_dict())))
+        assert clone == smap and clone.map_hash == smap.map_hash
+        bad = smap.as_dict()
+        bad["buckets"][5] = (bad["buckets"][5] + 1) % 3
+        with pytest.raises(ValueError, match="hash mismatch"):
+            ShardMap.from_dict(bad)
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="buckets"):
+            ShardMap(buckets=(0, 1), n_shards=2)
+        with pytest.raises(ValueError, match="outside"):
+            ShardMap(buckets=tuple([5] * N_BUCKETS), n_shards=2)
+        with pytest.raises(ValueError, match="outside"):
+            ShardMap.default(2).with_moves({N_BUCKETS: 0})
+
+    def test_rebalanced_moves_about_one_nth(self):
+        grown = ShardMap.default(2).rebalanced(3)
+        moved = ShardMap.default(2).moved_buckets(grown)
+        assert len(moved) == N_BUCKETS // 3  # the new shard's fair share
+        counts = [grown.buckets.count(s) for s in range(3)]
+        assert max(counts) - min(counts) <= 1
+
+    def test_retry_jitter_is_deterministic_and_bounded(self):
+        vals = {rid: retry_jitter_s(rid) for rid in
+                (f"rid-{i}" for i in range(200))}
+        for rid, v in vals.items():
+            assert 1.0 <= v < 3.0
+            assert retry_jitter_s(rid) == v  # no clock, no global RNG
+        assert len(set(vals.values())) > 50  # actually spreads
+
+
+class TestRouterConfig:
+    def test_round_trip_with_replica_fields(self):
+        cfg = RouterConfig(fleet_shards=3, replicas=2, hedge_delay_ms=7.5,
+                           fanout_timeout_s=12.0, request_timeout_ms=250.0)
+        clone = RouterConfig.from_dict(
+            json.loads(json.dumps(cfg.as_dict())))
+        assert clone == cfg
+        assert cfg.as_dict()["replicas"] == 2
+        assert cfg.as_dict()["hedgeDelayMs"] == 7.5
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="replicas"):
+            RouterConfig(replicas=0)
+        with pytest.raises(ValueError, match="hedge_delay_ms"):
+            RouterConfig(hedge_delay_ms=-1.0)
+
+
+# ---------------------------------------------------------------------------
+# the R=2 fleet (one trained model, several topologies)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def env(tmp_path_factory):
+    """One trained model served two ways: a single unsharded server (the
+    bit-parity reference) and a 2-shard x 2-replica fleet."""
+    tmp = str(tmp_path_factory.mktemp("fleet_elastic"))
+    d0 = os.path.join(tmp, "d0.avro")
+    write_training_examples(d0, _records(300, 0))
+    model = os.path.join(tmp, "model")
+    train_game_cli.run(["--training-data", d0, "--output-dir", model]
+                       + COMMON)
+    single = serve_game_cli.build_server(
+        ["--model-dir", model, "--feature-shards", SHARDS, "--port", "0",
+         "--no-warmup", "--rank-item-coordinate", "perUser",
+         "--rank-max-k", "8"]).start()
+    fleet = serve_fleet_cli.build_fleet(
+        ["--model-dir", model, "--feature-shards", SHARDS, "--port", "0",
+         "--fleet-shards", "2", "--replicas", "2", "--no-warmup",
+         "--rank-item-coordinate", "perUser", "--rank-max-k", "8"])
+    requests = _records(40, 11, cold_users=4)
+    yield {"tmp": tmp, "model": model, "single": single, "fleet": fleet,
+           "requests": requests}
+    fleet.stop()
+    single.stop()
+
+
+class TestReplicaGroups:
+    def test_r2_scores_bit_identical_to_single_host(self, env):
+        a = _post(env["single"].url + "/score",
+                  {"records": env["requests"]})
+        b = _post(env["fleet"].url + "/score",
+                  {"records": env["requests"]})
+        assert np.array_equal(
+            np.asarray(a["scores"], np.float64),
+            np.asarray(b["scores"], np.float64))
+        assert b["lineage"] == a["lineage"] is not None
+        # every fleet response is stamped with the governing map
+        assert b["shard_map"] == env["fleet"].router.shard_map.map_hash
+
+    def test_r2_rank_bit_identical_to_single_host(self, env):
+        for rec in env["requests"][:4]:
+            a = _post(env["single"].url + "/rank", {"record": rec, "k": 5})
+            b = _post(env["fleet"].url + "/rank", {"record": rec, "k": 5})
+            assert a["ids"] == b["ids"]
+            assert a["scores"] == b["scores"]
+
+    def test_healthz_reports_the_replica_topology(self, env):
+        out = _get(env["fleet"].url + "/healthz")
+        assert out["n_shards"] == 2 and out["replicas"] == 2
+        assert len(out["hosts"]) == 4
+        assert [(h["shard"], h["replica"]) for h in out["hosts"]] \
+            == [(0, 0), (0, 1), (1, 0), (1, 1)]
+        assert out["shard_map"]["mixed"] is False
+
+    def test_replica_kill_is_a_retry_not_a_503(self, env):
+        """The headline replica contract: with R=2, killing one host is
+        absorbed by its group — every request still serves, scores stay
+        bit-identical, and the absorption is visible as replica
+        retries, never as a client-visible 503 reason=upstream."""
+        fleet = serve_fleet_cli.build_fleet(
+            ["--model-dir", env["model"], "--feature-shards", SHARDS,
+             "--port", "0", "--fleet-shards", "2", "--replicas", "2",
+             "--no-warmup"])
+        try:
+            before = _post(fleet.url + "/score",
+                           {"records": env["requests"]})
+            retries0 = sum(
+                _metric("photon_fleet_replica_retries_total",
+                        {"shard": str(s)}) for s in range(2))
+            fleet.hosts[1].stop()  # shard 0, replica 1
+            # sweep request ids so BOTH primaries are exercised — half
+            # of these land on the dead replica first
+            for i in range(8):
+                out = _post(fleet.url + "/score",
+                            {"records": env["requests"]},
+                            headers={"X-Photon-Request-Id": f"kill-{i}"})
+                assert out["scores"] == before["scores"]
+            retries1 = sum(
+                _metric("photon_fleet_replica_retries_total",
+                        {"shard": str(s)}) for s in range(2))
+            assert retries1 > retries0
+            # degraded-but-ready: that is exactly what the redundancy
+            # is for
+            assert _get(fleet.url + "/readyz")["ready"] is True
+        finally:
+            fleet.stop()
+
+    def test_fleet_replica_fault_exhausts_to_typed_503(self, env):
+        """An injected ``fleet.replica`` fault fails the BACKUP launch:
+        with the primary replica already dead, the rotation exhausts and
+        the leg surfaces as the R=1 outcome — a typed 503
+        reason=upstream with a deterministic Retry-After."""
+        fleet = serve_fleet_cli.build_fleet(
+            ["--model-dir", env["model"], "--feature-shards", SHARDS,
+             "--port", "0", "--fleet-shards", "2", "--replicas", "2",
+             "--no-warmup"])
+        try:
+            fleet.hosts[1].stop()  # shard 0, replica 1
+            # a request id whose PRIMARY is the dead replica, so the
+            # leg must go through the backup-launch fault site
+            rid = next(r for r in (f"r{i}" for i in range(100))
+                       if stable_hash_u32(f"replica:{r}") % 2 == 1)
+            # ... scoring a record the DEAD host's shard owns (a record
+            # owned by the healthy shard would never touch the group)
+            rec = next(r for r in env["requests"]
+                       if shard_of_id(r["metadataMap"]["userId"], 2) == 0)
+            plan = {"seed": 0,
+                    "specs": [{"site": "fleet.replica", "at": [0]}]}
+            with injected(FaultPlan.from_json(plan)):
+                with pytest.raises(urllib.error.HTTPError) as err:
+                    _post(fleet.url + "/score", {"record": rec},
+                          headers={"X-Photon-Request-Id": rid})
+            assert err.value.code == 503
+            body = json.loads(err.value.read())
+            assert body["reason"] == "upstream"
+            # deterministic per-request-id Retry-After (retry_jitter_s,
+            # rounded by the HTTP layer)
+            assert (err.value.headers["Retry-After"]
+                    == str(max(1, round(retry_jitter_s(rid)))))
+            # without the fault the SAME request id fails over fine
+            out = _post(fleet.url + "/score", {"record": rec},
+                        headers={"X-Photon-Request-Id": rid})
+            assert len(out["scores"]) == 1
+        finally:
+            fleet.stop()
+
+
+class TestHedging:
+    def test_hedged_request_counts_once_and_strands_nothing(self, env):
+        """With an (absurdly small) fixed hedge delay every leg fires a
+        backup; each request must still produce exactly ONE served
+        response counted ONCE, and after the dust settles every pooled
+        connection is back in its free list — the cancelled loser's
+        connection returns through the normal give-back."""
+        fleet = serve_fleet_cli.build_fleet(
+            ["--model-dir", env["model"], "--feature-shards", SHARDS,
+             "--port", "0", "--fleet-shards", "2", "--replicas", "2",
+             "--hedge-delay-ms", "0.001", "--no-warmup"])
+        try:
+            want = _post(env["single"].url + "/score",
+                         {"records": env["requests"][:6]})
+            hedges0 = sum(_metric("photon_fleet_hedges_total",
+                                  {"shard": str(s)}) for s in range(2))
+            served0 = _metric("photon_fleet_requests_total",
+                              {"endpoint": "score"})
+            n = 10
+            for i in range(n):
+                out = _post(fleet.url + "/score",
+                            {"records": env["requests"][:6]},
+                            headers={"X-Photon-Request-Id": f"hedge-{i}"})
+                assert out["scores"] == want["scores"]
+            hedges1 = sum(_metric("photon_fleet_hedges_total",
+                                  {"shard": str(s)}) for s in range(2))
+            served1 = _metric("photon_fleet_requests_total",
+                              {"endpoint": "score"})
+            assert hedges1 > hedges0  # backups actually fired
+            # the accounting identity: n requests -> n served, however
+            # many backup legs raced underneath
+            assert served1 - served0 == n
+            # loser connections drain back to the pools: the hedge pool
+            # stays live (a sentinel clears promptly), the free lists
+            # stabilize, and a SECOND burst reuses the settled pool
+            # instead of growing it — a stranded loser would leak one
+            # connection per request
+            router = fleet.router
+            assert router._hedge_pool.submit(lambda: 42).result(
+                timeout=5.0) == 42
+
+            def settled_pool():
+                prev, deadline = None, time.monotonic() + 5.0
+                while time.monotonic() < deadline:
+                    cur = [len(c._free) for group in router.clients
+                           for c in group]
+                    if cur == prev:
+                        return cur
+                    prev = cur
+                    time.sleep(0.1)
+                return prev
+
+            p1 = settled_pool()
+            for i in range(n):
+                _post(fleet.url + "/score",
+                      {"records": env["requests"][:6]},
+                      headers={"X-Photon-Request-Id": f"hedge2-{i}"})
+            p2 = settled_pool()
+            assert sum(p2) <= sum(p1) + 2, (p1, p2)
+        finally:
+            fleet.stop()
+
+
+class TestDeadlineBudget:
+    def test_spent_budget_sheds_reason_deadline(self, env):
+        """A 1 ms budget cannot survive to a host exchange: the leg (or
+        the admission check) sheds with reason=deadline — the caller ran
+        out of time, no host was lost, so it must NOT read as upstream."""
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(env["fleet"].url + "/score",
+                  {"records": env["requests"][:4]},
+                  headers={"X-Photon-Deadline-Ms": "1"})
+        assert err.value.code == 429
+        body = json.loads(err.value.read())
+        assert body["reason"] == "deadline"
+        assert err.value.headers["Retry-After"]
+
+    def test_generous_budget_serves_and_echoes_remaining(self, env):
+        out = _post(env["fleet"].url + "/score",
+                    {"record": env["requests"][0]},
+                    headers={"X-Photon-Deadline-Ms": "30000"})
+        assert len(out["scores"]) == 1
+        assert 0 < out["deadline_ms"] <= 30000
+
+
+# ---------------------------------------------------------------------------
+# live resharding through the two-phase epoch
+# ---------------------------------------------------------------------------
+
+
+class TestLiveReshard:
+    def _shard0_ids(self, fleet):
+        smap = fleet.router.shard_map
+        ids = set()
+        for h in fleet.hosts:
+            for store in h.service.registry.active().stores.values():
+                ids.update(str(i) for i in store.row_of_id)
+        return ids, sorted({bucket_of_id(i) for i in ids
+                            if smap.shard_of(i) == 0})
+
+    def test_injected_refusal_aborts_with_incumbent_map(self, env):
+        fleet = env["fleet"]
+        before = _post(fleet.url + "/score",
+                       {"records": env["requests"][:8]})
+        incumbent = _get(fleet.url + "/healthz")["shard_map"]
+        _ids, donors = self._shard0_ids(fleet)
+        plan = {"seed": 0, "specs": [{"site": "serving.reload",
+                                      "at": [0]}]}
+        with injected(FaultPlan.from_json(plan)):
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _post(fleet.url + "/reshard",
+                      {"moves": {str(b): 1 for b in donors[:4]}})
+        assert err.value.code == 409
+        assert "incumbent map" in json.loads(err.value.read())["error"]
+        after_hz = _get(fleet.url + "/healthz")["shard_map"]
+        assert after_hz["hash"] == incumbent["hash"]
+        assert after_hz["version"] == incumbent["version"]
+        assert after_hz["mixed"] is False
+        after = _post(fleet.url + "/score",
+                      {"records": env["requests"][:8]})
+        assert after["scores"] == before["scores"]
+        assert after["shard_map"] == incumbent["hash"]
+
+    def test_reshard_moves_only_reassigned_rows_bit_identically(self, env):
+        fleet = env["fleet"]
+        before = _post(fleet.url + "/score",
+                       {"records": env["requests"]})
+        all_ids, donors = self._shard0_ids(fleet)
+        moves = {str(b): 1 for b in donors[:4]}
+        moved_set = {int(b) for b in moves}
+        smap = fleet.router.shard_map
+        n_rows = sum(1 for i in all_ids if bucket_of_id(i) in moved_set)
+        assert n_rows > 0, "fixture must move real rows"
+        out = _post(fleet.url + "/reshard", {"moves": moves})
+        assert out["previous"] == smap.map_hash
+        assert out["shard_map"] != smap.map_hash
+        assert out["map_version"] == smap.version + 1
+        assert out["moved_buckets"] == len(moves)
+        # O(moved): each of the R=2 replicas of the receiving (losing)
+        # shard gains (sheds) exactly the reassigned buckets' rows
+        assert out["moved"]["moved_in"] == 2 * n_rows
+        assert out["moved"]["moved_out"] == 2 * n_rows
+        assert out["moved"]["retained"] == 2 * (len(all_ids) - n_rows)
+        hz = _get(fleet.url + "/healthz")
+        assert hz["shard_map"]["hash"] == out["shard_map"]
+        assert hz["shard_map"]["mixed"] is False
+        # the bit-identity claim: same model content, new placement
+        after = _post(fleet.url + "/score",
+                      {"records": env["requests"]})
+        assert after["scores"] == before["scores"]
+        assert after["shard_map"] == out["shard_map"]
+        single = _post(env["single"].url + "/score",
+                       {"records": env["requests"]})
+        assert np.array_equal(
+            np.asarray(single["scores"], np.float64),
+            np.asarray(after["scores"], np.float64))
+
+    def test_bad_moves_are_a_400_not_an_epoch(self, env):
+        epochs0 = _metric("photon_fleet_shardmap_epochs_total",
+                          {"outcome": "aborted"})
+        for payload in ({}, {"moves": {}},
+                        {"moves": {"no-such-bucket": 1}},
+                        {"moves": {"70000": 1}}):
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _post(env["fleet"].url + "/reshard", payload)
+            assert err.value.code == 400
+        # malformed input never reaches the two-phase machinery
+        assert _metric("photon_fleet_shardmap_epochs_total",
+                       {"outcome": "aborted"}) == epochs0

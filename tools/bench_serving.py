@@ -137,6 +137,43 @@ def _scrape_metrics(base: str):
         return None
 
 
+def _scrape_process_metrics():
+    """Parsed snapshot of the process-global registry. For an in-process
+    fleet this is the right source for ROUTER-owned counters: the
+    router's folded ``/metrics`` merges every member's text, and since
+    in-process hosts share the router's registry the same series would
+    be re-counted once per member."""
+    from photon_ml_tpu.telemetry.prometheus import parse_text, render
+
+    return parse_text(render())
+
+
+def _counter_delta(m0, m1, name: str, **match) -> float:
+    """Summed delta of a counter family between two scrapes, restricted
+    to series whose labels carry every ``match`` pair."""
+    def total(m):
+        return sum(v for labels, v in (m or {}).get(name, [])
+                   if all(labels.get(k) == want for k, want in match.items()))
+    return total(m1) - total(m0)
+
+
+def fleet_elastic_extras(m0, m1, offered: int) -> dict:
+    """Replica-group activity over one load window, from the router's
+    folded /metrics: how many legs were retried on a replica, how many
+    backups were hedged (rate normalised by offered requests), and how
+    many shard-map epochs activated mid-window (0 in a plain bench)."""
+    hedges = int(_counter_delta(m0, m1, "photon_fleet_hedges_total"))
+    return {
+        "replica_retries": int(
+            _counter_delta(m0, m1, "photon_fleet_replica_retries_total")),
+        "hedges": hedges,
+        "hedge_rate": round(hedges / offered, 4) if offered else 0.0,
+        "reshard_epochs": int(
+            _counter_delta(m0, m1, "photon_fleet_shardmap_epochs_total",
+                           outcome="activated")),
+    }
+
+
 def _histogram_delta(m0, m1, name: str):
     """(uppers, cumulative-count deltas, count delta) for one label-free
     histogram between two scrapes — the load window's own distribution."""
@@ -318,16 +355,19 @@ def mixed_open_loop_run(base: str, pool, users, sizes, *,
     ``rank_every=0`` sends only scores, ``1`` only ranks, ``N>1`` makes
     every Nth request a rank. Returns ``{"score": {...}, "rank": {...}}``
     with per-kind ``offered``/``corrected_ms``/``shed``/``errors``/
-    ``reconnected``/``lineages``; each kind independently satisfies (and
-    asserts) the accounting identity ``served + shed + errored ==
-    offered`` (served = measured + reconnect-served) — what the chaos
-    harness checks per kind under injected faults, along with the
-    ``lineages`` set staying a singleton (no mixed-lineage response)."""
+    ``reconnected``/``lineages``/``shard_maps``; each kind independently
+    satisfies (and asserts) the accounting identity ``served + shed +
+    errored == offered`` (served = measured + reconnect-served) — what
+    the chaos harness checks per kind under injected faults, along with
+    the ``lineages`` set staying a singleton (no mixed-lineage response)
+    and ``shard_maps`` (the fleet's stamped map hashes) staying within
+    the maps the load window legitimately crossed."""
     lock = threading.Lock()
     counter = {"i": 0}
     books = {kind: {"offered": 0, "corrected_ms": [], "uncorrected_ms": [],
                     "shed": 0, "errors": [], "reconnected": 0,
-                    "lineages": set()} for kind in ("score", "rank")}
+                    "lineages": set(), "shard_maps": set()}
+             for kind in ("score", "rank")}
     start = time.perf_counter() + 0.05
 
     def worker():
@@ -380,6 +420,8 @@ def mixed_open_loop_run(base: str, pool, users, sizes, *,
                     # model generations in one load window
                     if "lineage" in out:
                         books[kind]["lineages"].add(out["lineage"])
+                    if "shard_map" in out:
+                        books[kind]["shard_maps"].add(out["shard_map"])
                     if resets:
                         books[kind]["reconnected"] += 1
                     else:
@@ -719,6 +761,8 @@ def run_fleet(args) -> None:
         "--feature-shards", args.feature_shards,
         "--port", "0", "--max-wait-ms", str(args.max_wait_ms),
         "--fleet-shards", str(args.fleet_shards),
+        "--replicas", str(args.replicas),
+        "--hedge-delay-ms", str(args.hedge_delay_ms),
     ]
     if args.max_queue is not None:
         fleet_argv += ["--max-queue", str(args.max_queue)]
@@ -734,15 +778,18 @@ def run_fleet(args) -> None:
         compiles0 = [_http_json(h + "/healthz")["compiles"]
                      for h in fleet.host_urls()]
         concurrency = args.concurrency if args.concurrency != 4 else 16
+        metrics0 = _scrape_process_metrics()
         run = open_loop_run(base, pool, sizes,
                             target_qps=args.target_qps,
                             requests=args.requests,
                             concurrency=concurrency)
+        metrics1 = _scrape_process_metrics()
         compiles1 = [_http_json(h + "/healthz")["compiles"]
                      for h in fleet.host_urls()]
         health = _http_json(base + "/healthz")
     finally:
         fleet.stop()
+    elastic = fleet_elastic_extras(metrics0, metrics1, run["offered"])
     shed_rate = run["shed"] / run["offered"] if run["offered"] else 0.0
     corrected_p99 = _percentile(run["corrected_ms"], 99)
     results = [{
@@ -764,6 +811,11 @@ def run_fleet(args) -> None:
         "n_reconnected": run["reconnected"],
         "n_shards": health["n_shards"],
         "host_status": [h.get("status") for h in health["hosts"]],
+        "replicas": args.replicas,
+        "hedge_rate": elastic["hedge_rate"],
+        "hedges": elastic["hedges"],
+        "replica_retries": elastic["replica_retries"],
+        "reshard_epochs": elastic["reshard_epochs"],
         # the fleet activation/zero-recompile story: per-host compile
         # deltas across the load window must all be zero
         "recompiles_during_load": [c1 - c0 for c0, c1
@@ -855,6 +907,13 @@ def main(argv=None):
     p.add_argument("--fleet-shards", type=int, default=2,
                    help="--mode fleet: entity-sharded hosts behind the "
                         "in-process router (serve_fleet --fleet-shards)")
+    p.add_argument("--replicas", type=int, default=1,
+                   help="--mode fleet: replica group size per shard "
+                        "(serve_fleet --replicas; R>=2 enables replica "
+                        "retry + hedged fan-out)")
+    p.add_argument("--hedge-delay-ms", type=float, default=0.0,
+                   help="--mode fleet: fixed hedge delay in ms (0 = "
+                        "adaptive p99-derived delay; ignored at R=1)")
     args = p.parse_args(argv)
 
     if args.mode == "fleet":

@@ -109,8 +109,8 @@ def settle(server, base: str, timeout_s: float = 10.0) -> dict:
 
 
 def run_fleet_chaos(args) -> int:
-    """``--fleet``: the fleet-router chaos cells (ISSUE 15). An N=2
-    entity-sharded fleet (cli/serve_fleet.py) under three failure
+    """``--fleet``: the fleet-router chaos cells (ISSUEs 15 + 16). An
+    N=2 entity-sharded fleet (cli/serve_fleet.py) under six failure
     shapes, each asserting the books and the bit-parity pins:
 
     - **fanout-fault**: seeded ``fleet.fanout`` faults during mixed
@@ -123,7 +123,22 @@ def run_fleet_chaos(args) -> int:
       fleet's probe scores are bit-identical to the pinned ones;
     - **two-phase-abort**: an injected ``serving.reload`` fault fails ONE
       host's prepare — the epoch aborts (409), every host's version and
-      the probe scores are untouched.
+      the probe scores are untouched;
+    - **hot-shard**: an open-loop storm of records that ALL live on one
+      shard — overload stays isolated: a concurrent cold-shard prober
+      keeps serving bit-identical scores with zero failures while the
+      hot shard sheds;
+    - **reshard-under-traffic**: ``POST /reshard`` fired mid-load —
+      zero client-visible errors, every served response stamped with
+      the incumbent or the candidate map hash (never a mixed one), the
+      repack counters prove only the reassigned buckets' rows moved
+      (O(moved), not a full repack), probes bit-identical across the
+      map swap;
+    - **replica-kill**: a fresh R=2 fleet (``--replicas 2``) serving
+      bit-identically to the R=1 one; one replica stopped mid-load —
+      ZERO client-visible errors (the surviving replica absorbs every
+      leg, ``photon_fleet_replica_retries_total`` moves), probes
+      bit-identical, every surviving batcher worker alive.
     """
     import threading
 
@@ -163,8 +178,15 @@ def run_fleet_chaos(args) -> int:
         probe_scores = bench_serving._http_json(
             base + "/score", probe)["scores"]
         probe_rank_url = bench_serving.rank_url(base, users[0], 5)
-        probe_rank = bench_serving._http_json(probe_rank_url)
-        probe_topk = (probe_rank["ids"], probe_rank["scores"])
+
+        def canon_rank(body):
+            # per-item scores are the bit-identity claim; ORDER among
+            # exactly tied scores is placement-dependent (a reshard
+            # legitimately reorders ties across shards) — canonicalize
+            return sorted(zip(body["ids"], body["scores"]),
+                          key=lambda p: (-p[1], str(p[0])))
+
+        probe_topk = canon_rank(bench_serving._http_json(probe_rank_url))
         print(f"[chaos-serving] fleet up at {base} "
               f"(hosts: {', '.join(fleet.host_urls())}), probes pinned",
               flush=True)
@@ -174,7 +196,7 @@ def run_fleet_chaos(args) -> int:
                 base, pool, users, [1], target_qps=args.target_qps,
                 requests=n, ks=(3, 8), rank_every=4)
 
-        def check_books(cell, run, ceiling):
+        def check_books(cell, run, ceiling, allowed_maps=None):
             problems = []
             for kind in ("score", "rank"):
                 b = run[kind]
@@ -185,6 +207,15 @@ def run_fleet_chaos(args) -> int:
                     problems.append(
                         f"{kind} responses MIXED lineages: "
                         f"{sorted(b['lineages'])}")
+                maps = b.get("shard_maps", set())
+                if allowed_maps is None and len(maps) > 1:
+                    problems.append(
+                        f"{kind} responses MIXED shard maps: "
+                        f"{sorted(maps)}")
+                elif allowed_maps is not None and maps - allowed_maps:
+                    problems.append(
+                        f"{kind} responses carried unexpected shard "
+                        f"maps: {sorted(maps - allowed_maps)}")
             errored = sum(len(run[k]["errors"]) for k in ("score", "rank"))
             if errored > ceiling * run["offered"]:
                 problems.append(f"error rate {errored / run['offered']:.3f}"
@@ -203,7 +234,7 @@ def run_fleet_chaos(args) -> int:
             if after["scores"] != probe_scores:
                 problems.append("probe scores changed")
             rank_after = bench_serving._http_json(probe_rank_url)
-            if (rank_after["ids"], rank_after["scores"]) != probe_topk:
+            if canon_rank(rank_after) != probe_topk:
                 problems.append("probe top-k changed")
 
         try:
@@ -300,8 +331,257 @@ def run_fleet_chaos(args) -> int:
             if problems:
                 failures.append("fleet two-phase-abort: "
                                 + "; ".join(problems))
+
+            # --- cell 4: hot-shard storm, cold shard unharmed ------------
+            cell = {"cell": "hot-shard"}
+            smap = fleet.router.shard_map
+
+            def user_of(rec):
+                return (rec.get("metadataMap") or {}).get("userId", "u0")
+
+            by_shard: dict = {0: [], 1: []}
+            for rec in pool:
+                by_shard[smap.shard_of(user_of(rec))].append(rec)
+            hot = max(by_shard, key=lambda s: len(by_shard[s]))
+            hot_pool, cold_pool = by_shard[hot], by_shard[1 - hot]
+            problems = []
+            if not hot_pool or not cold_pool:
+                problems.append(f"degenerate pool split "
+                                f"({len(hot_pool)}/{len(cold_pool)})")
+            else:
+                cold_probe = {"records": cold_pool[:5]}
+                cold_scores = bench_serving._http_json(
+                    base + "/score", cold_probe)["scores"]
+                stop_evt = threading.Event()
+                cold_book = {"served": 0, "failed": []}
+
+                def cold_prober():
+                    # the isolation witness: a cold-shard request stream
+                    # concurrent with the storm — it must keep serving
+                    # the pinned scores, never shed or error
+                    while not stop_evt.is_set():
+                        try:
+                            got = bench_serving._http_json(
+                                base + "/score", cold_probe,
+                                timeout=10)["scores"]
+                            if got != cold_scores:
+                                cold_book["failed"].append(
+                                    "cold scores moved")
+                            else:
+                                cold_book["served"] += 1
+                        except Exception as e:
+                            cold_book["failed"].append(repr(e))
+                        stop_evt.wait(0.02)
+
+                prober = threading.Thread(target=cold_prober)
+                prober.start()
+                try:
+                    run = bench_serving.mixed_open_loop_run(
+                        base, hot_pool, users, [4],
+                        target_qps=max(4 * args.target_qps, 800.0),
+                        requests=requests, rank_every=0)
+                finally:
+                    stop_evt.set()
+                    prober.join()
+                problems += check_books(cell, run, args.error_ceiling)
+                if not cold_book["served"]:
+                    problems.append("no cold-shard probe served during "
+                                    "the storm")
+                if cold_book["failed"]:
+                    problems.append(f"cold shard took collateral damage: "
+                                    f"{cold_book['failed'][:3]}")
+                check_probes(problems)
+                cell.update(hot_shard=hot, hot_shed=run["score"]["shed"],
+                            cold_probes_served=cold_book["served"])
+            cell["ok"] = not problems
+            cells.append(cell)
+            print(f"[chaos-serving] fleet hot-shard: "
+                  f"shed={cell.get('hot_shed')} "
+                  f"cold_served={cell.get('cold_probes_served')} "
+                  f"{'ok' if cell['ok'] else 'FAIL'}", flush=True)
+            if problems:
+                failures.append("fleet hot-shard: " + "; ".join(problems))
+
+            # --- cell 5: live reshard under open-loop traffic ------------
+            from photon_ml_tpu.fleet.sharding import bucket_of_id
+
+            incumbent = fleet.router.shard_map
+            all_ids = set()
+            for h in fleet.hosts:
+                for store in h.service.registry.active().stores.values():
+                    all_ids.update(str(i) for i in store.row_of_id)
+            # move the buckets that actually hold a donor shard's rows
+            # (plus that shard's first few empty ones) — a meaningful
+            # O(moved) assertion needs moved > 0 on the tiny model
+            donor = max(range(2), key=lambda s: sum(
+                1 for i in all_ids if incumbent.shard_of(i) == s))
+            donors = sorted({bucket_of_id(i) for i in all_ids
+                             if incumbent.shard_of(i) == donor})
+            donors += [b for b, s in enumerate(incumbent.buckets)
+                       if s == donor and b not in set(donors)][:16]
+            moves = {str(b): 1 - donor for b in donors}
+            moved_set = set(donors)
+            expected_moved = sum(1 for i in all_ids
+                                 if bucket_of_id(i) in moved_set)
+            cell = {"cell": "reshard-under-traffic",
+                    "moved_buckets": len(moves),
+                    "expected_moved_rows": expected_moved}
+            resp_box: dict = {}
+
+            def fire_reshard():
+                try:
+                    resp_box["resp"] = bench_serving._http_json(
+                        base + "/reshard", {"moves": moves})
+                except Exception as e:
+                    resp_box["error"] = repr(e)
+
+            resharder = threading.Timer(
+                0.25 * requests / args.target_qps, fire_reshard)
+            resharder.start()
+            run = run_mixed(requests)
+            resharder.join()
+            problems = []
+            if not expected_moved:
+                problems.append("degenerate reshard: no rows in the "
+                                "moved buckets")
+            resp = resp_box.get("resp")
+            if resp is None:
+                problems.append(f"/reshard failed: {resp_box.get('error')}")
+            # a reshard epoch mid-load costs ZERO client-visible errors;
+            # every served response carries the incumbent or the candidate
+            # map hash, never anything else
+            allowed = {incumbent.map_hash} | (
+                {resp["shard_map"]} if resp else set())
+            problems += check_books(cell, run, 0.0, allowed_maps=allowed)
+            if resp is not None:
+                if resp["moved_buckets"] != len(moves):
+                    problems.append(
+                        f"moved {resp['moved_buckets']} buckets, "
+                        f"want {len(moves)}")
+                m = resp["moved"]
+                # O(moved): exactly the reassigned buckets' rows repack —
+                # in == out == the rows living in the moved buckets, and
+                # everything else stays put
+                if (m["moved_in"] != expected_moved
+                        or m["moved_out"] != expected_moved):
+                    problems.append(
+                        f"repack not O(moved): counters {m}, want "
+                        f"{expected_moved} rows in both directions")
+                if m["retained"] != len(all_ids) - expected_moved:
+                    problems.append(
+                        f"retained {m['retained']} rows, want "
+                        f"{len(all_ids) - expected_moved}")
+                hz = bench_serving._http_json(base + "/healthz")
+                if hz["shard_map"]["hash"] != resp["shard_map"]:
+                    problems.append(
+                        f"router map {hz['shard_map']['hash']} != "
+                        f"activated {resp['shard_map']}")
+                if hz["shard_map"].get("mixed"):
+                    problems.append("hosts disagree on the shard map "
+                                    "after activation")
+                cell.update(shard_map=resp["shard_map"],
+                            moved=m, map_version=resp["map_version"])
+            check_probes(problems)  # bit-identical across the map swap
+            cell["ok"] = not problems
+            cells.append(cell)
+            print(f"[chaos-serving] fleet reshard-under-traffic: "
+                  f"moved={cell.get('moved')} "
+                  f"{'ok' if cell['ok'] else 'FAIL'}", flush=True)
+            if problems:
+                failures.append("fleet reshard-under-traffic: "
+                                + "; ".join(problems))
+
+            # no stranded work anywhere: every host's batcher workers
+            # must have survived all five cells
+            for i, h in enumerate(fleet.hosts):
+                for name, b in (("batcher", h.service.batcher),
+                                ("rank batcher", h.service.rank_batcher)):
+                    if b is not None and b.dead is not None:
+                        failures.append(
+                            f"fleet host {i} {name} worker died: "
+                            f"{b.dead!r}")
         finally:
             fleet.stop()
+
+        # --- cell 6: replica-kill on an R=2 fleet (fleet.replica) --------
+        cell = {"cell": "replica-kill"}
+        fleet2 = serve_fleet.build_fleet([
+            "--model-dir", model_dir,
+            "--feature-shards", chaos_sweep.SHARDS,
+            "--port", "0", "--fleet-shards", "2", "--replicas", "2",
+            "--microbatch", "8", "--max-wait-ms", "1",
+            "--max-queue", str(args.max_queue),
+            "--rank-item-coordinate", "perUser", "--rank-max-k", "16",
+        ])
+        base2 = fleet2.url
+        try:
+            bench_serving.wait_ready(base2)
+            problems = []
+            # replication is invisible to scores: the R=2 fleet answers
+            # bit-identically to the R=1 probes pinned above
+            got = bench_serving._http_json(base2 + "/score",
+                                           probe)["scores"]
+            if got != probe_scores:
+                problems.append("R=2 probe scores differ from the "
+                                "R=1 fleet")
+            rank2 = bench_serving._http_json(
+                bench_serving.rank_url(base2, users[0], 5))
+            if canon_rank(rank2) != probe_topk:
+                problems.append("R=2 probe top-k differs from the "
+                                "R=1 fleet")
+            snap0 = bench_serving._scrape_metrics(base2) or {}
+            retries0 = sum(v for _l, v in snap0.get(
+                "photon_fleet_replica_retries_total", []))
+            victim = fleet2.hosts[1]  # shard 0, replica 1
+            killer = threading.Timer(
+                0.25 * requests / args.target_qps, victim.stop)
+            killer.start()
+            run = bench_serving.mixed_open_loop_run(
+                base2, pool, users, [1], target_qps=args.target_qps,
+                requests=requests, ks=(3, 8), rank_every=4)
+            killer.join()
+            # the replica group absorbs the kill: ZERO client-visible
+            # errors (no 503 reason=upstream), not merely a bounded rate
+            problems += check_books(cell, run, 0.0)
+            for kind in ("score", "rank"):
+                if run[kind]["errors"]:
+                    problems.append(
+                        f"{kind} errors leaked past the replica group: "
+                        f"{run[kind]['errors'][:3]}")
+            ready = bench_serving._http_json(base2 + "/readyz")
+            if not ready["ready"]:
+                problems.append(f"fleet not ready with a replica down: "
+                                f"{ready}")
+            snap1 = bench_serving._scrape_metrics(base2) or {}
+            retries = sum(v for _l, v in snap1.get(
+                "photon_fleet_replica_retries_total", [])) - retries0
+            if retries <= 0:
+                problems.append("no replica retries recorded across "
+                                "the kill")
+            got = bench_serving._http_json(base2 + "/score",
+                                           probe)["scores"]
+            if got != probe_scores:
+                problems.append("probe scores moved across the "
+                                "replica kill")
+            for i, h in enumerate(fleet2.hosts):
+                if h is victim:
+                    continue
+                for name, b in (("batcher", h.service.batcher),
+                                ("rank batcher", h.service.rank_batcher)):
+                    if b is not None and b.dead is not None:
+                        problems.append(f"host {i} {name} worker died: "
+                                        f"{b.dead!r}")
+            cell.update(replica_retries=retries, ok=not problems)
+            cells.append(cell)
+            print(f"[chaos-serving] fleet replica-kill: "
+                  f"offered={run['offered']} "
+                  f"retries={retries} "
+                  f"{'ok' if cell['ok'] else 'FAIL'}", flush=True)
+            if problems:
+                failures.append("fleet replica-kill: "
+                                + "; ".join(problems))
+        finally:
+            fleet2.stop()
             set_default_policy(prev_policy)
 
         artifact = {"budget": args.budget, "fleet": True,
@@ -345,9 +625,12 @@ def main(argv=None) -> int:
                    help="run the FLEET cells instead: an N=2 "
                         "entity-sharded fleet behind the router under "
                         "injected fleet.fanout faults, a mid-load host "
-                        "kill + restart, and a faulted two-phase reload "
-                        "— accounting identity per kind, no "
-                        "mixed-lineage response, probe scores "
+                        "kill + restart, a faulted two-phase reload, a "
+                        "hot-shard storm (cold shard unharmed), a live "
+                        "reshard under traffic (O(moved) repack, no "
+                        "mixed-map response), and a replica kill on an "
+                        "R=2 fleet (zero client-visible errors) — "
+                        "accounting identity per kind, probe scores "
                         "bit-identical fleet-wide")
     args = p.parse_args(argv)
 
