@@ -1665,13 +1665,132 @@ def bench_refresh():
                   n_rows=int(REFRESH_ROWS), n_users=int(REFRESH_USERS))
 
 
+FRESH_ROWS = 50_000
+FRESH_USERS = 1_000
+FRESH_SONGS = 500
+FRESH_ROWS_PER_USER = 16
+
+
+def bench_freshness():
+    """End-to-end freshness lag of the closed loop (CONTINUOUS.md "The
+    closed loop") at 1% / 10% touched-user fractions: log labeled traffic
+    for exactly that fraction of users, join it
+    (``feedback.join_feedback``), refresh with ``--fleet-shards 2``
+    (touched-entity solve, everyone else carried), and activate each
+    per-shard patch on a fleet-sharded serving registry. The metric is
+    the wall from the NEWEST logged request to BOTH shards serving the
+    refreshed lineage — the ``photon_freshness_lag_seconds`` number the
+    autopilot gauges, measured through the identical code path without
+    the drift-event trigger. ``vs_baseline`` on the 1% line is the 10%
+    run's lag over the 1% run's (how sublinearly lag scales with touched
+    traffic — the O(touched) claim at loop scope)."""
+    from photon_ml_tpu.cli import train_game as train_game_cli
+    from photon_ml_tpu.cli import refresh_game as refresh_game_cli
+    from photon_ml_tpu.cli.config import parse_feature_shard_config
+    from photon_ml_tpu.feedback import join_feedback
+    from photon_ml_tpu.serving import ModelRegistry, RequestLog
+
+    base = _cached_fixture("fresh-base", _write_e2e_file, FRESH_ROWS,
+                           FRESH_USERS, FRESH_SONGS)
+    shards = "global=g|intercept,item=it|noIntercept"
+    coords = [
+        "global=fixed,shard=global,reg=L2,maxIter=25",
+        ("perUser=random,entity=userId,shard=item,reg=L2,maxIter=25,"
+         "buckets=histogram,maxSampleBuckets=4"),
+    ]
+    common = [
+        "--feature-shards", shards,
+        "--coordinates", *coords,
+        "--update-sequence", "global,perUser",
+        "--grid", "global=0.001", "perUser=1",
+        "--data-validation", "VALIDATE_DISABLED",
+        "--evaluators", "",
+    ]
+    shard_configs = tuple(parse_feature_shard_config(s)
+                          for s in shards.split(","))
+    rng = np.random.default_rng(17)
+
+    def log_traffic(log_dir, touched):
+        """Labeled score traffic for the first ``touched`` user ids —
+        the log the joiner turns back into training data."""
+        rl = RequestLog(log_dir, sample_rate=1.0, segment_records=64)
+        try:
+            for u in range(touched):
+                records = []
+                for _ in range(FRESH_ROWS_PER_USER):
+                    s = int(rng.integers(FRESH_SONGS))
+                    feats = ([{"name": f"g.x{k}", "term": "",
+                               "value": float(rng.normal())}
+                              for k in rng.choice(32, 6, replace=False)]
+                             + [{"name": f"it.x{k}", "term": "",
+                                 "value": float(rng.normal())}
+                                for k in rng.choice(8, 4, replace=False)])
+                    records.append({
+                        "features": feats, "offset": None,
+                        "label": float(rng.integers(2)),
+                        "metadataMap": {"userId": f"u{u}",
+                                        "songId": f"s{s}"}})
+                rl.log(request_id=f"fresh-u{u}", records=records,
+                       scores=[0.0] * len(records), version=1,
+                       lineage=None)
+        finally:
+            rl.close()  # durable segments before the join reads
+
+    _heartbeat()
+    with tempfile.TemporaryDirectory() as tmp:
+        prior = os.path.join(tmp, "base")
+        train_game_cli.run(["--training-data", base,
+                            "--output-dir", prior] + common)
+        _heartbeat()
+        results = []
+        for frac in (0.01, 0.10):
+            touched = max(1, int(FRESH_USERS * frac))
+            pct = int(frac * 100)
+            log_dir = os.path.join(tmp, f"reqlog-{pct}")
+            joined = os.path.join(tmp, f"joined-{pct}.avro")
+            out = os.path.join(tmp, f"refresh-{pct}")
+            # two fresh fleet-sharded registries per fraction: activation
+            # cost is part of the lag, measured from a cold patch
+            registries = [
+                ModelRegistry(shard_configs, max_batch=64, warmup=False,
+                              fleet_shard=(i, 2))
+                for i in range(2)]
+            for reg in registries:
+                reg.load(prior)
+            log_traffic(log_dir, touched)
+            join = join_feedback([log_dir], None, joined)
+            assert join.joined == touched * FRESH_ROWS_PER_USER, \
+                f"join lost rows: {join.as_dict()}"
+            res = refresh_game_cli.run(
+                ["--prior-dir", prior, "--training-data", joined,
+                 "--output-dir", out, "--fleet-shards", "2"] + common)
+            for i, reg in enumerate(registries):
+                reg.reload(os.path.join(out, f"patch-shard-{i}"))
+            lag = time.time() - join.last_ts
+            _heartbeat()
+            solved = sum(res["solved"].values())
+            results.append((frac, lag, solved, res))
+        (f1, lag1, solved1, _), (f10, lag10, solved10, _) = results
+        _emit("freshness_lag_s", lag1, "s", lag10 / max(lag1, 1e-9),
+              touched_fraction=f1, touched_users=int(FRESH_USERS * f1),
+              solved_entities=solved1,
+              joined_rows=int(FRESH_USERS * f1) * FRESH_ROWS_PER_USER,
+              fleet_shards=2, n_users=int(FRESH_USERS))
+        _emit("freshness_lag_s_10pct", lag10, "s", 1.0,
+              touched_fraction=f10, touched_users=int(FRESH_USERS * f10),
+              solved_entities=solved10,
+              joined_rows=int(FRESH_USERS * f10) * FRESH_ROWS_PER_USER,
+              fleet_shards=2, n_users=int(FRESH_USERS))
+
+
 def main(argv=None):
     import argparse
 
     p = argparse.ArgumentParser()
     p.add_argument("--only",
                    choices=["glm", "re", "re_sweep", "cd", "ingest", "e2e",
-                            "refresh", "serving", "ranked", "fleet"],
+                            "refresh", "freshness", "serving", "ranked",
+                            "fleet"],
                    help="run a single benchmark instead of the full suite")
     args = p.parse_args(argv)
     _setup_compile_cache()
@@ -1698,6 +1817,7 @@ def main(argv=None):
              "re_sweep": bench_re_sweep, "cd": bench_cd_sweep,
              "ingest": bench_ingest, "e2e": bench_end_to_end,
              "refresh": bench_refresh,
+             "freshness": bench_freshness,
              "serving": bench_serving_slo,
              "ranked": bench_serving_ranked,
              "fleet": bench_serving_fleet}[args.only]()
@@ -1736,6 +1856,8 @@ def main(argv=None):
         bench_cd_sweep()
         drain()
         bench_refresh()
+        drain()
+        bench_freshness()
         drain()
         bench_ingest()
         drain()

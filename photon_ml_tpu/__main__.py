@@ -14,6 +14,7 @@ _DRIVERS = {
     "train_glm": "photon_ml_tpu.cli.train_glm",
     "train_game": "photon_ml_tpu.cli.train_game",
     "refresh_game": "photon_ml_tpu.cli.refresh_game",
+    "join_feedback": "photon_ml_tpu.cli.join_feedback",
     "score_game": "photon_ml_tpu.cli.score_game",
     "serve_game": "photon_ml_tpu.cli.serve_game",
     "serve_fleet": "photon_ml_tpu.cli.serve_fleet",

@@ -422,3 +422,51 @@ def check_bounded_queue(ctx: FileContext):
 #: (``res-bounded-queue`` is engine-only — it postdates the legacy tool)
 RESILIENCE_RULE_IDS = ("res-bare-except", "res-sleep", "res-part-write",
                        "res-process", "res-table-home")
+
+
+#: the sanctioned request-log READ paths: the feedback joiner (the one
+#: label-join surface) and the replay audit tool; reqlog.py itself owns
+#: the reader it exports
+REQLOG_READ_ALLOWED = {
+    os.path.join("photon_ml_tpu", "serving", "reqlog.py"),
+    os.path.join("photon_ml_tpu", "feedback", "joiner.py"),
+    os.path.join("tools", "reqlog_replay.py"),
+}
+
+
+def _is_iter_reqlog_call(node: ast.AST, reader_names: set[str],
+                         reqlog_aliases: set[str]) -> bool:
+    """True for ``iter_reqlog(..)`` calls — by imported name or as an
+    attribute on an alias of the reqlog (or serving) module."""
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Name) and f.id in reader_names:
+        return True
+    return (isinstance(f, ast.Attribute) and f.attr == "iter_reqlog"
+            and isinstance(f.value, ast.Name)
+            and f.value.id in reqlog_aliases)
+
+
+@rule("res-reqlog-read-home",
+      "request-log READS stay in feedback/joiner.py and "
+      "tools/reqlog_replay.py", scope="all")
+def check_reqlog_read_home(ctx: FileContext):
+    if ctx.path in {os.path.normpath(p) for p in REQLOG_READ_ALLOWED}:
+        return
+    reader_names = (
+        ctx.from_aliases("photon_ml_tpu.serving.reqlog", "iter_reqlog")
+        | ctx.from_aliases("photon_ml_tpu.serving", "iter_reqlog"))
+    reqlog_aliases = (
+        ctx.module_aliases("photon_ml_tpu.serving.reqlog")
+        | ctx.module_aliases("photon_ml_tpu.serving"))
+    for node in ast.walk(ctx.tree):
+        if _is_iter_reqlog_call(node, reader_names, reqlog_aliases):
+            yield ctx.finding(
+                "res-reqlog-read-home", node,
+                "iter_reqlog call outside the sanctioned read paths — "
+                "the log's schema, segment order and join/duplicate "
+                "semantics are one contract owned by feedback/joiner.py "
+                "(training joins) and tools/reqlog_replay.py (replay "
+                "audits); a third reader silently forks that contract. "
+                "Join through feedback.join_feedback instead")

@@ -73,6 +73,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="ONE per-coordinate lambda config "
                         "'coordId=lambda' (a refresh fits a single "
                         "configuration — tuning belongs to full retrains)")
+    p.add_argument("--refresh-coordinates", nargs="+", default=None,
+                   metavar="COORD",
+                   help="restrict the touched-entity refit to these "
+                        "random-effect coordinates: every OTHER "
+                        "coordinate carries its coefficients forward "
+                        "bit-identically with zero solves even when its "
+                        "data changed (the feedback autopilot's "
+                        "drifted-coordinate refresh). Fixed effects "
+                        "always retrain. Default: refit wherever the "
+                        "manifest diff finds touched entities")
     p.add_argument("--refresh-sweeps", type=int, default=1,
                    help="refresh sweeps over the update sequence "
                         "(1 = production refresh: one warm pass)")
@@ -207,6 +217,20 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                 sorted(vocabs[re_coords[cid][0]][raw]
                        for raw in d.touched), np.int64)
             for cid, d in deltas.items()}
+        if args.refresh_coordinates:
+            allowed = set(args.refresh_coordinates)
+            unknown = sorted(allowed - set(re_coords))
+            if unknown:
+                raise SystemExit(
+                    f"--refresh-coordinates names unknown random-effect "
+                    f"coordinate(s) {unknown}; this model has "
+                    f"{sorted(re_coords)}")
+            # the drifted-coordinate restriction: an empty touched array
+            # (NOT a missing entry) pins the coordinate to a full carry
+            touched_entities = {
+                cid: (ids if cid in allowed
+                      else np.asarray([], np.int64))
+                for cid, ids in touched_entities.items()}
         if prior_manifest is None:
             import logging
 

@@ -60,6 +60,42 @@ def build_parser() -> argparse.ArgumentParser:
                         "(requires the item coordinate to be the only "
                         "random effect)")
     p.add_argument("--rank-max-k", type=int, default=128)
+    p.add_argument("--reqlog-dir", metavar="DIR", default=None,
+                   help="enable per-host request logs: host I writes its "
+                        "segments under DIR/host-I (serve_game "
+                        "--reqlog-dir); the feedback joiner consumes all "
+                        "of them")
+    p.add_argument("--reqlog-sample", type=float, default=1.0)
+    p.add_argument("--reqlog-segment-records", type=int, default=256)
+    p.add_argument("--quality-poll-s", type=float, default=0.0,
+                   help="per-host drift evaluator period (serve_game "
+                        "--quality-poll-s); in-process hosts share one "
+                        "event bus, so any host's drift event reaches "
+                        "the fleet autopilot")
+    p.add_argument("--drift-threshold", type=float, default=0.25)
+    p.add_argument("--canary-gate", action="store_true",
+                   help="per-host canary gate on reload candidates "
+                        "(serve_game --canary-gate); under the router's "
+                        "two-phase epoch ONE host's refusal aborts the "
+                        "activation fleet-wide")
+    p.add_argument("--canary-bound", type=float, default=None)
+    p.add_argument("--autopilot-config", metavar="JSON",
+                   help="close the freshness loop fleet-wide: a "
+                        "feedback.AutopilotConfig JSON file. One "
+                        "autopilot (subscribed to the shared bus) joins "
+                        "EVERY host's request log (--reqlog-dir "
+                        "required), refreshes the drifted coordinate "
+                        "with --fleet-shards = this fleet's shard count, "
+                        "and publishes the per-shard patch set where "
+                        "--router-watch-dir discovers it")
+    p.add_argument("--router-watch-dir", metavar="DIR",
+                   help="poll DIR on the ROUTER for published per-shard "
+                        "patch sets (patch-shard-0..N-1, stamps "
+                        "verified) or full model dirs, and drive each "
+                        "through the two-phase prepare→activate fleet "
+                        "epoch (fleet/watcher.py) — any host's refusal "
+                        "aborts with the incumbent serving fleet-wide")
+    p.add_argument("--router-watch-poll-s", type=float, default=10.0)
     from photon_ml_tpu.cli.config import (
         add_router_flags,
         add_telemetry_flags,
@@ -71,12 +107,15 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 class FleetHandle:
-    """The started fleet: router server + N host servers, one stop()."""
+    """The started fleet: router server + N host servers (plus the
+    optional loop pieces — fleet watcher, autopilot), one stop()."""
 
     def __init__(self, router_server, hosts, telemetry):
         self.router_server = router_server
         self.hosts = hosts
         self.telemetry = telemetry
+        self.watcher = None  # FleetPatchWatcher (--router-watch-dir)
+        self.autopilot = None  # FeedbackAutopilot (--autopilot-config)
 
     @property
     def url(self) -> str:
@@ -93,8 +132,16 @@ class FleetHandle:
         self.router_server.serve_forever()
 
     def stop(self) -> None:
+        # loop pieces first: no refresh launches or epochs against a
+        # fleet that is tearing down
+        if self.autopilot is not None:
+            self.autopilot.stop()
+        if self.watcher is not None:
+            self.watcher.stop()
         self.router_server.stop()
         for host in self.hosts:
+            if getattr(host, "drift_evaluator", None) is not None:
+                host.drift_evaluator.stop()
             host.stop()
         self.telemetry.close()
 
@@ -138,14 +185,35 @@ def build_fleet(argv: Optional[Sequence[str]] = None) -> FleetHandle:
         host_argv_common += ["--rank-item-coordinate",
                              args.rank_item_coordinate,
                              "--rank-max-k", str(args.rank_max_k)]
+    if args.quality_poll_s > 0:
+        host_argv_common += ["--quality-poll-s", str(args.quality_poll_s),
+                             "--drift-threshold",
+                             str(args.drift_threshold)]
+    if args.canary_gate:
+        host_argv_common.append("--canary-gate")
+    if args.canary_bound is not None:
+        host_argv_common += ["--canary-bound", str(args.canary_bound)]
+    import os as _os
+
     hosts = []
+    reqlog_dirs = []
     try:
         # shard-major host order ([s0r0, s0r1, s1r0, ...]): every replica
         # of a group serves the SAME shard view of the same model
         for i in range(n):
             for _r in range(config.replicas):
-                hosts.append(serve_game.build_server(
-                    host_argv_common + ["--fleet-shard", str(i)]).start())
+                host_argv = host_argv_common + ["--fleet-shard", str(i)]
+                if args.reqlog_dir:
+                    # one log per host (a real fleet has one per machine)
+                    d = _os.path.join(args.reqlog_dir,
+                                      f"host-{len(hosts)}")
+                    reqlog_dirs.append(d)
+                    host_argv += [
+                        "--reqlog-dir", d,
+                        "--reqlog-sample", str(args.reqlog_sample),
+                        "--reqlog-segment-records",
+                        str(args.reqlog_segment_records)]
+                hosts.append(serve_game.build_server(host_argv).start())
         router = FleetRouter(
             [h.url for h in hosts],
             replicas=config.replicas,
@@ -163,6 +231,34 @@ def build_fleet(argv: Optional[Sequence[str]] = None) -> FleetHandle:
     sample_store = next(iter(
         hosts[0].service.registry.active().stores.values()), None)
     handle = FleetHandle(server.start(), hosts, telemetry)
+    if args.router_watch_dir:
+        from photon_ml_tpu.fleet.watcher import FleetPatchWatcher
+
+        handle.watcher = FleetPatchWatcher(
+            router, args.router_watch_dir,
+            poll_s=args.router_watch_poll_s).start()
+    if args.autopilot_config:
+        if not args.reqlog_dir:
+            handle.stop()
+            raise SystemExit("--autopilot-config needs --reqlog-dir "
+                             "(the autopilot joins the hosts' request "
+                             "logs)")
+        from photon_ml_tpu.events import GLOBAL_BUS
+        from photon_ml_tpu.feedback import (
+            AutopilotConfig,
+            FeedbackAutopilot,
+        )
+
+        # in-process hosts share GLOBAL_BUS (each ModelRegistry's default
+        # bus), so ONE subscription hears every host's drift evaluator;
+        # the autopilot joins all N logs and cuts per-shard patches
+        ap_config = AutopilotConfig.load(args.autopilot_config)
+        if ap_config.fleet_shards == 0:
+            ap_config.fleet_shards = n
+        handle.autopilot = FeedbackAutopilot(
+            GLOBAL_BUS, ap_config, reqlog_dirs=reqlog_dirs,
+            reqlogs=[h.service.reqlog for h in hosts
+                     if h.service.reqlog is not None]).start()
     if sample_store is not None:
         import logging
 

@@ -117,6 +117,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--reqlog-max-mb", type=float, default=64.0,
                    help="total on-disk request-log budget; oldest segments "
                         "rotate out past it")
+    p.add_argument("--autopilot-config", metavar="JSON",
+                   help="close the freshness loop in-process: a "
+                        "feedback.AutopilotConfig JSON file (prior_dir, "
+                        "publish_dir, labels, the training-time specs, "
+                        "debounce/min-interval guards). On "
+                        "quality_drift_detected the autopilot joins this "
+                        "host's request log (--reqlog-dir required) to "
+                        "the labels, refreshes ONLY the drifted "
+                        "coordinate, and publishes into publish_dir — "
+                        "point --watch-dir there and the loop closes "
+                        "(CONTINUOUS.md 'The closed loop')")
     from photon_ml_tpu.cli.config import (
         add_quality_flags,
         add_rank_flags,
@@ -241,6 +252,19 @@ def build_server(argv: Optional[Sequence[str]] = None):
         server.drift_evaluator = DriftEvaluator(
             registry, threshold=quality.drift_threshold,
             poll_s=quality.quality_poll_s).start()
+    server.autopilot = None
+    if args.autopilot_config:
+        if reqlog is None:
+            raise SystemExit("--autopilot-config needs --reqlog-dir "
+                             "(the autopilot joins the request log)")
+        from photon_ml_tpu.feedback import (
+            AutopilotConfig,
+            FeedbackAutopilot,
+        )
+
+        server.autopilot = FeedbackAutopilot(
+            registry.bus, AutopilotConfig.load(args.autopilot_config),
+            reqlog_dirs=[args.reqlog_dir], reqlogs=[reqlog]).start()
     return server
 
 
@@ -257,6 +281,8 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
     except KeyboardInterrupt:
         pass
     finally:
+        if server.autopilot is not None:
+            server.autopilot.stop()
         if server.drift_evaluator is not None:
             server.drift_evaluator.stop()
         if server.watcher is not None:

@@ -1,0 +1,33 @@
+"""The feedback subsystem: the fleet retrains itself.
+
+Photon-ML's GLMix deployments (PAPER.md §0) kept per-entity random
+effects fresh with operator-scheduled Spark batch retrains; this package
+closes that loop ONLINE. The pieces:
+
+- :mod:`photon_ml_tpu.feedback.joiner` — deterministically join labels
+  (the request log's inline nullable ``label`` field plus an external
+  Avro/CSV source keyed by request id) to logged score records, emitting
+  incremental ``TrainingExampleAvro`` data the refresh driver consumes;
+  unjoinable/duplicate/late labels are counted, never dropped silently.
+- :mod:`photon_ml_tpu.feedback.autopilot` — subscribe to the registry
+  bus; on ``quality_drift_detected``, join the logged traffic and run
+  ``refresh_game`` in-process for ONLY the drifted coordinate
+  (touched-entity solve, carried coefficients bit-identical), publishing
+  the full model + per-shard patches into a watch directory under
+  debounce + max-refresh-rate guards and the ``feedback.join`` /
+  ``feedback.refresh_launch`` fault sites.
+
+Router-side activation (the loop's last hop) lives in
+:mod:`photon_ml_tpu.fleet.watcher`; the closed-loop architecture is
+drawn in CONTINUOUS.md.
+"""
+
+from photon_ml_tpu.feedback.autopilot import (  # noqa: F401
+    AutopilotConfig,
+    FeedbackAutopilot,
+)
+from photon_ml_tpu.feedback.joiner import (  # noqa: F401
+    JoinResult,
+    join_feedback,
+    load_labels,
+)

@@ -220,11 +220,20 @@ def read_datum(buf: BinaryIO, schema: Schema, names: dict) -> Any:
 
 
 def write_avro_file(path: str, records: Iterable[dict], schema: Schema,
-                    *, codec: str = "deflate", block_records: int = 4096) -> int:
-    """Write an Avro object-container file; returns the record count."""
+                    *, codec: str = "deflate", block_records: int = 4096,
+                    sync: "bytes | None" = None) -> int:
+    """Write an Avro object-container file; returns the record count.
+    ``sync`` pins the container's 16-byte sync marker — writers that
+    promise byte-identical output for identical records (the feedback
+    joiner) pass a deterministic one; the default stays random per spec
+    recommendation."""
     if codec not in ("null", "deflate", "snappy"):
         raise ValueError(f"unsupported codec {codec!r}")
-    sync = os.urandom(SYNC_SIZE)
+    if sync is None:
+        sync = os.urandom(SYNC_SIZE)
+    elif len(sync) != SYNC_SIZE:
+        raise ValueError(f"sync marker must be {SYNC_SIZE} bytes, "
+                         f"got {len(sync)}")
     names: dict = {}
     n_total = 0
     with open(path, "wb") as f:

@@ -429,10 +429,13 @@ class AvroDataReader:
 
 
 def write_training_examples(path: str, data_records: Iterable[dict], *,
-                            codec: str = "deflate") -> int:
-    """Convenience writer for tests/examples (TrainingExampleAvro rows)."""
+                            codec: str = "deflate",
+                            sync: "bytes | None" = None) -> int:
+    """Convenience writer for tests/examples (TrainingExampleAvro rows).
+    ``sync`` passes through to :func:`~photon_ml_tpu.io.avro.
+    write_avro_file` for writers that need byte-deterministic output."""
     from photon_ml_tpu.io.avro import write_avro_file
     from photon_ml_tpu.io.schemas import TRAINING_EXAMPLE_AVRO
 
     return write_avro_file(path, data_records, TRAINING_EXAMPLE_AVRO,
-                           codec=codec)
+                           codec=codec, sync=sync)

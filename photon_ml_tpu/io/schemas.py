@@ -94,6 +94,13 @@ REQUEST_LOG_SCORED_RECORD_AVRO = {
         # the served f32 score widened to double — exact, so replay
         # comparison is bit-level
         {"name": "score", "type": "double"},
+        # optional ground truth attached AT REQUEST TIME (backfill/replay
+        # clients that already know the outcome); most live traffic leaves
+        # it null and the feedback joiner attaches labels later from an
+        # external source keyed by request id. Readers decode with the
+        # embedded writer schema, so old segments without the field stay
+        # readable (feedback/joiner.py uses .get)
+        {"name": "label", "type": ["null", "double"], "default": None},
     ],
 }
 
@@ -131,6 +138,22 @@ REQUEST_LOG_AVRO = {
          "type": {"type": "array", "items": REQUEST_LOG_SCORED_RECORD_AVRO}},
         {"name": "topk", "type": ["null", REQUEST_LOG_TOPK_AVRO],
          "default": None},
+    ],
+}
+
+# External label source for the feedback joiner (feedback/joiner.py): one
+# record per observed outcome, keyed by the request id the serving front
+# end assigned (and echoed to the client) plus the record's index within
+# that request. The joiner matches these against logged
+# RequestLogScoredRecordAvro rows to build incremental training data.
+FEEDBACK_LABEL_AVRO = {
+    "type": "record",
+    "name": "FeedbackLabelAvro",
+    "namespace": NAMESPACE,
+    "fields": [
+        {"name": "requestId", "type": "string"},
+        {"name": "recordIndex", "type": "long", "default": 0},
+        {"name": "label", "type": "double"},
     ],
 }
 

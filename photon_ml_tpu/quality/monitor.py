@@ -226,8 +226,13 @@ class DriftEvaluator:
         if psi is not None and psi > self.threshold:
             with self._lock:
                 self.n_detections += 1
+            # the payload names WHAT drifted (coordinate/kind/drift) so a
+            # bus subscriber — the feedback autopilot above all — can act
+            # without re-scraping /metrics; psi/ks stay for back-compat
             self.registry.bus.post(
                 "quality_drift_detected", version=sm.version,
+                kind="psi", coordinate=TOTAL_COORDINATE,
+                drift=round(psi, 6),
                 psi=round(psi, 6),
                 ks=round(scores.get((TOTAL_COORDINATE, "ks"), 0.0), 6),
                 threshold=self.threshold, rows=monitor.n_rows)

@@ -60,6 +60,17 @@ misbehave. The registered sites:
                           replicas, or surfaces as a typed 503
                           (``reason=upstream``) when the rotation is
                           exhausted
+``feedback.join``         one visit per feedback-join pass
+                          (``feedback/joiner.py::join_feedback``) — a fault
+                          aborts that join cleanly (counted in
+                          ``photon_feedback_aborts_total{stage=join}`` when
+                          the autopilot drove it); serving and the request
+                          log are untouched and the next drift event retries
+``feedback.refresh_launch``  one visit per autopilot refresh launch
+                          (``feedback/autopilot.py``), before any join or
+                          refresh work — a fault aborts the launch with the
+                          incumbent serving; a wedged or faulted refresh
+                          never blocks the score path
 ========================  ====================================================
 
 Activation is explicit only: :func:`activate` / the :func:`injected` context
@@ -92,7 +103,7 @@ SITES = ("io.read", "ckpt.save", "io.model_save", "io.delta_publish",
          "collective", "optimizer.step", "worker.stall",
          "serving.parse", "serving.execute", "serving.reload",
          "serving.watch_tick", "io.save.reqlog", "fleet.fanout",
-         "fleet.replica")
+         "fleet.replica", "feedback.join", "feedback.refresh_launch")
 
 _MODES = ("raise", "nan", "stall", "kill")
 

@@ -166,6 +166,11 @@ class RequestLog:
                 "offset": (None if rec.get("offset") is None
                            else float(rec["offset"])),
                 "score": float(s),
+                # inline ground truth (backfill/replay clients); live
+                # traffic leaves it null — the feedback joiner attaches
+                # labels from the external source instead
+                "label": (None if rec.get("label") is None
+                          else float(rec["label"])),
             } for rec, s in zip(records, scores)],
             "topk": None if topk is None else {
                 "k": int(topk["k"]),

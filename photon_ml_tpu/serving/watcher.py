@@ -17,9 +17,13 @@ staged retire-then-rename (``io/pipeline.py``) means a directory either
 is absent or is complete — the watcher can never observe a half-written
 model. An entry that fails validation — or, under a canary-gated
 registry (``serve_game --canary-gate``, quality/canary.py), whose shadow
-scores diverge from the incumbent past the bound — is marked seen and
-skipped forever (its ``model_reload_rejected`` event/metric is the
-operator's signal); republish under a new name after fixing it.
+scores diverge from the incumbent past the bound — is skipped (its
+``model_reload_rejected`` event/metric is the operator's signal), but
+the seen/rejected set is keyed by CONTENT (:func:`candidate_content_key`,
+a stat fold over the entry's tree), not by name alone: a corrected
+republish under the SAME directory name changes the key and is
+re-attempted on the next poll. The fleet-side watcher
+(``fleet/watcher.py``) reuses the same keying.
 
 Waiting uses ``threading.Event.wait`` — serving code never sleeps
 (hygiene rule 2) and never reads ``perf_counter`` (telemetry hygiene).
@@ -27,6 +31,7 @@ Waiting uses ``threading.Event.wait`` — serving code never sleeps
 
 from __future__ import annotations
 
+import hashlib
 import logging
 import os
 import threading
@@ -36,6 +41,29 @@ from photon_ml_tpu.resilience.faults import fault_point
 from photon_ml_tpu.serving.registry import ModelRegistry
 
 logger = logging.getLogger(__name__)
+
+
+def candidate_content_key(path: str) -> str:
+    """Cheap content identity of a candidate directory: a fold of every
+    file's (relative path, size, mtime_ns), no data reads. Two publishes
+    of byte-identical trees CAN key differently (mtime moves) — that only
+    costs a redundant re-validate; what the key must guarantee is the
+    converse, that an in-place CHANGE never reuses a rejected entry's key
+    (the corrected-republish fix, ISSUE 17). Shared by the single-host
+    and the fleet watch-dir pollers so both forget a rejection as soon as
+    the entry's content moves."""
+    h = hashlib.blake2s(digest_size=12)
+    for dirpath, dirnames, filenames in os.walk(path):
+        dirnames.sort()
+        for name in sorted(filenames):
+            fp = os.path.join(dirpath, name)
+            try:
+                st = os.stat(fp)
+            except OSError:
+                continue  # racing publisher; next poll re-keys
+            h.update(f"{os.path.relpath(fp, path)}|{st.st_size}|"
+                     f"{st.st_mtime_ns}\n".encode())
+    return h.hexdigest()
 
 
 class ModelDirectoryWatcher:
@@ -51,7 +79,9 @@ class ModelDirectoryWatcher:
         #: /healthz payload) read them — the lock-discipline pass flagged
         #: the bare mutations, so they now share a lock
         self._lock = threading.Lock()
-        self._seen: set[str] = set()  # guarded-by: _lock
+        #: (entry name, content key) pairs already attempted — content
+        #: keyed, so a republish in place re-attempts (module docstring)
+        self._seen: set[tuple[str, str]] = set()  # guarded-by: _lock
         self._stop = threading.Event()
         #: start/stop are operator-lifecycle calls from one control thread
         self._thread: Optional[threading.Thread] = None  # guarded-by: caller
@@ -76,10 +106,13 @@ class ModelDirectoryWatcher:
             return 0  # publish dir not created yet — nothing to do
         applied = 0
         for name in names:
-            with self._lock:
-                if name in self._seen:
-                    continue
             path = os.path.join(self.watch_dir, name)
+            # key BEFORE the attempt: a publisher updating the entry
+            # mid-attempt changes the key and the next poll re-tries
+            key = (name, candidate_content_key(path))
+            with self._lock:
+                if key in self._seen:
+                    continue
             try:
                 from photon_ml_tpu.io.model_io import resolve_game_model_dir
 
@@ -90,7 +123,7 @@ class ModelDirectoryWatcher:
                 # still be picked up
                 continue
             with self._lock:
-                self._seen.add(name)
+                self._seen.add(key)
             try:
                 sm = self.registry.reload(path)
             except Exception as e:

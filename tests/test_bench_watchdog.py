@@ -171,7 +171,8 @@ class TestSuiteOrchestration:
     BENCHES = ["bench_end_to_end", "bench_glm", "bench_cd_sweep",
                "bench_refresh", "bench_ingest", "bench_serving_slo",
                "bench_serving_ranked", "bench_serving_fleet",
-               "bench_re_sweep", "bench_random_effect"]
+               "bench_freshness", "bench_re_sweep",
+               "bench_random_effect"]
 
     def _neuter(self, monkeypatch, order):
         # patch EVERY bench_* callable, not just the expected five: a

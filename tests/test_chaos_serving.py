@@ -10,7 +10,14 @@ injected ``serving.reload`` fault.
 ``--fleet`` runs the fleet cells instead (ISSUE 15): injected
 ``fleet.fanout`` faults, a mid-load host kill + same-port restart, and a
 faulted two-phase reload — per-kind accounting, no mixed-lineage
-response, probe scores bit-identical fleet-wide."""
+response, probe scores bit-identical fleet-wide.
+
+``--loop`` runs the freshness-loop cells (ISSUE 17): every hand-off of
+the closed serve→log→join→refresh→publish→activate loop faulted in turn
+(``feedback.join``, ``feedback.refresh_launch``, ``io.delta_publish``,
+``serving.reload`` on the activation epoch) — each abort leaves the
+incumbent serving bit-identically; the clean pass activates with zero
+recompiles on the untouched shard."""
 
 import os
 import sys
@@ -35,6 +42,15 @@ def test_chaos_serving_fleet_smoke_budget():
     # run on the nightly lane with the full grid
     assert chaos_serving.main(["--fleet", "--budget", "smoke",
                                "--rows", "300"]) == 0
+
+
+def test_chaos_serving_loop_smoke_budget():
+    # tier-1 BY DESIGN (ISSUE 17 acceptance): the loop cells are cheap —
+    # no open-loop load, one tiny model, three aborted refreshes and one
+    # clean activation — and they are the only end-to-end exercise of
+    # the feedback.join / feedback.refresh_launch fault sites
+    assert chaos_serving.main(["--loop", "--budget", "smoke",
+                               "--rows", "200"]) == 0
 
 
 @pytest.mark.slow

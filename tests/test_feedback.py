@@ -1,0 +1,596 @@
+"""Feedback subsystem tests (photon_ml_tpu/feedback/ + fleet/watcher.py
++ cli/join_feedback.py) — the self-driving freshness loop of ISSUE 17.
+
+The load-bearing contracts, each locked here:
+
+- **join accounting**: every logged score record and every label lands in
+  exactly one disposition — joined, unjoined, late (``unknown_request``)
+  or duplicate — and the joined output is deterministic (same log + same
+  labels → byte-identical Avro);
+- **fault sites**: ``feedback.join`` aborts a join pass cleanly and
+  ``feedback.refresh_launch`` aborts a loop before any work (both are
+  also driven end-to-end by ``chaos_serving.py --loop``);
+- **autopilot guards**: one refresh in flight, debounced re-posts
+  suppressed, a too-small join aborts (stage=join) with nothing
+  published;
+- **router watch-dir**: per-shard patch sets are stamp-verified before
+  any host is contacted, partial/foreign sets are refused, rejections
+  are CONTENT-keyed so a corrected republish under the same name
+  re-attempts;
+- **the E2E loop** (the PR's acceptance): drift event → join of the
+  fleet's request logs against an external label CSV → refresh of ONLY
+  the drifted coordinate (the other random-effect coordinate carries
+  bit-identically) → per-shard patches discovered by the router watcher
+  and activated through the two-phase epoch with ZERO recompiles on the
+  untouched host → a refused candidate leaves the incumbent serving
+  bit-identically fleet-wide.
+"""
+
+import json
+import os
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.cli import serve_fleet
+from photon_ml_tpu.cli import train_game as train_game_cli
+from photon_ml_tpu.cli.join_feedback import run as join_feedback_cli
+from photon_ml_tpu.events import EventBus, GLOBAL_BUS
+from photon_ml_tpu.feedback import (
+    AutopilotConfig,
+    FeedbackAutopilot,
+    join_feedback,
+    load_labels,
+)
+from photon_ml_tpu.fleet.sharding import shard_of_id
+from photon_ml_tpu.fleet.watcher import FleetPatchWatcher
+from photon_ml_tpu.io.avro import iter_avro_file
+from photon_ml_tpu.io.data_reader import write_training_examples
+from photon_ml_tpu.io.model_io import PATCH_KIND
+from photon_ml_tpu.resilience import FaultPlan, InjectedFault, injected
+from photon_ml_tpu.serving import RequestLog
+from photon_ml_tpu.serving.watcher import candidate_content_key
+
+# two random-effect coordinates, so a drifted-coordinate refresh has a
+# second coordinate whose bit-identical carry is observable
+SHARDS = "global=g|intercept,user=u|noIntercept,item=s|noIntercept"
+COORDS = [
+    "global=fixed,shard=global,reg=L2",
+    "perUser=random,entity=userId,shard=user,reg=L2",
+    "perItem=random,entity=songId,shard=item,reg=L2",
+]
+COMMON = [
+    "--feature-shards", SHARDS,
+    "--coordinates", *COORDS,
+    "--update-sequence", "global,perUser,perItem",
+    "--grid", "global=0.1", "perUser=1", "perItem=1",
+    "--evaluators", "",
+]
+D_FIXED, D_USER, D_ITEM = 4, 2, 2
+USERS = [f"u{i}" for i in range(10)]
+SONGS = [f"s{i}" for i in range(8)]
+
+
+def _features(rng):
+    return ([{"name": f"g.x{j}", "term": "",
+              "value": float(rng.normal())} for j in range(D_FIXED)]
+            + [{"name": f"u.z{j}", "term": "",
+                "value": float(rng.normal())} for j in range(D_USER)]
+            + [{"name": f"s.w{j}", "term": "",
+                "value": float(rng.normal())} for j in range(D_ITEM)])
+
+
+def _records(n, seed):
+    """Deterministic training rows cycling every user and song."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        out.append({"uid": str(i), "response": float(rng.integers(2)),
+                    "offset": None, "weight": None,
+                    "features": _features(rng),
+                    "metadataMap": {"userId": USERS[i % len(USERS)],
+                                    "songId": SONGS[i % len(SONGS)]}})
+    return out
+
+
+def _log_entries(log_dir, *, rows, rid_prefix="r", labels=None,
+                 segment_records=8):
+    """Write a request log directly (the unit-test channel; the E2E
+    fixture goes through the fleet's HTTP front instead)."""
+    rl = RequestLog(log_dir, sample_rate=1.0,
+                    segment_records=segment_records)
+    try:
+        for rid, recs in rows:
+            rl.log(request_id=rid, records=recs,
+                   scores=[0.0] * len(recs), version=1)
+    finally:
+        rl.close()
+
+
+# --------------------------------------------------------------------------
+# joiner units
+# --------------------------------------------------------------------------
+class TestJoiner:
+    def _rows(self, k=4):
+        rng = np.random.default_rng(7)
+        rows = []
+        for i in range(k):
+            rec = {"features": _features(rng), "offset": None,
+                   "metadataMap": {"userId": USERS[i % len(USERS)],
+                                   "songId": SONGS[i % len(SONGS)]}}
+            rows.append((f"r{i:03d}", [dict(rec), dict(rec)]))
+        return rows
+
+    def test_inline_external_late_duplicate_accounting(self, tmp_path):
+        log = str(tmp_path / "log")
+        rows = self._rows(4)
+        # r000#0 gets an INLINE label; everything else is unlabeled
+        rows[0][1][0]["label"] = 1.0
+        # r003 is logged twice — a replica double-log
+        rows.append((rows[3][0], [dict(r) for r in rows[3][1]]))
+        _log_entries(log, rows=rows)
+        csv = tmp_path / "labels.csv"
+        # header row + 2-col (index 0) + 3-col + a never-logged request
+        csv.write_text("request_id,label\n"
+                       "r001,1.0\n"
+                       "r002,1,0.0\n"
+                       "r003,0,1.0\n"
+                       "r003,1,0.0\n"
+                       "ghost,0,1.0\n")
+        out = str(tmp_path / "joined.avro")
+        res = join_feedback(log, str(csv), out)
+        # joined: r000#0 inline, r001#0, r002#1, r003#0, r003#1
+        assert res.joined == 5
+        # unjoined: r000#1, r001#1, r002#0 — plus the duplicate log's
+        # unlabeled... no: the re-log's labeled records are DUPLICATES
+        assert res.unjoined == 3
+        assert res.duplicates == 2  # r003#0 and r003#1, logged twice
+        assert res.late == 1  # ghost
+        assert res.requests == 5
+        recs = list(iter_avro_file(out))
+        assert [r["uid"] for r in recs] == [
+            "r000#0", "r001#0", "r002#1", "r003#0", "r003#1"]
+        assert [r["response"] for r in recs] == [1.0, 1.0, 0.0, 1.0, 0.0]
+        # the features ride verbatim — entity ids included
+        assert recs[0]["metadataMap"]["userId"] == "u0"
+        assert len(recs[0]["features"]) == D_FIXED + D_USER + D_ITEM
+
+    def test_join_deterministic_byte_identical(self, tmp_path):
+        log = str(tmp_path / "log")
+        _log_entries(log, rows=self._rows(3))
+        labels = {(f"r{i:03d}", 0): float(i % 2) for i in range(3)}
+        a, b = str(tmp_path / "a.avro"), str(tmp_path / "b.avro")
+        join_feedback([log], labels, a)
+        join_feedback([log], labels, b)
+        with open(a, "rb") as fa, open(b, "rb") as fb:
+            assert fa.read() == fb.read()
+
+    def test_empty_join_writes_valid_zero_row_file(self, tmp_path):
+        log = str(tmp_path / "log")
+        os.makedirs(log)
+        out = str(tmp_path / "joined.avro")
+        res = join_feedback(log, None, out)
+        assert res.joined == 0 and res.last_ts is None
+        assert list(iter_avro_file(out)) == []
+
+    def test_load_labels_csv_duplicate_first_wins(self, tmp_path):
+        csv = tmp_path / "l.csv"
+        csv.write_text("r1,1.0\nr1,0.0\nr2,3,0.5\n")
+        labels = load_labels(str(csv))
+        assert labels == {("r1", 0): 1.0, ("r2", 3): 0.5}
+
+    def test_join_fault_site_aborts_pass(self, tmp_path):
+        log = str(tmp_path / "log")
+        os.makedirs(log)
+        plan = FaultPlan.from_json(
+            {"seed": 0, "specs": [{"site": "feedback.join", "rate": 1.0}]})
+        with injected(plan):
+            with pytest.raises(InjectedFault):
+                join_feedback(log, None, str(tmp_path / "o.avro"))
+        assert plan.fired("feedback.join")
+
+    def test_cli_report_and_prior_requires_shards(self, tmp_path):
+        log = str(tmp_path / "log")
+        _log_entries(log, rows=self._rows(2))
+        csv = tmp_path / "l.csv"
+        csv.write_text("r000,1.0\nghost,0.0\n")
+        out = str(tmp_path / "joined.avro")
+        rpt = str(tmp_path / "report.json")
+        report = join_feedback_cli([
+            "--reqlog-dir", log, "--labels", str(csv),
+            "--output", out, "--report", rpt])
+        assert report["joined"] == 1 and report["late"] == 1
+        with open(rpt) as f:
+            assert json.load(f)["joined"] == 1
+        with pytest.raises(SystemExit):
+            join_feedback_cli(["--reqlog-dir", log, "--output", out,
+                               "--prior-dir", str(tmp_path)])
+
+
+# --------------------------------------------------------------------------
+# autopilot guards (no fleet, no training — abort paths only)
+# --------------------------------------------------------------------------
+def _guard_config(tmp_path, **over):
+    base = dict(prior_dir=str(tmp_path / "nope"),
+                publish_dir=str(tmp_path / "publish"),
+                feature_shards=SHARDS, coordinates=tuple(COORDS),
+                update_sequence="global,perUser,perItem",
+                grid=("global=0.1", "perUser=1", "perItem=1"),
+                evaluators="", data_validation="VALIDATE_DISABLED",
+                min_rows=1, debounce_s=0.0, min_interval_s=0.0)
+    base.update(over)
+    return AutopilotConfig(**base)
+
+
+def _wait_stats(ap, pred, timeout_s=30.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        s = ap.stats()
+        if pred(s):
+            return s
+        time.sleep(0.02)
+    return ap.stats()
+
+
+class TestAutopilotGuards:
+    def test_empty_join_aborts_and_debounce_suppresses(self, tmp_path):
+        log = str(tmp_path / "log")
+        os.makedirs(log)
+        bus = EventBus()
+        ap = FeedbackAutopilot(
+            bus, _guard_config(tmp_path, debounce_s=3600.0),
+            reqlog_dirs=[log]).start()
+        try:
+            bus.post("quality_drift_detected", version=1, kind="psi",
+                     coordinate="perUser", drift=1.0)
+            s = _wait_stats(ap, lambda s: s["aborts"] == 1
+                            and not s["busy"])
+            # 0 joined rows < min_rows: a counted abort, nothing published
+            assert s["aborts"] == 1 and s["refreshes"] == 0
+            assert not os.path.exists(os.path.join(
+                str(tmp_path / "publish"), "refresh-0001"))
+            # the debounce window swallows the evaluator's re-post
+            bus.post("quality_drift_detected", version=1, kind="psi",
+                     coordinate="perUser", drift=1.0)
+            s = _wait_stats(ap, lambda s: s["suppressed"] == 1,
+                            timeout_s=5.0)
+            assert s["suppressed"] == 1 and s["aborts"] == 1
+        finally:
+            ap.stop()
+
+    def test_launch_fault_aborts_before_any_work(self, tmp_path):
+        bus = EventBus()
+        ap = FeedbackAutopilot(bus, _guard_config(tmp_path),
+                               reqlog_dirs=[str(tmp_path / "none")]).start()
+        plan = FaultPlan.from_json({"seed": 0, "specs": [
+            {"site": "feedback.refresh_launch", "rate": 1.0}]})
+        try:
+            with injected(plan):
+                bus.post("quality_drift_detected", version=1, kind="psi",
+                         coordinate="perUser", drift=1.0)
+                s = _wait_stats(ap, lambda s: s["aborts"] == 1
+                                and not s["busy"])
+            assert plan.fired("feedback.refresh_launch")
+            assert s["aborts"] == 1 and s["refreshes"] == 0
+            # aborted before work: not even the publish root was created
+            assert not os.path.exists(str(tmp_path / "publish"))
+        finally:
+            ap.stop()
+
+    def test_other_events_ignored(self, tmp_path):
+        bus = EventBus()
+        ap = FeedbackAutopilot(bus, _guard_config(tmp_path),
+                               reqlog_dirs=[str(tmp_path)]).start()
+        try:
+            bus.post("model_saved", path="x")
+            bus.post("training_finished", driver="train_game")
+            s = ap.stats()
+            assert s == {"refreshes": 0, "aborts": 0, "suppressed": 0,
+                         "busy": False, "last": None}
+        finally:
+            ap.stop()
+
+    def test_config_json_roundtrip(self, tmp_path):
+        cfg = _guard_config(tmp_path, fleet_shards=2, labels="l.csv")
+        p = str(tmp_path / "ap.json")
+        with open(p, "w") as f:
+            json.dump(cfg.as_dict(), f)
+        back = AutopilotConfig.load(p)
+        assert back == cfg
+        assert isinstance(back.coordinates, tuple)
+
+
+# --------------------------------------------------------------------------
+# router watch-dir (stub router — the stamp/content-key layer alone)
+# --------------------------------------------------------------------------
+class _StubRouter:
+    def __init__(self, n_shards=2, refusals=0):
+        self.n_shards = n_shards
+        self.payloads = []
+        self.refusals = refusals
+
+    def reload(self, payload):
+        self.payloads.append(payload)
+        if self.refusals > 0:
+            self.refusals -= 1
+            raise RuntimeError("two-phase reload aborted — incumbent "
+                               "keeps serving fleet-wide")
+
+
+def _stamp(d, i, n=2, model_id="m1", parent="p0", kind=PATCH_KIND):
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "model-metadata.json"), "w") as f:
+        json.dump({"kind": kind, "fleetShard": i, "fleetShardCount": n,
+                   "modelId": model_id, "parentModel": parent}, f)
+    with open(os.path.join(d, "payload.avro"), "w") as f:
+        f.write("x")
+
+
+def _patch_entry(root, name, n=2, **kw):
+    entry = os.path.join(root, name)
+    for i in range(n):
+        _stamp(os.path.join(entry, f"patch-shard-{i}"), i, n=n, **kw)
+    return entry
+
+
+class TestFleetPatchWatcher:
+    def test_valid_set_activates_with_model_dirs(self, tmp_path):
+        router = _StubRouter()
+        entry = _patch_entry(str(tmp_path), "refresh-0001")
+        w = FleetPatchWatcher(router, str(tmp_path), poll_s=3600.0)
+        assert w.scan_once() == 1
+        assert router.payloads == [{"model_dirs": [
+            os.path.join(entry, "patch-shard-0"),
+            os.path.join(entry, "patch-shard-1")]}]
+        assert (w.n_applied, w.n_rejected) == (1, 0)
+        # same content: the next poll is a no-op
+        assert w.scan_once() == 0 and len(router.payloads) == 1
+
+    def test_bad_stamps_refused_before_any_host(self, tmp_path):
+        router = _StubRouter()
+        w = FleetPatchWatcher(router, str(tmp_path), poll_s=3600.0)
+        # shard stamp in the wrong slot
+        e1 = _patch_entry(str(tmp_path), "a-swapped")
+        _stamp(os.path.join(e1, "patch-shard-1"), 0)
+        # stamped for a 3-shard fleet
+        _patch_entry(str(tmp_path), "b-foreign", n=2)
+        _stamp(os.path.join(str(tmp_path), "b-foreign", "patch-shard-0"),
+               0, n=3)
+        # mixed lineage across the set
+        e3 = _patch_entry(str(tmp_path), "c-mixed")
+        _stamp(os.path.join(e3, "patch-shard-1"), 1, model_id="m2")
+        # partial set (one shard of two)
+        e4 = os.path.join(str(tmp_path), "d-partial")
+        _stamp(os.path.join(e4, "patch-shard-0"), 0)
+        assert w.scan_once() == 0
+        assert w.n_rejected == 4
+        assert router.payloads == []  # refused WITHOUT contacting hosts
+
+    def test_scratch_dirs_ignored_but_not_seen(self, tmp_path):
+        router = _StubRouter()
+        w = FleetPatchWatcher(router, str(tmp_path), poll_s=3600.0)
+        late = os.path.join(str(tmp_path), "still-publishing")
+        os.makedirs(late)
+        with open(os.path.join(late, "notes.txt"), "w") as f:
+            f.write("scratch")
+        assert w.scan_once() == 0
+        assert (w.n_applied, w.n_rejected) == (0, 0)
+        # the entry finishes publishing later under the SAME name — it
+        # was never marked seen, so it activates now
+        for i in range(2):
+            _stamp(os.path.join(late, f"patch-shard-{i}"), i)
+        assert w.scan_once() == 1
+
+    def test_rejection_is_content_keyed_republish_retries(self, tmp_path):
+        router = _StubRouter(refusals=1)
+        entry = _patch_entry(str(tmp_path), "refresh-0001")
+        w = FleetPatchWatcher(router, str(tmp_path), poll_s=3600.0)
+        assert w.scan_once() == 0  # epoch aborts; incumbent serving
+        assert (w.n_applied, w.n_rejected) == (0, 1)
+        assert w.scan_once() == 0  # unchanged content: no re-attempt
+        assert len(router.payloads) == 1
+        # a corrected republish in place changes the content key
+        meta = os.path.join(entry, "patch-shard-0", "model-metadata.json")
+        key0 = candidate_content_key(entry)
+        st = os.stat(meta)
+        os.utime(meta, ns=(st.st_atime_ns, st.st_mtime_ns + 1_000_000))
+        assert candidate_content_key(entry) != key0
+        assert w.scan_once() == 1
+        assert (w.n_applied, w.n_rejected) == (1, 1)
+
+
+# --------------------------------------------------------------------------
+# the E2E loop (the PR's acceptance test)
+# --------------------------------------------------------------------------
+def _http(url, body=None, headers=None, timeout=30):
+    data = None if body is None else json.dumps(body).encode()
+    req = urllib.request.Request(
+        url, data=data,
+        headers={"Content-Type": "application/json", **(headers or {})})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _load_model(run_dir):
+    from photon_ml_tpu.cli.config import parse_feature_shard_config
+    from photon_ml_tpu.io.index import IndexMap
+    from photon_ml_tpu.io.model_io import (
+        game_model_entity_vocabs,
+        load_game_model,
+        resolve_game_model_dir,
+    )
+
+    best = resolve_game_model_dir(run_dir)
+    maps = {c.shard_id: IndexMap.load(os.path.join(
+        run_dir, "feature-indexes", f"{c.shard_id}.json"))
+        for c in (parse_feature_shard_config(s)
+                  for s in SHARDS.split(","))}
+    vocabs = game_model_entity_vocabs(best)
+    return load_game_model(best, maps, vocabs), vocabs
+
+
+@pytest.fixture(scope="module")
+def e2e(tmp_path_factory):
+    """One closed loop, end to end, through the real wiring: trained
+    base model → 2-shard fleet (``serve_fleet`` with --reqlog-dir,
+    --autopilot-config, --router-watch-dir) → client-stamped /score
+    traffic → external label CSV → drift event → autopilot refresh →
+    watcher-driven two-phase activation → a refused candidate."""
+    tmp = str(tmp_path_factory.mktemp("feedback_e2e"))
+    d0 = os.path.join(tmp, "d0.avro")
+    write_training_examples(d0, _records(500, 0))
+    r0 = os.path.join(tmp, "r0")
+    train_game_cli.run(["--training-data", d0, "--output-dir", r0,
+                        "--data-validation", "VALIDATE_DISABLED"] + COMMON)
+
+    # every drift request targets entities OWNED BY SHARD 0, so shard
+    # 1's patch carries no rows — the zero-recompile leg
+    users0 = [u for u in USERS if shard_of_id(u, 2) == 0]
+    songs0 = [s for s in SONGS if shard_of_id(s, 2) == 0]
+    assert len(users0) >= 2 and len(songs0) >= 1, (users0, songs0)
+
+    k = 24
+    labels_csv = os.path.join(tmp, "labels.csv")
+    with open(labels_csv, "w") as f:
+        f.write("request_id,label\n")
+        for i in range(k):
+            f.write(f"fb-{i:03d},{float(i % 2)}\n")
+        f.write("ghost,0,1.0\n")  # a label the log never saw: late
+
+    publish = os.path.join(tmp, "publish")
+    cfg = AutopilotConfig(
+        prior_dir=r0, publish_dir=publish, feature_shards=SHARDS,
+        coordinates=tuple(COORDS),
+        update_sequence="global,perUser,perItem",
+        grid=("global=0.1", "perUser=1", "perItem=1"),
+        labels=labels_csv, evaluators="",
+        data_validation="VALIDATE_DISABLED",
+        min_rows=1, debounce_s=0.0, min_interval_s=0.0)
+    cfg_path = os.path.join(tmp, "autopilot.json")
+    with open(cfg_path, "w") as f:
+        json.dump(cfg.as_dict(), f)
+
+    fleet = serve_fleet.build_fleet([
+        "--model-dir", r0, "--feature-shards", SHARDS,
+        "--port", "0", "--fleet-shards", "2",
+        "--microbatch", "8", "--max-wait-ms", "1",
+        "--reqlog-dir", os.path.join(tmp, "reqlog"),
+        "--reqlog-segment-records", "8",
+        "--autopilot-config", cfg_path,
+        "--router-watch-dir", publish,
+        "--router-watch-poll-s", "0.2",
+    ])
+    facts = {"r0": r0, "k": k, "users0": users0}
+    try:
+        base = fleet.url
+        # fleet_shards defaulted to the fleet's own shard count
+        assert fleet.autopilot.config.fleet_shards == 2
+        rng = np.random.default_rng(42)
+        for i in range(k):
+            rec = {"features": _features(rng), "offset": None,
+                   "metadataMap": {"userId": users0[i % len(users0)],
+                                   "songId": songs0[i % len(songs0)]}}
+            _http(base + "/score", {"records": [rec]},
+                  headers={"X-Photon-Request-Id": f"fb-{i:03d}"})
+        health0 = [_http(u + "/healthz") for u in fleet.host_urls()]
+
+        GLOBAL_BUS.post("quality_drift_detected", version=1, kind="psi",
+                        coordinate="perUser", drift=1.0, threshold=0.25,
+                        rows=k)
+        s = _wait_stats(fleet.autopilot,
+                        lambda s: s["refreshes"] + s["aborts"] >= 1
+                        and not s["busy"], timeout_s=240.0)
+        facts["stats"] = s
+
+        # the watcher (0.2 s poll) discovers the published per-shard set
+        deadline = time.monotonic() + 60.0
+        while (time.monotonic() < deadline
+               and fleet.watcher.n_applied < 1
+               and fleet.watcher.n_rejected == 0):
+            time.sleep(0.05)
+        facts["applied"] = fleet.watcher.n_applied
+        facts["rejected_during_activation"] = fleet.watcher.n_rejected
+        health1 = [_http(u + "/healthz") for u in fleet.host_urls()]
+        facts["health0"], facts["health1"] = health0, health1
+
+        # pin post-activation probes, then present a REFUSABLE candidate
+        # (a partial patch set) — the incumbent must keep serving
+        probe = {"records": [
+            {"features": _features(np.random.default_rng(7)),
+             "offset": None,
+             "metadataMap": {"userId": users0[0], "songId": songs0[0]}}]}
+        facts["probe_scores"] = _http(base + "/score", probe)["scores"]
+        bad = os.path.join(publish, "zz-bad")
+        _stamp(os.path.join(bad, "patch-shard-0"), 0)
+        deadline = time.monotonic() + 30.0
+        while (time.monotonic() < deadline
+               and fleet.watcher.n_rejected
+               <= facts["rejected_during_activation"]):
+            time.sleep(0.05)
+        facts["rejected"] = fleet.watcher.n_rejected
+        facts["health2"] = [_http(u + "/healthz")
+                            for u in fleet.host_urls()]
+        facts["probe_scores_after"] = _http(base + "/score",
+                                            probe)["scores"]
+    finally:
+        fleet.stop()
+    return facts
+
+
+class TestClosedLoopE2E:
+    def test_loop_published_exactly_one_refresh(self, e2e):
+        s = e2e["stats"]
+        assert s["refreshes"] == 1, s
+        assert s["aborts"] == 0, s
+
+    def test_join_accounting_through_the_fleet_logs(self, e2e):
+        join = e2e["stats"]["last"]["join"]
+        # every client-stamped request joined its CSV label; the ghost
+        # label is late; nothing was silently dropped
+        assert join["joined"] == e2e["k"]
+        assert join["late"] == 1
+        assert join["unjoined"] == 0
+
+    def test_only_the_drifted_coordinate_solved(self, e2e):
+        solved = e2e["stats"]["last"]["solved"]
+        assert e2e["stats"]["last"]["coordinate"] == "perUser"
+        assert solved["perUser"] == len(e2e["users0"])
+        # the OTHER random-effect coordinate: zero solves, full carry
+        assert solved["perItem"] == 0
+
+    def test_carried_coordinate_bit_identical(self, e2e):
+        m0, v0 = _load_model(e2e["r0"])
+        m1, v1 = _load_model(e2e["stats"]["last"]["entry"])
+        re0, re1 = m0.coordinates["perItem"], m1.coordinates["perItem"]
+        for raw, dense0 in v0["songId"].items():
+            row0 = re0.entity_rows([dense0])[0]
+            row1 = re1.entity_rows([v1["songId"][raw]])[0]
+            assert np.array_equal(row0, row1), raw
+        # and the drifted coordinate's logged users actually moved
+        reu0, reu1 = m0.coordinates["perUser"], m1.coordinates["perUser"]
+        changed = 0
+        for raw in e2e["users0"]:
+            row0 = reu0.entity_rows([v0["userId"][raw]])[0]
+            row1 = reu1.entity_rows([v1["userId"][raw]])[0]
+            changed += int(not np.array_equal(row0, row1))
+        assert changed > 0
+
+    def test_router_activated_the_patch_set_fleet_wide(self, e2e):
+        assert e2e["applied"] == 1
+        assert e2e["rejected_during_activation"] == 0
+        for h0, h1 in zip(e2e["health0"], e2e["health1"]):
+            assert h1["version"] > h0["version"], (h0, h1)
+
+    def test_untouched_host_activated_with_zero_recompiles(self, e2e):
+        # host 1 = shard 1: every logged entity lives on shard 0, so its
+        # patch has no rows and activation reuses the jitted executables
+        assert (e2e["health1"][1]["compiles"]
+                == e2e["health0"][1]["compiles"])
+
+    def test_refused_candidate_leaves_incumbent_bit_identical(self, e2e):
+        assert e2e["rejected"] == e2e["rejected_during_activation"] + 1
+        for h1, h2 in zip(e2e["health1"], e2e["health2"]):
+            assert h2["version"] == h1["version"]
+        assert e2e["probe_scores_after"] == e2e["probe_scores"]

@@ -597,6 +597,243 @@ def run_fleet_chaos(args) -> int:
     return 1 if failures else 0
 
 
+def run_loop_chaos(args) -> int:
+    """``--loop``: chaos cells for every hand-off of the closed freshness
+    loop (ISSUE 17; CONTINUOUS.md "The closed loop"). A 2-shard fleet
+    serves while a FeedbackAutopilot + router FleetPatchWatcher run the
+    loop's legs with faults injected at each:
+
+    - ``join-fault``: ``feedback.join`` fires → the autopilot aborts at
+      the join stage; incumbent probe scores bit-identical.
+    - ``launch-fault``: ``feedback.refresh_launch`` fires → aborts
+      before ANY work; no staging dir survives, probes bit-identical.
+    - ``publish-fault``: ``io.delta_publish`` at rate 1 (outlasting the
+      retry budget) → the refresh leg fails, the loop aborts, probes
+      bit-identical.
+    - ``loop-activation``: a clean loop publishes per-shard patches;
+      the router watcher's first epoch is faulted (``serving.reload``)
+      → fleet-wide abort with the incumbent serving and probes
+      bit-identical; a corrected republish (content re-key) then
+      activates — versions advance everywhere, the untouched shard
+      compiles NOTHING, and the loop's retry accounting is clean.
+
+    Every labeled request targets users OWNED BY SHARD 0, so shard 1's
+    patch carries no entity rows — the zero-recompile assertion.
+    """
+    from photon_ml_tpu.cli import serve_fleet
+    from photon_ml_tpu.events import GLOBAL_BUS
+    from photon_ml_tpu.feedback import AutopilotConfig, FeedbackAutopilot
+    from photon_ml_tpu.fleet.sharding import shard_of_id
+    from photon_ml_tpu.fleet.watcher import FleetPatchWatcher
+    from photon_ml_tpu.resilience import FaultPlan, injected
+    from photon_ml_tpu.resilience.retry import (
+        get_default_policy,
+        set_default_policy,
+    )
+    from photon_ml_tpu.serving import RequestLog
+
+    cells: list[dict] = []
+    failures: list[str] = []
+    prev_policy = get_default_policy()
+    with tempfile.TemporaryDirectory() as tmp:
+        model_dir, train_path = train_model(tmp, args.rows)
+        set_default_policy(prev_policy)
+        fleet = serve_fleet.build_fleet([
+            "--model-dir", model_dir,
+            "--feature-shards", chaos_sweep.SHARDS,
+            "--port", "0", "--fleet-shards", "2",
+            "--microbatch", "8", "--max-wait-ms", "1",
+            "--max-queue", str(args.max_queue),
+        ])
+        base = fleet.url
+        bench_serving.wait_ready(base)
+        from photon_ml_tpu.io.avro import iter_avro_file
+
+        pool = list(iter_avro_file(train_path))[:256]
+
+        def user_of(rec):
+            return (rec.get("metadataMap") or {}).get("userId", "u0")
+
+        touched_pool = [r for r in pool if shard_of_id(user_of(r), 2) == 0]
+        probe = {"records": pool[:5]}
+        probe_scores = bench_serving._http_json(
+            base + "/score", probe)["scores"]
+
+        publish_dir = os.path.join(tmp, "publish")
+        reqlog_dir = os.path.join(tmp, "reqlog")
+        rl = RequestLog(reqlog_dir, sample_rate=1.0, segment_records=16)
+        try:
+            for i in range(0, min(len(touched_pool), 64), 8):
+                chunk = touched_pool[i:i + 8]
+                rl.log(request_id=f"loop-{i:03d}",
+                       records=[{"features": r["features"],
+                                 "metadataMap": r["metadataMap"],
+                                 "offset": r.get("offset"),
+                                 "label": float(r["response"])}
+                                for r in chunk],
+                       scores=[0.0] * len(chunk), version=1, lineage=None)
+        finally:
+            rl.close()  # durable segments before any join reads
+
+        config = AutopilotConfig(
+            prior_dir=model_dir, publish_dir=publish_dir,
+            feature_shards=chaos_sweep.SHARDS,
+            coordinates=tuple(chaos_sweep.COORDS),
+            update_sequence="global,perUser",
+            grid=("global=0.1", "perUser=1"),
+            evaluators="", data_validation="VALIDATE_DISABLED",
+            fleet_shards=2, min_rows=1,
+            debounce_s=0.0, min_interval_s=0.0)
+        autopilot = FeedbackAutopilot(GLOBAL_BUS, config,
+                                      reqlog_dirs=[reqlog_dir]).start()
+        watcher = FleetPatchWatcher(fleet.router, publish_dir,
+                                    poll_s=3600.0)  # driven by hand
+
+        def drive_loop(timeout_s=180.0):
+            """Post one drift event; wait for the launched loop to
+            finish. Returns (refreshes_delta, aborts_delta)."""
+            before = autopilot.stats()
+            GLOBAL_BUS.post("quality_drift_detected", version=1,
+                            kind="psi", coordinate="perUser", drift=1.0,
+                            threshold=0.25, rows=999)
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                now = autopilot.stats()
+                if (not now["busy"]
+                        and now["refreshes"] + now["aborts"]
+                        > before["refreshes"] + before["aborts"]):
+                    return (now["refreshes"] - before["refreshes"],
+                            now["aborts"] - before["aborts"])
+                time.sleep(0.05)
+            return (0, 0)
+
+        def check_probes(problems):
+            after = bench_serving._http_json(base + "/score", probe)
+            if after["scores"] != probe_scores:
+                problems.append("probe scores changed — the incumbent "
+                                "did not keep serving bit-identically")
+
+        def abort_cell(name, plan_obj, stage):
+            cell = {"cell": name, "plan": plan_obj}
+            plan = FaultPlan.from_json(plan_obj)
+            with injected(plan):
+                refreshed, aborted = drive_loop()
+            problems = []
+            if not plan.fired(plan_obj["specs"][0]["site"]):
+                problems.append(
+                    f"{plan_obj['specs'][0]['site']} never fired")
+            if (refreshed, aborted) != (0, 1):
+                problems.append(f"want 1 abort, 0 refreshes; got "
+                                f"{aborted} aborts, {refreshed} refreshes")
+            if os.path.exists(publish_dir) and any(
+                    not e.startswith(".")
+                    for e in os.listdir(publish_dir)):
+                problems.append("an aborted loop left a published entry")
+            check_probes(problems)
+            cell.update(stage=stage, ok=not problems)
+            cells.append(cell)
+            print(f"[chaos-serving] loop {name}: aborted={aborted} "
+                  f"{'ok' if cell['ok'] else 'FAIL'}", flush=True)
+            if problems:
+                failures.append(f"loop {name}: " + "; ".join(problems))
+
+        try:
+            # --- cells 1-3: each learn-leg hand-off faulted -------------
+            abort_cell("join-fault", {"seed": 0, "specs": [
+                {"site": "feedback.join", "rate": 1.0}]}, "join")
+            abort_cell("launch-fault", {"seed": 0, "specs": [
+                {"site": "feedback.refresh_launch", "rate": 1.0}]},
+                "launch")
+            # rate 1 with no max_fires outlasts the publish retry budget,
+            # so the refresh leg itself fails and the loop aborts
+            abort_cell("publish-fault", {"seed": 0, "specs": [
+                {"site": "io.delta_publish", "rate": 1.0}]}, "refresh")
+
+            # --- cell 4: clean loop, faulted activation, then retry -----
+            cell = {"cell": "loop-activation"}
+            problems = []
+            refreshed, aborted = drive_loop()
+            if (refreshed, aborted) != (1, 0):
+                problems.append(f"clean loop: want 1 refresh, got "
+                                f"{refreshed} refreshes {aborted} aborts")
+            entries = [e for e in os.listdir(publish_dir)
+                       if not e.startswith(".")] \
+                if os.path.exists(publish_dir) else []
+            if len(entries) != 1:
+                problems.append(f"want 1 published entry, got {entries}")
+            versions0 = [bench_serving._http_json(u + "/healthz")["version"]
+                         for u in fleet.host_urls()]
+            reload_plan = {"seed": 0,
+                           "specs": [{"site": "serving.reload", "at": [0]}]}
+            with injected(FaultPlan.from_json(reload_plan)):
+                watcher.scan_once()
+            versions1 = [bench_serving._http_json(u + "/healthz")["version"]
+                         for u in fleet.host_urls()]
+            if watcher.n_rejected != 1 or watcher.n_applied != 0:
+                problems.append(
+                    f"faulted epoch: want 1 rejected 0 applied, got "
+                    f"{watcher.n_rejected}/{watcher.n_applied}")
+            if versions1 != versions0:
+                problems.append(f"versions moved {versions0} → "
+                                f"{versions1} across an aborted epoch")
+            check_probes(problems)
+            if entries:
+                # corrected republish in place: touching the entry's
+                # content re-keys it (candidate_content_key) and the next
+                # poll re-attempts — no rename dance required
+                entry = os.path.join(publish_dir, entries[0])
+                meta = os.path.join(entry, "patch-shard-0",
+                                    "model-metadata.json")
+                os.utime(meta, None)
+                compiles0 = [
+                    bench_serving._http_json(u + "/healthz")["compiles"]
+                    for u in fleet.host_urls()]
+                watcher.scan_once()
+                if watcher.n_applied != 1:
+                    problems.append(f"republished entry did not activate "
+                                    f"(applied={watcher.n_applied})")
+                versions2 = [
+                    bench_serving._http_json(u + "/healthz")["version"]
+                    for u in fleet.host_urls()]
+                if not all(v2 > v1 for v1, v2
+                           in zip(versions1, versions2)):
+                    problems.append(f"versions did not advance fleet-wide"
+                                    f": {versions1} → {versions2}")
+                compiles1 = [
+                    bench_serving._http_json(u + "/healthz")["compiles"]
+                    for u in fleet.host_urls()]
+                # every labeled row targeted shard-0 users, so shard 1's
+                # patch has no entity rows: activation compiles nothing
+                if compiles1[1] != compiles0[1]:
+                    problems.append(
+                        f"untouched shard recompiled: "
+                        f"{compiles0[1]} → {compiles1[1]}")
+                cell.update(versions=versions2,
+                            untouched_compiles=compiles1[1]
+                            - compiles0[1])
+            cell["ok"] = not problems
+            cells.append(cell)
+            print(f"[chaos-serving] loop loop-activation: "
+                  f"{'ok' if cell['ok'] else 'FAIL'}", flush=True)
+            if problems:
+                failures.append("loop loop-activation: "
+                                + "; ".join(problems))
+        finally:
+            autopilot.stop()
+            fleet.stop()
+            set_default_policy(prev_policy)  # refresh runs install their own
+        artifact = {"budget": args.budget, "loop": True,
+                    "cells": cells, "failures": failures}
+        out_path = args.output or os.path.join(tmp, "chaos_serving.json")
+        with open(out_path, "w") as f:
+            json.dump(artifact, f, indent=2, sort_keys=True)
+    print(f"[chaos-serving] loop cells: {len(cells)}, "
+          f"failures: {len(failures)}", flush=True)
+    for f_ in failures:
+        print(f"[chaos-serving] FAIL {f_}", flush=True)
+    return 1 if failures else 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description="serving chaos harness: open-loop load under seeded "
@@ -632,8 +869,20 @@ def main(argv=None) -> int:
                         "R=2 fleet (zero client-visible errors) — "
                         "accounting identity per kind, probe scores "
                         "bit-identical fleet-wide")
+    p.add_argument("--loop", action="store_true",
+                   help="run the FRESHNESS-LOOP cells instead: a 2-shard "
+                        "fleet with a FeedbackAutopilot + router "
+                        "FleetPatchWatcher; faults at feedback.join, "
+                        "feedback.refresh_launch, io.delta_publish, and "
+                        "the activation epoch (serving.reload) — every "
+                        "hand-off aborts cleanly with the incumbent "
+                        "serving bit-identically, a corrected republish "
+                        "retries, and the untouched shard activates with "
+                        "zero recompiles")
     args = p.parse_args(argv)
 
+    if args.loop:
+        return run_loop_chaos(args)
     if args.fleet:
         return run_fleet_chaos(args)
 
