@@ -1469,6 +1469,7 @@ def bench_serving_fleet():
                 argparse.Namespace(data=None, pool=128), fleet)
             compiles0 = [bench_serving._http_json(u + "/healthz")["compiles"]
                          for u in fleet.host_urls()]
+            folded0 = bench_serving._scrape_metrics(fleet.url)
             metrics0 = bench_serving._scrape_process_metrics()
             run = bench_serving.open_loop_run(
                 fleet.url, pool, [1, 1, 1, 2, 4],
@@ -1476,6 +1477,8 @@ def bench_serving_fleet():
                 concurrency=16)
             compiles1 = [bench_serving._http_json(u + "/healthz")["compiles"]
                          for u in fleet.host_urls()]
+            folded1 = bench_serving._scrape_metrics(fleet.url)
+            proc1 = bench_serving._scrape_process_metrics()
             _fire_reshard()
             metrics1 = bench_serving._scrape_process_metrics()
             entities = [
@@ -1485,6 +1488,34 @@ def bench_serving_fleet():
         finally:
             fleet.stop()
         _heartbeat()
+    # fold parity (the fleet observability plane's accounting claim): the
+    # router's folded /metrics carries every member's serving-latency
+    # histogram once. The in-process hosts share the router's process
+    # registry, so the fold sums the SAME histogram (1 + n_hosts) times —
+    # the folded count's delta over the load window must be exactly that
+    # multiple of the process-registry delta, and the process delta must
+    # cover every client-served request (each served request executed on
+    # >= 1 host; cross-shard records, hedges and replica retries only ADD
+    # host-side observations, never remove them).
+    from photon_ml_tpu.telemetry.prometheus import series_value
+    lat_count = "photon_serving_request_latency_seconds_count"
+    members = 1 + len(entities)  # router + every host, one shared registry
+    fold_delta = int(series_value(folded1, lat_count)
+                     - series_value(folded0, lat_count))
+    proc_delta = int(series_value(proc1, lat_count)
+                     - series_value(metrics0, lat_count))
+    served = len(run["corrected_ms"]) + run["reconnected"]
+    if fold_delta != members * proc_delta:
+        raise AssertionError(
+            f"fleet /metrics fold parity: folded {lat_count} moved "
+            f"{fold_delta} over the load window, expected {members} "
+            f"members (router + hosts sharing one registry) x process "
+            f"delta {proc_delta} = {members * proc_delta}")
+    if proc_delta < served:
+        raise AssertionError(
+            f"fleet /metrics fold parity: hosts observed {proc_delta} "
+            f"admitted /score requests but clients tallied {served} "
+            f"served — the fold is missing host observations")
     corrected_p99 = bench_serving._percentile(run["corrected_ms"], 99)
     verdict = bench_serving.slo_gate_verdict(
         corrected_p99, slo_ms,
@@ -1513,6 +1544,8 @@ def bench_serving_fleet():
                                   in zip(compiles0, compiles1)],
           n_shed=run["shed"], n_errors=len(run["errors"]),
           n_reconnected=run["reconnected"],
+          fold_members=members, fold_count_delta=fold_delta,
+          host_observations=proc_delta,
           slo_p99_ms=slo_ms, slo_verdict=verdict["verdict"])
 
 

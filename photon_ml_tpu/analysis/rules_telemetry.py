@@ -302,7 +302,116 @@ def check_request_identity(ctx: FileContext):
                 f"from tools/reqlog_replay.py (hygiene rule 7)")
 
 
+#: names that carry raw REQUEST payload — a subscript/.get() on one of
+#: these reaching a span attribute or metric label is unbounded
+#: cardinality (every distinct entity id becomes its own series/tag)
+REQUEST_PAYLOAD_NAMES = frozenset({
+    "meta", "metadata", "metadatamap", "record", "records", "payload",
+    "body", "params", "qs", "query",
+})
+
+#: bare local names that obviously hold a per-request entity identity
+ENTITY_ID_NAME_RE = re.compile(
+    r"\A(user|entity|item|song|member)_?id\Z", re.IGNORECASE)
+
+#: span/annotation call names whose KEYWORDS become span attributes
+SPAN_ATTR_CALLS = frozenset({"span", "span_under", "record_span",
+                             "annotate", "set"})
+
+#: keywords that are sanctioned tags: the request id is the designed
+#: per-request join key (hygiene rule 7), and span_under/record_span
+#: plumbing keywords aren't attributes at all
+SANCTIONED_ATTR_KEYWORDS = frozenset({"request_id", "parent_id",
+                                      "seconds", "ts"})
+
+
+def _payload_root(node: ast.AST) -> bool:
+    """True when the expression reads a raw request-payload field:
+    ``meta["userId"]``, ``payload.get("memberId")``, ``record[...]`` —
+    chased through attribute chains (``self.payload[...]``)."""
+    if isinstance(node, ast.Subscript):
+        return _payload_base(node.value)
+    if (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "get"):
+        return _payload_base(node.func.value)
+    return False
+
+
+def _payload_base(node: ast.AST) -> bool:
+    if isinstance(node, ast.Name):
+        return node.id.lower() in REQUEST_PAYLOAD_NAMES
+    if isinstance(node, ast.Attribute):
+        return node.attr.lower() in REQUEST_PAYLOAD_NAMES
+    return False
+
+
+def _unbounded_value(node: ast.AST) -> bool:
+    """An attribute/label VALUE expression with unbounded request-derived
+    cardinality: a payload subscript/get, an entity-id-named local, or
+    an f-string / str() / concat wrapping one."""
+    if _payload_root(node):
+        return True
+    if isinstance(node, ast.Name) and ENTITY_ID_NAME_RE.match(node.id):
+        return True
+    if isinstance(node, ast.JoinedStr):
+        return any(_unbounded_value(v.value) for v in node.values
+                   if isinstance(v, ast.FormattedValue))
+    if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+            and node.func.id in ("str", "repr") and node.args):
+        return _unbounded_value(node.args[0])
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        return (_unbounded_value(node.left)
+                or _unbounded_value(node.right))
+    return False
+
+
+@rule("tel-span-attr-cardinality",
+      "no span attributes or metric label values derived from unbounded "
+      "request fields — tags index storage, payloads don't belong there")
+def check_span_attr_cardinality(ctx: FileContext):
+    """Span attributes and metric labels are INDEXED: every distinct
+    value is a new series (metrics) or a new tag value (trace tooling
+    group-bys). A value read off the raw request payload — an entity id,
+    a metadata field — is unbounded, so one hot user explodes the
+    registry and the span tree's group keys. Bounded request identity
+    already has sanctioned homes: the request id (hygiene rule 7) and
+    the closed leg-summary stage vocabulary
+    (``serving/http.py::parse_leg_summary`` — the parser DROPS unknown
+    keys precisely so fleet trace stitching can never import a host's
+    unbounded field names as span data)."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or not node.keywords:
+            continue
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            call_name = func.attr
+        elif isinstance(func, ast.Name):
+            call_name = func.id
+        else:
+            continue
+        if call_name == "labels":
+            kind = "metric label"
+        elif call_name in SPAN_ATTR_CALLS:
+            kind = "span attribute"
+        else:
+            continue
+        for kw in node.keywords:
+            if kw.arg is None or kw.arg in SANCTIONED_ATTR_KEYWORDS:
+                continue
+            if _unbounded_value(kw.value):
+                yield ctx.finding(
+                    "tel-span-attr-cardinality", node,
+                    f"{kind} {kw.arg!r} set from a raw request field — "
+                    f"unbounded cardinality: every distinct value becomes "
+                    f"its own series/tag. Count it under a bounded label, "
+                    f"or join through the request id (the sanctioned "
+                    f"per-request key)")
+
+
 #: the shim's rule subset, in the legacy tool's documented order
+#: (``tel-span-attr-cardinality`` is engine-only — it postdates the
+#: legacy tool)
 TELEMETRY_RULE_IDS = ("tel-print", "tel-perf-counter", "tel-metric-name",
                       "tel-registry", "tel-wall-clock", "tel-drift-home",
                       "tel-request-identity")

@@ -580,6 +580,13 @@ class RouterConfig:
     ``request_timeout_ms`` is the router-side default deadline for
     requests carrying no ``X-Photon-Deadline-Ms`` of their own (0 =
     none), propagated to hosts as the REMAINING budget.
+
+    ``slo_objective_ms`` arms the fleet SLO burn-rate tracker
+    (``fleet/observe.py``): a routed request slower than the objective
+    (or failed) spends error budget against ``slo_target``; the tracker
+    ticks every ``slo_tick_s`` and posts edge-triggered
+    ``slo_burn_alert`` events (→ ``photon_slo_burn_total{window}``).
+    0 = no tracker.
     """
 
     fleet_shards: int = 2
@@ -587,6 +594,9 @@ class RouterConfig:
     hedge_delay_ms: float = 0.0
     fanout_timeout_s: float = 30.0
     request_timeout_ms: float = 0.0
+    slo_objective_ms: float = 0.0
+    slo_target: float = 0.999
+    slo_tick_s: float = 10.0
 
     def __post_init__(self):
         if self.fleet_shards < 1:
@@ -601,6 +611,15 @@ class RouterConfig:
         if self.fanout_timeout_s <= 0:
             raise ValueError(f"fanout_timeout_s must be > 0, "
                              f"got {self.fanout_timeout_s}")
+        if self.slo_objective_ms < 0:
+            raise ValueError(f"slo_objective_ms must be >= 0, "
+                             f"got {self.slo_objective_ms}")
+        if not 0.0 < self.slo_target < 1.0:
+            raise ValueError(f"slo_target must be in (0, 1), "
+                             f"got {self.slo_target}")
+        if self.slo_tick_s <= 0:
+            raise ValueError(f"slo_tick_s must be > 0, "
+                             f"got {self.slo_tick_s}")
 
     # --- config-file round-trip ------------------------------------------
     def as_dict(self) -> dict:
@@ -608,7 +627,10 @@ class RouterConfig:
                 "replicas": self.replicas,
                 "hedgeDelayMs": self.hedge_delay_ms,
                 "fanoutTimeoutS": self.fanout_timeout_s,
-                "requestTimeoutMs": self.request_timeout_ms}
+                "requestTimeoutMs": self.request_timeout_ms,
+                "sloObjectiveMs": self.slo_objective_ms,
+                "sloTarget": self.slo_target,
+                "sloTickS": self.slo_tick_s}
 
     @classmethod
     def from_dict(cls, d: Mapping) -> "RouterConfig":
@@ -616,7 +638,10 @@ class RouterConfig:
                    replicas=int(d.get("replicas", 1)),
                    hedge_delay_ms=float(d.get("hedgeDelayMs", 0.0)),
                    fanout_timeout_s=float(d.get("fanoutTimeoutS", 30.0)),
-                   request_timeout_ms=float(d.get("requestTimeoutMs", 0.0)))
+                   request_timeout_ms=float(d.get("requestTimeoutMs", 0.0)),
+                   slo_objective_ms=float(d.get("sloObjectiveMs", 0.0)),
+                   slo_target=float(d.get("sloTarget", 0.999)),
+                   slo_tick_s=float(d.get("sloTickS", 10.0)))
 
 
 def add_router_flags(parser) -> None:
@@ -644,6 +669,21 @@ def add_router_flags(parser) -> None:
              "to a typed 503 (reason=upstream) instead of a hang, and a "
              "request's remaining deadline budget caps each leg below "
              "this")
+    parser.add_argument(
+        "--slo-objective-ms", type=float, default=0.0,
+        help="latency objective arming the fleet SLO burn-rate tracker: "
+             "a routed request slower than this (or failed) spends error "
+             "budget; crossing a burn-rate threshold posts slo_burn_alert "
+             "(photon_slo_burn_total). 0 = no tracker")
+    parser.add_argument(
+        "--slo-target", type=float, default=0.999,
+        help="SLO success-rate target (the error budget is 1 - target); "
+             "burn rate 1.0 spends the budget exactly at the sustainable "
+             "rate")
+    parser.add_argument(
+        "--slo-tick-s", type=float, default=10.0,
+        help="how often the burn-rate tracker closes a bucket and "
+             "evaluates its alert windows")
 
 
 def router_from_args(args) -> RouterConfig:
@@ -651,7 +691,10 @@ def router_from_args(args) -> RouterConfig:
                         replicas=args.replicas,
                         hedge_delay_ms=args.hedge_delay_ms,
                         fanout_timeout_s=args.fanout_timeout_s,
-                        request_timeout_ms=args.request_timeout_ms)
+                        request_timeout_ms=args.request_timeout_ms,
+                        slo_objective_ms=args.slo_objective_ms,
+                        slo_target=args.slo_target,
+                        slo_tick_s=args.slo_tick_s)
 
 
 def parse_grid(specs: Sequence[str]) -> list[Mapping[str, float]]:

@@ -4,7 +4,8 @@ Launches a local N-host serving fleet in ONE process — N entity-sharded
 ``serve_game`` servers (each packing its 1/N slice of every dense
 coefficient table) behind a :class:`~photon_ml_tpu.fleet.router.
 FleetRouter` — and serves the router's endpoints (``/score`` ``/rank``
-``/healthz`` ``/readyz`` ``/metrics`` ``/reload``). This is the test and
+``/healthz`` ``/readyz`` ``/metrics`` ``/statusz`` ``/reload``). This is
+the test and
 bench topology (and the "does sharding change my scores?" audit tool: it
 must not — f32 responses are bit-identical to an unsharded server). A
 production fleet runs the same pieces across machines: one ``serve_game
@@ -220,6 +221,17 @@ def build_fleet(argv: Optional[Sequence[str]] = None) -> FleetHandle:
             hedge_delay_ms=config.hedge_delay_ms,
             fanout_timeout_s=config.fanout_timeout_s,
             default_timeout_ms=config.request_timeout_ms)
+        if config.slo_objective_ms > 0:
+            from photon_ml_tpu.events import GLOBAL_BUS
+            from photon_ml_tpu.fleet.observe import SloBurnTracker
+
+            # alerts land on the shared bus; the telemetry bridge turns
+            # them into photon_slo_burn_total{window}
+            router.observer.attach_slo(
+                SloBurnTracker(GLOBAL_BUS,
+                               objective_s=config.slo_objective_ms / 1e3,
+                               target=config.slo_target),
+                tick_s=config.slo_tick_s)
         server = RouterServer(router, host=args.host, port=args.port)
     except BaseException:
         for h in hosts:
@@ -276,7 +288,7 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
     fleet = build_fleet(argv)
     rank_on = bool(fleet.hosts[0].service.registry.rank_coordinate)
     endpoints = ("/score" + (" /rank" if rank_on else "")
-                 + " /healthz /readyz /metrics /reload /reshard")
+                 + " /healthz /readyz /metrics /statusz /reload /reshard")
     router = fleet.router
     print(f"serving GAME fleet ({router.n_shards} shards x "
           f"{router.replicas} replicas) on "

@@ -29,12 +29,14 @@ piece that makes the fleet look like ONE server:
   fleet-wide), then activates everywhere. The single-host watcher +
   canary gate generalize exactly here: gate at the router, activate
   everywhere.
-- ``GET /metrics`` — the fleet fold: every host's ``/metrics`` text plus
-  the router's own registry through
+- ``GET /metrics`` — the fleet fold: every host's ``/metrics`` text,
+  scraped over the SAME pooled leg connections, plus the router's own
+  registry through
   :func:`photon_ml_tpu.telemetry.aggregate.aggregate_text` (counters and
   histogram series sum; host-owned gauges — queue depth, brownout level,
-  rank items — are tagged ``process="<shard>"`` and fan out). The same
-  fold ``tools/metrics_fold.py`` runs offline, byte-identically.
+  rank items — are tagged ``shard="I"``, ``replica="J"`` and fan out).
+  The same fold ``tools/metrics_fold.py`` runs offline, byte-identically.
+  ``GET /statusz`` is the human topology page (``fleet/observe.py``).
 
 **Elastic fleet** (PR 16): each shard can run a REPLICA GROUP of R hosts
 (``serve_fleet --replicas R``; the host list is shard-major). A failed
@@ -83,16 +85,23 @@ from typing import Mapping, Optional, Sequence
 
 import numpy as np
 
+from photon_ml_tpu.fleet.observe import (  # noqa: F401  (re-exported)
+    FleetObserver,
+    fold_fleet_snapshots,
+    tag_host_owned,
+)
 from photon_ml_tpu.fleet.sharding import ShardMap, retry_jitter_s, stable_hash_u32
 from photon_ml_tpu.game.model import sum_coordinate_margins
 from photon_ml_tpu.resilience.faults import fault_point
 from photon_ml_tpu.serving import overload as _overload
 from photon_ml_tpu.serving.http import (
     DEADLINE_HEADER,
+    LEG_SUMMARY_HEADER,
     REQUEST_ID_HEADER,
     SHARD_MAP_HEADER,
     ShardMapMismatch,
     new_request_id,
+    parse_leg_summary,
     shed_status,
 )
 from photon_ml_tpu.telemetry import metrics as _metrics
@@ -218,13 +227,20 @@ class HostClient:
 
     def request(self, method: str, path: str, payload=None,
                 headers: Optional[Mapping[str, str]] = None,
-                timeout_s: Optional[float] = None) -> "tuple[int, dict]":
+                timeout_s: Optional[float] = None,
+                raw: bool = False,
+                headers_out: Optional[dict] = None) -> "tuple[int, dict]":
         """One JSON request → ``(status, body)``. Raises ``OSError`` /
         ``http.client.HTTPException`` when the host is unreachable past
         the bounded reconnect (the caller owns the upstream mapping).
         ``timeout_s`` caps THIS exchange below the pool-wide default —
         the router passes the request's remaining deadline budget, so a
-        leg can never outlive the deadline it is serving."""
+        leg can never outlive the deadline it is serving.
+        ``raw=True`` returns the body as decoded TEXT instead of parsed
+        JSON (the observer scrapes ``/metrics`` exposition over these
+        same pooled connections — and through the same ``fleet.fanout``
+        chaos site). ``headers_out`` receives the response headers the
+        caller cares about (the leg-summary stage breakdown)."""
         # the fleet chaos site: one visit per LEG (not per reconnect
         # attempt) — an injected fault is a host that cannot be reached
         fault_point("fleet.fanout", host=self.url, path=path)
@@ -244,6 +260,13 @@ class HostClient:
                 conn.request(method, path, body=body, headers=hdrs)
                 resp = conn.getresponse()
                 data = resp.read()
+                if headers_out is not None:
+                    summary = resp.getheader(LEG_SUMMARY_HEADER)
+                    if summary is not None:
+                        headers_out[LEG_SUMMARY_HEADER] = summary
+                if raw:
+                    self._give(conn)
+                    return resp.status, data.decode()
                 status, out = resp.status, json.loads(data or b"{}")
                 if status == 503 and out.get("reason") == "stopping":
                     # the host is DRAINING: it answered a complete
@@ -330,6 +353,9 @@ class FleetRouter:
         self._lat_lock = threading.Lock()
         self._latency = [collections.deque(maxlen=128)
                          for _ in range(self.n_shards)]
+        #: legs in flight against each shard right now — the observer
+        #: samples this into photon_fleet_shard_load at scrape time
+        self._shard_inflight = [0] * self.n_shards  # guarded-by: _lat_lock
         #: serializes two-phase epochs (model reload / live reshard)
         self._epoch_lock = threading.Lock()
         #: the drain barrier: reshard activation waits for in-flight
@@ -343,8 +369,23 @@ class FleetRouter:
         self._coordinates: Optional[list] = None  # guarded-by: _lock
         self._rank_info: Optional[dict] = None  # guarded-by: _lock
         self.n_requests = 0  # guarded-by: _lock
+        #: the observability plane — scrapes hosts over THESE pooled
+        #: clients, owns /statusz and the optional SLO tracker (no
+        #: threads until attach_slo asks for a tick loop)
+        self.observer = FleetObserver(self)
         _FLEET_HOSTS.set(len(host_urls))
         _SHARDMAP_VERSION.set(self.shard_map.version)
+
+    # --- observability taps ----------------------------------------------
+    def latency_snapshot(self) -> "list[list[float]]":
+        """Copy of each shard's recent-leg latency window (seconds)."""
+        with self._lat_lock:
+            return [list(d) for d in self._latency]
+
+    def shard_load(self) -> "list[int]":
+        """Legs currently in flight against each shard."""
+        with self._lat_lock:
+            return list(self._shard_inflight)
 
     # --- deadlines (same contract as ServingService) ----------------------
     def resolve_deadline(self,
@@ -473,27 +514,54 @@ class FleetRouter:
 
     def _fanout_leg(self, shard: int, method: str, path: str, payload,
                     headers, request_id: Optional[str],
-                    timeout_s: Optional[float]) -> "tuple[int, dict]":
+                    timeout_s: Optional[float],
+                    parent_span: Optional[int] = None,
+                    ) -> "tuple[int, dict]":
         """One shard's exchange across its replica group: primary first;
         a primary that FAILS is retried on the next replica (counted in
         ``photon_fleet_replica_retries_total``); a primary that is merely
         SLOW is hedged — the backup fires after the hedge delay, the
         first answer wins, and the loser's outcome is consumed (its
-        pooled connection returns through the normal give-back)."""
+        pooled connection returns through the normal give-back).
+
+        Every attempt — primary, retry, hedge — is a ``fleet.leg`` span
+        parented on the request's fan-out span (``parent_span``; replica
+        attempts run on the hedge pool, where contextvars don't follow),
+        so the merged ``trace.jsonl`` shows hedges and retries as
+        SIBLINGS under one tree. The host's stage breakdown rides back in
+        the leg-summary header and lands as ``host.*`` child spans."""
         group = self.clients[shard]
         label = str(shard)
 
-        def attempt(replica: int) -> "tuple[int, dict]":
-            t0 = time.monotonic()
-            out = group[replica].request(method, path, payload,
-                                         headers=headers,
-                                         timeout_s=timeout_s)
-            with self._lat_lock:
-                self._latency[shard].append(time.monotonic() - t0)
+        def attempt(replica: int, kind: str) -> "tuple[int, dict]":
+            with _tracing.span_under(parent_span, "fleet.leg",
+                                     shard=label, replica=str(replica),
+                                     kind=kind) as sp:
+                headers_out: dict = {}
+                t0 = time.monotonic()
+                out = group[replica].request(method, path, payload,
+                                             headers=headers,
+                                             timeout_s=timeout_s,
+                                             headers_out=headers_out)
+                with self._lat_lock:
+                    self._latency[shard].append(time.monotonic() - t0)
+                summary = parse_leg_summary(
+                    headers_out.get(LEG_SUMMARY_HEADER))
+                host_span = summary.pop("span", None)
+                if host_span is not None:
+                    # the host-side span id: joins this leg to the
+                    # host's OWN trace file when the two are merged
+                    sp.set(host_span=host_span)
+                for stage, seconds in summary.items():
+                    _tracing.record_span("host." + stage,
+                                         seconds=seconds,
+                                         parent_id=sp.span_id,
+                                         shard=label,
+                                         replica=str(replica))
             return out
 
         if len(group) == 1:
-            return attempt(0)
+            return attempt(0, "primary")
         order = self._replica_order(request_id)
         pending: dict = {}  # future -> replica
         errors: list = []
@@ -516,7 +584,8 @@ class FleetRouter:
                     return
                 if kind == "retry":
                     _REPLICA_RETRIES.labels(shard=label).inc()
-            pending[self._hedge_pool.submit(attempt, replica)] = replica
+            pending[self._hedge_pool.submit(attempt, replica,
+                                            kind)] = replica
 
         launch("primary")
         hedged = False
@@ -581,7 +650,8 @@ class FleetRouter:
 
     def _leg(self, shard: int, method: str, path: str, payload=None,
              headers=None, request_id: Optional[str] = None,
-             deadline: Optional[float] = None) -> dict:
+             deadline: Optional[float] = None,
+             parent_span: Optional[int] = None) -> dict:
         """One per-shard leg: timed, replica-failed-over, hedged,
         deadline-bounded, upstream-mapped, shed-passthrough."""
         timeout_s = None
@@ -595,11 +665,27 @@ class FleetRouter:
                     "deadline",
                     message=f"deadline expired before shard {shard} leg")
             timeout_s = remaining
+        with self._lat_lock:
+            self._shard_inflight[shard] += 1
+        try:
+            return self._timed_leg(shard, method, path, payload, headers,
+                                   request_id, timeout_s, deadline,
+                                   parent_span)
+        finally:
+            with self._lat_lock:
+                self._shard_inflight[shard] -= 1
+
+    def _timed_leg(self, shard: int, method: str, path: str, payload,
+                   headers, request_id: Optional[str],
+                   timeout_s: Optional[float],
+                   deadline: Optional[float],
+                   parent_span: Optional[int]) -> dict:
         with _FANOUT_SECONDS.labels(shard=str(shard)).time() as timer:
             try:
                 status, body = self._fanout_leg(shard, method, path,
                                                 payload, headers,
-                                                request_id, timeout_s)
+                                                request_id, timeout_s,
+                                                parent_span=parent_span)
             except Exception as e:
                 timer.discard()
                 if deadline is not None and time.monotonic() >= deadline:
@@ -642,8 +728,13 @@ class FleetRouter:
     def _gather(self, legs: "list[tuple]") -> list:
         """Run legs concurrently; returns bodies in leg order, raising
         the FIRST leg failure (after every future settles — no leg is
-        left running against a dead request)."""
-        futures = [self._pool.submit(self._leg, *leg) for leg in legs]
+        left running against a dead request). The caller's open span
+        (fleet.score / fleet.rank) is captured HERE, on the request
+        thread, and handed to each leg explicitly — pool threads don't
+        inherit the tracing contextvars."""
+        parent = _tracing.current_span_id()
+        futures = [self._pool.submit(self._leg, *leg, parent_span=parent)
+                   for leg in legs]
         results, first_error = [], None
         for fut in futures:
             try:
@@ -1102,6 +1193,13 @@ class FleetRouter:
                     if h.get("status") == "ok"}
         maps = {h.get("shard_map") for h in hosts
                 if h.get("status") == "ok"} - {None}
+        # per-shard replica coverage — the operator's first question
+        # about a degraded fleet is "which shard, how much redundancy
+        # left", not "which host"
+        replicas_up = [0] * self.n_shards
+        for h in hosts:
+            if h.get("status") == "ok":
+                replicas_up[h["shard"]] += 1
         return {"status": "ok" if all(h.get("status") == "ok"
                                       for h in hosts) else "degraded",
                 "n_shards": self.n_shards,
@@ -1112,6 +1210,7 @@ class FleetRouter:
                               "version": self.shard_map.version,
                               "mixed": bool(maps
                                             - {self.shard_map.map_hash})},
+                "shard_replicas_up": replicas_up,
                 "hosts": hosts,
                 "shed": _overload.shed_counts()}
 
@@ -1122,6 +1221,7 @@ class FleetRouter:
         down to fewer replicas than configured is degraded-but-ready
         (that is exactly what the redundancy is for)."""
         reasons = []
+        uncovered = []
         for s in range(self.n_shards):
             group_reasons = []
             for r in range(self.replicas):
@@ -1137,78 +1237,41 @@ class FleetRouter:
                 except Exception as e:
                     group_reasons.append(
                         f"{self._host_name(s, r)}: unreachable ({e!r})")
+            if group_reasons:
+                uncovered.append(s)
             reasons.extend(group_reasons)
         body = {"ready": not reasons, "reasons": reasons,
                 "n_shards": self.n_shards, "replicas": self.replicas}
+        if uncovered:
+            # the typed refusal: a shard with ZERO live replicas means
+            # wrong-by-omission scores, the one thing /readyz gates
+            body["reason"] = "shard_uncovered"
+            body["uncovered_shards"] = uncovered
         return (200 if not reasons else 503), body
-
-    def host_metrics_texts(self) -> "list[str]":
-        """Each host's raw ``/metrics`` exposition text, in shard-major
-        host order (unreachable hosts contribute an empty snapshot — a
-        scrape must not fail because one host is down)."""
-        import urllib.request
-
-        texts = []
-        for group in self.clients:
-            for client in group:
-                try:
-                    with urllib.request.urlopen(client.url + "/metrics",
-                                                timeout=client.timeout_s
-                                                ) as resp:
-                        texts.append(resp.read().decode())
-                except Exception:
-                    texts.append("")
-        return texts
 
     def metrics_text(self) -> str:
         """The fleet-folded exposition: the router's own registry first
-        (chief semantics), then every host's snapshot tagged
-        ``process="<shard>"`` so host-owned gauges fan out — the same
-        fold, fed the same texts, as ``tools/metrics_fold.py`` offline
-        (byte-identical; the tier-1 fold-consistency test locks it)."""
-        from photon_ml_tpu.telemetry.prometheus import render
+        (chief semantics), then every live host's snapshot — scraped
+        over the POOLED leg connections — with host-owned gauges tagged
+        ``shard="I"``, ``replica="J"`` so they fan out per host. The
+        same fold, fed the same texts, as ``tools/metrics_fold.py``
+        offline (byte-identical; the tier-1 fold-consistency test locks
+        it). A host failing mid-scrape leaves a
+        ``photon_fleet_scrape_errors_total`` annotation, never a 500."""
+        return self.observer.metrics_text()
 
-        return fold_fleet_texts(render(), self.host_metrics_texts())
+    def statusz(self) -> dict:
+        """The fleet topology page (``GET /statusz``) — delegated to the
+        observability plane."""
+        return self.observer.statusz()
 
     def close(self) -> None:
+        self.observer.close()
         self._pool.shutdown(wait=True)
         self._hedge_pool.shutdown(wait=True)
         for group in self.clients:
             for client in group:
                 client.close()
-
-
-def fold_fleet_texts(router_text: str, host_texts: Sequence[str]) -> str:
-    """The fleet metric fold: router snapshot (chief-first) + per-host
-    snapshots with host-owned gauges tagged ``process="<shard>"``,
-    through the ONE merge code path (``telemetry/aggregate.py``)."""
-    from photon_ml_tpu.telemetry.aggregate import aggregate_text
-
-    texts = [router_text]
-    for shard, text in enumerate(host_texts):
-        if text:
-            texts.append(tag_host_owned(text, ("process", str(shard))))
-    return aggregate_text(texts)
-
-
-def tag_host_owned(text: str, tag: "tuple[str, str]") -> str:
-    """Append ``tag`` to every host-owned gauge series of an exposition
-    text (``metrics.mark_host_owned`` declares which). Training renders
-    do this at render time (``render(host_tag=...)``); the router
-    re-tags hosts' already-rendered scrapes — same label, same fan-out
-    semantics."""
-    from photon_ml_tpu.telemetry.metrics import host_owned_gauges
-    from photon_ml_tpu.telemetry.prometheus import parse_text, render
-
-    snapshot = parse_text(text)
-    owned = host_owned_gauges()
-    key, value = tag
-    for name, fam in snapshot.families.items():
-        if fam.get("type") != "gauge" or name not in owned:
-            continue
-        snapshot[name] = [({**labels, key: value}, v)
-                          for labels, v in snapshot.get(name, ())]
-    return render(snapshot)
 
 
 # ---------------------------------------------------------------------------
@@ -1253,28 +1316,36 @@ def _make_handler(router: FleetRouter):
 
         def _dispatch(self, rid: str, fn, payload: dict,
                       deadline: Optional[float]) -> None:
+            # the root of the merged trace: fleet.score/rank and every
+            # fleet.leg (hedges and retries included) nest under this
+            # one request-id-tagged span; its outcome feeds the SLO
+            # burn tracker when one is attached
             headers = None
-            try:
-                out = fn(payload, request_id=rid, deadline=deadline)
-                status = 200
-            except _overload.Shed as e:
-                out = {"error": str(e), "reason": e.reason,
-                       "request_id": rid}
-                status = shed_status(e)
-                headers = {"Retry-After":
-                           str(max(1, round(e.retry_after_s)))}
-            except MixedLineageError as e:
-                out = {"error": str(e), "reason": "mixed_lineage",
-                       "request_id": rid}
-                status = 503
-            except ShardMapMismatch as e:
-                out = {"error": str(e), "reason": "shard_map_mismatch",
-                       "request_id": rid}
-                status = 503
-            except ValueError as e:
-                out, status = {"error": str(e)}, 400
-            except Exception as e:
-                out, status = {"error": repr(e)}, 500
+            t0 = time.monotonic()
+            with _tracing.span("fleet.request", request_id=rid):
+                try:
+                    out = fn(payload, request_id=rid, deadline=deadline)
+                    status = 200
+                except _overload.Shed as e:
+                    out = {"error": str(e), "reason": e.reason,
+                           "request_id": rid}
+                    status = shed_status(e)
+                    headers = {"Retry-After":
+                               str(max(1, round(e.retry_after_s)))}
+                except MixedLineageError as e:
+                    out = {"error": str(e), "reason": "mixed_lineage",
+                           "request_id": rid}
+                    status = 503
+                except ShardMapMismatch as e:
+                    out = {"error": str(e), "reason": "shard_map_mismatch",
+                           "request_id": rid}
+                    status = 503
+                except ValueError as e:
+                    out, status = {"error": str(e)}, 400
+                except Exception as e:
+                    out, status = {"error": repr(e)}, 500
+            router.observer.observe_request(time.monotonic() - t0,
+                                            ok=status == 200)
             self._reply(status, out, headers=headers)
 
         def do_GET(self):  # noqa: N802
@@ -1305,6 +1376,8 @@ def _make_handler(router: FleetRouter):
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)
+            elif parsed.path == "/statusz":
+                self._reply(200, router.statusz())
             else:
                 self._reply(404, {"error": f"unknown path {self.path}"})
 

@@ -52,6 +52,7 @@ from typing import Callable, Optional, Sequence
 import numpy as np
 
 from photon_ml_tpu.serving import overload as _overload
+from photon_ml_tpu.serving import stages as _stages
 from photon_ml_tpu.telemetry import metrics as _metrics
 
 #: how well the linger window coalesces traffic — the distribution should
@@ -152,10 +153,16 @@ class MicroBatcher:
             return len(self._queue)
 
     def submit(self, record: dict,
-               deadline: Optional[float] = None) -> "Future[float]":
+               deadline: Optional[float] = None,
+               stage_out: Optional[dict] = None) -> "Future[float]":
         """Enqueue one record; the Future resolves to its float score.
         ``deadline`` is an absolute ``time.monotonic()`` instant — an
-        entry still queued past it is shed at drain time. Raises
+        entry still queued past it is shed at drain time. ``stage_out``,
+        when given, receives this request's stage seconds (its own
+        queue_wait plus the batch's assemble/execute — every rider of a
+        micro-batch paid the whole batch's wall) for the fleet
+        leg-summary side channel; ContextVars don't cross the worker
+        thread, so the sink rides the entry. Raises
         :class:`~photon_ml_tpu.serving.overload.Shed` when the bounded
         queue is full, RuntimeError once the batcher is closed or its
         worker has died."""
@@ -178,17 +185,19 @@ class MicroBatcher:
                     message=f"queue full ({len(self._queue)}/"
                             f"{self.max_queue} requests waiting)",
                     retry_after_s=max(self.max_wait_s * 2, 0.05))
-            self._queue.append((record, fut, time.monotonic(), deadline))
+            self._queue.append(
+                (record, fut, time.monotonic(), deadline, stage_out))
             _QUEUE_DEPTH.set(len(self._queue))
             self._cond.notify()
         return fut
 
     def score(self, record: dict, timeout: Optional[float] = None,
-              deadline: Optional[float] = None) -> float:
+              deadline: Optional[float] = None,
+              stage_out: Optional[dict] = None) -> float:
         """Blocking convenience wrapper around :meth:`submit`. On timeout
         the Future is cancelled so the abandoned entry is discarded at
         drain time instead of consuming a batch slot."""
-        fut = self.submit(record, deadline=deadline)
+        fut = self.submit(record, deadline=deadline, stage_out=stage_out)
         try:
             return fut.result(timeout=timeout)
         except FutureTimeoutError:
@@ -221,22 +230,33 @@ class MicroBatcher:
     def _process(self, batch: list) -> None:
         import time
 
-        records = [r for r, _, _, _ in batch]
+        records = [r for r, _, _, _, _ in batch]
         _BATCH_SIZE.observe(len(records))
         now = time.monotonic()
         wait_hist = _STAGE_SECONDS.labels(stage="queue_wait")
-        for _, _, t_enq, _ in batch:
-            wait_hist.observe(max(now - t_enq, 0.0))
+        for _, _, t_enq, _, stage_out in batch:
+            waited = max(now - t_enq, 0.0)
+            wait_hist.observe(waited)
+            if stage_out is not None:
+                stage_out["queue_wait"] = waited
         with self._cond:
             self._inflight = batch
         # NOTE: _inflight is cleared only on the resolved paths below — a
         # BaseException escaping this method must leave it set so _abort
         # can fail the very batch that killed the worker
+        batch_stages: dict = {}
         try:
-            scores = self._score_fn(records)
+            with _stages.collect(batch_stages):
+                scores = self._score_fn(records)
         except Exception as e:  # score failure fails THIS batch only
             self._finish(batch, exception=e)
             return
+        # the engine timed assemble/execute once for the whole batch;
+        # every rider waited on that same wall, so each sink gets the
+        # batch-level seconds (leg-summary semantics, not attribution)
+        for _, _, _, _, stage_out in batch:
+            if stage_out is not None:
+                stage_out.update(batch_stages)
         arr = np.asarray(scores)
         if arr.shape[:1] != (len(batch),):
             # contract violation from the score fn: fail the batch loudly
@@ -257,10 +277,10 @@ class MicroBatcher:
 
     def _finish(self, batch: list, *, scores=None, exception=None) -> None:
         if exception is not None:
-            for _, fut, _, _ in batch:
+            for _, fut, _, _, _ in batch:
                 _resolve(fut, exception=exception)
         else:
-            for (_, fut, _, _), s in zip(batch, scores):
+            for (_, fut, _, _, _), s in zip(batch, scores):
                 _resolve(fut, result=self._coerce(s))
         with self._cond:
             self._inflight = []
@@ -277,7 +297,7 @@ class MicroBatcher:
             self._cond.notify_all()
         err = RuntimeError(f"batcher worker died: {exc!r}")
         err.__cause__ = exc
-        for _, fut, _, _ in pending:
+        for _, fut, _, _, _ in pending:
             _resolve(fut, exception=err)
 
     def _next_batch(self):
@@ -308,7 +328,7 @@ class MicroBatcher:
                 now = time.monotonic()
                 while self._queue and len(out) < self.max_batch:
                     entry = self._queue.popleft()
-                    _, fut, _, deadline = entry
+                    _, fut, _, deadline, _ = entry
                     if fut.cancelled():
                         # abandoned by a timed-out score() caller: the
                         # request has no listener — don't spend a slot
@@ -318,7 +338,7 @@ class MicroBatcher:
                         continue
                     out.append(entry)
                 _QUEUE_DEPTH.set(len(self._queue))
-            for _, fut, _, _ in expired:
+            for _, fut, _, _, _ in expired:
                 # shed, not scored: the caller's budget is already gone
                 _resolve(fut, exception=_overload.shed(
                     "deadline",

@@ -41,6 +41,7 @@ from photon_ml_tpu.io.index import IndexMap
 from photon_ml_tpu.resilience.faults import fault_point
 from photon_ml_tpu.types import INTERCEPT_KEY
 from photon_ml_tpu.serving import overload as _overload
+from photon_ml_tpu.serving import stages as _stages
 from photon_ml_tpu.serving import store as _store
 from photon_ml_tpu.serving.store import EntityCoefficientStore
 from photon_ml_tpu.telemetry import metrics as _metrics
@@ -283,8 +284,9 @@ class ScoringEngine:
         # get the error, the batcher worker survives) and a request shed by
         # admission control never even reaches this point
         fault_point("serving.execute", n=len(records))
-        with _STAGE_SECONDS.labels(stage="batch_assemble").time():
+        with _STAGE_SECONDS.labels(stage="batch_assemble").time() as t:
             batch = self.pack(records)
+        _stages.record("batch_assemble", t.seconds)
         return self.score_batch(batch)
 
     def score_margins(self, records: Sequence[dict]):
@@ -295,8 +297,9 @@ class ScoringEngine:
         ``(scores (n,) f32, offsets (n,) f32, [(cid, (n,) f32), ...])``
         in the model's coordinate order."""
         fault_point("serving.execute", n=len(records))
-        with _STAGE_SECONDS.labels(stage="batch_assemble").time():
+        with _STAGE_SECONDS.labels(stage="batch_assemble").time() as t:
             batch = self.pack(records)
+        _stages.record("batch_assemble", t.seconds)
         scores, margins = self.score_batch(batch, with_margins=True)
         return scores, batch.offsets, \
             [(cid, m) for (cid, _cm), m in zip(self._coords, margins)]
@@ -307,7 +310,7 @@ class ScoringEngine:
                    for _ in self._coords] if with_margins else None
         # batches past the largest bucket chunk — per-sample independence
         # makes the split score-invariant
-        with _STAGE_SECONDS.labels(stage="execute").time():
+        with _STAGE_SECONDS.labels(stage="execute").time() as exec_t:
             for lo in range(0, batch.n, self.max_batch):
                 hi = min(lo + self.max_batch, batch.n)
                 chunk, chunk_margins = self._score_chunk(
@@ -316,6 +319,7 @@ class ScoringEngine:
                 if with_margins:
                     for j, m in enumerate(chunk_margins):
                         margins[j][lo:hi] = m
+        _stages.record("execute", exec_t.seconds)
         with self._lock:
             self._n_calls += 1
             self._n_scored += batch.n

@@ -70,6 +70,7 @@ from typing import Mapping, Optional
 
 from photon_ml_tpu.resilience.faults import fault_point
 from photon_ml_tpu.serving import overload as _overload
+from photon_ml_tpu.serving import stages as _stages
 from photon_ml_tpu.serving.batcher import BatcherClosed, MicroBatcher
 from photon_ml_tpu.serving.registry import ModelRegistry
 from photon_ml_tpu.serving.reqlog import RequestLog
@@ -119,6 +120,62 @@ DEADLINE_HEADER = "X-Photon-Deadline-Ms"
 #: like a mixed-lineage fan-out, because answering under the wrong map
 #: would silently score rows this host no longer owns
 SHARD_MAP_HEADER = "X-Photon-Shard-Map"
+
+#: outbound on 200 ``/score`` + ``/rank`` responses: this request's
+#: per-stage seconds and the host-side span id, compactly encoded
+#: (``span=<id>;parse=<s>;queue_wait=<s>;...``), so a fleet router can
+#: stitch each fan-out leg's remote stage breakdown into its own trace
+#: tree (OBSERVABILITY.md "Fleet observability"). Single-host clients may
+#: ignore it; absent stages are simply omitted.
+LEG_SUMMARY_HEADER = "X-Photon-Leg-Summary"
+
+#: the CLOSED stage vocabulary a leg summary may carry — exactly the
+#: request-path critical-path stages. Parsing a (possibly foreign)
+#: header must never mint unbounded span-attribute or label values, so
+#: both directions are restricted to these keys (the
+#: ``tel-span-attr-cardinality`` lint guards the consumers).
+LEG_SUMMARY_STAGES = (
+    "parse", "queue_wait", "batch_assemble", "execute", "respond")
+
+
+def format_leg_summary(stages: Mapping[str, float]) -> str:
+    """Encode a stage-seconds mapping (plus optional ``span`` id) as the
+    ``X-Photon-Leg-Summary`` header value. Only the closed stage
+    vocabulary is emitted; seconds carry microsecond precision."""
+    parts = []
+    span_id = stages.get("span")
+    if span_id is not None:
+        parts.append(f"span={int(span_id)}")
+    for key in LEG_SUMMARY_STAGES:
+        value = stages.get(key)
+        if value is not None:
+            parts.append(f"{key}={float(value):.6f}")
+    return ";".join(parts)
+
+
+def parse_leg_summary(value: "Optional[str]") -> dict:
+    """Decode a leg-summary header → ``{stage: seconds}`` (+ ``span``
+    int). Defensive by design: unknown keys and malformed values are
+    DROPPED, not surfaced — this dict feeds span attributes, and an
+    arbitrary upstream must not be able to inject unbounded attribute
+    keys or non-numeric values into the trace."""
+    out: dict = {}
+    for part in (value or "").split(";"):
+        key, eq, raw = part.partition("=")
+        if not eq:
+            continue
+        key = key.strip()
+        if key == "span":
+            try:
+                out["span"] = int(raw)
+            except ValueError:
+                pass
+        elif key in LEG_SUMMARY_STAGES:
+            try:
+                out[key] = float(raw)
+            except ValueError:
+                pass
+    return out
 
 
 class ShardMapMismatch(RuntimeError):
@@ -240,7 +297,8 @@ class ServingService:
     def score(self, payload: dict,
               request_id: Optional[str] = None,
               stage_ms: Optional[Mapping[str, float]] = None,
-              deadline: Optional[float] = None) -> dict:
+              deadline: Optional[float] = None,
+              stage_sink: Optional[dict] = None) -> dict:
         """Score one request. ``request_id`` is assigned by the HTTP layer
         (direct embedders may omit it — one is minted here so the span and
         the request log never carry an empty identity); ``stage_ms`` folds
@@ -250,7 +308,10 @@ class ServingService:
         :class:`~photon_ml_tpu.serving.overload.Shed` (→ 429) when the
         request is refused by admission control — an expired deadline, a
         full microbatcher queue, or max brownout — WITHOUT it ever
-        reaching the engine's execute stage or the latency histogram."""
+        reaching the engine's execute stage or the latency histogram.
+        ``stage_sink``, when given, receives this request's stage
+        seconds + the score span id — the leg-summary side channel the
+        fleet router stitches into its trace."""
         if request_id is None:
             request_id = new_request_id()
         if "record" in payload:
@@ -276,9 +337,14 @@ class ServingService:
                         f"traffic",
                 retry_after_s=2.0)
         margins = offsets = None
+        # the stage side channel: same-thread stages reach the sink via
+        # the collect() contextvar; the batched path crosses the worker
+        # thread, so the sink also rides the batcher entry (stage_out)
+        sink = stage_sink if stage_sink is not None else {}
         with _REQUEST_LATENCY.time() as timer, \
                 _maybe_span("serving.score", request_id=request_id,
-                            batch=len(records)) as sp:
+                            batch=len(records)) as sp, \
+                _stages.collect(sink):
             version = self.registry.active_version
             try:
                 if with_margins:
@@ -289,7 +355,8 @@ class ServingService:
                     scores = [float(s) for s in raw]
                 elif self.batcher is not None and len(records) == 1:
                     scores = [self.batcher.score(records[0],
-                                                 deadline=deadline)]
+                                                 deadline=deadline,
+                                                 stage_out=stage_sink)]
                 else:
                     scores = [float(s)
                               for s in self.registry.active().score(records)]
@@ -300,6 +367,10 @@ class ServingService:
                 timer.discard()
                 raise
             sp.set(version=version)
+            if stage_sink is not None:
+                span_id = getattr(sp, "span_id", None)
+                if span_id is not None:
+                    stage_sink["span"] = span_id
         latency_ms = timer.seconds * 1e3
         with self._lock:
             self.n_requests += 1
@@ -344,7 +415,8 @@ class ServingService:
     def rank(self, payload: dict,
              request_id: Optional[str] = None,
              stage_ms: Optional[Mapping[str, float]] = None,
-             deadline: Optional[float] = None) -> dict:
+             deadline: Optional[float] = None,
+             stage_sink: Optional[dict] = None) -> dict:
         """Rank one user against the active version's item axis
         (SERVING.md "Ranked retrieval"). ``payload`` carries ``k`` plus
         either ``user`` (a raw entity id — ranked featureless, applied to
@@ -389,20 +461,27 @@ class ServingService:
                 message=f"brownout level {_overload.level()} is shedding "
                         f"traffic",
                 retry_after_s=2.0)
+        sink = stage_sink if stage_sink is not None else {}
         with _RANK_REQUEST_LATENCY.time() as timer, \
                 _maybe_span("serving.rank", request_id=request_id,
-                            k=k) as sp:
+                            k=k) as sp, \
+                _stages.collect(sink):
             version = self.registry.active_version
             try:
                 if self.rank_batcher is not None:
-                    ids, scores = self.rank_batcher.score((record, k),
-                                                          deadline=deadline)
+                    ids, scores = self.rank_batcher.score(
+                        (record, k), deadline=deadline,
+                        stage_out=stage_sink)
                 else:
                     ((ids, scores),) = active.rank([record], [k])
             except _overload.Shed:
                 timer.discard()
                 raise
             sp.set(version=version, n=len(ids))
+            if stage_sink is not None:
+                span_id = getattr(sp, "span_id", None)
+                if span_id is not None:
+                    stage_sink["span"] = span_id
         _RANK_K.observe(k)
         latency_ms = timer.seconds * 1e3
         with self._lock:
@@ -652,6 +731,31 @@ def _make_handler(service: ServingService):
             self.end_headers()
             self.wfile.write(data)
 
+        def _reply_with_summary(self, rid: str, status: int, out: dict,
+                                headers: Optional[dict],
+                                leg_stages: dict,
+                                parse_s: float) -> None:
+            """Reply, attaching the leg-summary header to 200 scored/
+            ranked responses. ``respond`` in the summary is the JSON
+            serialization share — the socket write lands after the
+            header by construction, so it can never be inside it (the
+            registry histogram still times the full respond stage)."""
+            if status == 200 and leg_stages:
+                leg_stages["parse"] = parse_s
+                t_ser = time.monotonic()
+                data = json.dumps(out).encode()
+                leg_stages["respond"] = time.monotonic() - t_ser
+                headers = dict(headers or {})
+                headers[LEG_SUMMARY_HEADER] = format_leg_summary(leg_stages)
+                with _maybe_span("serving.respond", request_id=rid), \
+                        _STAGE_SECONDS.labels(stage="respond").time():
+                    self._reply_raw(status, data, "application/json",
+                                    headers=headers)
+                return
+            with _maybe_span("serving.respond", request_id=rid), \
+                    _STAGE_SECONDS.labels(stage="respond").time():
+                self._reply(status, out, headers=headers)
+
         def _payload(self) -> dict:
             length = int(self.headers.get("Content-Length") or 0)
             if not length:
@@ -713,6 +817,7 @@ def _make_handler(service: ServingService):
             has not already (POST stamps it in its parse stage), call
             the service, map Shed → 429 like /score."""
             headers = None
+            leg_stages: dict = {}
             try:
                 if resolve_deadline:
                     with _maybe_span("serving.parse", request_id=rid), \
@@ -725,7 +830,8 @@ def _make_handler(service: ServingService):
                 service.check_shard_map(self.headers.get(SHARD_MAP_HEADER))
                 out = service.rank(payload, request_id=rid,
                                    stage_ms={"parse": parse_ms},
-                                   deadline=self.deadline)
+                                   deadline=self.deadline,
+                                   stage_sink=leg_stages)
                 status = 200
             except ShardMapMismatch as e:
                 out = {"error": str(e), "reason": "shard_map_mismatch",
@@ -745,9 +851,8 @@ def _make_handler(service: ServingService):
                 out, status = {"error": str(e)}, 400
             except Exception as e:
                 out, status = {"error": repr(e)}, 500
-            with _maybe_span("serving.respond", request_id=rid), \
-                    _STAGE_SECONDS.labels(stage="respond").time():
-                self._reply(status, out, headers=headers)
+            self._reply_with_summary(rid, status, out, headers,
+                                     leg_stages, parse_ms / 1e3)
 
         def do_POST(self):  # noqa: N802
             if self._refuse_if_stopping():
@@ -782,13 +887,15 @@ def _make_handler(service: ServingService):
                 return
             if self.path == "/score":
                 headers = None
+                leg_stages: dict = {}
                 try:
                     service.check_shard_map(
                         self.headers.get(SHARD_MAP_HEADER))
                     out = service.score(
                         payload, request_id=rid,
                         stage_ms={"parse": parse_t.seconds * 1e3},
-                        deadline=self.deadline)
+                        deadline=self.deadline,
+                        stage_sink=leg_stages)
                     status = 200
                 except ShardMapMismatch as e:
                     # refused like mixed lineage: the fan-out was routed
@@ -816,9 +923,8 @@ def _make_handler(service: ServingService):
                     out, status = {"error": str(e)}, 400
                 except Exception as e:
                     out, status = {"error": repr(e)}, 500
-                with _maybe_span("serving.respond", request_id=rid), \
-                        _STAGE_SECONDS.labels(stage="respond").time():
-                    self._reply(status, out, headers=headers)
+                self._reply_with_summary(rid, status, out, headers,
+                                         leg_stages, parse_t.seconds)
             elif self.path == "/rank":
                 # POST variant for full records: {"record": ..., "k": N}
                 self._handle_rank(rid, payload,

@@ -117,6 +117,11 @@ def _make_listener(reg: MetricsRegistry) -> Callable:
         "Serving brownout level transitions (up = degrading under "
         "pressure, down = recovering — a closed vocabulary)",
         labels=("direction",))
+    slo_burns = reg.counter(
+        "photon_slo_burn_total",
+        "SLO burn-rate alerts fired by the fleet tracker, by burn "
+        "window (the tracker's fixed window names — a closed vocabulary)",
+        labels=("window",))
 
     def listener(event) -> None:
         name, p = event.name, event.payload
@@ -166,6 +171,8 @@ def _make_listener(reg: MetricsRegistry) -> Callable:
             direction = ("up" if float(p.get("level", 0))
                          > float(p.get("previous", 0)) else "down")
             brownout_changes.labels(direction=direction).inc()
+        elif name == "slo_burn_alert":
+            slo_burns.labels(window=str(p.get("window", ""))).inc()
 
     return listener
 

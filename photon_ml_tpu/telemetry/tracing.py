@@ -188,6 +188,70 @@ class Tracer:
                      "parent_id": _CURRENT.get(), "ts": time.time(),
                      **payload})
 
+    @contextlib.contextmanager
+    def span_under(self, parent_id: Optional[int], name: str,
+                   **attrs) -> Iterator[Span]:
+        """A span with an EXPLICIT parent — for work handed to a pool
+        thread where the submitting request's contextvars do not follow
+        (the fleet router's fan-out legs). Inside the context, nested
+        ``span()`` calls parent to this span as usual; at exit, a parent
+        that already closed re-parents this span to root rather than
+        recording an interval that leaks outside it."""
+        sp = Span(name, next(self._ids), parent_id, attrs)
+        token = _CURRENT.set(sp.span_id)
+        # the explicit parent is the only known-open ancestor here: the
+        # submitting thread's deeper ancestry is not visible to this pool
+        # thread, and claiming it would let re-parenting resurrect spans
+        # this leg never nested inside
+        ancestry = () if parent_id is None else (parent_id,)
+        stack_token = _STACK.set(ancestry + (sp.span_id,))
+        with self._lock:
+            self._open.add(sp.span_id)
+        sp.ts = time.time()
+        sp.t0 = time.perf_counter()
+        try:
+            yield sp
+        finally:
+            with self._lock:
+                self._open.discard(sp.span_id)
+            sp.t1 = time.perf_counter()
+            sp.seconds = sp.t1 - sp.t0
+            _CURRENT.reset(token)
+            _STACK.reset(stack_token)
+            with self._lock:
+                if (sp.parent_id is not None
+                        and sp.parent_id not in self._open):
+                    sp.parent_id = None
+            if self._fh is not None:
+                self._write(sp.record())
+            bus = self._bus
+            if bus is not None:
+                bus.post("span_finished", span=name, span_id=sp.span_id,
+                         parent_id=sp.parent_id, seconds=sp.seconds)
+
+    def record_span(self, name: str, *, seconds: float,
+                    parent_id: Optional[int] = None,
+                    ts: Optional[float] = None, **attrs) -> int:
+        """Materialize an EXTERNALLY timed region as a completed span —
+        how the router turns a shard host's leg-summary stage seconds
+        into children of its ``fleet.leg`` span. ``t0``/``t1`` are null
+        (the remote perf_counter domain is not comparable to ours; the
+        report tools only need ``seconds``/``parent_id``). Returns the
+        new span id. No-op (id still minted) when unconfigured."""
+        span_id = next(self._ids)
+        if self._fh is not None:
+            record = {"name": name, "span_id": span_id,
+                      "parent_id": parent_id,
+                      "ts": time.time() if ts is None else ts,
+                      "t0": None, "t1": None,
+                      "seconds": float(seconds), **attrs}
+            bad = _RESERVED & attrs.keys()
+            if bad:
+                raise ValueError(
+                    f"span attributes shadow reserved keys {bad}")
+            self._write(record)
+        return span_id
+
 
 #: process-global tracer the drivers configure; instrumented modules call
 #: the module-level :func:`span` so embedders can swap sinks in one place
@@ -200,6 +264,24 @@ def span(name: str, **attrs):
 
 def annotate(name: str, **payload) -> None:
     GLOBAL_TRACER.annotate(name, **payload)
+
+
+def current_span_id() -> Optional[int]:
+    """The enclosing span's id on this thread/context (None = root) —
+    capture it BEFORE handing work to a pool so :func:`span_under` can
+    stitch the pool thread's spans back under the request."""
+    return _CURRENT.get()
+
+
+def span_under(parent_id: Optional[int], name: str, **attrs):
+    return GLOBAL_TRACER.span_under(parent_id, name, **attrs)
+
+
+def record_span(name: str, *, seconds: float,
+                parent_id: Optional[int] = None,
+                ts: Optional[float] = None, **attrs) -> int:
+    return GLOBAL_TRACER.record_span(
+        name, seconds=seconds, parent_id=parent_id, ts=ts, **attrs)
 
 
 def enabled() -> bool:

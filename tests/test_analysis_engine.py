@@ -825,3 +825,81 @@ def test_shard_home_ignores_unrelated_modulo():
             return off % 1024
     """
     assert check(src, ["res-shard-home"]) == []
+
+
+# ---------------------------------------------------------------------------
+# tel-span-attr-cardinality (ISSUE 18): span attributes / metric label
+# values derived from unbounded request fields
+# ---------------------------------------------------------------------------
+
+CARD = ["tel-span-attr-cardinality"]
+
+
+def test_span_attr_from_payload_subscript_is_flagged():
+    src = """
+        from photon_ml_tpu.telemetry import tracing
+
+        def handle(payload):
+            with tracing.span("serving.score", user=payload["userId"]):
+                pass
+    """
+    got = check(src, CARD)
+    assert rule_ids(got) == ["tel-span-attr-cardinality"]
+    assert "unbounded" in got[0].message
+
+
+def test_span_attr_from_metadata_get_and_entity_name_are_flagged():
+    # .get() off a metadata map; a bare entity-id-named local; an
+    # f-string wrapping one — each is a distinct unbounded tag value
+    src = """
+        from photon_ml_tpu.telemetry import tracing
+
+        def handle(meta, user_id):
+            sp = tracing.record_span("x", seconds=0.1,
+                                     member=meta.get("memberId"))
+            sp2 = tracing.record_span("y", seconds=0.1, who=user_id)
+            with tracing.span("z", tag=f"u:{user_id}"):
+                pass
+    """
+    assert rule_ids(check(src, CARD)) == ["tel-span-attr-cardinality"] * 3
+
+
+def test_metric_label_from_payload_field_is_flagged():
+    src = """
+        from photon_ml_tpu.telemetry import metrics
+
+        C = metrics.counter("photon_x_total", "help", labels=("who",))
+
+        def bump(record):
+            C.labels(who=str(record["userId"])).inc()
+    """
+    got = check(src, CARD)
+    assert rule_ids(got) == ["tel-span-attr-cardinality"]
+    assert "metric label" in got[0].message
+
+
+def test_sanctioned_request_id_and_bounded_values_pass():
+    # the request id is the designed per-request join key; bounded
+    # values (literals, counts, closed-vocabulary stage names from
+    # parse_leg_summary) are what tags are FOR
+    src = """
+        from photon_ml_tpu.telemetry import tracing
+        from photon_ml_tpu.serving.http import parse_leg_summary
+
+        def handle(records, request_id, header):
+            with tracing.span("serving.score", request_id=request_id,
+                              batch=len(records)) as sp:
+                sp.set(version=3)
+                for stage, seconds in parse_leg_summary(header).items():
+                    tracing.record_span("host." + stage, seconds=seconds,
+                                        parent_id=sp.span_id)
+    """
+    assert check(src, CARD) == []
+
+
+def test_span_attr_cardinality_is_clean_on_the_tree():
+    # the rule must hold tree-wide from day one (the router's
+    # leg-summary parser is the motivating call site: its closed stage
+    # vocabulary is what keeps host.* span names bounded)
+    report = engine.run(REPO, rule_ids=["tel-span-attr-cardinality"])
+    assert report.findings == [], report.findings

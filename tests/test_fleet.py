@@ -457,27 +457,24 @@ class TestFleetMetricsFold:
             "tools"))
         import metrics_fold
 
-        from photon_ml_tpu.fleet.router import (
-            fold_fleet_texts,
-            tag_host_owned,
-        )
+        from photon_ml_tpu.fleet.observe import fold_fleet_snapshots
 
         router = env["fleet"].router
-        host_texts = router.host_metrics_texts()
-        assert all(host_texts)
+        snapshots = router.observer.scrape()
+        assert len(snapshots) == router.n_shards * router.replicas
         router_text = "# TYPE photon_fleet_hosts gauge\n" \
                       "photon_fleet_hosts 2\n"
-        live = fold_fleet_texts(router_text, host_texts)
-        # the offline layout: router snapshot as the chief, tagged host
-        # snapshots as workers — exactly what a fleet operator dumps
+        live = fold_fleet_snapshots(router_text, snapshots)
+        # the offline layout: router snapshot as the chief, RAW host
+        # snapshots under hosts/shard-I-replica-J — the tool applies the
+        # same tagging the live fold does
         run_dir = tmp_path / "telemetry"
-        (run_dir / "workers").mkdir(parents=True)
+        (run_dir / "hosts").mkdir(parents=True)
         (run_dir / "metrics.prom").write_text(router_text)
-        for i, text in enumerate(host_texts):
-            proc = run_dir / "workers" / f"proc-{i}"
-            proc.mkdir()
-            (proc / "metrics.prom").write_text(
-                tag_host_owned(text, ("process", str(i))))
+        for s, r, text in snapshots:
+            d = run_dir / "hosts" / f"shard-{s}-replica-{r}"
+            d.mkdir()
+            (d / "metrics.prom").write_text(text)
         folded = metrics_fold.fold_metrics(str(run_dir))
         assert open(folded).read() == live
 
@@ -487,8 +484,9 @@ class TestFleetMetricsFold:
         text = env["fleet"].router.metrics_text()
         snap = parse_text(text)
         depth = snap.get("photon_serving_queue_depth", [])
-        procs = {labels.get("process") for labels, _v in depth}
-        assert {"0", "1"} <= procs
+        shards = {(labels.get("shard"), labels.get("replica"))
+                  for labels, _v in depth}
+        assert {("0", "0"), ("1", "0")} <= shards
 
 
 # ---------------------------------------------------------------------------

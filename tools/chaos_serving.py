@@ -249,6 +249,23 @@ def run_fleet_chaos(args) -> int:
             # plus parse-noise headroom like the single-host grid
             problems = check_books(cell, run, max(args.error_ceiling,
                                                   4 * rate))
+            # scrape-through-faults: the router's folded /metrics visits
+            # the SAME fleet.fanout site once per host scrape leg, so a
+            # rate-1.0 plan faults EVERY scrape deterministically — the
+            # fold must still answer (router-only partial fold, never a
+            # 500) and annotate the losses per host
+            with injected(FaultPlan.from_json(
+                    {"seed": 0, "specs": [{"site": "fleet.fanout",
+                                           "rate": 1.0}]})):
+                snap_lost = bench_serving._scrape_metrics(base)
+            if snap_lost is None:
+                problems.append("router /metrics failed with every host "
+                                "scrape faulted (partial fold must be "
+                                "served, never a 500)")
+            elif not sum(v for _labels, v in snap_lost.get(
+                    "photon_fleet_scrape_errors_total", [])):
+                problems.append("faulted scrapes left photon_fleet_"
+                                "scrape_errors_total at 0")
             check_probes(problems)
             cell["ok"] = not problems
             cells.append(cell)

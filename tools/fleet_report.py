@@ -1,0 +1,252 @@
+#!/usr/bin/env python
+"""Fleet observability report: one text page from a fleet's artifacts.
+
+Where ``perf_report.py`` answers "where did the wall-clock go",
+this tool answers the fleet operator's questions — which shard is hot,
+how much replica redundancy is left, is the error budget burning —
+from the same kinds of artifacts:
+
+- ``metrics.aggregate.prom`` (or ``metrics.prom``) — a saved fleet
+  ``GET /metrics`` fold (or ``tools/metrics_fold.py``'s offline refold
+  of dumped host snapshots — byte-identical by construction);
+- ``statusz.json`` — a saved ``GET /statusz`` body (optional: the
+  topology section is skipped without it);
+- ``trace.jsonl`` / ``trace.merged.jsonl`` — router spans (optional:
+  the fan-out section is skipped without it). Hedges and replica
+  retries appear as sibling ``fleet.leg`` spans under one
+  ``fleet.request`` tree, so the per-kind tallies here are countable
+  straight off the records.
+
+The report is a pure function of its inputs (no clocks, no environment
+reads) — the golden test feeds fixture artifacts and compares bytes.
+
+Usage::
+
+    python tools/fleet_report.py DIR
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Mapping, Optional, Sequence
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from photon_ml_tpu.telemetry import prometheus as tprom  # noqa: E402
+
+
+def _labeled(parsed: Mapping, series: str, label: str) -> dict[str, float]:
+    """{label value: summed sample value} over one series' samples."""
+    out: dict[str, float] = {}
+    for labels, value in parsed.get(series, ()):
+        if label in labels:
+            out[labels[label]] = out.get(labels[label], 0.0) + value
+    return out
+
+
+def _scalar(parsed: Mapping, series: str) -> Optional[float]:
+    for _labels, value in parsed.get(series, ()):
+        return value
+    return None
+
+
+def shard_table(parsed: Mapping) -> list[dict]:
+    """Per-shard heat + fault tallies from the folded snapshot's
+    ``photon_fleet_*`` families, one row per shard id seen anywhere."""
+    p50 = _labeled(parsed, "photon_fleet_shard_p50_seconds", "shard")
+    p99 = _labeled(parsed, "photon_fleet_shard_p99_seconds", "shard")
+    load = _labeled(parsed, "photon_fleet_shard_load", "shard")
+    legs = _labeled(parsed, "photon_fleet_fanout_seconds_count", "shard")
+    hedges = _labeled(parsed, "photon_fleet_hedges_total", "shard")
+    wins = _labeled(parsed, "photon_fleet_hedge_wins_total", "shard")
+    retries = _labeled(parsed, "photon_fleet_replica_retries_total",
+                       "shard")
+    upstream = _labeled(parsed, "photon_fleet_upstream_errors_total",
+                        "shard")
+    scrape = _labeled(parsed, "photon_fleet_scrape_errors_total", "shard")
+    shards = sorted(set(p50) | set(p99) | set(load) | set(legs)
+                    | set(hedges) | set(retries) | set(upstream)
+                    | set(scrape),
+                    key=lambda s: (len(s), s))
+    return [{"shard": s,
+             "p50_ms": p50.get(s, 0.0) * 1e3,
+             "p99_ms": p99.get(s, 0.0) * 1e3,
+             "load": load.get(s, 0.0),
+             "legs": legs.get(s, 0.0),
+             "hedges": hedges.get(s, 0.0),
+             "hedge_wins": wins.get(s, 0.0),
+             "retries": retries.get(s, 0.0),
+             "upstream_errors": upstream.get(s, 0.0),
+             "scrape_errors": scrape.get(s, 0.0)}
+            for s in shards]
+
+
+def leg_tallies(spans: Sequence[Mapping]) -> Optional[dict]:
+    """Fan-out shape from router spans: ``fleet.request`` trees and
+    their ``fleet.leg`` children by kind. None without fleet spans."""
+    requests = sum(1 for s in spans if s.get("name") == "fleet.request")
+    kinds: dict[str, int] = {}
+    stitched = 0
+    for s in spans:
+        if s.get("name") == "fleet.leg":
+            kind = str(s.get("kind", "primary"))
+            kinds[kind] = kinds.get(kind, 0) + 1
+            if s.get("host_span") is not None:
+                stitched += 1
+    if not requests and not kinds:
+        return None
+    host_stages = sum(1 for s in spans
+                      if str(s.get("name", "")).startswith("host."))
+    return {"requests": requests, "kinds": kinds, "stitched": stitched,
+            "host_stages": host_stages}
+
+
+def build_report(prom_text: str, statusz: Optional[Mapping] = None,
+                 spans: Sequence[Mapping] = ()) -> str:
+    """The report text (the CLI prints it; tests golden-compare it)."""
+    parsed = tprom.parse_text(prom_text)
+    lines: list[str] = ["== photon fleet report =="]
+
+    # --- overview ---------------------------------------------------------
+    hosts = _scalar(parsed, "photon_fleet_hosts")
+    map_version = _scalar(parsed, "photon_fleet_shardmap_version")
+    by_endpoint = _labeled(parsed, "photon_fleet_requests_total",
+                           "endpoint")
+    bits = []
+    if hosts is not None:
+        bits.append(f"{int(hosts)} host(s)")
+    if map_version is not None:
+        bits.append(f"shard map v{int(map_version)}")
+    if by_endpoint:
+        served = ", ".join(f"{ep} {int(n)}"
+                           for ep, n in sorted(by_endpoint.items()))
+        bits.append(f"requests: {served}")
+    lines.append("; ".join(bits) if bits else
+                 "(no photon_fleet_* series in snapshot)")
+
+    # --- per-shard heat ----------------------------------------------------
+    rows = shard_table(parsed)
+    if rows:
+        lines.append("")
+        lines.append("-- per-shard heat --")
+        lines.append(f"{'shard':<6} {'p50_ms':>8} {'p99_ms':>8} "
+                     f"{'load':>5} {'legs':>7} {'hedge':>6} {'won':>4} "
+                     f"{'retry':>6} {'upstream':>9} {'scrape_err':>11}")
+        for r in rows:
+            lines.append(
+                f"{r['shard']:<6} {r['p50_ms']:>8.3f} {r['p99_ms']:>8.3f} "
+                f"{int(r['load']):>5d} {int(r['legs']):>7d} "
+                f"{int(r['hedges']):>6d} {int(r['hedge_wins']):>4d} "
+                f"{int(r['retries']):>6d} {int(r['upstream_errors']):>9d} "
+                f"{int(r['scrape_errors']):>11d}")
+
+    # --- SLO burn ----------------------------------------------------------
+    burns = _labeled(parsed, "photon_slo_burn_total", "window")
+    if burns:
+        lines.append("")
+        lines.append("-- SLO burn alerts --")
+        for window in sorted(burns, key=lambda w: (len(w), w)):
+            lines.append(f"{window}: {int(burns[window])} alert(s)")
+
+    # --- fan-out trace shape -----------------------------------------------
+    tallies = leg_tallies(spans)
+    if tallies is not None:
+        lines.append("")
+        lines.append("-- fan-out traces --")
+        kinds = ", ".join(f"{k} {n}" for k, n in
+                          sorted(tallies["kinds"].items()))
+        lines.append(f"{tallies['requests']} fleet.request tree(s); "
+                     f"legs: {kinds or '(none)'}")
+        lines.append(f"{tallies['stitched']} leg(s) stitched to a host "
+                     f"span, {tallies['host_stages']} host stage "
+                     f"span(s) attached")
+
+    # --- topology ----------------------------------------------------------
+    if statusz is not None:
+        lines.append("")
+        lines.append("-- topology (statusz) --")
+        shard_map = statusz.get("shard_map") or {}
+        lines.append(
+            f"status {statusz.get('status')}; "
+            f"{statusz.get('n_shards')} shard(s) x "
+            f"{statusz.get('replicas')} replica(s); "
+            f"map {str(shard_map.get('hash'))[:12]} "
+            f"v{shard_map.get('version')}")
+        up = statusz.get("shard_replicas_up")
+        if up is not None:
+            lines.append("replicas up per shard: "
+                         + " ".join(f"s{i}={n}"
+                                    for i, n in enumerate(up)))
+        for host in statusz.get("hosts", ()):
+            scrape = host.get("last_scrape")
+            scraped = ("never scraped" if scrape is None
+                       else ("scrape ok" if scrape.get("ok")
+                             else f"scrape FAILED "
+                                  f"({scrape.get('error', '?')})"))
+            lines.append(
+                f"  s{host.get('shard')}r{host.get('replica')} "
+                f"{host.get('url')}: {host.get('status')}, {scraped}")
+        slo = statusz.get("slo")
+        if slo:
+            for w in slo:
+                state = "BURNING" if w.get("burning") else "ok"
+                lines.append(
+                    f"  slo[{w.get('window')}]: burn "
+                    f"{w.get('burn_rate')} (threshold "
+                    f"{w.get('threshold')}) — {state}, "
+                    f"{w.get('bad')}/{w.get('total')} bad")
+    return "\n".join(lines) + "\n"
+
+
+def load_spans(path: str) -> list[dict]:
+    spans = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            if rec.get("span_id") is None:
+                continue
+            spans.append(rec)
+    return spans
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        description="Render a fleet observability report from saved "
+                    "fleet artifacts (metrics fold + statusz + traces)")
+    p.add_argument("run_dir", help="directory holding the fleet's saved "
+                                   "artifacts")
+    args = p.parse_args(argv)
+    prom = os.path.join(args.run_dir, "metrics.aggregate.prom")
+    if not os.path.exists(prom):
+        prom = os.path.join(args.run_dir, "metrics.prom")
+    if not os.path.exists(prom):
+        print(f"no metrics snapshot under {args.run_dir} (expected "
+              f"metrics.aggregate.prom or metrics.prom — save the "
+              f"router's GET /metrics, or run tools/metrics_fold.py "
+              f"over dumped host snapshots)", file=sys.stderr)
+        return 1
+    with open(prom, encoding="utf-8") as f:
+        prom_text = f.read()
+    statusz = None
+    status_path = os.path.join(args.run_dir, "statusz.json")
+    if os.path.exists(status_path):
+        with open(status_path, encoding="utf-8") as f:
+            statusz = json.load(f)
+    spans: list = []
+    for name in ("trace.merged.jsonl", "trace.jsonl"):
+        trace_path = os.path.join(args.run_dir, name)
+        if os.path.exists(trace_path):
+            spans = load_spans(trace_path)
+            break
+    sys.stdout.write(build_report(prom_text, statusz, spans))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

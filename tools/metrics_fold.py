@@ -18,6 +18,14 @@ under ``DIR/``, workers under ``DIR/workers/proc-N/``) plus per-process
   per-process; the merged key is ``(process, span_id)``), so cross-host
   sweep skew is visible in a single file.
 
+**Fleet layout**: a serving-fleet dump puts the ROUTER's snapshot under
+``DIR/`` and each host's under ``DIR/hosts/shard-I-replica-J/``. When
+that layout is present, the fold tags each host's host-owned gauges
+``shard="I"``, ``replica="J"`` — the identical tagging the router's live
+``GET /metrics`` applies (``photon_ml_tpu/fleet/observe.py``), so
+re-folding a fleet's dumped snapshots reproduces the live fold
+byte-for-byte.
+
 Usage::
 
     python tools/metrics_fold.py DIR [--output AGG.prom] [--no-traces]
@@ -55,6 +63,25 @@ def worker_dirs(run_dir: str) -> list[tuple[int, str]]:
     return sorted(out)
 
 
+def host_dirs(run_dir: str) -> list[tuple[int, int, str]]:
+    """``(shard, replica, dir)`` for every ``hosts/shard-I-replica-J``
+    subdir, shard-major (the order the router's live scrape visits)."""
+    out = []
+    root = os.path.join(run_dir, "hosts")
+    if os.path.isdir(root):
+        for name in os.listdir(root):
+            parts = name.split("-")
+            if len(parts) != 4 or parts[0] != "shard" or \
+                    parts[2] != "replica":
+                continue
+            try:
+                out.append((int(parts[1]), int(parts[3]),
+                            os.path.join(root, name)))
+            except ValueError:
+                continue
+    return sorted(out)
+
+
 def _snapshot_paths(run_dir: str, filename: str) -> list[tuple[int, str]]:
     return [(0, os.path.join(run_dir, filename))] + [
         (pid, os.path.join(d, filename)) for pid, d in worker_dirs(run_dir)]
@@ -69,8 +96,12 @@ def _write_atomic(path: str, text: str) -> str:
 
 
 def fold_metrics(run_dir: str, output: Optional[str] = None) -> str:
-    """Merge ``metrics.prom`` + ``workers/proc-N/metrics.prom`` into
-    ``metrics.aggregate.prom`` (or ``output``); returns the written path."""
+    """Merge ``metrics.prom`` + ``workers/proc-N/metrics.prom`` (and, in
+    the fleet layout, ``hosts/shard-I-replica-J/metrics.prom``) into
+    ``metrics.aggregate.prom`` (or ``output``); returns the written
+    path."""
+    from photon_ml_tpu.fleet.observe import fold_fleet_snapshots
+
     texts = []
     for pid, path in _snapshot_paths(run_dir, "metrics.prom"):
         if not os.path.exists(path):
@@ -79,9 +110,23 @@ def fold_metrics(run_dir: str, output: Optional[str] = None) -> str:
                 f"run started with --telemetry-dir on every process?")
         with open(path, encoding="utf-8") as f:
             texts.append(f.read())
+    hosts = host_dirs(run_dir)
+    if hosts:
+        # the fleet refold: the first snapshot is the router's, each
+        # host's gets the same shard/replica tagging the live scrape
+        # applies — feeding fold_fleet_snapshots keeps this tool and
+        # router.metrics_text() the same fold by construction
+        snapshots = []
+        for shard, replica, d in hosts:
+            with open(os.path.join(d, "metrics.prom"),
+                      encoding="utf-8") as f:
+                snapshots.append((shard, replica, f.read()))
+        folded = fold_fleet_snapshots(aggregate_text(texts), snapshots)
+    else:
+        folded = aggregate_text(texts)
     return _write_atomic(
         output or os.path.join(run_dir, "metrics.aggregate.prom"),
-        aggregate_text(texts))
+        folded)
 
 
 def fold_traces(run_dir: str, output: Optional[str] = None) -> Optional[str]:
@@ -115,8 +160,9 @@ def main(argv=None) -> int:
                         help="skip the trace.jsonl merge")
     args = parser.parse_args(argv)
     n_workers = len(worker_dirs(args.run_dir))
+    n_hosts = len(host_dirs(args.run_dir))
     agg = fold_metrics(args.run_dir, args.output)
-    print(f"folded {1 + n_workers} process snapshot(s) -> {agg}")
+    print(f"folded {1 + n_workers + n_hosts} process snapshot(s) -> {agg}")
     if not args.no_traces:
         merged = fold_traces(args.run_dir)
         if merged:
