@@ -1405,7 +1405,11 @@ def bench_serving_fleet():
     fleet against: compare with ``serving_open_loop_qps`` to read the
     router tax, the per-host entity counts in the extras to read the
     table-byte split, and ``hedge_rate``/``reshard_epochs`` to read the
-    elasticity machinery's footprint under load."""
+    elasticity machinery's footprint under load. Two retained-plane
+    gates ride along: the history-sampler overhead window pair
+    (open-loop p99 with 20 Hz sampling on vs off must stay bounded) and
+    ``advisor_detect_ticks`` (a synthetic 10x-skewed shard must latch in
+    exactly the hysteresis sustain window)."""
     import argparse
     import tempfile
 
@@ -1485,6 +1489,24 @@ def bench_serving_fleet():
                 sum(s.n_entities
                     for s in h.service.registry.active().stores.values())
                 for h in fleet.hosts]
+            # retained-plane overhead: the same open-loop window with
+            # every history sampler OFF, then ON at an aggressively
+            # short period (20 Hz across router + 4 hosts — far past
+            # the production default). Sampling is a side thread + one
+            # registry render per tick, so the p99 delta it costs the
+            # serving path must stay bounded (gated below).
+            overhead_n = max(SERVING_REQUESTS // 2, 100)
+            run_off = bench_serving.open_loop_run(
+                fleet.url, pool, [1, 1, 1, 2, 4],
+                target_qps=FLEET_TARGET_QPS, requests=overhead_n,
+                concurrency=16)
+            fleet.history.start(0.05)
+            for h in fleet.hosts:
+                h.history.start(0.05)
+            run_on = bench_serving.open_loop_run(
+                fleet.url, pool, [1, 1, 1, 2, 4],
+                target_qps=FLEET_TARGET_QPS, requests=overhead_n,
+                concurrency=16)
         finally:
             fleet.stop()
         _heartbeat()
@@ -1522,6 +1544,45 @@ def bench_serving_fleet():
         shed_rate=run["shed"] / max(run["offered"], 1))
     elastic = bench_serving.fleet_elastic_extras(
         metrics0, metrics1, run["offered"])
+    # the sampler-overhead gate: generous (2x + 50 ms) so a noisy
+    # 1-core box never flakes it, but a sampler that serializes the
+    # request path behind its registry render blows straight through
+    sampler_p99_off = bench_serving._percentile(run_off["corrected_ms"], 99)
+    sampler_p99_on = bench_serving._percentile(run_on["corrected_ms"], 99)
+    if sampler_p99_on > 2.0 * sampler_p99_off + 50.0:
+        raise AssertionError(
+            f"history-sampler overhead: open-loop p99 went "
+            f"{sampler_p99_off:.1f} ms -> {sampler_p99_on:.1f} ms with "
+            f"20 Hz sampling on — the retained plane is standing on the "
+            f"serving path")
+    # hot-shard advisor detection bound: a synthetic 10x-skewed shard
+    # fed tick by tick must latch in EXACTLY sustain_ticks ticks —
+    # detection latency is the hysteresis design, not heuristics
+    from photon_ml_tpu.fleet.advisor import HotShardAdvisor
+
+    class _SynthHistory:
+        def __init__(self):
+            self.snaps = []
+
+        def snapshots(self, window=0):
+            return self.snaps[-window:] if window else list(self.snaps)
+
+    synth = _SynthHistory()
+    synth_advisor = HotShardAdvisor(history=synth,
+                                    shard_map_fn=lambda: None)
+    advisor_detect_ticks = 0
+    for t in range(1, 2 * synth_advisor.sustain_ticks + 2):
+        synth.snaps.append({"tick": t, "ts": float(t), "series": {
+            "shard_p99": {"0": 0.050, "1": 0.005},
+            "shard_load": {"0": 6.0, "1": 1.0}}})
+        if synth_advisor.tick():
+            advisor_detect_ticks = t
+            break
+    if advisor_detect_ticks != synth_advisor.sustain_ticks:
+        raise AssertionError(
+            f"hot-shard advisor latched a sustained 10x skew in "
+            f"{advisor_detect_ticks} tick(s), want exactly "
+            f"{synth_advisor.sustain_ticks} (the sustain window)")
     _emit("serving_fleet_qps", run["achieved_qps"],
           "req/s (open loop /score through the fleet router, 2 local "
           "entity-sharded shards x 2 replicas with hedged fan-out, "
@@ -1546,6 +1607,9 @@ def bench_serving_fleet():
           n_reconnected=run["reconnected"],
           fold_members=members, fold_count_delta=fold_delta,
           host_observations=proc_delta,
+          history_p99_off_ms=round(sampler_p99_off, 3),
+          history_p99_on_ms=round(sampler_p99_on, 3),
+          advisor_detect_ticks=advisor_detect_ticks,
           slo_p99_ms=slo_ms, slo_verdict=verdict["verdict"])
 
 

@@ -409,9 +409,107 @@ def check_span_attr_cardinality(ctx: FileContext):
                     f"per-request key)")
 
 
+#: the retained-telemetry plane's own plumbing: history/flightrec pass
+#: names through variables they validate at runtime (SERIES_NAME_RE,
+#: RECORD_KINDS) — the lint covers their CALLERS
+RETAINED_ALLOWED_FILES = {
+    os.path.join("photon_ml_tpu", "telemetry", "history.py"),
+    os.path.join("photon_ml_tpu", "telemetry", "flightrec.py"),
+}
+
+#: retained-telemetry writers whose NAME argument joins the black box /
+#: history vocabulary (FlightRecorder.note / record_event)
+RETAINED_NAME_CALLS = frozenset({"note", "record_event"})
+
+#: the static twin of telemetry.history.SERIES_NAME_RE
+RETAINED_NAME_RE = re.compile(r"\A[a-z][a-z0-9_]{0,59}\Z")
+
+
+@rule("tel-retained-vocab",
+      "flight-recorder note/event names and history series names come "
+      "from a closed literal vocabulary; payload fields stay out of the "
+      "black box")
+def check_retained_vocab(ctx: FileContext):
+    """The retained-telemetry plane (telemetry/history.py ring,
+    telemetry/flightrec.py black box) is indexed storage exactly like
+    span attributes: ``tools/postmortem.py`` and the ``/history`` fold
+    group by record names, so a COMPUTED name is an unbounded vocabulary
+    (every distinct value becomes its own report key) and a payload-
+    derived field value ships request data into crash dumps. Mirrors
+    ``tel-span-attr-cardinality``: names must be literal snake_case,
+    values may carry the request id (the sanctioned join key) but never
+    raw payload reads; requested history series must be members of
+    ``telemetry.history.HISTORY_SERIES``."""
+    if ctx.path in RETAINED_ALLOWED_FILES:
+        return
+    from photon_ml_tpu.telemetry.history import HISTORY_SERIES
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            call_name = func.attr
+        elif isinstance(func, ast.Name):
+            call_name = func.id
+        else:
+            continue
+        if call_name == "history_payload":
+            for kw in node.keywords:
+                if kw.arg != "series":
+                    continue
+                if not isinstance(kw.value, (ast.List, ast.Tuple)):
+                    continue  # computed lists are checked at runtime
+                for elt in kw.value.elts:
+                    if (isinstance(elt, ast.Constant)
+                            and isinstance(elt.value, str)
+                            and elt.value not in HISTORY_SERIES):
+                        yield ctx.finding(
+                            "tel-retained-vocab", elt,
+                            f"history series {elt.value!r} outside the "
+                            f"closed vocabulary (telemetry.history."
+                            f"HISTORY_SERIES) — the fold and /history "
+                            f"only serve derived series they can "
+                            f"recompute")
+            continue
+        if call_name not in RETAINED_NAME_CALLS:
+            continue
+        if node.args:
+            name_arg = node.args[0]
+            if not (isinstance(name_arg, ast.Constant)
+                    and isinstance(name_arg.value, str)):
+                yield ctx.finding(
+                    "tel-retained-vocab", node,
+                    f"{call_name}() name computed at runtime — flight "
+                    f"records are grouped by name in postmortems, so the "
+                    f"vocabulary is closed: pass a literal snake_case "
+                    f"string")
+            elif not RETAINED_NAME_RE.match(name_arg.value):
+                yield ctx.finding(
+                    "tel-retained-vocab", node,
+                    f"{call_name}() name {name_arg.value!r} outside the "
+                    f"closed vocabulary — flight record names are "
+                    f"snake_case literals")
+        for kw in node.keywords:
+            if kw.arg is None:
+                yield ctx.finding(
+                    "tel-retained-vocab", node,
+                    f"{call_name}(**...) splats computed field names "
+                    f"into the black box — the field vocabulary is "
+                    f"closed; spell the fields as literal keywords")
+            elif (kw.arg not in SANCTIONED_ATTR_KEYWORDS
+                    and _unbounded_value(kw.value)):
+                yield ctx.finding(
+                    "tel-retained-vocab", node,
+                    f"flight record field {kw.arg!r} set from a raw "
+                    f"request field — crash dumps are retained and "
+                    f"shared; join through the request id (the "
+                    f"sanctioned per-request key) instead of shipping "
+                    f"payload data")
+
+
 #: the shim's rule subset, in the legacy tool's documented order
-#: (``tel-span-attr-cardinality`` is engine-only — it postdates the
-#: legacy tool)
+#: (``tel-span-attr-cardinality`` and ``tel-retained-vocab`` are
+#: engine-only — they postdate the legacy tool)
 TELEMETRY_RULE_IDS = ("tel-print", "tel-perf-counter", "tel-metric-name",
                       "tel-registry", "tel-wall-clock", "tel-drift-home",
                       "tel-request-identity")

@@ -98,10 +98,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "aborts with the incumbent serving fleet-wide")
     p.add_argument("--router-watch-poll-s", type=float, default=10.0)
     from photon_ml_tpu.cli.config import (
+        add_retained_flags,
         add_router_flags,
         add_telemetry_flags,
     )
 
+    add_retained_flags(p)
     add_router_flags(p)
     add_telemetry_flags(p)
     return p
@@ -117,6 +119,10 @@ class FleetHandle:
         self.telemetry = telemetry
         self.watcher = None  # FleetPatchWatcher (--router-watch-dir)
         self.autopilot = None  # FeedbackAutopilot (--autopilot-config)
+        self.history = None  # router-side HistorySampler
+        self.advisor = None  # HotShardAdvisor (GET /advisor)
+        self.flight = None  # FlightRecorder (--flight-dir)
+        self.watchdog = None  # flight Watchdog (--watchdog-timeout-s)
 
     @property
     def url(self) -> str:
@@ -139,6 +145,12 @@ class FleetHandle:
             self.autopilot.stop()
         if self.watcher is not None:
             self.watcher.stop()
+        if self.watchdog is not None:
+            self.watchdog.close()
+        if self.history is not None:
+            self.history.close()
+        if self.flight is not None:
+            self.flight.close()
         self.router_server.stop()
         for host in self.hosts:
             if getattr(host, "drift_evaluator", None) is not None:
@@ -179,6 +191,12 @@ def build_fleet(argv: Optional[Sequence[str]] = None) -> FleetHandle:
         # single-process topology (a distributed fleet keeps them on)
         "--brownout-poll-s", "0",
         "--fleet-shard-count", str(n),
+        # every host retains its own /history ring (the router's fleet
+        # timeline folds them); the flight recorder stays fleet-level —
+        # one black box per process (a distributed fleet passes
+        # --flight-dir to each serve_game instead)
+        "--history-capacity", str(args.history_capacity),
+        "--history-period-s", str(args.history_period_s),
     ]
     if args.no_warmup:
         host_argv_common.append("--no-warmup")
@@ -232,6 +250,53 @@ def build_fleet(argv: Optional[Sequence[str]] = None) -> FleetHandle:
                                objective_s=config.slo_objective_ms / 1e3,
                                target=config.slo_target),
                 tick_s=config.slo_tick_s)
+        # the router's retained-telemetry plane: a history ring whose
+        # every snapshot carries fresh shard heat (pre_sample), the
+        # read-only hot-shard advisor ticking off each snapshot, and —
+        # with --flight-dir — the fleet's black box
+        from photon_ml_tpu.cli.config import retained_from_args
+        from photon_ml_tpu.events import GLOBAL_BUS
+        from photon_ml_tpu.fleet.advisor import HotShardAdvisor
+        from photon_ml_tpu.telemetry.history import HistorySampler
+        from photon_ml_tpu.telemetry.tracing import GLOBAL_TRACER
+
+        retained = retained_from_args(args)
+        router_sampler = HistorySampler(
+            capacity=retained.history_capacity, source="router",
+            pre_sample=router.observer.refresh_heat)
+        router.observer.attach_history(router_sampler)
+        advisor = HotShardAdvisor(history=router_sampler,
+                                  shard_map_fn=lambda: router.shard_map,
+                                  bus=GLOBAL_BUS)
+        router.advisor = advisor
+        router_sampler.add_listener(lambda _snap: advisor.tick())
+        flight = None
+        watchdog = None
+        if retained.flight_dir:
+            import logging as _logging
+
+            from photon_ml_tpu.telemetry.flightrec import (
+                FlightRecorder,
+                Watchdog,
+            )
+
+            # the dump's context header is the fleet statusz — shard-map
+            # version/hash, per-host lineage, SLO burn state — what the
+            # postmortem reconstructs the final epoch from
+            flight = FlightRecorder(
+                retained.flight_dir, capacity=retained.flight_capacity,
+                source="fleet", context_fn=router.observer.statusz,
+                tracer=GLOBAL_TRACER)
+            flight.install(bus=GLOBAL_BUS, tracer=GLOBAL_TRACER,
+                           sampler=router_sampler,
+                           logger=_logging.getLogger("photon_ml_tpu"))
+            if (retained.watchdog_timeout_s > 0
+                    and retained.history_period_s > 0):
+                watchdog = Watchdog(
+                    flight, timeout_s=retained.watchdog_timeout_s)
+                router_sampler.add_listener(lambda _snap: watchdog.pet())
+                watchdog.start(retained.history_period_s)
+        router_sampler.start(retained.history_period_s)
         server = RouterServer(router, host=args.host, port=args.port)
     except BaseException:
         for h in hosts:
@@ -243,6 +308,10 @@ def build_fleet(argv: Optional[Sequence[str]] = None) -> FleetHandle:
     sample_store = next(iter(
         hosts[0].service.registry.active().stores.values()), None)
     handle = FleetHandle(server.start(), hosts, telemetry)
+    handle.history = router_sampler
+    handle.advisor = advisor
+    handle.flight = flight
+    handle.watchdog = watchdog
     if args.router_watch_dir:
         from photon_ml_tpu.fleet.watcher import FleetPatchWatcher
 
@@ -286,9 +355,15 @@ def build_fleet(argv: Optional[Sequence[str]] = None) -> FleetHandle:
 
 def run(argv: Optional[Sequence[str]] = None) -> dict:
     fleet = build_fleet(argv)
+    if fleet.flight is not None:
+        # process-level dump triggers belong to the main (signal
+        # handlers only install on the main thread)
+        fleet.flight.install_sigterm()
+        fleet.flight.install_excepthook()
     rank_on = bool(fleet.hosts[0].service.registry.rank_coordinate)
     endpoints = ("/score" + (" /rank" if rank_on else "")
-                 + " /healthz /readyz /metrics /statusz /reload /reshard")
+                 + " /healthz /readyz /metrics /statusz /reload /reshard"
+                 + " /history /advisor")
     router = fleet.router
     print(f"serving GAME fleet ({router.n_shards} shards x "
           f"{router.replicas} replicas) on "

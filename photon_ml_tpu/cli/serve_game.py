@@ -131,11 +131,13 @@ def build_parser() -> argparse.ArgumentParser:
     from photon_ml_tpu.cli.config import (
         add_quality_flags,
         add_rank_flags,
+        add_retained_flags,
         add_telemetry_flags,
     )
 
     add_quality_flags(p)
     add_rank_flags(p)
+    add_retained_flags(p)
     add_telemetry_flags(p)
     return p
 
@@ -265,15 +267,62 @@ def build_server(argv: Optional[Sequence[str]] = None):
         server.autopilot = FeedbackAutopilot(
             registry.bus, AutopilotConfig.load(args.autopilot_config),
             reqlog_dirs=[args.reqlog_dir], reqlogs=[reqlog]).start()
+    # retained telemetry: the history ring is always armed (GET /history
+    # costs one bounded ring); the flight recorder and its stall
+    # watchdog only when --flight-dir asks for the black box
+    import logging
+
+    from photon_ml_tpu.cli.config import retained_from_args
+    from photon_ml_tpu.events import GLOBAL_BUS
+    from photon_ml_tpu.telemetry.history import HistorySampler
+    from photon_ml_tpu.telemetry.tracing import GLOBAL_TRACER
+
+    retained = retained_from_args(args)
+    sampler = HistorySampler(capacity=retained.history_capacity,
+                             source="host")
+    service.history = sampler
+    server.history = sampler
+    server.flight = None
+    server.watchdog = None
+    if retained.flight_dir:
+        from photon_ml_tpu.telemetry.flightrec import (
+            FlightRecorder,
+            Watchdog,
+        )
+
+        # the dump's context header is the host's live healthz (active
+        # version/lineage, compiles) — what the postmortem reconstructs
+        # the final epoch from
+        recorder = FlightRecorder(
+            retained.flight_dir, capacity=retained.flight_capacity,
+            source="host", context_fn=service.healthz,
+            tracer=GLOBAL_TRACER)
+        recorder.install(bus=GLOBAL_BUS, tracer=GLOBAL_TRACER,
+                         sampler=sampler,
+                         logger=logging.getLogger("photon_ml_tpu"))
+        server.flight = recorder
+        if retained.watchdog_timeout_s > 0 and retained.history_period_s > 0:
+            watchdog = Watchdog(recorder,
+                                timeout_s=retained.watchdog_timeout_s)
+            sampler.add_listener(lambda _snap: watchdog.pet())
+            watchdog.start(retained.history_period_s)
+            server.watchdog = watchdog
+    sampler.start(retained.history_period_s)
     return server
 
 
 def run(argv: Optional[Sequence[str]] = None) -> dict:
     server = build_server(argv)
+    if server.flight is not None:
+        # the main owns the process-level triggers: a signal handler
+        # only installs on the main thread, so build_server (callable
+        # from anywhere) cannot arm these
+        server.flight.install_sigterm()
+        server.flight.install_excepthook()
     version = server.service.registry.active_version
     rank_on = server.service.registry.rank_coordinate is not None
     endpoints = ("/score" + (" /rank" if rank_on else "")
-                 + " /healthz /readyz /metrics /reload")
+                 + " /healthz /readyz /metrics /reload /history")
     print(f"serving GAME model version {version} on {server.url} "
           f"({endpoints})", flush=True)
     try:
@@ -287,6 +336,11 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
             server.drift_evaluator.stop()
         if server.watcher is not None:
             server.watcher.stop()
+        if server.watchdog is not None:
+            server.watchdog.close()
+        server.history.close()
+        if server.flight is not None:
+            server.flight.close()
         server.stop()
         server.telemetry.close()
     return {"url": server.url, "version": version}

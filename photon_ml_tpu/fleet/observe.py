@@ -239,6 +239,9 @@ class FleetObserver:
         #: attach_slo/close are operator-lifecycle calls from one
         #: control thread (like RouterServer start/stop)
         self.slo: Optional[SloBurnTracker] = None  # guarded-by: caller
+        #: router-side history ring (serve_fleet arms it) — the fleet
+        #: timeline folds per-host rings against this one's snapshots
+        self.history_sampler = None  # guarded-by: caller
         self._lock = threading.Lock()
         #: (shard, replica) -> {"t": monotonic stamp, "ok", "error"}
         self._last_scrape: dict = {}  # guarded-by: _lock
@@ -304,6 +307,60 @@ class FleetObserver:
         with self._lock:
             self._last_scrape[(shard, replica)] = {
                 "t": time.monotonic(), "ok": ok, "error": error}
+
+    # --- retained history -------------------------------------------------
+    def attach_history(self, sampler) -> "FleetObserver":
+        """Arm the router-side history ring (a
+        :class:`~photon_ml_tpu.telemetry.history.HistorySampler` whose
+        ``pre_sample`` refreshes the heat gauges, so every snapshot
+        carries shard p50/p99/load)."""
+        self.history_sampler = sampler
+        return self
+
+    def scrape_history(self) -> "list[tuple[int, int, list]]":
+        """Every live host's retained ring (``GET /history?raw=1`` over
+        the pooled connections), shard-major ``(shard, replica,
+        snapshots)``. Failure semantics mirror :meth:`scrape`: a dead
+        host is annotated and skipped, the fold stays partial."""
+        import json as _json
+
+        rings = []
+        for s, group in enumerate(self.router.clients):
+            for r, client in enumerate(group):
+                try:
+                    status, text = client.request(
+                        "GET", "/history?raw=1", raw=True)
+                    if status != 200:
+                        raise RuntimeError(f"/history -> {status}")
+                    rings.append((s, r, _json.loads(text)["snapshots"]))
+                    self._note(s, r, ok=True)
+                except Exception as e:
+                    _SCRAPE_ERRORS.labels(shard=str(s),
+                                          replica=str(r)).inc()
+                    self._note(s, r, ok=False, error=repr(e))
+        return rings
+
+    def history(self, *, window: int = 0, series=(),
+                include_prom: bool = False) -> dict:
+        """The fleet timeline (router ``GET /history``): per-host rings
+        folded against the router's own ring through
+        :func:`fold_fleet_snapshots` — the EXACT merge semantics
+        ``tools/metrics_fold.py`` applies offline — then re-derived into
+        the closed series vocabulary
+        (:func:`photon_ml_tpu.telemetry.history.fold_history`)."""
+        from photon_ml_tpu.telemetry.history import (
+            fold_history,
+            history_payload,
+        )
+
+        sampler = self.history_sampler
+        if sampler is None:
+            raise RuntimeError("history sampler not armed on the router")
+        folded = fold_history(fold_fleet_snapshots, sampler.snapshots(),
+                              self.scrape_history())
+        return history_payload(folded, source="fleet",
+                               capacity=sampler.capacity, window=window,
+                               series=series, include_prom=include_prom)
 
     # --- heat -------------------------------------------------------------
     @staticmethod

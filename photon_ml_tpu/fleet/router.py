@@ -1378,6 +1378,33 @@ def _make_handler(router: FleetRouter):
                 self.wfile.write(data)
             elif parsed.path == "/statusz":
                 self._reply(200, router.statusz())
+            elif parsed.path == "/history":
+                # the fleet timeline: per-host retained rings folded
+                # against the router's own ring with the metrics_fold
+                # merge semantics (fleet/observe.py::FleetObserver.history)
+                qs = urllib.parse.parse_qs(parsed.query)
+                try:
+                    window = int((qs.get("window") or ["0"])[0])
+                    series = tuple(
+                        s for s in (qs.get("series") or [""])[0].split(",")
+                        if s)
+                    raw = (qs.get("raw") or ["0"])[0] not in ("", "0")
+                    body = router.observer.history(
+                        window=window, series=series, include_prom=raw)
+                except ValueError as e:
+                    self._reply(400, {"error": str(e)})
+                    return
+                except RuntimeError as e:
+                    self._reply(404, {"error": str(e)})
+                    return
+                self._reply(200, body)
+            elif parsed.path == "/advisor":
+                advisor = getattr(router, "advisor", None)
+                if advisor is None:
+                    self._reply(404, {"error": "hot-shard advisor "
+                                               "not armed"})
+                    return
+                self._reply(200, advisor.status())
             else:
                 self._reply(404, {"error": f"unknown path {self.path}"})
 

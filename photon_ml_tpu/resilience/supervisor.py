@@ -232,6 +232,10 @@ class FleetSupervisor:
                         return FleetResult(
                             restarts=self.restarts, attempts=attempt + 1,
                             result=self._read_result(result_path))
+                    # an installed flight recorder (telemetry.flightrec)
+                    # treats reason="stall" as a black-box dump trigger —
+                    # the last spans/events/history hit disk before the
+                    # stalled fleet is killed and restarted below
                     self.bus.post(
                         "supervisor_fault_detected", attempt=attempt,
                         reason=fault.reason, process=fault.process,

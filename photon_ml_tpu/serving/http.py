@@ -806,8 +806,34 @@ def _make_handler(service: ServingService):
                 )
 
                 self._reply_raw(200, render().encode(), CONTENT_TYPE)
+            elif parsed.path == "/history":
+                self._handle_history(parsed.query)
             else:
                 self._reply(404, {"error": f"unknown path {self.path}"})
+
+        def _handle_history(self, query: str) -> None:
+            """``GET /history?series=&window=[&raw=1]`` — the host's
+            retained-telemetry ring (closed series vocabulary; unknown
+            names and bad windows are a 400, an unarmed sampler a 404).
+            ``raw=1`` includes each snapshot's watched-subset exposition
+            text — what the fleet router's fold scrapes."""
+            sampler = getattr(service, "history", None)
+            if sampler is None:
+                self._reply(404, {"error": "history sampler not armed"})
+                return
+            qs = parse_qs(query)
+            try:
+                window = int((qs.get("window") or ["0"])[0])
+                series = tuple(
+                    s for s in (qs.get("series") or [""])[0].split(",")
+                    if s)
+                raw = (qs.get("raw") or ["0"])[0] not in ("", "0")
+                data = sampler.payload_json(window=window, series=series,
+                                            include_prom=raw)
+            except ValueError as e:
+                self._reply(400, {"error": str(e)})
+                return
+            self._reply_raw(200, data, "application/json")
 
         def _handle_rank(self, rid: str, payload: dict,
                          parse_ms: float = 0.0,
@@ -985,4 +1011,11 @@ class GameServer:
         self._httpd.server_close()
         if self._thread is not None:
             self._thread.join()
+        # retained-telemetry plane, when the driver armed one (attrs set
+        # by cli/serve_game.build_server; all closes are idempotent, so
+        # the driver's own finally-close is harmless)
+        for attr in ("watchdog", "history", "flight"):
+            obj = getattr(self, attr, None)
+            if obj is not None:
+                obj.close()
         self.service.close()

@@ -97,6 +97,10 @@ class Tracer:
         #: at span exit so an async span re-parents instead of recording an
         #: interval that leaks outside its (already closed) parent
         self._open: set[int] = set()
+        #: completed-record taps (the flight recorder's span lane) —
+        #: replaced wholesale on mutation so readers iterate an immutable
+        #: snapshot without taking the lock on the span hot path
+        self._taps: tuple = ()
 
     @property
     def enabled(self) -> bool:
@@ -129,7 +133,32 @@ class Tracer:
             self._path = None
             self._bus = None
 
+    def add_tap(self, fn) -> "callable":
+        """Call ``fn(record)`` for every completed span/annotation record
+        — even when no file sink is configured (the flight recorder taps
+        here so the black box fills on hosts that never write
+        ``trace.jsonl``). Tap exceptions are swallowed; returns a
+        removal callable."""
+        with self._lock:
+            self._taps = self._taps + (fn,)
+
+        def _remove() -> None:
+            with self._lock:
+                self._taps = tuple(t for t in self._taps if t is not fn)
+        return _remove
+
+    @property
+    def _sinking(self) -> bool:
+        """True when a completed record goes anywhere (file or tap) —
+        the guard that keeps unconfigured spans dict-build-free."""
+        return self._fh is not None or bool(self._taps)
+
     def _write(self, record: dict) -> None:
+        for tap in self._taps:
+            try:
+                tap(record)
+            except Exception:
+                pass
         line = json.dumps(record) + "\n"
         with self._lock:
             if self._fh is not None:
@@ -171,7 +200,7 @@ class Tracer:
                     sp.parent_id = next(
                         (a for a in reversed(ancestors) if a in self._open),
                         None)
-            if self._fh is not None:
+            if self._sinking:
                 self._write(sp.record())
             bus = self._bus
             if bus is not None:
@@ -182,7 +211,7 @@ class Tracer:
         """Write a non-span record (e.g. an optimizer iteration table) into
         the trace file, tagged with the current span as its parent. No-op
         when unconfigured."""
-        if self._fh is None:
+        if not self._sinking:
             return
         self._write({"name": name, "span_id": None,
                      "parent_id": _CURRENT.get(), "ts": time.time(),
@@ -222,7 +251,7 @@ class Tracer:
                 if (sp.parent_id is not None
                         and sp.parent_id not in self._open):
                     sp.parent_id = None
-            if self._fh is not None:
+            if self._sinking:
                 self._write(sp.record())
             bus = self._bus
             if bus is not None:
@@ -239,7 +268,7 @@ class Tracer:
         report tools only need ``seconds``/``parent_id``). Returns the
         new span id. No-op (id still minted) when unconfigured."""
         span_id = next(self._ids)
-        if self._fh is not None:
+        if self._sinking:
             record = {"name": name, "span_id": span_id,
                       "parent_id": parent_id,
                       "ts": time.time() if ts is None else ts,
@@ -251,6 +280,13 @@ class Tracer:
                     f"span attributes shadow reserved keys {bad}")
             self._write(record)
         return span_id
+
+    def open_span_ids(self) -> tuple:
+        """Ids of spans currently open anywhere in the process, sorted —
+        what the flight recorder stamps into a dump header so a
+        postmortem can name the work in flight at the moment of death."""
+        with self._lock:
+            return tuple(sorted(self._open))
 
 
 #: process-global tracer the drivers configure; instrumented modules call

@@ -541,6 +541,105 @@ class TestPlaneIsFree:
 
 
 # ---------------------------------------------------------------------------
+# retained telemetry: /history (host + router fold) and /advisor
+# ---------------------------------------------------------------------------
+
+
+class TestRetainedHistory:
+    @staticmethod
+    def _tick_all(fleet, now):
+        """One aligned manual tick everywhere: host rings first, then
+        the router ring (whose pre_sample refreshes the heat gauges)."""
+        for h in fleet.hosts:
+            h.history.sample(now=now)
+        fleet.history.sample(now=now)
+
+    def test_host_endpoint_serves_the_ring(self, env):
+        host = env["fleet"].hosts[0]
+        host.history.sample(now=50.0)
+        body = _get(host.url + "/history?series=requests,queue_depth"
+                    "&window=1")
+        assert body["source"] == "host"
+        assert body["series"] == ["requests", "queue_depth"]
+        assert len(body["snapshots"]) == 1
+        snap = body["snapshots"][0]
+        assert set(snap["series"]) == {"requests", "queue_depth"}
+        assert "prom" not in snap  # raw text only ships with ?raw=1
+        raw = _get(host.url + "/history?window=1&raw=1")
+        assert "photon_serving_requests_total" \
+            in raw["snapshots"][0]["prom"]
+
+    def test_unknown_series_is_a_400_on_both_tiers(self, env):
+        fleet = env["fleet"]
+        self._tick_all(fleet, 60.0)
+        for url in (fleet.hosts[0].url, fleet.url):
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _get(url + "/history?series=userId")
+            assert err.value.code == 400
+            assert "closed" in json.loads(err.value.read())["error"]
+
+    def test_router_fold_matches_offline_metrics_fold(self, env,
+                                                      tmp_path):
+        import metrics_fold
+
+        fleet = env["fleet"]
+        self._tick_all(fleet, 100.0)
+        _post(fleet.url + "/score", {"records": env["requests"][:8]})
+        self._tick_all(fleet, 101.0)
+        body = _get(fleet.url + "/history?raw=1")
+        assert body["source"] == "fleet"
+        assert len(body["snapshots"]) >= 2
+        newest = body["snapshots"][-1]
+        assert newest["tick"] == fleet.history.snapshots()[-1]["tick"]
+        assert newest["series"]["requests"] > 0  # the traffic landed
+        # parity: dump the SAME per-host ring rows the fold consumed and
+        # refold them offline with tools/metrics_fold.py — byte-identical
+        run_dir = tmp_path / "telemetry"
+        (run_dir / "hosts").mkdir(parents=True)
+        (run_dir / "metrics.prom").write_text(
+            fleet.history.snapshots()[-1]["prom"])
+        for s, r, snaps in fleet.router.observer.scrape_history():
+            d = run_dir / "hosts" / f"shard-{s}-replica-{r}"
+            d.mkdir()
+            (d / "metrics.prom").write_text(snaps[-1]["prom"])
+        folded = metrics_fold.fold_metrics(str(run_dir))
+        assert open(folded).read() == newest["prom"]
+
+    def test_advisor_endpoint_rides_the_router_ring(self, env):
+        fleet = env["fleet"]
+        before = _get(fleet.url + "/advisor")
+        fleet.history.sample(now=200.0)  # the sampler listener ticks it
+        body = _get(fleet.url + "/advisor")
+        assert body["ticks"] == before["ticks"] + 1
+        assert body["history_tick"] \
+            == fleet.history.snapshots()[-1]["tick"]
+        assert body["params"] == {"enter_ratio": 2.0, "exit_ratio": 1.25,
+                                  "sustain_ticks": 3}
+        assert set(body["shards"]) == {"0", "1"}
+        # the warm fleet is balanced: no latch, no advice
+        assert body["hot"] == []
+        assert body["recommendation"] is None
+
+    def test_plane_stays_free_with_retained_armed(self, env):
+        fleet = env["fleet"]
+        compiles0 = [_get(u + "/healthz")["compiles"]
+                     for u in fleet.host_urls()]
+        for i in range(2):
+            fleet_scores = _post(fleet.url + "/score",
+                                 {"records": env["requests"]})["scores"]
+            self._tick_all(fleet, 300.0 + i)
+            _get(fleet.url + "/history?window=1")
+            _get(fleet.url + "/advisor")
+        single_scores = _post(env["single"].url + "/score",
+                              {"records": env["requests"]})["scores"]
+        assert fleet_scores == single_scores
+        assert all(s == float(np.float32(s)) for s in fleet_scores)
+        compiles1 = [_get(u + "/healthz")["compiles"]
+                     for u in fleet.host_urls()]
+        assert compiles1 == compiles0
+
+
+# ---------------------------------------------------------------------------
 # tools/fleet_report.py golden
 # ---------------------------------------------------------------------------
 
@@ -643,6 +742,58 @@ replicas up per shard: s0=2 s1=1
 """
 
 
+REPORT_HISTORY = {
+    "source": "fleet", "capacity": 240,
+    "series": ["requests", "shed_rate", "hedge_rate", "latency_p50",
+               "latency_p99", "queue_depth", "slo_burn", "shard_p99"],
+    "snapshots": [
+        {"tick": 7, "ts": 100.0, "series": {
+            "requests": 24.0, "shed_rate": 0.0, "hedge_rate": 0.125,
+            "latency_p50": 0.004, "latency_p99": 0.012,
+            "queue_depth": 0.0, "slo_burn": 0.0,
+            "shard_p99": {"0": 0.012, "1": 0.008}}},
+        {"tick": 8, "ts": 101.0, "series": {
+            "requests": 30.0, "shed_rate": 0.0625, "hedge_rate": 0.1,
+            "latency_p50": 0.005, "latency_p99": 0.0301,
+            "queue_depth": 2.0, "slo_burn": 1.0,
+            "shard_p99": {"0": 0.009, "1": 0.0301}}},
+    ],
+}
+
+REPORT_ADVISOR = {
+    "hot": [1], "ticks": 42, "detections": 1, "history_tick": 8,
+    "params": {"enter_ratio": 2.0, "exit_ratio": 1.25,
+               "sustain_ticks": 3},
+    "shards": {
+        "0": {"p99_s": 0.009, "p99_ratio": 0.299, "load": 1.0,
+              "load_ratio": 0.6667, "skew": 0.6667},
+        "1": {"p99_s": 0.0301, "p99_ratio": 3.3444, "load": 2.0,
+              "load_ratio": 1.5, "skew": 3.3444},
+    },
+    "recommendation": {"kind": "scale_out", "n_shards": 3,
+                       "base_version": 3,
+                       "base_hash": "deadbeefcafe1234",
+                       "n_moves": 1365, "moves_from_hot": 683,
+                       "moves": {}},
+}
+
+EXPECTED_RETAINED_TAIL = """\
+-- fleet timeline (last 2 of 2 retained tick(s), source fleet) --
+t7 requests=24 shed_rate=0 hedge_rate=0.125 latency_p50=0.004 \
+latency_p99=0.012 queue_depth=0 slo_burn=0 hottest=s0:12.000ms
+t8 requests=30 shed_rate=0.0625 hedge_rate=0.1 latency_p50=0.005 \
+latency_p99=0.0301 queue_depth=2 slo_burn=1 hottest=s1:30.100ms
+
+-- hot-shard advisor --
+hot: s1; 1 detection(s) over 42 tick(s) (enter 2.0x, exit 1.25x, \
+sustain 3)
+  s0: skew 0.6667x (p99 9.000ms ratio 0.299; load 1.0 ratio 0.6667)
+  s1: skew 3.3444x (p99 30.100ms ratio 3.3444; load 2.0 ratio 1.5)
+advice: scale_out to 3 shard(s) — 1365 bucket move(s), 683 off hot \
+shard(s), from map v3
+"""
+
+
 class TestFleetReport:
     def test_report_is_a_deterministic_golden(self):
         import fleet_report
@@ -653,6 +804,20 @@ class TestFleetReport:
         # pure function: same artifacts, same bytes
         assert got == fleet_report.build_report(
             REPORT_PROM, REPORT_STATUSZ, REPORT_SPANS)
+
+    def test_retained_sections_extend_the_golden(self):
+        import fleet_report
+
+        got = fleet_report.build_report(REPORT_PROM, REPORT_STATUSZ,
+                                        REPORT_SPANS,
+                                        history=REPORT_HISTORY,
+                                        advisor=REPORT_ADVISOR)
+        assert got == EXPECTED_REPORT + "\n" + EXPECTED_RETAINED_TAIL
+        # a cool advisor renders advice: none, not a recommendation
+        cool = dict(REPORT_ADVISOR, hot=[], recommendation=None)
+        got = fleet_report.build_report(REPORT_PROM, advisor=cool)
+        assert "hot: (none); 1 detection(s)" in got
+        assert "advice: none (fleet is cool)" in got
 
     def test_sections_degrade_without_optional_artifacts(self):
         import fleet_report
@@ -678,6 +843,22 @@ class TestFleetReport:
                                 "parent_id": 1}) + "\n")  # annotation
         assert fleet_report.main([str(run_dir)]) == 0
         assert capsys.readouterr().out == EXPECTED_REPORT
+
+    def test_cli_resolves_retained_artifacts(self, tmp_path, capsys):
+        import fleet_report
+
+        run_dir = tmp_path / "artifacts"
+        run_dir.mkdir()
+        (run_dir / "metrics.aggregate.prom").write_text(REPORT_PROM)
+        (run_dir / "statusz.json").write_text(json.dumps(REPORT_STATUSZ))
+        with open(run_dir / "trace.jsonl", "w") as f:
+            for span in REPORT_SPANS:
+                f.write(json.dumps(span) + "\n")
+        (run_dir / "history.json").write_text(json.dumps(REPORT_HISTORY))
+        (run_dir / "advisor.json").write_text(json.dumps(REPORT_ADVISOR))
+        assert fleet_report.main([str(run_dir)]) == 0
+        assert capsys.readouterr().out \
+            == EXPECTED_REPORT + "\n" + EXPECTED_RETAINED_TAIL
 
     def test_cli_errors_without_a_snapshot(self, tmp_path, capsys):
         import fleet_report

@@ -154,3 +154,50 @@ def test_cli_json_report(tmp_path):
     assert {f["rule"] for f in doc["findings"]} == {"res-sleep",
                                                     "res-bare-except"}
     assert all(f["path"].endswith("bad.py") for f in doc["findings"])
+
+
+# ---------------------------------------------------------------------------
+# tel-retained-vocab (flight recorder / history closed vocabulary)
+# ---------------------------------------------------------------------------
+
+
+def _retained(snippet,
+              rel=os.path.join("photon_ml_tpu", "serving", "x.py")):
+    return engine.check_source(snippet, rel, ["tel-retained-vocab"])
+
+
+def test_retained_vocab_accepts_literal_snake_names():
+    assert _retained("rec.note('reshard_started', request_id=rid)\n") \
+        == []
+    assert _retained(
+        "rec.record_event('fault_injected', dict(e.payload))\n") == []
+
+
+def test_retained_vocab_rejects_computed_or_non_snake_names():
+    assert len(_retained("rec.note(make_name())\n")) == 1
+    assert len(_retained("rec.note('BadName')\n")) == 1
+    assert len(_retained("rec.record_event(evt_name, {})\n")) == 1
+
+
+def test_retained_vocab_rejects_splatted_or_payload_fields():
+    assert len(_retained("rec.note('ok_name', **fields)\n")) == 1
+    assert len(_retained(
+        "rec.note('ok_name', who=payload.get('userId'))\n")) == 1
+    # the request id is the sanctioned join key, wherever it comes from
+    assert _retained(
+        "rec.note('ok_name', request_id=payload.get('rid'))\n") == []
+
+
+def test_retained_vocab_checks_history_payload_series_literals():
+    good = "history_payload(snaps, series=['requests', 'shed_rate'])\n"
+    assert _retained(good) == []
+    bad = "history_payload(snaps, series=['requests', 'bogus'])\n"
+    findings = _retained(bad)
+    assert len(findings) == 1 and "bogus" in findings[0].message
+    # computed series lists are the runtime check's business
+    assert _retained("history_payload(snaps, series=wanted)\n") == []
+
+
+def test_retained_vocab_exempts_the_plane_itself():
+    rel = os.path.join("photon_ml_tpu", "telemetry", "flightrec.py")
+    assert _retained("rec.note(name, **fields)\n", rel) == []

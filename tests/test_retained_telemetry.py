@@ -1,0 +1,762 @@
+"""Retained-telemetry tests: the on-host history ring
+(``telemetry/history.py``), the black-box flight recorder and its stall
+watchdog (``telemetry/flightrec.py``), the hot-shard advisor
+(``fleet/advisor.py``) and the postmortem page (``tools/postmortem.py``).
+
+The contracts locked here:
+
+- **history**: the sampler keeps a bounded, tick-monotonic ring; derived
+  series are interval deltas/quantiles of the WATCHED registry subset;
+  the query vocabulary is closed (unknown series raise, the endpoints
+  400); ``fold_history`` aligns per-host rings by distance from the
+  newest snapshot and re-derives fleet series from folded text;
+- **flight recorder**: all four trigger classes — fault-site trip,
+  unhandled exception (sys + threading hooks, chained), SIGTERM
+  (chained), watchdog stall (edge-latched) — produce an ATOMIC
+  ``flight-<ts>.jsonl`` (never a ``.tmp``, every line complete JSON);
+  repeat triggers of one reason coalesce under the cooldown; the ring
+  wraps at capacity keeping the newest records;
+- **tracer tap**: ``record_span``/``span``/``span_under`` feed the
+  flight ring through ``Tracer.add_tap`` even with NO file sink, under
+  concurrent writer threads, with contiguous sequence numbers;
+- **advisor**: a synthetic hot shard latches in EXACTLY
+  ``sustain_ticks`` ticks, a skew oscillating inside the hysteresis
+  band produces zero flaps, and the recommendation is the minimal-move
+  ``ShardMap.rebalanced`` scale-out;
+- **postmortem**: the incident page is a byte-deterministic golden of
+  the dump's bytes.
+"""
+
+import json
+import logging
+import os
+import signal
+import sys
+import threading
+import time
+
+import pytest
+
+from photon_ml_tpu.events import EventBus
+from photon_ml_tpu.fleet.advisor import HotShardAdvisor
+from photon_ml_tpu.fleet.observe import fold_fleet_snapshots
+from photon_ml_tpu.fleet.sharding import ShardMap
+from photon_ml_tpu.telemetry import tracing
+from photon_ml_tpu.telemetry.flightrec import (
+    DUMP_REASONS,
+    RECORD_KINDS,
+    FlightRecorder,
+    Watchdog,
+)
+from photon_ml_tpu.telemetry.history import (
+    HISTORY_SERIES,
+    WATCHED_FAMILIES,
+    HistorySampler,
+    derive_series,
+    fold_history,
+    history_payload,
+    subset_text,
+)
+from photon_ml_tpu.telemetry.metrics import (
+    MetricsRegistry,
+    quantile_from_buckets,
+)
+from photon_ml_tpu.telemetry.prometheus import (
+    parse_text,
+    render,
+    series_value,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+def _dumps(flight_dir) -> list:
+    return sorted(f for f in os.listdir(flight_dir)
+                  if f.endswith(".jsonl"))
+
+
+# ---------------------------------------------------------------------------
+# the history ring
+# ---------------------------------------------------------------------------
+
+
+class TestHistorySampler:
+    def test_ring_is_bounded_and_tick_monotonic(self):
+        reg = MetricsRegistry()
+        sampler = HistorySampler(registry=reg, capacity=4, source="host")
+        for t in range(6):
+            sampler.sample(now=float(t))
+        snaps = sampler.snapshots()
+        assert [s["tick"] for s in snaps] == [3, 4, 5, 6]
+        assert [s["ts"] for s in snaps] == [2.0, 3.0, 4.0, 5.0]
+        for snap in snaps:
+            assert set(snap["series"]) == set(HISTORY_SERIES)
+        assert [s["tick"] for s in sampler.snapshots(window=2)] == [5, 6]
+
+    def test_series_vocabulary_is_sorted_and_closed(self):
+        assert list(HISTORY_SERIES) == sorted(HISTORY_SERIES)
+        assert list(WATCHED_FAMILIES) == sorted(WATCHED_FAMILIES)
+        with pytest.raises(ValueError, match="closed"):
+            history_payload([], source="host", capacity=1,
+                            series=("requests", "userId"))
+        with pytest.raises(ValueError, match="window"):
+            history_payload([], source="host", capacity=1, window=-1)
+
+    def test_payload_windows_filters_and_raw(self):
+        reg = MetricsRegistry()
+        reg.counter("photon_serving_requests_total", "h").inc(3)
+        sampler = HistorySampler(registry=reg, capacity=8)
+        sampler.sample(now=1.0)
+        reg.counter("photon_serving_requests_total", "h").inc(2)
+        sampler.sample(now=2.0)
+        body = sampler.payload(window=1, series=("requests",))
+        assert body["source"] == "host" and body["capacity"] == 8
+        assert body["series"] == ["requests"]
+        assert len(body["snapshots"]) == 1
+        snap = body["snapshots"][0]
+        assert snap["tick"] == 2
+        assert snap["series"] == {"requests": 2.0}
+        assert "prom" not in snap
+        raw = sampler.payload(window=1, include_prom=True)
+        assert "photon_serving_requests_total 5" \
+            in raw["snapshots"][0]["prom"]
+        # payload_json is the wire form: deterministic key order
+        assert sampler.payload_json(window=1) \
+            == json.dumps(sampler.payload(window=1),
+                          sort_keys=True).encode()
+
+    def test_subset_text_keeps_only_watched_families(self):
+        text = ("# TYPE photon_serving_requests_total counter\n"
+                "photon_serving_requests_total 7\n"
+                "# TYPE photon_private_total counter\n"
+                "photon_private_total 9\n")
+        subset = subset_text(text)
+        assert "photon_serving_requests_total 7" in subset
+        assert "photon_private" not in subset
+        assert series_value(parse_text(subset),
+                            "photon_serving_requests_total") == 7.0
+
+    def test_derived_series_are_interval_deltas_and_quantiles(self):
+        def prom(req, shed, hedges, fleet_req, b1, b2, binf):
+            return (
+                "# TYPE photon_serving_requests_total counter\n"
+                f"photon_serving_requests_total {req}\n"
+                "# TYPE photon_shed_total counter\n"
+                f"photon_shed_total {shed}\n"
+                "# TYPE photon_fleet_hedges_total counter\n"
+                f"photon_fleet_hedges_total {hedges}\n"
+                "# TYPE photon_fleet_requests_total counter\n"
+                f"photon_fleet_requests_total {fleet_req}\n"
+                "# TYPE photon_serving_request_latency_seconds histogram\n"
+                f'photon_serving_request_latency_seconds_bucket{{le="0.01"}} {b1}\n'  # noqa: E501
+                f'photon_serving_request_latency_seconds_bucket{{le="0.1"}} {b2}\n'  # noqa: E501
+                f'photon_serving_request_latency_seconds_bucket{{le="+Inf"}} {binf}\n'  # noqa: E501
+                "# TYPE photon_serving_queue_depth gauge\n"
+                "photon_serving_queue_depth 3\n"
+                "# TYPE photon_fleet_shard_p99_seconds gauge\n"
+                'photon_fleet_shard_p99_seconds{shard="0"} 0.02\n'
+                'photon_fleet_shard_p99_seconds{shard="1"} 0.005\n')
+
+        prev = parse_text(prom(100, 5, 2, 50, 10, 20, 20))
+        cur = parse_text(prom(140, 15, 7, 90, 30, 55, 60))
+        series = derive_series(prev, cur, dt_s=1.0)
+        assert series["requests"] == 40.0
+        assert series["shed_rate"] == pytest.approx(10 / 50)
+        assert series["hedge_rate"] == pytest.approx(5 / 40)
+        assert series["queue_depth"] == 3.0
+        assert series["shard_p99"] == {"0": 0.02, "1": 0.005}
+        # quantiles come from the interval's bucket-count DELTAS, the
+        # same estimator the registry histograms use
+        delta = [20.0, 35.0, 40.0]
+        assert series["latency_p50"] == pytest.approx(
+            quantile_from_buckets([0.01, 0.1], delta, 0.50))
+        assert series["latency_p99"] == pytest.approx(
+            quantile_from_buckets([0.01, 0.1], delta, 0.99))
+        # an idle interval has no latency evidence, not a stale average
+        idle = derive_series(cur, cur, dt_s=1.0)
+        assert idle["requests"] == 0.0
+        assert idle["latency_p50"] is None
+        assert idle["latency_p99"] is None
+
+    def test_listeners_fire_and_are_removable_and_fault_isolated(self):
+        sampler = HistorySampler(registry=MetricsRegistry(), capacity=4)
+        seen = []
+        remove = sampler.add_listener(seen.append)
+        sampler.add_listener(lambda _s: 1 / 0)  # must not break sampling
+        snap = sampler.sample(now=1.0)
+        assert seen == [snap]
+        remove()
+        sampler.sample(now=2.0)
+        assert len(seen) == 1
+
+    def test_fold_history_aligns_newest_and_sums_counters(self):
+        def ctext(total):
+            return ("# TYPE photon_serving_requests_total counter\n"
+                    f"photon_serving_requests_total {total}\n")
+
+        router = [{"tick": t, "ts": float(t), "prom": ctext(0)}
+                  for t in (1, 2, 3)]
+        host_a = [{"tick": t, "ts": float(t), "prom": ctext(10 * t)}
+                  for t in (1, 2, 3)]
+        host_b = [{"tick": t, "ts": float(t), "prom": ctext(100 * t)}
+                  for t in (2, 3)]  # shorter ring bounds the fold
+        folded = fold_history(fold_fleet_snapshots, router,
+                              [(0, 0, host_a), (1, 0, host_b)])
+        assert [f["tick"] for f in folded] == [2, 3]
+        # row 0 has no predecessor: the delta is the folded total; row 1
+        # is the interval's increment summed across hosts
+        assert folded[0]["series"]["requests"] == 220.0
+        assert folded[1]["series"]["requests"] == 110.0
+        assert series_value(parse_text(folded[1]["prom"]),
+                            "photon_serving_requests_total") == 330.0
+
+
+# ---------------------------------------------------------------------------
+# the flight recorder
+# ---------------------------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_ring_wraps_keeping_the_newest(self, tmp_path):
+        rec = FlightRecorder(str(tmp_path), capacity=8)
+        for i in range(20):
+            rec.note("reshard_started", attempt=i)
+        records = rec.records()
+        assert rec.seq == 20
+        assert [r["seq"] for r in records] == list(range(13, 21))
+        assert all(r["kind"] == "note" for r in records)
+
+    def test_vocabularies_are_closed(self, tmp_path):
+        rec = FlightRecorder(str(tmp_path), capacity=4)
+        assert DUMP_REASONS == ("fault_site", "unhandled_exception",
+                                "sigterm", "watchdog_stall", "manual")
+        assert RECORD_KINDS == ("span", "event", "log", "history", "note")
+        with pytest.raises(ValueError, match="closed"):
+            rec.dump("oops")
+        with pytest.raises(ValueError, match="vocabulary"):
+            rec.note("Not_Snake")
+        with pytest.raises(ValueError, match="vocabulary"):
+            rec.note("ok_name", badField=1)
+        with pytest.raises(ValueError):
+            FlightRecorder(str(tmp_path), capacity=0)
+
+    def test_dump_is_atomic_and_every_line_complete(self, tmp_path):
+        rec = FlightRecorder(str(tmp_path), capacity=16, source="host")
+        rec.note("reshard_started", request_id="r-1")
+        rec.record_event("fault_injected", {"site": "serving.score"},
+                         ts=9.0)
+        rec.record_log("queue saturated", level="WARNING")
+        rec.record_history({"tick": 2, "ts": 1.0,
+                            "series": {"requests": 4.0}})
+        path = rec.dump("manual", ts=1.0)
+        assert os.path.basename(path) == "flight-1000.jsonl"
+        assert not [f for f in os.listdir(tmp_path)
+                    if f.endswith(".tmp")]
+        lines = [json.loads(line) for line in open(path)]
+        assert lines[0]["kind"] == "flight_header"
+        assert lines[0]["reason"] == "manual"
+        assert lines[0]["source"] == "host"
+        assert lines[0]["schema"] == 1
+        assert lines[0]["seq"] == 4
+        assert lines[0]["capacity"] == 16
+        assert lines[0]["retained"] == 4
+        assert lines[0]["active_span_ids"] == []
+        assert [r["kind"] for r in lines[1:]] \
+            == ["note", "event", "log", "history"]
+
+    def test_repeat_triggers_coalesce_under_the_cooldown(self, tmp_path):
+        rec = FlightRecorder(str(tmp_path), capacity=4, cooldown_s=60.0)
+        rec.note("reshard_started")
+        first = rec.dump("manual", ts=2.0)
+        assert first is not None
+        assert rec.dump("manual", ts=2.0) is None  # coalesced
+        forced = rec.dump("manual", ts=2.0, force=True)
+        assert os.path.basename(forced) == "flight-2000-1.jsonl"
+        # a DIFFERENT reason is its own cooldown lane
+        assert rec.dump("fault_site", ts=3.0) is not None
+        assert len(_dumps(tmp_path)) == 3
+
+    def test_context_probe_failure_is_recorded_not_fatal(self, tmp_path):
+        def bad_context():
+            raise RuntimeError("statusz down")
+
+        rec = FlightRecorder(str(tmp_path), capacity=4,
+                             context_fn=bad_context)
+        path = rec.dump("manual", ts=1.0)
+        header = json.loads(open(path).readline())
+        assert "context" not in header
+        assert "statusz down" in header["context_error"]
+
+    def test_fault_site_event_triggers_a_dump(self, tmp_path):
+        bus = EventBus()
+        rec = FlightRecorder(str(tmp_path), capacity=16, cooldown_s=0.0)
+        uninstall = rec.install(bus=bus)
+        try:
+            bus.post("model_reloaded", version=2)
+            assert _dumps(tmp_path) == []  # ordinary events only record
+            bus.post("fault_injected", site="serving.score", op=1)
+        finally:
+            uninstall()
+        dumps = _dumps(tmp_path)
+        assert len(dumps) == 1
+        header, *records = [json.loads(line) for line in
+                            open(os.path.join(tmp_path, dumps[0]))]
+        assert header["reason"] == "fault_site"
+        events = [r["event"] for r in records if r["kind"] == "event"]
+        assert events == ["model_reloaded", "fault_injected"]
+        # uninstalled: the bus lane is dead
+        bus.post("fault_injected", site="serving.score", op=2)
+        assert len(_dumps(tmp_path)) == 1
+
+    def test_supervisor_stall_event_triggers_a_dump(self, tmp_path):
+        bus = EventBus()
+        rec = FlightRecorder(str(tmp_path), capacity=16, cooldown_s=0.0)
+        rec.install(bus=bus)
+        try:
+            bus.post("supervisor_fault_detected", worker=0, reason="exit")
+            assert _dumps(tmp_path) == []  # only the stall reason dumps
+            bus.post("supervisor_fault_detected", worker=0,
+                     reason="stall")
+        finally:
+            rec.close()
+        dumps = _dumps(tmp_path)
+        assert len(dumps) == 1
+        header = json.loads(
+            open(os.path.join(tmp_path, dumps[0])).readline())
+        assert header["reason"] == "watchdog_stall"
+
+    def test_unhandled_thread_exception_triggers_a_dump(self, tmp_path):
+        rec = FlightRecorder(str(tmp_path), capacity=16, cooldown_s=0.0)
+        quiet = lambda args: None  # noqa: E731 — swallow the chained print
+        prev = threading.excepthook
+        threading.excepthook = quiet
+        try:
+            rec.install_excepthook()
+
+            def boom():
+                raise RuntimeError("boom in worker")
+
+            t = threading.Thread(target=boom, name="crasher")
+            t.start()
+            t.join()
+        finally:
+            rec.uninstall_hooks()
+            threading.excepthook = prev
+        dumps = _dumps(tmp_path)
+        assert len(dumps) == 1
+        header, *records = [json.loads(line) for line in
+                            open(os.path.join(tmp_path, dumps[0]))]
+        assert header["reason"] == "unhandled_exception"
+        notes = [r for r in records if r["kind"] == "note"]
+        assert notes and notes[-1]["note"] == "unhandled_exception"
+        assert "boom in worker" in notes[-1]["fields"]["error"]
+        assert notes[-1]["fields"]["thread"] == "crasher"
+        assert "RuntimeError" in notes[-1]["fields"]["trace"]
+
+    def test_sys_excepthook_chains_to_the_previous_hook(self, tmp_path):
+        rec = FlightRecorder(str(tmp_path), capacity=16, cooldown_s=0.0)
+        chained = []
+        prev = sys.excepthook
+        sys.excepthook = lambda *args: chained.append(args)
+        try:
+            rec.install_excepthook()
+            err = RuntimeError("main thread crash")
+            sys.excepthook(RuntimeError, err, None)
+        finally:
+            rec.uninstall_hooks()
+            sys.excepthook = prev
+        assert len(chained) == 1 and chained[0][1] is err
+        dumps = _dumps(tmp_path)
+        assert len(dumps) == 1
+        header = json.loads(
+            open(os.path.join(tmp_path, dumps[0])).readline())
+        assert header["reason"] == "unhandled_exception"
+
+    def test_sigterm_dumps_then_chains(self, tmp_path):
+        rec = FlightRecorder(str(tmp_path), capacity=16, cooldown_s=0.0)
+        rec.note("reshard_started", request_id="r-term")
+        got = []
+        prev = signal.signal(signal.SIGTERM,
+                             lambda signum, frame: got.append(signum))
+        try:
+            assert rec.install_sigterm()
+            os.kill(os.getpid(), signal.SIGTERM)
+            deadline = time.monotonic() + 10.0
+            while not got and time.monotonic() < deadline:
+                time.sleep(0.01)
+        finally:
+            rec.uninstall_hooks()
+            signal.signal(signal.SIGTERM, prev)
+        assert got == [signal.SIGTERM]  # the previous handler still ran
+        dumps = _dumps(tmp_path)
+        assert len(dumps) == 1
+        header = json.loads(
+            open(os.path.join(tmp_path, dumps[0])).readline())
+        assert header["reason"] == "sigterm"
+
+    def test_sigterm_install_off_main_thread_is_refused(self, tmp_path):
+        rec = FlightRecorder(str(tmp_path), capacity=4)
+        out = []
+        t = threading.Thread(
+            target=lambda: out.append(rec.install_sigterm()))
+        t.start()
+        t.join()
+        assert out == [False]
+
+    def test_history_and_log_lanes(self, tmp_path):
+        sampler = HistorySampler(registry=MetricsRegistry(), capacity=4)
+        logger = logging.getLogger("photon_test_flight")
+        logger.setLevel(logging.INFO)
+        rec = FlightRecorder(str(tmp_path), capacity=8)
+        rec.install(sampler=sampler, logger=logger)
+        try:
+            sampler.sample(now=1.0)
+            logger.warning("disk almost full")
+        finally:
+            rec.close()
+        kinds = {r["kind"]: r for r in rec.records()}
+        assert kinds["history"]["tick"] == 1
+        assert set(kinds["history"]["series"]) == set(HISTORY_SERIES)
+        assert kinds["log"]["level"] == "WARNING"
+        assert "disk almost full" in kinds["log"]["line"]
+        # closed: the lanes are detached
+        logger.warning("after close")
+        assert rec.seq == 2
+
+
+# ---------------------------------------------------------------------------
+# the watchdog (in-process stall trigger)
+# ---------------------------------------------------------------------------
+
+
+class TestWatchdog:
+    def test_stall_dump_is_edge_triggered_and_rearms_on_pet(
+            self, tmp_path):
+        rec = FlightRecorder(str(tmp_path), capacity=16, cooldown_s=0.0)
+        wd = Watchdog(rec, timeout_s=10.0)
+        wd.pet(now=0.0)
+        assert wd.check(now=5.0) is None  # fresh
+        first = wd.check(now=10.0)
+        assert first is not None  # stalled: one dump
+        assert wd.check(now=11.0) is None  # latched: no repeat
+        wd.pet(now=12.0)  # progress resumed: re-arm
+        second = wd.check(now=30.0)
+        assert second is not None and second != first
+        header = json.loads(open(second).readline())
+        assert header["reason"] == "watchdog_stall"
+        notes = [r for r in rec.records() if r["kind"] == "note"]
+        assert notes[0]["note"] == "watchdog_stall"
+        assert notes[0]["fields"]["pet_age_s"] == pytest.approx(10.0)
+
+    def test_timeout_validation(self, tmp_path):
+        rec = FlightRecorder(str(tmp_path), capacity=4)
+        with pytest.raises(ValueError):
+            Watchdog(rec, timeout_s=0.0)
+
+
+# ---------------------------------------------------------------------------
+# the tracer tap under concurrent writers
+# ---------------------------------------------------------------------------
+
+
+class TestTracerTap:
+    def test_ring_fills_from_concurrent_spans_without_a_file_sink(
+            self, tmp_path):
+        tracer = tracing.Tracer()
+        rec = FlightRecorder(str(tmp_path), capacity=4096)
+        remove = rec.install(tracer=tracer)
+        n_threads, per = 8, 25
+        errors = []
+
+        def worker(i):
+            try:
+                for j in range(per):
+                    with tracer.span("fleet.request",
+                                     request_id=f"r{i}-{j}") as sp:
+                        with tracer.span("fleet.score"):
+                            pass
+                        parent = sp.span_id
+                    # a pool-thread leg with an explicit parent
+                    with tracer.span_under(parent, "fleet.leg",
+                                           kind="primary"):
+                        pass
+                    tracer.record_span("host.execute", seconds=0.001,
+                                       parent_id=parent)
+            except Exception as e:  # pragma: no cover - fail loudly
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert errors == []
+        expected = n_threads * per * 4
+        records = rec.records()
+        assert rec.seq == expected
+        assert len(records) == expected
+        assert all(r["kind"] == "span" for r in records)
+        # contiguous under concurrency: the lock hands out every seq once
+        assert [r["seq"] for r in records] == list(range(1, expected + 1))
+        rids = {r["record"].get("request_id") for r in records
+                if r["record"]["name"] == "fleet.request"}
+        assert rids == {f"r{i}-{j}" for i in range(n_threads)
+                        for j in range(per)}
+        # every span closed: a dump taken now shows no work in flight
+        path = rec.dump("manual", force=True)
+        assert json.loads(open(path).readline())["active_span_ids"] == []
+        remove()
+        with tracer.span("fleet.request"):
+            pass
+        assert rec.seq == expected  # the tap is gone
+
+    def test_open_spans_are_named_in_the_dump_header(self, tmp_path):
+        tracer = tracing.Tracer()
+        rec = FlightRecorder(str(tmp_path), capacity=64)
+        rec.install(tracer=tracer)
+        cm = tracer.span("serving.request", request_id="r-open")
+        sp = cm.__enter__()
+        try:
+            path = rec.dump("manual", force=True)
+        finally:
+            cm.__exit__(None, None, None)
+        header = json.loads(open(path).readline())
+        assert header["active_span_ids"] == [sp.span_id]
+
+
+# ---------------------------------------------------------------------------
+# the hot-shard advisor
+# ---------------------------------------------------------------------------
+
+
+class _SynthHistory:
+    """A driven stand-in for HistorySampler: tests append snapshots."""
+
+    def __init__(self):
+        self.snaps = []
+
+    def feed(self, tick, p99_by_shard, load_by_shard=None):
+        self.snaps.append({
+            "tick": tick, "ts": float(tick),
+            "series": {"shard_p99": dict(p99_by_shard),
+                       "shard_load": dict(load_by_shard or {})}})
+
+    def snapshots(self, window=0):
+        return self.snaps[-window:] if window else list(self.snaps)
+
+
+def _advisor(history, **kw):
+    kw.setdefault("shard_map_fn", lambda: ShardMap.default(2))
+    return HotShardAdvisor(history=history, **kw)
+
+
+class TestHotShardAdvisor:
+    def _run_ratio(self, advisor, history, tick, ratio):
+        """One tick where shard 0's p99 is ``ratio`` x shard 1's."""
+        history.feed(tick, {"0": 0.010 * ratio, "1": 0.010})
+        return advisor.tick()
+
+    def test_detects_in_exactly_sustain_ticks(self):
+        history = _SynthHistory()
+        advisor = _advisor(history)
+        for t in (1, 2):
+            assert self._run_ratio(advisor, history, t, 3.0) == []
+        detections = self._run_ratio(advisor, history, 3, 3.0)
+        assert len(detections) == 1
+        det = detections[0]
+        assert det["shard"] == 0
+        assert det["history_tick"] == 3
+        assert det["sustained_ticks"] == advisor.sustain_ticks == 3
+        assert det["skew"] == pytest.approx(3.0)
+        status = advisor.status()
+        assert status["hot"] == [0]
+        assert status["detections"] == 1
+
+    def test_reticking_the_same_snapshot_adds_no_evidence(self):
+        history = _SynthHistory()
+        advisor = _advisor(history)
+        history.feed(1, {"0": 0.030, "1": 0.010})
+        advisor.tick()
+        for _ in range(10):  # listener + poll loop double-wiring
+            assert advisor.tick() == []
+        assert advisor.status()["ticks"] == 1
+        assert advisor.status()["hot"] == []
+
+    def test_zero_flaps_inside_the_hysteresis_band(self):
+        bus = EventBus()
+        events = []
+        bus.subscribe(lambda e: events.append(e.name)
+                      if e.name.startswith("hot_shard") else None)
+        history = _SynthHistory()
+        advisor = _advisor(history, bus=bus)
+        tick = 0
+        for _ in range(3):  # latch
+            tick += 1
+            self._run_ratio(advisor, history, tick, 3.0)
+        assert events == ["hot_shard_detected"]
+        # oscillate strictly INSIDE (exit_ratio, enter_ratio): neither
+        # counter can sustain, so the latch must not move
+        for i in range(10):
+            tick += 1
+            self._run_ratio(advisor, history, tick,
+                            1.3 if i % 2 else 1.9)
+        assert events == ["hot_shard_detected"]
+        assert advisor.status()["hot"] == [0]
+        # sustained cool clears exactly once
+        for _ in range(3):
+            tick += 1
+            self._run_ratio(advisor, history, tick, 1.0)
+        assert events == ["hot_shard_detected", "hot_shard_cleared"]
+        assert advisor.status()["hot"] == []
+
+    def test_gauge_follows_the_latch(self):
+        history = _SynthHistory()
+        advisor = _advisor(history)
+        tick = 0
+        for _ in range(3):
+            tick += 1
+            self._run_ratio(advisor, history, tick, 3.0)
+        assert series_value(parse_text(render()), "photon_hot_shard",
+                            {"shard": "0"}) == 1.0
+        for _ in range(3):
+            tick += 1
+            self._run_ratio(advisor, history, tick, 1.0)
+        assert series_value(parse_text(render()), "photon_hot_shard",
+                            {"shard": "0"}) == 0.0
+
+    def test_load_skew_alone_can_latch(self):
+        history = _SynthHistory()
+        advisor = _advisor(history)
+        for t in (1, 2, 3):
+            # identical p99s; shard 0 holds 9x the in-flight legs
+            history.feed(t, {"0": 0.010, "1": 0.010},
+                         {"0": 9.0, "1": 0.0})
+            got = advisor.tick()
+        assert [d["shard"] for d in got] == [0]
+        assert got[0]["load_ratio"] == pytest.approx(10.0)
+
+    def test_skew_needs_at_least_two_shards(self):
+        history = _SynthHistory()
+        advisor = _advisor(history)
+        for t in (1, 2, 3, 4):
+            history.feed(t, {"0": 0.500})
+            assert advisor.tick() == []
+        assert advisor.status()["hot"] == []
+        assert advisor.recommendation() is None
+
+    def test_recommendation_is_the_minimal_move_scale_out(self):
+        history = _SynthHistory()
+        smap = ShardMap.default(2)
+        advisor = _advisor(history, shard_map_fn=lambda: smap)
+        assert advisor.recommendation() is None  # cool fleet: no advice
+        tick = 0
+        for _ in range(3):
+            tick += 1
+            self._run_ratio(advisor, history, tick, 3.0)
+        rec = advisor.recommendation()
+        assert rec["kind"] == "scale_out"
+        assert rec["n_shards"] == 3
+        assert rec["base_version"] == smap.version
+        assert rec["base_hash"] == smap.map_hash
+        assert rec["n_moves"] == len(rec["moves"])
+        assert rec["moves_from_hot"] >= 1
+        target = smap.rebalanced(3)
+        for bucket, shard in rec["moves"].items():
+            assert target.buckets[int(bucket)] == shard
+            assert smap.buckets[int(bucket)] != shard
+        status = advisor.status()
+        assert status["recommendation"]["n_moves"] == rec["n_moves"]
+
+    def test_hysteresis_parameter_validation(self):
+        history = _SynthHistory()
+        with pytest.raises(ValueError, match="hysteresis"):
+            _advisor(history, enter_ratio=2.0, exit_ratio=2.0)
+        with pytest.raises(ValueError, match="sustain_ticks"):
+            _advisor(history, sustain_ticks=0)
+
+
+# ---------------------------------------------------------------------------
+# the postmortem page (byte-deterministic golden)
+# ---------------------------------------------------------------------------
+
+POSTMORTEM_CONTEXT = {
+    "status": "ok",
+    "version": 3,
+    "model_lineage_id": "lin-a1b2",
+    "parentModel": "lin-root",
+    "shard_map": {"version": 2, "hash": "cafebabe12345678", "nShards": 2},
+}
+
+EXPECTED_POSTMORTEM = """\
+== photon flight postmortem ==
+reason: manual; source: host; dumped at ts 1.500
+ring: 5/8 record(s) retained of 5 written
+
+-- context at dump --
+shard map: v2 cafebabe1234 (2 shard(s))
+model: version 3 lineage lin-a1b2 (parent lin-root)
+status: ok
+
+-- timeline (last 4 of 4 entries) --
+#1 note reshard_started request_id=r-1
+#2 event slo_burn_alert burn_rate=7.2 window=5m
+#3 history tick=4 requests=24 shed_rate=0.25 shard_p99[max]=s0:0.012
+#4 log [WARNING] queue saturated
+
+-- last requests (last 1 of 1 spans carrying a request id) --
+#5 serving.score request_id=r-9 12.500ms shard=0
+
+-- spans open at dump (0) --
+(none)
+
+-- SLO burn activity (1 event(s) retained) --
+#2 slo_burn_alert window=5m burn_rate=7.2
+"""
+
+
+class TestPostmortem:
+    def _dump(self, tmp_path):
+        rec = FlightRecorder(str(tmp_path), capacity=8, source="host",
+                             context_fn=lambda: POSTMORTEM_CONTEXT)
+        rec.note("reshard_started", request_id="r-1")
+        rec.record_event("slo_burn_alert",
+                         {"window": "5m", "burn_rate": 7.2}, ts=10.0)
+        rec.record_history({"tick": 4, "ts": 11.0,
+                            "series": {"requests": 24.0,
+                                       "shed_rate": 0.25,
+                                       "shard_p99": {"0": 0.012,
+                                                     "1": 0.004}}})
+        rec.record_log("queue saturated", level="WARNING")
+        rec.record_span({"name": "serving.score", "span_id": 5,
+                         "parent_id": 1, "request_id": "r-9",
+                         "seconds": 0.0125, "shard": "0"})
+        return rec.dump("manual", ts=1.5)
+
+    def test_report_is_a_byte_deterministic_golden(self, tmp_path):
+        import postmortem
+
+        path = self._dump(tmp_path)
+        header, records = postmortem.load_dump(path)
+        report = postmortem.build_report(header, records)
+        assert report == EXPECTED_POSTMORTEM
+        # pure function of the dump's bytes: render twice, same bytes
+        assert report == postmortem.build_report(
+            *postmortem.load_dump(path))
+
+    def test_cli_prints_the_report(self, tmp_path, capsys):
+        import postmortem
+
+        path = self._dump(tmp_path)
+        assert postmortem.main([path]) == 0
+        assert capsys.readouterr().out == EXPECTED_POSTMORTEM
+
+    def test_loader_rejects_a_headerless_file(self, tmp_path):
+        import postmortem
+
+        bogus = tmp_path / "not-a-flight.jsonl"
+        bogus.write_text(json.dumps({"kind": "note"}) + "\n")
+        with pytest.raises(ValueError, match="flight_header"):
+            postmortem.load_dump(str(bogus))
+        assert postmortem.main([str(bogus)]) == 1

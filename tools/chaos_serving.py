@@ -138,7 +138,14 @@ def run_fleet_chaos(args) -> int:
       bit-identically to the R=1 one; one replica stopped mid-load —
       ZERO client-visible errors (the surviving replica absorbs every
       leg, ``photon_fleet_replica_retries_total`` moves), probes
-      bit-identical, every surviving batcher worker alive.
+      bit-identical, every surviving batcher worker alive;
+    - **flight-dump**: a retained-plane fleet (``--flight-dir`` +
+      ``--history-period-s``) with one host killed mid-load while a
+      seeded ``fleet.fanout`` fault trips the fault-site trigger — the
+      black box must publish a COMPLETE parseable dump atomically (no
+      ``.tmp`` survivor), and ``tools/postmortem.py`` must render it
+      byte-deterministically, reconstructing the final shard-map
+      generation, model lineage and last admitted request ids.
     """
     import threading
 
@@ -601,6 +608,120 @@ def run_fleet_chaos(args) -> int:
             fleet2.stop()
             set_default_policy(prev_policy)
 
+        # --- cell 7: black box survives a mid-load host kill -------------
+        # a retained-plane fleet under traffic, one host killed mid-load
+        # while a seeded fleet.fanout fault trips the fault-site dump
+        # trigger: the flight dump must publish ATOMICALLY (complete
+        # parseable JSONL, no .tmp), and the postmortem page must be
+        # byte-deterministic AND reconstruct the fleet's final shard-map
+        # generation, model lineage and last admitted request ids
+        import postmortem
+
+        cell = {"cell": "flight-dump"}
+        flight_dir = os.path.join(tmp, "flight")
+        fleet3 = serve_fleet.build_fleet([
+            "--model-dir", model_dir,
+            "--feature-shards", chaos_sweep.SHARDS,
+            "--port", "0", "--fleet-shards", "2",
+            "--microbatch", "8", "--max-wait-ms", "1",
+            "--max-queue", str(args.max_queue),
+            "--history-period-s", "0.1", "--history-capacity", "64",
+            "--flight-dir", flight_dir,
+        ])
+        base3 = fleet3.url
+        try:
+            bench_serving.wait_ready(base3)
+            problems = []
+            statusz = bench_serving._http_json(base3 + "/statusz")
+            map_version = statusz["shard_map"]["version"]
+            lineages = [str(h.get("lineage")) for h in statusz["hosts"]
+                        if h.get("lineage")]
+            victim = fleet3.hosts[1]
+            killer = threading.Timer(
+                0.25 * requests / args.target_qps, victim.stop)
+            killer.start()
+            with injected(FaultPlan.from_json(
+                    {"seed": 0, "specs": [{"site": "fleet.fanout",
+                                           "rate": 0.05}]})):
+                run = bench_serving.mixed_open_loop_run(
+                    base3, pool, users, [1],
+                    target_qps=args.target_qps, requests=requests,
+                    rank_every=0)
+            killer.join()
+            # losing a shard mid-load makes errors legitimate — the
+            # accounting identity is the claim here, not the rate
+            problems += check_books(cell, run, 1.0)
+            # the in-flight trigger dump (the FIRST fault_injected, which
+            # can land before any span closed) proves the trigger class;
+            # the ring keeps filling afterwards, so the postmortem's
+            # request-reconstruction claims run against a final forced
+            # dump of the full ring
+            final_path = fleet3.flight.dump("manual", force=True)
+            entries = sorted(os.listdir(flight_dir)) \
+                if os.path.isdir(flight_dir) else []
+            dumps = [e for e in entries if e.endswith(".jsonl")]
+            if any(e.endswith(".tmp") for e in entries):
+                problems.append("a .tmp sibling survived — the dump "
+                                "publish is not atomic")
+            header: dict = {}
+            trigger = [e for e in dumps
+                       if e != os.path.basename(final_path)]
+            if not trigger:
+                problems.append("no flight dump published (fault-site "
+                                "trigger never tripped?)")
+            else:
+                path = os.path.join(flight_dir, trigger[0])
+                with open(path, encoding="utf-8") as f:
+                    raw_lines = [ln for ln in f.read().splitlines() if ln]
+                try:
+                    parsed_lines = [json.loads(ln) for ln in raw_lines]
+                except json.JSONDecodeError as e:
+                    parsed_lines = []
+                    problems.append(f"dump line unparseable: {e!r}")
+                if parsed_lines:
+                    header = parsed_lines[0]
+                    if header.get("kind") != "flight_header":
+                        problems.append("dump does not lead with the "
+                                        "flight_header line")
+                    if header.get("reason") != "fault_site":
+                        problems.append(f"dump reason "
+                                        f"{header.get('reason')!r}, want "
+                                        f"fault_site")
+            hdr, records = postmortem.load_dump(final_path)
+            report = postmortem.build_report(hdr, records)
+            if report != postmortem.build_report(
+                    *postmortem.load_dump(final_path)):
+                problems.append("postmortem is not byte-deterministic")
+            if f"shard map: v{map_version}" not in report:
+                problems.append("postmortem lost the final shard-map "
+                                "generation")
+            if lineages and not any(x in report for x in lineages):
+                problems.append("postmortem lost the model lineage")
+            rids = [r["record"]["request_id"] for r in records
+                    if r.get("kind") == "span"
+                    and "request_id" in (r.get("record") or {})]
+            if not rids:
+                problems.append("no request-id spans retained in "
+                                "the black box")
+            missing = [rid for rid in rids[-5:]
+                       if f"request_id={rid}" not in report]
+            if missing:
+                problems.append(f"postmortem lost admitted "
+                                f"request(s) {missing}")
+            cell.update(retained=len(records), request_ids=len(rids),
+                        dumps=len(dumps),
+                        reason=header.get("reason"), ok=not problems)
+            cells.append(cell)
+            print(f"[chaos-serving] fleet flight-dump: "
+                  f"dumps={len(dumps)} reason={header.get('reason')} "
+                  f"{'ok' if cell['ok'] else 'FAIL'}", flush=True)
+            if problems:
+                failures.append("fleet flight-dump: "
+                                + "; ".join(problems))
+        finally:
+            fleet3.stop()
+            set_default_policy(prev_policy)
+
         artifact = {"budget": args.budget, "fleet": True,
                     "cells": cells, "failures": failures}
         out_path = args.output or os.path.join(tmp, "chaos_serving.json")
@@ -882,10 +1003,14 @@ def main(argv=None) -> int:
                         "kill + restart, a faulted two-phase reload, a "
                         "hot-shard storm (cold shard unharmed), a live "
                         "reshard under traffic (O(moved) repack, no "
-                        "mixed-map response), and a replica kill on an "
-                        "R=2 fleet (zero client-visible errors) — "
-                        "accounting identity per kind, probe scores "
-                        "bit-identical fleet-wide")
+                        "mixed-map response), a replica kill on an "
+                        "R=2 fleet (zero client-visible errors), and a "
+                        "flight-recorder cell (host killed mid-load "
+                        "must leave a complete atomic black-box dump "
+                        "whose postmortem reconstructs the final "
+                        "epoch + request ids) — accounting identity "
+                        "per kind, probe scores bit-identical "
+                        "fleet-wide")
     p.add_argument("--loop", action="store_true",
                    help="run the FRESHNESS-LOOP cells instead: a 2-shard "
                         "fleet with a FeedbackAutopilot + router "

@@ -15,7 +15,11 @@ from the same kinds of artifacts:
   the fan-out section is skipped without it). Hedges and replica
   retries appear as sibling ``fleet.leg`` spans under one
   ``fleet.request`` tree, so the per-kind tallies here are countable
-  straight off the records.
+  straight off the records;
+- ``history.json`` — a saved fleet ``GET /history`` body (optional:
+  the timeline section is skipped without it);
+- ``advisor.json`` — a saved ``GET /advisor`` body (optional: the
+  hot-shard section is skipped without it).
 
 The report is a pure function of its inputs (no clocks, no environment
 reads) — the golden test feeds fixture artifacts and compares bytes.
@@ -104,8 +108,40 @@ def leg_tallies(spans: Sequence[Mapping]) -> Optional[dict]:
             "host_stages": host_stages}
 
 
+#: timeline ticks rendered — the history ring holds more; the page
+#: shows the recent trend an operator reads before pulling raw JSON
+TIMELINE_TAIL = 12
+
+
+def timeline_rows(history: Mapping) -> list[str]:
+    """One line per retained history tick (newest last): the fleet-level
+    derived series worth a glance, plus the hottest shard p99."""
+    rows = []
+    for snap in history.get("snapshots", ()):
+        series = snap.get("series") or {}
+        bits = [f"t{snap.get('tick')}"]
+        for key in ("requests", "shed_rate", "hedge_rate", "latency_p50",
+                    "latency_p99", "queue_depth", "slo_burn"):
+            value = series.get(key)
+            if value is None:
+                continue
+            if isinstance(value, float):
+                bits.append(f"{key}={value:.4g}")
+            else:
+                bits.append(f"{key}={value}")
+        shard_p99 = series.get("shard_p99") or {}
+        if shard_p99:
+            hot = max(shard_p99.items(),
+                      key=lambda kv: (kv[1], str(kv[0])))
+            bits.append(f"hottest=s{hot[0]}:{hot[1] * 1e3:.3f}ms")
+        rows.append(" ".join(bits))
+    return rows
+
+
 def build_report(prom_text: str, statusz: Optional[Mapping] = None,
-                 spans: Sequence[Mapping] = ()) -> str:
+                 spans: Sequence[Mapping] = (),
+                 history: Optional[Mapping] = None,
+                 advisor: Optional[Mapping] = None) -> str:
     """The report text (the CLI prints it; tests golden-compare it)."""
     parsed = tprom.parse_text(prom_text)
     lines: list[str] = ["== photon fleet report =="]
@@ -198,6 +234,47 @@ def build_report(prom_text: str, statusz: Optional[Mapping] = None,
                     f"{w.get('burn_rate')} (threshold "
                     f"{w.get('threshold')}) — {state}, "
                     f"{w.get('bad')}/{w.get('total')} bad")
+
+    # --- fleet timeline (retained history) ---------------------------------
+    if history is not None:
+        rows = timeline_rows(history)
+        lines.append("")
+        lines.append(
+            f"-- fleet timeline (last {min(len(rows), TIMELINE_TAIL)} "
+            f"of {len(rows)} retained tick(s), source "
+            f"{history.get('source')}) --")
+        lines.extend(rows[-TIMELINE_TAIL:] or ["(no snapshots retained)"])
+
+    # --- hot-shard advisor -------------------------------------------------
+    if advisor is not None:
+        lines.append("")
+        lines.append("-- hot-shard advisor --")
+        params = advisor.get("params") or {}
+        hot = advisor.get("hot") or []
+        lines.append(
+            f"hot: {' '.join(f's{s}' for s in hot) or '(none)'}; "
+            f"{advisor.get('detections', 0)} detection(s) over "
+            f"{advisor.get('ticks', 0)} tick(s) "
+            f"(enter {params.get('enter_ratio')}x, exit "
+            f"{params.get('exit_ratio')}x, sustain "
+            f"{params.get('sustain_ticks')})")
+        shards = advisor.get("shards") or {}
+        for s in sorted(shards, key=lambda k: (len(k), k)):
+            ev = shards[s]
+            lines.append(
+                f"  s{s}: skew {ev.get('skew')}x (p99 "
+                f"{ev.get('p99_s', 0.0) * 1e3:.3f}ms ratio "
+                f"{ev.get('p99_ratio')}; load {ev.get('load')} ratio "
+                f"{ev.get('load_ratio')})")
+        rec = advisor.get("recommendation")
+        if rec is not None:
+            lines.append(
+                f"advice: {rec.get('kind')} to {rec.get('n_shards')} "
+                f"shard(s) — {rec.get('n_moves')} bucket move(s), "
+                f"{rec.get('moves_from_hot')} off hot shard(s), from "
+                f"map v{rec.get('base_version')}")
+        else:
+            lines.append("advice: none (fleet is cool)")
     return "\n".join(lines) + "\n"
 
 
@@ -244,7 +321,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         if os.path.exists(trace_path):
             spans = load_spans(trace_path)
             break
-    sys.stdout.write(build_report(prom_text, statusz, spans))
+    history = None
+    history_path = os.path.join(args.run_dir, "history.json")
+    if os.path.exists(history_path):
+        with open(history_path, encoding="utf-8") as f:
+            history = json.load(f)
+    advisor = None
+    advisor_path = os.path.join(args.run_dir, "advisor.json")
+    if os.path.exists(advisor_path):
+        with open(advisor_path, encoding="utf-8") as f:
+            advisor = json.load(f)
+    sys.stdout.write(build_report(prom_text, statusz, spans,
+                                  history=history, advisor=advisor))
     return 0
 
 

@@ -1,0 +1,254 @@
+#!/usr/bin/env python
+"""Incident report from a black-box flight dump.
+
+``telemetry/flightrec.py`` publishes ``flight-<ts>.jsonl`` when a
+process trips a fault site, hits an unhandled exception, receives
+SIGTERM or stalls its watchdog. This tool turns one dump into the page
+an on-call reads first: what tripped, what the process looked like
+(shard map generation, model lineage, SLO burn state), the retained
+timeline of events and history ticks, the last admitted requests and
+the spans still open at dump time.
+
+The report is a pure function of the dump's bytes — no clocks, no
+environment reads — so rendering the same dump twice yields identical
+bytes (the golden test and the chaos harness both rely on that).
+
+Usage::
+
+    python tools/postmortem.py FLIGHT.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Mapping, Optional, Sequence, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: how much tail to render per section — the ring holds more; the page
+#: shows what an operator reads before opening the raw dump
+TIMELINE_TAIL = 40
+REQUESTS_TAIL = 20
+
+
+def load_dump(path: str) -> Tuple[dict, list]:
+    """Parse a flight dump into (header, records). Every line must be
+    complete JSON — the writer's tmp + ``os.replace`` guarantees it."""
+    header: Optional[dict] = None
+    records: list = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            if rec.get("kind") == "flight_header":
+                header = rec
+            else:
+                records.append(rec)
+    if header is None:
+        raise ValueError(f"{path} has no flight_header line — not a "
+                         f"flight dump")
+    return header, records
+
+
+def _fmt_ts(ts) -> str:
+    if ts is None:
+        return "?"
+    return f"{float(ts):.3f}"
+
+
+def _series_digest(series: Mapping) -> str:
+    """One history tick on one line: the load-bearing scalars, then any
+    per-shard p99 skew worth a glance."""
+    bits = []
+    for key in ("requests", "shed_rate", "hedge_rate", "latency_p99",
+                "queue_depth", "slo_burn"):
+        value = series.get(key)
+        if value is None:
+            continue
+        if isinstance(value, float):
+            bits.append(f"{key}={value:.4g}")
+        else:
+            bits.append(f"{key}={value}")
+    shard_p99 = series.get("shard_p99")
+    if isinstance(shard_p99, Mapping) and shard_p99:
+        hot = max(shard_p99.items(), key=lambda kv: (kv[1], str(kv[0])))
+        bits.append(f"shard_p99[max]=s{hot[0]}:{hot[1]:.4g}")
+    return " ".join(bits) or "(no series)"
+
+
+def _context_lines(context: Mapping) -> list:
+    """Render the dump-time context block. A fleet dump carries the
+    router's statusz (shard map generation, per-host lineage); a host
+    dump carries healthz (active version + model lineage). Both shapes
+    are rendered; unknown shapes fall back to sorted JSON."""
+    lines = []
+    shard_map = context.get("shard_map")
+    if isinstance(shard_map, Mapping):
+        lines.append(
+            f"shard map: v{shard_map.get('version')} "
+            f"{str(shard_map.get('hash'))[:12]} "
+            f"({shard_map.get('nShards', shard_map.get('n_shards'))} "
+            f"shard(s))")
+    if "model_lineage_id" in context:  # host healthz
+        lines.append(
+            f"model: version {context.get('version')} lineage "
+            f"{context.get('model_lineage_id')} (parent "
+            f"{context.get('parentModel')})")
+    if "status" in context:
+        lines.append(f"status: {context['status']}")
+    for host in context.get("hosts", ()):
+        if not isinstance(host, Mapping):
+            continue
+        lines.append(
+            f"  s{host.get('shard')}r{host.get('replica')} "
+            f"{host.get('url')}: {host.get('status')}, lineage "
+            f"{host.get('lineage')}")
+    slo = context.get("slo")
+    if slo:
+        for w in slo:
+            state = "BURNING" if w.get("burning") else "ok"
+            lines.append(
+                f"  slo[{w.get('window')}]: burn {w.get('burn_rate')} "
+                f"(threshold {w.get('threshold')}) — {state}, "
+                f"{w.get('bad')}/{w.get('total')} bad")
+    if not lines:
+        lines.append(json.dumps(context, sort_keys=True, default=str))
+    return lines
+
+
+def _timeline_entry(rec: Mapping) -> Optional[str]:
+    kind = rec.get("kind")
+    seq = rec.get("seq")
+    if kind == "event":
+        payload = rec.get("payload") or {}
+        detail = " ".join(
+            f"{k}={payload[k]}" for k in sorted(payload)
+            if isinstance(payload[k], (str, int, float, bool,
+                                       type(None))))
+        return (f"#{seq} event {rec.get('event')}"
+                + (f" {detail}" if detail else ""))
+    if kind == "note":
+        fields = rec.get("fields") or {}
+        detail = " ".join(f"{k}={fields[k]}" for k in sorted(fields)
+                          if k != "trace")
+        return (f"#{seq} note {rec.get('note')}"
+                + (f" {detail}" if detail else ""))
+    if kind == "history":
+        return (f"#{seq} history tick={rec.get('tick')} "
+                + _series_digest(rec.get("series") or {}))
+    if kind == "log":
+        return (f"#{seq} log [{rec.get('level')}] "
+                f"{str(rec.get('line'))[:160]}")
+    return None  # spans get their own section
+
+
+def build_report(header: Mapping, records: Sequence[Mapping]) -> str:
+    """The incident page (the CLI prints it; tests golden-compare it)."""
+    lines = ["== photon flight postmortem =="]
+    lines.append(
+        f"reason: {header.get('reason')}; source: {header.get('source')}; "
+        f"dumped at ts {_fmt_ts(header.get('ts'))}")
+    lines.append(
+        f"ring: {header.get('retained')}/{header.get('capacity')} "
+        f"record(s) retained of {header.get('seq')} written")
+
+    # --- dump-time context -------------------------------------------------
+    lines.append("")
+    lines.append("-- context at dump --")
+    context = header.get("context")
+    if isinstance(context, Mapping):
+        lines.extend(_context_lines(context))
+    elif header.get("context_error"):
+        lines.append(f"context probe failed: {header['context_error']}")
+    else:
+        lines.append("(no context probe armed)")
+
+    # --- timeline ----------------------------------------------------------
+    entries = [e for e in (_timeline_entry(r) for r in records)
+               if e is not None]
+    lines.append("")
+    lines.append(f"-- timeline (last {min(len(entries), TIMELINE_TAIL)} "
+                 f"of {len(entries)} entries) --")
+    lines.extend(entries[-TIMELINE_TAIL:] or ["(empty)"])
+
+    # --- last requests -----------------------------------------------------
+    requests = []
+    for rec in records:
+        if rec.get("kind") != "span":
+            continue
+        span = rec.get("record") or {}
+        rid = span.get("request_id")
+        if rid is None:
+            continue
+        requests.append((rec.get("seq"), span))
+    lines.append("")
+    lines.append(
+        f"-- last requests (last {min(len(requests), REQUESTS_TAIL)} "
+        f"of {len(requests)} spans carrying a request id) --")
+    if requests:
+        for seq, span in requests[-REQUESTS_TAIL:]:
+            seconds = span.get("seconds")
+            took = ("?" if not isinstance(seconds, (int, float))
+                    else f"{seconds * 1e3:.3f}ms")
+            extras = " ".join(
+                f"{k}={span[k]}" for k in sorted(span)
+                if k not in ("name", "span_id", "parent_id", "ts", "t0",
+                             "t1", "seconds", "request_id")
+                and isinstance(span[k], (str, int, float, bool)))
+            lines.append(f"#{seq} {span.get('name')} "
+                         f"request_id={span.get('request_id')} {took}"
+                         + (f" {extras}" if extras else ""))
+    else:
+        lines.append("(none retained)")
+
+    # --- active spans ------------------------------------------------------
+    active = header.get("active_span_ids") or []
+    lines.append("")
+    lines.append(f"-- spans open at dump ({len(active)}) --")
+    if active:
+        lines.extend(str(s) for s in active)
+    else:
+        lines.append("(none)")
+
+    # --- SLO burn state ----------------------------------------------------
+    burns = [r for r in records
+             if r.get("kind") == "event"
+             and r.get("event") in ("slo_burn_started", "slo_burn_ended",
+                                    "slo_burn_alert")]
+    lines.append("")
+    lines.append(f"-- SLO burn activity ({len(burns)} event(s) "
+                 f"retained) --")
+    if burns:
+        for rec in burns:
+            payload = rec.get("payload") or {}
+            lines.append(
+                f"#{rec.get('seq')} {rec.get('event')} "
+                f"window={payload.get('window')} "
+                f"burn_rate={payload.get('burn_rate')}")
+    else:
+        lines.append("(no burn events in the retained window)")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        description="Render an incident report from a flight-recorder "
+                    "dump (flight-<ts>.jsonl)")
+    p.add_argument("dump", help="path to the flight dump")
+    args = p.parse_args(argv)
+    try:
+        header, records = load_dump(args.dump)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"cannot read flight dump: {e}", file=sys.stderr)
+        return 1
+    sys.stdout.write(build_report(header, records))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
