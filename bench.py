@@ -1360,13 +1360,20 @@ def bench_serving_slo():
             "--port", "0", "--max-wait-ms", "1",
         ]).start()
         try:
+            from photon_ml_tpu.telemetry.saturation import (
+                device_busy_seconds,
+            )
+
             pool = bench_serving._request_pool(
                 argparse.Namespace(data=None, pool=128), server)
             metrics0 = bench_serving._scrape_metrics(server.url)
+            busy0, wall0 = device_busy_seconds(), time.monotonic()
             run = bench_serving.open_loop_run(
                 server.url, pool, [1, 1, 1, 2, 4],
                 target_qps=SERVING_TARGET_QPS, requests=SERVING_REQUESTS,
                 concurrency=16)
+            busy1, wall1 = device_busy_seconds(), time.monotonic()
+            conn_peak = server.service.connections.stats()["peak"]
             metrics1 = bench_serving._scrape_metrics(server.url)
         finally:
             server.stop()
@@ -1382,6 +1389,12 @@ def bench_serving_slo():
         "slo_p99_ms": slo_ms,
         "slo_verdict": verdict["verdict"],
         "n_errors": len(run["errors"]),
+        # capacity-plane extras: device duty over the load window (the
+        # USE sampler's utilization source) and the connection high
+        # watermark — how close the box ran to its socket budget
+        "duty_cycle": round((busy1 - busy0)
+                            / max(wall1 - wall0, 1e-9), 4),
+        "conn_peak": conn_peak,
     }
     if metrics1 is not None:
         stages = bench_serving.stage_breakdown(metrics0, metrics1)
@@ -1471,14 +1484,24 @@ def bench_serving_fleet():
         try:
             pool = bench_serving.fleet_request_pool(
                 argparse.Namespace(data=None, pool=128), fleet)
+            from photon_ml_tpu.telemetry.saturation import (
+                device_busy_seconds,
+            )
+
             compiles0 = [bench_serving._http_json(u + "/healthz")["compiles"]
                          for u in fleet.host_urls()]
             folded0 = bench_serving._scrape_metrics(fleet.url)
             metrics0 = bench_serving._scrape_process_metrics()
+            busy0, wall0 = device_busy_seconds(), time.monotonic()
             run = bench_serving.open_loop_run(
                 fleet.url, pool, [1, 1, 1, 2, 4],
                 target_qps=FLEET_TARGET_QPS, requests=SERVING_REQUESTS,
                 concurrency=16)
+            busy1, wall1 = device_busy_seconds(), time.monotonic()
+            # high watermark across the in-process hosts' trackers —
+            # the fleet's closest approach to a per-host socket budget
+            conn_peak = max(h.service.connections.stats()["peak"]
+                            for h in fleet.hosts)
             compiles1 = [bench_serving._http_json(u + "/healthz")["compiles"]
                          for u in fleet.host_urls()]
             folded1 = bench_serving._scrape_metrics(fleet.url)
@@ -1610,6 +1633,9 @@ def bench_serving_fleet():
           history_p99_off_ms=round(sampler_p99_off, 3),
           history_p99_on_ms=round(sampler_p99_on, 3),
           advisor_detect_ticks=advisor_detect_ticks,
+          duty_cycle=round((busy1 - busy0)
+                           / max(wall1 - wall0, 1e-9), 4),
+          conn_peak=conn_peak,
           slo_p99_ms=slo_ms, slo_verdict=verdict["verdict"])
 
 

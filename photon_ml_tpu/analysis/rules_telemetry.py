@@ -507,6 +507,83 @@ def check_retained_vocab(ctx: FileContext):
                     f"payload data")
 
 
+#: the ONE connection-accounting home: socket-lifecycle metric families
+#: (``photon_connection*``) and the ConnectionTracker primitive live in
+#: serving/http.py; everything else observes connections through the
+#: tracker's stats()/utilization() or the capacity plane's probes
+CONN_HOME_FILE = os.path.join("photon_ml_tpu", "serving", "http.py")
+CONN_METRIC_PREFIX = "photon_connection"
+
+#: static twin of ``telemetry.saturation.RESOURCES`` — the closed
+#: USE-method resource vocabulary (a test asserts the copies agree, the
+#: same pattern as RETAINED_NAME_RE vs SERIES_NAME_RE)
+SATURATION_RESOURCES = frozenset({
+    "device", "batcher_queue", "rank_batcher_queue", "http_connections",
+    "handler_threads", "saver_pool", "router_pool", "hedge_pool",
+    "reqlog",
+})
+
+
+@rule("tel-conn-home",
+      "connection accounting lives in serving/http.py only; saturation "
+      "probes register closed-vocabulary resource names")
+def check_conn_home(ctx: FileContext):
+    """The capacity plane's contracts (ISSUE 20). Connection accounting
+    holds an identity (``accepted == closed + open``) that only survives
+    because ONE tracker under ONE lock mutates it — a second
+    ``photon_connection*`` family or a re-derived ConnectionTracker
+    forks the arithmetic away from ``/healthz`` and the fold. And the
+    USE-method gauges are keyed by resource name: ``add_probe`` with a
+    computed or out-of-vocabulary name opens the label set that
+    ``tools/capacity_report.py`` and the ``resource_util`` history
+    series group by."""
+    conn_banned = ctx.path != CONN_HOME_FILE
+    for node in ast.walk(ctx.tree):
+        if (conn_banned and isinstance(node, ast.ClassDef)
+                and node.name == "ConnectionTracker"):
+            yield ctx.finding(
+                "tel-conn-home", node,
+                "ConnectionTracker defined outside photon_ml_tpu/serving/"
+                "http.py — connection accounting has ONE home so the "
+                "accepted == closed + open identity holds under one "
+                "lock; import serving.http.ConnectionTracker instead")
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if (isinstance(func, ast.Attribute) and func.attr == "add_probe"
+                and node.args):
+            name_arg = node.args[0]
+            if not (isinstance(name_arg, ast.Constant)
+                    and isinstance(name_arg.value, str)):
+                yield ctx.finding(
+                    "tel-conn-home", node,
+                    "add_probe() resource name computed at runtime — "
+                    "the USE-method resource vocabulary is closed "
+                    "(telemetry.saturation.RESOURCES); pass one of its "
+                    "members as a literal")
+            elif name_arg.value not in SATURATION_RESOURCES:
+                yield ctx.finding(
+                    "tel-conn-home", node,
+                    f"add_probe() resource {name_arg.value!r} outside "
+                    f"the closed vocabulary (telemetry.saturation."
+                    f"RESOURCES) — capacity_report and the "
+                    f"resource_util history series group by these "
+                    f"names; additions are a reviewed vocabulary "
+                    f"change, not a call-site invention")
+    if conn_banned:
+        for node in _factory_calls(ctx):
+            name, _, _ = _metric_call_args(node)
+            if name is not None and name.startswith(CONN_METRIC_PREFIX):
+                yield ctx.finding(
+                    "tel-conn-home", node,
+                    f"connection metric {name!r} registered outside "
+                    f"photon_ml_tpu/serving/http.py — the socket-"
+                    f"lifecycle families have ONE writer (the "
+                    f"ConnectionTracker); a second family double-counts "
+                    f"connections in the fleet fold")
+
+
 #: the shim's rule subset, in the legacy tool's documented order
 #: (``tel-span-attr-cardinality`` and ``tel-retained-vocab`` are
 #: engine-only — they postdate the legacy tool)

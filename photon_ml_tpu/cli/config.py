@@ -515,6 +515,58 @@ def retained_from_args(args) -> RetainedConfig:
 
 
 # ---------------------------------------------------------------------------
+# Capacity configuration (serve_game and serve_fleet)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class CapacityConfig:
+    """The serving mains' capacity-plane knobs (OBSERVABILITY.md
+    "Saturation & capacity"), round-trippable through a JSON config file
+    like :class:`RetainedConfig`.
+
+    ``max_connections`` (0 = unlimited) is the connection budget: past
+    it, a new socket is answered with ONE typed 503
+    ``reason=connections`` + ``Connection: close`` and refused — the
+    accounting (and the refusal contract) the future event-loop front
+    end must preserve. The saturation sampler itself is always armed on
+    a serving host (USE gauges ride the history ring's tick; there is
+    nothing to configure).
+    """
+
+    max_connections: int = 0
+
+    def __post_init__(self):
+        if self.max_connections < 0:
+            raise ValueError(f"max_connections must be >= 0, "
+                             f"got {self.max_connections}")
+
+    # --- config-file round-trip ------------------------------------------
+    def as_dict(self) -> dict:
+        return {"maxConnections": self.max_connections}
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "CapacityConfig":
+        return cls(max_connections=int(d.get("maxConnections", 0)))
+
+
+def add_capacity_flags(parser) -> None:
+    """The capacity-plane flags (serve_game, serve_fleet)."""
+    parser.add_argument(
+        "--max-connections", type=int, default=0, metavar="N",
+        help="connection budget per serving host (0 = unlimited): a "
+             "socket past the ceiling gets one typed 503 "
+             "reason=connections with Connection: close — counted in "
+             "photon_connections_refused_total, surfaced by /readyz as "
+             "connections_exhausted, feeding the brownout ladder — "
+             "never a hang (SERVING.md 'Connection budget')")
+
+
+def capacity_from_args(args) -> CapacityConfig:
+    return CapacityConfig(max_connections=args.max_connections)
+
+
+# ---------------------------------------------------------------------------
 # Model-quality configuration (serve_game; baseline knobs on the trainers)
 # ---------------------------------------------------------------------------
 

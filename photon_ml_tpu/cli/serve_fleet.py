@@ -98,11 +98,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "aborts with the incumbent serving fleet-wide")
     p.add_argument("--router-watch-poll-s", type=float, default=10.0)
     from photon_ml_tpu.cli.config import (
+        add_capacity_flags,
         add_retained_flags,
         add_router_flags,
         add_telemetry_flags,
     )
 
+    add_capacity_flags(p)
     add_retained_flags(p)
     add_router_flags(p)
     add_telemetry_flags(p)
@@ -120,6 +122,7 @@ class FleetHandle:
         self.watcher = None  # FleetPatchWatcher (--router-watch-dir)
         self.autopilot = None  # FeedbackAutopilot (--autopilot-config)
         self.history = None  # router-side HistorySampler
+        self.saturation = None  # router-side SaturationSampler
         self.advisor = None  # HotShardAdvisor (GET /advisor)
         self.flight = None  # FlightRecorder (--flight-dir)
         self.watchdog = None  # flight Watchdog (--watchdog-timeout-s)
@@ -186,6 +189,10 @@ def build_fleet(argv: Optional[Sequence[str]] = None) -> FleetHandle:
         "--microbatch", str(args.microbatch),
         "--max-wait-ms", str(args.max_wait_ms),
         "--max-queue", str(args.max_queue),
+        # the connection budget is per-host (each host guards its own
+        # socket table); the router's refusal handling maps the typed
+        # 503 reason=connections into its upstream error accounting
+        "--max-connections", str(args.max_connections),
         # brownout state is process-global; N in-process hosts sharing it
         # would shed each other's work — controllers stay off in the
         # single-process topology (a distributed fleet keeps them on)
@@ -261,9 +268,28 @@ def build_fleet(argv: Optional[Sequence[str]] = None) -> FleetHandle:
         from photon_ml_tpu.telemetry.tracing import GLOBAL_TRACER
 
         retained = retained_from_args(args)
+        # router-tier capacity plane: the two fan-out executors are the
+        # router's own saturable resources (the hosts probe their own)
+        from photon_ml_tpu.telemetry.saturation import (
+            SaturationSampler,
+            executor_probe,
+        )
+
+        router_saturation = SaturationSampler()
+        router_saturation.add_probe(
+            "router_pool", executor_probe(router.fanout_pool))
+        router_saturation.add_probe(
+            "hedge_pool", executor_probe(router.hedge_pool))
+
+        def _router_pre_sample() -> None:
+            # heat first so the snapshot's shard series and the USE
+            # gauges describe the same instant
+            router.observer.refresh_heat()
+            router_saturation.sample()
+
         router_sampler = HistorySampler(
             capacity=retained.history_capacity, source="router",
-            pre_sample=router.observer.refresh_heat)
+            pre_sample=_router_pre_sample)
         router.observer.attach_history(router_sampler)
         advisor = HotShardAdvisor(history=router_sampler,
                                   shard_map_fn=lambda: router.shard_map,
@@ -309,6 +335,7 @@ def build_fleet(argv: Optional[Sequence[str]] = None) -> FleetHandle:
         hosts[0].service.registry.active().stores.values()), None)
     handle = FleetHandle(server.start(), hosts, telemetry)
     handle.history = router_sampler
+    handle.saturation = router_saturation
     handle.advisor = advisor
     handle.flight = flight
     handle.watchdog = watchdog

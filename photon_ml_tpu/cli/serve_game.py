@@ -129,12 +129,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "point --watch-dir there and the loop closes "
                         "(CONTINUOUS.md 'The closed loop')")
     from photon_ml_tpu.cli.config import (
+        add_capacity_flags,
         add_quality_flags,
         add_rank_flags,
         add_retained_flags,
         add_telemetry_flags,
     )
 
+    add_capacity_flags(p)
     add_quality_flags(p)
     add_rank_flags(p)
     add_retained_flags(p)
@@ -218,12 +220,21 @@ def build_server(argv: Optional[Sequence[str]] = None):
             _rank_fn, coerce=lambda s: s,
             max_batch=8, max_wait_ms=args.max_wait_ms,
             max_queue=args.max_queue if args.max_queue > 0 else None)
+    # the connection plane: accounting is always on; --max-connections
+    # arms the budget (typed 503 refusals past the ceiling)
+    from photon_ml_tpu.cli.config import capacity_from_args
+    from photon_ml_tpu.serving.http import ConnectionTracker
+
+    capacity = capacity_from_args(args)
+    connections = ConnectionTracker(
+        max_connections=capacity.max_connections)
     overload = None
     if batcher is not None and args.brownout_poll_s > 0:
         from photon_ml_tpu.serving import OverloadController
 
         overload = OverloadController(
-            batcher, poll_s=args.brownout_poll_s).start()
+            batcher, poll_s=args.brownout_poll_s,
+            connections=connections).start()
     reqlog = None
     if args.reqlog_dir:
         from photon_ml_tpu.serving import RequestLog
@@ -236,7 +247,8 @@ def build_server(argv: Optional[Sequence[str]] = None):
                              batcher=batcher, rank_batcher=rank_batcher,
                              reqlog=reqlog,
                              default_timeout_ms=args.request_timeout_ms,
-                             overload=overload)
+                             overload=overload,
+                             connections=connections)
     server = GameServer(service, host=args.host, port=args.port)
     server.telemetry = telemetry  # closed by run()'s finally
     server.watcher = None
@@ -278,8 +290,64 @@ def build_server(argv: Optional[Sequence[str]] = None):
     from photon_ml_tpu.telemetry.tracing import GLOBAL_TRACER
 
     retained = retained_from_args(args)
+    # the capacity plane (OBSERVABILITY.md "Saturation & capacity"):
+    # USE gauges per serving-path resource, refreshed as the history
+    # ring's pre-sample so every retained snapshot carries them — the
+    # probes are built HERE, at the wiring site, so telemetry never
+    # imports serving
+    from photon_ml_tpu.serving import overload as serving_overload
+    from photon_ml_tpu.telemetry.saturation import (
+        SaturationSampler,
+        busy_probe,
+        executor_probe,
+        device_busy_seconds,
+        queue_probe,
+    )
+
+    saturation = SaturationSampler()
+    saturation.add_probe("device", busy_probe(device_busy_seconds))
+    if batcher is not None:
+        saturation.add_probe("batcher_queue", queue_probe(
+            batcher.queue_depth, lambda: batcher.max_queue,
+            lambda: serving_overload.shed_counts()["queue_full"]))
+    if rank_batcher is not None:
+        saturation.add_probe("rank_batcher_queue", queue_probe(
+            rank_batcher.queue_depth, lambda: rank_batcher.max_queue))
+
+    def _connections_probe() -> dict:
+        stats = connections.stats()
+        return {"utilization": connections.utilization(),
+                "saturation": float(stats["open"]),
+                "errors": float(stats["refused"])}
+
+    def _handler_threads_probe() -> dict:
+        # ThreadingHTTPServer spawns a thread per connection (no fixed
+        # pool): active request threads against the connection budget
+        stats = connections.stats()
+        budget = connections.max_connections
+        return {"utilization": (stats["active"] / budget if budget
+                                else 0.0),
+                "saturation": float(stats["active"])}
+
+    saturation.add_probe("http_connections", _connections_probe)
+    saturation.add_probe("handler_threads", _handler_threads_probe)
+    if reqlog is not None:
+        def _reqlog_probe() -> dict:
+            stats = reqlog.stats()
+            return {"utilization": (min(1.0, stats["bytes"]
+                                        / reqlog.max_bytes)
+                                    if reqlog.max_bytes else 0.0),
+                    "saturation": float(stats["buffered"]),
+                    "errors": float(stats["dropped"])}
+
+        saturation.add_probe("reqlog", _reqlog_probe)
+        saturation.add_probe("saver_pool",
+                             executor_probe(reqlog.saver.save_executor))
+    service.saturation = saturation
+    server.saturation = saturation
     sampler = HistorySampler(capacity=retained.history_capacity,
-                             source="host")
+                             source="host",
+                             pre_sample=saturation.sample)
     service.history = sampler
     server.history = sampler
     server.flight = None

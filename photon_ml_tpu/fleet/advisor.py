@@ -106,6 +106,12 @@ class HotShardAdvisor:
                for k, v in (series.get("shard_p99") or {}).items()}
         load = {int(k): float(v)
                 for k, v in (series.get("shard_load") or {}).items()}
+        # the capacity plane's bottleneck attribution (history series
+        # ``shard_binding``): which resource is most utilized on each
+        # shard's hosts — "unknown" when the fleet predates the
+        # saturation sampler or it is not armed
+        binding = {str(k): str(v)
+                   for k, v in (series.get("shard_binding") or {}).items()}
         shards = sorted(set(p99) | set(load))
         out: dict[int, dict] = {}
         if len(shards) < 2:
@@ -121,7 +127,8 @@ class HotShardAdvisor:
                       "p99_ratio": round(p99_ratio, 4),
                       "load": load.get(s, 0.0),
                       "load_ratio": round(load_ratio, 4),
-                      "skew": round(max(p99_ratio, load_ratio), 4)}
+                      "skew": round(max(p99_ratio, load_ratio), 4),
+                      "binding_resource": binding.get(str(s), "unknown")}
         return out
 
     # ------------------------------------------------------------------
@@ -195,6 +202,9 @@ class HotShardAdvisor:
         the fleet is cool."""
         with self._lock:
             hot = sorted(self._hot)
+            skew = self._last_skew
+            bindings = {str(s): skew[s].get("binding_resource", "unknown")
+                        for s in hot if s in skew}
         if not hot:
             return None
         smap = self._shard_map_fn()
@@ -208,6 +218,11 @@ class HotShardAdvisor:
             "base_hash": smap.map_hash,
             "n_moves": len(moves),
             "moves_from_hot": from_hot,
+            # the binding resource of each hot shard, so the operator
+            # reading the advice knows WHAT the extra shard relieves
+            # (scale-out cures device/queue pressure; a connection-bound
+            # shard may want --max-connections raised instead)
+            "binding_resources": bindings,
             "moves": {str(b): moves[b] for b in sorted(moves)},
         }
 

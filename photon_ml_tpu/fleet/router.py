@@ -377,6 +377,18 @@ class FleetRouter:
         _SHARDMAP_VERSION.set(self.shard_map.version)
 
     # --- observability taps ----------------------------------------------
+    @property
+    def fanout_pool(self) -> ThreadPoolExecutor:
+        """The fan-out leg executor — exposed read-only so the capacity
+        plane (telemetry.saturation.executor_probe, wired by
+        cli/serve_fleet) can gauge router_pool occupancy."""
+        return self._pool
+
+    @property
+    def hedge_pool(self) -> ThreadPoolExecutor:
+        """The replica-attempt executor (hedge_pool resource)."""
+        return self._hedge_pool
+
     def latency_snapshot(self) -> "list[list[float]]":
         """Copy of each shard's recent-leg latency window (seconds)."""
         with self._lat_lock:

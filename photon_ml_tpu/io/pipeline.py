@@ -274,6 +274,12 @@ class BackgroundSaver:
         self._lock = threading.Lock()
         self._pending: list[tuple[str, Future]] = []  # guarded-by: _lock
 
+    @property
+    def save_executor(self):
+        """The orchestrator pool — what the capacity plane's
+        ``saver_pool`` probe (telemetry/saturation.py) watches."""
+        return self._saves
+
     # --- submission -------------------------------------------------------
     def _track(self, label: str, fut: Future) -> Future:
         with self._lock:

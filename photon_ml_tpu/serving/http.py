@@ -105,6 +105,144 @@ _RANK_K = _metrics.histogram(
     "Requested k per admitted /rank request",
     buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024))
 
+# --- connection plane (OBSERVABILITY.md "Saturation & capacity") -----------
+# THE one sanctioned home for serving socket accounting (lint rule
+# tel-conn-home): the baseline instrument the future event-loop front end
+# must preserve — accepts/closes/refusals, open vs idle keep-alive
+# sockets, connection lifetime and requests-per-connection.
+
+_CONN_ACCEPTED = _metrics.counter(
+    "photon_connections_accepted_total",
+    "Client connections accepted by the serving front end")
+_CONN_CLOSED = _metrics.counter(
+    "photon_connections_closed_total",
+    "Accepted client connections since closed (accepted == closed + "
+    "open, the accounting identity the chaos harness asserts)")
+_CONN_REFUSED = _metrics.counter(
+    "photon_connections_refused_total",
+    "Connections refused by the --max-connections budget (each is "
+    "answered with one typed 503 reason=connections + Connection: close)")
+
+#: instantaneous socket accounting — host-owned: each process holds its
+#: own sockets, so a fleet fold fans these out per host
+_CONN_OPEN = _metrics.gauge(
+    "photon_connections_open",
+    "Client connections currently open (accepted, not yet closed)")
+_CONN_IDLE = _metrics.gauge(
+    "photon_connections_idle",
+    "Open keep-alive connections with no request in flight")
+_CONN_PEAK = _metrics.gauge(
+    "photon_connections_peak",
+    "High-water mark of concurrently open client connections")
+for _g in ("photon_connections_open", "photon_connections_idle",
+           "photon_connections_peak"):
+    _metrics.mark_host_owned(_g)
+
+#: keep-alive connections live far longer than requests — wider bounds
+#: than the latency buckets
+_CONN_LIFETIME = _metrics.histogram(
+    "photon_connection_lifetime_seconds",
+    "Lifetime of each closed client connection (accept to close)",
+    buckets=(0.001, 0.01, 0.1, 0.5, 1.0, 5.0, 30.0, 60.0, 300.0,
+             1800.0, 3600.0))
+_CONN_REQUESTS = _metrics.histogram(
+    "photon_connection_requests",
+    "Requests served per closed client connection (keep-alive reuse)",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024))
+
+
+class ConnectionTracker:
+    """Lock-disciplined accounting for the serving front end's client
+    sockets — the one place (``tel-conn-home``) connection counts live,
+    whatever the I/O model behind them.
+
+    Invariant, held under one lock and asserted by the chaos harness:
+    ``accepted == closed + open``. ``max_connections`` (0 = unlimited)
+    is the admission budget: a connection past the ceiling is REFUSED —
+    counted here, answered by the handler with a typed 503
+    ``reason=connections`` + ``Connection: close`` — never queued and
+    never hung, exactly like every other admission refusal."""
+
+    def __init__(self, max_connections: int = 0):
+        self.max_connections = max(0, int(max_connections))
+        self._lock = threading.Lock()
+        self.accepted = 0  # guarded-by: _lock
+        self.closed = 0  # guarded-by: _lock
+        self.refused = 0  # guarded-by: _lock
+        self.open = 0  # guarded-by: _lock
+        self.active = 0  # guarded-by: _lock
+        self.peak = 0  # guarded-by: _lock
+
+    def connect(self) -> bool:
+        """Account one inbound connection; False = over budget (the
+        caller owes the client one typed refusal before closing)."""
+        with self._lock:
+            if self.max_connections and self.open >= self.max_connections:
+                self.refused += 1
+                _CONN_REFUSED.inc()
+                return False
+            self.accepted += 1
+            self.open += 1
+            if self.open > self.peak:
+                self.peak = self.open
+                _CONN_PEAK.set(self.peak)
+            _CONN_ACCEPTED.inc()
+            _CONN_OPEN.set(self.open)
+            _CONN_IDLE.set(self.open - self.active)
+            return True
+
+    def disconnect(self, lifetime_s: float, n_requests: int,
+                   admitted: bool = True) -> None:
+        if not admitted:
+            return  # refused connections were never counted open
+        with self._lock:
+            self.closed += 1
+            self.open = max(0, self.open - 1)
+            _CONN_CLOSED.inc()
+            _CONN_OPEN.set(self.open)
+            _CONN_IDLE.set(max(0, self.open - self.active))
+        _CONN_LIFETIME.observe(max(0.0, float(lifetime_s)))
+        _CONN_REQUESTS.observe(max(0, int(n_requests)))
+
+    def request_begin(self) -> None:
+        with self._lock:
+            self.active += 1
+            _CONN_IDLE.set(max(0, self.open - self.active))
+
+    def request_end(self) -> None:
+        with self._lock:
+            self.active = max(0, self.active - 1)
+            _CONN_IDLE.set(max(0, self.open - self.active))
+
+    def utilization(self) -> float:
+        """Open connections over the budget (0.0 when unlimited) — the
+        ``http_connections`` saturation probe and the overload
+        controller's connection-pressure input."""
+        with self._lock:
+            if not self.max_connections:
+                return 0.0
+            return min(1.0, self.open / self.max_connections)
+
+    def exhausted(self) -> bool:
+        """At (or past) the budget ceiling — what flips ``/readyz`` to
+        503 ``connections_exhausted``."""
+        with self._lock:
+            return bool(self.max_connections
+                        and self.open >= self.max_connections)
+
+    def stats(self) -> dict:
+        """The ``/healthz`` connection block (scrape equivalents are the
+        ``photon_connections_*`` families)."""
+        with self._lock:
+            return {"open": self.open,
+                    "idle": max(0, self.open - self.active),
+                    "active": self.active,
+                    "peak": self.peak,
+                    "budget": self.max_connections,
+                    "accepted": self.accepted,
+                    "closed": self.closed,
+                    "refused": self.refused}
+
 #: the inbound/outbound request-id header
 REQUEST_ID_HEADER = "X-Photon-Request-Id"
 
@@ -202,8 +340,10 @@ _NULL_SPAN = _NullSpan()
 def shed_status(e: "_overload.Shed") -> int:
     """HTTP status for a typed shed: 429 (busy — retry the same place)
     for admission-control refusals, **503** for ``reason="upstream"``
-    (the fleet router lost a host leg; the capacity is gone, not busy)."""
-    return 503 if e.reason == "upstream" else 429
+    (the fleet router lost a host leg) and ``reason="connections"`` (the
+    socket budget is spent) — in both the capacity is gone, not busy,
+    so the client should go elsewhere rather than hammer this host."""
+    return 503 if e.reason in ("upstream", "connections") else 429
 
 
 @contextlib.contextmanager
@@ -226,7 +366,8 @@ class ServingService:
                  rank_batcher: Optional[MicroBatcher] = None,
                  reqlog: Optional[RequestLog] = None,
                  default_timeout_ms: float = 0.0,
-                 overload=None):
+                 overload=None,
+                 connections: Optional[ConnectionTracker] = None):
         self.registry = registry
         self.default_model_dir = default_model_dir
         self.batcher = batcher
@@ -241,6 +382,11 @@ class ServingService:
         #: optional OverloadController (serving/overload.py), owned here:
         #: closed with the service, surfaced by /readyz
         self.overload = overload
+        #: the connection-plane accounting (always on — the budget is
+        #: what's optional): every handler setup/finish and request
+        #: passes through it, and /healthz + /readyz surface its stats
+        self.connections = connections if connections is not None \
+            else ConnectionTracker()
         self._lock = threading.Lock()
         self.n_requests = 0  # guarded-by: _lock
         self.n_scored = 0  # guarded-by: _lock
@@ -562,6 +708,9 @@ class ServingService:
                             else self.batcher.queue_depth()),
             "shed": _overload.shed_counts(),
             "brownout_level": _overload.level(),
+            # the connection plane: open/idle/peak sockets + the
+            # --max-connections budget (0 = unlimited)
+            "connections": self.connections.stats(),
         }
         if self.reqlog is not None:
             out["reqlog"] = self.reqlog.stats()
@@ -602,6 +751,10 @@ class ServingService:
         lvl = _overload.level()
         if lvl >= _overload.MAX_LEVEL:
             reasons.append("brownout_max")
+        if self.connections.exhausted():
+            # at the socket ceiling a load balancer must route around
+            # this host NOW — new connections are being refused
+            reasons.append("connections_exhausted")
         body = {
             "ready": not reasons,
             "reasons": reasons,
@@ -610,6 +763,7 @@ class ServingService:
                             else self.batcher.queue_depth()),
             "shed": _overload.shed_counts(),
             "brownout_level": lvl,
+            "connections": self.connections.stats(),
         }
         return (200 if not reasons else 503), body
 
@@ -699,6 +853,27 @@ def _make_handler(service: ServingService):
         def log_message(self, fmt, *args):  # noqa: D102
             pass
 
+        # --- connection accounting (tel-conn-home: THE one home) ---------
+        def setup(self):
+            """One inbound socket: account it before the first request
+            is read. Over the --max-connections budget ``connect()``
+            refuses — the request loop still runs so the client gets ONE
+            typed 503 (never a silent close, never a hang) before
+            :meth:`finish` drops the socket."""
+            super().setup()
+            self._conn_t0 = time.monotonic()
+            self._conn_requests = 0
+            self._conn_admitted = service.connections.connect()
+
+        def finish(self):
+            try:
+                super().finish()
+            finally:
+                service.connections.disconnect(
+                    time.monotonic() - self._conn_t0,
+                    self._conn_requests,
+                    admitted=getattr(self, "_conn_admitted", True))
+
         def _request_id(self) -> str:
             """Honor the inbound header; mint otherwise. Echoed on every
             response by :meth:`_reply_raw`."""
@@ -777,9 +952,40 @@ def _make_handler(service: ServingService):
                         headers={"Connection": "close"})
             return True
 
+        def _refuse_if_exhausted(self) -> bool:
+            """A connection refused by the --max-connections budget is
+            answered with one typed 503 ``reason=connections`` +
+            ``Connection: close`` — the same refusal shape as a
+            stopping host, feeding the same shed counter family the
+            brownout ladder watches. Never a hang: the client learns
+            the budget is spent and goes elsewhere."""
+            if getattr(self, "_conn_admitted", True):
+                return False
+            self.close_connection = True
+            e = _overload.shed(
+                "connections",
+                message=f"connection budget exhausted "
+                        f"(--max-connections "
+                        f"{service.connections.max_connections})",
+                retry_after_s=1.0)
+            self._reply(shed_status(e),
+                        {"error": str(e), "reason": e.reason},
+                        headers={"Connection": "close",
+                                 "Retry-After":
+                                     str(max(1, round(e.retry_after_s)))})
+            return True
+
         def do_GET(self):  # noqa: N802
-            if self._refuse_if_stopping():
+            if self._refuse_if_stopping() or self._refuse_if_exhausted():
                 return
+            self._conn_requests += 1
+            service.connections.request_begin()
+            try:
+                self._get_traced()
+            finally:
+                service.connections.request_end()
+
+        def _get_traced(self) -> None:
             rid = self._request_id()
             parsed = urlsplit(self.path)
             if parsed.path == "/rank":
@@ -881,12 +1087,17 @@ def _make_handler(service: ServingService):
                                      leg_stages, parse_ms / 1e3)
 
         def do_POST(self):  # noqa: N802
-            if self._refuse_if_stopping():
+            if self._refuse_if_stopping() or self._refuse_if_exhausted():
                 return
-            rid = self._request_id()
-            with _maybe_span("serving.request", request_id=rid,
-                             path=self.path):
-                self._post_traced(rid)
+            self._conn_requests += 1
+            service.connections.request_begin()
+            try:
+                rid = self._request_id()
+                with _maybe_span("serving.request", request_id=rid,
+                                 path=self.path):
+                    self._post_traced(rid)
+            finally:
+                service.connections.request_end()
 
         def _post_traced(self, rid: str) -> None:
             payload = None

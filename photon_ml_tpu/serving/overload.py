@@ -60,8 +60,9 @@ logger = logging.getLogger(__name__)
 _SHED_TOTAL = _metrics.counter(
     "photon_shed_total",
     "Requests shed by serving admission control, by reason "
-    "(queue_full | deadline | brownout | upstream — the last is the "
-    "fleet router mapping a dead/slow/faulted host leg to a typed 503)",
+    "(queue_full | deadline | brownout | connections | upstream — the "
+    "last two map to 503: the connection budget or the fleet capacity "
+    "is exhausted, the caller did nothing wrong)",
     labels=("reason",))
 
 #: current brownout degradation level (0 = full service, MAX_LEVEL =
@@ -78,8 +79,12 @@ _metrics.mark_host_owned("photon_brownout_level")
 #: fleet router's reason — a per-host fan-out leg failed (dead host, slow
 #: host past the fan-out timeout, injected ``fleet.fanout`` fault) — and
 #: maps to **503** rather than 429: the caller did nothing wrong and the
-#: capacity is gone, not busy.
-SHED_REASONS = ("queue_full", "deadline", "brownout", "upstream")
+#: capacity is gone, not busy. ``connections`` is the ``--max-connections``
+#: budget refusing a socket past the ceiling (SERVING.md "Connection
+#: budget"): also a 503, sent with ``Connection: close`` so the client
+#: retries against a host with socket headroom.
+SHED_REASONS = ("queue_full", "deadline", "brownout", "connections",
+                "upstream")
 for _r in SHED_REASONS:
     _SHED_TOTAL.labels(reason=_r)
 
@@ -196,8 +201,14 @@ class OverloadController:
     def __init__(self, batcher, *, high_util: float = 0.75,
                  low_util: float = 0.25,
                  wait_p99_ms: Optional[float] = None,
-                 poll_s: float = 1.0, bus=None):
+                 poll_s: float = 1.0, bus=None,
+                 connections=None):
         self.batcher = batcher
+        #: optional ConnectionTracker (serving/http.py): a host whose
+        #: ``--max-connections`` budget is nearly spent is under pressure
+        #: even with a shallow batcher queue, so connection utilization
+        #: feeds the same watermarks queue utilization does
+        self.connections = connections
         self.high_util = float(high_util)
         self.low_util = float(low_util)
         #: optional queue-wait p99 threshold (ms) that escalates even
@@ -236,11 +247,13 @@ class OverloadController:
         depth = self.batcher.queue_depth()
         cap = self.batcher.max_queue
         util = (depth / cap) if cap else 0.0
+        conn_util = (0.0 if self.connections is None
+                     else self.connections.utilization())
         wait_p99 = self._windowed_wait_p99_ms()
-        hot = util >= self.high_util or (
+        hot = util >= self.high_util or conn_util >= self.high_util or (
             self.wait_p99_ms is not None and wait_p99 is not None
             and wait_p99 >= self.wait_p99_ms)
-        cool = util <= self.low_util and (
+        cool = util <= self.low_util and conn_util <= self.low_util and (
             self.wait_p99_ms is None or wait_p99 is None
             or wait_p99 < self.wait_p99_ms)
         cur = level()

@@ -275,6 +275,12 @@ class RequestLog:
                 pass
 
     # --- introspection ----------------------------------------------------
+    @property
+    def saver(self):
+        """The background writer pool — what the capacity plane's
+        ``saver_pool`` probe watches."""
+        return self._saver
+
     def stats(self) -> dict:
         """The ``/healthz`` payload: budget counters + config."""
         with self._lock:

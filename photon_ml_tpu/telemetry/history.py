@@ -59,12 +59,15 @@ __all__ = [
 #: (mirrors the leg-summary stage vocabulary in ``serving/http.py``).
 WATCHED_FAMILIES = (
     "photon_compiles_total",
+    "photon_connections_open",
     "photon_fleet_hedges_total",
     "photon_fleet_requests_total",
     "photon_fleet_shard_load",
     "photon_fleet_shard_p50_seconds",
     "photon_fleet_shard_p99_seconds",
     "photon_fleet_upstream_errors_total",
+    "photon_resource_saturation",
+    "photon_resource_utilization",
     "photon_serving_queue_depth",
     "photon_serving_request_latency_seconds",
     "photon_serving_requests_total",
@@ -77,11 +80,15 @@ WATCHED_FAMILIES = (
 #: timeline, so a typo'd dashboard fails loudly.
 HISTORY_SERIES = (
     "compiles",
+    "duty_cycle",
     "hedge_rate",
     "latency_p50",
     "latency_p99",
+    "open_connections",
     "queue_depth",
     "requests",
+    "resource_util",
+    "shard_binding",
     "shard_load",
     "shard_p50",
     "shard_p99",
@@ -141,6 +148,41 @@ def _labeled_gauge(parsed: ParsedSnapshot, name: str,
     return out
 
 
+def _labeled_max(parsed: ParsedSnapshot, name: str,
+                 label: str) -> dict[str, float]:
+    """Per-``label`` maxima of gauge ``name`` — on folded text a
+    host-owned gauge fans out per host, and the capacity question is
+    "how saturated is the WORST instance", never the average."""
+    out: dict[str, float] = {}
+    for labels, value in parsed.get(name, ()):
+        key = labels.get(label)
+        if key is not None and float(value) > out.get(key, float("-inf")):
+            out[key] = float(value)
+    return out
+
+
+def _shard_binding(parsed: ParsedSnapshot) -> dict[str, str]:
+    """Per-shard binding resource: the resource with the highest
+    utilization among this shard's fanned-out
+    ``photon_resource_utilization`` series (ties break to the
+    lexicographically first resource — deterministic, like every fold).
+    Host-tier snapshots carry no ``shard`` label, so the dict is empty
+    there and populated exactly where it means something: the folded
+    fleet timeline."""
+    best: dict[str, tuple[float, str]] = {}
+    for labels, value in parsed.get("photon_resource_utilization", ()):
+        shard = labels.get("shard")
+        resource = labels.get("resource")
+        if shard is None or resource is None:
+            continue
+        cur = best.get(shard)
+        value = float(value)
+        if cur is None or value > cur[0] \
+                or (value == cur[0] and resource < cur[1]):
+            best[shard] = (value, resource)
+    return {shard: resource for shard, (_v, resource) in best.items()}
+
+
 def _hist_cumulative(parsed: ParsedSnapshot,
                      name: str) -> tuple[list[float], list[float]]:
     """Summed-over-labels cumulative bucket counts for histogram
@@ -192,14 +234,32 @@ def derive_series(prev: Optional[ParsedSnapshot], cur: ParsedSnapshot,
     fleet_requests = _delta(prev, cur, "photon_fleet_requests_total")
     return {
         "compiles": _counter_sum(cur, "photon_compiles_total"),
+        # device-seconds per wall second: on host text this is one duty
+        # cycle in [0, 1]; on folded text the fanned-out per-host gauges
+        # SUM, so the fleet reads in device-seconds/second (N hosts
+        # flat-out = N.0) — capacity, not a percentage
+        "duty_cycle": float(sum(
+            v for labels, v in cur.get("photon_resource_utilization", ())
+            if labels.get("resource") == "device")),
         "hedge_rate": hedges / max(fleet_requests, 1.0),
         "latency_p50": _window_quantile(
             prev, cur, "photon_serving_request_latency_seconds", 0.50),
         "latency_p99": _window_quantile(
             prev, cur, "photon_serving_request_latency_seconds", 0.99),
+        "open_connections": float(sum(
+            v for _l, v in cur.get("photon_connections_open", ()))),
         "queue_depth": float(sum(
             v for _l, v in cur.get("photon_serving_queue_depth", ()))),
         "requests": requests,
+        # worst-instance utilization per resource — the binding axis of
+        # the USE plane (max across hosts on folded text: the capacity
+        # question is about the most constrained instance)
+        "resource_util": _labeled_max(
+            cur, "photon_resource_utilization", "resource"),
+        # shard → its most-utilized resource, readable only on folded
+        # text (host-owned gauges carry shard labels there); what the
+        # hot-shard advisor stamps on detections
+        "shard_binding": _shard_binding(cur),
         "shard_load": _labeled_gauge(
             cur, "photon_fleet_shard_load", "shard"),
         "shard_p50": _labeled_gauge(

@@ -1,0 +1,267 @@
+"""Resource-saturation telemetry: USE-method gauges over a CLOSED vocabulary.
+
+The retained-telemetry ring (history.py) answers "what happened"; this
+module answers the capacity question behind it — **which resource is the
+binding constraint right now**. Following the USE method (utilization /
+saturation / errors per resource — the same SRE playbook the SLO burn
+tracker borrowed its budget math from), every serving-path resource gets
+three gauges:
+
+- ``photon_resource_utilization{resource=...}`` — busy fraction in
+  [0, 1]: device duty cycle, queue depth over ``--max-queue``, pool
+  active-workers over pool size, open connections over
+  ``--max-connections``.
+- ``photon_resource_saturation{resource=...}`` — waiting work (queue
+  depth, pending pool tasks, buffered reqlog records): the "extra demand
+  the resource could not absorb" axis.
+- ``photon_resource_errors{resource=...}`` — errors attributed to the
+  resource over the LAST sampling interval (sheds, refused connections,
+  dropped log records). Probes report cumulative counts; the sampler
+  deltas them, so the gauge reads as a per-interval rate numerator.
+
+The resource vocabulary (:data:`RESOURCES`) is CLOSED and lint-enforced
+(``tel-conn-home``): a resource name never derives from traffic, so the
+plane's cardinality is bounded by construction, and a dashboard can
+enumerate the axis. :class:`SaturationSampler` is **injectable-tick**
+like :class:`~photon_ml_tpu.telemetry.history.HistorySampler` — it does
+no threading of its own; the serving mains hang ``sample`` off the
+history sampler's ``pre_sample`` hook so every retained ring snapshot
+carries fresh saturation gauges, and the router's byte-identical fold
+(``tools/metrics_fold.py``) ships them fleet-wide for free.
+
+Probes are plain callables returning a small dict, CONSTRUCTED AT THE
+WIRING SITE (``cli/serve_game.py`` / ``cli/serve_fleet.py`` /
+``serving/http.py``) — telemetry never imports serving or fleet, the
+same inversion ``fold_history`` uses. This module only supplies the
+generic probe builders (:func:`queue_probe`, :func:`executor_probe`,
+:func:`busy_probe`) and the device duty-cycle derivation.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Mapping, Optional
+
+from photon_ml_tpu.telemetry import metrics as _metrics
+
+__all__ = [
+    "RESOURCES",
+    "SaturationSampler",
+    "busy_probe",
+    "device_busy_seconds",
+    "executor_probe",
+    "queue_probe",
+]
+
+#: the CLOSED resource vocabulary — every serving-path resource the
+#: capacity plane accounts for. Additions are a reviewed decision (the
+#: ``tel-conn-home`` lint requires probe registrations to name one of
+#: these as a literal), mirroring the history-series and shed-reason
+#: vocabularies.
+RESOURCES = (
+    "device",
+    "batcher_queue",
+    "rank_batcher_queue",
+    "http_connections",
+    "handler_threads",
+    "saver_pool",
+    "router_pool",
+    "hedge_pool",
+    "reqlog",
+)
+
+_UTILIZATION = _metrics.gauge(
+    "photon_resource_utilization",
+    "USE-method utilization per serving-path resource (busy fraction in "
+    "[0, 1]: device duty cycle, queue depth / capacity, pool active / "
+    "size, open connections / budget)",
+    labels=("resource",))
+_SATURATION = _metrics.gauge(
+    "photon_resource_saturation",
+    "USE-method saturation per serving-path resource (waiting work: "
+    "queue depth, pending pool tasks, buffered log records)",
+    labels=("resource",))
+_ERRORS = _metrics.gauge(
+    "photon_resource_errors",
+    "USE-method errors attributed to each serving-path resource over "
+    "the last sampling interval (sheds, refused connections, drops)",
+    labels=("resource",))
+# each host saturates on its own pressure: a fleet fold must fan these
+# out per host, never let one host's duty cycle overwrite another's
+for _fam in ("photon_resource_utilization", "photon_resource_saturation",
+             "photon_resource_errors"):
+    _metrics.mark_host_owned(_fam)
+
+
+def _clamp01(value: float) -> float:
+    return 0.0 if value < 0.0 else (1.0 if value > 1.0 else float(value))
+
+
+def queue_probe(depth_fn: Callable[[], int],
+                capacity_fn: Callable[[], Optional[int]],
+                errors_fn: Optional[Callable[[], float]] = None,
+                ) -> Callable[[], dict]:
+    """Probe for a bounded queue: utilization = depth / capacity (0 when
+    unbounded), saturation = depth, errors = the caller's cumulative
+    refusal count (e.g. this queue's shed tally)."""
+    def probe() -> dict:
+        depth = float(depth_fn())
+        cap = capacity_fn()
+        out = {"utilization": _clamp01(depth / cap) if cap else 0.0,
+               "saturation": depth}
+        if errors_fn is not None:
+            out["errors"] = float(errors_fn())
+        return out
+    return probe
+
+
+def executor_probe(executor, size: Optional[int] = None,
+                   ) -> Callable[[], dict]:
+    """Probe for a stdlib ``ThreadPoolExecutor``: utilization = active
+    workers / pool size, saturation = queued-but-unstarted tasks. Reads
+    two private attributes (``_idle_semaphore``, ``_work_queue``) — the
+    ONE sanctioned peek, confined here so a stdlib change breaks exactly
+    one function (and degrades to zeros, never raises)."""
+    def probe() -> dict:
+        cap = size if size is not None \
+            else getattr(executor, "_max_workers", 0)
+        try:
+            idle = executor._idle_semaphore._value
+            spawned = len(executor._threads)
+            pending = executor._work_queue.qsize()
+        except AttributeError:  # pragma: no cover - stdlib drift
+            return {"utilization": 0.0, "saturation": 0.0}
+        active = max(0, spawned - idle)
+        return {"utilization": _clamp01(active / cap) if cap else 0.0,
+                "saturation": float(pending)}
+    return probe
+
+
+def busy_probe(busy_seconds_fn: Callable[[], float],
+               errors_fn: Optional[Callable[[], float]] = None,
+               ) -> Callable[[], dict]:
+    """Probe for a duty-cycle resource: the callable returns CUMULATIVE
+    busy-seconds; the sampler turns the interval delta over wall time
+    into utilization (clamped to [0, 1] — overlapping busy intervals on
+    a threaded host can nominally exceed the wall clock)."""
+    def probe() -> dict:
+        out: dict = {"busy_seconds": float(busy_seconds_fn())}
+        if errors_fn is not None:
+            out["errors"] = float(errors_fn())
+        return out
+    return probe
+
+
+def device_busy_seconds(registry=None) -> float:
+    """Cumulative device busy-seconds, from whichever layer timed the
+    dispatch in this process: the summed ``_sum`` of the profiling
+    layer's ``photon_execute_latency_seconds`` histogram (training and
+    any ``profile_jit``-wrapped program) plus the request path's
+    ``photon_serving_stage_seconds{stage="execute"}`` — serving engines
+    count compiles via ``record_compile`` and time the device leg as the
+    execute STAGE, so the profiled family never accumulates there (the
+    two sources are disjoint per process, never double-counted). Feed
+    through :func:`busy_probe`; the interval delta over wall time IS the
+    device duty cycle."""
+    reg = registry if registry is not None else _metrics.default_registry()
+    total = 0.0
+    fam = reg.get("photon_execute_latency_seconds")
+    if fam is not None:
+        total += sum(child.sum for _labels, child in fam.children())
+    stages = reg.get("photon_serving_stage_seconds")
+    if stages is not None:
+        idx = (stages.label_names.index("stage")
+               if "stage" in stages.label_names else None)
+        total += sum(child.sum for values, child in stages.children()
+                     if idx is not None and values[idx] == "execute")
+    return float(total)
+
+
+class SaturationSampler:
+    """Derives the three USE gauges for every registered probe on each
+    injectable tick.
+
+    ``add_probe(resource, probe)`` registers a callable returning a dict
+    with any of ``utilization`` / ``saturation`` / ``errors`` (cumulative
+    — deltaed here) / ``busy_seconds`` (cumulative — converted to
+    utilization over the interval). Unknown resource names raise: the
+    vocabulary is closed at runtime exactly as ``tel-conn-home`` closes
+    it at lint time. ``sample(now=)`` drives every probe and publishes
+    the gauges; a failing probe zeroes its resource for the tick rather
+    than taking down sampling (observation never takes down serving).
+    """
+
+    def __init__(self, *, registry=None):
+        self._registry = registry if registry is not None \
+            else _metrics.default_registry()
+        self._utilization = self._registry.gauge(
+            "photon_resource_utilization", _UTILIZATION.help,
+            labels=("resource",))
+        self._saturation = self._registry.gauge(
+            "photon_resource_saturation", _SATURATION.help,
+            labels=("resource",))
+        self._errors = self._registry.gauge(
+            "photon_resource_errors", _ERRORS.help, labels=("resource",))
+        self._lock = threading.Lock()
+        self._probes: dict[str, Callable[[], dict]] = {}  # guarded-by: _lock
+        self._prev_errors: dict[str, float] = {}  # guarded-by: _lock
+        self._prev_busy: dict[str, float] = {}  # guarded-by: _lock
+        self._prev_ts: Optional[float] = None  # guarded-by: _lock
+
+    def add_probe(self, resource: str,
+                  probe: Callable[[], dict]) -> None:
+        if resource not in RESOURCES:
+            raise ValueError(
+                f"unknown resource {resource!r}: the saturation "
+                f"vocabulary is closed ({', '.join(RESOURCES)})")
+        with self._lock:
+            self._probes[resource] = probe
+
+    def resources(self) -> tuple:
+        """The currently probed resources (sorted, for /statusz)."""
+        with self._lock:
+            return tuple(sorted(self._probes))
+
+    def sample(self, now: Optional[float] = None) -> dict:
+        """One injectable tick: run every probe, publish the gauges,
+        return ``{resource: {utilization, saturation, errors}}``. Wired
+        as the history sampler's ``pre_sample`` so each retained ring
+        snapshot carries this tick's values."""
+        if now is None:
+            import time as _time
+            now = _time.monotonic()
+        out: dict[str, dict] = {}
+        with self._lock:
+            probes = dict(self._probes)
+            dt = (now - self._prev_ts) if self._prev_ts is not None else 0.0
+            self._prev_ts = float(now)
+        for resource, probe in probes.items():
+            try:
+                raw: Mapping = probe() or {}
+            except Exception:
+                raw = {}
+            util = float(raw.get("utilization", 0.0))
+            busy = raw.get("busy_seconds")
+            with self._lock:
+                if busy is not None:
+                    prev = self._prev_busy.get(resource)
+                    self._prev_busy[resource] = float(busy)
+                    if prev is not None and dt > 0:
+                        util = _clamp01((float(busy) - prev) / dt)
+                    else:
+                        util = 0.0
+                errors_cum = float(raw.get("errors", 0.0))
+                prev_err = self._prev_errors.get(resource, errors_cum)
+                self._prev_errors[resource] = errors_cum
+            values = {
+                "utilization": _clamp01(util),
+                "saturation": max(0.0, float(raw.get("saturation", 0.0))),
+                "errors": max(0.0, errors_cum - prev_err),
+            }
+            self._utilization.labels(resource=resource).set(
+                values["utilization"])
+            self._saturation.labels(resource=resource).set(
+                values["saturation"])
+            self._errors.labels(resource=resource).set(values["errors"])
+            out[resource] = values
+        return out

@@ -903,3 +903,93 @@ def test_span_attr_cardinality_is_clean_on_the_tree():
     # vocabulary is what keeps host.* span names bounded)
     report = engine.run(REPO, rule_ids=["tel-span-attr-cardinality"])
     assert report.findings == [], report.findings
+
+
+# ---------------------------------------------------------------------------
+# tel-conn-home: connection accounting confined to serving/http.py,
+# saturation probes name closed-vocabulary resources
+# ---------------------------------------------------------------------------
+
+CONN = ["tel-conn-home"]
+CONN_HOME = os.path.join("photon_ml_tpu", "serving", "http.py")
+
+
+def test_conn_home_flags_connection_metric_outside_http():
+    src = """
+        from photon_ml_tpu.telemetry import metrics
+
+        OPEN = metrics.gauge("photon_connections_open", "a fork")
+        LIFE = metrics.histogram("photon_connection_lifetime_seconds",
+                                 "another fork")
+    """
+    got = check(src, CONN)
+    assert rule_ids(got) == ["tel-conn-home"] * 2
+    assert "ONE writer" in got[0].message
+
+
+def test_conn_home_flags_tracker_redefinition_outside_http():
+    src = """
+        class ConnectionTracker:
+            def connect(self):
+                pass
+    """
+    got = check(src, CONN)
+    assert rule_ids(got) == ["tel-conn-home"]
+    assert "accepted == closed + open" in got[0].message
+
+
+def test_conn_home_allows_the_home_itself():
+    src = """
+        from photon_ml_tpu.telemetry import metrics
+
+        OPEN = metrics.gauge("photon_connections_open", "host gauge")
+
+        class ConnectionTracker:
+            pass
+    """
+    assert check(src, CONN, rel=CONN_HOME) == []
+
+
+def test_conn_home_importing_the_tracker_is_fine():
+    # instantiation is the sanctioned use — only DEFINITION forks it
+    src = """
+        from photon_ml_tpu.serving.http import ConnectionTracker
+
+        tracker = ConnectionTracker(max_connections=8)
+    """
+    assert check(src, CONN) == []
+
+
+def test_conn_home_add_probe_vocabulary():
+    bad_name = """
+        sampler.add_probe("gpu_fans", lambda: {})
+    """
+    got = check(bad_name, CONN)
+    assert rule_ids(got) == ["tel-conn-home"]
+    assert "closed vocabulary" in got[0].message
+
+    computed = """
+        sampler.add_probe("pool_" + str(i), lambda: {})
+    """
+    got = check(computed, CONN)
+    assert rule_ids(got) == ["tel-conn-home"]
+    assert "computed at runtime" in got[0].message
+
+    good = """
+        sampler.add_probe("batcher_queue", probe)
+        sampler.add_probe("router_pool", other)
+    """
+    assert check(good, CONN) == []
+
+
+def test_conn_home_vocab_copy_matches_saturation_resources():
+    # the rule's static twin must track the runtime vocabulary — the
+    # same copy-sync contract as RETAINED_NAME_RE vs SERIES_NAME_RE
+    from photon_ml_tpu.analysis.rules_telemetry import SATURATION_RESOURCES
+    from photon_ml_tpu.telemetry.saturation import RESOURCES
+    assert SATURATION_RESOURCES == frozenset(RESOURCES)
+
+
+def test_conn_home_is_clean_on_the_tree():
+    report = engine.run(REPO, rule_ids=["tel-conn-home"])
+    assert report.findings == [], report.findings

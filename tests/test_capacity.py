@@ -1,0 +1,676 @@
+"""Saturation & capacity plane tests (telemetry/saturation.py, the
+connection plane in serving/http.py, the new history series, and
+tools/capacity_report.py).
+
+The load-bearing contracts locked here:
+
+- **closed vocabulary**: the saturation sampler only accepts probes for
+  the nine named resources; anything else is a ValueError, not a new
+  time series;
+- **USE derivation**: utilization/saturation/errors gauges are pure
+  functions of the probes — cumulative busy-seconds and error counters
+  are converted to per-interval rates by the sampler's injectable
+  clock, never by sleeping;
+- **connection accounting identity**: ``accepted == closed + open``
+  holds under the tracker's lock through admits, refusals and closes —
+  and a refused connection is NEVER counted open;
+- **typed refusal**: past ``--max-connections`` the server answers ONE
+  typed 503 (``reason=connections``) with ``Connection: close`` and
+  ``Retry-After`` — never a hang, never a silent RST — and ``/readyz``
+  reports ``connections_exhausted`` while the budget is full;
+- **plane is free**: f32 scores stay bit-identical and the engine
+  compiles nothing new with the saturation sampler, the connection
+  tracker and the budget all armed while ``/metrics`` and ``/history``
+  scrapes interleave;
+- **capacity report**: ``tools/capacity_report.py`` is a byte
+  deterministic golden that names the binding resource correctly on
+  queue-saturated vs device-saturated fixtures.
+"""
+
+import http.client
+import json
+import os
+import sys
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.cli import serve_game as serve_game_cli
+from photon_ml_tpu.cli import train_game as train_game_cli
+from photon_ml_tpu.cli.config import (
+    CapacityConfig,
+    parse_feature_shard_config,
+)
+from photon_ml_tpu.io.data_reader import write_training_examples
+from photon_ml_tpu.serving import ModelRegistry
+from photon_ml_tpu.serving.http import ConnectionTracker
+from photon_ml_tpu.telemetry.history import derive_series
+from photon_ml_tpu.telemetry.metrics import MetricsRegistry
+from photon_ml_tpu.telemetry.prometheus import parse_text
+from photon_ml_tpu.telemetry.saturation import (
+    RESOURCES,
+    SaturationSampler,
+    busy_probe,
+    device_busy_seconds,
+    executor_probe,
+    queue_probe,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+SHARDS = "global=fixed|intercept,user=user|noIntercept"
+SHARD_CONFIGS = tuple(parse_feature_shard_config(s)
+                      for s in SHARDS.split(","))
+COORDS = [
+    "global=fixed,shard=global,reg=L2",
+    "perUser=random,entity=userId,shard=user,reg=L2",
+]
+D_FIXED, D_USER, N_USERS = 5, 3, 7
+
+
+def _records(n, seed):
+    prng = np.random.default_rng(777)
+    w = prng.normal(size=D_FIXED)
+    u = 1.5 * prng.normal(size=(N_USERS, D_USER))
+    rng = np.random.default_rng(seed)
+    xf = rng.normal(size=(n, D_FIXED))
+    xu = rng.normal(size=(n, D_USER))
+    users = rng.integers(0, N_USERS, size=n)
+    margin = xf @ w + np.einsum("nd,nd->n", xu, u[users])
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-margin))).astype(float)
+    out = []
+    for i in range(n):
+        feats = [{"name": f"fixed.x{j}", "term": "",
+                  "value": float(xf[i, j])} for j in range(D_FIXED)]
+        feats += [{"name": f"user.z{j}", "term": "",
+                   "value": float(xu[i, j])} for j in range(D_USER)]
+        out.append({
+            "uid": str(i), "response": float(y[i]), "offset": None,
+            "weight": None, "features": feats,
+            "metadataMap": {"userId": f"u{users[i]}"},
+        })
+    return out
+
+
+@pytest.fixture(scope="module")
+def trained(tmp_path_factory):
+    tmp = str(tmp_path_factory.mktemp("capacity"))
+    train_path = os.path.join(tmp, "train.avro")
+    write_training_examples(train_path, _records(400, seed=0))
+    out = os.path.join(tmp, "run")
+    train_game_cli.run([
+        "--training-data", train_path,
+        "--output-dir", out,
+        "--feature-shards", SHARDS,
+        "--coordinates", *COORDS,
+        "--update-sequence", "global,perUser",
+        "--grid", "global=0.1", "perUser=1",
+        "--evaluators", "",
+    ])
+    return {"tmp": tmp, "model": out,
+            "requests": _records(24, seed=11)}
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=60) as resp:
+        return json.loads(resp.read())
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        return json.loads(resp.read())
+
+
+# ---------------------------------------------------------------------------
+# the saturation sampler
+# ---------------------------------------------------------------------------
+
+
+class TestSaturationSampler:
+    def test_vocabulary_is_closed(self):
+        sampler = SaturationSampler(registry=MetricsRegistry())
+        with pytest.raises(ValueError) as err:
+            sampler.add_probe("userId", lambda: {})
+        assert "closed" in str(err.value)
+        assert list(RESOURCES) == sorted(set(RESOURCES), key=RESOURCES.index)
+        assert len(RESOURCES) == 9
+
+    def test_queue_probe_is_depth_over_capacity(self):
+        sampler = SaturationSampler(registry=MetricsRegistry())
+        depth, cap = [3], [10]
+        sampler.add_probe("batcher_queue", queue_probe(
+            lambda: depth[0], lambda: cap[0]))
+        out = sampler.sample(now=1.0)["batcher_queue"]
+        assert out == {"utilization": 0.3, "saturation": 3.0,
+                       "errors": 0.0}
+        depth[0], cap[0] = 30, 10  # overfull clamps at 1.0
+        assert sampler.sample(now=2.0)["batcher_queue"][
+            "utilization"] == 1.0
+        cap[0] = 0  # unbounded queue: occupancy is undefined, not inf
+        assert sampler.sample(now=3.0)["batcher_queue"][
+            "utilization"] == 0.0
+
+    def test_busy_probe_converts_cumulative_seconds_to_duty(self):
+        sampler = SaturationSampler(registry=MetricsRegistry())
+        busy = [0.0]
+        sampler.add_probe("device", busy_probe(lambda: busy[0]))
+        # first tick has no interval: duty is 0, not garbage
+        assert sampler.sample(now=10.0)["device"]["utilization"] == 0.0
+        busy[0] = 1.5
+        out = sampler.sample(now=12.0)["device"]
+        assert out["utilization"] == pytest.approx(0.75)
+        # an idle interval decays to 0 (delta, not cumulative average)
+        assert sampler.sample(now=13.0)["device"]["utilization"] == 0.0
+
+    def test_error_counters_are_interval_deltas(self):
+        sampler = SaturationSampler(registry=MetricsRegistry())
+        errs = [7.0]  # pre-existing total at arm time
+        sampler.add_probe("reqlog", lambda: {"errors": errs[0]})
+        # first sight of a cumulative counter is baseline, not a burst
+        assert sampler.sample(now=1.0)["reqlog"]["errors"] == 0.0
+        errs[0] = 9.0
+        assert sampler.sample(now=2.0)["reqlog"]["errors"] == 2.0
+        assert sampler.sample(now=3.0)["reqlog"]["errors"] == 0.0
+
+    def test_probe_failure_degrades_to_absent_not_fatal(self):
+        sampler = SaturationSampler(registry=MetricsRegistry())
+        sampler.add_probe("device", lambda: 1 / 0)
+        sampler.add_probe("batcher_queue",
+                          queue_probe(lambda: 1, lambda: 4))
+        out = sampler.sample(now=1.0)
+        assert out["batcher_queue"]["utilization"] == 0.25
+        assert out["device"] == {"utilization": 0.0, "saturation": 0.0,
+                                 "errors": 0.0}
+
+    def test_gauges_land_in_the_registry(self):
+        registry = MetricsRegistry()
+        sampler = SaturationSampler(registry=registry)
+        sampler.add_probe("http_connections",
+                          lambda: {"utilization": 0.5,
+                                   "saturation": 4.0, "errors": 2.0})
+        sampler.sample(now=1.0)
+        sampler.sample(now=2.0)
+        from photon_ml_tpu.telemetry.prometheus import render
+        parsed = parse_text(render(registry))
+        by_resource = {labels["resource"]: value for labels, value
+                       in parsed["photon_resource_utilization"]}
+        assert by_resource["http_connections"] == 0.5
+        sat = {labels["resource"]: value for labels, value
+               in parsed["photon_resource_saturation"]}
+        assert sat["http_connections"] == 4.0
+
+    def test_executor_probe_reads_pool_occupancy(self):
+        import threading
+        from concurrent.futures import ThreadPoolExecutor
+
+        pool = ThreadPoolExecutor(max_workers=2)
+        try:
+            probe = executor_probe(pool)
+            gate = threading.Event()
+            futures = [pool.submit(gate.wait, 10) for _ in range(3)]
+            out = probe()
+            assert out["utilization"] == 1.0  # both workers busy
+            assert out["saturation"] >= 1.0  # one task queued
+            gate.set()
+            for f in futures:
+                f.result(timeout=10)
+        finally:
+            pool.shutdown(wait=True)
+
+    def test_device_busy_seconds_sums_execute_latency(self):
+        registry = MetricsRegistry()
+        hist = registry.histogram(
+            "photon_execute_latency_seconds", "test", labels=("fn",))
+        hist.labels(fn="a").observe(0.25)
+        hist.labels(fn="b").observe(0.5)
+        assert device_busy_seconds(registry) == pytest.approx(0.75)
+        assert device_busy_seconds(MetricsRegistry()) == 0.0
+
+    def test_device_busy_seconds_counts_the_serving_execute_stage(self):
+        """Serving engines time the device leg as the execute STAGE
+        (record_compile, not profile_jit), so the profiled family is
+        absent in a serving process — the probe must read both sources
+        or live duty cycle is identically zero."""
+        registry = MetricsRegistry()
+        stages = registry.histogram(
+            "photon_serving_stage_seconds", "test", labels=("stage",))
+        stages.labels(stage="execute").observe(0.3)
+        stages.labels(stage="execute").observe(0.1)
+        stages.labels(stage="parse").observe(9.0)  # never device time
+        assert device_busy_seconds(registry) == pytest.approx(0.4)
+        # both layers present sum (disjoint per process in practice)
+        registry.histogram("photon_execute_latency_seconds", "test",
+                           labels=("fn",)).labels(fn="a").observe(0.25)
+        assert device_busy_seconds(registry) == pytest.approx(0.65)
+
+
+# ---------------------------------------------------------------------------
+# the connection tracker
+# ---------------------------------------------------------------------------
+
+
+class TestConnectionTracker:
+    def test_accounting_identity_through_admits_and_closes(self):
+        t = ConnectionTracker()
+        assert t.connect() and t.connect() and t.connect()
+        t.disconnect(0.5, 2)
+        st = t.stats()
+        assert st["accepted"] == st["closed"] + st["open"]
+        assert (st["accepted"], st["closed"], st["open"]) == (3, 1, 2)
+        assert st["peak"] == 3
+
+    def test_budget_refuses_and_refusals_are_never_open(self):
+        t = ConnectionTracker(max_connections=2)
+        assert t.connect() and t.connect()
+        assert not t.connect()  # refused at the ceiling
+        st = t.stats()
+        assert st["refused"] == 1 and st["open"] == 2
+        assert st["accepted"] == st["closed"] + st["open"]
+        assert t.exhausted() and t.utilization() == 1.0
+        # a refused handler's disconnect is a no-op, not a negative
+        t.disconnect(0.0, 0, admitted=False)
+        assert t.stats() == st
+        t.disconnect(0.1, 1)
+        assert not t.exhausted()
+        assert t.connect()  # the freed slot admits again
+
+    def test_unlimited_budget_never_refuses(self):
+        t = ConnectionTracker(max_connections=0)
+        for _ in range(64):
+            assert t.connect()
+        assert t.utilization() == 0.0 and not t.exhausted()
+
+    def test_idle_tracks_requests_in_flight(self):
+        t = ConnectionTracker()
+        t.connect()
+        assert t.stats()["idle"] == 1  # keep-alive, between requests
+        t.request_begin()
+        assert t.stats()["idle"] == 0 and t.stats()["active"] == 1
+        t.request_end()
+        assert t.stats()["idle"] == 1 and t.stats()["active"] == 0
+
+    def test_capacity_config_round_trip(self):
+        config = CapacityConfig(max_connections=128)
+        assert CapacityConfig.from_dict(config.as_dict()) == config
+        with pytest.raises(ValueError):
+            CapacityConfig(max_connections=-1)
+
+
+# ---------------------------------------------------------------------------
+# the new history series
+# ---------------------------------------------------------------------------
+
+
+CAP_PROM = """\
+# TYPE photon_resource_utilization gauge
+photon_resource_utilization{resource="device",shard="0"} 0.6
+photon_resource_utilization{resource="device",shard="1"} 0.3
+photon_resource_utilization{resource="batcher_queue",shard="0"} 0.2
+photon_resource_utilization{resource="batcher_queue",shard="1"} 0.9
+# TYPE photon_connections_open gauge
+photon_connections_open{shard="0"} 5
+photon_connections_open{shard="1"} 3
+"""
+
+
+class TestCapacityHistorySeries:
+    def test_duty_cycle_sums_device_utilization(self):
+        parsed = parse_text(CAP_PROM)
+        series = derive_series(parsed, parsed, dt_s=1.0)
+        # folded text: device-seconds per second across the fleet
+        assert series["duty_cycle"] == pytest.approx(0.9)
+        assert series["open_connections"] == 8.0
+
+    def test_resource_util_is_the_worst_instance_per_resource(self):
+        series = derive_series({}, parse_text(CAP_PROM), dt_s=1.0)
+        assert series["resource_util"] == {"device": 0.6,
+                                           "batcher_queue": 0.9}
+
+    def test_shard_binding_is_per_shard_argmax(self):
+        series = derive_series({}, parse_text(CAP_PROM), dt_s=1.0)
+        assert series["shard_binding"] == {"0": "device",
+                                           "1": "batcher_queue"}
+
+    def test_shard_binding_tie_breaks_lexicographically(self):
+        text = (
+            "# TYPE photon_resource_utilization gauge\n"
+            'photon_resource_utilization{resource="device",shard="0"}'
+            " 0.5\n"
+            'photon_resource_utilization{resource="batcher_queue",'
+            'shard="0"} 0.5\n')
+        series = derive_series({}, parse_text(text), dt_s=1.0)
+        assert series["shard_binding"] == {"0": "batcher_queue"}
+
+    def test_host_tier_text_yields_no_shard_binding(self):
+        # host-tier gauges carry no shard label — binding is a FOLDED
+        # reading (the fan-out happens in the fleet fold)
+        text = ("# TYPE photon_resource_utilization gauge\n"
+                'photon_resource_utilization{resource="device"} 0.6\n')
+        series = derive_series({}, parse_text(text), dt_s=1.0)
+        assert series["shard_binding"] == {}
+        assert series["duty_cycle"] == pytest.approx(0.6)
+
+
+# ---------------------------------------------------------------------------
+# the capacity report (byte-deterministic golden)
+# ---------------------------------------------------------------------------
+
+
+QUEUE_SATURATED_HISTORY = {
+    "source": "fleet", "capacity": 240,
+    "snapshots": [
+        {"tick": 1, "ts": 100.0, "series": {
+            "requests": 50.0, "latency_p99": 0.004,
+            "duty_cycle": 0.2, "open_connections": 4.0,
+            "resource_util": {"device": 0.2, "batcher_queue": 0.1,
+                              "http_connections": 0.05}}},
+        {"tick": 2, "ts": 110.0, "series": {
+            "requests": 600.0, "latency_p99": 0.018,
+            "duty_cycle": 0.55, "open_connections": 14.0,
+            "resource_util": {"device": 0.55, "batcher_queue": 0.9,
+                              "http_connections": 0.35}}},
+    ],
+}
+
+DEVICE_SATURATED_HISTORY = {
+    "source": "fleet", "capacity": 240,
+    "snapshots": [
+        {"tick": 1, "ts": 100.0, "series": {
+            "requests": 50.0, "latency_p99": 0.004,
+            "duty_cycle": 0.3, "open_connections": 4.0,
+            "resource_util": {"device": 0.3, "batcher_queue": 0.05,
+                              "http_connections": 0.05}}},
+        {"tick": 2, "ts": 110.0, "series": {
+            "requests": 800.0, "latency_p99": 0.031,
+            "duty_cycle": 0.96, "open_connections": 10.0,
+            "resource_util": {"device": 0.96, "batcher_queue": 0.2,
+                              "http_connections": 0.25}}},
+    ],
+}
+
+EXPECTED_QUEUE_REPORT = """\
+== photon capacity report ==
+2 retained tick(s); source fleet; SLO objective 20ms
+
+-- binding resource per window (last 2 of 2) --
+tick        qps   duty  conns   p99_ms binding              util
+t1            -  0.200      4    4.000 device              0.200
+t2           60  0.550     14   18.000 batcher_queue       0.900
+
+-- max-sustainable-QPS projection --
+peak evidence at t2: 60 qps with batcher_queue at 90.0% utilization
+linear projection: ~66.67 qps sustainable (headroom ~6.667 qps) \
+before batcher_queue saturates
+p99 18.000ms within the 20ms objective at the peak window
+"""
+
+EXPECTED_DEVICE_REPORT = """\
+== photon capacity report ==
+2 retained tick(s); source fleet; SLO objective 20ms
+
+-- binding resource per window (last 2 of 2) --
+tick        qps   duty  conns   p99_ms binding              util
+t1            -  0.300      4    4.000 device              0.300
+t2           80  0.960     10   31.000 device              0.960
+
+-- max-sustainable-QPS projection --
+peak evidence at t2: 80 qps with device at 96.0% utilization
+linear projection: ~83.33 qps sustainable (headroom ~3.333 qps) \
+before device saturates
+WARNING: p99 31.000ms already exceeds the 20ms objective at the peak \
+window — headroom is 0 regardless of utilization
+"""
+
+
+class TestCapacityReport:
+    def test_queue_saturated_golden_names_the_queue(self):
+        import capacity_report
+
+        got = capacity_report.build_report(QUEUE_SATURATED_HISTORY,
+                                           slo_objective_ms=20.0)
+        assert got == EXPECTED_QUEUE_REPORT
+        # pure function: same artifacts, same bytes
+        assert got == capacity_report.build_report(
+            QUEUE_SATURATED_HISTORY, slo_objective_ms=20.0)
+
+    def test_device_saturated_golden_names_the_device(self):
+        import capacity_report
+
+        got = capacity_report.build_report(DEVICE_SATURATED_HISTORY,
+                                           slo_objective_ms=20.0)
+        assert got == EXPECTED_DEVICE_REPORT
+
+    def test_per_shard_table_reads_the_folded_snapshot(self):
+        import capacity_report
+
+        got = capacity_report.build_report(QUEUE_SATURATED_HISTORY,
+                                           CAP_PROM,
+                                           slo_objective_ms=20.0)
+        assert "-- per-shard capacity (folded snapshot) --" in got
+        lines = got.splitlines()
+        s0 = next(row for row in lines if row.startswith("0 "))
+        s1 = next(row for row in lines if row.startswith("1 "))
+        assert "device" in s0 and "0.600" in s0 and s0.rstrip().endswith("5")
+        assert "batcher_queue" in s1 and "0.900" in s1
+
+    def test_no_saturation_evidence_degrades_gracefully(self):
+        import capacity_report
+
+        idle = {"source": "host", "snapshots": [
+            {"tick": 1, "ts": 1.0, "series": {"requests": 0.0,
+                                              "resource_util": {}}}]}
+        got = capacity_report.build_report(idle)
+        assert "no saturation evidence" in got
+        assert "(none)" in got
+
+    def test_cli_round_trip_and_missing_history(self, tmp_path, capsys):
+        import capacity_report
+
+        run_dir = tmp_path / "artifacts"
+        run_dir.mkdir()
+        (run_dir / "history.json").write_text(
+            json.dumps(QUEUE_SATURATED_HISTORY))
+        (run_dir / "metrics.aggregate.prom").write_text(CAP_PROM)
+        assert capacity_report.main(
+            [str(run_dir), "--slo-objective-ms", "20"]) == 0
+        out = capsys.readouterr().out
+        assert out.startswith(EXPECTED_QUEUE_REPORT.rstrip("\n"))
+        assert "-- per-shard capacity (folded snapshot) --" in out
+        assert capacity_report.main([str(tmp_path / "nope")]) == 1
+
+
+# ---------------------------------------------------------------------------
+# the advisor's binding annotation
+# ---------------------------------------------------------------------------
+
+
+class TestAdvisorBinding:
+    class _SynthHistory:
+        def __init__(self):
+            self.snaps = []
+
+        def feed(self, tick, p99_by_shard, binding=None):
+            self.snaps.append({
+                "tick": tick, "ts": float(tick),
+                "series": {"shard_p99": dict(p99_by_shard),
+                           "shard_load": {},
+                           "shard_binding": dict(binding or {})}})
+
+        def snapshots(self, window=0):
+            return self.snaps[-window:] if window else list(self.snaps)
+
+    def test_detection_and_advice_carry_the_binding_resource(self):
+        from photon_ml_tpu.fleet.advisor import HotShardAdvisor
+        from photon_ml_tpu.fleet.sharding import ShardMap
+
+        history = self._SynthHistory()
+        advisor = HotShardAdvisor(
+            history=history, shard_map_fn=lambda: ShardMap.default(2),
+            sustain_ticks=2)
+        detections = []
+        for tick in (1, 2):
+            history.feed(tick, {"0": 0.050, "1": 0.010},
+                         binding={"0": "batcher_queue", "1": "device"})
+            detections += advisor.tick()
+        assert [d["shard"] for d in detections] == [0]
+        assert detections[0]["binding_resource"] == "batcher_queue"
+        rec = advisor.recommendation()
+        assert rec["binding_resources"] == {"0": "batcher_queue"}
+        assert advisor.status()["shards"]["0"]["binding_resource"] \
+            == "batcher_queue"
+
+    def test_missing_binding_series_reads_unknown(self):
+        from photon_ml_tpu.fleet.advisor import HotShardAdvisor
+        from photon_ml_tpu.fleet.sharding import ShardMap
+
+        history = self._SynthHistory()
+        advisor = HotShardAdvisor(
+            history=history, shard_map_fn=lambda: ShardMap.default(2),
+            sustain_ticks=1)
+        history.feed(1, {"0": 0.050, "1": 0.010})
+        (det,) = advisor.tick()
+        assert det["binding_resource"] == "unknown"
+
+
+# ---------------------------------------------------------------------------
+# the serving integration (budget refusal + plane-is-free)
+# ---------------------------------------------------------------------------
+
+
+class TestConnectionBudgetHttp:
+    def test_exhaustion_is_a_typed_503_then_recovers(self, trained):
+        server = serve_game_cli.build_server([
+            "--model-dir", trained["model"],
+            "--feature-shards", SHARDS,
+            "--port", "0", "--max-batch", "8", "--no-warmup",
+            "--max-connections", "2", "--history-period-s", "0",
+        ]).start()
+        u = urllib.parse.urlparse(server.url)
+        conns = []
+        try:
+            for _ in range(2):
+                c = http.client.HTTPConnection(u.hostname, u.port,
+                                               timeout=30)
+                c.request("GET", "/healthz")
+                resp = c.getresponse()
+                resp.read()  # drain: the socket stays open idle
+                assert resp.status == 200
+                conns.append(c)
+            over = http.client.HTTPConnection(u.hostname, u.port,
+                                              timeout=30)
+            over.request("GET", "/healthz")
+            resp = over.getresponse()
+            body = json.loads(resp.read())
+            assert resp.status == 503
+            assert resp.getheader("Connection") == "close"
+            assert resp.getheader("Retry-After") is not None
+            assert body["reason"] == "connections"
+            over.close()
+            # an admitted keep-alive socket still serves /readyz, which
+            # reports WHY the next connection would bounce
+            conns[0].request("GET", "/readyz")
+            ready = conns[0].getresponse()
+            ready_body = json.loads(ready.read())
+            assert ready.status == 503
+            assert "connections_exhausted" in ready_body["reasons"]
+            assert ready_body["connections"]["budget"] == 2
+            for c in conns:
+                c.close()
+            conns = []
+            # budget freed: admission and readiness recover
+            deadline = __import__("time").monotonic() + 30
+            while __import__("time").monotonic() < deadline:
+                health = _get(server.url + "/healthz")
+                if health["connections"]["open"] <= 1:
+                    break
+            ready = _get(server.url + "/readyz")
+            assert ready["ready"] is True
+            st = _get(server.url + "/healthz")["connections"]
+            assert st["accepted"] == st["closed"] + st["open"]
+            assert st["refused"] == 1
+        finally:
+            for c in conns:
+                c.close()
+            server.stop()
+
+    def test_plane_is_free_with_everything_armed(self, trained):
+        """Acceptance gate: f32 scores bit-identical to an unsharded
+        registry and ZERO steady-state recompiles with the saturation
+        sampler, connection tracker and --max-connections all armed
+        while /metrics and /history scrapes interleave."""
+        plain = ModelRegistry(SHARD_CONFIGS, max_batch=16)
+        base_scores = plain.load(trained["model"]).score(
+            trained["requests"])
+
+        server = serve_game_cli.build_server([
+            "--model-dir", trained["model"],
+            "--feature-shards", SHARDS,
+            "--port", "0", "--max-batch", "16", "--max-wait-ms", "1",
+            "--max-queue", "64", "--max-connections", "32",
+            "--history-period-s", "0",
+        ]).start()
+        try:
+            service = server.service
+            assert server.saturation is not None
+            assert "device" in server.saturation.resources()
+            engine = service.registry.active().engine
+            frozen = engine.compile_count
+            for i in range(3):
+                out = _post(server.url + "/score",
+                            {"records": trained["requests"]})
+                # the retained ring ticks (pre_sample runs the USE
+                # probes) BETWEEN scoring rounds, with scrapes riding
+                server.history.sample(now=100.0 + i)
+                with urllib.request.urlopen(server.url + "/metrics",
+                                            timeout=60) as resp:
+                    text = resp.read().decode()
+                assert "photon_resource_utilization" in text
+                hist = _get(server.url
+                            + "/history?series=duty_cycle,"
+                              "open_connections,resource_util")
+                newest = hist["snapshots"][-1]["series"]
+                assert set(newest) == {"duty_cycle",
+                                       "open_connections",
+                                       "resource_util"}
+                assert newest["duty_cycle"] >= 0.0
+            assert np.array_equal(
+                np.asarray(out["scores"], np.float32), base_scores)
+            assert engine.compile_count == frozen
+            st = _get(server.url + "/healthz")["connections"]
+            assert st["accepted"] == st["closed"] + st["open"]
+            assert st["refused"] == 0
+        finally:
+            server.stop()
+
+    def test_connection_histograms_observe_lifetimes(self, trained):
+        from photon_ml_tpu.telemetry import metrics as _metrics
+
+        server = serve_game_cli.build_server([
+            "--model-dir", trained["model"],
+            "--feature-shards", SHARDS,
+            "--port", "0", "--max-batch", "8", "--no-warmup",
+            "--history-period-s", "0",
+        ]).start()
+        try:
+            reg = _metrics.default_registry()
+            life0 = reg.get("photon_connection_lifetime_seconds")
+            count0 = life0.count if life0 is not None else 0
+            _get(server.url + "/healthz")
+            deadline = __import__("time").monotonic() + 30
+            while __import__("time").monotonic() < deadline:
+                life = reg.get("photon_connection_lifetime_seconds")
+                if life is not None and life.count > count0:
+                    break
+            assert reg.get("photon_connection_lifetime_seconds").count \
+                > count0
+            reqs = reg.get("photon_connection_requests")
+            assert reqs is not None and reqs.count >= 1
+        finally:
+            server.stop()

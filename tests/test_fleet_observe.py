@@ -53,6 +53,7 @@ from photon_ml_tpu.serving.http import (
 )
 from photon_ml_tpu.telemetry import bridge, tracing
 from photon_ml_tpu.telemetry.prometheus import parse_text, series_value
+from photon_ml_tpu.telemetry.saturation import RESOURCES
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.join(REPO, "tools"))
@@ -605,6 +606,30 @@ class TestRetainedHistory:
         folded = metrics_fold.fold_metrics(str(run_dir))
         assert open(folded).read() == newest["prom"]
 
+    def test_capacity_series_serve_on_both_tiers(self, env):
+        """The four capacity series (ISSUE 20) ride the retained ring on
+        the host tier AND the router's fold; shard attribution only
+        exists on the folded tick (host-tier shard_binding is {})."""
+        fleet = env["fleet"]
+        self._tick_all(fleet, 150.0)
+        q = ("/history?window=1&series=duty_cycle,open_connections,"
+             "resource_util,shard_binding")
+        host_snap = _get(fleet.hosts[0].url + q)["snapshots"][-1]
+        assert host_snap["series"]["shard_binding"] == {}
+        assert host_snap["series"]["open_connections"] >= 0.0
+        # the host's own USE gauges carry at least the device resource
+        assert "device" in host_snap["series"]["resource_util"]
+        body = _get(fleet.url + q)
+        assert body["source"] == "fleet"
+        snap = body["snapshots"][-1]["series"]
+        assert set(snap) == {"duty_cycle", "open_connections",
+                             "resource_util", "shard_binding"}
+        # folded: every shard attributes a binding resource, and the
+        # names stay inside the closed vocabulary
+        assert set(snap["shard_binding"]) == {"0", "1"}
+        assert set(snap["shard_binding"].values()) <= set(RESOURCES)
+        assert snap["duty_cycle"] >= 0.0
+
     def test_advisor_endpoint_rides_the_router_ring(self, env):
         fleet = env["fleet"]
         before = _get(fleet.url + "/advisor")
@@ -745,17 +770,20 @@ replicas up per shard: s0=2 s1=1
 REPORT_HISTORY = {
     "source": "fleet", "capacity": 240,
     "series": ["requests", "shed_rate", "hedge_rate", "latency_p50",
-               "latency_p99", "queue_depth", "slo_burn", "shard_p99"],
+               "latency_p99", "queue_depth", "duty_cycle",
+               "open_connections", "slo_burn", "shard_p99"],
     "snapshots": [
         {"tick": 7, "ts": 100.0, "series": {
             "requests": 24.0, "shed_rate": 0.0, "hedge_rate": 0.125,
             "latency_p50": 0.004, "latency_p99": 0.012,
-            "queue_depth": 0.0, "slo_burn": 0.0,
+            "queue_depth": 0.0, "duty_cycle": 1.25,
+            "open_connections": 6.0, "slo_burn": 0.0,
             "shard_p99": {"0": 0.012, "1": 0.008}}},
         {"tick": 8, "ts": 101.0, "series": {
             "requests": 30.0, "shed_rate": 0.0625, "hedge_rate": 0.1,
             "latency_p50": 0.005, "latency_p99": 0.0301,
-            "queue_depth": 2.0, "slo_burn": 1.0,
+            "queue_depth": 2.0, "duty_cycle": 2.75,
+            "open_connections": 8.0, "slo_burn": 1.0,
             "shard_p99": {"0": 0.009, "1": 0.0301}}},
     ],
 }
@@ -766,31 +794,38 @@ REPORT_ADVISOR = {
                "sustain_ticks": 3},
     "shards": {
         "0": {"p99_s": 0.009, "p99_ratio": 0.299, "load": 1.0,
-              "load_ratio": 0.6667, "skew": 0.6667},
+              "load_ratio": 0.6667, "skew": 0.6667,
+              "binding_resource": "device"},
         "1": {"p99_s": 0.0301, "p99_ratio": 3.3444, "load": 2.0,
-              "load_ratio": 1.5, "skew": 3.3444},
+              "load_ratio": 1.5, "skew": 3.3444,
+              "binding_resource": "batcher_queue"},
     },
     "recommendation": {"kind": "scale_out", "n_shards": 3,
                        "base_version": 3,
                        "base_hash": "deadbeefcafe1234",
                        "n_moves": 1365, "moves_from_hot": 683,
+                       "binding_resources": {"1": "batcher_queue"},
                        "moves": {}},
 }
 
 EXPECTED_RETAINED_TAIL = """\
 -- fleet timeline (last 2 of 2 retained tick(s), source fleet) --
 t7 requests=24 shed_rate=0 hedge_rate=0.125 latency_p50=0.004 \
-latency_p99=0.012 queue_depth=0 slo_burn=0 hottest=s0:12.000ms
+latency_p99=0.012 queue_depth=0 duty_cycle=1.25 open_connections=6 \
+slo_burn=0 hottest=s0:12.000ms
 t8 requests=30 shed_rate=0.0625 hedge_rate=0.1 latency_p50=0.005 \
-latency_p99=0.0301 queue_depth=2 slo_burn=1 hottest=s1:30.100ms
+latency_p99=0.0301 queue_depth=2 duty_cycle=2.75 open_connections=8 \
+slo_burn=1 hottest=s1:30.100ms
 
 -- hot-shard advisor --
 hot: s1; 1 detection(s) over 42 tick(s) (enter 2.0x, exit 1.25x, \
 sustain 3)
-  s0: skew 0.6667x (p99 9.000ms ratio 0.299; load 1.0 ratio 0.6667)
-  s1: skew 3.3444x (p99 30.100ms ratio 3.3444; load 2.0 ratio 1.5)
+  s0: skew 0.6667x (p99 9.000ms ratio 0.299; load 1.0 ratio 0.6667; \
+binding device)
+  s1: skew 3.3444x (p99 30.100ms ratio 3.3444; load 2.0 ratio 1.5; \
+binding batcher_queue)
 advice: scale_out to 3 shard(s) — 1365 bucket move(s), 683 off hot \
-shard(s), from map v3
+shard(s), from map v3 — binding: s1=batcher_queue
 """
 
 

@@ -294,7 +294,7 @@ class TestDeadlineHttp:
         assert out["version"] == 1
         assert out["queue_depth"] == 0
         assert set(out["shed"]) == {"queue_full", "deadline", "brownout",
-                                    "upstream"}
+                                    "connections", "upstream"}
         assert out["brownout_level"] == 0
         # /healthz mirrors the same overload story
         health = _get(server.url + "/healthz")

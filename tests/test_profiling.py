@@ -390,6 +390,39 @@ class TestBenchGate:
         assert v["verdict"] == "regression"
         assert v["regressions"][0]["metric"] == "gone"
 
+    def test_saturation_families_absent_from_baseline_never_gate(
+            self, tmp_path):
+        """Old baselines predate the capacity plane: duty_cycle /
+        conn_peak readings in the current run must be surfaced as
+        ``new_nongating``, not compared (bench_gate module docstring)."""
+        cur = self._write(tmp_path, "c.json",
+                          _summary({"serving_slo_qps": 95.0,
+                                    "duty_cycle": 0.82,
+                                    "conn_peak": 4.0}))
+        base = self._write(tmp_path, "b.json",
+                           _summary({"serving_slo_qps": 100.0}))
+        v = bench_gate.gate(bench_gate.load_artifact(cur),
+                            bench_gate.load_artifact(base), threshold=0.3)
+        assert v["verdict"] == "ok"
+        assert v["compared"] == 1
+        assert v["new_nongating"] == ["conn_peak", "duty_cycle"]
+
+    def test_capacity_extras_inside_metric_payloads_are_invisible(
+            self, tmp_path):
+        """bench.py attaches duty_cycle/conn_peak as per-line extras
+        inside the metric payload; the gate reads only ``value``, so an
+        old baseline without them compares clean."""
+        doc = _summary({"serving_slo_qps": 95.0})
+        doc["metrics"]["serving_slo_qps"].update(
+            {"duty_cycle": 0.82, "conn_peak": 4})
+        cur = self._write(tmp_path, "c.json", doc)
+        base = self._write(tmp_path, "b.json",
+                           _summary({"serving_slo_qps": 100.0}))
+        v = bench_gate.gate(bench_gate.load_artifact(cur),
+                            bench_gate.load_artifact(base), threshold=0.3)
+        assert v["verdict"] == "ok" and v["compared"] == 1
+        assert "new_nongating" not in v
+
     def test_infra_failure_on_error_key_and_rc(self, tmp_path):
         cur = self._write(tmp_path, "c.json",
                           _summary({}, error="device unreachable"))

@@ -31,6 +31,18 @@ Artifact shapes accepted, for both sides: the harness wrapper
 (``{"rc": N, "parsed": {..suite_summary..}}`` — the BENCH_rNN.json files)
 and a bare ``suite_summary`` object (the last stdout line of ``bench.py``).
 
+**Saturation/capacity families are non-gating against old baselines.**
+The capacity plane (telemetry/saturation.py, PR 20) taught ``bench.py``
+to emit ``duty_cycle`` / ``conn_peak`` readings; baselines recorded
+before that plane existed simply lack them. The gate iterates BASELINE
+metric names, so a metric present only in the current run never gates —
+but that must be a contract, not an accident: ``SATURATION_FAMILIES``
+names the families, and the verdict surfaces them under
+``new_nongating`` so a reviewer sees they were measured and deliberately
+not compared (they become comparable once they land in a baseline).
+Capacity readings attached as per-line *extras* inside a metric payload
+never reach ``artifact_metrics`` at all — only ``value`` is read.
+
 Usage::
 
     python tools/bench_gate.py CURRENT.json [BASELINE.json]
@@ -52,6 +64,17 @@ VERDICT_MISSING_BASELINE = "missing-baseline"
 
 EXIT_CODES = {VERDICT_OK: 0, VERDICT_MISSING_BASELINE: 0,
               VERDICT_REGRESSION: 1, VERDICT_INFRA: 2}
+
+#: capacity-plane metric-name prefixes (see module docstring): absent
+#: from pre-plane baselines by construction, so their appearance in a
+#: current run is reported (``new_nongating``) but never compared
+SATURATION_FAMILIES = ("duty_cycle", "conn_peak",
+                       "photon_resource_", "photon_connection")
+
+
+def is_saturation_family(name: str) -> bool:
+    """True when ``name`` belongs to a capacity-plane family."""
+    return any(name.startswith(prefix) for prefix in SATURATION_FAMILIES)
 
 
 def normalize_artifact(doc: Mapping) -> dict:
@@ -145,6 +168,13 @@ def gate(current: Optional[Mapping], baseline: Optional[Mapping],
                 if n in cur and base[n] and cur[n] / base[n] > 1.0 + threshold}
     if improved:
         out["improved"] = improved
+    # Saturation/capacity families measured now but absent from an older
+    # baseline: surfaced, never gated (module docstring). Other
+    # current-only metrics stay silent, as before.
+    new_nongating = sorted(n for n in cur
+                           if n not in base and is_saturation_family(n))
+    if new_nongating:
+        out["new_nongating"] = new_nongating
     return out
 
 

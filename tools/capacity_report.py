@@ -1,0 +1,256 @@
+#!/usr/bin/env python
+"""Capacity report: bottleneck attribution from saved fleet artifacts.
+
+Where ``fleet_report.py`` answers "which shard is hot", this tool
+answers the capacity planner's questions — WHAT resource binds first,
+how much sustainable throughput is left before it saturates, and which
+shard hits its wall soonest — from artifacts the observability plane
+already saves:
+
+- ``history.json`` — a saved ``GET /history`` body (host or router
+  tier). Every retained tick carries the USE-method series the
+  saturation sampler derived (``resource_util``, ``duty_cycle``,
+  ``open_connections`` — telemetry/saturation.py), so the report's
+  per-window binding resource is read straight off the ring;
+- ``metrics.aggregate.prom`` (or ``metrics.prom``) — a saved fleet
+  ``GET /metrics`` fold (optional: the per-shard capacity table needs
+  the fold's fanned-out host-owned gauges; a host-tier snapshot renders
+  without shard attribution).
+
+The **binding resource** of a window is the argmax of that tick's
+per-resource utilization (ties break to the lexicographically-first
+resource — deterministic, like every vocabulary in this codebase). The
+**max-sustainable-QPS projection** scales the observed rate by the
+binding resource's remaining headroom: at utilization ``u`` with
+observed rate ``q``, the linear projection is ``q / u`` — a first-order
+estimate (real systems curve near saturation), which is why the report
+prints it against the ``--slo-objective-ms`` evidence: a window whose
+p99 already exceeds the objective has NO headroom regardless of the
+utilization arithmetic.
+
+The report is a pure function of its inputs (no clocks, no environment
+reads) — the golden test feeds fixture artifacts and compares bytes.
+
+Usage::
+
+    python tools/capacity_report.py DIR [--slo-objective-ms MS]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Mapping, Optional, Sequence
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from photon_ml_tpu.telemetry import prometheus as tprom  # noqa: E402
+
+#: timeline windows rendered — the ring holds more; the page shows the
+#: recent trend (matches fleet_report's tail length)
+WINDOW_TAIL = 12
+
+
+def binding_of(resource_util: Mapping) -> "tuple[str, float]":
+    """(resource, utilization) with the highest utilization; ties break
+    to the lexicographically-first resource name. ``("(none)", 0.0)``
+    when the tick carries no utilization evidence."""
+    best: Optional[tuple[str, float]] = None
+    for resource in sorted(resource_util):
+        value = float(resource_util[resource])
+        if best is None or value > best[1]:
+            best = (str(resource), value)
+    return best if best is not None else ("(none)", 0.0)
+
+
+def window_rows(history: Mapping) -> list[dict]:
+    """One row per retained tick: observed rate (requests over the
+    inter-tick wall time), duty cycle, open connections, p99, and the
+    binding resource. The first tick has no predecessor, so its rate is
+    None (rendered ``-``)."""
+    rows: list[dict] = []
+    prev_ts: Optional[float] = None
+    for snap in history.get("snapshots", ()):
+        series = snap.get("series") or {}
+        ts = snap.get("ts")
+        qps: Optional[float] = None
+        requests = series.get("requests")
+        if (requests is not None and prev_ts is not None
+                and ts is not None and ts > prev_ts):
+            qps = float(requests) / (float(ts) - float(prev_ts))
+        binding, util = binding_of(series.get("resource_util") or {})
+        rows.append({
+            "tick": snap.get("tick"),
+            "qps": qps,
+            "requests": requests,
+            "duty_cycle": series.get("duty_cycle"),
+            "open_connections": series.get("open_connections"),
+            "p99_s": series.get("latency_p99"),
+            "binding": binding,
+            "binding_util": util,
+        })
+        prev_ts = float(ts) if ts is not None else prev_ts
+    return rows
+
+
+def projection(rows: Sequence[Mapping],
+               slo_objective_ms: float) -> Optional[dict]:
+    """Max-sustainable-QPS estimate from the window with the most
+    saturation evidence: the FIRST row with the highest binding
+    utilization and an observed rate. None when no window carries both
+    a rate and non-zero utilization."""
+    peak: Optional[Mapping] = None
+    for row in rows:
+        if row["qps"] is None or row["binding_util"] <= 0.0:
+            continue
+        if peak is None or row["binding_util"] > peak["binding_util"]:
+            peak = row
+    if peak is None:
+        return None
+    max_qps = peak["qps"] / peak["binding_util"]
+    p99_ms = (None if peak["p99_s"] is None
+              else float(peak["p99_s"]) * 1e3)
+    slo_ok = (None if (p99_ms is None or slo_objective_ms <= 0)
+              else p99_ms <= slo_objective_ms)
+    return {"tick": peak["tick"], "qps": peak["qps"],
+            "binding": peak["binding"],
+            "binding_util": peak["binding_util"],
+            "max_qps": max_qps, "headroom_qps": max_qps - peak["qps"],
+            "p99_ms": p99_ms, "slo_ok": slo_ok}
+
+
+def shard_capacity(parsed: Mapping) -> list[dict]:
+    """Per-shard binding resource from a FOLDED snapshot, where the
+    host-owned ``photon_resource_utilization`` gauges carry both
+    ``shard`` and ``resource`` labels (tools/metrics_fold.py /
+    fleet/observe.py). Empty on a host-tier snapshot."""
+    best: dict[str, tuple[str, float]] = {}
+    opens: dict[str, float] = {}
+    for labels, value in parsed.get("photon_resource_utilization", ()):
+        shard, resource = labels.get("shard"), labels.get("resource")
+        if shard is None or resource is None:
+            continue
+        value = float(value)
+        cur = best.get(shard)
+        if (cur is None or value > cur[1]
+                or (value == cur[1] and resource < cur[0])):
+            best[shard] = (str(resource), value)
+    for labels, value in parsed.get("photon_connections_open", ()):
+        shard = labels.get("shard")
+        if shard is not None:
+            opens[shard] = opens.get(shard, 0.0) + float(value)
+    return [{"shard": s, "binding": best[s][0], "util": best[s][1],
+             "open_connections": opens.get(s, 0.0)}
+            for s in sorted(best, key=lambda k: (len(k), k))]
+
+
+def build_report(history: Mapping, prom_text: str = "",
+                 slo_objective_ms: float = 0.0) -> str:
+    """The report text (the CLI prints it; tests golden-compare it)."""
+    lines: list[str] = ["== photon capacity report =="]
+    rows = window_rows(history)
+    bits = [f"{len(rows)} retained tick(s)",
+            f"source {history.get('source')}"]
+    if slo_objective_ms > 0:
+        bits.append(f"SLO objective {slo_objective_ms:g}ms")
+    lines.append("; ".join(bits))
+
+    # --- per-window binding ------------------------------------------------
+    lines.append("")
+    lines.append(f"-- binding resource per window (last "
+                 f"{min(len(rows), WINDOW_TAIL)} of {len(rows)}) --")
+    lines.append(f"{'tick':<6} {'qps':>8} {'duty':>6} {'conns':>6} "
+                 f"{'p99_ms':>8} {'binding':<18} {'util':>6}")
+    for row in rows[-WINDOW_TAIL:] or ():
+        qps = "-" if row["qps"] is None else f"{row['qps']:.4g}"
+        duty = ("-" if row["duty_cycle"] is None
+                else f"{row['duty_cycle']:.3f}")
+        conns = ("-" if row["open_connections"] is None
+                 else f"{int(row['open_connections'])}")
+        p99 = ("-" if row["p99_s"] is None
+               else f"{row['p99_s'] * 1e3:.3f}")
+        lines.append(
+            f"t{row['tick']:<5} {qps:>8} {duty:>6} {conns:>6} "
+            f"{p99:>8} {row['binding']:<18} "
+            f"{row['binding_util']:>6.3f}")
+    if not rows:
+        lines.append("(no snapshots retained)")
+
+    # --- projection --------------------------------------------------------
+    proj = projection(rows, slo_objective_ms)
+    lines.append("")
+    lines.append("-- max-sustainable-QPS projection --")
+    if proj is None:
+        lines.append("no saturation evidence (no window carries both an "
+                     "observed rate and non-zero utilization)")
+    else:
+        lines.append(
+            f"peak evidence at t{proj['tick']}: {proj['qps']:.4g} qps "
+            f"with {proj['binding']} at "
+            f"{proj['binding_util'] * 100:.1f}% utilization")
+        lines.append(
+            f"linear projection: ~{proj['max_qps']:.4g} qps sustainable "
+            f"(headroom ~{proj['headroom_qps']:.4g} qps) before "
+            f"{proj['binding']} saturates")
+        if proj["slo_ok"] is False:
+            lines.append(
+                f"WARNING: p99 {proj['p99_ms']:.3f}ms already exceeds "
+                f"the {slo_objective_ms:g}ms objective at the peak "
+                f"window — headroom is 0 regardless of utilization")
+        elif proj["slo_ok"] is True:
+            lines.append(
+                f"p99 {proj['p99_ms']:.3f}ms within the "
+                f"{slo_objective_ms:g}ms objective at the peak window")
+
+    # --- per-shard capacity ------------------------------------------------
+    if prom_text:
+        shards = shard_capacity(tprom.parse_text(prom_text))
+        if shards:
+            lines.append("")
+            lines.append("-- per-shard capacity (folded snapshot) --")
+            lines.append(f"{'shard':<6} {'binding':<18} {'util':>6} "
+                         f"{'conns':>6}")
+            for row in shards:
+                lines.append(
+                    f"{row['shard']:<6} {row['binding']:<18} "
+                    f"{row['util']:>6.3f} "
+                    f"{int(row['open_connections']):>6d}")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        description="Render a capacity/bottleneck report from saved "
+                    "observability artifacts (history ring + metrics "
+                    "fold)")
+    p.add_argument("run_dir", help="directory holding the saved "
+                                   "artifacts")
+    p.add_argument("--slo-objective-ms", type=float, default=0.0,
+                   help="latency objective the projection is judged "
+                        "against (same value as serve_fleet "
+                        "--slo-objective-ms); 0 = skip the check")
+    args = p.parse_args(argv)
+    history_path = os.path.join(args.run_dir, "history.json")
+    if not os.path.exists(history_path):
+        print(f"no history.json under {args.run_dir} (save the server's "
+              f"GET /history body — the capacity plane's evidence lives "
+              f"in the retained ring)", file=sys.stderr)
+        return 1
+    with open(history_path, encoding="utf-8") as f:
+        history = json.load(f)
+    prom_text = ""
+    for name in ("metrics.aggregate.prom", "metrics.prom"):
+        prom_path = os.path.join(args.run_dir, name)
+        if os.path.exists(prom_path):
+            with open(prom_path, encoding="utf-8") as f:
+                prom_text = f.read()
+            break
+    sys.stdout.write(build_report(
+        history, prom_text, slo_objective_ms=args.slo_objective_ms))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

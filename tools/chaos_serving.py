@@ -287,6 +287,12 @@ def run_fleet_chaos(args) -> int:
 
             # --- cell 2: kill one host mid-load, then restart it --------
             cell = {"cell": "host-kill"}
+            # capacity-plane baseline: the survivor's open-connection
+            # gauge before the storm (its own /healthz socket included,
+            # so the post-recovery read is like-for-like)
+            survivor_url = fleet.hosts[0].url
+            conn_before = bench_serving._http_json(
+                survivor_url + "/healthz")["connections"]["open"]
             victim = fleet.hosts[1]
             victim_port = victim.port
             killer = threading.Timer(
@@ -314,6 +320,30 @@ def run_fleet_chaos(args) -> int:
             ready = bench_serving._http_json(base + "/readyz")
             if not ready["ready"]:
                 problems.append(f"fleet not ready after restart: {ready}")
+            # capacity plane under chaos: once the load stops, every
+            # live host's connection books must balance (accepted ==
+            # closed + open is a single-lock snapshot identity) and the
+            # survivor's open-connection gauge must drain back to its
+            # pre-kill baseline — leaked sockets would show up here
+            deadline = time.monotonic() + 10.0
+            conn_after = None
+            while time.monotonic() < deadline:
+                conn_after = bench_serving._http_json(
+                    survivor_url + "/healthz")["connections"]["open"]
+                if conn_after <= conn_before:
+                    break
+                time.sleep(0.2)
+            if conn_after is None or conn_after > conn_before:
+                problems.append(
+                    f"open-connection gauge did not return to its "
+                    f"pre-kill baseline ({conn_after} > {conn_before})")
+            for live in fleet.hosts:
+                stats = bench_serving._http_json(
+                    live.url + "/healthz")["connections"]
+                if stats["accepted"] != stats["closed"] + stats["open"]:
+                    problems.append(
+                        f"connection accounting identity broke on "
+                        f"{live.url}: {stats}")
             cell["ok"] = not problems
             cells.append(cell)
             print(f"[chaos-serving] fleet host-kill: "

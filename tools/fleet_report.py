@@ -121,7 +121,8 @@ def timeline_rows(history: Mapping) -> list[str]:
         series = snap.get("series") or {}
         bits = [f"t{snap.get('tick')}"]
         for key in ("requests", "shed_rate", "hedge_rate", "latency_p50",
-                    "latency_p99", "queue_depth", "slo_burn"):
+                    "latency_p99", "queue_depth", "duty_cycle",
+                    "open_connections", "slo_burn"):
             value = series.get(key)
             if value is None:
                 continue
@@ -261,18 +262,27 @@ def build_report(prom_text: str, statusz: Optional[Mapping] = None,
         shards = advisor.get("shards") or {}
         for s in sorted(shards, key=lambda k: (len(k), k)):
             ev = shards[s]
+            # binding resource rides along when the capacity plane is
+            # armed (saved advisor bodies predating it render unchanged)
+            binding = (f"; binding {ev['binding_resource']}"
+                       if "binding_resource" in ev else "")
             lines.append(
                 f"  s{s}: skew {ev.get('skew')}x (p99 "
                 f"{ev.get('p99_s', 0.0) * 1e3:.3f}ms ratio "
                 f"{ev.get('p99_ratio')}; load {ev.get('load')} ratio "
-                f"{ev.get('load_ratio')})")
+                f"{ev.get('load_ratio')}{binding})")
         rec = advisor.get("recommendation")
         if rec is not None:
+            bindings = rec.get("binding_resources") or {}
+            bound = ("" if not bindings else
+                     " — binding: " + " ".join(
+                         f"s{s}={bindings[s]}" for s in
+                         sorted(bindings, key=lambda k: (len(k), k))))
             lines.append(
                 f"advice: {rec.get('kind')} to {rec.get('n_shards')} "
                 f"shard(s) — {rec.get('n_moves')} bucket move(s), "
                 f"{rec.get('moves_from_hot')} off hot shard(s), from "
-                f"map v{rec.get('base_version')}")
+                f"map v{rec.get('base_version')}{bound}")
         else:
             lines.append("advice: none (fleet is cool)")
     return "\n".join(lines) + "\n"
